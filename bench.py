@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Headline benchmark — one JSON line for the driver.
+
+Current flagship config: exact brute-force kNN on SIFT-shaped synthetic
+data (1M × 128 float32, k=10, query batch 10 — the reference's
+"batch size 10" headline regime, ``docs/source/raft_ann_benchmarks.md``).
+Exact search ⇒ recall@10 is 1.0 by construction; the figure of merit is
+QPS.
+
+``vs_baseline`` normalizes QPS by the single-chip HBM roofline for this
+config: each batch must stream the whole dataset (512 MB) from HBM, so
+roofline QPS = batch · BW / bytes  =  10 · 819e9 / 512e6 ≈ 16k QPS on
+TPU v5e. A value of 1.0 means memory-bound optimal; >1 means the cache/
+fusion behavior beats the naive stream estimate. (The reference repo
+publishes no numeric tables to compare against — see BASELINE.md.)
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.neighbors import brute_force
+
+N, D, K, BATCH = 1_000_000, 128, 10, 10
+V5E_HBM_BYTES_PER_S = 819e9
+ROOFLINE_QPS = BATCH * V5E_HBM_BYTES_PER_S / (N * D * 4)
+
+
+def main():
+    key = jax.random.key(0)
+    kd, kq = jax.random.split(key)
+    dataset = jax.random.normal(kd, (N, D), jnp.float32)
+    queries = jax.random.normal(kq, (BATCH, D), jnp.float32)
+    index = brute_force.build(None, dataset)
+
+    def run():
+        d, i = brute_force.search(None, index, queries, K, db_tile=262144)
+        jax.block_until_ready((d, i))
+        return d, i
+
+    run()  # compile + warm
+    n_iters = 20
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        run()
+    dt = (time.perf_counter() - t0) / n_iters
+    qps = BATCH / dt
+
+    print(json.dumps({
+        "metric": "brute_force_knn_qps_sift1m_shape_b10_k10",
+        "value": round(qps, 2),
+        "unit": "QPS",
+        "vs_baseline": round(qps / ROOFLINE_QPS, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""raft_tpu — TPU-native ML/IR primitives and vector-search framework.
+
+A from-scratch JAX / XLA / Pallas / pjit re-design of the capabilities of
+RAPIDS RAFT (reference: cpp/include/raft/** in the upstream repo): pairwise
+distances, batched k-selection, (balanced) k-means, dense/sparse linear
+algebra, statistics, random generation, and GPU-class vector search
+(brute-force kNN, IVF-Flat, IVF-PQ, CAGRA, refinement) — built and served
+entirely from TPU HBM, sharded over ICI/DCN meshes via ``jax.sharding``.
+
+Layering (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``raft_tpu.core``       — resources handle, logging, serialization (L1)
+- ``raft_tpu.linalg``     — dense math primitives (L2)
+- ``raft_tpu.matrix``     — matrix ops incl. ``select_k`` (L2)
+- ``raft_tpu.random``     — counter-based RNG + data generators (L2)
+- ``raft_tpu.stats``      — statistics & ML metrics (L2)
+- ``raft_tpu.sparse``     — sparse structures, distances, solvers (L2/L3)
+- ``raft_tpu.distance``   — pairwise distances, fused L2 NN (L3)
+- ``raft_tpu.cluster``    — kmeans, balanced kmeans, linkage, spectral (L3)
+- ``raft_tpu.neighbors``  — brute force / IVF-Flat / IVF-PQ / CAGRA (L4)
+- ``raft_tpu.comms``      — collectives over ICI/DCN device meshes (L5)
+- ``raft_tpu.ops``        — Pallas TPU kernels backing the hot paths
+- ``raft_tpu.bench``      — ANN benchmark harness (L8)
+
+Unlike the reference there is no explicit-instantiation layer (L6) — XLA's
+jit cache replaces it — and the Python API *is* the primary API (L7).
+"""
+
+__version__ = "0.1.0"
+
+from raft_tpu.core.resources import Resources, DeviceResources
+
+__all__ = [
+    "Resources",
+    "DeviceResources",
+    "__version__",
+]

@@ -1,0 +1,14 @@
+"""Clustering algorithms (reference ``raft/cluster/``)."""
+
+from raft_tpu.cluster import kmeans
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans import KMeansParams, InitMethod
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+
+__all__ = [
+    "kmeans",
+    "kmeans_balanced",
+    "KMeansParams",
+    "InitMethod",
+    "KMeansBalancedParams",
+]

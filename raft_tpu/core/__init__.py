@@ -1,0 +1,41 @@
+"""Core runtime: resources handle, logging, serialization, bitset.
+
+TPU-native analog of the reference's ``raft/core/`` layer (SURVEY.md §2.1).
+The reference's mdspan/mdarray machinery collapses to plain ``jax.Array`` +
+shape/dtype validation helpers; RMM/stream plumbing collapses to XLA's
+async dispatch; the resources registry survives as a small Python context
+holding the mesh, PRNG state and tunables shared by every algorithm.
+"""
+
+from raft_tpu.core.resources import Resources, DeviceResources
+from raft_tpu.core.logger import logger, set_level, LogLevel
+from raft_tpu.core.serialize import (
+    serialize_array,
+    deserialize_array,
+    serialize_scalar,
+    deserialize_scalar,
+)
+from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.validation import (
+    expect,
+    check_matrix,
+    check_vector,
+    canonical_dtype,
+)
+
+__all__ = [
+    "Resources",
+    "DeviceResources",
+    "logger",
+    "set_level",
+    "LogLevel",
+    "serialize_array",
+    "deserialize_array",
+    "serialize_scalar",
+    "deserialize_scalar",
+    "Bitset",
+    "expect",
+    "check_matrix",
+    "check_vector",
+    "canonical_dtype",
+]

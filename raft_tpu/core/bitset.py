@@ -1,0 +1,87 @@
+"""Device bitset — analog of ``raft::core::bitset`` (``core/bitset.cuh:41-116``).
+
+Backed by a ``uint32`` word array (jax.Array) so it passes through jit and
+shards over meshes. Used by sample filters at search time
+(``neighbors/sample_filter.cuh``) to mask index rows in/out.
+
+Functional style: mutators return a new ``Bitset`` (XLA model), unlike the
+reference's in-place device writes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+WORD_BITS = 32
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class Bitset:
+    """Fixed-length bitset over uint32 words.
+
+    ``bits[i]`` lives at word ``i // 32``, bit ``i % 32``. ``n_bits`` is
+    static (aux data) so jitted consumers specialize on length.
+    """
+
+    words: jax.Array  # uint32[ceil(n_bits/32)]
+    n_bits: int
+
+    # -- pytree plumbing -----------------------------------------------------
+    def tree_flatten(self):
+        return (self.words,), self.n_bits
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(words=children[0], n_bits=aux)
+
+    # -- constructors --------------------------------------------------------
+    @classmethod
+    def create(cls, n_bits: int, default: bool = True) -> "Bitset":
+        """All-set (default) or all-clear bitset; the reference default is
+        all-set so that "no filter" passes everything."""
+        n_words = (n_bits + WORD_BITS - 1) // WORD_BITS
+        fill = jnp.uint32(0xFFFFFFFF) if default else jnp.uint32(0)
+        return cls(jnp.full((n_words,), fill, dtype=jnp.uint32), n_bits)
+
+    @classmethod
+    def from_mask(cls, mask) -> "Bitset":
+        """Pack a boolean vector into words."""
+        mask = jnp.asarray(mask, dtype=jnp.bool_)
+        n_bits = mask.shape[0]
+        n_words = (n_bits + WORD_BITS - 1) // WORD_BITS
+        padded = jnp.zeros((n_words * WORD_BITS,), jnp.bool_).at[:n_bits].set(mask)
+        bits = padded.reshape(n_words, WORD_BITS).astype(jnp.uint32)
+        weights = (jnp.uint32(1) << jnp.arange(WORD_BITS, dtype=jnp.uint32))
+        return cls((bits * weights).sum(axis=1).astype(jnp.uint32), n_bits)
+
+    # -- queries -------------------------------------------------------------
+    def test(self, idx) -> jax.Array:
+        """``bitset_view::test`` — vectorized: idx may be any int array."""
+        idx = jnp.asarray(idx)
+        word = self.words[idx // WORD_BITS]
+        return ((word >> (idx % WORD_BITS).astype(jnp.uint32)) & 1).astype(jnp.bool_)
+
+    def to_mask(self) -> jax.Array:
+        """Unpack to bool[n_bits]."""
+        shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)
+        bits = (self.words[:, None] >> shifts[None, :]) & 1
+        return bits.reshape(-1)[: self.n_bits].astype(jnp.bool_)
+
+    def count(self) -> jax.Array:
+        """Population count (``bitset::count``)."""
+        return self.to_mask().sum(dtype=jnp.int32)
+
+    # -- functional mutators -------------------------------------------------
+    def set(self, idx, value: bool = True) -> "Bitset":
+        mask = self.to_mask()
+        mask = mask.at[idx].set(value)
+        return Bitset.from_mask(mask)
+
+    def flip(self) -> "Bitset":
+        inverted = jnp.bitwise_not(self.words)
+        # keep padding bits clear so count() stays correct
+        return Bitset.from_mask(Bitset(inverted, self.n_bits).to_mask())

@@ -1,0 +1,71 @@
+"""Versioned binary serialization, NumPy ``.npy``-framed.
+
+The reference serializes every index as a stream of scalars + mdspans in
+NumPy ``.npy`` encoding (``core/serialize.hpp:35-116``,
+``core/detail/mdspan_numpy_serializer.hpp``). We reuse the exact same wire
+idea — scalars are 0-d ``.npy`` records, arrays are ``.npy`` records — so
+indexes saved here are plain concatenated npy streams, inspectable with
+``numpy.lib.format``. Each index format carries a version scalar checked at
+load, mirroring e.g. IVF-Flat v4 (``detail/ivf_flat_serialize.cuh:37``).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, BinaryIO, Union
+
+import jax
+import numpy as np
+from numpy.lib import format as npy_format
+
+Writable = Union[BinaryIO, io.BufferedIOBase]
+
+
+def serialize_array(fh: Writable, arr) -> None:
+    """Write one array as an ``.npy`` record (host transfer if needed).
+
+    Analog of ``raft::serialize_mdspan`` (``core/serialize.hpp:35``).
+    """
+    np_arr = np.asarray(jax.device_get(arr) if isinstance(arr, jax.Array) else arr)
+    npy_format.write_array(fh, np_arr, allow_pickle=False)
+
+
+def deserialize_array(fh: BinaryIO) -> np.ndarray:
+    """Read one ``.npy`` record (``raft::deserialize_mdspan``)."""
+    return npy_format.read_array(fh, allow_pickle=False)
+
+
+def serialize_scalar(fh: Writable, value: Any, dtype=None) -> None:
+    """Write one scalar as a 0-d ``.npy`` record
+    (``raft::serialize_scalar``, ``core/serialize.hpp:99``)."""
+    np_val = np.asarray(value, dtype=dtype)
+    if np_val.shape != ():
+        raise ValueError(f"serialize_scalar expects a scalar, got shape {np_val.shape}")
+    npy_format.write_array(fh, np_val, allow_pickle=False)
+
+
+def deserialize_scalar(fh: BinaryIO):
+    arr = npy_format.read_array(fh, allow_pickle=False)
+    if arr.shape != ():
+        raise ValueError(f"expected scalar record, got shape {arr.shape}")
+    return arr[()]
+
+
+def open_maybe_path(fh_or_path, mode: str):
+    """Return (fh, owns) accepting open files, str/bytes paths, and
+    os.PathLike — shared by every index save/load."""
+    import os
+
+    if isinstance(fh_or_path, (str, bytes, os.PathLike)):
+        return open(fh_or_path, mode), True
+    return fh_or_path, False
+
+
+def check_version(found: int, expected: int, what: str) -> None:
+    """Version gate used by every index loader (mirrors the serialization
+    version checks, e.g. ``detail/ivf_pq_serialize.cuh:39``)."""
+    if int(found) != int(expected):
+        raise ValueError(
+            f"{what}: serialization format version mismatch "
+            f"(file v{int(found)}, loader v{int(expected)})"
+        )

@@ -1,0 +1,37 @@
+"""Profiler ranges — analog of the reference's NVTX RAII ranges.
+
+Reference: ``core/nvtx.hpp:20-70`` inserts named ranges at every public
+entry point. The TPU-native equivalents are ``jax.named_scope`` (annotates
+the jaxpr/HLO so ranges appear in XLA profiler traces) plus
+``jax.profiler.TraceAnnotation`` for host-side spans. ``range`` composes
+both so one decorator/context manager covers traced and untraced code.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+
+
+@contextlib.contextmanager
+def range(name: str, *fmt_args):
+    """RAII-style profiling range (``common::nvtx::range``)."""
+    label = name % fmt_args if fmt_args else name
+    with jax.named_scope(label), jax.profiler.TraceAnnotation(label):
+        yield
+
+
+def annotated(name: str):
+    """Decorator form, used on public API entry points."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with range(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

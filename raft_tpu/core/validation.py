@@ -1,0 +1,58 @@
+"""Input validation — analog of ``RAFT_EXPECTS`` / mdspan extent checks.
+
+The reference enforces preconditions with macros (``core/error.hpp``) and
+encodes layout/extent contracts in mdspan types. Here arrays are plain
+``jax.Array``/numpy, so the contracts become small check helpers used at
+every public entry point (host-side, zero cost under jit tracing).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class RaftError(RuntimeError):
+    """Analog of ``raft::exception`` (``core/error.hpp``)."""
+
+
+def expect(cond: bool, msg: str) -> None:
+    """``RAFT_EXPECTS(cond, msg)``."""
+    if not cond:
+        raise RaftError(msg)
+
+
+def check_matrix(x, name: str = "x", dtype=None, cols: Optional[int] = None):
+    x = jnp.asarray(x)
+    expect(x.ndim == 2, f"{name} must be 2-D, got shape {x.shape}")
+    if cols is not None:
+        expect(x.shape[1] == cols, f"{name} must have {cols} columns, got {x.shape[1]}")
+    if dtype is not None:
+        x = x.astype(dtype)
+    return x
+
+
+def check_vector(x, name: str = "x", dtype=None, size: Optional[int] = None):
+    x = jnp.asarray(x)
+    expect(x.ndim == 1, f"{name} must be 1-D, got shape {x.shape}")
+    if size is not None:
+        expect(x.shape[0] == size, f"{name} must have length {size}, got {x.shape[0]}")
+    if dtype is not None:
+        x = x.astype(dtype)
+    return x
+
+
+def canonical_dtype(dtype) -> np.dtype:
+    """Map supported input dtypes to the compute dtype used on TPU.
+
+    The reference's vector-search dtypes are float32/float16/int8/uint8
+    (``ivf_flat_types.hpp``, ``ivf_pq_types.hpp``). On TPU we compute in
+    float32 (MXU accumulate) or bfloat16; int8/uint8 stay packed in storage
+    and are upcast in kernels.
+    """
+    dt = np.dtype(dtype)
+    if dt in (np.dtype(np.float64),):
+        return np.dtype(np.float32)
+    return dt

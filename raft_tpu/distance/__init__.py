@@ -1,0 +1,23 @@
+"""Pairwise distances, fused NN reductions, gram kernels (reference L3,
+``raft/distance/``)."""
+
+from raft_tpu.distance.types import DistanceType, is_min_close, EXPANDED_METRICS
+from raft_tpu.distance.pairwise import pairwise_distance, pairwise_distance_tiled
+from raft_tpu.distance.fused_l2_nn import (
+    fused_l2_nn_argmin,
+    fused_l2_nn_argmin_precomputed,
+)
+from raft_tpu.distance.kernels import KernelType, KernelParams, gram_matrix
+
+__all__ = [
+    "DistanceType",
+    "is_min_close",
+    "EXPANDED_METRICS",
+    "pairwise_distance",
+    "pairwise_distance_tiled",
+    "fused_l2_nn_argmin",
+    "fused_l2_nn_argmin_precomputed",
+    "KernelType",
+    "KernelParams",
+    "gram_matrix",
+]

@@ -1,0 +1,99 @@
+"""Fused L2 nearest-neighbor (distance + argmin without materializing m×n).
+
+Analog of ``fusedL2NN`` / ``fusedL2NNMinReduce``
+(``distance/fused_l2_nn-inl.cuh:76,151``) — the hot kernel inside balanced
+k-means EM (SURVEY.md §3.1). The reference fuses the GEMM epilog with a
+warp argmin; on TPU we keep the GEMM on the MXU and fuse the argmin into
+the same jit program, tiling over the *center* axis with ``lax.scan`` so
+peak memory is ``m × tile`` instead of ``m × n``. XLA fuses the epilog
+(norm add + min/argmin) into the GEMM consumer, which is the same
+memory-traffic win the CUDA fusion buys.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+
+
+@partial(jax.jit, static_argnames=("sqrt", "tile"))
+def _fused_l2_nn(x, y, y_sq_norms, sqrt: bool, tile: int):
+    m, d = x.shape
+    n = y.shape[0]
+    xf = x.astype(jnp.float32)
+    x_sq = jnp.sum(jnp.square(xf), axis=1)
+
+    pad = (-n) % tile
+    yp = jnp.pad(y.astype(jnp.float32), ((0, pad), (0, 0)))
+    ynp = jnp.pad(y_sq_norms.astype(jnp.float32), (0, pad), constant_values=jnp.inf)
+    y_tiles = yp.reshape(-1, tile, d)
+    yn_tiles = ynp.reshape(-1, tile)
+
+    def step(carry, inp):
+        best_val, best_idx = carry
+        tile_idx, (yt, ynt) = inp
+        # (m, tile) partial distances: ||x||^2 dropped (constant per row)
+        ip = jax.lax.dot_general(
+            xf, yt, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        part = ynt[None, :] - 2.0 * ip
+        idx = jnp.argmin(part, axis=1)
+        val = jnp.take_along_axis(part, idx[:, None], axis=1)[:, 0]
+        gidx = tile_idx * tile + idx
+        better = val < best_val
+        return (
+            jnp.where(better, val, best_val),
+            jnp.where(better, gidx, best_idx),
+        ), None
+
+    init = (jnp.full((m,), jnp.inf, jnp.float32), jnp.zeros((m,), jnp.int32))
+    (best_val, best_idx), _ = jax.lax.scan(
+        step, init, (jnp.arange(y_tiles.shape[0]), (y_tiles, yn_tiles))
+    )
+    dist = best_val + x_sq
+    dist = jnp.maximum(dist, 0.0)
+    if sqrt:
+        dist = jnp.sqrt(dist)
+    return dist, best_idx.astype(jnp.int32)
+
+
+def fused_l2_nn_argmin(
+    res: Optional[Resources],
+    x,
+    y,
+    sqrt: bool = False,
+    tile: int = 2048,
+) -> Tuple[jax.Array, jax.Array]:
+    """For each row of ``x``, the (distance, index) of its L2-nearest row
+    of ``y`` — the ``fusedL2NNMinReduce`` entry point.
+
+    Returns ``(min_dist[m] float32, argmin[m] int32)``; distances are
+    squared L2 unless ``sqrt``.
+    """
+    ensure_resources(res)
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    expect(x.ndim == 2 and y.ndim == 2, "x and y must be 2-D")
+    expect(x.shape[1] == y.shape[1], "feature dims differ")
+    y_sq = jnp.sum(jnp.square(y.astype(jnp.float32)), axis=1)
+    with tracing.range("raft_tpu.fused_l2_nn"):
+        return _fused_l2_nn(x, y, y_sq, sqrt, min(tile, max(64, y.shape[0])))
+
+
+def fused_l2_nn_argmin_precomputed(x, y, y_sq_norms, sqrt: bool = False, tile: int = 2048):
+    """Variant taking precomputed ``||y||^2`` (the k-means hot loop reuses
+    center norms across EM iterations, mirroring ``fusedL2NN``'s norm
+    arguments)."""
+    return _fused_l2_nn(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(y_sq_norms), sqrt,
+        min(tile, max(64, jnp.asarray(y).shape[0])),
+    )

@@ -1,0 +1,61 @@
+"""Kernel gram matrices — analog of ``raft/distance/kernels.cuh``.
+
+Reference (``distance/detail/kernels/gram_matrix.cuh`` +
+``distance_types.hpp`` ``kernels::KernelType``): LINEAR, POLYNOMIAL, RBF,
+TANH gram matrices for SVM-style methods. All four ride one MXU GEMM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.distance.pairwise import pairwise_distance
+from raft_tpu.distance.types import DistanceType
+
+
+class KernelType(enum.IntEnum):
+    LINEAR = 0
+    POLYNOMIAL = 1
+    RBF = 2
+    TANH = 3
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelParams:
+    """Mirrors ``raft::distance::kernels::KernelParams``."""
+
+    kernel: KernelType = KernelType.LINEAR
+    degree: int = 3
+    gamma: float = 1.0
+    coef0: float = 0.0
+
+
+def gram_matrix(
+    res: Optional[Resources],
+    x,
+    y,
+    params: KernelParams = KernelParams(),
+) -> jax.Array:
+    """Compute K(x_i, y_j) for all pairs.
+
+    LINEAR: <x,y>; POLYNOMIAL: (gamma <x,y> + coef0)^degree;
+    RBF: exp(-gamma |x-y|^2); TANH: tanh(gamma <x,y> + coef0).
+    """
+    res = ensure_resources(res)
+    if params.kernel == KernelType.RBF:
+        sq = pairwise_distance(res, x, y, DistanceType.L2Expanded)
+        return jnp.exp(-params.gamma * sq)
+    ip = pairwise_distance(res, x, y, DistanceType.InnerProduct)
+    if params.kernel == KernelType.LINEAR:
+        return ip
+    if params.kernel == KernelType.POLYNOMIAL:
+        return jnp.power(params.gamma * ip + params.coef0, params.degree)
+    if params.kernel == KernelType.TANH:
+        return jnp.tanh(params.gamma * ip + params.coef0)
+    raise NotImplementedError(f"kernel {params.kernel!r}")

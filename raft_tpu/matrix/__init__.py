@@ -1,0 +1,34 @@
+"""Matrix primitives incl. batched k-selection (reference ``raft/matrix/``)."""
+
+from raft_tpu.matrix.select_k import select_k, SelectAlgo
+from raft_tpu.matrix.ops import (
+    gather,
+    gather_if,
+    scatter,
+    slice,
+    argmax,
+    argmin,
+    col_sort,
+    linewise_op,
+    reverse,
+    triangular_upper,
+    triangular_lower,
+    matrix_print,
+)
+
+__all__ = [
+    "select_k",
+    "SelectAlgo",
+    "gather",
+    "gather_if",
+    "scatter",
+    "slice",
+    "argmax",
+    "argmin",
+    "col_sort",
+    "linewise_op",
+    "reverse",
+    "triangular_upper",
+    "triangular_lower",
+    "matrix_print",
+]

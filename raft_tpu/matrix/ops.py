@@ -1,0 +1,91 @@
+"""Dense matrix operations — analog of ``raft/matrix/*.cuh`` (30 headers).
+
+Most reference matrix primitives are one-liners in JAX; they are collected
+here so the public surface matches the reference inventory (SURVEY.md §2.2
+"matrix ops": gather/scatter, slice, per-row argmax/argmin, col-wise sort,
+linewise op, reverse, triangular, print).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import numpy as np
+
+
+def gather(matrix, indices) -> jax.Array:
+    """Row gather: out[i] = matrix[indices[i]] (``matrix/gather.cuh``)."""
+    return jnp.take(jnp.asarray(matrix), jnp.asarray(indices), axis=0)
+
+
+def gather_if(matrix, indices, stencil, pred: Callable) -> jax.Array:
+    """Conditional row gather (``matrix::gather_if``): rows whose stencil
+    fails the predicate are zeroed."""
+    out = gather(matrix, indices)
+    keep = pred(jnp.asarray(stencil))
+    return jnp.where(keep[:, None], out, 0)
+
+
+def scatter(matrix, indices, updates) -> jax.Array:
+    """Row scatter: out[indices[i]] = updates[i] (``matrix/scatter.cuh``)."""
+    return jnp.asarray(matrix).at[jnp.asarray(indices)].set(jnp.asarray(updates))
+
+
+def slice(matrix, rows: Tuple[int, int], cols: Tuple[int, int]) -> jax.Array:
+    """Contiguous sub-matrix copy (``matrix/slice.cuh``)."""
+    return jnp.asarray(matrix)[rows[0] : rows[1], cols[0] : cols[1]]
+
+
+def argmax(matrix, axis: int = 1) -> jax.Array:
+    """Per-row argmax (``matrix/argmax.cuh``)."""
+    return jnp.argmax(jnp.asarray(matrix), axis=axis).astype(jnp.int32)
+
+
+def argmin(matrix, axis: int = 1) -> jax.Array:
+    """Per-row argmin (``matrix/argmin.cuh``)."""
+    return jnp.argmin(jnp.asarray(matrix), axis=axis).astype(jnp.int32)
+
+
+def col_sort(keys, values=None):
+    """Sort each row's columns by key (``matrix/col_wise_sort.cuh``);
+    optionally permute a payload alongside."""
+    keys = jnp.asarray(keys)
+    order = jnp.argsort(keys, axis=1, stable=True)
+    sorted_keys = jnp.take_along_axis(keys, order, axis=1)
+    if values is None:
+        return sorted_keys, order.astype(jnp.int32)
+    return sorted_keys, jnp.take_along_axis(jnp.asarray(values), order, axis=1)
+
+
+def linewise_op(matrix, vec, along_rows: bool, op: Callable) -> jax.Array:
+    """Broadcast a vector op along rows or columns
+    (``matrix/linewise_op.cuh`` / ``linalg::matrix_vector_op``)."""
+    matrix = jnp.asarray(matrix)
+    vec = jnp.asarray(vec)
+    if along_rows:  # vec has one entry per column
+        return op(matrix, vec[None, :])
+    return op(matrix, vec[:, None])
+
+
+def reverse(matrix, axis: int = 1) -> jax.Array:
+    """Flip rows or columns (``matrix/reverse.cuh``)."""
+    return jnp.flip(jnp.asarray(matrix), axis=axis)
+
+
+def triangular_upper(matrix) -> jax.Array:
+    """Upper-triangular copy (``matrix/triangular.cuh``)."""
+    return jnp.triu(jnp.asarray(matrix))
+
+
+def triangular_lower(matrix) -> jax.Array:
+    return jnp.tril(jnp.asarray(matrix))
+
+
+def matrix_print(matrix, name: str = "matrix", max_rows: int = 8, max_cols: int = 8):
+    """Host-side pretty print (``matrix/print.cuh``)."""
+    arr = np.asarray(jax.device_get(matrix))
+    print(f"{name} shape={arr.shape} dtype={arr.dtype}")
+    print(np.array2string(arr[:max_rows, :max_cols], precision=4))

@@ -1,0 +1,11 @@
+"""Vector-search algorithms — the flagship layer (reference
+``raft/neighbors/``, SURVEY.md §2.5)."""
+
+from raft_tpu.neighbors import brute_force
+from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
+
+__all__ = [
+    "brute_force",
+    "IndexParams",
+    "SearchParams",
+]

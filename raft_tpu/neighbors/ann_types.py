@@ -1,0 +1,28 @@
+"""Base ANN types — analog of ``neighbors/ann_types.hpp:29-48``.
+
+Every index family follows the reference's contract: an ``index`` object
+built by ``build(params, dataset)``, queried by ``search(params, index,
+queries, k)``, extended by ``extend``, and (de)serialized. Indexes here are
+registered pytrees of jax.Arrays + static metadata, so they pass through
+jit, shard over meshes, and donate cleanly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from raft_tpu.distance.types import DistanceType
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexParams:
+    """Base build parameters (``ann_types.hpp`` ``index_params``)."""
+
+    metric: DistanceType = DistanceType.L2Expanded
+    metric_arg: float = 2.0
+    add_data_on_build: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchParams:
+    """Base search parameters (``ann_types.hpp`` ``search_params``)."""

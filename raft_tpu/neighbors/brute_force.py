@@ -1,0 +1,238 @@
+"""Exact brute-force kNN — analog of ``raft::neighbors::brute_force``
+(``neighbors/brute_force-inl.cuh``; impl ``detail/knn_brute_force.cuh``).
+
+Reference architecture: a tiled loop (row tiles × database tiles) running
+``pairwise_distance`` then per-tile ``select_k``, with a global merge
+(``tiled_brute_force_knn:57-260``), plus a fused L2 kernel for small k and
+``knn_merge_parts`` for multi-shard merges.
+
+TPU re-design: one jitted scan over database tiles that carries a running
+(k-best values, indices) state and merges each tile's local top-k with a
+single ``lax.top_k`` over the 2k concatenation. The pairwise tile rides
+the MXU; the merge is the TPU-KNN-paper two-phase pattern. Queries are
+tiled host-side only to bound the q×tile buffer; dataset tiling is inside
+the scan so HBM traffic is streamed.
+
+The index object precomputes database norms, mirroring
+``brute_force_types.hpp``'s norm caching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.serialize import (
+    check_version,
+    deserialize_array,
+    deserialize_scalar,
+    open_maybe_path,
+    serialize_array,
+    serialize_scalar,
+)
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.pairwise import _pairwise_distance_impl
+from raft_tpu.distance.types import DistanceType, is_min_close
+from raft_tpu.neighbors.ann_types import IndexParams
+
+_SERIALIZATION_VERSION = 1
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class BruteForceIndex:
+    """Exact-search index: the dataset plus cached norms
+    (``brute_force_types.hpp`` ``brute_force::index``)."""
+
+    dataset: jax.Array          # (n, d)
+    norms: jax.Array            # (n,) cached ||y||^2 for expanded metrics
+    metric: DistanceType
+    metric_arg: float
+
+    def tree_flatten(self):
+        return (self.dataset, self.norms), (self.metric, self.metric_arg)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1])
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+
+def build(
+    res: Optional[Resources],
+    dataset,
+    metric: DistanceType = DistanceType.L2Expanded,
+    metric_arg: float = 2.0,
+) -> BruteForceIndex:
+    """Construct the index (norm caching only — exact search has no train
+    step). Analog of ``brute_force::build``."""
+    res = ensure_resources(res)
+    dataset = res.put(jnp.asarray(dataset))
+    expect(dataset.ndim == 2, "dataset must be (n, d)")
+    norms = jnp.sum(jnp.square(dataset.astype(jnp.float32)), axis=1)
+    return BruteForceIndex(dataset, norms, DistanceType(metric), metric_arg)
+
+
+@partial(jax.jit, static_argnames=("k", "metric", "metric_arg", "tile"))
+def _knn_scan(queries, dataset, k: int, metric: DistanceType, metric_arg: float,
+              tile: int):
+    """Scan database tiles, carrying running top-k (the global-merge loop of
+    ``tiled_brute_force_knn``)."""
+    n, d = dataset.shape
+    q = queries.shape[0]
+    select_min = is_min_close(metric)
+    pad_val = jnp.inf if select_min else -jnp.inf
+
+    pad = (-n) % tile
+    dsp = jnp.pad(dataset, ((0, pad), (0, 0)))
+    tiles = dsp.reshape(-1, tile, d)
+    n_tiles = tiles.shape[0]
+
+    def step(carry, inp):
+        best_d, best_i = carry
+        t_idx, yt = inp
+        dist = _pairwise_distance_impl(queries, yt, metric, metric_arg, "highest")
+        # mask out padding rows of the final tile
+        col_ids = t_idx * tile + jnp.arange(tile)
+        dist = jnp.where((col_ids < n)[None, :], dist, pad_val)
+        kk = min(k, tile)
+        if select_min:
+            tile_d, tile_i = jax.lax.top_k(-dist, kk)
+            tile_d = -tile_d
+        else:
+            tile_d, tile_i = jax.lax.top_k(dist, kk)
+        tile_gi = t_idx * tile + tile_i
+        # merge with running state over the 2k candidates
+        cat_d = jnp.concatenate([best_d, tile_d], axis=1)
+        cat_i = jnp.concatenate([best_i, tile_gi.astype(jnp.int32)], axis=1)
+        if select_min:
+            new_d, pos = jax.lax.top_k(-cat_d, k)
+            new_d = -new_d
+        else:
+            new_d, pos = jax.lax.top_k(cat_d, k)
+        new_i = jnp.take_along_axis(cat_i, pos, axis=1)
+        return (new_d, new_i), None
+
+    init = (
+        jnp.full((q, k), pad_val, jnp.float32),
+        jnp.full((q, k), -1, jnp.int32),
+    )
+    (best_d, best_i), _ = jax.lax.scan(step, init, (jnp.arange(n_tiles), tiles))
+    return best_d, best_i
+
+
+def search(
+    res: Optional[Resources],
+    index: BruteForceIndex,
+    queries,
+    k: int,
+    query_tile: int = 8192,
+    db_tile: int = 32768,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN: returns (distances (q, k), indices (q, k) int32) —
+    ``brute_force::knn`` / ``brute_force::search``.
+
+    For ``InnerProduct`` the returned "distances" are similarities sorted
+    descending (``is_min_close`` semantics, matching the reference)."""
+    ensure_resources(res)
+    queries = jnp.asarray(queries)
+    expect(queries.ndim == 2, "queries must be (q, d)")
+    expect(queries.shape[1] == index.dim, "query dim mismatch")
+    expect(0 < k <= index.size, f"k must be in (0, {index.size}]")
+    db_tile = min(db_tile, max(128, index.size))
+    with tracing.range("raft_tpu.brute_force.search"):
+        q = queries.shape[0]
+        if q <= query_tile:
+            return _knn_scan(queries, index.dataset, k, index.metric,
+                             index.metric_arg, db_tile)
+        outs_d, outs_i = [], []
+        for start in range(0, q, query_tile):
+            dq, iq = _knn_scan(queries[start : start + query_tile], index.dataset,
+                               k, index.metric, index.metric_arg, db_tile)
+            outs_d.append(dq)
+            outs_i.append(iq)
+        return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+
+
+def knn(
+    res: Optional[Resources],
+    dataset,
+    queries,
+    k: int,
+    metric: DistanceType = DistanceType.L2Expanded,
+    metric_arg: float = 2.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-shot convenience matching ``brute_force::knn``."""
+    index = build(res, dataset, metric, metric_arg)
+    return search(res, index, queries, k)
+
+
+def knn_merge_parts(distances, indices, select_min: bool = True):
+    """Merge per-shard kNN results — analog of ``knn_merge_parts``
+    (``detail/knn_merge_parts.cuh``), the building block of distributed
+    search (SURVEY.md §5 long-context equivalent).
+
+    Args:
+      distances: (n_parts, q, k); indices: (n_parts, q, k) with *global* ids.
+    Returns merged (q, k) pair.
+    """
+    distances = jnp.asarray(distances)
+    indices = jnp.asarray(indices)
+    n_parts, q, k = distances.shape
+    cat_d = jnp.moveaxis(distances, 0, 1).reshape(q, n_parts * k)
+    cat_i = jnp.moveaxis(indices, 0, 1).reshape(q, n_parts * k)
+    if select_min:
+        merged_d, pos = jax.lax.top_k(-cat_d, k)
+        merged_d = -merged_d
+    else:
+        merged_d, pos = jax.lax.top_k(cat_d, k)
+    merged_i = jnp.take_along_axis(cat_i, pos, axis=1)
+    return merged_d, merged_i
+
+
+# -- serialization ----------------------------------------------------------
+
+
+def save(index: BruteForceIndex, fh_or_path) -> None:
+    """Versioned npy-stream serialization (pattern of
+    ``brute_force_serialize``)."""
+    fh, own = open_maybe_path(fh_or_path, "wb")
+    try:
+        serialize_scalar(fh, _SERIALIZATION_VERSION, np.int32)
+        serialize_scalar(fh, int(index.metric), np.int32)
+        serialize_scalar(fh, index.metric_arg, np.float32)
+        serialize_array(fh, index.dataset)
+        serialize_array(fh, index.norms)
+    finally:
+        if own:
+            fh.close()
+
+
+def load(res: Optional[Resources], fh_or_path) -> BruteForceIndex:
+    res = ensure_resources(res)
+    fh, own = open_maybe_path(fh_or_path, "rb")
+    try:
+        check_version(deserialize_scalar(fh), _SERIALIZATION_VERSION, "brute_force")
+        metric = DistanceType(int(deserialize_scalar(fh)))
+        metric_arg = float(deserialize_scalar(fh))
+        dataset = res.put(deserialize_array(fh))
+        norms = res.put(deserialize_array(fh))
+        return BruteForceIndex(dataset, norms, metric, metric_arg)
+    finally:
+        if own:
+            fh.close()

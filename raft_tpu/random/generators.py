@@ -1,0 +1,120 @@
+"""Synthetic data generators — ``make_blobs``, ``make_regression``, RMAT
+graphs, multi-variable gaussian (reference ``random/make_blobs.cuh``,
+``random/make_regression.cuh``, ``random/rmat_rectangular_generator.cuh``,
+``random/multi_variable_gaussian.cuh``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng import RngState, _key_of
+
+
+def make_blobs(
+    rng,
+    n_samples: int,
+    n_features: int,
+    n_clusters: int = 3,
+    cluster_std: float = 1.0,
+    center_box: Tuple[float, float] = (-10.0, 10.0),
+    centers=None,
+    shuffle: bool = True,
+    dtype=jnp.float32,
+):
+    """Isotropic Gaussian blobs (``random::make_blobs``). Returns (X, labels,
+    centers)."""
+    key = _key_of(rng)
+    k_centers, k_labels, k_noise, k_shuffle = jax.random.split(key, 4)
+    if centers is None:
+        centers = jax.random.uniform(
+            k_centers, (n_clusters, n_features), dtype=dtype,
+            minval=center_box[0], maxval=center_box[1],
+        )
+    else:
+        centers = jnp.asarray(centers, dtype)
+        n_clusters = centers.shape[0]
+    labels = jax.random.randint(k_labels, (n_samples,), 0, n_clusters)
+    noise = cluster_std * jax.random.normal(k_noise, (n_samples, n_features), dtype=dtype)
+    x = centers[labels] + noise
+    if shuffle:
+        perm = jax.random.permutation(k_shuffle, n_samples)
+        x, labels = x[perm], labels[perm]
+    return x, labels.astype(jnp.int32), centers
+
+
+def make_regression(
+    rng,
+    n_samples: int,
+    n_features: int,
+    n_informative: Optional[int] = None,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    noise: float = 0.0,
+    shuffle: bool = True,
+    dtype=jnp.float32,
+):
+    """Random linear regression problem (``random::make_regression``).
+    Returns (X, y, coef)."""
+    n_informative = n_informative if n_informative is not None else n_features
+    key = _key_of(rng)
+    k_x, k_w, k_noise, k_shuffle = jax.random.split(key, 4)
+    x = jax.random.normal(k_x, (n_samples, n_features), dtype=dtype)
+    coef = jnp.zeros((n_features, n_targets), dtype)
+    w = 100.0 * jax.random.uniform(k_w, (n_informative, n_targets), dtype=dtype)
+    coef = coef.at[:n_informative].set(w)
+    y = x @ coef + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(k_noise, y.shape, dtype=dtype)
+    if shuffle:
+        perm = jax.random.permutation(k_shuffle, n_samples)
+        x, y = x[perm], y[perm]
+    return x, y, coef
+
+
+def rmat(
+    rng,
+    r_scale: int,
+    c_scale: int,
+    n_edges: int,
+    theta=None,
+) -> jax.Array:
+    """RMAT rectangular graph generator
+    (``random::rmat_rectangular_generator``): recursively pick quadrants by
+    (a,b,c,d) probabilities, one bit per level — fully vectorized over
+    edges. Returns int32 (n_edges, 2) [src, dst]."""
+    key = _key_of(rng)
+    if theta is None:
+        theta = jnp.array([0.57, 0.19, 0.19, 0.05], jnp.float32)
+    theta = jnp.asarray(theta, jnp.float32).reshape(-1)[:4]
+    probs = theta / theta.sum()
+    # quadrant draw per (edge, level)
+    max_scale = max(r_scale, c_scale)
+    draws = jax.random.categorical(
+        key, jnp.log(probs)[None, None, :], axis=-1,
+        shape=(n_edges, max_scale),
+    )
+    # quadrant 0,1,2,3 → (row_bit, col_bit) = (q >> 1, q & 1)
+    row_bits = (draws >> 1).astype(jnp.int32)
+    col_bits = (draws & 1).astype(jnp.int32)
+    # bit i contributes 2^(scale-1-i) within its own scale range
+    r_pow = jnp.where(jnp.arange(max_scale) < r_scale,
+                      2 ** (r_scale - 1 - jnp.arange(max_scale)), 0).astype(jnp.int32)
+    c_pow = jnp.where(jnp.arange(max_scale) < c_scale,
+                      2 ** (c_scale - 1 - jnp.arange(max_scale)), 0).astype(jnp.int32)
+    src = (row_bits * r_pow[None, :]).sum(axis=1)
+    dst = (col_bits * c_pow[None, :]).sum(axis=1)
+    return jnp.stack([src, dst], axis=1).astype(jnp.int32)
+
+
+def multi_variable_gaussian(rng, mean, cov, n_samples: int) -> jax.Array:
+    """Draw from N(mean, cov) (``random::multi_variable_gaussian``) via
+    Cholesky (jnp.linalg — XLA's TPU-native factorization)."""
+    key = _key_of(rng)
+    mean = jnp.asarray(mean, jnp.float32)
+    cov = jnp.asarray(cov, jnp.float32)
+    chol = jnp.linalg.cholesky(cov)
+    z = jax.random.normal(key, (n_samples, mean.shape[0]), dtype=jnp.float32)
+    return mean[None, :] + z @ chol.T

@@ -1,0 +1,128 @@
+"""Random generation — analog of ``raft/random/`` (``random/rng.cuh``).
+
+The reference uses counter-based Philox/PCG generators threaded via
+``RngState`` (``random/rng_state.hpp:28-52``). JAX's threefry PRNG is
+already counter-based and splittable, so ``RngState`` here simply wraps a
+key + offset discipline with the same distribution surface: uniform,
+uniformInt, normal, normalInt, lognormal, gumbel, logistic, laplace,
+exponential, rayleigh, bernoulli, scaled_bernoulli, sample-without-
+replacement, permute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GeneratorType(enum.IntEnum):
+    """Mirrors ``random/rng_state.hpp`` (PCG default, Philox). Both map to
+    JAX's counter-based threefry; the distinction is kept for API parity."""
+
+    Pcg = 0
+    Philox = 1
+
+
+@dataclasses.dataclass
+class RngState:
+    """Seed + generator selector (``random::RngState``). ``advance`` mirrors
+    the reference's subsequence advancing for reproducible parallel draws."""
+
+    seed: int = 0
+    type: GeneratorType = GeneratorType.Pcg
+    _counter: int = 0
+
+    def key(self) -> jax.Array:
+        k = jax.random.fold_in(jax.random.key(self.seed), self._counter)
+        self._counter += 1
+        return k
+
+    def advance(self, n: int = 1) -> None:
+        self._counter += n
+
+
+def _key_of(rng: "RngState | jax.Array") -> jax.Array:
+    if isinstance(rng, RngState):
+        return rng.key()
+    return rng
+
+
+def uniform(rng, shape, low=0.0, high=1.0, dtype=jnp.float32):
+    return jax.random.uniform(_key_of(rng), shape, dtype=dtype, minval=low, maxval=high)
+
+
+def uniform_int(rng, shape, low, high, dtype=jnp.int32):
+    return jax.random.randint(_key_of(rng), shape, low, high, dtype=dtype)
+
+
+def normal(rng, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return mu + sigma * jax.random.normal(_key_of(rng), shape, dtype=dtype)
+
+
+def lognormal(rng, shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    return jnp.exp(normal(rng, shape, mu, sigma, dtype))
+
+
+def gumbel(rng, shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+    return mu + beta * jax.random.gumbel(_key_of(rng), shape, dtype=dtype)
+
+
+def logistic(rng, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.logistic(_key_of(rng), shape, dtype=dtype)
+
+
+def laplace(rng, shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    return mu + scale * jax.random.laplace(_key_of(rng), shape, dtype=dtype)
+
+
+def exponential(rng, shape, lam=1.0, dtype=jnp.float32):
+    return jax.random.exponential(_key_of(rng), shape, dtype=dtype) / lam
+
+
+def rayleigh(rng, shape, sigma=1.0, dtype=jnp.float32):
+    u = jax.random.uniform(_key_of(rng), shape, dtype=dtype)
+    return sigma * jnp.sqrt(-2.0 * jnp.log1p(-u))
+
+
+def bernoulli(rng, shape, prob=0.5):
+    return jax.random.bernoulli(_key_of(rng), prob, shape)
+
+
+def scaled_bernoulli(rng, shape, prob=0.5, scale=1.0, dtype=jnp.float32):
+    return jnp.where(bernoulli(rng, shape, prob), dtype(scale), dtype(-scale))
+
+
+def permute(rng, n: int) -> jax.Array:
+    """Random permutation of [0, n) (``random::permute``)."""
+    return jax.random.permutation(_key_of(rng), n)
+
+
+def sample_without_replacement(
+    rng,
+    n_samples: int,
+    population: int,
+    weights=None,
+) -> jax.Array:
+    """Sample ``n_samples`` distinct indices from [0, population)
+    (``random::sample_without_replacement``, weighted via Gumbel-top-k —
+    the counter-based parallel formulation natural on TPU)."""
+    key = _key_of(rng)
+    if weights is None:
+        return jax.random.permutation(key, population)[:n_samples]
+    logits = jnp.log(jnp.asarray(weights, jnp.float32))
+    g = jax.random.gumbel(key, (population,), dtype=jnp.float32)
+    _, idx = jax.lax.top_k(logits + g, n_samples)
+    return idx
+
+
+def subsample(rng, population: int, n_samples: int) -> jax.Array:
+    """Deterministic-stride subsample used for trainset selection
+    (role of ``detail/ivf_pq_build.cuh:1537-1607`` subsampling)."""
+    if n_samples >= population:
+        return jnp.arange(population)
+    stride = population // n_samples
+    return (jnp.arange(n_samples) * stride).astype(jnp.int32)

@@ -1,0 +1,5 @@
+"""Shared utilities (reference ``raft/util/`` + test-support helpers)."""
+
+from raft_tpu.utils.recall import eval_recall, eval_neighbours
+
+__all__ = ["eval_recall", "eval_neighbours"]

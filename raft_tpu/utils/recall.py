@@ -1,0 +1,64 @@
+"""Recall evaluation with distance-tie tolerance — analog of
+``cpp/test/neighbors/ann_utils.cuh:127-210`` (``eval_recall`` /
+``eval_neighbours``), promoted into the library because the benchmark
+harness uses it too (``bench/ann/src/common/benchmark.hpp`` recall
+counter)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def eval_recall(
+    expected_idx,
+    actual_idx,
+    expected_dist=None,
+    actual_dist=None,
+    eps: float = 1e-3,
+) -> Tuple[float, int, int]:
+    """Fraction of true neighbors found, counting distance-ties as hits.
+
+    A returned neighbor that is not in the ground-truth id set still counts
+    if its distance matches a ground-truth distance within ``eps`` (the
+    reference's tie handling).
+
+    Returns (recall, n_match, n_total).
+    """
+    expected_idx = np.asarray(expected_idx)
+    actual_idx = np.asarray(actual_idx)
+    q, k = expected_idx.shape
+    match = 0
+    for i in range(q):
+        want = set(expected_idx[i].tolist())
+        got = actual_idx[i].tolist()
+        for j, g in enumerate(got):
+            if g in want:
+                match += 1
+            elif expected_dist is not None and actual_dist is not None:
+                ad = actual_dist[i, j]
+                if np.any(np.abs(np.asarray(expected_dist[i]) - ad) <= eps * max(1.0, abs(ad))):
+                    match += 1
+    return match / (q * k), match, q * k
+
+
+def eval_neighbours(
+    expected_idx,
+    actual_idx,
+    expected_dist,
+    actual_dist,
+    min_recall: float,
+    eps: float = 1e-3,
+) -> float:
+    """Assert-style evaluation (``eval_neighbours``): returns recall, raises
+    AssertionError below ``min_recall`` (with slack eps on the threshold,
+    matching the reference's error bound)."""
+    recall, match, total = eval_recall(
+        expected_idx, actual_idx, expected_dist, actual_dist, eps
+    )
+    if recall < min_recall - eps:
+        raise AssertionError(
+            f"recall {recall:.4f} ({match}/{total}) below required {min_recall:.4f}"
+        )
+    return recall

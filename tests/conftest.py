@@ -1,0 +1,44 @@
+"""Test configuration: force a clean 8-virtual-device CPU JAX.
+
+Multi-chip sharding is validated the way the reference validates MNMG
+logic without a cluster (SURVEY.md §4: LocalCUDACluster of local
+processes) — here a single process exposing 8 virtual CPU devices via
+``xla_force_host_platform_device_count``.
+
+This environment routes every interpreter to a single remote TPU chip via
+a PJRT relay plugin registered in ``sitecustomize``; it forces
+``jax_platforms="axon,cpu"`` via jax.config (which overrides the
+JAX_PLATFORMS env var). Tests must never contend for the one real chip,
+so we override the config back to pure CPU *before any backend
+initializes* — jax.config.update beats the plugin's registration-time
+setting as long as it runs before the first ``jax.devices()``.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+assert jax.devices()[0].platform == "cpu", "tests must run on CPU devices"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng_np():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def res():
+    from raft_tpu import Resources
+
+    return Resources(seed=42)

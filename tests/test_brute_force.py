@@ -1,0 +1,102 @@
+"""Brute-force kNN tests (analog of cpp/test/neighbors/knn.cu +
+tiled_knn.cu): exact match vs numpy ground truth, tiling invariance,
+merge_parts, serialization."""
+
+import io
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as spd
+
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors import brute_force
+from raft_tpu.utils import eval_neighbours
+
+
+def _groundtruth(x, q, k, metric="sqeuclidean"):
+    d = spd.cdist(q, x, metric)
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+class TestBruteForce:
+    @pytest.mark.parametrize("metric", [DistanceType.L2Expanded,
+                                        DistanceType.L2SqrtExpanded])
+    def test_exact_recall_l2(self, rng_np, metric):
+        x = rng_np.standard_normal((500, 16)).astype(np.float32)
+        q = rng_np.standard_normal((40, 16)).astype(np.float32)
+        dist, idx = brute_force.knn(None, x, q, 10, metric=metric)
+        scipy_metric = "sqeuclidean" if metric == DistanceType.L2Expanded else "euclidean"
+        gt_d, gt_i = _groundtruth(x, q, 10, scipy_metric)
+        recall = eval_neighbours(gt_i, np.asarray(idx), gt_d, np.asarray(dist),
+                                 min_recall=0.99)
+        assert recall >= 0.99
+        np.testing.assert_allclose(np.asarray(dist), gt_d, rtol=1e-3, atol=1e-3)
+
+    def test_inner_product_direction(self, rng_np):
+        x = rng_np.standard_normal((200, 8)).astype(np.float32)
+        q = rng_np.standard_normal((10, 8)).astype(np.float32)
+        dist, idx = brute_force.knn(None, x, q, 5, metric=DistanceType.InnerProduct)
+        sims = q @ x.T
+        gt_i = np.argsort(-sims, axis=1)[:, :5]
+        gt_d = np.take_along_axis(sims, gt_i, axis=1)
+        # descending similarities
+        assert (np.diff(np.asarray(dist), axis=1) <= 1e-5).all()
+        eval_neighbours(gt_i, np.asarray(idx), gt_d, np.asarray(dist), min_recall=0.99)
+
+    def test_cosine(self, rng_np):
+        x = rng_np.standard_normal((300, 12)).astype(np.float32)
+        q = rng_np.standard_normal((20, 12)).astype(np.float32)
+        dist, idx = brute_force.knn(None, x, q, 8, metric=DistanceType.CosineExpanded)
+        gt_d, gt_i = _groundtruth(x, q, 8, "cosine")
+        eval_neighbours(gt_i, np.asarray(idx), gt_d, np.asarray(dist), min_recall=0.98)
+
+    def test_tiling_invariance(self, rng_np):
+        """Small db_tile must give identical results to one big tile."""
+        x = rng_np.standard_normal((1000, 8)).astype(np.float32)
+        q = rng_np.standard_normal((16, 8)).astype(np.float32)
+        index = brute_force.build(None, x)
+        d1, i1 = brute_force.search(None, index, q, 10, db_tile=64)
+        d2, i2 = brute_force.search(None, index, q, 10, db_tile=100000)
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5, atol=1e-5)
+
+    def test_query_tiling(self, rng_np):
+        x = rng_np.standard_normal((100, 8)).astype(np.float32)
+        q = rng_np.standard_normal((50, 8)).astype(np.float32)
+        index = brute_force.build(None, x)
+        d1, i1 = brute_force.search(None, index, q, 5, query_tile=7)
+        d2, i2 = brute_force.search(None, index, q, 5, query_tile=1000)
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_k_one(self, rng_np):
+        x = rng_np.standard_normal((64, 4)).astype(np.float32)
+        q = x[:5] + 1e-4  # queries near known rows
+        dist, idx = brute_force.knn(None, x, q, 1)
+        np.testing.assert_array_equal(np.asarray(idx)[:, 0], np.arange(5))
+
+    def test_merge_parts(self, rng_np):
+        x = rng_np.standard_normal((400, 8)).astype(np.float32)
+        q = rng_np.standard_normal((12, 8)).astype(np.float32)
+        # shard database in two, search each, merge
+        parts_d, parts_i = [], []
+        for shard, offset in ((x[:200], 0), (x[200:], 200)):
+            d, i = brute_force.knn(None, shard, q, 6)
+            parts_d.append(np.asarray(d))
+            parts_i.append(np.asarray(i) + offset)
+        md, mi = brute_force.knn_merge_parts(np.stack(parts_d), np.stack(parts_i))
+        gt_d, gt_i = _groundtruth(x, q, 6)
+        eval_neighbours(gt_i, np.asarray(mi), gt_d, np.asarray(md), min_recall=0.99)
+
+    def test_serialization_roundtrip(self, rng_np, tmp_path):
+        x = rng_np.standard_normal((50, 6)).astype(np.float32)
+        index = brute_force.build(None, x, metric=DistanceType.CosineExpanded)
+        path = str(tmp_path / "bf.bin")
+        brute_force.save(index, path)
+        loaded = brute_force.load(None, path)
+        assert loaded.metric == DistanceType.CosineExpanded
+        np.testing.assert_array_equal(np.asarray(loaded.dataset), x)
+        q = rng_np.standard_normal((4, 6)).astype(np.float32)
+        d1, i1 = brute_force.search(None, index, q, 3)
+        d2, i2 = brute_force.search(None, loaded, q, 3)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))

@@ -1,0 +1,141 @@
+"""Core runtime tests (analog of reference cpp/test/core/)."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import Resources
+from raft_tpu.core import (
+    Bitset,
+    deserialize_array,
+    deserialize_scalar,
+    serialize_array,
+    serialize_scalar,
+)
+from raft_tpu.core import interruptible
+from raft_tpu.core.serialize import check_version
+from raft_tpu.core.validation import RaftError, check_matrix, expect
+
+
+class TestResources:
+    def test_next_key_unique(self):
+        res = Resources(seed=1)
+        k1, k2 = res.next_key(), res.next_key()
+        assert not np.array_equal(jax.random.key_data(k1), jax.random.key_data(k2))
+
+    def test_next_key_batch(self):
+        res = Resources(seed=1)
+        keys = res.next_key(4)
+        assert keys.shape[0] == 4
+
+    def test_reproducible(self):
+        a = Resources(seed=7).next_key()
+        b = Resources(seed=7).next_key()
+        assert np.array_equal(jax.random.key_data(a), jax.random.key_data(b))
+
+    def test_sync(self):
+        res = Resources()
+        x = jnp.ones((8,))
+        res.sync(x)
+        res.sync()
+
+    def test_subcomm(self):
+        res = Resources()
+        res.set_subcomm("row", "fake")
+        assert res.get_subcomm("row") == "fake"
+
+
+class TestSerialize:
+    def test_array_roundtrip(self, rng_np):
+        buf = io.BytesIO()
+        arr = rng_np.standard_normal((5, 3)).astype(np.float32)
+        serialize_array(buf, jnp.asarray(arr))
+        buf.seek(0)
+        out = deserialize_array(buf)
+        np.testing.assert_array_equal(out, arr)
+
+    def test_scalar_roundtrip(self):
+        buf = io.BytesIO()
+        serialize_scalar(buf, 42, np.int64)
+        serialize_scalar(buf, 2.5, np.float32)
+        buf.seek(0)
+        assert deserialize_scalar(buf) == 42
+        assert deserialize_scalar(buf) == np.float32(2.5)
+
+    def test_stream_of_records(self, rng_np):
+        buf = io.BytesIO()
+        serialize_scalar(buf, 4, np.int32)  # version
+        a = rng_np.random((4, 4)).astype(np.float32)
+        serialize_array(buf, a)
+        buf.seek(0)
+        assert deserialize_scalar(buf) == 4
+        np.testing.assert_array_equal(deserialize_array(buf), a)
+
+    def test_check_version(self):
+        check_version(3, 3, "x")
+        with pytest.raises(ValueError):
+            check_version(2, 3, "x")
+
+
+class TestBitset:
+    def test_default_all_set(self):
+        bs = Bitset.create(70)
+        assert int(bs.count()) == 70
+        assert bool(bs.test(69))
+
+    def test_from_mask_roundtrip(self, rng_np):
+        mask = rng_np.random(100) < 0.5
+        bs = Bitset.from_mask(mask)
+        np.testing.assert_array_equal(np.asarray(bs.to_mask()), mask)
+        assert int(bs.count()) == mask.sum()
+
+    def test_vectorized_test(self, rng_np):
+        mask = rng_np.random(64) < 0.5
+        bs = Bitset.from_mask(mask)
+        idx = jnp.array([0, 5, 63])
+        np.testing.assert_array_equal(np.asarray(bs.test(idx)), mask[[0, 5, 63]])
+
+    def test_set_flip(self):
+        bs = Bitset.create(40, default=False)
+        bs = bs.set(jnp.array([1, 3]))
+        assert int(bs.count()) == 2
+        flipped = bs.flip()
+        assert int(flipped.count()) == 38
+
+    def test_jit_through(self):
+        bs = Bitset.from_mask(jnp.array([True, False, True]))
+
+        @jax.jit
+        def f(b):
+            return b.count()
+
+        assert int(f(bs)) == 2
+
+
+class TestValidation:
+    def test_expect(self):
+        expect(True, "ok")
+        with pytest.raises(RaftError):
+            expect(False, "bad")
+
+    def test_check_matrix(self):
+        check_matrix(jnp.ones((3, 4)), cols=4)
+        with pytest.raises(RaftError):
+            check_matrix(jnp.ones((3,)))
+
+
+class TestInterruptible:
+    def test_yield_no_flag(self):
+        interruptible.yield_()  # no-op
+
+    def test_cancel_then_yield(self):
+        interruptible.cancel()
+        with pytest.raises(interruptible.InterruptedException):
+            interruptible.yield_()
+        interruptible.yield_()  # flag cleared
+
+    def test_synchronize(self):
+        interruptible.synchronize(jnp.ones((4,)))

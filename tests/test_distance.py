@@ -1,0 +1,178 @@
+"""Distance tests vs scipy references (analog of cpp/test/distance/*)."""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as spd
+
+from raft_tpu.distance import (
+    DistanceType,
+    KernelParams,
+    KernelType,
+    fused_l2_nn_argmin,
+    gram_matrix,
+    is_min_close,
+    pairwise_distance,
+    pairwise_distance_tiled,
+)
+
+M, N, D = 33, 47, 16
+
+
+def _data(rng_np, positive=False, binary=False, d=D):
+    x = rng_np.standard_normal((M, d)).astype(np.float32)
+    y = rng_np.standard_normal((N, d)).astype(np.float32)
+    if positive:
+        x, y = np.abs(x) + 0.01, np.abs(y) + 0.01
+    if binary:
+        x, y = (x > 0).astype(np.float32), (y > 0).astype(np.float32)
+    return x, y
+
+
+SCIPY_METRICS = [
+    (DistanceType.L2SqrtExpanded, "euclidean", {}, False, False),
+    (DistanceType.L2Expanded, "sqeuclidean", {}, False, False),
+    (DistanceType.L2SqrtUnexpanded, "euclidean", {}, False, False),
+    (DistanceType.L2Unexpanded, "sqeuclidean", {}, False, False),
+    (DistanceType.CosineExpanded, "cosine", {}, False, False),
+    (DistanceType.L1, "cityblock", {}, False, False),
+    (DistanceType.Linf, "chebyshev", {}, False, False),
+    (DistanceType.Canberra, "canberra", {}, False, False),
+    (DistanceType.CorrelationExpanded, "correlation", {}, False, False),
+    (DistanceType.BrayCurtis, "braycurtis", {}, True, False),
+    (DistanceType.JensenShannon, "jensenshannon", {}, True, False),
+    (DistanceType.LpUnexpanded, "minkowski", {"p": 3.0}, False, False),
+    (DistanceType.HammingUnexpanded, "hamming", {}, False, True),
+    (DistanceType.RusselRaoExpanded, "russellrao", {}, False, True),
+    (DistanceType.DiceExpanded, "dice", {}, False, True),
+]
+
+
+@pytest.mark.parametrize("metric,scipy_name,kwargs,positive,binary", SCIPY_METRICS)
+def test_vs_scipy(rng_np, metric, scipy_name, kwargs, positive, binary):
+    x, y = _data(rng_np, positive=positive, binary=binary)
+    if metric == DistanceType.JensenShannon:
+        # scipy normalizes to probability vectors internally; the reference
+        # formula assumes already-normalized inputs
+        x /= x.sum(1, keepdims=True)
+        y /= y.sum(1, keepdims=True)
+    got = np.asarray(
+        pairwise_distance(None, x, y, metric, metric_arg=kwargs.get("p", 2.0))
+    )
+    want = spd.cdist(x.astype(np.float64), y.astype(np.float64), scipy_name, **kwargs)
+    atol = 2e-3 if "sq" in scipy_name or metric == DistanceType.L2Expanded else 1e-3
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=atol)
+
+
+def test_inner_product(rng_np):
+    x, y = _data(rng_np)
+    got = np.asarray(pairwise_distance(None, x, y, DistanceType.InnerProduct))
+    np.testing.assert_allclose(got, x @ y.T, rtol=1e-4, atol=1e-4)
+    assert not is_min_close(DistanceType.InnerProduct)
+    assert is_min_close(DistanceType.L2Expanded)
+
+
+def test_hellinger(rng_np):
+    x, y = _data(rng_np, positive=True)
+    # normalize to probability vectors
+    x /= x.sum(1, keepdims=True)
+    y /= y.sum(1, keepdims=True)
+    got = np.asarray(pairwise_distance(None, x, y, DistanceType.HellingerExpanded))
+    ip = np.sqrt(x) @ np.sqrt(y).T
+    want = np.sqrt(np.maximum(1.0 - ip, 0.0))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_kl_divergence(rng_np):
+    x, y = _data(rng_np, positive=True)
+    x /= x.sum(1, keepdims=True)
+    y /= y.sum(1, keepdims=True)
+    got = np.asarray(pairwise_distance(None, x, y, DistanceType.KLDivergence))
+    want = np.array(
+        [[np.sum(xi * (np.log(xi) - np.log(yj))) for yj in y] for xi in x]
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_jaccard(rng_np):
+    x, y = _data(rng_np, binary=True)
+    got = np.asarray(pairwise_distance(None, x, y, DistanceType.JaccardExpanded))
+    ip = x @ y.T
+    denom = (x**2).sum(1)[:, None] + (y**2).sum(1)[None, :] - ip
+    want = 1.0 - np.divide(ip, denom, out=np.zeros_like(ip), where=denom != 0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_haversine(rng_np):
+    x = rng_np.uniform(-1.0, 1.0, (10, 2)).astype(np.float32)
+    y = rng_np.uniform(-1.0, 1.0, (12, 2)).astype(np.float32)
+    got = np.asarray(pairwise_distance(None, x, y, DistanceType.Haversine))
+
+    def hav(a, b):
+        s1 = np.sin(0.5 * (a[0] - b[0])) ** 2
+        s2 = np.sin(0.5 * (a[1] - b[1])) ** 2
+        return 2 * np.arcsin(np.sqrt(s1 + np.cos(a[0]) * np.cos(b[0]) * s2))
+
+    want = np.array([[hav(a, b) for b in y] for a in x])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_tiled_matches_full(rng_np):
+    x = rng_np.standard_normal((300, 8)).astype(np.float32)
+    y = rng_np.standard_normal((50, 8)).astype(np.float32)
+    full = np.asarray(pairwise_distance(None, x, y, DistanceType.L2Expanded))
+    tiled = np.asarray(
+        pairwise_distance_tiled(None, x, y, DistanceType.L2Expanded, row_tile=128)
+    )
+    np.testing.assert_allclose(full, tiled, rtol=1e-5, atol=1e-5)
+
+
+def test_self_distance_zero_diag(rng_np):
+    x, _ = _data(rng_np)
+    d = np.asarray(pairwise_distance(None, x, x, DistanceType.L2Expanded))
+    np.testing.assert_allclose(np.diag(d), 0.0, atol=1e-3)
+
+
+class TestFusedL2NN:
+    def test_matches_bruteforce(self, rng_np):
+        x = rng_np.standard_normal((100, 12)).astype(np.float32)
+        y = rng_np.standard_normal((37, 12)).astype(np.float32)
+        dist, idx = fused_l2_nn_argmin(None, x, y, tile=16)
+        full = spd.cdist(x, y, "sqeuclidean")
+        # tie tolerance (as in reference ann_utils.cuh eval_neighbours):
+        # the distance at the chosen index must equal the true min
+        chosen = full[np.arange(len(x)), np.asarray(idx)]
+        np.testing.assert_allclose(chosen, full.min(1), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dist), full.min(1), rtol=1e-3, atol=1e-3)
+
+    def test_sqrt(self, rng_np):
+        x = rng_np.standard_normal((20, 4)).astype(np.float32)
+        y = rng_np.standard_normal((8, 4)).astype(np.float32)
+        dist, _ = fused_l2_nn_argmin(None, x, y, sqrt=True)
+        full = spd.cdist(x, y, "euclidean")
+        np.testing.assert_allclose(np.asarray(dist), full.min(1), rtol=1e-3, atol=1e-3)
+
+
+class TestGram:
+    def test_linear(self, rng_np):
+        x, y = _data(rng_np)
+        k = np.asarray(gram_matrix(None, x, y, KernelParams(KernelType.LINEAR)))
+        np.testing.assert_allclose(k, x @ y.T, rtol=1e-4, atol=1e-4)
+
+    def test_rbf(self, rng_np):
+        x, y = _data(rng_np)
+        gamma = 0.5
+        k = np.asarray(gram_matrix(None, x, y, KernelParams(KernelType.RBF, gamma=gamma)))
+        want = np.exp(-gamma * spd.cdist(x, y, "sqeuclidean"))
+        np.testing.assert_allclose(k, want, rtol=1e-3, atol=1e-3)
+
+    def test_poly(self, rng_np):
+        x, y = _data(rng_np)
+        p = KernelParams(KernelType.POLYNOMIAL, degree=2, gamma=0.1, coef0=1.0)
+        k = np.asarray(gram_matrix(None, x, y, p))
+        np.testing.assert_allclose(k, (0.1 * (x @ y.T) + 1.0) ** 2, rtol=1e-3, atol=1e-3)
+
+    def test_tanh(self, rng_np):
+        x, y = _data(rng_np)
+        p = KernelParams(KernelType.TANH, gamma=0.01, coef0=0.5)
+        k = np.asarray(gram_matrix(None, x, y, p))
+        np.testing.assert_allclose(k, np.tanh(0.01 * (x @ y.T) + 0.5), rtol=1e-3, atol=1e-3)

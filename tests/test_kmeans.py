@@ -1,0 +1,116 @@
+"""k-means tests vs sklearn-style expectations (analog of
+cpp/test/cluster/kmeans*.cu, test_kmeans.py)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import random as rrandom
+from raft_tpu.cluster import kmeans, kmeans_balanced
+from raft_tpu.cluster import KMeansParams, KMeansBalancedParams, InitMethod
+from raft_tpu.distance.types import DistanceType
+
+
+@pytest.fixture(scope="module")
+def blobs():
+    x, labels, centers = rrandom.make_blobs(
+        rrandom.RngState(0), 2000, 10, n_clusters=5, cluster_std=0.5
+    )
+    return np.asarray(x), np.asarray(labels), np.asarray(centers)
+
+
+class TestKMeans:
+    def test_fit_recovers_blobs(self, blobs):
+        x, true_labels, true_centers = blobs
+        params = KMeansParams(n_clusters=5, max_iter=100, seed=0)
+        centroids, inertia, n_iter = kmeans.fit(None, params, x)
+        centroids = np.asarray(centroids)
+        # every true center should have a learned centroid nearby
+        d = ((true_centers[:, None, :] - centroids[None]) ** 2).sum(-1)
+        assert d.min(axis=1).max() < 1.0
+        assert float(inertia) < 2000 * 10 * 0.5**2 * 2.5
+        assert int(n_iter) >= 1
+
+    def test_random_init(self, blobs):
+        x, _, _ = blobs
+        params = KMeansParams(n_clusters=5, max_iter=100, init=InitMethod.Random, seed=3)
+        centroids, inertia, _ = kmeans.fit(None, params, x)
+        assert np.isfinite(np.asarray(centroids)).all()
+
+    def test_array_init(self, blobs):
+        x, _, true_centers = blobs
+        params = KMeansParams(n_clusters=5, max_iter=50, init=InitMethod.Array)
+        centroids, _, n_iter = kmeans.fit(None, params, x, init_centroids=true_centers)
+        assert int(n_iter) <= 10  # should converge almost instantly
+
+    def test_predict_consistent(self, blobs):
+        x, true_labels, _ = blobs
+        params = KMeansParams(n_clusters=5, max_iter=100, seed=0)
+        centroids, _, _ = kmeans.fit(None, params, x)
+        labels, _ = kmeans.predict(None, params, centroids, x)
+        labels = np.asarray(labels)
+        # cluster assignment should match blob structure up to permutation:
+        # points sharing a true label share a predicted label
+        from scipy.stats import mode
+        agree = 0
+        for c in range(5):
+            sel = true_labels == c
+            agree += (labels[sel] == mode(labels[sel]).mode).sum()
+        assert agree / len(labels) > 0.95
+
+    def test_transform_shape(self, blobs):
+        x, _, _ = blobs
+        params = KMeansParams(n_clusters=5)
+        centroids, _, _ = kmeans.fit(None, params, x)
+        t = kmeans.transform(None, params, centroids, x)
+        assert t.shape == (2000, 5)
+
+    def test_cluster_cost_matches_inertia(self, blobs):
+        x, _, _ = blobs
+        params = KMeansParams(n_clusters=5, max_iter=100, seed=0)
+        centroids, inertia, _ = kmeans.fit(None, params, x)
+        cost = kmeans.cluster_cost(None, centroids, x)
+        np.testing.assert_allclose(float(cost), float(inertia), rtol=1e-3)
+
+    def test_find_k(self):
+        x, _, _ = rrandom.make_blobs(rrandom.RngState(1), 300, 4, n_clusters=3,
+                                     cluster_std=0.2)
+        best_k, _ = kmeans.find_k(None, np.asarray(x), k_max=6, k_min=2, max_iter=50)
+        assert best_k == 3
+
+
+class TestKMeansBalanced:
+    def test_fit_quality_and_balance(self, blobs):
+        x, _, _ = blobs
+        params = KMeansBalancedParams(n_iters=20, seed=0)
+        centers, labels, sizes = kmeans_balanced.build_clusters(None, params, x, 8)
+        sizes = np.asarray(sizes)
+        assert sizes.sum() == len(x)
+        # balancing: no cluster should be tiny
+        assert sizes.min() > 0.25 * len(x) / 8 * 0.5
+
+    def test_predict(self, blobs):
+        x, _, _ = blobs
+        params = KMeansBalancedParams(n_iters=10, seed=0)
+        centers = kmeans_balanced.fit(None, params, x, 6)
+        labels = np.asarray(kmeans_balanced.predict(None, params, centers, x))
+        d = ((x[:, None, :] - np.asarray(centers)[None]) ** 2).sum(-1)
+        np.testing.assert_array_equal(labels, d.argmin(1))
+
+    def test_inner_product_metric(self, blobs):
+        x, _, _ = blobs
+        xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+        params = KMeansBalancedParams(n_iters=10, seed=0,
+                                      metric=DistanceType.InnerProduct)
+        centers = np.asarray(kmeans_balanced.fit(None, params, xn, 4))
+        # centers stay normalized for IP metric
+        np.testing.assert_allclose(np.linalg.norm(centers, axis=1), 1.0, atol=1e-3)
+
+    def test_calc_centers_and_sizes(self, rng_np):
+        x = rng_np.standard_normal((50, 3)).astype(np.float32)
+        labels = rng_np.integers(0, 4, 50).astype(np.int32)
+        centers, sizes = kmeans_balanced.calc_centers_and_sizes(x, labels, 4)
+        for c in range(4):
+            if (labels == c).any():
+                np.testing.assert_allclose(
+                    np.asarray(centers)[c], x[labels == c].mean(0), rtol=1e-4, atol=1e-4
+                )

@@ -1,0 +1,90 @@
+"""select_k + matrix ops tests (analog of cpp/test/matrix/*)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import matrix
+from raft_tpu.matrix import SelectAlgo, select_k
+
+
+class TestSelectK:
+    @pytest.mark.parametrize("select_min", [True, False])
+    @pytest.mark.parametrize("algo", [SelectAlgo.TOPK, SelectAlgo.SORT])
+    def test_exact(self, rng_np, select_min, algo):
+        vals = rng_np.standard_normal((13, 200)).astype(np.float32)
+        k = 17
+        got_v, got_i = select_k(None, vals, k, select_min=select_min, algo=algo)
+        order = np.argsort(vals if select_min else -vals, axis=1, kind="stable")
+        want_v = np.take_along_axis(vals, order[:, :k], axis=1)
+        np.testing.assert_allclose(np.sort(np.asarray(got_v), 1), np.sort(want_v, 1),
+                                   rtol=1e-6, atol=1e-6)
+        # values at returned indices must match returned values
+        np.testing.assert_allclose(
+            np.take_along_axis(vals, np.asarray(got_i), axis=1),
+            np.asarray(got_v), rtol=1e-6, atol=1e-6,
+        )
+
+    def test_index_payload(self, rng_np):
+        vals = rng_np.standard_normal((4, 50)).astype(np.float32)
+        payload = rng_np.integers(1000, 2000, (4, 50)).astype(np.int32)
+        _, got_i = select_k(None, vals, 5, index_values=payload)
+        pos = np.argsort(vals, 1)[:, :5]
+        want = np.take_along_axis(payload, pos, 1)
+        assert set(np.asarray(got_i).ravel()) == set(want.ravel())
+
+    def test_k_equals_n(self, rng_np):
+        vals = rng_np.standard_normal((3, 8)).astype(np.float32)
+        got_v, _ = select_k(None, vals, 8)
+        np.testing.assert_allclose(np.asarray(got_v), np.sort(vals, 1), rtol=1e-6)
+
+    def test_approx_recall(self, rng_np):
+        vals = rng_np.standard_normal((4, 4096)).astype(np.float32)
+        k = 10
+        got_v, got_i = select_k(None, vals, k, algo=SelectAlgo.APPROX)
+        want_i = np.argsort(vals, 1)[:, :k]
+        recall = np.mean([
+            len(set(np.asarray(got_i)[b]) & set(want_i[b])) / k
+            for b in range(vals.shape[0])
+        ])
+        assert recall >= 0.7
+
+
+class TestMatrixOps:
+    def test_gather_scatter(self, rng_np):
+        m = rng_np.standard_normal((10, 4)).astype(np.float32)
+        idx = np.array([3, 1, 7])
+        g = np.asarray(matrix.gather(m, idx))
+        np.testing.assert_array_equal(g, m[idx])
+        s = np.asarray(matrix.scatter(np.zeros_like(m), idx, g))
+        np.testing.assert_array_equal(s[idx], m[idx])
+
+    def test_gather_if(self, rng_np):
+        m = rng_np.standard_normal((6, 3)).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        stencil = np.array([1, 0, 1])
+        out = np.asarray(matrix.gather_if(m, idx, stencil, lambda s: s > 0))
+        np.testing.assert_array_equal(out[1], 0)
+        np.testing.assert_array_equal(out[0], m[0])
+
+    def test_argmax_argmin(self, rng_np):
+        m = rng_np.standard_normal((5, 9)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.argmax(m)), m.argmax(1))
+        np.testing.assert_array_equal(np.asarray(matrix.argmin(m)), m.argmin(1))
+
+    def test_col_sort(self, rng_np):
+        m = rng_np.standard_normal((4, 7)).astype(np.float32)
+        keys, order = matrix.col_sort(m)
+        np.testing.assert_allclose(np.asarray(keys), np.sort(m, 1), rtol=1e-6)
+
+    def test_slice_reverse_tri(self, rng_np):
+        m = rng_np.standard_normal((6, 6)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.slice(m, (1, 4), (2, 5))), m[1:4, 2:5])
+        np.testing.assert_array_equal(np.asarray(matrix.reverse(m)), m[:, ::-1])
+        np.testing.assert_array_equal(np.asarray(matrix.triangular_upper(m)), np.triu(m))
+
+    def test_linewise(self, rng_np):
+        m = rng_np.standard_normal((3, 5)).astype(np.float32)
+        v = rng_np.standard_normal(5).astype(np.float32)
+        out = np.asarray(matrix.linewise_op(m, v, True, jnp.add))
+        np.testing.assert_allclose(out, m + v[None, :], rtol=1e-6)

@@ -1,0 +1,90 @@
+"""RNG + generator tests (analog of cpp/test/random/*)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import random as rrandom
+
+
+class TestRng:
+    def test_reproducible(self):
+        a = rrandom.uniform(rrandom.RngState(3), (100,))
+        b = rrandom.uniform(rrandom.RngState(3), (100,))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_stream_advances(self):
+        st = rrandom.RngState(3)
+        a = rrandom.uniform(st, (100,))
+        b = rrandom.uniform(st, (100,))
+        assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_uniform_range(self):
+        x = np.asarray(rrandom.uniform(rrandom.RngState(0), (5000,), low=-2, high=3))
+        assert x.min() >= -2 and x.max() < 3
+        assert abs(x.mean() - 0.5) < 0.1
+
+    def test_normal_moments(self):
+        x = np.asarray(rrandom.normal(rrandom.RngState(0), (20000,), mu=1.0, sigma=2.0))
+        assert abs(x.mean() - 1.0) < 0.1
+        assert abs(x.std() - 2.0) < 0.1
+
+    @pytest.mark.parametrize("fn", ["lognormal", "gumbel", "logistic", "laplace",
+                                    "exponential", "rayleigh"])
+    def test_distributions_finite(self, fn):
+        x = np.asarray(getattr(rrandom, fn)(rrandom.RngState(0), (1000,)))
+        assert np.isfinite(x).all()
+
+    def test_bernoulli(self):
+        x = np.asarray(rrandom.bernoulli(rrandom.RngState(0), (10000,), prob=0.3))
+        assert abs(x.mean() - 0.3) < 0.05
+
+    def test_permute(self):
+        p = np.asarray(rrandom.permute(rrandom.RngState(0), 100))
+        np.testing.assert_array_equal(np.sort(p), np.arange(100))
+
+    def test_sample_without_replacement(self):
+        s = np.asarray(rrandom.sample_without_replacement(rrandom.RngState(0), 20, 100))
+        assert len(set(s.tolist())) == 20
+
+    def test_weighted_sample(self):
+        w = np.zeros(50)
+        w[:10] = 1.0
+        s = np.asarray(
+            rrandom.sample_without_replacement(rrandom.RngState(0), 10, 50, weights=w + 1e-9)
+        )
+        assert set(s.tolist()) == set(range(10))
+
+
+class TestGenerators:
+    def test_make_blobs_separable(self):
+        x, labels, centers = rrandom.make_blobs(
+            rrandom.RngState(0), 500, 8, n_clusters=4, cluster_std=0.1
+        )
+        x, labels, centers = np.asarray(x), np.asarray(labels), np.asarray(centers)
+        assert x.shape == (500, 8) and labels.shape == (500,)
+        # each point is closest to its own center
+        d = ((x[:, None, :] - centers[None]) ** 2).sum(-1)
+        assert (d.argmin(1) == labels).mean() > 0.99
+
+    def test_make_regression_solvable(self):
+        x, y, coef = rrandom.make_regression(rrandom.RngState(0), 200, 10, noise=0.0)
+        x, y, coef = np.asarray(x), np.asarray(y), np.asarray(coef)
+        fitted, *_ = np.linalg.lstsq(x, y, rcond=None)
+        np.testing.assert_allclose(fitted, coef, rtol=1e-2, atol=1e-2)
+
+    def test_rmat_shapes(self):
+        e = np.asarray(rrandom.rmat(rrandom.RngState(0), 8, 8, 1000))
+        assert e.shape == (1000, 2)
+        assert e.min() >= 0 and e.max() < 256
+
+    def test_rmat_skew(self):
+        # default theta strongly favors quadrant a → low ids dominate
+        e = np.asarray(rrandom.rmat(rrandom.RngState(0), 10, 10, 5000))
+        assert (e[:, 0] < 512).mean() > 0.6
+
+    def test_mvg(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        x = np.asarray(
+            rrandom.multi_variable_gaussian(rrandom.RngState(0), np.zeros(2), cov, 20000)
+        )
+        np.testing.assert_allclose(np.cov(x.T), cov, atol=0.15)

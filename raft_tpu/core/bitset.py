@@ -18,6 +18,17 @@ import jax.numpy as jnp
 WORD_BITS = 32
 
 
+def test_words(words, idx):
+    """Vectorized bit test against a raw word array — the jit-internal form
+    of :meth:`Bitset.test` used by search kernels that carry ``words``
+    through ``lax.scan``. Negative indices are treated as bit 0 (callers
+    mask them separately)."""
+    idx = jnp.asarray(idx)
+    safe = jnp.clip(idx, 0)
+    word = words[safe // WORD_BITS]
+    return ((word >> (safe % WORD_BITS).astype(jnp.uint32)) & 1).astype(jnp.bool_)
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass(frozen=True)
 class Bitset:
@@ -61,9 +72,7 @@ class Bitset:
     # -- queries -------------------------------------------------------------
     def test(self, idx) -> jax.Array:
         """``bitset_view::test`` — vectorized: idx may be any int array."""
-        idx = jnp.asarray(idx)
-        word = self.words[idx // WORD_BITS]
-        return ((word >> (idx % WORD_BITS).astype(jnp.uint32)) & 1).astype(jnp.bool_)
+        return test_words(self.words, idx)
 
     def to_mask(self) -> jax.Array:
         """Unpack to bool[n_bits]."""

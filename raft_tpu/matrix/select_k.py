@@ -71,6 +71,26 @@ def _select_k_impl(values, k: int, select_min: bool, algo: SelectAlgo, recall_ta
     return vals, idx.astype(jnp.int32)
 
 
+def merge_topk(best_d, best_i, cand_d, cand_i, k: int, select_min: bool = True):
+    """Merge a running top-k state with a new candidate block — the shared
+    streamed-merge step of brute-force / IVF-Flat / IVF-PQ scans (role of
+    the warp-level merge in the reference's tiled kNN,
+    ``detail/knn_brute_force.cuh:238-280``).
+
+    Args: (batch, k) running values/ids + (batch, m) candidates.
+    Returns merged (batch, k) values/ids.
+    """
+    cat_d = jnp.concatenate([best_d, cand_d], axis=1)
+    cat_i = jnp.concatenate([best_i, cand_i], axis=1)
+    if select_min:
+        new_d, pos = jax.lax.top_k(-cat_d, k)
+        new_d = -new_d
+    else:
+        new_d, pos = jax.lax.top_k(cat_d, k)
+    new_i = jnp.take_along_axis(cat_i, pos, axis=1)
+    return new_d, new_i
+
+
 def select_k(
     res: Optional[Resources],
     values,

@@ -2,10 +2,18 @@
 ``raft/neighbors/``, SURVEY.md §2.5)."""
 
 from raft_tpu.neighbors import brute_force
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors import ivf_pq
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
+# pylibraft parity: ``neighbors.refine`` is the function (the submodule
+# stays importable as ``raft_tpu.neighbors.refine`` via sys.modules)
+from raft_tpu.neighbors.refine import refine
 
 __all__ = [
     "brute_force",
+    "ivf_flat",
+    "ivf_pq",
+    "refine",
     "IndexParams",
     "SearchParams",
 ]

@@ -41,6 +41,7 @@ from raft_tpu.core.serialize import (
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.pairwise import _pairwise_distance_impl
 from raft_tpu.distance.types import DistanceType, is_min_close
+from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors.ann_types import IndexParams
 
 _SERIALIZATION_VERSION = 1
@@ -117,15 +118,8 @@ def _knn_scan(queries, dataset, k: int, metric: DistanceType, metric_arg: float,
         else:
             tile_d, tile_i = jax.lax.top_k(dist, kk)
         tile_gi = t_idx * tile + tile_i
-        # merge with running state over the 2k candidates
-        cat_d = jnp.concatenate([best_d, tile_d], axis=1)
-        cat_i = jnp.concatenate([best_i, tile_gi.astype(jnp.int32)], axis=1)
-        if select_min:
-            new_d, pos = jax.lax.top_k(-cat_d, k)
-            new_d = -new_d
-        else:
-            new_d, pos = jax.lax.top_k(cat_d, k)
-        new_i = jnp.take_along_axis(cat_i, pos, axis=1)
+        new_d, new_i = merge_topk(best_d, best_i, tile_d,
+                                  tile_gi.astype(jnp.int32), k, select_min)
         return (new_d, new_i), None
 
     init = (
@@ -196,13 +190,8 @@ def knn_merge_parts(distances, indices, select_min: bool = True):
     n_parts, q, k = distances.shape
     cat_d = jnp.moveaxis(distances, 0, 1).reshape(q, n_parts * k)
     cat_i = jnp.moveaxis(indices, 0, 1).reshape(q, n_parts * k)
-    if select_min:
-        merged_d, pos = jax.lax.top_k(-cat_d, k)
-        merged_d = -merged_d
-    else:
-        merged_d, pos = jax.lax.top_k(cat_d, k)
-    merged_i = jnp.take_along_axis(cat_i, pos, axis=1)
-    return merged_d, merged_i
+    return merge_topk(cat_d[:, :k], cat_i[:, :k], cat_d[:, k:], cat_i[:, k:],
+                      k, select_min)
 
 
 # -- serialization ----------------------------------------------------------

@@ -1,0 +1,434 @@
+"""IVF-Flat — inverted-file index with raw vectors, TPU-native re-design
+of ``raft::neighbors::ivf_flat`` (``neighbors/ivf_flat_types.hpp:131``,
+build ``detail/ivf_flat_build.cuh:301``, search
+``detail/ivf_flat_search-inl.cuh:38-210``).
+
+Reference architecture: balanced-kmeans cluster centers; ragged per-list
+device arrays with vectors interleaved in groups of 32
+(``ivf_flat_types.hpp:163-176``); search = coarse GEMM + select_k over
+centers, then a fused ``interleaved_scan`` kernel over probed lists.
+
+TPU re-design (SURVEY.md §7.4): raggedness is the enemy of XLA, so lists
+live in ONE dense padded tensor ``data[n_lists, max_list_size, dim]``
+(max_list_size = padded max cluster population; balanced k-means keeps the
+overhead ≈2× worst case). The probe scan becomes a ``lax.scan`` over probe
+ranks: gather one probed list per query (a dense row gather), one batched
+MXU GEMM per rank, mask padding slots to +inf, and merge into a running
+top-k — the same streamed-merge shape as brute force. Per-slot squared
+norms are precomputed so the scan is a pure ``norms - 2 x·y`` epilog
+(the reference caches norms the same way, ``ivf_flat_types.hpp``).
+
+int8/uint8 datasets are stored packed and upcast inside the scan
+(reference supports float/int8/uint8, ``ivf_flat_types.hpp:49-68``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu.core import tracing
+from raft_tpu.core.bitset import Bitset, test_words
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.serialize import (
+    check_version,
+    deserialize_array,
+    deserialize_scalar,
+    open_maybe_path,
+    serialize_array,
+    serialize_scalar,
+)
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType, is_min_close
+from raft_tpu.matrix.select_k import merge_topk
+from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
+
+_SERIALIZATION_VERSION = 4  # kept in step with the reference's v4 format id
+
+
+@dataclasses.dataclass(frozen=True)
+class IvfFlatIndexParams(IndexParams):
+    """Mirrors ``ivf_flat::index_params`` (``ivf_flat_types.hpp:49-68``)."""
+
+    n_lists: int = 1024
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    adaptive_centers: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class IvfFlatSearchParams(SearchParams):
+    """Mirrors ``ivf_flat::search_params``."""
+
+    n_probes: int = 20
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class IvfFlatIndex:
+    """Padded-dense IVF index (role of ``ivf_flat::index``,
+    ``ivf_flat_types.hpp:131``)."""
+
+    centers: jax.Array        # (n_lists, d) float32
+    center_norms: jax.Array   # (n_lists,) float32 squared norms
+    data: jax.Array           # (n_lists, max_list_size, d) storage dtype
+    data_norms: jax.Array     # (n_lists, max_list_size) f32, +inf at padding
+    indices: jax.Array        # (n_lists, max_list_size) int32, -1 at padding
+    list_sizes: jax.Array     # (n_lists,) int32
+    metric: DistanceType
+    adaptive_centers: bool
+
+    def tree_flatten(self):
+        return (
+            self.centers, self.center_norms, self.data, self.data_norms,
+            self.indices, self.list_sizes,
+        ), (self.metric, self.adaptive_centers)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, metric=aux[0], adaptive_centers=aux[1])
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def max_list_size(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(self.list_sizes.sum())
+
+
+# ---------------------------------------------------------------------------
+# build / extend
+# ---------------------------------------------------------------------------
+
+
+def _pack_lists(dataset, ids, labels, n_lists: int, max_list_size: int):
+    """Scatter rows into the padded [n_lists, max_list_size] layout.
+
+    Dense formulation of the reference's per-list packing
+    (``detail/ivf_flat_build.cuh:161`` extend): stable-sort rows by label,
+    compute each row's rank within its list, scatter into flat slots.
+    """
+    n, d = dataset.shape
+    labels = labels.astype(jnp.int32)
+    order = jnp.argsort(labels, stable=True)
+    sorted_labels = labels[order]
+    # rank within list = position - first position of this label
+    first_pos = jnp.searchsorted(sorted_labels, jnp.arange(n_lists), side="left")
+    rank = jnp.arange(n) - first_pos[sorted_labels]
+    slot = sorted_labels * max_list_size + rank
+
+    flat_data = jnp.zeros((n_lists * max_list_size, d), dataset.dtype)
+    flat_idx = jnp.full((n_lists * max_list_size,), -1, jnp.int32)
+    flat_data = flat_data.at[slot].set(dataset[order])
+    flat_idx = flat_idx.at[slot].set(ids[order].astype(jnp.int32))
+
+    data = flat_data.reshape(n_lists, max_list_size, d)
+    indices = flat_idx.reshape(n_lists, max_list_size)
+    sizes = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.int32), labels, num_segments=n_lists
+    )
+    # per-slot norms; +inf on padding so padded slots never win the top-k
+    norms = jnp.sum(jnp.square(data.astype(jnp.float32)), axis=2)
+    norms = jnp.where(indices >= 0, norms, jnp.inf)
+    return data, norms, indices, sizes
+
+
+def build(
+    res: Optional[Resources],
+    params: IvfFlatIndexParams,
+    dataset,
+) -> IvfFlatIndex:
+    """Train the coarse quantizer and (optionally) fill the lists —
+    ``ivf_flat::build`` (``detail/ivf_flat_build.cuh:301``)."""
+    res = ensure_resources(res)
+    dataset = jnp.asarray(dataset)
+    expect(dataset.ndim == 2, "dataset must be (n, d)")
+    n, d = dataset.shape
+    expect(params.n_lists <= n, "n_lists > n_rows")
+    expect(
+        params.metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                          DistanceType.InnerProduct),
+        f"ivf_flat supports L2Expanded/L2SqrtExpanded/InnerProduct, got {params.metric!r}",
+    )
+    with tracing.range("raft_tpu.ivf_flat.build"):
+        # subsample trainset (``ivf_pq_build.cuh:1537`` pattern shared by IVF)
+        frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+        n_train = max(params.n_lists, int(n * frac))
+        if n_train < n:
+            stride = n // n_train
+            trainset = dataset[:: stride][:n_train].astype(jnp.float32)
+        else:
+            trainset = dataset.astype(jnp.float32)
+        km_params = KMeansBalancedParams(
+            n_iters=params.kmeans_n_iters,
+            metric=(DistanceType.InnerProduct
+                    if params.metric == DistanceType.InnerProduct
+                    else DistanceType.L2Expanded),
+            seed=res.seed,
+        )
+        centers = kmeans_balanced.fit(res, km_params, trainset, params.n_lists)
+        center_norms = jnp.sum(jnp.square(centers), axis=1)
+
+        empty = IvfFlatIndex(
+            centers=centers,
+            center_norms=center_norms,
+            data=jnp.zeros((params.n_lists, 0, d), dataset.dtype),
+            data_norms=jnp.zeros((params.n_lists, 0), jnp.float32),
+            indices=jnp.full((params.n_lists, 0), -1, jnp.int32),
+            list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
+            metric=DistanceType(params.metric),
+            adaptive_centers=params.adaptive_centers,
+        )
+        if not params.add_data_on_build:
+            return empty
+        return extend(res, empty, dataset, jnp.arange(n, dtype=jnp.int32))
+
+
+def extend(
+    res: Optional[Resources],
+    index: IvfFlatIndex,
+    new_vectors,
+    new_indices=None,
+) -> IvfFlatIndex:
+    """Add vectors to the index — ``ivf_flat::extend``
+    (``detail/ivf_flat_build.cuh:161``). Functional: returns a new index
+    (XLA model; the reference mutates device lists in place).
+
+    With ``adaptive_centers`` the centers drift toward the running mean of
+    their list (``ivf_flat_types.hpp:57-68``)."""
+    res = ensure_resources(res)
+    new_vectors = jnp.asarray(new_vectors)
+    expect(new_vectors.ndim == 2 and new_vectors.shape[1] == index.dim,
+           "new_vectors must be (n, dim)")
+    n_new = new_vectors.shape[0]
+    if new_indices is None:
+        start = index.size
+        new_indices = jnp.arange(start, start + n_new, dtype=jnp.int32)
+    else:
+        new_indices = jnp.asarray(new_indices, jnp.int32)
+
+    with tracing.range("raft_tpu.ivf_flat.extend"):
+        km_params = KMeansBalancedParams(
+            metric=(DistanceType.InnerProduct
+                    if index.metric == DistanceType.InnerProduct
+                    else DistanceType.L2Expanded))
+        new_labels = kmeans_balanced.predict(res, km_params, index.centers,
+                                             new_vectors.astype(jnp.float32))
+
+        # gather existing rows back to flat form and re-pack everything
+        if index.max_list_size > 0:
+            old_rows = index.data.reshape(-1, index.dim)
+            old_ids = index.indices.reshape(-1)
+            old_labels = jnp.repeat(jnp.arange(index.n_lists, dtype=jnp.int32),
+                                    index.max_list_size)
+            keep = old_ids >= 0
+            # compaction happens on host-side sizes; keep as dense select
+            all_vecs = jnp.concatenate([old_rows[keep], new_vectors])
+            all_ids = jnp.concatenate([old_ids[keep], new_indices])
+            all_labels = jnp.concatenate([old_labels[keep], new_labels])
+        else:
+            all_vecs, all_ids, all_labels = new_vectors, new_indices, new_labels
+
+        sizes = jax.ops.segment_sum(
+            jnp.ones((all_vecs.shape[0],), jnp.int32), all_labels,
+            num_segments=index.n_lists,
+        )
+        # one host sync at build/extend time to fix the padded extent
+        max_size = int(jnp.max(sizes))
+        max_size = max(8, -(-max_size // 8) * 8)  # round up to sublane multiple
+
+        data, norms, indices, sizes = _pack_lists(
+            all_vecs, all_ids, all_labels, index.n_lists, max_size
+        )
+
+        centers = index.centers
+        if index.adaptive_centers:
+            sums = jax.ops.segment_sum(
+                all_vecs.astype(jnp.float32), all_labels,
+                num_segments=index.n_lists,
+            )
+            nonempty = sizes > 0
+            centers = jnp.where(
+                nonempty[:, None],
+                sums / jnp.maximum(sizes, 1)[:, None].astype(jnp.float32),
+                centers,
+            )
+        center_norms = jnp.sum(jnp.square(centers), axis=1)
+
+        return IvfFlatIndex(
+            centers=centers,
+            center_norms=center_norms,
+            data=data,
+            data_norms=norms,
+            indices=indices,
+            list_sizes=sizes,
+            metric=index.metric,
+            adaptive_centers=index.adaptive_centers,
+        )
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_probes", "k", "metric"))
+def _search_impl(queries, centers, center_norms, data, data_norms, indices,
+                 filter_words, n_probes: int, k: int, metric: DistanceType):
+    """Coarse select + probe scan with running top-k merge."""
+    q, d = queries.shape
+    n_lists, max_size, _ = data.shape
+    select_min = is_min_close(metric)
+    qf = queries.astype(jnp.float32)
+
+    # ---- coarse: ``select_clusters`` (GEMM + select_k over centers)
+    ip = jax.lax.dot_general(
+        qf, centers, (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    if metric == DistanceType.InnerProduct:
+        _, probes = jax.lax.top_k(ip, n_probes)                 # max similarity
+    else:
+        coarse = center_norms[None, :] - 2.0 * ip               # ||c||^2-2q·c
+        _, probes = jax.lax.top_k(-coarse, n_probes)
+    probes = probes.astype(jnp.int32)                           # (q, n_probes)
+
+    pad_val = jnp.inf if select_min else -jnp.inf
+
+    # ---- probe scan: one gathered list + one batched GEMM per probe rank
+    def step(carry, rank):
+        best_d, best_i = carry
+        lists = probes[:, rank]                                  # (q,)
+        rows = jnp.take(data, lists, axis=0).astype(jnp.float32)  # (q, m, d)
+        row_norms = jnp.take(data_norms, lists, axis=0)          # (q, m)
+        row_ids = jnp.take(indices, lists, axis=0)               # (q, m)
+        ipr = jax.lax.dot_general(
+            rows, qf, (((2,), (1,)), ((0,), (0,))),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )                                                        # (q, m)
+        if metric == DistanceType.InnerProduct:
+            dist = jnp.where(row_ids >= 0, ipr, pad_val)
+        else:
+            dist = row_norms - 2.0 * ipr                         # +||q||^2 later
+            dist = jnp.where(row_ids >= 0, dist, pad_val)
+        if filter_words is not None:
+            bits = test_words(filter_words, row_ids)
+            dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
+
+        new_d, new_i = merge_topk(best_d, best_i, dist, row_ids, k, select_min)
+        return (new_d, new_i), None
+
+    init = (
+        jnp.full((q, k), pad_val, jnp.float32),
+        jnp.full((q, k), -1, jnp.int32),
+    )
+    (best_d, best_i), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
+
+    if metric != DistanceType.InnerProduct:
+        q_sq = jnp.sum(jnp.square(qf), axis=1, keepdims=True)
+        best_d = jnp.where(jnp.isfinite(best_d),
+                           jnp.maximum(best_d + q_sq, 0.0), best_d)
+        if metric == DistanceType.L2SqrtExpanded:
+            best_d = jnp.where(jnp.isfinite(best_d), jnp.sqrt(best_d), best_d)
+    return best_d, best_i
+
+
+def search(
+    res: Optional[Resources],
+    params: IvfFlatSearchParams,
+    index: IvfFlatIndex,
+    queries,
+    k: int,
+    sample_filter: Optional[Bitset] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """ANN search — ``ivf_flat::search``
+    (``detail/ivf_flat_search-inl.cuh:38-210``).
+
+    Returns (distances, indices) of shape (q, k); missing slots (when
+    fewer than k valid candidates were probed) have index -1."""
+    ensure_resources(res)
+    queries = jnp.asarray(queries)
+    expect(queries.ndim == 2 and queries.shape[1] == index.dim,
+           "queries must be (q, dim)")
+    expect(index.max_list_size > 0, "index is empty — extend() it first")
+    n_probes = min(params.n_probes, index.n_lists)
+    filter_words = None
+    if sample_filter is not None:
+        filter_words = sample_filter.words
+    with tracing.range("raft_tpu.ivf_flat.search"):
+        return _search_impl(
+            queries, index.centers, index.center_norms, index.data,
+            index.data_norms, index.indices, filter_words,
+            n_probes, k, index.metric,
+        )
+
+
+# ---------------------------------------------------------------------------
+# serialization (versioned npy stream, reference v4 layout analog)
+# ---------------------------------------------------------------------------
+
+
+def save(index: IvfFlatIndex, fh_or_path) -> None:
+    """``ivf_flat::serialize`` (``detail/ivf_flat_serialize.cuh:37``)."""
+    fh, own = open_maybe_path(fh_or_path, "wb")
+    try:
+        serialize_scalar(fh, _SERIALIZATION_VERSION, np.int32)
+        serialize_scalar(fh, int(index.metric), np.int32)
+        serialize_scalar(fh, int(index.adaptive_centers), np.int32)
+        serialize_array(fh, index.centers)
+        serialize_array(fh, index.data)
+        serialize_array(fh, index.indices)
+        serialize_array(fh, index.list_sizes)
+    finally:
+        if own:
+            fh.close()
+
+
+def load(res: Optional[Resources], fh_or_path) -> IvfFlatIndex:
+    """``ivf_flat::deserialize``."""
+    res = ensure_resources(res)
+    fh, own = open_maybe_path(fh_or_path, "rb")
+    try:
+        check_version(deserialize_scalar(fh), _SERIALIZATION_VERSION, "ivf_flat")
+        metric = DistanceType(int(deserialize_scalar(fh)))
+        adaptive = bool(deserialize_scalar(fh))
+        centers = res.put(deserialize_array(fh))
+        data = res.put(deserialize_array(fh))
+        indices = res.put(deserialize_array(fh))
+        sizes = res.put(deserialize_array(fh))
+    finally:
+        if own:
+            fh.close()
+    centers = jnp.asarray(centers)
+    data_f = jnp.asarray(data).astype(jnp.float32)
+    indices = jnp.asarray(indices)
+    norms = jnp.sum(jnp.square(data_f), axis=2)
+    norms = jnp.where(indices >= 0, norms, jnp.inf)
+    return IvfFlatIndex(
+        centers=centers,
+        center_norms=jnp.sum(jnp.square(centers), axis=1),
+        data=jnp.asarray(data),
+        data_norms=norms,
+        indices=indices,
+        list_sizes=jnp.asarray(sizes),
+        metric=metric,
+        adaptive_centers=adaptive,
+    )

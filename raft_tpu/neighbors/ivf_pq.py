@@ -1,0 +1,577 @@
+"""IVF-PQ — inverted file with product quantization, TPU-native re-design
+of ``raft::neighbors::ivf_pq`` (``neighbors/ivf_pq_types.hpp:219``, build
+``detail/ivf_pq_build.cuh:1513``, search ``detail/ivf_pq_search.cuh:732``).
+
+Reference architecture: balanced-kmeans coarse clusters; residuals rotated
+by a (random orthogonal) matrix (``make_rotation_matrix``,
+``detail/ivf_pq_build.cuh:122``); product codebooks trained per subspace or
+per cluster (``:344``/``:421``); codes packed interleaved in 16-byte
+chunks; search builds a per-(query, probe) lookup table and scores codes in
+a fused kernel with fp8/fp16/fp32 LUTs
+(``detail/ivf_pq_compute_similarity-inl.cuh:125-177``).
+
+TPU re-design:
+
+- codes live in ONE dense padded tensor ``codes[n_lists, max_list_size,
+  pq_dim] uint8`` — no interleaving: the TPU reads codes in vectorized
+  rows, and XLA lays out the trailing dims for the VPU. (The CUDA
+  interleave exists to serve 32 threads striding a list; irrelevant here.)
+- the LUT phase is a batched MXU GEMM (`q̃` rotation + pairwise-sq-dist
+  against codebooks); scoring is a vectorized table gather per subspace,
+  merged into a running top-k scan over probe ranks, identical in shape
+  to the IVF-Flat scan.
+- codebook training is a ``vmap``-ed fixed-iteration Lloyd EM over the
+  pq_dim subspaces (one compiled kernel trains all codebooks at once,
+  vs the reference's stream-parallel loop of kmeans launches).
+
+Supported metrics: L2Expanded / L2SqrtExpanded / InnerProduct (reference
+set, ``ivf_pq_types.hpp``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu.core import tracing
+from raft_tpu.core.bitset import Bitset, test_words
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.serialize import (
+    check_version,
+    deserialize_array,
+    deserialize_scalar,
+    open_maybe_path,
+    serialize_array,
+    serialize_scalar,
+)
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType, is_min_close
+from raft_tpu.matrix.select_k import merge_topk
+from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
+
+_SERIALIZATION_VERSION = 3  # kept in step with the reference's v3 format id
+
+
+class CodebookKind(enum.IntEnum):
+    """Mirrors ``ivf_pq::codebook_gen`` (``ivf_pq_types.hpp:42-46``)."""
+
+    PER_SUBSPACE = 0
+    PER_CLUSTER = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class IvfPqIndexParams(IndexParams):
+    """Mirrors ``ivf_pq::index_params`` (``ivf_pq_types.hpp:48-111``)."""
+
+    n_lists: int = 1024
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    pq_bits: int = 8              # 4..8
+    pq_dim: int = 0               # 0 → auto: dim/4 rounded to multiple of 8
+    codebook_kind: CodebookKind = CodebookKind.PER_SUBSPACE
+    force_random_rotation: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class IvfPqSearchParams(SearchParams):
+    """Mirrors ``ivf_pq::search_params`` — ``lut_dtype``/
+    ``internal_distance_dtype`` select the scoring precision like the
+    reference's fp32/fp16/fp8 LUT variants."""
+
+    n_probes: int = 20
+    lut_dtype: jnp.dtype = jnp.float32
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class IvfPqIndex:
+    """PQ-compressed IVF index (role of ``ivf_pq::index``)."""
+
+    centers: jax.Array        # (n_lists, dim) f32 cluster centers
+    rotation: jax.Array       # (dim_ext, dim) f32 orthogonal-ish map
+    codebooks: jax.Array      # PER_SUBSPACE: (pq_dim, 2^bits, pq_len)
+                              # PER_CLUSTER:  (n_lists, 2^bits, pq_len)
+    codes: jax.Array          # (n_lists, max_list_size, pq_dim) uint8
+    indices: jax.Array        # (n_lists, max_list_size) int32, -1 pad
+    list_sizes: jax.Array     # (n_lists,) int32
+    metric: DistanceType
+    codebook_kind: CodebookKind
+    pq_bits: int
+
+    def tree_flatten(self):
+        return (
+            self.centers, self.rotation, self.codebooks, self.codes,
+            self.indices, self.list_sizes,
+        ), (self.metric, self.codebook_kind, self.pq_bits)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, metric=aux[0], codebook_kind=aux[1], pq_bits=aux[2])
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def dim_ext(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def pq_dim(self) -> int:
+        return self.codes.shape[2]
+
+    @property
+    def pq_len(self) -> int:
+        return self.codebooks.shape[2]
+
+    @property
+    def pq_book_size(self) -> int:
+        return 1 << self.pq_bits
+
+    @property
+    def max_list_size(self) -> int:
+        return self.codes.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(self.list_sizes.sum())
+
+
+# ---------------------------------------------------------------------------
+# build helpers
+# ---------------------------------------------------------------------------
+
+
+def _auto_pq_dim(dim: int) -> int:
+    """Reference heuristic: dim/4 rounded up to a multiple of 8
+    (``ivf_pq_types.hpp`` pq_dim docs)."""
+    pq = max(1, dim // 4)
+    return max(8, -(-pq // 8) * 8) if dim >= 32 else max(1, pq)
+
+
+def make_rotation_matrix(key, dim_ext: int, dim: int, force_random: bool):
+    """Orthogonal projection dim → dim_ext
+    (``detail/ivf_pq_build.cuh:122``): identity when dims align and
+    randomness is not forced; otherwise QR of a gaussian."""
+    if not force_random and dim_ext == dim:
+        return jnp.eye(dim, dtype=jnp.float32)
+    g = jax.random.normal(key, (dim_ext, max(dim_ext, dim)), jnp.float32)
+    qmat, _ = jnp.linalg.qr(g.T)            # (max, dim_ext) orthonormal cols
+    return qmat[:dim, :].T                  # (dim_ext, dim), R R^T = I on range
+
+
+@partial(jax.jit, static_argnames=("n_centers", "n_iters"))
+def _vmapped_lloyd(trainsets, key, n_centers: int, n_iters: int):
+    """Fixed-iteration Lloyd EM vmapped over leading axis — trains all
+    pq_dim (or n_lists) codebooks in one compiled kernel
+    (role of ``train_per_subset``/``train_per_cluster``,
+    ``detail/ivf_pq_build.cuh:344,421``)."""
+
+    def one(trainset, k):
+        n = trainset.shape[0]
+        idx = jax.random.choice(k, n, (n_centers,), replace=n < n_centers)
+        centers = trainset[idx]
+
+        def body(_, centers):
+            d = (
+                jnp.sum(jnp.square(trainset), 1)[:, None]
+                - 2.0 * trainset @ centers.T
+                + jnp.sum(jnp.square(centers), 1)[None, :]
+            )
+            labels = jnp.argmin(d, axis=1)
+            sums = jax.ops.segment_sum(trainset, labels, num_segments=n_centers)
+            counts = jax.ops.segment_sum(
+                jnp.ones((n,), jnp.float32), labels, num_segments=n_centers
+            )
+            new = sums / jnp.maximum(counts, 1.0)[:, None]
+            return jnp.where((counts > 0)[:, None], new, centers)
+
+        return jax.lax.fori_loop(0, n_iters, body, centers)
+
+    keys = jax.random.split(key, trainsets.shape[0])
+    return jax.vmap(one)(trainsets, keys)
+
+
+def _rotate_residuals(vectors, labels, centers, rotation):
+    """R @ (x - c_label), reshaped to (n, pq_dim, pq_len)."""
+    res = vectors.astype(jnp.float32) - centers[labels]
+    rot = res @ rotation.T                     # (n, dim_ext)
+    return rot
+
+
+def _encode(rot_residuals, codebooks, labels, codebook_kind: CodebookKind,
+            pq_dim: int, pq_len: int):
+    """Nearest-codeword per subspace
+    (role of ``process_and_fill_codes_kernel``, ``ivf_pq_build.cuh:946``)."""
+    n = rot_residuals.shape[0]
+    sub = rot_residuals.reshape(n, pq_dim, pq_len)
+    if codebook_kind == CodebookKind.PER_SUBSPACE:
+        # dist[n, s, j] = ||sub[n,s] - cb[s,j]||^2
+        d = (
+            jnp.sum(jnp.square(sub), -1)[:, :, None]
+            - 2.0 * jnp.einsum("nsl,sjl->nsj", sub, codebooks)
+            + jnp.sum(jnp.square(codebooks), -1)[None, :, :]
+        )
+    else:
+        cb = codebooks[labels]                 # (n, 2^bits, pq_len)
+        d = (
+            jnp.sum(jnp.square(sub), -1)[:, :, None]
+            - 2.0 * jnp.einsum("nsl,njl->nsj", sub, cb)
+            + jnp.sum(jnp.square(cb), -1)[:, None, :]
+        )
+    return jnp.argmin(d, axis=2).astype(jnp.uint8)
+
+
+def _pack_codes(codes, ids, labels, n_lists: int, max_list_size: int):
+    """Scatter code rows into the padded [n_lists, max_list_size] layout
+    (same dense packing as ivf_flat)."""
+    n, pq_dim = codes.shape
+    labels = labels.astype(jnp.int32)
+    order = jnp.argsort(labels, stable=True)
+    sorted_labels = labels[order]
+    first_pos = jnp.searchsorted(sorted_labels, jnp.arange(n_lists), side="left")
+    rank = jnp.arange(n) - first_pos[sorted_labels]
+    slot = sorted_labels * max_list_size + rank
+
+    flat_codes = jnp.zeros((n_lists * max_list_size, pq_dim), jnp.uint8)
+    flat_idx = jnp.full((n_lists * max_list_size,), -1, jnp.int32)
+    flat_codes = flat_codes.at[slot].set(codes[order])
+    flat_idx = flat_idx.at[slot].set(ids[order])
+    sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels,
+                                num_segments=n_lists)
+    return (flat_codes.reshape(n_lists, max_list_size, pq_dim),
+            flat_idx.reshape(n_lists, max_list_size), sizes)
+
+
+# ---------------------------------------------------------------------------
+# build / extend
+# ---------------------------------------------------------------------------
+
+
+def build(
+    res: Optional[Resources],
+    params: IvfPqIndexParams,
+    dataset,
+) -> IvfPqIndex:
+    """Train coarse centers, rotation, codebooks; encode the dataset —
+    ``ivf_pq::build`` (``detail/ivf_pq_build.cuh:1513-1723``)."""
+    res = ensure_resources(res)
+    dataset = jnp.asarray(dataset)
+    expect(dataset.ndim == 2, "dataset must be (n, d)")
+    n, dim = dataset.shape
+    expect(4 <= params.pq_bits <= 8, "pq_bits must be in [4, 8]")
+    expect(params.n_lists <= n, "n_lists > n_rows")
+    expect(
+        params.metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                          DistanceType.InnerProduct),
+        f"ivf_pq supports L2/L2Sqrt/InnerProduct, got {params.metric!r}",
+    )
+    pq_dim = params.pq_dim if params.pq_dim > 0 else _auto_pq_dim(dim)
+    pq_len = -(-dim // pq_dim)                 # ceil
+    dim_ext = pq_dim * pq_len
+
+    with tracing.range("raft_tpu.ivf_pq.build"):
+        frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+        # trainset must cover both the coarse clusters and the codebooks
+        n_train = max(params.n_lists * 2, 1 << params.pq_bits, int(n * frac))
+        n_train = min(n, n_train)
+        stride = max(1, n // n_train)
+        trainset = dataset[::stride][:n_train].astype(jnp.float32)
+
+        km = KMeansBalancedParams(
+            n_iters=params.kmeans_n_iters,
+            metric=(DistanceType.InnerProduct
+                    if params.metric == DistanceType.InnerProduct
+                    else DistanceType.L2Expanded),
+            seed=res.seed,
+        )
+        centers = kmeans_balanced.fit(res, km, trainset, params.n_lists)
+
+        rotation = make_rotation_matrix(
+            jax.random.fold_in(jax.random.key(res.seed), 7),
+            dim_ext, dim,
+            params.force_random_rotation or (dim != dim_ext),
+        )
+
+        # codebook training on rotated trainset residuals
+        train_labels = kmeans_balanced.predict(res, km, centers, trainset)
+        rot = _rotate_residuals(trainset, train_labels, centers, rotation)
+        book_size = 1 << params.pq_bits
+        key = jax.random.fold_in(jax.random.key(res.seed), 11)
+        if params.codebook_kind == CodebookKind.PER_SUBSPACE:
+            sub = jnp.moveaxis(rot.reshape(-1, pq_dim, pq_len), 1, 0)
+            codebooks = _vmapped_lloyd(sub, key, book_size, 25)
+        else:
+            # per cluster: train on that cluster's OWN subvectors (all
+            # subspaces pooled); rows are drawn modulo the cluster's segment
+            # length so no foreign-cluster residuals leak in
+            per = max(book_size * 4 // pq_dim + 1, 64)
+            order = jnp.argsort(train_labels, stable=True)
+            sorted_lab = train_labels[order]
+            firsts = jnp.searchsorted(sorted_lab, jnp.arange(params.n_lists))
+            ends = jnp.append(firsts[1:], trainset.shape[0])
+            seg_len = jnp.maximum(ends - firsts, 1)
+            take = firsts[:, None] + (jnp.arange(per)[None, :] % seg_len[:, None])
+            rows = rot[order][take]            # (n_lists, per, dim_ext)
+            pooled = rows.reshape(params.n_lists, per * pq_dim, pq_len)
+            codebooks = _vmapped_lloyd(pooled, key, book_size, 25)
+
+        empty = IvfPqIndex(
+            centers=centers,
+            rotation=rotation,
+            codebooks=codebooks,
+            codes=jnp.zeros((params.n_lists, 0, pq_dim), jnp.uint8),
+            indices=jnp.full((params.n_lists, 0), -1, jnp.int32),
+            list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
+            metric=DistanceType(params.metric),
+            codebook_kind=params.codebook_kind,
+            pq_bits=params.pq_bits,
+        )
+        if not params.add_data_on_build:
+            return empty
+        return extend(res, empty, dataset, jnp.arange(n, dtype=jnp.int32))
+
+
+def extend(
+    res: Optional[Resources],
+    index: IvfPqIndex,
+    new_vectors,
+    new_indices=None,
+) -> IvfPqIndex:
+    """Encode + add vectors — ``ivf_pq::extend``. Functional rebuild of the
+    padded code planes."""
+    res = ensure_resources(res)
+    new_vectors = jnp.asarray(new_vectors)
+    expect(new_vectors.ndim == 2 and new_vectors.shape[1] == index.dim,
+           "new_vectors must be (n, dim)")
+    n_new = new_vectors.shape[0]
+    if new_indices is None:
+        start = index.size
+        new_indices = jnp.arange(start, start + n_new, dtype=jnp.int32)
+    else:
+        new_indices = jnp.asarray(new_indices, jnp.int32)
+
+    with tracing.range("raft_tpu.ivf_pq.extend"):
+        km = KMeansBalancedParams(
+            metric=(DistanceType.InnerProduct
+                    if index.metric == DistanceType.InnerProduct
+                    else DistanceType.L2Expanded))
+        labels = kmeans_balanced.predict(res, km, index.centers,
+                                         new_vectors.astype(jnp.float32))
+        rot = _rotate_residuals(new_vectors, labels, index.centers, index.rotation)
+        new_codes = _encode(rot, index.codebooks, labels, index.codebook_kind,
+                            index.pq_dim, index.pq_len)
+
+        if index.max_list_size > 0:
+            old_codes = index.codes.reshape(-1, index.pq_dim)
+            old_ids = index.indices.reshape(-1)
+            old_labels = jnp.repeat(jnp.arange(index.n_lists, dtype=jnp.int32),
+                                    index.max_list_size)
+            keep = old_ids >= 0
+            all_codes = jnp.concatenate([old_codes[keep], new_codes])
+            all_ids = jnp.concatenate([old_ids[keep], new_indices])
+            all_labels = jnp.concatenate([old_labels[keep], labels])
+        else:
+            all_codes, all_ids, all_labels = new_codes, new_indices, labels
+
+        sizes = jax.ops.segment_sum(
+            jnp.ones((all_codes.shape[0],), jnp.int32), all_labels,
+            num_segments=index.n_lists,
+        )
+        max_size = int(jnp.max(sizes))
+        max_size = max(8, -(-max_size // 8) * 8)
+        codes, indices, sizes = _pack_codes(all_codes, all_ids, all_labels,
+                                            index.n_lists, max_size)
+        return dataclasses.replace(index, codes=codes, indices=indices,
+                                   list_sizes=sizes)
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_probes", "k", "metric", "codebook_kind",
+                                   "lut_dtype"))
+def _search_impl(queries, centers, rotation, codebooks, codes, indices,
+                 filter_words, n_probes: int, k: int, metric: DistanceType,
+                 codebook_kind: CodebookKind, lut_dtype):
+    q, dim = queries.shape
+    n_lists, max_size, pq_dim = codes.shape
+    book_size = codebooks.shape[1]
+    pq_len = codebooks.shape[2]
+    select_min = is_min_close(metric)
+    qf = queries.astype(jnp.float32)
+
+    # ---- coarse cluster selection (``select_clusters``,
+    #      detail/ivf_pq_search.cuh:70-156)
+    ip = jax.lax.dot_general(
+        qf, centers, (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    if metric == DistanceType.InnerProduct:
+        _, probes = jax.lax.top_k(ip, n_probes)
+    else:
+        c_norms = jnp.sum(jnp.square(centers), axis=1)
+        _, probes = jax.lax.top_k(-(c_norms[None, :] - 2.0 * ip), n_probes)
+    probes = probes.astype(jnp.int32)
+
+    pad_val = jnp.inf if select_min else -jnp.inf
+
+    # ---- probe-invariant precomputation (hoisted out of the scan)
+    ip_query = metric == DistanceType.InnerProduct
+    if ip_query:
+        # score = q·y = q·c + (Rq)·ỹ — the rotated query never changes
+        qsub_fixed = (qf @ rotation.T).reshape(q, pq_dim, pq_len)
+        if codebook_kind == CodebookKind.PER_SUBSPACE:
+            lut_fixed = jnp.einsum("qsl,sjl->qsj", qsub_fixed, codebooks)
+        else:
+            lut_fixed = None
+    else:
+        qsub_fixed = None
+        lut_fixed = None
+
+    # ---- per-probe LUT + code scoring scan
+    def step(carry, rank):
+        best_d, best_i = carry
+        lists = probes[:, rank]                        # (q,)
+        c = centers[lists]                             # (q, dim)
+        if ip_query:
+            base = jnp.sum(qf * c, axis=1)             # (q,)
+            if lut_fixed is not None:
+                lut = lut_fixed
+            else:
+                cb = codebooks[lists]                  # (q, J, L)
+                lut = jnp.einsum("qsl,qjl->qsj", qsub_fixed, cb)
+        else:
+            qsub = ((qf - c) @ rotation.T).reshape(q, pq_dim, pq_len)
+            base = jnp.zeros((q,), jnp.float32)
+            if codebook_kind == CodebookKind.PER_SUBSPACE:
+                cb = codebooks                         # (pq_dim, J, L)
+                lut = (
+                    jnp.sum(jnp.square(qsub), -1)[:, :, None]
+                    - 2.0 * jnp.einsum("qsl,sjl->qsj", qsub, cb)
+                    + jnp.sum(jnp.square(cb), -1)[None, :, :]
+                )
+            else:
+                cb = codebooks[lists]                  # (q, J, L)
+                lut = (
+                    jnp.sum(jnp.square(qsub), -1)[:, :, None]
+                    - 2.0 * jnp.einsum("qsl,qjl->qsj", qsub, cb)
+                    + jnp.sum(jnp.square(cb), -1)[:, None, :]
+                )
+        lut = lut.astype(lut_dtype)                    # (q, pq_dim, J)
+
+        rows = jnp.take(codes, lists, axis=0)          # (q, m, pq_dim) u8
+        row_ids = jnp.take(indices, lists, axis=0)     # (q, m)
+        # score codes: dist[q, m] = sum_s lut[q, s, rows[q, m, s]]
+        gathered = jnp.take_along_axis(
+            lut[:, None, :, :],                        # (q, 1, s, J)
+            rows.astype(jnp.int32)[:, :, :, None],     # (q, m, s, 1)
+            axis=3,
+        )[..., 0]                                      # (q, m, s)
+        dist = jnp.sum(gathered.astype(jnp.float32), axis=2) + base[:, None]
+        dist = jnp.where(row_ids >= 0, dist, pad_val)
+        if filter_words is not None:
+            bits = test_words(filter_words, row_ids)
+            dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
+
+        new_d, new_i = merge_topk(best_d, best_i, dist, row_ids, k, select_min)
+        return (new_d, new_i), None
+
+    init = (
+        jnp.full((q, k), pad_val, jnp.float32),
+        jnp.full((q, k), -1, jnp.int32),
+    )
+    (best_d, best_i), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
+
+    if metric == DistanceType.L2SqrtExpanded:
+        best_d = jnp.where(jnp.isfinite(best_d),
+                           jnp.sqrt(jnp.maximum(best_d, 0.0)), best_d)
+    return best_d, best_i
+
+
+def search(
+    res: Optional[Resources],
+    params: IvfPqSearchParams,
+    index: IvfPqIndex,
+    queries,
+    k: int,
+    sample_filter: Optional[Bitset] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """ANN search — ``ivf_pq::search`` (``detail/ivf_pq_search.cuh:732``).
+
+    For L2 metrics the returned distances are approximate (residual-PQ)
+    squared L2 (or sqrt thereof); use :func:`raft_tpu.neighbors.refine`
+    to re-rank with exact distances, as the reference does."""
+    ensure_resources(res)
+    queries = jnp.asarray(queries)
+    expect(queries.ndim == 2 and queries.shape[1] == index.dim,
+           "queries must be (q, dim)")
+    expect(index.max_list_size > 0, "index is empty — extend() it first")
+    n_probes = min(params.n_probes, index.n_lists)
+    filter_words = sample_filter.words if sample_filter is not None else None
+    with tracing.range("raft_tpu.ivf_pq.search"):
+        return _search_impl(
+            queries, index.centers, index.rotation, index.codebooks,
+            index.codes, index.indices, filter_words,
+            n_probes, k, index.metric, index.codebook_kind,
+            params.lut_dtype,
+        )
+
+
+# ---------------------------------------------------------------------------
+# serialization
+# ---------------------------------------------------------------------------
+
+
+def save(index: IvfPqIndex, fh_or_path) -> None:
+    """``ivf_pq::serialize`` (``detail/ivf_pq_serialize.cuh:39``)."""
+    fh, own = open_maybe_path(fh_or_path, "wb")
+    try:
+        serialize_scalar(fh, _SERIALIZATION_VERSION, np.int32)
+        serialize_scalar(fh, int(index.metric), np.int32)
+        serialize_scalar(fh, int(index.codebook_kind), np.int32)
+        serialize_scalar(fh, index.pq_bits, np.int32)
+        serialize_array(fh, index.centers)
+        serialize_array(fh, index.rotation)
+        serialize_array(fh, index.codebooks)
+        serialize_array(fh, index.codes)
+        serialize_array(fh, index.indices)
+        serialize_array(fh, index.list_sizes)
+    finally:
+        if own:
+            fh.close()
+
+
+def load(res: Optional[Resources], fh_or_path) -> IvfPqIndex:
+    res = ensure_resources(res)
+    fh, own = open_maybe_path(fh_or_path, "rb")
+    try:
+        check_version(deserialize_scalar(fh), _SERIALIZATION_VERSION, "ivf_pq")
+        metric = DistanceType(int(deserialize_scalar(fh)))
+        kind = CodebookKind(int(deserialize_scalar(fh)))
+        pq_bits = int(deserialize_scalar(fh))
+        arrays = [res.put(deserialize_array(fh)) for _ in range(6)]
+    finally:
+        if own:
+            fh.close()
+    centers, rotation, codebooks, codes, indices, sizes = map(jnp.asarray, arrays)
+    return IvfPqIndex(
+        centers=centers, rotation=rotation, codebooks=codebooks,
+        codes=codes, indices=indices, list_sizes=sizes,
+        metric=metric, codebook_kind=kind, pq_bits=pq_bits,
+    )

@@ -1,0 +1,166 @@
+"""IVF-PQ tests — reference pattern (cpp/test/neighbors/ann_ivf_pq.cuh):
+recall floor scaled to compression ratio, exhaustive-probe sanity,
+refinement rescue, both codebook kinds, serialization."""
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as spd
+
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors.refine import refine
+from raft_tpu.neighbors.ivf_pq import (
+    CodebookKind,
+    IvfPqIndexParams,
+    IvfPqSearchParams,
+)
+from raft_tpu.utils import eval_recall
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(3)
+    # clustered data (IVF-PQ's target regime, and makes recall stable)
+    centers = rng.standard_normal((20, 32)) * 5
+    labels = rng.integers(0, 20, 5000)
+    x = (centers[labels] + rng.standard_normal((5000, 32))).astype(np.float32)
+    q = (centers[rng.integers(0, 20, 40)]
+         + rng.standard_normal((40, 32))).astype(np.float32)
+    return x, q
+
+
+def _gt(x, q, k):
+    d = spd.cdist(q, x, "sqeuclidean")
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+class TestIvfPq:
+    def test_recall_exhaustive(self, dataset):
+        """All lists probed: recall limited only by PQ compression."""
+        x, q = dataset
+        params = IvfPqIndexParams(n_lists=20, pq_dim=8, pq_bits=8,
+                                  kmeans_n_iters=10)
+        index = ivf_pq.build(None, params, x)
+        assert index.size == len(x)
+        assert index.codes.shape[2] == 8
+        _, idx = ivf_pq.search(None, IvfPqSearchParams(n_probes=20), index, q, 10)
+        _, gt_i = _gt(x, q, 10)
+        r, _, _ = eval_recall(gt_i, np.asarray(idx))
+        assert r >= 0.55, f"recall {r}"  # 16x compression floor
+
+    def test_refinement_rescues_recall(self, dataset):
+        """PQ top-40 + exact refine to 10 ≈ exact search (the reference's
+        two-pass pattern)."""
+        x, q = dataset
+        params = IvfPqIndexParams(n_lists=20, pq_dim=8, pq_bits=8)
+        index = ivf_pq.build(None, params, x)
+        _, cand = ivf_pq.search(None, IvfPqSearchParams(n_probes=20), index, q, 40)
+        dist, idx = refine(None, x, q, np.asarray(cand), 10)
+        _, gt_i = _gt(x, q, 10)
+        r, _, _ = eval_recall(gt_i, np.asarray(idx))
+        assert r >= 0.85, f"refined recall {r}"
+        # refined distances must be exact
+        gt_d = spd.cdist(q, x, "sqeuclidean")
+        got = np.asarray(dist)
+        want = np.take_along_axis(gt_d, np.asarray(idx), axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-2)
+
+    def test_per_cluster_codebooks(self, dataset):
+        x, q = dataset
+        params = IvfPqIndexParams(n_lists=20, pq_dim=8,
+                                  codebook_kind=CodebookKind.PER_CLUSTER)
+        index = ivf_pq.build(None, params, x)
+        assert index.codebooks.shape[0] == 20
+        _, idx = ivf_pq.search(None, IvfPqSearchParams(n_probes=20), index, q, 10)
+        _, gt_i = _gt(x, q, 10)
+        r, _, _ = eval_recall(gt_i, np.asarray(idx))
+        assert r >= 0.5, f"recall {r}"
+
+    def test_pq_bits_4(self, dataset):
+        x, q = dataset
+        params = IvfPqIndexParams(n_lists=20, pq_dim=16, pq_bits=4)
+        index = ivf_pq.build(None, params, x)
+        assert index.pq_book_size == 16
+        assert int(np.asarray(index.codes).max()) < 16
+        _, idx = ivf_pq.search(None, IvfPqSearchParams(n_probes=20), index, q, 10)
+        _, gt_i = _gt(x, q, 10)
+        r, _, _ = eval_recall(gt_i, np.asarray(idx))
+        assert r >= 0.4, f"recall {r}"
+
+    def test_rotation_applied_when_dims_misalign(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((500, 30)).astype(np.float32)  # 30 % 8 != 0
+        params = IvfPqIndexParams(n_lists=4, pq_dim=8)
+        index = ivf_pq.build(None, params, x)
+        assert index.dim_ext == 32 and index.pq_len == 4
+        _, idx = ivf_pq.search(None, IvfPqSearchParams(n_probes=4), index,
+                               x[:5], 1)
+        # self-queries should mostly find themselves even through PQ
+        assert (np.asarray(idx)[:, 0] == np.arange(5)).mean() >= 0.6
+
+    def test_extend_after_empty_build(self, dataset):
+        x, q = dataset
+        params = IvfPqIndexParams(n_lists=10, pq_dim=8, add_data_on_build=False)
+        index = ivf_pq.build(None, params, x)
+        assert index.size == 0
+        index = ivf_pq.extend(None, index, x)
+        assert index.size == len(x)
+
+    def test_inner_product(self):
+        """Gaussian data (healthy IP spread): top-10 must be contained in
+        the PQ top-60 candidates. (Normalized clustered data is excluded:
+        its top-10 score span is tighter than the 16x quantization error —
+        fundamental to PQ, not an implementation property.)"""
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((5000, 32)).astype(np.float32)
+        q = rng.standard_normal((30, 32)).astype(np.float32)
+        params = IvfPqIndexParams(n_lists=10, pq_dim=8,
+                                  metric=DistanceType.InnerProduct)
+        index = ivf_pq.build(None, params, x)
+        sims, cand = ivf_pq.search(None, IvfPqSearchParams(n_probes=10),
+                                   index, q, 60)
+        # scores must be descending (similarity direction)
+        assert (np.diff(np.asarray(sims), axis=1) <= 1e-5).all()
+        gt_i = np.argsort(-(q @ x.T), axis=1)[:, :10]
+        cand = np.asarray(cand)
+        containment = np.mean([
+            len(set(cand[i]) & set(gt_i[i])) / 10 for i in range(len(q))
+        ])
+        assert containment >= 0.85, f"IP containment {containment}"
+
+    def test_serialization_roundtrip(self, dataset, tmp_path):
+        x, q = dataset
+        params = IvfPqIndexParams(n_lists=10, pq_dim=8)
+        index = ivf_pq.build(None, params, x)
+        path = tmp_path / "pq.bin"
+        ivf_pq.save(index, path)
+        loaded = ivf_pq.load(None, path)
+        d1, i1 = ivf_pq.search(None, IvfPqSearchParams(n_probes=5), index, q, 5)
+        d2, i2 = ivf_pq.search(None, IvfPqSearchParams(n_probes=5), loaded, q, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
+
+
+class TestRefine:
+    def test_refine_exact_subset(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((200, 8)).astype(np.float32)
+        q = rng.standard_normal((10, 8)).astype(np.float32)
+        cand = np.tile(np.arange(50, dtype=np.int32), (10, 1))
+        dist, idx = refine(None, x, q, cand, 5)
+        gt = spd.cdist(q, x[:50], "sqeuclidean")
+        want_i = np.argsort(gt, 1)[:, :5]
+        np.testing.assert_allclose(
+            np.asarray(dist), np.take_along_axis(gt, want_i, 1),
+            rtol=1e-3, atol=1e-3)
+
+    def test_refine_with_missing(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((50, 4)).astype(np.float32)
+        q = x[:2]
+        cand = np.array([[0, 1, -1, -1], [2, -1, -1, 3]], np.int32)
+        dist, idx = refine(None, x, q, cand, 2)
+        idx = np.asarray(idx)
+        assert idx[0, 0] == 0  # self
+        assert -1 not in idx[:, 0]

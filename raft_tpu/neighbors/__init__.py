@@ -2,8 +2,10 @@
 ``raft/neighbors/``, SURVEY.md §2.5)."""
 
 from raft_tpu.neighbors import brute_force
+from raft_tpu.neighbors import cagra
 from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors import nn_descent
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 # pylibraft parity: ``neighbors.refine`` is the function (the submodule
 # stays importable as ``raft_tpu.neighbors.refine`` via sys.modules)
@@ -11,8 +13,10 @@ from raft_tpu.neighbors.refine import refine
 
 __all__ = [
     "brute_force",
+    "cagra",
     "ivf_flat",
     "ivf_pq",
+    "nn_descent",
     "refine",
     "IndexParams",
     "SearchParams",

@@ -1,0 +1,39 @@
+"""Shared gather+GEMM exact-distance helper for the graph-based
+neighbors (nn_descent, cagra) and refine — one implementation of the
+numerically sensitive clip-gather / HIGHEST-precision inner-product /
+expanded-L2 pattern (role of the reference's shared naive distance path,
+``cpp/internal/raft_internal/neighbors/naive_knn.cuh``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.distance.types import DistanceType
+
+
+def gathered_distances(x, dataset, cand_ids, metric: DistanceType):
+    """Distance from each row of ``x`` to its candidate dataset rows.
+
+    Args:
+      x: (t, d) float32 query/node vectors.
+      dataset: (n, d) vectors to gather from.
+      cand_ids: (t, c) int ids into dataset; negatives are invalid.
+      metric: L2Expanded / L2SqrtExpanded score as squared L2;
+        InnerProduct scores as NEGATED similarity (minimization form).
+
+    Returns (t, c) float32 with +inf at invalid ids.
+    """
+    rows = jnp.take(dataset, jnp.clip(cand_ids, 0), axis=0).astype(jnp.float32)
+    ip = jnp.einsum("td,tcd->tc", x, rows,
+                    precision=jax.lax.Precision.HIGHEST)
+    if metric == DistanceType.InnerProduct:
+        d = -ip
+    else:
+        d = (
+            jnp.sum(jnp.square(rows), axis=2)
+            - 2.0 * ip
+            + jnp.sum(jnp.square(x), axis=1)[:, None]
+        )
+        d = jnp.maximum(d, 0.0)
+    return jnp.where(cand_ids >= 0, d, jnp.inf)

@@ -1,0 +1,484 @@
+"""CAGRA — graph-based ANN, TPU-native re-design of
+``raft::neighbors::cagra`` (``cagra_types.hpp:131`` index, params
+``:54-111``; build ``detail/cagra/cagra_build.cuh:44-123``; optimize
+``detail/cagra/graph_core.cuh:320``; search ``detail/cagra/cagra_search.cuh:105``).
+
+Reference architecture: k-NN graph from batched IVF-PQ searches (+refine)
+or NN-descent; graph *optimize* = 2-hop detour counting (``kern_prune``,
+``graph_core.cuh:128``) + reverse-edge augmentation (``kern_make_rev_graph
+:191``); search = persistent CUDA kernels walking the graph with a
+random-hash visited table, per-CTA bitonic top-M and three kernel
+families (single-cta / multi-cta / multi-kernel).
+
+TPU re-design:
+
+- **build**: same two graph sources (IVF-PQ batches + refine, or the
+  dense NN-descent in :mod:`raft_tpu.neighbors.nn_descent`).
+- **optimize**: detour counting is a *dense batched tensor op* — for a
+  node tile, gather the neighbor-of-neighbor id cube (t, K, K) and count
+  rank-lower 2-hop matches with one broadcast compare; no atomics. The
+  reverse graph uses sort-and-rank packing.
+- **search**: one jitted ``lax.while_loop`` per query batch ("beam
+  search" formulation): an itopk buffer (ids, dists, explored flags) is
+  expanded ``search_width`` parents at a time; candidate scoring is a
+  batched gather + MXU contraction over all queries at once. Instead of
+  the GPU's visited hashmap, merging deduplicates ids with
+  buffer-copy-priority, which both dedups and preserves explored flags —
+  re-proposed candidates can never re-enter unexplored, so termination
+  ("all buffer entries explored") is exact. Queries are tiled host-side;
+  every shape is static.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.serialize import (
+    check_version,
+    deserialize_array,
+    deserialize_scalar,
+    open_maybe_path,
+    serialize_array,
+    serialize_scalar,
+)
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+from raft_tpu.neighbors import nn_descent as nn_descent_mod
+from raft_tpu.neighbors._exact import gathered_distances
+from raft_tpu.neighbors.nn_descent import _reverse_sample
+from raft_tpu.neighbors.refine import refine
+
+_SERIALIZATION_VERSION = 4
+
+
+class BuildAlgo(enum.Enum):
+    """Mirrors ``cagra::graph_build_algo`` (``cagra_types.hpp``)."""
+
+    IVF_PQ = "ivf_pq"
+    NN_DESCENT = "nn_descent"
+
+
+@dataclasses.dataclass(frozen=True)
+class CagraIndexParams:
+    """Mirrors ``cagra::index_params`` (``cagra_types.hpp:54-111``)."""
+
+    metric: DistanceType = DistanceType.L2Expanded
+    intermediate_graph_degree: int = 128
+    graph_degree: int = 64
+    build_algo: BuildAlgo = BuildAlgo.IVF_PQ
+    nn_descent_niter: int = 20
+    # IVF-PQ graph-build knobs (reference auto-derives; exposed here)
+    ivf_pq_n_lists: int = 0       # 0 → auto sqrt(n)
+    ivf_pq_n_probes: int = 0      # 0 → auto
+    refine_rate: float = 2.0      # gpu_top_k = degree * refine_rate
+
+
+@dataclasses.dataclass(frozen=True)
+class CagraSearchParams:
+    """Mirrors ``cagra::search_params`` (``cagra_types.hpp``): ``itopk_size``
+    is the retained candidate buffer, ``search_width`` the number of
+    parents expanded per iteration, ``max_iterations`` 0 → auto."""
+
+    itopk_size: int = 64
+    search_width: int = 1
+    max_iterations: int = 0
+    num_random_samplings: int = 1
+    rand_xor_mask: int = 0x128394  # seed salt, role of the reference field
+    query_tile: int = 256
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class CagraIndex:
+    """Dataset + fixed-degree neighbor graph (``cagra::index``,
+    ``cagra_types.hpp:131``; the dataset is stored padded/strided in the
+    reference — on TPU a plain dense (n, d) array)."""
+
+    dataset: jax.Array      # (n, d)
+    graph: jax.Array        # (n, graph_degree) int32
+    metric: DistanceType
+
+    def tree_flatten(self):
+        return (self.dataset, self.graph), (self.metric,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0])
+
+    @property
+    def size(self) -> int:
+        return self.dataset.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.dataset.shape[1]
+
+    @property
+    def graph_degree(self) -> int:
+        return self.graph.shape[1]
+
+
+# ---------------------------------------------------------------------------
+# build
+# ---------------------------------------------------------------------------
+
+
+def build_knn_graph(
+    res: Optional[Resources],
+    dataset,
+    k: int,
+    metric: DistanceType = DistanceType.L2Expanded,
+    n_lists: int = 0,
+    n_probes: int = 0,
+    refine_rate: float = 2.0,
+    batch: int = 1024,
+) -> jax.Array:
+    """Intermediate k-NN graph via batched IVF-PQ self-search + refine —
+    ``detail/cagra/cagra_build.cuh:44-123`` (1024-query batches at
+    ``:105``). Self-matches are dropped; returns (n, k) int32."""
+    res = ensure_resources(res)
+    dataset = jnp.asarray(dataset)
+    n, dim = dataset.shape
+    n_lists = n_lists or max(8, min(n // 39 + 1, int(np.sqrt(n) * 2)))
+    n_probes = n_probes or max(8, n_lists // 10)
+    gpu_k = max(k + 1, int((k + 1) * refine_rate))
+
+    params = ivf_pq_mod.IvfPqIndexParams(
+        metric=metric, n_lists=n_lists,
+        kmeans_trainset_fraction=min(1.0, 10240 / max(n, 1) + 0.1),
+    )
+    index = ivf_pq_mod.build(res, params, dataset)
+    sp = ivf_pq_mod.IvfPqSearchParams(n_probes=n_probes)
+
+    out = []
+    for start in range(0, n, batch):
+        q = dataset[start : start + batch]
+        _, cand = ivf_pq_mod.search(res, sp, index, q, gpu_k)
+        _, idx = refine(res, dataset, q, cand, k + 1, metric)
+        # drop self-hits: mask rows equal to the query's own id
+        own = jnp.arange(start, start + q.shape[0], dtype=jnp.int32)[:, None]
+        keep = idx != own
+        # stable-compact each row to k entries (self-hit, if found, removed)
+        pos = jnp.where(keep, jnp.cumsum(keep, axis=1) - 1, k + 1)
+        row = jnp.full((q.shape[0], k + 2), -1, jnp.int32)
+        row = row.at[jnp.arange(q.shape[0])[:, None], pos].set(idx, mode="drop")
+        out.append(row[:, :k])
+    return jnp.concatenate(out, axis=0)
+
+
+@partial(jax.jit, static_argnames=("tile",))
+def _detour_counts(graph, tile: int):
+    """2-hop detour count per edge (role of ``kern_prune``,
+    ``graph_core.cuh:128``): edge (i → g[i,r]) is detourable through the
+    higher-ranked neighbor g[i,l] (l < r) when g[i,r] ∈ graph[g[i,l]]."""
+    n, k = graph.shape
+    pad = (-n) % tile
+    node_ids = jnp.arange(n + pad, dtype=jnp.int32) % n
+
+    def step(_, t):
+        nid = jax.lax.dynamic_slice_in_dim(node_ids, t * tile, tile)
+        g = jnp.take(graph, nid, axis=0)                       # (t, k)
+        nbrs = jnp.take(graph, jnp.clip(g, 0), axis=0)         # (t, k, k)
+        nbrs = jnp.where((g >= 0)[:, :, None], nbrs, -1)
+
+        # accumulate over l so the intermediate stays (t, k, k) instead of
+        # a (t, k, k, k) broadcast cube
+        def count_l(l, counts):
+            # match[t, r] = g[t, r] ∈ nbrs[t, l, :], only for r > l
+            eq = nbrs[:, l, :, None] == g[:, None, :]          # (t, m, r)
+            match = jnp.any(eq, axis=1) & (g >= 0)             # (t, r)
+            rank_ok = jnp.arange(k) > l
+            return counts + (match & rank_ok[None, :]).astype(jnp.int32)
+
+        counts = jax.lax.fori_loop(
+            0, k, count_l, jnp.zeros((tile, k), jnp.int32)
+        )
+        return None, counts
+
+    n_tiles = (n + pad) // tile
+    _, out = jax.lax.scan(step, None, jnp.arange(n_tiles))
+    return out.reshape(-1, k)[:n]
+
+
+@partial(jax.jit, static_argnames=("fwd_keep",))
+def _select_forward(graph, detours, fwd_keep: int):
+    """The fwd_keep lowest-detour edges per node, rank-order preserved
+    (ties broken toward closer neighbors)."""
+    k = graph.shape[1]
+    rank = jnp.arange(k, dtype=jnp.int32)[None, :]
+    score = jnp.where(graph >= 0, detours * k + rank, jnp.iinfo(jnp.int32).max)
+    _, pos = jax.lax.top_k(-score, fwd_keep)
+    return jnp.take_along_axis(graph, jnp.sort(pos, axis=1), axis=1)
+
+
+@partial(jax.jit, static_argnames=("out_degree",))
+def _merge_forward_reverse(graph, fwd, rev, out_degree: int):
+    """Merge the kept forward edges with reverse edges and leftover
+    forward edges, dedup'd by priority (role of ``graph_core.cuh``
+    ``optimize:320`` + ``kern_make_rev_graph:191``)."""
+    n, k = graph.shape
+
+    # candidates in priority order: kept-forward, reverse, remaining-forward
+    cand = jnp.concatenate([fwd, rev, graph], axis=1)
+    c = cand.shape[1]
+    prio = jnp.arange(c, dtype=jnp.int32)[None, :]
+    prio = jnp.where(cand >= 0, prio, c)
+    order = jnp.argsort(cand, axis=1, stable=True)      # groups equal ids
+    sid = jnp.take_along_axis(cand, order, axis=1)
+    sprio = jnp.take_along_axis(prio, order, axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros((n, 1), bool), sid[:, 1:] == sid[:, :-1]], axis=1
+    )
+    sprio = jnp.where(dup | (sid < 0), c, sprio)
+    _, best = jax.lax.top_k(-sprio, out_degree)
+    keep_ids = jnp.take_along_axis(sid, best, axis=1)
+    keep_prio = jnp.take_along_axis(sprio, best, axis=1)
+    # order final rows by priority so closest-first ordering survives
+    reorder = jnp.argsort(keep_prio, axis=1, stable=True)
+    out = jnp.take_along_axis(keep_ids, reorder, axis=1)
+    return jnp.where(jnp.take_along_axis(keep_prio, reorder, axis=1) < c,
+                     out, -1)
+
+
+def optimize(
+    res: Optional[Resources],
+    knn_graph,
+    out_degree: int,
+    tile: int = 128,
+) -> jax.Array:
+    """Prune an intermediate k-NN graph to a fixed-degree search graph —
+    ``cagra::optimize`` (``graph_core.cuh:320``)."""
+    ensure_resources(res)
+    knn_graph = jnp.asarray(knn_graph, jnp.int32)
+    n, k = knn_graph.shape
+    expect(out_degree <= k, "out_degree must be <= input graph degree")
+    with tracing.range("raft_tpu.cagra.optimize"):
+        detours = _detour_counts(knn_graph, tile)
+        fwd = _select_forward(knn_graph, detours, out_degree // 2)
+        rev = _reverse_sample(fwd, n, out_degree - out_degree // 2)
+        return _merge_forward_reverse(knn_graph, fwd, rev, out_degree)
+
+
+def build(
+    res: Optional[Resources],
+    params: CagraIndexParams,
+    dataset,
+) -> CagraIndex:
+    """knn-graph + optimize — ``cagra::build`` (``cagra.cuh:296-331``)."""
+    res = ensure_resources(res)
+    dataset = jnp.asarray(dataset)
+    expect(dataset.ndim == 2, "dataset must be (n, d)")
+    expect(params.metric in (DistanceType.L2Expanded,
+                             DistanceType.L2SqrtExpanded,
+                             DistanceType.InnerProduct),
+           f"cagra supports L2/InnerProduct, got {params.metric!r}")
+    n = dataset.shape[0]
+    ideg = min(params.intermediate_graph_degree, n - 1)
+    odeg = min(params.graph_degree, ideg)
+
+    with tracing.range("raft_tpu.cagra.build"):
+        if params.build_algo == BuildAlgo.NN_DESCENT:
+            nnd = nn_descent_mod.NNDescentParams(
+                graph_degree=ideg,
+                intermediate_graph_degree=min(int(ideg * 1.5), n - 1),
+                max_iterations=params.nn_descent_niter,
+                metric=params.metric,
+                seed=res.seed,
+            )
+            knn_graph = nn_descent_mod.build(res, nnd, dataset)
+        else:
+            knn_graph = build_knn_graph(
+                res, dataset, ideg, params.metric,
+                params.ivf_pq_n_lists, params.ivf_pq_n_probes,
+                params.refine_rate,
+            )
+        graph = optimize(res, knn_graph, odeg)
+        return CagraIndex(dataset=res.put(dataset), graph=graph,
+                          metric=DistanceType(params.metric))
+
+
+def from_graph(res, dataset, graph,
+               metric: DistanceType = DistanceType.L2Expanded) -> CagraIndex:
+    """Assemble an index from a prebuilt graph (reference's index
+    constructor taking dataset + knn_graph views)."""
+    res = ensure_resources(res)
+    return CagraIndex(res.put(jnp.asarray(dataset)),
+                      res.put(jnp.asarray(graph, jnp.int32)),
+                      DistanceType(metric))
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+def _buffer_merge(ids, dists, explored, cand_ids, cand_d, L: int):
+    """Merge candidates into the itopk buffer with id-dedup where the
+    buffer copy wins — preserving explored flags (the hash-free visited
+    mechanism; see module docstring)."""
+    q = ids.shape[0]
+    all_ids = jnp.concatenate([ids, cand_ids], axis=1)
+    all_d = jnp.concatenate([dists, cand_d], axis=1)
+    all_e = jnp.concatenate(
+        [explored, jnp.zeros(cand_ids.shape, bool)], axis=1
+    )
+    order = jnp.argsort(all_ids, axis=1, stable=True)
+    sid = jnp.take_along_axis(all_ids, order, axis=1)
+    sd = jnp.take_along_axis(all_d, order, axis=1)
+    se = jnp.take_along_axis(all_e, order, axis=1)
+    dup = jnp.concatenate(
+        [jnp.zeros((q, 1), bool), sid[:, 1:] == sid[:, :-1]], axis=1
+    )
+    # stable argsort keeps buffer copies (lower concat position) first
+    # within an id group, so dup marks the candidate copy
+    sd = jnp.where(dup | (sid < 0), jnp.inf, sd)
+    neg, pos = jax.lax.top_k(-sd, L)
+    return (
+        jnp.take_along_axis(sid, pos, axis=1),
+        -neg,
+        jnp.take_along_axis(se, pos, axis=1),
+    )
+
+
+@partial(jax.jit, static_argnames=("k", "L", "w", "max_iters", "metric"))
+def _search_batch(dataset, graph, queries, seed_ids, k: int, L: int, w: int,
+                  max_iters: int, metric: DistanceType):
+    q, dim = queries.shape
+    n, deg = graph.shape
+    qf = queries.astype(jnp.float32)
+    ip_metric = metric == DistanceType.InnerProduct
+
+    def score(cand):                                     # (q, c) ids → dists
+        return gathered_distances(qf, dataset, cand, metric)
+
+    # random seeding (role of the reference's random_samplings)
+    seed_d = score(seed_ids)
+    ids, dists, explored = _buffer_merge(
+        jnp.full((q, L), -1, jnp.int32), jnp.full((q, L), jnp.inf),
+        jnp.zeros((q, L), bool), seed_ids, seed_d, L,
+    )
+
+    def cond(state):
+        ids, dists, explored, it = state
+        frontier = (~explored) & jnp.isfinite(dists)
+        return (it < max_iters) & jnp.any(frontier)
+
+    def body(state):
+        ids, dists, explored, it = state
+        masked = jnp.where(explored | (ids < 0), jnp.inf, dists)
+        _, ppos = jax.lax.top_k(-masked, w)              # (q, w) parents
+        valid = jnp.isfinite(jnp.take_along_axis(masked, ppos, axis=1))
+        parents = jnp.where(valid,
+                            jnp.take_along_axis(ids, ppos, axis=1), -1)
+        explored = explored.at[
+            jnp.arange(q)[:, None], ppos
+        ].set(explored[jnp.arange(q)[:, None], ppos] | valid)
+        cand = jnp.take(graph, jnp.clip(parents, 0), axis=0)  # (q, w, deg)
+        cand = jnp.where((parents >= 0)[:, :, None], cand, -1)
+        cand = cand.reshape(q, w * deg)
+        cand_d = score(cand)
+        ids, dists, explored = _buffer_merge(ids, dists, explored, cand,
+                                             cand_d, L)
+        return ids, dists, explored, it + 1
+
+    ids, dists, explored, _ = jax.lax.while_loop(
+        cond, body, (ids, dists, explored, jnp.zeros((), jnp.int32))
+    )
+
+    out_d, out_i = dists[:, :k], ids[:, :k]
+    if ip_metric:
+        out_d = -out_d
+    elif metric == DistanceType.L2SqrtExpanded:
+        out_d = jnp.where(jnp.isfinite(out_d),
+                          jnp.sqrt(jnp.maximum(out_d, 0.0)), out_d)
+    return out_d, out_i
+
+
+def search(
+    res: Optional[Resources],
+    params: CagraSearchParams,
+    index: CagraIndex,
+    queries,
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Graph beam search — ``cagra::search`` → ``search_main``
+    (``detail/cagra/cagra_search.cuh:105``)."""
+    res = ensure_resources(res)
+    queries = jnp.asarray(queries)
+    expect(queries.ndim == 2 and queries.shape[1] == index.dim,
+           "queries must be (q, dim)")
+    if queries.shape[0] == 0:
+        return (jnp.zeros((0, k), jnp.float32), jnp.zeros((0, k), jnp.int32))
+    n = index.size
+    L = max(params.itopk_size, k)
+    w = max(1, params.search_width)
+    max_iters = params.max_iterations or (L // w + 24)
+    n_seeds = max(L, w * index.graph_degree) * max(1, params.num_random_samplings)
+    n_seeds = min(n_seeds, n)
+
+    with tracing.range("raft_tpu.cagra.search"):
+        outs_d, outs_i = [], []
+        tile = max(1, params.query_tile)
+        for start in range(0, queries.shape[0], tile):
+            qt = queries[start : start + tile]
+            key = jax.random.fold_in(
+                jax.random.key(res.seed ^ params.rand_xor_mask), start
+            )
+            seeds = jax.random.randint(
+                key, (qt.shape[0], n_seeds), 0, n, jnp.int32
+            )
+            d, i = _search_batch(index.dataset, index.graph, qt, seeds,
+                                 k, L, w, max_iters, index.metric)
+            outs_d.append(d)
+            outs_i.append(i)
+        if len(outs_d) == 1:
+            return outs_d[0], outs_i[0]
+        return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+
+
+# ---------------------------------------------------------------------------
+# serialization
+# ---------------------------------------------------------------------------
+
+
+def save(index: CagraIndex, fh_or_path, include_dataset: bool = True) -> None:
+    """``cagra::serialize`` (``detail/cagra/cagra_serialize.cuh``)."""
+    fh, own = open_maybe_path(fh_or_path, "wb")
+    try:
+        serialize_scalar(fh, _SERIALIZATION_VERSION, np.int32)
+        serialize_scalar(fh, int(index.metric), np.int32)
+        serialize_scalar(fh, 1 if include_dataset else 0, np.int32)
+        serialize_array(fh, index.graph)
+        if include_dataset:
+            serialize_array(fh, index.dataset)
+    finally:
+        if own:
+            fh.close()
+
+
+def load(res: Optional[Resources], fh_or_path, dataset=None) -> CagraIndex:
+    """Load an index; pass ``dataset`` when it was saved without one."""
+    res = ensure_resources(res)
+    fh, own = open_maybe_path(fh_or_path, "rb")
+    try:
+        check_version(deserialize_scalar(fh), _SERIALIZATION_VERSION, "cagra")
+        metric = DistanceType(int(deserialize_scalar(fh)))
+        has_ds = int(deserialize_scalar(fh)) != 0
+        graph = res.put(deserialize_array(fh))
+        if has_ds:
+            dataset = res.put(deserialize_array(fh))
+    finally:
+        if own:
+            fh.close()
+    expect(dataset is not None, "index was saved without its dataset")
+    return CagraIndex(jnp.asarray(dataset), jnp.asarray(graph), metric)

@@ -1,0 +1,159 @@
+"""CAGRA + NN-descent tests — reference pattern
+(cpp/test/neighbors/ann_cagra.cuh, ann_nn_descent.cuh): random dataset,
+ground truth by brute force, recall >= threshold; graph-quality checks
+for NN-descent; optimize invariants; serialization round-trip."""
+
+import io
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as spd
+
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors import cagra, nn_descent
+from raft_tpu.neighbors.cagra import (
+    BuildAlgo,
+    CagraIndexParams,
+    CagraSearchParams,
+)
+from raft_tpu.neighbors.nn_descent import NNDescentParams
+from raft_tpu.utils import eval_recall
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(7)
+    centers = rng.standard_normal((16, 24)) * 4
+    labels = rng.integers(0, 16, 3000)
+    x = (centers[labels] + rng.standard_normal((3000, 24))).astype(np.float32)
+    q = (centers[rng.integers(0, 16, 32)]
+         + rng.standard_normal((32, 24))).astype(np.float32)
+    return x, q
+
+
+def _gt(x, q, k):
+    d = spd.cdist(q, x, "sqeuclidean")
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+def _knn_graph_recall(x, graph, k):
+    """Fraction of true k-NN (excluding self) present in the graph rows."""
+    d = spd.cdist(x, x, "sqeuclidean")
+    np.fill_diagonal(d, np.inf)
+    gt = np.argsort(d, axis=1, kind="stable")[:, :k]
+    r, _, _ = eval_recall(gt, np.asarray(graph)[:, :k])
+    return r
+
+
+class TestNNDescent:
+    def test_graph_recall(self, dataset):
+        x, _ = dataset
+        params = NNDescentParams(graph_degree=16, intermediate_graph_degree=32,
+                                 max_iterations=12, seed=1)
+        graph = nn_descent.build(None, params, x)
+        assert graph.shape == (len(x), 16)
+        g = np.asarray(graph)
+        # no self loops, valid ids
+        assert not np.any(g == np.arange(len(x))[:, None])
+        assert g.max() < len(x)
+        r = _knn_graph_recall(x, g, 16)
+        assert r >= 0.85, f"graph recall {r}"
+
+    def test_returns_sorted_distances(self, dataset):
+        x, _ = dataset
+        params = NNDescentParams(graph_degree=8, intermediate_graph_degree=24,
+                                 max_iterations=8, seed=2)
+        graph, dists = nn_descent.build(None, params, x, return_distances=True)
+        d = np.asarray(dists)
+        assert np.all(np.diff(d, axis=1) >= -1e-4)
+        # distances match the actual pairs
+        g = np.asarray(graph)
+        ref = np.sum((x[:50, None, :] - x[g[:50]]) ** 2, axis=2)
+        np.testing.assert_allclose(d[:50], ref, rtol=1e-3, atol=1e-3)
+
+
+class TestCagraOptimize:
+    def test_degree_and_validity(self, dataset):
+        x, _ = dataset
+        params = NNDescentParams(graph_degree=32, intermediate_graph_degree=48,
+                                 max_iterations=10, seed=3)
+        knn_graph = nn_descent.build(None, params, x)
+        graph = cagra.optimize(None, knn_graph, 16)
+        g = np.asarray(graph)
+        assert g.shape == (len(x), 16)
+        assert g.max() < len(x)
+        # rows are dedup'd (ignoring -1 padding)
+        for row in g[:100]:
+            vals = row[row >= 0]
+            assert len(set(vals.tolist())) == len(vals)
+        # pruning keeps the graph mostly full
+        assert (g >= 0).mean() > 0.95
+
+
+class TestCagraSearch:
+    @pytest.mark.parametrize("algo", [BuildAlgo.NN_DESCENT, BuildAlgo.IVF_PQ])
+    def test_recall(self, dataset, algo):
+        x, q = dataset
+        params = CagraIndexParams(
+            intermediate_graph_degree=48, graph_degree=24, build_algo=algo
+        )
+        index = cagra.build(None, params, x)
+        assert index.graph.shape == (len(x), 24)
+        sp = CagraSearchParams(itopk_size=64, search_width=4)
+        d, i = cagra.search(None, sp, index, q, 10)
+        gt_d, gt_i = _gt(x, q, 10)
+        r, _, _ = eval_recall(gt_i, np.asarray(i), gt_d, np.asarray(d))
+        assert r >= 0.9, f"recall {r} ({algo})"
+        # distances are exact for returned ids
+        ref = np.sum((q[:, None, :] - x[np.asarray(i)]) ** 2, axis=2)
+        np.testing.assert_allclose(np.asarray(d), ref, rtol=1e-3, atol=1e-2)
+
+    def test_inner_product(self, dataset):
+        x, q = dataset
+        xn = x / np.linalg.norm(x, axis=1, keepdims=True)
+        qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+        params = CagraIndexParams(
+            intermediate_graph_degree=48, graph_degree=24,
+            build_algo=BuildAlgo.NN_DESCENT,
+            metric=DistanceType.InnerProduct,
+        )
+        index = cagra.build(None, params, xn)
+        sp = CagraSearchParams(itopk_size=64, search_width=4)
+        d, i = cagra.search(None, sp, index, qn, 10)
+        sims = qn @ xn.T
+        gt = np.argsort(-sims, axis=1, kind="stable")[:, :10]
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        assert r >= 0.85, f"ip recall {r}"
+        # similarities descending
+        assert np.all(np.diff(np.asarray(d), axis=1) <= 1e-4)
+
+    def test_serialization_roundtrip(self, dataset):
+        x, q = dataset
+        params = CagraIndexParams(intermediate_graph_degree=32,
+                                  graph_degree=16,
+                                  build_algo=BuildAlgo.NN_DESCENT)
+        index = cagra.build(None, params, x)
+        buf = io.BytesIO()
+        cagra.save(index, buf)
+        buf.seek(0)
+        loaded = cagra.load(None, buf)
+        np.testing.assert_array_equal(np.asarray(index.graph),
+                                      np.asarray(loaded.graph))
+        sp = CagraSearchParams(itopk_size=32, search_width=2)
+        d0, i0 = cagra.search(None, sp, index, q, 5)
+        d1, i1 = cagra.search(None, sp, loaded, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_save_without_dataset(self, dataset):
+        x, _ = dataset
+        params = CagraIndexParams(intermediate_graph_degree=32,
+                                  graph_degree=16,
+                                  build_algo=BuildAlgo.NN_DESCENT)
+        index = cagra.build(None, params, x)
+        buf = io.BytesIO()
+        cagra.save(index, buf, include_dataset=False)
+        buf.seek(0)
+        loaded = cagra.load(None, buf, dataset=x)
+        np.testing.assert_array_equal(np.asarray(index.graph),
+                                      np.asarray(loaded.graph))

@@ -1,0 +1,54 @@
+"""Distributed communication — TPU-native re-design of ``raft/comms/``
+(SURVEY.md §2.6).
+
+The reference injects a virtual collectives interface (``comms_t``,
+``core/comms.hpp:242``) backed by NCCL+UCX (``comms/std_comms.hpp``) or
+MPI (``comms/mpi_comms.hpp``) into the resources handle, bootstrapped by
+Dask (``raft_dask.common.Comms``) or MPI.
+
+On TPU the transport is the ICI/DCN fabric driven by XLA collectives:
+``Comms`` wraps a ``jax.sharding.Mesh`` axis, the collectives are
+``jax.lax`` primitives usable inside ``shard_map``/``pjit`` programs, and
+bootstrap is ``jax.distributed.initialize``. ``comm_split`` becomes mesh
+axis subdivision.
+"""
+
+from raft_tpu.comms.comms import (
+    Comms,
+    Op,
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    bcast,
+    device_recv,
+    device_send,
+    device_sendrecv,
+    gather,
+    reduce,
+    reducescatter,
+)
+from raft_tpu.comms.bootstrap import (
+    initialize,
+    local_comms,
+    make_mesh,
+)
+
+__all__ = [
+    "Comms",
+    "Op",
+    "allreduce",
+    "allgather",
+    "alltoall",
+    "barrier",
+    "bcast",
+    "gather",
+    "reduce",
+    "reducescatter",
+    "device_send",
+    "device_recv",
+    "device_sendrecv",
+    "initialize",
+    "local_comms",
+    "make_mesh",
+]

@@ -1,0 +1,66 @@
+"""Multi-process bootstrap — analog of raft-dask's NCCL-uniqueId dance
+(``raft_dask/common/comms.py:137-215`` create_nccl_uniqueid + per-worker
+``inject_comms_on_handle``) and of ``initialize_mpi_comms``
+(``comms/mpi_comms.hpp:60``).
+
+On TPU the rendezvous is ``jax.distributed.initialize`` (coordinator
+address + process id replace the NCCL uniqueId broadcast); the "clique"
+is the global device mesh; injection is ``Resources(mesh=..., comms=...)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from raft_tpu.comms.comms import Comms
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Join the multi-process clique (``jax.distributed.initialize``).
+
+    Role of ``Comms.init`` (``raft_dask/common/comms.py:172-215``): after
+    this, ``jax.devices()`` spans every process's chips and meshes built
+    by :func:`make_mesh` are global. On Cloud TPU all arguments
+    auto-detect from the runtime environment.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+
+
+def make_mesh(
+    axis_names: Sequence[str] = ("data",),
+    shape: Optional[Sequence[int]] = None,
+    devices=None,
+) -> Mesh:
+    """Global mesh over all (or given) devices; the TPU's comms clique.
+
+    With multiple axes this is the 2D row/col process grid the reference
+    builds with ``comm_split`` + ``set_subcomm``."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+    return Mesh(np.array(devs).reshape(tuple(shape)), tuple(axis_names))
+
+
+def local_comms(
+    axis_names: Sequence[str] = ("data",),
+    shape: Optional[Sequence[int]] = None,
+) -> Comms:
+    """Comms over all locally visible devices — the test-time analog of
+    the reference's LocalCUDACluster trick (SURVEY.md §4): virtual CPU
+    devices via ``--xla_force_host_platform_device_count`` stand in for a
+    multi-host clique."""
+    return Comms(make_mesh(axis_names, shape), axis_names[0])

@@ -1,0 +1,243 @@
+"""``comms_t``-shaped collectives over XLA — analog of
+``core/comms.hpp:125-215`` (``comms_iface``) / ``:242`` (``comms_t``).
+
+Free functions mirror the reference's collective set (allreduce, bcast,
+reduce, allgather, gather, reducescatter, alltoall, p2p send/recv) as
+``jax.lax`` calls valid inside a ``shard_map``-decorated program over a
+named mesh axis — the TPU's NCCL ring is the ICI torus and XLA schedules
+the transfers. ``Comms`` packages a mesh + axis with rank/size accessors
+and a ``run`` helper so algorithms can be written against the same
+"get the comms, call collectives" shape as the reference
+(``resource::get_comms(handle).allreduce(...)``).
+
+Unlike NCCL, these collectives are *compiled into* the program: there is
+no stream to synchronize and no comm to abort — XLA's SPMD partitioner
+proves shape agreement at trace time, which is why the reference's
+error-propagating ``sync_stream`` barrier (``core/comms.hpp:282-291``)
+reduces to :func:`barrier` here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class Op(enum.Enum):
+    """Reduction ops (``core/comms.hpp`` ``op_t``: SUM/PROD/MIN/MAX)."""
+
+    SUM = "sum"
+    PROD = "prod"
+    MIN = "min"
+    MAX = "max"
+
+
+# ---------------------------------------------------------------------------
+# collectives — call inside shard_map over the named axis
+# ---------------------------------------------------------------------------
+
+
+def allreduce(x, op: Op = Op.SUM, axis: str = "data"):
+    """``comms_t::allreduce`` → psum/pmax/pmin (XLA all-reduce on ICI)."""
+    if op == Op.SUM:
+        return jax.lax.psum(x, axis)
+    if op == Op.MAX:
+        return jax.lax.pmax(x, axis)
+    if op == Op.MIN:
+        return jax.lax.pmin(x, axis)
+    # PROD: no native pprod — gather then reduce (correct for any sign)
+    return jnp.prod(jax.lax.all_gather(x, axis), axis=0)
+
+
+def bcast(x, root: int = 0, axis: str = "data"):
+    """``comms_t::bcast``: every rank ends with root's value."""
+    rank = jax.lax.axis_index(axis)
+    contrib = jnp.where(rank == root, x, jnp.zeros_like(x))
+    return jax.lax.psum(contrib, axis)
+
+
+def reduce(x, root: int = 0, op: Op = Op.SUM, axis: str = "data"):
+    """``comms_t::reduce``: the reduced value (the reference only
+    guarantees it on root; here every rank gets it, a superset)."""
+    return allreduce(x, op, axis)
+
+
+def allgather(x, axis: str = "data", tiled: bool = False):
+    """``comms_t::allgather``: stack (or concat when ``tiled``) every
+    rank's block along a new leading axis."""
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def gather(x, root: int = 0, axis: str = "data", tiled: bool = False):
+    """``comms_t::gather`` (valid on every rank, superset of reference)."""
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def allgatherv(x, valid_size, axis: str = "data"):
+    """``comms_t::allgatherv``: ragged gather emulated with the padded
+    block + per-rank sizes (TPU collectives need static shapes).
+
+    Returns (stacked (n_ranks, max_block, ...), sizes (n_ranks,))."""
+    return (
+        jax.lax.all_gather(x, axis),
+        jax.lax.all_gather(jnp.asarray(valid_size, jnp.int32), axis),
+    )
+
+
+def reducescatter(x, op: Op = Op.SUM, axis: str = "data"):
+    """``comms_t::reducescatter`` → psum_scatter over the leading dim."""
+    if op != Op.SUM:
+        gathered = allreduce(x, op, axis)
+        n = jax.lax.axis_size(axis)
+        rank = jax.lax.axis_index(axis)
+        block = x.shape[0] // n
+        return jax.lax.dynamic_slice_in_dim(gathered, rank * block, block)
+    return jax.lax.psum_scatter(x, axis, tiled=True)
+
+
+def alltoall(x, axis: str = "data"):
+    """``comms_t`` device_multicast/alltoall: exchange row blocks so rank
+    r receives block r from every rank (``lax.all_to_all``)."""
+    n = jax.lax.axis_size(axis)
+    blocks = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    return jax.lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0)
+
+
+def device_send(x, dest_offset: int = 1, axis: str = "data"):
+    """Ring send: rank r's value moves to rank (r + dest_offset) % n —
+    the p2p pattern expressible on the ICI torus (``comms_t::device_send``;
+    arbitrary pairs route through :func:`device_sendrecv` perms)."""
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + dest_offset) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def device_recv(x, src_offset: int = 1, axis: str = "data"):
+    """Ring recv: receive the value from rank (r - src_offset) % n."""
+    return device_send(x, src_offset, axis)
+
+
+def device_sendrecv(x, perm: Sequence[tuple], axis: str = "data"):
+    """``comms_t::device_sendrecv``: explicit (src, dst) pair list."""
+    return jax.lax.ppermute(x, axis, list(perm))
+
+
+def barrier(axis: str = "data"):
+    """``comms_t::barrier`` / ``sync_stream``: a psum fence all ranks
+    must reach; returns the rank count."""
+    return jax.lax.psum(jnp.ones((), jnp.int32), axis)
+
+
+def rank(axis: str = "data"):
+    """``comms_t::get_rank``."""
+    return jax.lax.axis_index(axis)
+
+
+def size(axis: str = "data"):
+    """``comms_t::get_size``."""
+    return jax.lax.axis_size(axis)
+
+
+# ---------------------------------------------------------------------------
+# Comms handle
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Comms:
+    """Mesh + axis handle injected into :class:`~raft_tpu.core.Resources`
+    (role of ``std_comms`` built by ``build_comms_nccl_only``,
+    ``comms/std_comms.hpp:69``, and of raft-dask's ``Comms``,
+    ``raft_dask/common/comms.py:39``).
+
+    ``axis`` is the mesh axis this communicator spans; ``split`` carves
+    sub-communicators out of a multi-axis mesh the way ``comm_split`` +
+    ``set_subcomm`` build 2D process grids (``core/resource/sub_comms.hpp``).
+    """
+
+    mesh: Mesh
+    axis: str = "data"
+
+    @property
+    def size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    @property
+    def nranks(self) -> int:
+        return self.size
+
+    @property
+    def process_rank(self) -> int:
+        """This *process*'s rank (multi-host); device-level rank is
+        :func:`rank` inside the mapped program."""
+        return jax.process_index()
+
+    def sharding(self, *spec) -> NamedSharding:
+        """NamedSharding over this comms' mesh."""
+        return NamedSharding(self.mesh, P(*spec))
+
+    def row_sharded(self) -> NamedSharding:
+        return self.sharding(self.axis)
+
+    def replicated(self) -> NamedSharding:
+        return self.sharding()
+
+    def run(
+        self,
+        fn: Callable,
+        *args,
+        in_specs,
+        out_specs,
+        check_vma: bool = True,
+    ):
+        """shard_map ``fn`` over this mesh: the body may call the module's
+        collectives with ``axis=self.axis``."""
+        return jax.shard_map(
+            fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )(*args)
+
+    def split(self, axis: str) -> "Comms":
+        """Sub-communicator over another axis of the same mesh
+        (``comms_t::comm_split`` for static 2D grids)."""
+        if axis not in self.mesh.axis_names:
+            raise ValueError(f"axis {axis!r} not in mesh {self.mesh.axis_names}")
+        return Comms(self.mesh, axis)
+
+    # -- self-tests (role of comms/comms_test.hpp:34-118) --------------------
+
+    def test_allreduce(self) -> bool:
+        n = self.size
+        x = jnp.arange(n, dtype=jnp.float32)
+        out = self.run(
+            lambda v: allreduce(v, Op.SUM, self.axis),
+            jax.device_put(x, self.row_sharded()),
+            in_specs=P(self.axis), out_specs=P(self.axis),
+        )
+        return bool(jnp.all(out == jnp.sum(x)))
+
+    def test_bcast(self, root: int = 0) -> bool:
+        n = self.size
+        x = jnp.arange(n, dtype=jnp.float32) + 3
+        out = self.run(
+            lambda v: bcast(v, root, self.axis),
+            jax.device_put(x, self.row_sharded()),
+            in_specs=P(self.axis), out_specs=P(self.axis),
+        )
+        return bool(jnp.all(out == x[root]))
+
+    def test_pointToPoint_simple_send_recv(self) -> bool:
+        n = self.size
+        x = jnp.arange(n, dtype=jnp.float32)
+        out = self.run(
+            lambda v: device_send(v, 1, self.axis),
+            jax.device_put(x, self.row_sharded()),
+            in_specs=P(self.axis), out_specs=P(self.axis),
+        )
+        return bool(jnp.all(out == jnp.roll(x, 1)))

@@ -1,0 +1,24 @@
+"""Distributed (multi-chip / multi-host) algorithms — the consumer side
+of :mod:`raft_tpu.comms`, replacing the reference's raft-dask MNMG layer
+(SURVEY.md §2.6, §3.5).
+
+Two composition patterns, mirroring the reference:
+
+- **SPMD over a mesh** (``shard_map`` + collectives): distributed k-means
+  (psum'd center updates — the ``calc_centers_and_sizes`` + allreduce
+  pattern) and distributed brute-force kNN (per-shard top-k + all-gather
+  merge, replacing ``knn_merge_parts``).
+- **index-per-shard** (host orchestration): ANN indexes built per shard
+  and merged at query time — raft-dask's index-per-worker pattern.
+"""
+
+from raft_tpu.distributed.kmeans import fit as kmeans_fit
+from raft_tpu.distributed.knn import brute_force_knn
+from raft_tpu.distributed.sharded_ann import ShardedIndex, build_sharded
+
+__all__ = [
+    "kmeans_fit",
+    "brute_force_knn",
+    "ShardedIndex",
+    "build_sharded",
+]

@@ -1,0 +1,93 @@
+"""Distributed k-means — the psum'd EM the reference runs MNMG via
+allreduce (SURVEY.md §7 step 7: "kmeans EM with psum of per-shard
+centers/sizes — exactly mirrors ``calc_centers_and_sizes`` + allreduce").
+
+One ``shard_map``-ed program: each shard labels its rows against the
+replicated centers (MXU GEMM), computes local center sums/counts, and a
+``psum`` across the mesh axis produces the global M-step. Convergence is
+checked on the psum'd inertia, like the reference's per-iteration
+inertia reduction (``cluster/detail/kmeans.cuh``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.cluster.kmeans import _kmeanspp_init
+from raft_tpu.comms.comms import Comms, Op, allreduce
+from raft_tpu.core import tracing
+from raft_tpu.core.validation import expect
+
+
+def fit(
+    comms: Comms,
+    x,
+    n_clusters: int,
+    n_iters: int = 20,
+    seed: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Fit k-means over a row-sharded dataset.
+
+    Returns (centers (k, d) replicated, inertia scalar). Matches the
+    single-device :func:`raft_tpu.cluster.kmeans.fit` EM up to shard
+    summation order.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    expect(x.ndim == 2, "x must be (n, d)")
+    n, d = x.shape
+    expect(n % comms.size == 0,
+           "rows must divide the mesh axis (pad the dataset)")
+    expect(n_clusters <= n, "n_clusters > n_rows")
+    axis = comms.axis
+
+    # kmeans++ init on a strided subsample (replicated), then the
+    # sharded EM — the reference MNMG kmeans seeds on one worker and
+    # broadcasts too. The subsample must cover n_clusters distinct picks.
+    sub_size = min(n, max(2048, 4 * n_clusters))
+    sub = x[:: max(1, n // sub_size)][:sub_size]
+    expect(n_clusters <= sub.shape[0], "n_clusters exceeds init subsample")
+    centers0 = _kmeanspp_init(jax.random.key(seed), sub, n_clusters)
+    x = jax.device_put(x, comms.row_sharded())
+    centers0 = jax.device_put(centers0, comms.replicated())
+
+    @partial(jax.jit, static_argnames=())
+    def _run(x_sh, c0):
+        def body(x_loc, c0_rep):
+            def em(_, centers):
+                d2 = (
+                    jnp.sum(jnp.square(x_loc), 1)[:, None]
+                    - 2.0 * x_loc @ centers.T
+                    + jnp.sum(jnp.square(centers), 1)[None, :]
+                )
+                labels = jnp.argmin(d2, axis=1)
+                sums = jax.ops.segment_sum(x_loc, labels,
+                                           num_segments=n_clusters)
+                counts = jax.ops.segment_sum(
+                    jnp.ones((x_loc.shape[0],), jnp.float32), labels,
+                    num_segments=n_clusters)
+                sums = allreduce(sums, Op.SUM, axis)
+                counts = allreduce(counts, Op.SUM, axis)
+                new = sums / jnp.maximum(counts, 1.0)[:, None]
+                return jnp.where((counts > 0)[:, None], new, centers)
+
+            centers = jax.lax.fori_loop(0, n_iters, em, c0_rep)
+            d2 = (
+                jnp.sum(jnp.square(x_loc), 1)[:, None]
+                - 2.0 * x_loc @ centers.T
+                + jnp.sum(jnp.square(centers), 1)[None, :]
+            )
+            inertia = allreduce(jnp.sum(jnp.min(d2, axis=1)), Op.SUM, axis)
+            return centers, inertia
+
+        return jax.shard_map(
+            body, mesh=comms.mesh, in_specs=(P(axis, None), P()),
+            out_specs=(P(), P()),
+        )(x_sh, c0)
+
+    with tracing.range("raft_tpu.distributed.kmeans_fit"):
+        return _run(x, centers0)

@@ -1,0 +1,122 @@
+"""Distributed exact kNN — per-shard top-k + all-gather merge.
+
+This is the TPU-native form of the reference's MNMG search pattern:
+raft-dask shards the dataset one part per worker, each worker runs local
+brute force, and ``knn_merge_parts`` (``detail/knn_merge_parts.cuh``)
+fuses the per-part results. Here the dataset is row-sharded over a mesh
+axis, the local scan runs per shard under ``shard_map``, and the merge is
+an ``all_gather`` + top-k — XLA rides the ICI ring for the gather.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms, allgather, rank
+from raft_tpu.core import tracing
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.pairwise import _pairwise_distance_impl
+from raft_tpu.distance.types import DistanceType, is_min_close
+from raft_tpu.matrix.select_k import merge_topk
+from raft_tpu.neighbors.brute_force import knn_merge_parts
+
+
+def brute_force_knn(
+    comms: Comms,
+    dataset,
+    queries,
+    k: int,
+    metric: DistanceType = DistanceType.L2Expanded,
+    metric_arg: float = 2.0,
+    db_tile: int = 32768,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN over a row-sharded dataset.
+
+    Args:
+      comms: mesh/axis handle; ``dataset`` is (re)sharded over its axis.
+      dataset: (n, d) — placed row-sharded if not already.
+      queries: (q, d) — replicated to every shard.
+      k: neighbors per query.
+
+    Returns (distances (q, k), global indices (q, k) int32), identical to
+    single-device ``brute_force.knn`` up to tie ordering.
+    """
+    dataset = jnp.asarray(dataset)
+    queries = jnp.asarray(queries)
+    expect(dataset.ndim == 2 and queries.ndim == 2, "2-D inputs required")
+    expect(dataset.shape[0] % comms.size == 0,
+           "dataset rows must divide the mesh axis (pad the dataset)")
+    n_local = dataset.shape[0] // comms.size
+    expect(0 < k <= n_local, "k must be <= rows per shard")
+    select_min = is_min_close(metric)
+    axis = comms.axis
+
+    dataset = jax.device_put(dataset, comms.row_sharded())
+    queries = jax.device_put(queries, comms.replicated())
+    tile = min(db_tile, max(128, n_local))
+
+    @partial(jax.jit, static_argnames=())
+    def _run(ds, qs):
+        def body(ds_local, qs_rep):
+            d_loc, i_loc = _local_scan(qs_rep, ds_local, k, metric,
+                                       metric_arg, tile, select_min, axis)
+            i_glob = i_loc + rank(axis) * n_local
+            all_d = allgather(d_loc, axis)            # (R, q, k)
+            all_i = allgather(i_glob, axis)
+            return knn_merge_parts(all_d, all_i, select_min)
+
+        # the merged result is replicated (identical on every shard) but
+        # post-all_gather values can't be statically proven so; skip the
+        # vma check
+        return jax.shard_map(
+            body, mesh=comms.mesh, in_specs=(P(axis, None), P()),
+            out_specs=(P(), P()), check_vma=False,
+        )(ds, qs)
+
+    with tracing.range("raft_tpu.distributed.brute_force_knn"):
+        return _run(dataset, queries)
+
+
+def _local_scan(queries, dataset, k: int, metric, metric_arg, tile: int,
+                select_min: bool, axis: Optional[str] = None):
+    """Per-shard tiled scan (the single-device ``_knn_scan`` body inlined
+    so it traces inside shard_map; ``axis`` marks the carry as
+    device-varying for shard_map's vma check)."""
+    n, d = dataset.shape
+    q = queries.shape[0]
+    pad_val = jnp.inf if select_min else -jnp.inf
+    pad = (-n) % tile
+    dsp = jnp.pad(dataset, ((0, pad), (0, 0)))
+    tiles = dsp.reshape(-1, tile, d)
+
+    def step(carry, inp):
+        best_d, best_i = carry
+        t_idx, yt = inp
+        dist = _pairwise_distance_impl(queries, yt, metric, metric_arg,
+                                       "highest")
+        col_ids = t_idx * tile + jnp.arange(tile)
+        dist = jnp.where((col_ids < n)[None, :], dist, pad_val)
+        kk = min(k, tile)
+        if select_min:
+            tile_d, tile_i = jax.lax.top_k(-dist, kk)
+            tile_d = -tile_d
+        else:
+            tile_d, tile_i = jax.lax.top_k(dist, kk)
+        tile_gi = (t_idx * tile + tile_i).astype(jnp.int32)
+        return merge_topk(best_d, best_i, tile_d, tile_gi, k, select_min), None
+
+    init = (jnp.full((q, k), pad_val, jnp.float32),
+            jnp.full((q, k), -1, jnp.int32))
+    if axis is not None:
+        # mark the carry device-varying (pvary was deprecated for pcast)
+        pcast = getattr(jax.lax, "pcast", None)
+        init = (pcast(init, axis, to="varying") if pcast is not None
+                else jax.lax.pvary(init, axis))
+    (best_d, best_i), _ = jax.lax.scan(
+        step, init, (jnp.arange(tiles.shape[0]), tiles))
+    return best_d, best_i

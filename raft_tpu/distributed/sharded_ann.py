@@ -1,0 +1,95 @@
+"""Index-per-shard ANN — raft-dask's MNMG pattern (one index per worker,
+merge at query time; ``raft_dask`` + ``knn_merge_parts``,
+SURVEY.md §5 "MNMG sharding via raft-dask").
+
+The dataset is split into row shards; any single-device index family
+(ivf_flat / ivf_pq / cagra / brute_force) is built per shard with its
+arrays placed on that shard's device; search fans out per shard and
+merges with the shared top-k merge. Host code orchestrates (exactly the
+Dask worker role); per-shard compute stays jitted on its device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+from raft_tpu.matrix.select_k import merge_topk
+from raft_tpu.neighbors.brute_force import knn_merge_parts
+
+
+@dataclasses.dataclass
+class ShardedIndex:
+    """Per-shard sub-indexes + their global row offsets."""
+
+    shards: List[Any]
+    offsets: List[int]
+    search_fn: Callable  # (res, index, queries, k) -> (dists, ids)
+    select_min: bool = True
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.shards)
+
+    def search(
+        self,
+        res: Optional[Resources],
+        queries,
+        k: int,
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Fan out to every shard, then ``knn_merge_parts``."""
+        res = ensure_resources(res)
+        queries = jnp.asarray(queries)
+        with tracing.range("raft_tpu.distributed.sharded_search"):
+            parts_d, parts_i = [], []
+            for index, off in zip(self.shards, self.offsets):
+                d, i = self.search_fn(res, index, queries, k)
+                parts_d.append(d)
+                parts_i.append(jnp.where(i >= 0, i + off, i))
+            return knn_merge_parts(
+                jnp.stack(parts_d), jnp.stack(parts_i), self.select_min
+            )
+
+
+def build_sharded(
+    res: Optional[Resources],
+    build_fn: Callable,
+    search_fn: Callable,
+    dataset,
+    n_shards: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    select_min: bool = True,
+) -> ShardedIndex:
+    """Split ``dataset`` into row shards and build one sub-index each.
+
+    ``build_fn(res, shard)`` builds a sub-index; when ``devices`` is
+    given, shard s's arrays are placed on ``devices[s % len]`` (one index
+    per chip — the raft-dask worker layout).
+    """
+    res = ensure_resources(res)
+    dataset = jnp.asarray(dataset)
+    expect(dataset.ndim == 2, "dataset must be (n, d)")
+    if devices is None and n_shards is None:
+        devices = jax.devices()
+    if n_shards is None:
+        n_shards = len(devices)
+    n = dataset.shape[0]
+    expect(n_shards <= n, "more shards than rows")
+
+    bounds = [round(s * n / n_shards) for s in range(n_shards + 1)]
+    shards, offsets = [], []
+    with tracing.range("raft_tpu.distributed.build_sharded"):
+        for s in range(n_shards):
+            part = dataset[bounds[s] : bounds[s + 1]]
+            shard_res = dataclasses.replace(
+                res, device=devices[s % len(devices)] if devices else None
+            )
+            shards.append(build_fn(shard_res, part))
+            offsets.append(bounds[s])
+    return ShardedIndex(shards, offsets, search_fn, select_min)

@@ -1,0 +1,24 @@
+"""Sparse primitives — analog of ``raft/sparse/`` (SURVEY.md §2.3):
+COO/CSR containers, conversions, structure ops, linalg (spmm/norm/
+symmetrize/transpose/add/laplacian), pairwise distances, sparse kNN +
+kNN-graph construction + cross-component NN, Borůvka MST and Lanczos.
+"""
+
+from raft_tpu.sparse import convert
+from raft_tpu.sparse import distance
+from raft_tpu.sparse import linalg
+from raft_tpu.sparse import neighbors
+from raft_tpu.sparse import ops
+from raft_tpu.sparse import solver
+from raft_tpu.sparse.types import COO, CSR
+
+__all__ = [
+    "COO",
+    "CSR",
+    "convert",
+    "distance",
+    "linalg",
+    "neighbors",
+    "ops",
+    "solver",
+]

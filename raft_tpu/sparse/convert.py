@@ -1,0 +1,51 @@
+"""Sparse format conversions — analog of ``raft/sparse/convert/``
+(``convert/coo.cuh``, ``convert/csr.cuh``, ``convert/dense.cuh``).
+
+All conversions are jittable except the dense→sparse directions, which
+need a host-side nonzero count (static shapes)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.types import COO, CSR
+
+
+def coo_to_csr(coo: COO) -> CSR:
+    """``convert::sorted_coo_to_csr``: sort by (row, col), build indptr.
+    Padding rows (-1) sort to the back."""
+    m, n = coo.shape
+    row_key = jnp.where(coo.rows >= 0, coo.rows, m)
+    order = jnp.lexsort((coo.cols, row_key))
+    rows = coo.rows[order]
+    cols = coo.cols[order]
+    vals = coo.vals[order]
+    counts = jax.ops.segment_sum(
+        jnp.where(rows >= 0, 1, 0), jnp.clip(rows, 0), num_segments=m)
+    indptr = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
+    return CSR(indptr, cols, vals, coo.shape)
+
+
+def csr_to_coo(csr: CSR) -> COO:
+    """``convert::csr_to_coo``: expand indptr to row ids."""
+    return COO(csr.row_ids(), csr.indices, csr.data, csr.shape)
+
+
+def dense_to_csr(dense) -> CSR:
+    """``convert::dense_to_csr`` (host-side nnz count)."""
+    return CSR.from_dense(dense)
+
+
+def dense_to_coo(dense) -> COO:
+    return COO.from_dense(dense)
+
+
+def csr_to_dense(csr: CSR) -> jax.Array:
+    """``convert::csr_to_dense``."""
+    return csr.to_dense()
+
+
+def coo_to_dense(coo: COO) -> jax.Array:
+    return coo.to_dense()

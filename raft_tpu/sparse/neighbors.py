@@ -1,0 +1,131 @@
+"""Sparse neighbors — analog of ``raft/sparse/neighbors/``
+(``brute_force.cuh`` tiled sparse kNN, ``knn_graph.cuh`` graph
+construction, ``cross_component_nn.cuh`` MST-component connection).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.distance.pairwise import _pairwise_distance_impl
+from raft_tpu.distance.types import DistanceType, is_min_close
+from raft_tpu.matrix.select_k import merge_topk
+from raft_tpu.sparse.ops import row_slice
+from raft_tpu.sparse.types import COO, CSR
+
+
+def brute_force_knn(
+    res: Optional[Resources],
+    database: CSR,
+    queries: CSR,
+    k: int,
+    metric: DistanceType = DistanceType.L2Expanded,
+    metric_arg: float = 2.0,
+    tile: int = 2048,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN between sparse row sets (``sparse::neighbors::
+    brute_force_knn``): tiled densify + dense distance + running top-k
+    merge (the reference's batcher, ``detail/knn.cuh``)."""
+    ensure_resources(res)
+    assert database.shape[1] == queries.shape[1], "column dims must match"
+    n = database.shape[0]
+    q = queries.shape[0]
+    select_min = is_min_close(metric)
+    pad_val = jnp.inf if select_min else -jnp.inf
+    qd = queries.to_dense()
+
+    with tracing.range("raft_tpu.sparse.brute_force_knn"):
+        best_d = jnp.full((q, k), pad_val, jnp.float32)
+        best_i = jnp.full((q, k), -1, jnp.int32)
+        for start in range(0, n, tile):
+            stop = min(start + tile, n)
+            bd = row_slice(database, start, stop).to_dense()
+            dist = _pairwise_distance_impl(qd, bd, metric, metric_arg,
+                                           "highest")
+            kk = min(k, stop - start)
+            if select_min:
+                td, ti = jax.lax.top_k(-dist, kk)
+                td = -td
+            else:
+                td, ti = jax.lax.top_k(dist, kk)
+            best_d, best_i = merge_topk(best_d, best_i, td,
+                                        (ti + start).astype(jnp.int32),
+                                        k, select_min)
+        return best_d, best_i
+
+
+def knn_graph(
+    res: Optional[Resources],
+    x,
+    k: int,
+    metric: DistanceType = DistanceType.L2Expanded,
+) -> COO:
+    """Symmetric k-NN graph over dense rows → COO adjacency
+    (``sparse::neighbors::knn_graph``; consumed by single-linkage).
+    Self-edges are excluded; edges carry distances."""
+    from raft_tpu.neighbors import brute_force  # local: avoid import cycle
+
+    res = ensure_resources(res)
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    with tracing.range("raft_tpu.sparse.knn_graph"):
+        d, i = brute_force.knn(res, x, x, k + 1, metric)
+        rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k + 1)
+        cols = i.reshape(-1)
+        vals = d.reshape(-1).astype(jnp.float32)
+        keep = (rows != cols) & (cols >= 0)
+        # cap at k per row by dropping the first self-match (stable compact
+        # not needed: padding entries are masked with row=-1)
+        return COO(jnp.where(keep, rows, -1),
+                   jnp.where(keep, cols, 0),
+                   jnp.where(keep, vals, 0), (n, n))
+
+
+def cross_component_nn(
+    res: Optional[Resources],
+    x,
+    labels,
+    metric: DistanceType = DistanceType.L2Expanded,
+    tile: int = 1024,
+) -> COO:
+    """Nearest neighbor in a *different* component per component —
+    ``sparse::neighbors::cross_component_nn`` (connects MST forests in
+    single-linkage). Returns COO edges (one per component: min outgoing).
+    """
+    res = ensure_resources(res)
+    x = jnp.asarray(x)
+    labels = jnp.asarray(labels, jnp.int32)
+    n = x.shape[0]
+    n_comp = int(jnp.max(labels)) + 1
+
+    with tracing.range("raft_tpu.sparse.cross_component_nn"):
+        best_d = jnp.full((n,), jnp.inf, jnp.float32)
+        best_j = jnp.zeros((n,), jnp.int32)
+        for start in range(0, n, tile):
+            stop = min(start + tile, n)
+            dist = _pairwise_distance_impl(x, x[start:stop], metric, 2.0,
+                                           "highest")          # (n, t)
+            same = labels[:, None] == labels[None, start:stop]
+            dist = jnp.where(same, jnp.inf, dist)
+            td = jnp.min(dist, axis=1)
+            tj = jnp.argmin(dist, axis=1).astype(jnp.int32) + start
+            upd = td < best_d
+            best_d = jnp.where(upd, td, best_d)
+            best_j = jnp.where(upd, tj, best_j)
+        # reduce per component: min outgoing edge
+        comp_min = jax.ops.segment_min(best_d, labels, num_segments=n_comp)
+        is_min = best_d == jnp.take(comp_min, labels)
+        # first vertex achieving the min per component
+        first = jax.ops.segment_min(
+            jnp.where(is_min, jnp.arange(n), n), labels, num_segments=n_comp)
+        src = jnp.clip(first, 0, n - 1).astype(jnp.int32)
+        dst = jnp.take(best_j, src)
+        w = jnp.take(best_d, src)
+        valid = (first < n) & jnp.isfinite(w)
+        return COO(jnp.where(valid, src, -1), jnp.where(valid, dst, 0),
+                   jnp.where(valid, w, 0), (n, n))

@@ -1,0 +1,97 @@
+"""Sparse structure operations — analog of ``raft/sparse/op/``
+(``sort.cuh``, ``reduce.cuh`` max-duplicate merge, ``filter.cuh`` value
+filtering, ``slice.cuh`` row slicing) plus ``linalg/degree.cuh``."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.types import COO, CSR
+
+
+def coo_sort(coo: COO) -> COO:
+    """``op::coo_sort``: order entries by (row, col); padding last.
+
+    Componentwise lexsort — no fused int64 key, which would overflow
+    int32 under JAX's default x64-disabled mode."""
+    m = coo.shape[0]
+    row_key = jnp.where(coo.rows >= 0, coo.rows, m)
+    order = jnp.lexsort((coo.cols, row_key))
+    return COO(coo.rows[order], coo.cols[order], coo.vals[order], coo.shape)
+
+
+def max_duplicates(coo: COO) -> COO:
+    """``op::max_duplicates``: merge duplicate (row, col) entries keeping
+    the max value (used when symmetrizing kNN graphs)."""
+    return _merge_duplicates(coo, "max")
+
+
+def sum_duplicates(coo: COO) -> COO:
+    """Merge duplicate (row, col) entries by summation (the cuSPARSE
+    ``coosort``+reduce idiom the reference leans on)."""
+    return _merge_duplicates(coo, "sum")
+
+
+def _merge_duplicates(coo: COO, how: str) -> COO:
+    c = coo_sort(coo)
+    same_prev = (c.rows[1:] == c.rows[:-1]) & (c.cols[1:] == c.cols[:-1])
+    is_first = jnp.concatenate(
+        [jnp.ones((1,), bool), ~same_prev]) & (c.rows >= 0)
+    seg = jnp.cumsum(is_first) - 1                   # group id per entry
+    seg = jnp.where(c.rows >= 0, seg, c.nnz)         # padding → drop bucket
+    if how == "sum":
+        merged = jax.ops.segment_sum(c.vals, seg, num_segments=c.nnz + 1)
+    else:
+        merged = jax.ops.segment_max(c.vals, seg, num_segments=c.nnz + 1)
+    ngroups = jnp.sum(is_first)
+    slot = jnp.where(is_first, seg, c.nnz)
+    rows = jnp.full((c.nnz + 1,), -1, jnp.int32).at[slot].set(c.rows, mode="drop")
+    cols = jnp.zeros((c.nnz + 1,), jnp.int32).at[slot].set(c.cols, mode="drop")
+    valid = jnp.arange(c.nnz) < ngroups
+    vals = jnp.where(valid, merged[: c.nnz], 0)
+    return COO(jnp.where(valid, rows[: c.nnz], -1), cols[: c.nnz],
+               vals, coo.shape)
+
+
+def remove_scalar(coo: COO, scalar) -> COO:
+    """``op::coo_remove_scalar``: entries equal to ``scalar`` become
+    padding (capacity is static, so they are masked, not compacted)."""
+    drop = (coo.vals == scalar) | (coo.rows < 0)
+    return COO(jnp.where(drop, -1, coo.rows), coo.cols,
+               jnp.where(drop, 0, coo.vals), coo.shape)
+
+
+def remove_zeros(coo: COO) -> COO:
+    """``op::coo_remove_zeros``."""
+    return remove_scalar(coo, 0)
+
+
+def row_slice(csr: CSR, start: int, stop: int) -> CSR:
+    """``op::csr_row_slice_indptr`` + populate: rows [start, stop).
+
+    Static-shape form: capacity stays the full nnz; entries outside the
+    slice are zeroed padding past the new indptr."""
+    m = stop - start
+    indptr = csr.indptr[start : stop + 1] - csr.indptr[start]
+    n_keep = csr.indptr[stop] - csr.indptr[start]
+    idx = jnp.arange(csr.nnz) + csr.indptr[start]
+    valid = jnp.arange(csr.nnz) < n_keep
+    indices = jnp.where(valid, csr.indices[jnp.clip(idx, 0, csr.nnz - 1)], 0)
+    data = jnp.where(valid, csr.data[jnp.clip(idx, 0, csr.nnz - 1)], 0)
+    return CSR(indptr, indices, data, (m, csr.shape[1]))
+
+
+def degree(coo: COO) -> jax.Array:
+    """``linalg::coo_degree``: nonzeros per row."""
+    valid = coo.rows >= 0
+    return jax.ops.segment_sum(
+        valid.astype(jnp.int32), jnp.clip(coo.rows, 0),
+        num_segments=coo.shape[0])
+
+
+def csr_row_op(csr: CSR, fn) -> CSR:
+    """``op::csr_row_op``: map ``fn(row_id, value)`` over entries."""
+    r = csr.row_ids()
+    out = fn(r, csr.data)
+    return CSR(csr.indptr, csr.indices, jnp.where(r >= 0, out, 0), csr.shape)

@@ -1,0 +1,191 @@
+"""Sparse solvers — analog of ``raft/sparse/solver/``:
+parallel Borůvka MST (``mst_solver.cuh``, ``detail/mst_solver_inl.cuh``)
+and the Lanczos smallest-eigenvector solver (``lanczos.cuh:68``
+``computeSmallestEigenvectors``).
+
+TPU re-design of Borůvka: the reference's per-vertex atomic min-edge
+kernels become ``segment_min`` reductions over a static edge list, and
+supervertex contraction becomes pointer-jumping on a label array —
+every round is a fixed-shape XLA program; ``ceil(log2 n)`` rounds
+suffice because components at least halve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.sparse.types import COO, CSR
+
+
+@dataclasses.dataclass
+class MSTResult:
+    """``Graph_COO`` result of the MST solver (src/dst/weights) plus the
+    per-vertex component color (``mst_solver_t::solve`` outputs)."""
+
+    src: jax.Array      # (n_edges_cap,) int32, -1 padding
+    dst: jax.Array
+    weights: jax.Array
+    color: jax.Array    # (n,) final component label per vertex
+    n_edges: int        # valid edge count
+
+    @property
+    def total_weight(self) -> float:
+        return float(jnp.sum(jnp.where(self.src >= 0, self.weights, 0.0)))
+
+
+@partial(jax.jit, static_argnames=("n", "rounds"))
+def _boruvka(u, v, w, rank, n: int, rounds: int):
+    e = u.shape[0]
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
+
+    def round_fn(_, state):
+        comp, in_mst = state
+        cu = jnp.take(comp, jnp.clip(u, 0))
+        cv = jnp.take(comp, jnp.clip(v, 0))
+        alive = (cu != cv) & (u >= 0)
+        key = jnp.where(alive, rank, big)
+        # min outgoing edge rank per component (both directions)
+        m1 = jax.ops.segment_min(key, cu, num_segments=n)
+        m2 = jax.ops.segment_min(key, cv, num_segments=n)
+        minkey = jnp.minimum(m1, m2)
+        chosen = alive & (
+            (rank == jnp.take(minkey, cu)) | (rank == jnp.take(minkey, cv))
+        )
+        in_mst = in_mst | chosen
+
+        # hooking: each component points at its min-edge partner
+        partner = jnp.arange(n, dtype=jnp.int32)
+        sel_u = chosen & (rank == jnp.take(minkey, cu))
+        sel_v = chosen & (rank == jnp.take(minkey, cv))
+        partner = partner.at[jnp.where(sel_u, cu, n)].set(
+            jnp.where(sel_u, cv, 0), mode="drop")
+        partner = partner.at[jnp.where(sel_v, cv, n)].set(
+            jnp.where(sel_v, cu, 0), mode="drop")
+        # break 2-cycles toward the smaller label
+        two_cycle = jnp.take(partner, partner) == jnp.arange(n)
+        par = jnp.where(two_cycle & (jnp.arange(n) < partner),
+                        jnp.arange(n), partner)
+        # pointer jumping to forest roots
+        for _ in range(max(1, rounds)):
+            par = jnp.take(par, par)
+        comp = jnp.take(par, comp)
+        return comp, in_mst
+
+    comp0 = jnp.arange(n, dtype=jnp.int32)
+    in_mst0 = jnp.zeros((e,), bool)
+    comp, in_mst = jax.lax.fori_loop(0, rounds, round_fn, (comp0, in_mst0))
+    return comp, in_mst
+
+
+def mst(
+    res: Optional[Resources],
+    adjacency: CSR,
+) -> MSTResult:
+    """Minimum spanning forest of a (symmetric, weighted) CSR graph —
+    ``solver::mst`` (``mst_solver.cuh``). Deterministic: ties broken by a
+    global weight-rank ordering (the reference's alteration trick,
+    ``detail/mst_solver_inl.cuh``)."""
+    ensure_resources(res)
+    n = adjacency.shape[0]
+    r = adjacency.row_ids()
+    u = jnp.where(r >= 0, r, -1)
+    v = adjacency.indices
+    w = adjacency.data.astype(jnp.float32)
+
+    with tracing.range("raft_tpu.sparse.mst"):
+        # canonical rank: (weight, lo, hi) lexicographic — no fused
+        # int key (int32 would overflow for large n); the two directed
+        # copies of an undirected edge share the lower rank
+        lo = jnp.minimum(u, v)
+        hi = jnp.maximum(u, v)
+        order = jnp.lexsort((hi, lo, w))
+        rank = jnp.zeros((u.shape[0],), jnp.int32).at[order].set(
+            jnp.arange(u.shape[0], dtype=jnp.int32))
+        srt = jnp.lexsort((hi, lo))
+        rank_srt = rank[srt]
+        same_prev = jnp.concatenate(
+            [jnp.zeros((1,), bool),
+             (lo[srt][1:] == lo[srt][:-1]) & (hi[srt][1:] == hi[srt][:-1])])
+        pair_min = jnp.minimum(rank_srt,
+                               jnp.where(same_prev,
+                                         jnp.roll(rank_srt, 1), rank_srt))
+        rank = rank.at[srt].set(pair_min)
+        rank = jnp.where(u >= 0, rank, jnp.iinfo(jnp.int32).max)
+
+        rounds = max(1, math.ceil(math.log2(max(n, 2))))
+        comp, in_mst = _boruvka(u, v, w, rank, n, rounds)
+
+        # emit each undirected MST edge once (first copy in (lo, hi) order)
+        in_srt = in_mst[srt]
+        dup = jnp.concatenate([jnp.zeros((1,), bool), same_prev[1:] & in_srt[:-1]])
+        first_copy = jnp.zeros_like(in_mst).at[srt].set(~dup)
+        emit = in_mst & first_copy
+        src = jnp.where(emit, u, -1)
+        dst = jnp.where(emit, v, 0)
+        ww = jnp.where(emit, w, 0)
+        return MSTResult(src=src, dst=dst, weights=ww, color=comp,
+                         n_edges=int(jnp.sum(emit)))
+
+
+def lanczos_smallest(
+    res: Optional[Resources],
+    a: CSR,
+    k: int,
+    max_iter: int = 0,
+    seed: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """k smallest eigenpairs of a symmetric sparse matrix —
+    ``sparse::solver::lanczos`` ``computeSmallestEigenvectors``
+    (``lanczos.cuh:68``). Lanczos with full reorthogonalization; the
+    tridiagonal eigenproblem is solved densely (role of the reference's
+    LAPACK steqr call).
+
+    Returns (eigenvalues (k,), eigenvectors (n, k))."""
+    from raft_tpu.sparse.linalg import spmv
+
+    ensure_resources(res)
+    n = a.shape[0]
+    m = min(n, max_iter or max(4 * k + 8, 32))
+
+    with tracing.range("raft_tpu.sparse.lanczos"):
+        v0 = jax.random.normal(jax.random.key(seed), (n,), jnp.float32)
+        v0 = v0 / jnp.linalg.norm(v0)
+
+        def body(j, state):
+            vmat, alpha, beta = state
+            vj = vmat[j]
+            wv = spmv(a, vj)
+            aj = jnp.dot(vj, wv)
+            wv = wv - aj * vj - jnp.where(j > 0, beta[j - 1], 0.0) * vmat[j - 1]
+            # full reorthogonalization against all previous vectors
+            mask = (jnp.arange(m + 1) <= j)[:, None]
+            proj = (vmat * mask) @ wv
+            wv = wv - ((vmat * mask).T @ proj)
+            bj = jnp.linalg.norm(wv)
+            vnext = jnp.where(bj > 1e-10, wv / jnp.maximum(bj, 1e-30),
+                              jnp.zeros_like(wv))
+            vmat = vmat.at[j + 1].set(vnext)
+            return vmat, alpha.at[j].set(aj), beta.at[j].set(bj)
+
+        vmat0 = jnp.zeros((m + 1, n), jnp.float32).at[0].set(v0)
+        alpha0 = jnp.zeros((m,), jnp.float32)
+        beta0 = jnp.zeros((m,), jnp.float32)
+        vmat, alpha, beta = jax.lax.fori_loop(0, m, body,
+                                              (vmat0, alpha0, beta0))
+
+        t = jnp.diag(alpha) + jnp.diag(beta[: m - 1], 1) \
+            + jnp.diag(beta[: m - 1], -1)
+        evals, evecs = jnp.linalg.eigh(t)
+        eigvecs = vmat[:m].T @ evecs[:, :k]
+        # normalize (guard rank deficiency)
+        norms = jnp.linalg.norm(eigvecs, axis=0)
+        eigvecs = eigvecs / jnp.maximum(norms, 1e-30)
+        return evals[:k], eigvecs

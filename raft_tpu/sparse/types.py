@@ -1,0 +1,138 @@
+"""Sparse matrix containers — analog of the reference's COO/CSR types
+(``core/sparse_types.hpp``, ``core/device_coo_matrix.hpp``,
+``core/device_csr_matrix.hpp``, ``sparse/coo.hpp``, ``sparse/csr.hpp``).
+
+TPU re-design: XLA requires static shapes, so both containers are
+registered pytrees of fixed-size ``jax.Array``s whose *capacity* (nnz) is
+a static Python int; padding entries carry ``row == -1`` (COO) or simply
+zero value. Host code owns construction/compaction; device code uses
+gather + ``segment_sum`` in place of the reference's cuSPARSE handles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class COO:
+    """Coordinate-format sparse matrix (``raft::sparse::COO``,
+    ``sparse/coo.hpp``). Invalid (padding) entries have ``rows == -1``."""
+
+    rows: jax.Array   # (nnz,) int32, -1 = padding
+    cols: jax.Array   # (nnz,) int32
+    vals: jax.Array   # (nnz,)
+    shape: Tuple[int, int]
+
+    def tree_flatten(self):
+        return (self.rows, self.cols, self.vals), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, shape=aux[0])
+
+    @property
+    def nnz(self) -> int:
+        return self.rows.shape[0]
+
+    def to_dense(self) -> jax.Array:
+        m, n = self.shape
+        out = jnp.zeros((m, n), self.vals.dtype)
+        valid = self.rows >= 0
+        r = jnp.where(valid, self.rows, 0)
+        c = jnp.where(valid, self.cols, 0)
+        v = jnp.where(valid, self.vals, 0)
+        return out.at[r, c].add(v)
+
+    @classmethod
+    def from_dense(cls, dense, nnz: Optional[int] = None) -> "COO":
+        dense = np.asarray(dense)
+        r, c = np.nonzero(dense)
+        v = dense[r, c]
+        if nnz is None:
+            nnz = len(r)
+        pad = nnz - len(r)
+        if pad < 0:
+            raise ValueError(f"nnz capacity {nnz} < actual nonzeros {len(r)}")
+        rows = np.concatenate([r, np.full(pad, -1)]).astype(np.int32)
+        cols = np.concatenate([c, np.zeros(pad)]).astype(np.int32)
+        vals = np.concatenate([v, np.zeros(pad, dense.dtype)])
+        return cls(jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals),
+                   dense.shape)
+
+    @classmethod
+    def from_scipy(cls, mat) -> "COO":
+        coo = mat.tocoo()
+        return cls(jnp.asarray(coo.row, jnp.int32),
+                   jnp.asarray(coo.col, jnp.int32),
+                   jnp.asarray(coo.data), coo.shape)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class CSR:
+    """Compressed-sparse-row matrix (``raft::sparse::csr``,
+    ``sparse/csr.hpp``). Padding entries (beyond ``indptr[-1]``) hold
+    zero values so device math can ignore them."""
+
+    indptr: jax.Array   # (m + 1,) int32
+    indices: jax.Array  # (nnz,) int32
+    data: jax.Array     # (nnz,)
+    shape: Tuple[int, int]
+
+    def tree_flatten(self):
+        return (self.indptr, self.indices, self.data), (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, shape=aux[0])
+
+    @property
+    def nnz(self) -> int:
+        return self.indices.shape[0]
+
+    def row_ids(self) -> jax.Array:
+        """Expanded (nnz,) row id per entry, -1 for padding — the COO view
+        the segment-sum kernels consume."""
+        m = self.shape[0]
+        counts = jnp.diff(self.indptr)
+        ids = jnp.repeat(jnp.arange(m, dtype=jnp.int32), counts,
+                         total_repeat_length=self.nnz)
+        # jnp.repeat pads the tail with the last row id when
+        # sum(counts) < nnz; rewrite padding as -1
+        valid = jnp.arange(self.nnz) < self.indptr[-1]
+        return jnp.where(valid, ids, -1)
+
+    def to_dense(self) -> jax.Array:
+        m, n = self.shape
+        r = self.row_ids()
+        valid = r >= 0
+        out = jnp.zeros((m, n), self.data.dtype)
+        return out.at[jnp.where(valid, r, 0),
+                      jnp.where(valid, self.indices, 0)].add(
+            jnp.where(valid, self.data, 0))
+
+    @classmethod
+    def from_dense(cls, dense) -> "CSR":
+        dense = np.asarray(dense)
+        m, n = dense.shape
+        r, c = np.nonzero(dense)
+        v = dense[r, c]
+        indptr = np.zeros(m + 1, np.int32)
+        np.add.at(indptr, r + 1, 1)
+        indptr = np.cumsum(indptr).astype(np.int32)
+        return cls(jnp.asarray(indptr), jnp.asarray(c.astype(np.int32)),
+                   jnp.asarray(v), (m, n))
+
+    @classmethod
+    def from_scipy(cls, mat) -> "CSR":
+        csr = mat.tocsr()
+        return cls(jnp.asarray(csr.indptr, jnp.int32),
+                   jnp.asarray(csr.indices, jnp.int32),
+                   jnp.asarray(csr.data), csr.shape)

@@ -1,0 +1,211 @@
+"""Sparse subsystem tests — reference pattern (cpp/test/sparse/):
+every primitive validated against scipy.sparse / numpy references."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+import scipy.spatial.distance as spd
+
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.sparse import COO, CSR, convert, linalg, neighbors, ops, solver
+
+
+@pytest.fixture
+def rand_csr(rng_np):
+    def make(m=32, n=24, density=0.2, seed=0):
+        rs = np.random.RandomState(seed)
+        mat = sp.random(m, n, density=density, format="csr",
+                        random_state=rs, dtype=np.float32)
+        return CSR.from_scipy(mat), mat
+    return make
+
+
+class TestTypesAndConvert:
+    def test_roundtrip_dense(self, rand_csr):
+        csr, ref = rand_csr()
+        np.testing.assert_allclose(np.asarray(csr.to_dense()),
+                                   ref.toarray(), rtol=1e-6)
+        coo = convert.csr_to_coo(csr)
+        np.testing.assert_allclose(np.asarray(coo.to_dense()),
+                                   ref.toarray(), rtol=1e-6)
+        back = convert.coo_to_csr(coo)
+        np.testing.assert_allclose(np.asarray(back.to_dense()),
+                                   ref.toarray(), rtol=1e-6)
+
+    def test_coo_padding(self):
+        # capacity > actual nnz: padding rows = -1 are ignored
+        dense = np.array([[1, 0], [0, 2]], np.float32)
+        coo = COO.from_dense(dense, nnz=6)
+        assert coo.nnz == 6
+        np.testing.assert_allclose(np.asarray(coo.to_dense()), dense)
+        csr = convert.coo_to_csr(coo)
+        np.testing.assert_allclose(np.asarray(csr.to_dense()), dense)
+
+    def test_from_dense_csr(self):
+        dense = np.array([[0, 3, 0], [4, 0, 5]], np.float32)
+        csr = CSR.from_dense(dense)
+        assert csr.nnz == 3
+        np.testing.assert_array_equal(np.asarray(csr.indptr), [0, 1, 3])
+        np.testing.assert_allclose(np.asarray(csr.to_dense()), dense)
+
+
+class TestOps:
+    def test_sort_and_dedup(self):
+        rows = np.array([2, 0, 2, 0, -1], np.int32)
+        cols = np.array([1, 1, 1, 1, 0], np.int32)
+        vals = np.array([5.0, 1.0, 7.0, 2.0, 9.0], np.float32)
+        coo = COO(rows, cols, vals, (3, 2))
+        summed = ops.sum_duplicates(coo)
+        dense = np.asarray(summed.to_dense())
+        np.testing.assert_allclose(dense, [[0, 3], [0, 0], [0, 12]])
+        maxed = ops.max_duplicates(coo)
+        np.testing.assert_allclose(np.asarray(maxed.to_dense()),
+                                   [[0, 2], [0, 0], [0, 7]])
+
+    def test_remove_scalar_degree(self, rand_csr):
+        csr, ref = rand_csr()
+        coo = convert.csr_to_coo(csr)
+        deg = np.asarray(ops.degree(coo))
+        np.testing.assert_array_equal(deg, np.diff(ref.indptr))
+        cleaned = ops.remove_zeros(coo)
+        np.testing.assert_allclose(np.asarray(cleaned.to_dense()),
+                                   ref.toarray())
+
+    def test_row_slice(self, rand_csr):
+        csr, ref = rand_csr()
+        sliced = ops.row_slice(csr, 8, 20)
+        np.testing.assert_allclose(np.asarray(sliced.to_dense()),
+                                   ref[8:20].toarray(), rtol=1e-6)
+
+
+class TestLinalg:
+    def test_spmm(self, rand_csr, rng_np):
+        csr, ref = rand_csr()
+        b = rng_np.standard_normal((24, 7)).astype(np.float32)
+        out = linalg.spmm(csr, b)
+        np.testing.assert_allclose(np.asarray(out), ref @ b,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_row_norms_and_normalize(self, rand_csr):
+        csr, ref = rand_csr()
+        np.testing.assert_allclose(
+            np.asarray(linalg.row_norm_csr(csr, "l1")),
+            np.abs(ref).sum(axis=1).A1, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(linalg.row_norm_csr(csr, "l2")),
+            np.square(ref.toarray()).sum(axis=1), rtol=1e-5, atol=1e-6)
+        normed = linalg.csr_row_normalize(csr, "l1")
+        sums = np.abs(np.asarray(normed.to_dense())).sum(axis=1)
+        nonzero = np.diff(ref.indptr) > 0
+        np.testing.assert_allclose(sums[nonzero], 1.0, rtol=1e-5)
+
+    def test_transpose_add(self, rand_csr):
+        a, ra = rand_csr(seed=1)
+        b, rb = rand_csr(seed=2)
+        t = linalg.transpose(a)
+        np.testing.assert_allclose(np.asarray(t.to_dense()),
+                                   ra.toarray().T, rtol=1e-6)
+        s = linalg.add(a, b)
+        np.testing.assert_allclose(np.asarray(s.to_dense()),
+                                   (ra + rb).toarray(), rtol=1e-5, atol=1e-6)
+
+    def test_symmetrize(self):
+        dense = np.array([[0, 2, 0], [0, 0, 4], [1, 0, 0]], np.float32)
+        coo = COO.from_dense(dense)
+        sym = linalg.coo_symmetrize(coo)
+        np.testing.assert_allclose(np.asarray(sym.to_dense()),
+                                   dense + dense.T)
+
+    def test_laplacian(self):
+        g = np.array([[0, 1, 1], [1, 0, 0], [1, 0, 0]], np.float32)
+        lap = linalg.laplacian(CSR.from_dense(g), normalized=False)
+        want = np.diag(g.sum(1)) - g
+        np.testing.assert_allclose(np.asarray(lap.to_dense()), want)
+
+
+class TestDistanceAndNeighbors:
+    def test_pairwise(self, rand_csr):
+        from raft_tpu.sparse.distance import pairwise_distance
+        a, ra = rand_csr(m=20, seed=3)
+        b, rb = rand_csr(m=16, seed=4)
+        d = pairwise_distance(None, a, b, DistanceType.L2Expanded, tile=8)
+        want = spd.cdist(ra.toarray(), rb.toarray(), "sqeuclidean")
+        np.testing.assert_allclose(np.asarray(d), want, rtol=1e-3, atol=1e-3)
+
+    def test_sparse_knn(self, rand_csr):
+        db, rdb = rand_csr(m=64, seed=5)
+        q, rq = rand_csr(m=10, seed=6)
+        d, i = neighbors.brute_force_knn(None, db, q, 5, tile=16)
+        want = spd.cdist(rq.toarray(), rdb.toarray(), "sqeuclidean")
+        gt = np.argsort(want, axis=1, kind="stable")[:, :5]
+        gt_d = np.take_along_axis(want, gt, axis=1)
+        np.testing.assert_allclose(np.sort(np.asarray(d), axis=1),
+                                   np.sort(gt_d, axis=1),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_knn_graph(self, rng_np):
+        x = rng_np.standard_normal((50, 8)).astype(np.float32)
+        g = neighbors.knn_graph(None, x, 4)
+        rows = np.asarray(g.rows)
+        cols = np.asarray(g.cols)
+        valid = rows >= 0
+        assert not np.any(rows[valid] == cols[valid])  # no self edges
+        # each row has exactly k=4 valid edges (self dropped from k+1)
+        counts = np.bincount(rows[valid], minlength=50)
+        assert np.all(counts >= 4)
+
+    def test_cross_component_nn(self, rng_np):
+        # two well-separated blobs; the crossing edge must connect them
+        a = rng_np.standard_normal((20, 4)).astype(np.float32)
+        b = rng_np.standard_normal((20, 4)).astype(np.float32) + 50
+        x = np.vstack([a, b])
+        labels = np.array([0] * 20 + [1] * 20, np.int32)
+        edges = neighbors.cross_component_nn(None, x, labels)
+        src = np.asarray(edges.rows)
+        dst = np.asarray(edges.cols)
+        valid = src >= 0
+        assert valid.sum() == 2  # one outgoing edge per component
+        for s, t in zip(src[valid], dst[valid]):
+            assert labels[s] != labels[t]
+
+
+class TestSolvers:
+    def test_mst_path_graph(self):
+        # chain 0-1-2-3 with one heavy shortcut: MST = the chain
+        dense = np.zeros((4, 4), np.float32)
+        for i, w in [(0, 1.0), (1, 2.0), (2, 3.0)]:
+            dense[i, i + 1] = dense[i + 1, i] = w
+        dense[0, 3] = dense[3, 0] = 10.0
+        result = solver.mst(None, CSR.from_dense(dense))
+        assert result.n_edges == 3
+        np.testing.assert_allclose(result.total_weight, 6.0)
+        assert len(set(np.asarray(result.color).tolist())) == 1
+
+    def test_mst_vs_scipy(self, rng_np):
+        # random dense symmetric graph; compare weight to scipy
+        n = 24
+        w = rng_np.random((n, n)).astype(np.float32)
+        w = np.triu(w, 1)
+        w = w + w.T
+        result = solver.mst(None, CSR.from_dense(w))
+        from scipy.sparse.csgraph import minimum_spanning_tree
+        want = minimum_spanning_tree(w).sum()
+        assert result.n_edges == n - 1
+        np.testing.assert_allclose(result.total_weight, want, rtol=1e-5)
+
+    def test_lanczos_smallest(self, rng_np):
+        # symmetric PSD matrix: compare smallest eigenvalues to numpy
+        n = 40
+        a = rng_np.standard_normal((n, n)).astype(np.float32)
+        m = a @ a.T / n + np.eye(n, dtype=np.float32)
+        m[np.abs(m) < 0.05] = 0  # sparsify
+        m = (m + m.T) / 2
+        evals, evecs = solver.lanczos_smallest(None, CSR.from_dense(m), 3)
+        want = np.sort(np.linalg.eigvalsh(m))[:3]
+        np.testing.assert_allclose(np.asarray(evals), want,
+                                   rtol=5e-2, atol=5e-2)
+        # residual check ||Av - λv||
+        for j in range(3):
+            v = np.asarray(evecs)[:, j]
+            lam = float(evals[j])
+            assert np.linalg.norm(m @ v - lam * v) < 0.1

@@ -1,0 +1,90 @@
+"""Dense linear algebra primitives — TPU-native re-design of ``raft/linalg/``.
+
+The reference wraps cuBLAS (gemm/gemv/axpy/dot), hand-writes elementwise /
+reduction CUDA kernels, and wraps cuSOLVER for factorizations. On TPU the
+BLAS layer is ``jax.lax.dot_general`` on the MXU, elementwise ops are XLA
+fusions, and factorizations are ``jax.lax.linalg`` / ``jnp.linalg`` (which
+XLA lowers to TPU-native routines). What this package adds on top is the
+reference's *API surface*: free functions taking a ``Resources`` handle +
+arrays, with the same semantics (row/col norms, strided vs coalesced
+reductions, key-grouped reductions, rank-1 Cholesky update, randomized SVD).
+"""
+
+from raft_tpu.linalg.blas import axpy, dot, gemm, gemv
+from raft_tpu.linalg.elementwise import (
+    add,
+    binary_op,
+    divide,
+    map_offset,
+    multiply,
+    power,
+    scalar_add,
+    scalar_multiply,
+    sqrt,
+    subtract,
+    ternary_op,
+    unary_op,
+)
+from raft_tpu.linalg.matrix_vector import matrix_vector_op
+from raft_tpu.linalg.reduce import (
+    L1Norm,
+    L2Norm,
+    LinfNorm,
+    coalesced_reduction,
+    map_reduce,
+    mean_squared_error,
+    norm,
+    normalize,
+    reduce,
+    reduce_cols_by_key,
+    reduce_rows_by_key,
+    strided_reduction,
+)
+from raft_tpu.linalg.solvers import (
+    cholesky_rank_one_update,
+    eig_dc,
+    eig_jacobi,
+    lstsq,
+    qr,
+    rsvd,
+    svd,
+)
+
+__all__ = [
+    "axpy",
+    "dot",
+    "gemm",
+    "gemv",
+    "add",
+    "binary_op",
+    "divide",
+    "map_offset",
+    "multiply",
+    "power",
+    "scalar_add",
+    "scalar_multiply",
+    "sqrt",
+    "subtract",
+    "ternary_op",
+    "unary_op",
+    "matrix_vector_op",
+    "L1Norm",
+    "L2Norm",
+    "LinfNorm",
+    "coalesced_reduction",
+    "map_reduce",
+    "mean_squared_error",
+    "norm",
+    "normalize",
+    "reduce",
+    "reduce_cols_by_key",
+    "reduce_rows_by_key",
+    "strided_reduction",
+    "cholesky_rank_one_update",
+    "eig_dc",
+    "eig_jacobi",
+    "lstsq",
+    "qr",
+    "rsvd",
+    "svd",
+]

@@ -1,0 +1,85 @@
+"""BLAS-level ops — analog of the reference's cuBLAS wrappers
+(``linalg/gemm.cuh``, ``linalg/detail/cublas_wrappers.hpp``).
+
+On TPU there is no vendor handle to thread: every call is a
+``jax.lax.dot_general`` that XLA tiles onto the MXU. The handle still
+supplies the default matmul precision so callers get the same
+precision-policy knob cuBLAS math modes gave the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+
+
+def gemm(
+    res: Optional[Resources],
+    a,
+    b,
+    *,
+    alpha: float = 1.0,
+    beta: float = 0.0,
+    c=None,
+    trans_a: bool = False,
+    trans_b: bool = False,
+):
+    """``alpha * op(A) @ op(B) + beta * C`` — analog of ``linalg::gemm``
+    (reference ``linalg/gemm.cuh``). Accumulates in float32 on the MXU."""
+    res = ensure_resources(res)
+    if trans_a:
+        a = a.T
+    if trans_b:
+        b = b.T
+    expect(a.shape[1] == b.shape[0], "gemm: inner dimensions must agree")
+    out = jax.lax.dot_general(
+        a,
+        b,
+        (((1,), (0,)), ((), ())),
+        precision=res.matmul_precision,
+        preferred_element_type=jnp.float32,
+    )
+    out = alpha * out
+    if beta != 0.0:
+        expect(c is not None, "gemm: beta != 0 requires C")
+        out = out + beta * c
+    return out.astype(a.dtype)
+
+
+def gemv(
+    res: Optional[Resources],
+    a,
+    x,
+    *,
+    alpha: float = 1.0,
+    beta: float = 0.0,
+    y=None,
+    trans: bool = False,
+):
+    """``alpha * op(A) @ x + beta * y`` — analog of the cuBLAS gemv wrapper."""
+    res = ensure_resources(res)
+    if trans:
+        a = a.T
+    expect(a.shape[1] == x.shape[0], "gemv: dimensions must agree")
+    out = alpha * jnp.dot(
+        a.astype(jnp.float32), x.astype(jnp.float32), precision=res.matmul_precision
+    )
+    if beta != 0.0:
+        expect(y is not None, "gemv: beta != 0 requires y")
+        out = out + beta * y
+    return out.astype(a.dtype)
+
+
+def axpy(res: Optional[Resources], alpha: float, x, y):
+    """``y + alpha * x`` (functional: returns the result)."""
+    return y + alpha * x
+
+
+def dot(res: Optional[Resources], x, y):
+    """Vector dot product with float32 accumulation."""
+    return jnp.dot(x.astype(jnp.float32), y.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,73 @@
+"""Elementwise map family — analog of ``linalg/map.cuh`` and the
+add/subtract/multiply/divide/power/sqrt headers under ``raft/linalg/``.
+
+The reference hand-writes vectorized CUDA kernels for each; under XLA
+every one of these is a single fused VPU loop, so the value here is API
+parity (free functions over arrays) rather than codegen.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources
+
+
+def unary_op(res: Optional[Resources], x, op: Callable):
+    """Apply ``op`` elementwise (``linalg::unaryOp``, ``linalg/unary_op.cuh``)."""
+    return op(x)
+
+
+def binary_op(res: Optional[Resources], x, y, op: Callable):
+    """Apply ``op(x, y)`` elementwise (``linalg::binaryOp``)."""
+    return op(x, y)
+
+
+def ternary_op(res: Optional[Resources], x, y, z, op: Callable):
+    """Apply ``op(x, y, z)`` elementwise (``linalg::ternaryOp``)."""
+    return op(x, y, z)
+
+
+def map_offset(res: Optional[Resources], shape, op: Callable, dtype=jnp.float32):
+    """Map over flat element offsets (``linalg::map_offset``,
+    ``linalg/map.cuh``): ``out[i] = op(i)`` reshaped to ``shape``."""
+    n = 1
+    for s in shape:
+        n *= s
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return op(idx).astype(dtype).reshape(shape)
+
+
+def add(res: Optional[Resources], x, y):
+    return x + y
+
+
+def subtract(res: Optional[Resources], x, y):
+    return x - y
+
+
+def multiply(res: Optional[Resources], x, y):
+    return x * y
+
+
+def divide(res: Optional[Resources], x, y):
+    return x / y
+
+
+def scalar_add(res: Optional[Resources], x, scalar):
+    return x + scalar
+
+
+def scalar_multiply(res: Optional[Resources], x, scalar):
+    return x * scalar
+
+
+def power(res: Optional[Resources], x, y):
+    return jnp.power(x, y)
+
+
+def sqrt(res: Optional[Resources], x):
+    return jnp.sqrt(x)

@@ -1,0 +1,35 @@
+"""Broadcast matrix ± vector ops — analog of ``linalg::matrix_vector_op``
+(``linalg/matrix_vector_op.cuh``).
+
+The reference picks vectorized-IO kernels by alignment; XLA handles layout,
+so this reduces to a broadcast the compiler fuses into neighbors.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources
+from raft_tpu.core.validation import expect
+
+
+def matrix_vector_op(
+    res: Optional[Resources],
+    matrix,
+    vec,
+    op: Callable = jnp.add,
+    *,
+    along_rows: bool = True,
+):
+    """Apply ``op(matrix, vec)`` broadcasting ``vec`` along rows or columns.
+
+    ``along_rows=True`` broadcasts over the row axis (vec has one entry per
+    column), matching the reference's ``bcastAlongRows``.
+    """
+    if along_rows:
+        expect(vec.shape[0] == matrix.shape[1], "matrix_vector_op: |vec| != n_cols")
+        return op(matrix, vec[None, :])
+    expect(vec.shape[0] == matrix.shape[0], "matrix_vector_op: |vec| != n_rows")
+    return op(matrix, vec[:, None])

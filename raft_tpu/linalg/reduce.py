@@ -1,0 +1,163 @@
+"""Reductions and norms — analog of ``linalg/reduce.cuh``,
+``linalg/coalesced_reduction.cuh``, ``linalg/strided_reduction.cuh``,
+``linalg/norm.cuh``, ``linalg/normalize.cuh``,
+``linalg/mean_squared_error.cuh``, ``linalg/reduce_rows_by_key.cuh``,
+``linalg/reduce_cols_by_key.cuh``.
+
+The reference distinguishes *coalesced* (reduce along the contiguous
+dimension) from *strided* reductions because GPU kernel shape differs; on
+TPU both are one ``jnp`` reduction XLA lays out for the VPU, so the two
+names are kept only as API parity aliases over ``axis=``.
+
+Key-grouped reductions use ``segment_sum``-style one-hot matmuls: grouping
+by key is a gather/scatter on GPU but is MXU-friendly as a one-hot GEMM on
+TPU for the small key cardinalities these APIs target.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources
+from raft_tpu.core.validation import expect
+
+# Norm types mirroring ``raft::linalg::NormType``.
+L1Norm = "l1"
+L2Norm = "l2"
+LinfNorm = "linf"
+
+
+def reduce(
+    res: Optional[Resources],
+    matrix,
+    *,
+    along_rows: bool = True,
+    main_op: Callable = lambda x: x,
+    reduce_op: Callable = jnp.sum,
+    final_op: Callable = lambda x: x,
+    init=None,
+):
+    """General map-reduce over one matrix axis (``linalg::reduce``).
+
+    ``along_rows=True`` reduces each row to a scalar (output length n_rows),
+    matching the reference's ``apply_along_rows``. ``init`` seeds the
+    accumulator (reference semantics: correct for max/min reductions, not
+    an additive bias) — implemented by reducing over the mapped matrix
+    with an extra init-valued lane appended.
+    """
+    axis = 1 if along_rows else 0
+    x = main_op(matrix)
+    if init is not None:
+        pad_shape = (x.shape[0], 1) if along_rows else (1, x.shape[1])
+        x = jnp.concatenate([x, jnp.full(pad_shape, init, x.dtype)], axis=axis)
+    return final_op(reduce_op(x, axis=axis))
+
+
+def coalesced_reduction(res: Optional[Resources], matrix, **kwargs):
+    """Row-wise reduction for row-major data (``linalg/coalesced_reduction.cuh``)."""
+    return reduce(res, matrix, along_rows=True, **kwargs)
+
+
+def strided_reduction(res: Optional[Resources], matrix, **kwargs):
+    """Column-wise reduction for row-major data (``linalg/strided_reduction.cuh``)."""
+    return reduce(res, matrix, along_rows=False, **kwargs)
+
+
+def map_reduce(
+    res: Optional[Resources],
+    x,
+    map_op: Callable,
+    reduce_op: Callable = jnp.sum,
+):
+    """Fused map + full reduction (``linalg::mapThenReduce``)."""
+    return reduce_op(map_op(x))
+
+
+def norm(
+    res: Optional[Resources],
+    matrix,
+    norm_type: str = L2Norm,
+    *,
+    along_rows: bool = True,
+    sqrt: bool = False,
+):
+    """Row / column norms (``linalg::rowNorm`` / ``colNorm``,
+    ``linalg/norm.cuh``). Note the reference's L2 norm is the *squared*
+    norm unless ``sqrt=True`` — matched here."""
+    axis = 1 if along_rows else 0
+    x = matrix.astype(jnp.float32)
+    if norm_type == L1Norm:
+        out = jnp.sum(jnp.abs(x), axis=axis)
+    elif norm_type == L2Norm:
+        out = jnp.sum(jnp.square(x), axis=axis)
+        if sqrt:
+            out = jnp.sqrt(out)
+        return out
+    elif norm_type == LinfNorm:
+        out = jnp.max(jnp.abs(x), axis=axis)
+    else:
+        raise ValueError(f"unknown norm type: {norm_type!r}")
+    return out
+
+
+def normalize(
+    res: Optional[Resources],
+    matrix,
+    norm_type: str = L2Norm,
+    *,
+    eps: float = 1e-10,
+):
+    """Row-normalize (``linalg::row_normalize``, ``linalg/normalize.cuh``)."""
+    if norm_type == L2Norm:
+        n = jnp.sqrt(norm(res, matrix, L2Norm, along_rows=True))
+    else:
+        n = norm(res, matrix, norm_type, along_rows=True)
+    return matrix / jnp.maximum(n, eps)[:, None]
+
+
+def mean_squared_error(res: Optional[Resources], a, b, *, weight: float = 1.0):
+    """``linalg::meanSquaredError``: weight * mean((a-b)^2) over all elements."""
+    d = a.astype(jnp.float32) - b.astype(jnp.float32)
+    return weight * jnp.mean(jnp.square(d))
+
+
+def reduce_rows_by_key(
+    res: Optional[Resources],
+    matrix,
+    keys,
+    n_keys: int,
+    *,
+    weights=None,
+):
+    """Sum rows grouped by per-row key → ``(n_keys, n_cols)``
+    (``linalg::reduce_rows_by_key``). One-hot GEMM: MXU-friendly scatter-add."""
+    expect(keys.shape[0] == matrix.shape[0], "reduce_rows_by_key: |keys| != n_rows")
+    onehot = jax.nn.one_hot(keys, n_keys, dtype=jnp.float32)
+    x = matrix.astype(jnp.float32)
+    if weights is not None:
+        x = x * weights[:, None]
+    out = jax.lax.dot_general(
+        onehot, x, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return out
+
+
+def reduce_cols_by_key(
+    res: Optional[Resources],
+    matrix,
+    keys,
+    n_keys: int,
+):
+    """Sum columns grouped by per-column key → ``(n_rows, n_keys)``
+    (``linalg::reduce_cols_by_key``)."""
+    expect(keys.shape[0] == matrix.shape[1], "reduce_cols_by_key: |keys| != n_cols")
+    onehot = jax.nn.one_hot(keys, n_keys, dtype=jnp.float32)
+    return jax.lax.dot_general(
+        matrix.astype(jnp.float32),
+        onehot,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )

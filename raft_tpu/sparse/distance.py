@@ -38,13 +38,20 @@ def pairwise_distance(
     ensure_resources(res)
     assert x.shape[1] == y.shape[1], "column dims must match"
     m = x.shape[0]
-    yd = y.to_dense()
+    n = y.shape[0]
     with tracing.range("raft_tpu.sparse.pairwise_distance"):
-        outs = []
-        for start in range(0, m, tile):
-            stop = min(start + tile, m)
-            xd = row_slice(x, start, stop).to_dense()
-            outs.append(
-                _pairwise_distance_impl(xd, yd, metric, metric_arg, "highest")
-            )
-        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+        rows = []
+        for xs in range(0, m, tile):
+            xe = min(xs + tile, m)
+            xd = row_slice(x, xs, xe).to_dense()
+            cols = []
+            for ys in range(0, n, tile):
+                ye = min(ys + tile, n)
+                yd = row_slice(y, ys, ye).to_dense()
+                cols.append(
+                    _pairwise_distance_impl(xd, yd, metric, metric_arg,
+                                            "highest")
+                )
+            rows.append(cols[0] if len(cols) == 1
+                        else jnp.concatenate(cols, axis=1))
+        return rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=0)

@@ -75,12 +75,16 @@ def knn_graph(
     n = x.shape[0]
     with tracing.range("raft_tpu.sparse.knn_graph"):
         d, i = brute_force.knn(res, x, x, k + 1, metric)
+        rows2d = jnp.arange(n, dtype=jnp.int32)[:, None]
+        # keep the first k non-self hits per row: with duplicate points the
+        # self-match may be displaced out of the top-(k+1), so dropping
+        # self-edges alone would leave k+1 edges on some rows
+        nonself = (i != rows2d) & (i >= 0)
+        rank = jnp.cumsum(nonself, axis=1)
+        keep = (nonself & (rank <= k)).reshape(-1)
         rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k + 1)
         cols = i.reshape(-1)
         vals = d.reshape(-1).astype(jnp.float32)
-        keep = (rows != cols) & (cols >= 0)
-        # cap at k per row by dropping the first self-match (stable compact
-        # not needed: padding entries are masked with row=-1)
         return COO(jnp.where(keep, rows, -1),
                    jnp.where(keep, cols, 0),
                    jnp.where(keep, vals, 0), (n, n))

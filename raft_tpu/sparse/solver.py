@@ -156,7 +156,8 @@ def lanczos_smallest(
     m = min(n, max_iter or max(4 * k + 8, 32))
 
     with tracing.range("raft_tpu.sparse.lanczos"):
-        v0 = jax.random.normal(jax.random.key(seed), (n,), jnp.float32)
+        key = jax.random.key(seed)
+        v0 = jax.random.normal(key, (n,), jnp.float32)
         v0 = v0 / jnp.linalg.norm(v0)
 
         def body(j, state):
@@ -170,10 +171,21 @@ def lanczos_smallest(
             proj = (vmat * mask) @ wv
             wv = wv - ((vmat * mask).T @ proj)
             bj = jnp.linalg.norm(wv)
-            vnext = jnp.where(bj > 1e-10, wv / jnp.maximum(bj, 1e-30),
-                              jnp.zeros_like(wv))
+            # breakdown (invariant subspace exhausted): restart with a
+            # fresh random vector orthogonalized against the basis, and
+            # record beta=0 so T decouples into blocks — the reference's
+            # LAPACK-restart behavior; without this, un-run iterations
+            # would inject spurious zero eigenvalues
+            breakdown = bj <= 1e-6
+            rv = jax.random.normal(jax.random.fold_in(key, j + 1), (n,),
+                                   jnp.float32)
+            for _ in range(2):
+                rv = rv - ((vmat * mask).T @ ((vmat * mask) @ rv))
+            rv = rv / jnp.maximum(jnp.linalg.norm(rv), 1e-30)
+            vnext = jnp.where(breakdown, rv, wv / jnp.maximum(bj, 1e-30))
             vmat = vmat.at[j + 1].set(vnext)
-            return vmat, alpha.at[j].set(aj), beta.at[j].set(bj)
+            return (vmat, alpha.at[j].set(aj),
+                    beta.at[j].set(jnp.where(breakdown, 0.0, bj)))
 
         vmat0 = jnp.zeros((m + 1, n), jnp.float32).at[0].set(v0)
         alpha0 = jnp.zeros((m,), jnp.float32)

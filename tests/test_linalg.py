@@ -1,0 +1,202 @@
+"""linalg tests — numpy/scipy cross-checks, mirroring the reference's
+``cpp/test/linalg/`` naive-reference pattern (SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+
+
+class TestBlas:
+    def test_gemm(self, rng_np, res):
+        a = rng_np.standard_normal((17, 9)).astype(np.float32)
+        b = rng_np.standard_normal((9, 23)).astype(np.float32)
+        out = linalg.gemm(res, a, b)
+        np.testing.assert_allclose(np.asarray(out), a @ b, rtol=1e-5, atol=1e-5)
+
+    def test_gemm_trans_alpha_beta(self, rng_np, res):
+        a = rng_np.standard_normal((9, 17)).astype(np.float32)
+        b = rng_np.standard_normal((23, 9)).astype(np.float32)
+        c = rng_np.standard_normal((17, 23)).astype(np.float32)
+        out = linalg.gemm(res, a, b, alpha=2.0, beta=0.5, c=c, trans_a=True, trans_b=True)
+        np.testing.assert_allclose(
+            np.asarray(out), 2.0 * (a.T @ b.T) + 0.5 * c, rtol=1e-4, atol=1e-4
+        )
+
+    def test_gemv_axpy_dot(self, rng_np, res):
+        a = rng_np.standard_normal((11, 7)).astype(np.float32)
+        x = rng_np.standard_normal(7).astype(np.float32)
+        y = rng_np.standard_normal(11).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.gemv(res, a, x)), a @ x, rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.axpy(res, 2.0, y, y)), 3.0 * y, rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.dot(res, x, x)), x @ x, rtol=1e-5
+        )
+
+
+class TestElementwise:
+    def test_ops(self, rng_np, res):
+        x = rng_np.standard_normal((5, 6)).astype(np.float32)
+        y = rng_np.standard_normal((5, 6)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(linalg.add(res, x, y)), x + y)
+        np.testing.assert_allclose(np.asarray(linalg.subtract(res, x, y)), x - y)
+        np.testing.assert_allclose(np.asarray(linalg.multiply(res, x, y)), x * y)
+        np.testing.assert_allclose(
+            np.asarray(linalg.divide(res, x, np.abs(y) + 1)), x / (np.abs(y) + 1)
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.sqrt(res, np.abs(x))), np.sqrt(np.abs(x)), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.unary_op(res, x, lambda v: v * 3)), x * 3
+        )
+
+    def test_map_offset(self, res):
+        out = linalg.map_offset(res, (3, 4), lambda i: i * 2, dtype=jnp.int32)
+        np.testing.assert_array_equal(
+            np.asarray(out), (np.arange(12) * 2).reshape(3, 4)
+        )
+
+
+class TestMatrixVector:
+    def test_along_rows(self, rng_np, res):
+        m = rng_np.standard_normal((6, 4)).astype(np.float32)
+        v = rng_np.standard_normal(4).astype(np.float32)
+        out = linalg.matrix_vector_op(res, m, v, jnp.add, along_rows=True)
+        np.testing.assert_allclose(np.asarray(out), m + v[None, :])
+
+    def test_along_cols(self, rng_np, res):
+        m = rng_np.standard_normal((6, 4)).astype(np.float32)
+        v = rng_np.standard_normal(6).astype(np.float32)
+        out = linalg.matrix_vector_op(res, m, v, jnp.multiply, along_rows=False)
+        np.testing.assert_allclose(np.asarray(out), m * v[:, None])
+
+
+class TestReduce:
+    def test_reduce_rows_cols(self, rng_np, res):
+        m = rng_np.standard_normal((8, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.coalesced_reduction(res, m)), m.sum(axis=1), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.strided_reduction(res, m)), m.sum(axis=0), rtol=1e-5
+        )
+
+    def test_norms(self, rng_np, res):
+        m = rng_np.standard_normal((8, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.norm(res, m, linalg.L1Norm)),
+            np.abs(m).sum(axis=1),
+            rtol=1e-5,
+        )
+        # reference L2 norm is squared unless sqrt=True
+        np.testing.assert_allclose(
+            np.asarray(linalg.norm(res, m, linalg.L2Norm)),
+            (m**2).sum(axis=1),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.norm(res, m, linalg.L2Norm, sqrt=True)),
+            np.linalg.norm(m, axis=1),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(linalg.norm(res, m, linalg.LinfNorm, along_rows=False)),
+            np.abs(m).max(axis=0),
+            rtol=1e-5,
+        )
+
+    def test_normalize(self, rng_np, res):
+        m = rng_np.standard_normal((8, 5)).astype(np.float32)
+        out = np.asarray(linalg.normalize(res, m))
+        np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+
+    def test_mse(self, rng_np, res):
+        a = rng_np.standard_normal((8, 5)).astype(np.float32)
+        b = rng_np.standard_normal((8, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(linalg.mean_squared_error(res, a, b)),
+            ((a - b) ** 2).mean(),
+            rtol=1e-5,
+        )
+
+    def test_reduce_rows_by_key(self, rng_np, res):
+        m = rng_np.standard_normal((20, 4)).astype(np.float32)
+        keys = rng_np.integers(0, 3, 20)
+        out = np.asarray(linalg.reduce_rows_by_key(res, m, jnp.asarray(keys), 3))
+        want = np.zeros((3, 4), np.float32)
+        for i, k in enumerate(keys):
+            want[k] += m[i]
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_reduce_cols_by_key(self, rng_np, res):
+        m = rng_np.standard_normal((4, 20)).astype(np.float32)
+        keys = rng_np.integers(0, 5, 20)
+        out = np.asarray(linalg.reduce_cols_by_key(res, m, jnp.asarray(keys), 5))
+        want = np.zeros((4, 5), np.float32)
+        for j, k in enumerate(keys):
+            want[:, k] += m[:, j]
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+class TestSolvers:
+    def test_eig(self, rng_np, res):
+        a = rng_np.standard_normal((12, 12)).astype(np.float32)
+        a = a @ a.T + 12 * np.eye(12, dtype=np.float32)
+        v, w = linalg.eig_dc(res, a)
+        v, w = np.asarray(v), np.asarray(w)
+        np.testing.assert_allclose(a @ v, v * w[None, :], rtol=1e-2, atol=1e-2)
+        assert np.all(np.diff(w) >= -1e-4)  # ascending
+
+    def test_svd(self, rng_np, res):
+        a = rng_np.standard_normal((15, 8)).astype(np.float32)
+        u, s, v = (np.asarray(z) for z in linalg.svd(res, a))
+        np.testing.assert_allclose(u @ np.diag(s) @ v.T, a, rtol=1e-3, atol=1e-3)
+
+    def test_qr(self, rng_np, res):
+        a = rng_np.standard_normal((10, 6)).astype(np.float32)
+        q, r = (np.asarray(z) for z in linalg.qr(res, a))
+        np.testing.assert_allclose(q @ r, a, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(q.T @ q, np.eye(6), atol=1e-4)
+
+    def test_rsvd_low_rank_recovery(self, rng_np, res):
+        # exact low-rank matrix: rsvd must recover it to float tolerance
+        u0 = rng_np.standard_normal((40, 5)).astype(np.float32)
+        v0 = rng_np.standard_normal((5, 30)).astype(np.float32)
+        a = u0 @ v0
+        u, s, v = linalg.rsvd(res, a, 5, n_iters=3)
+        approx = np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(v).T
+        np.testing.assert_allclose(approx, a, rtol=1e-2, atol=1e-2)
+        s_true = np.linalg.svd(a, compute_uv=False)[:5]
+        np.testing.assert_allclose(np.asarray(s), s_true, rtol=1e-3)
+
+    def test_lstsq(self, rng_np, res):
+        a = rng_np.standard_normal((30, 6)).astype(np.float32)
+        x_true = rng_np.standard_normal(6).astype(np.float32)
+        b = a @ x_true
+        x = np.asarray(linalg.lstsq(res, a, b))
+        np.testing.assert_allclose(x, x_true, rtol=1e-3, atol=1e-3)
+
+    def test_cholesky_rank_one_update(self, rng_np, res):
+        n = 7
+        a = rng_np.standard_normal((n, n)).astype(np.float32)
+        a = a @ a.T + n * np.eye(n, dtype=np.float32)
+        x = rng_np.standard_normal(n).astype(np.float32)
+        l0 = np.linalg.cholesky(a)
+        l1 = np.asarray(linalg.cholesky_rank_one_update(res, l0, x))
+        np.testing.assert_allclose(
+            l1 @ l1.T, a + np.outer(x, x), rtol=1e-3, atol=1e-3
+        )
+
+
+class TestReduceInitSemantics:
+    def test_init_seeds_accumulator(self, res):
+        """init is the accumulator seed (reference linalg::reduce), not an
+        additive bias: max-reduce of negatives with init=0 returns 0."""
+        out = linalg.reduce(res, jnp.array([[-5.0, -2.0]]), reduce_op=jnp.max, init=0.0)
+        assert float(out[0]) == 0.0

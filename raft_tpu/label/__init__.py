@@ -1,0 +1,18 @@
+"""Label utilities — analog of ``raft/label/`` (``classlabels.cuh``:
+``getUniquelabels`` / ``getOvrlabels`` / ``make_monotonic``;
+``merge_labels.cuh``: union-find label merge).
+"""
+
+from raft_tpu.label.classlabels import (
+    get_unique_labels,
+    make_monotonic,
+    merge_labels,
+    ovr_labels,
+)
+
+__all__ = [
+    "get_unique_labels",
+    "make_monotonic",
+    "merge_labels",
+    "ovr_labels",
+]

@@ -1,0 +1,81 @@
+"""Class-label utilities — ``raft/label/classlabels.cuh`` and
+``raft/label/merge_labels.cuh``.
+
+``merge_labels`` is the reference's union-find-flavored label
+reconciliation used by connected components; on TPU it is pointer
+jumping over a static min-label table — ``ceil(log2 n)`` fixed rounds.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.resources import Resources
+
+
+def get_unique_labels(res: Optional[Resources], labels) -> jax.Array:
+    """Sorted unique labels — ``label::getUniquelabels``. Host-side
+    (result size is data-dependent, like the reference's two-pass
+    count+fill)."""
+    return jnp.asarray(np.unique(np.asarray(labels)))
+
+
+def make_monotonic(
+    res: Optional[Resources], labels, classes=None
+) -> jax.Array:
+    """Map arbitrary label values onto 0..n_classes-1 —
+    ``label::make_monotonic``."""
+    if classes is None:
+        classes = get_unique_labels(res, labels)
+    labels = jnp.asarray(labels)
+    # rank of each label within the sorted class table
+    return jnp.searchsorted(classes, labels).astype(jnp.int32)
+
+
+def ovr_labels(res: Optional[Resources], labels, target) -> jax.Array:
+    """One-vs-rest relabeling: 1 where ``labels == target`` else 0 —
+    ``label::getOvrlabels``."""
+    return (jnp.asarray(labels) == target).astype(jnp.int32)
+
+
+def merge_labels(
+    res: Optional[Resources],
+    labels_a,
+    labels_b,
+    mask=None,
+) -> jax.Array:
+    """Merge two label assignments: rows sharing a label in either input
+    end up with one common (minimum) label — ``label::merge_labels``
+    (``merge_labels.cuh``; used to stitch connected components computed
+    in batches).
+
+    ``mask`` restricts which rows participate (unmasked rows keep
+    ``labels_a``).
+    """
+    la = jnp.asarray(labels_a, jnp.int32)
+    lb = jnp.asarray(labels_b, jnp.int32)
+    n = la.shape[0]
+    if mask is None:
+        mask = jnp.ones((n,), bool)
+
+    # representative per b-group: min a-label in the group; then propagate
+    # a→rep(a) links by pointer jumping until fixed point
+    n_groups = n  # b-labels are < n by construction in CC usage
+    rounds = max(1, int(np.ceil(np.log2(max(n, 2)))))
+
+    def body(_, lab):
+        grp_min = jax.ops.segment_min(
+            jnp.where(mask, lab, jnp.iinfo(jnp.int32).max),
+            jnp.where(mask, lb, n_groups - 1),
+            num_segments=n_groups,
+        )
+        new = jnp.where(mask, jnp.minimum(lab, jnp.take(grp_min, lb)), lab)
+        # chase a-labels: label of my label's row (labels index rows in CC)
+        chased = jnp.take(new, jnp.clip(new, 0, n - 1))
+        return jnp.where(mask, jnp.minimum(new, chased), new)
+
+    return jax.lax.fori_loop(0, rounds, body, la)

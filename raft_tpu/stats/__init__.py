@@ -1,0 +1,63 @@
+"""Statistics — TPU-native re-design of ``raft/stats/`` (28 headers,
+SURVEY.md §2.2): summary statistics plus ML evaluation metrics.
+
+The reference hand-writes a CUDA kernel per statistic; here each is a
+fused XLA expression (VPU reductions, one-hot MXU GEMMs for contingency
+/ grouped statistics), keeping the reference's free-function API shape.
+"""
+
+from raft_tpu.stats.summary import (
+    cov,
+    histogram,
+    mean,
+    mean_center,
+    minmax,
+    stddev,
+    sum_stat,
+    var,
+    weighted_mean,
+)
+from raft_tpu.stats.metrics import (
+    accuracy,
+    adjusted_rand_index,
+    completeness_score,
+    contingency_matrix,
+    dispersion,
+    entropy,
+    homogeneity_score,
+    information_criterion,
+    kl_divergence,
+    mutual_info_score,
+    r2_score,
+    rand_index,
+    silhouette_score,
+    trustworthiness,
+    v_measure,
+)
+
+__all__ = [
+    "cov",
+    "histogram",
+    "mean",
+    "mean_center",
+    "minmax",
+    "stddev",
+    "sum_stat",
+    "var",
+    "weighted_mean",
+    "accuracy",
+    "adjusted_rand_index",
+    "completeness_score",
+    "contingency_matrix",
+    "dispersion",
+    "entropy",
+    "homogeneity_score",
+    "information_criterion",
+    "kl_divergence",
+    "mutual_info_score",
+    "r2_score",
+    "rand_index",
+    "silhouette_score",
+    "trustworthiness",
+    "v_measure",
+]

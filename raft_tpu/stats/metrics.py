@@ -1,0 +1,279 @@
+"""ML evaluation metrics — analog of ``stats/accuracy.cuh``,
+``stats/r2_score.cuh``, ``stats/entropy.cuh``, ``stats/kl_divergence.cuh``,
+``stats/contingency_matrix.cuh``, ``stats/rand_index.cuh``,
+``stats/adjusted_rand_index.cuh``, ``stats/mutual_info_score.cuh``,
+``stats/homogeneity_score.cuh``, ``stats/completeness_score.cuh``,
+``stats/v_measure.cuh``, ``stats/silhouette_score.cuh``,
+``stats/trustworthiness_score.cuh``, ``stats/information_criterion.cuh``,
+``stats/dispersion.cuh``.
+
+Clustering-comparison metrics all flow through one contingency-matrix
+builder (a one-hot MXU GEMM) the way the reference funnels them through
+``contingencyMatrix``.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType
+
+_EPS = 1e-12
+
+
+def accuracy(res: Optional[Resources], predictions, labels):
+    """Fraction of exact matches — ``stats::accuracy``."""
+    return jnp.mean((predictions == labels).astype(jnp.float32))
+
+
+def r2_score(res: Optional[Resources], y, y_hat):
+    """Coefficient of determination — ``stats::r2_score``."""
+    y = y.astype(jnp.float32)
+    y_hat = y_hat.astype(jnp.float32)
+    ss_res = jnp.sum(jnp.square(y - y_hat))
+    ss_tot = jnp.sum(jnp.square(y - jnp.mean(y)))
+    return 1.0 - ss_res / jnp.maximum(ss_tot, _EPS)
+
+
+def entropy(res: Optional[Resources], labels, n_classes: int):
+    """Shannon entropy (nats) of a label set — ``stats::entropy``."""
+    counts = jnp.bincount(labels.astype(jnp.int32), length=n_classes)
+    p = counts / jnp.maximum(jnp.sum(counts), 1)
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, _EPS)), 0.0))
+
+
+def kl_divergence(res: Optional[Resources], p, q):
+    """KL(p ‖ q) over two distributions — ``stats::kl_divergence``."""
+    p = p.astype(jnp.float32)
+    q = q.astype(jnp.float32)
+    return jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.maximum(p, _EPS) /
+                                                jnp.maximum(q, _EPS)), 0.0))
+
+
+def contingency_matrix(
+    res: Optional[Resources],
+    labels_a,
+    labels_b,
+    n_classes_a: Optional[int] = None,
+    n_classes_b: Optional[int] = None,
+):
+    """(n_classes_a, n_classes_b) co-occurrence counts —
+    ``stats::contingencyMatrix``; one-hot GEMM instead of the reference's
+    shared-memory atomic kernels."""
+    la = labels_a.astype(jnp.int32)
+    lb = labels_b.astype(jnp.int32)
+    na = n_classes_a if n_classes_a is not None else int(jnp.max(la)) + 1
+    nb = n_classes_b if n_classes_b is not None else int(jnp.max(lb)) + 1
+    oa = jax.nn.one_hot(la, na, dtype=jnp.float32)
+    ob = jax.nn.one_hot(lb, nb, dtype=jnp.float32)
+    return jax.lax.dot_general(
+        oa, ob, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(jnp.int32)
+
+
+def _comb2(x):
+    x = x.astype(jnp.float32)
+    return x * (x - 1.0) / 2.0
+
+
+def rand_index(res: Optional[Resources], labels_a, labels_b):
+    """Rand index — ``stats::rand_index``."""
+    cm = contingency_matrix(res, labels_a, labels_b).astype(jnp.float32)
+    n = jnp.sum(cm)
+    sum_ij = jnp.sum(_comb2(cm))
+    sum_a = jnp.sum(_comb2(jnp.sum(cm, axis=1)))
+    sum_b = jnp.sum(_comb2(jnp.sum(cm, axis=0)))
+    total = _comb2(n)
+    return (total + 2.0 * sum_ij - sum_a - sum_b) / jnp.maximum(total, _EPS)
+
+
+def adjusted_rand_index(res: Optional[Resources], labels_a, labels_b):
+    """Adjusted Rand index — ``stats::adjusted_rand_index``."""
+    cm = contingency_matrix(res, labels_a, labels_b).astype(jnp.float32)
+    n = jnp.sum(cm)
+    sum_ij = jnp.sum(_comb2(cm))
+    sum_a = jnp.sum(_comb2(jnp.sum(cm, axis=1)))
+    sum_b = jnp.sum(_comb2(jnp.sum(cm, axis=0)))
+    total = jnp.maximum(_comb2(n), _EPS)
+    expected = sum_a * sum_b / total
+    max_index = 0.5 * (sum_a + sum_b)
+    return (sum_ij - expected) / jnp.maximum(max_index - expected, _EPS)
+
+
+def mutual_info_score(res: Optional[Resources], labels_a, labels_b):
+    """Mutual information (nats) — ``stats::mutual_info_score``."""
+    cm = contingency_matrix(res, labels_a, labels_b).astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(cm), 1.0)
+    p_ij = cm / n
+    p_a = jnp.sum(p_ij, axis=1, keepdims=True)
+    p_b = jnp.sum(p_ij, axis=0, keepdims=True)
+    ratio = p_ij / jnp.maximum(p_a * p_b, _EPS)
+    return jnp.sum(jnp.where(p_ij > 0,
+                             p_ij * jnp.log(jnp.maximum(ratio, _EPS)), 0.0))
+
+
+def homogeneity_score(res: Optional[Resources], labels_true, labels_pred,
+                      n_classes: Optional[int] = None):
+    """``stats::homogeneity_score``: 1 - H(C|K)/H(C)."""
+    nc = n_classes or int(jnp.max(labels_true)) + 1
+    mi = mutual_info_score(res, labels_true, labels_pred)
+    h_c = entropy(res, labels_true, nc)
+    return jnp.where(h_c > _EPS, mi / jnp.maximum(h_c, _EPS), 1.0)
+
+
+def completeness_score(res: Optional[Resources], labels_true, labels_pred,
+                       n_classes: Optional[int] = None):
+    """``stats::completeness_score``: 1 - H(K|C)/H(K)."""
+    nk = n_classes or int(jnp.max(labels_pred)) + 1
+    mi = mutual_info_score(res, labels_true, labels_pred)
+    h_k = entropy(res, labels_pred, nk)
+    return jnp.where(h_k > _EPS, mi / jnp.maximum(h_k, _EPS), 1.0)
+
+
+def v_measure(res: Optional[Resources], labels_true, labels_pred,
+              beta: float = 1.0):
+    """``stats::v_measure``: weighted harmonic mean of homogeneity and
+    completeness."""
+    h = homogeneity_score(res, labels_true, labels_pred)
+    c = completeness_score(res, labels_true, labels_pred)
+    return jnp.where(h + c > _EPS,
+                     (1 + beta) * h * c / jnp.maximum(beta * h + c, _EPS),
+                     0.0)
+
+
+def silhouette_score(
+    res: Optional[Resources],
+    x,
+    labels,
+    n_clusters: Optional[int] = None,
+    metric: DistanceType = DistanceType.L2SqrtExpanded,
+    *,
+    tile: int = 4096,
+):
+    """Mean silhouette coefficient — ``stats::silhouette_score`` (and its
+    ``batched::`` variant: ``tile`` bounds the distance buffer at
+    ``tile × n``, the reference's chunking knob).
+
+    Per-sample mean distance to every cluster is one distance-tile ×
+    one-hot GEMM; a(i)/b(i) then come from the (tile, n_clusters) matrix.
+    """
+    from raft_tpu.distance.pairwise import _pairwise_distance_impl
+
+    res = ensure_resources(res)
+    x = jnp.asarray(x)
+    labels = jnp.asarray(labels, jnp.int32)
+    n = x.shape[0]
+    k = n_clusters or int(jnp.max(labels)) + 1
+    expect(k >= 2, "silhouette_score requires >= 2 clusters")
+    onehot = jax.nn.one_hot(labels, k, dtype=jnp.float32)   # (n, k)
+    counts = jnp.sum(onehot, axis=0)                        # (k,)
+
+    scores = []
+    for start in range(0, n, tile):
+        stop = min(start + tile, n)
+        d = _pairwise_distance_impl(x[start:stop], x, metric, 2.0, "highest")
+        # sum distance from each row to every cluster: (t, n) @ (n, k)
+        sums = d @ onehot
+        lt = labels[start:stop]
+        own = counts[lt]                                     # cluster sizes
+        own_sum = jnp.take_along_axis(sums, lt[:, None], axis=1)[:, 0]
+        a = own_sum / jnp.maximum(own - 1.0, 1.0)            # excl. self (d=0)
+        other_mean = sums / jnp.maximum(counts[None, :], 1.0)
+        other_mean = jnp.where(
+            jax.nn.one_hot(lt, k, dtype=bool), jnp.inf, other_mean)
+        b = jnp.min(other_mean, axis=1)
+        s = (b - a) / jnp.maximum(jnp.maximum(a, b), _EPS)
+        s = jnp.where(own <= 1.0, 0.0, s)  # singleton convention
+        scores.append(s)
+    return jnp.mean(jnp.concatenate(scores))
+
+
+def trustworthiness(
+    res: Optional[Resources],
+    x,
+    x_embedded,
+    k: int,
+    metric: DistanceType = DistanceType.L2SqrtExpanded,
+):
+    """Trustworthiness of an embedding — ``stats::trustworthiness_score``:
+    penalizes embedded-space neighbors that are far in the original space
+    by their original-space rank."""
+    from raft_tpu.distance.pairwise import _pairwise_distance_impl
+
+    res = ensure_resources(res)
+    x = jnp.asarray(x)
+    xe = jnp.asarray(x_embedded)
+    n = x.shape[0]
+    expect(k < n / 2, "trustworthiness: k must be < n/2")
+
+    d_orig = _pairwise_distance_impl(x, x, metric, 2.0, "highest")
+    d_emb = _pairwise_distance_impl(xe, xe, metric, 2.0, "highest")
+    eye = jnp.eye(n, dtype=bool)
+    d_orig = jnp.where(eye, jnp.inf, d_orig)
+    d_emb = jnp.where(eye, jnp.inf, d_emb)
+
+    # original-space rank of every pair (0 = nearest)
+    order_orig = jnp.argsort(d_orig, axis=1)
+    ranks = jnp.zeros((n, n), jnp.int32)
+    ranks = jax.vmap(
+        lambda r, o: r.at[o].set(jnp.arange(n, dtype=jnp.int32))
+    )(ranks, order_orig)
+
+    # k nearest in embedded space
+    _, nn_emb = jax.lax.top_k(-d_emb, k)
+    r = jnp.take_along_axis(ranks, nn_emb, axis=1)          # (n, k)
+    penalty = jnp.sum(jnp.maximum(r - k + 1, 0).astype(jnp.float32))
+    norm = 2.0 / (n * k * (2.0 * n - 3.0 * k - 1.0))
+    return 1.0 - norm * penalty
+
+
+class ICType(enum.IntEnum):
+    """``stats::IC_Type`` (``stats/information_criterion.cuh``)."""
+
+    AIC = 0
+    AICc = 1
+    BIC = 2
+
+
+def information_criterion(
+    res: Optional[Resources],
+    log_likelihood,
+    ic_type: ICType,
+    n_params: int,
+    n_samples: int,
+):
+    """Batched AIC/AICc/BIC — ``stats::information_criterion_batched``."""
+    ll = jnp.asarray(log_likelihood, jnp.float32)
+    d = float(n_params)
+    n = float(n_samples)
+    if ic_type == ICType.AIC:
+        pen = 2.0 * d
+    elif ic_type == ICType.AICc:
+        pen = 2.0 * d + 2.0 * d * (d + 1.0) / max(n - d - 1.0, 1e-6)
+    elif ic_type == ICType.BIC:
+        pen = jnp.log(n) * d
+    else:
+        raise ValueError(f"unknown IC type: {ic_type}")
+    return -2.0 * ll + pen
+
+
+def dispersion(
+    res: Optional[Resources],
+    centroids,
+    cluster_sizes,
+    global_centroid=None,
+):
+    """Cluster dispersion sqrt(Σ_c n_c ‖μ_c − μ‖²) — ``stats::dispersion``
+    (used by kmeans ``find_k``)."""
+    c = centroids.astype(jnp.float32)
+    sz = cluster_sizes.astype(jnp.float32)
+    if global_centroid is None:
+        global_centroid = (sz @ c) / jnp.maximum(jnp.sum(sz), 1.0)
+    d2 = jnp.sum(jnp.square(c - global_centroid[None, :]), axis=1)
+    return jnp.sqrt(jnp.sum(sz * d2))

@@ -1,0 +1,122 @@
+"""Summary statistics — analog of ``stats/mean.cuh``, ``stats/var.cuh``,
+``stats/stddev.cuh``, ``stats/cov.cuh``, ``stats/histogram.cuh``,
+``stats/minmax.cuh``, ``stats/weighted_mean.cuh``, ``stats/sum.cuh``,
+``stats/mean_center.cuh``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.resources import Resources
+from raft_tpu.core.validation import expect
+
+
+def mean(res: Optional[Resources], data, *, along_rows: bool = False):
+    """Column means by default (``stats::mean`` reduces over rows of a
+    column-major sample matrix; samples are rows here)."""
+    axis = 1 if along_rows else 0
+    return jnp.mean(data.astype(jnp.float32), axis=axis)
+
+
+def sum_stat(res: Optional[Resources], data, *, along_rows: bool = False):
+    """``stats::sum``."""
+    axis = 1 if along_rows else 0
+    return jnp.sum(data.astype(jnp.float32), axis=axis)
+
+
+def var(res: Optional[Resources], data, mu=None, *, sample: bool = True):
+    """Column variances (``stats::vars``); ``sample=True`` → N-1 norm."""
+    x = data.astype(jnp.float32)
+    if mu is None:
+        mu = jnp.mean(x, axis=0)
+    n = x.shape[0]
+    denom = max(n - 1, 1) if sample else n
+    return jnp.sum(jnp.square(x - mu[None, :]), axis=0) / denom
+
+
+def stddev(res: Optional[Resources], data, mu=None, *, sample: bool = True):
+    """``stats::stddev``."""
+    return jnp.sqrt(var(res, data, mu, sample=sample))
+
+
+def mean_center(res: Optional[Resources], data, mu=None):
+    """``stats::meanCenter``: subtract column means."""
+    x = data.astype(jnp.float32)
+    if mu is None:
+        mu = jnp.mean(x, axis=0)
+    return x - mu[None, :]
+
+
+def cov(
+    res: Optional[Resources],
+    data,
+    mu=None,
+    *,
+    sample: bool = True,
+):
+    """Covariance matrix of row-sample data — ``stats::cov``
+    (``stats/cov.cuh``): one centered MXU GEMM."""
+    x = mean_center(res, data, mu)
+    n = x.shape[0]
+    denom = max(n - 1, 1) if sample else n
+    return jax.lax.dot_general(
+        x, x, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) / denom
+
+
+def histogram(
+    res: Optional[Resources],
+    data,
+    n_bins: int,
+    *,
+    lo: Optional[float] = None,
+    hi: Optional[float] = None,
+):
+    """Per-column histograms — ``stats::histogram``
+    (``stats/histogram.cuh``). Returns ``(n_bins, n_cols)`` int32 counts.
+
+    The reference offers many binning strategies tuned for GPU shared
+    memory; one bucketed one-hot reduction covers them on TPU.
+    """
+    x = data.astype(jnp.float32)
+    if x.ndim == 1:
+        x = x[:, None]
+    lo_v = jnp.min(x) if lo is None else lo
+    hi_v = jnp.max(x) if hi is None else hi
+    width = jnp.maximum((hi_v - lo_v) / n_bins, 1e-30)
+    idx = jnp.clip(((x - lo_v) / width).astype(jnp.int32), 0, n_bins - 1)
+    onehot = jax.nn.one_hot(idx, n_bins, dtype=jnp.int32, axis=0)  # (bins, n, c)
+    return jnp.sum(onehot, axis=1)
+
+
+def minmax(
+    res: Optional[Resources], data
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-column (min, max) — ``stats::minmax`` (``stats/minmax.cuh``)."""
+    return jnp.min(data, axis=0), jnp.max(data, axis=0)
+
+
+def weighted_mean(
+    res: Optional[Resources],
+    data,
+    weights,
+    *,
+    along_rows: bool = True,
+):
+    """Weighted mean — ``stats::rowWeightedMean`` / ``colWeightedMean``.
+
+    ``along_rows=True`` averages within each row with one weight per
+    column (the reference's row-weighted-mean), producing one value per
+    row."""
+    x = data.astype(jnp.float32)
+    w = weights.astype(jnp.float32)
+    wsum = jnp.maximum(jnp.sum(w), 1e-30)
+    if along_rows:
+        expect(w.shape[0] == x.shape[1], "weighted_mean: |weights| != n_cols")
+        return x @ w / wsum
+    expect(w.shape[0] == x.shape[0], "weighted_mean: |weights| != n_rows")
+    return w @ x / wsum

@@ -1,0 +1,231 @@
+"""stats + label tests — sklearn/scipy cross-checks, the reference's
+``python/pylibraft/pylibraft/test`` pattern (SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import sklearn.metrics as skm
+
+from raft_tpu import label, stats
+from raft_tpu.stats.metrics import ICType
+
+
+class TestSummary:
+    def test_mean_var_stddev(self, rng_np, res):
+        x = rng_np.standard_normal((50, 7)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(stats.mean(res, x)), x.mean(axis=0), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats.var(res, x)), x.var(axis=0, ddof=1), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats.stddev(res, x)), x.std(axis=0, ddof=1), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats.sum_stat(res, x, along_rows=True)),
+            x.sum(axis=1),
+            rtol=1e-4,
+        )
+
+    def test_cov(self, rng_np, res):
+        x = rng_np.standard_normal((100, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(stats.cov(res, x)), np.cov(x, rowvar=False), rtol=1e-3, atol=1e-4
+        )
+
+    def test_mean_center(self, rng_np, res):
+        x = rng_np.standard_normal((20, 4)).astype(np.float32)
+        out = np.asarray(stats.mean_center(res, x))
+        np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-5)
+
+    def test_histogram(self, rng_np, res):
+        x = rng_np.uniform(0, 1, (200, 3)).astype(np.float32)
+        h = np.asarray(stats.histogram(res, x, 10, lo=0.0, hi=1.0))
+        assert h.shape == (10, 3)
+        np.testing.assert_array_equal(h.sum(axis=0), 200)
+        for c in range(3):
+            want, _ = np.histogram(x[:, c], bins=10, range=(0, 1))
+            np.testing.assert_array_equal(h[:, c], want)
+
+    def test_minmax(self, rng_np, res):
+        x = rng_np.standard_normal((30, 4)).astype(np.float32)
+        mn, mx = stats.minmax(res, x)
+        np.testing.assert_allclose(np.asarray(mn), x.min(axis=0))
+        np.testing.assert_allclose(np.asarray(mx), x.max(axis=0))
+
+    def test_weighted_mean(self, rng_np, res):
+        x = rng_np.standard_normal((12, 6)).astype(np.float32)
+        w = rng_np.uniform(0.1, 1.0, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(stats.weighted_mean(res, x, w, along_rows=True)),
+            (x * w).sum(axis=1) / w.sum(),
+            rtol=1e-5,
+        )
+        w2 = rng_np.uniform(0.1, 1.0, 12).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(stats.weighted_mean(res, x, w2, along_rows=False)),
+            (x * w2[:, None]).sum(axis=0) / w2.sum(),
+            rtol=1e-5,
+        )
+
+
+class TestRegressionMetrics:
+    def test_accuracy(self, rng_np, res):
+        y = rng_np.integers(0, 3, 100)
+        p = y.copy()
+        p[:25] = (p[:25] + 1) % 3
+        np.testing.assert_allclose(np.asarray(stats.accuracy(res, p, y)), 0.75)
+
+    def test_r2(self, rng_np, res):
+        y = rng_np.standard_normal(80).astype(np.float32)
+        yh = y + 0.1 * rng_np.standard_normal(80).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(stats.r2_score(res, y, yh)),
+            skm.r2_score(y, yh),
+            rtol=1e-3,
+        )
+
+
+class TestClusteringMetrics:
+    @pytest.fixture
+    def two_labelings(self, rng_np):
+        a = rng_np.integers(0, 4, 300)
+        b = a.copy()
+        flip = rng_np.random(300) < 0.2
+        b[flip] = rng_np.integers(0, 4, int(flip.sum()))
+        return a, b
+
+    def test_contingency(self, two_labelings, res):
+        a, b = two_labelings
+        cm = np.asarray(stats.contingency_matrix(res, jnp.asarray(a), jnp.asarray(b)))
+        want = skm.cluster.contingency_matrix(a, b)
+        np.testing.assert_array_equal(cm, want)
+
+    def test_rand_index(self, two_labelings, res):
+        a, b = two_labelings
+        # sklearn's rand_score is the same unadjusted RI
+        np.testing.assert_allclose(
+            np.asarray(stats.rand_index(res, jnp.asarray(a), jnp.asarray(b))),
+            skm.rand_score(a, b),
+            rtol=1e-5,
+        )
+
+    def test_adjusted_rand_index(self, two_labelings, res):
+        a, b = two_labelings
+        np.testing.assert_allclose(
+            np.asarray(stats.adjusted_rand_index(res, jnp.asarray(a), jnp.asarray(b))),
+            skm.adjusted_rand_score(a, b),
+            rtol=1e-4,
+        )
+
+    def test_mutual_info(self, two_labelings, res):
+        a, b = two_labelings
+        np.testing.assert_allclose(
+            np.asarray(stats.mutual_info_score(res, jnp.asarray(a), jnp.asarray(b))),
+            skm.mutual_info_score(a, b),
+            rtol=1e-4,
+        )
+
+    def test_homogeneity_completeness_v_measure(self, two_labelings, res):
+        a, b = two_labelings
+        h, c, v = skm.homogeneity_completeness_v_measure(a, b)
+        np.testing.assert_allclose(
+            np.asarray(stats.homogeneity_score(res, jnp.asarray(a), jnp.asarray(b))),
+            h,
+            rtol=1e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats.completeness_score(res, jnp.asarray(a), jnp.asarray(b))),
+            c,
+            rtol=1e-3,
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats.v_measure(res, jnp.asarray(a), jnp.asarray(b))),
+            v,
+            rtol=1e-3,
+        )
+
+    def test_entropy(self, res):
+        labels = jnp.asarray([0, 0, 1, 1])
+        np.testing.assert_allclose(
+            np.asarray(stats.entropy(res, labels, 2)), np.log(2), rtol=1e-5
+        )
+
+    def test_kl(self, res):
+        p = jnp.asarray([0.5, 0.5])
+        q = jnp.asarray([0.9, 0.1])
+        want = 0.5 * np.log(0.5 / 0.9) + 0.5 * np.log(0.5 / 0.1)
+        np.testing.assert_allclose(np.asarray(stats.kl_divergence(res, p, q)), want, rtol=1e-5)
+
+    def test_silhouette(self, rng_np, res):
+        from sklearn.datasets import make_blobs
+
+        x, y = make_blobs(n_samples=200, centers=4, n_features=8, random_state=0)
+        x = x.astype(np.float32)
+        got = np.asarray(stats.silhouette_score(res, x, jnp.asarray(y)))
+        want = skm.silhouette_score(x, y)
+        np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-3)
+
+    def test_silhouette_batched_matches(self, rng_np, res):
+        from sklearn.datasets import make_blobs
+
+        x, y = make_blobs(n_samples=150, centers=3, n_features=5, random_state=1)
+        x = x.astype(np.float32)
+        full = np.asarray(stats.silhouette_score(res, x, jnp.asarray(y)))
+        tiled = np.asarray(stats.silhouette_score(res, x, jnp.asarray(y), tile=37))
+        np.testing.assert_allclose(tiled, full, rtol=1e-5)
+
+    def test_trustworthiness(self, rng_np, res):
+        from sklearn.manifold import trustworthiness as sk_trust
+
+        x = rng_np.standard_normal((100, 10)).astype(np.float32)
+        xe = x[:, :2] + 0.01 * rng_np.standard_normal((100, 2)).astype(np.float32)
+        got = np.asarray(stats.trustworthiness(res, x, xe, 5))
+        want = sk_trust(x, xe, n_neighbors=5)
+        np.testing.assert_allclose(got, want, rtol=1e-2)
+
+    def test_information_criterion(self, res):
+        ll = jnp.asarray([-100.0, -200.0])
+        aic = np.asarray(stats.information_criterion(res, ll, ICType.AIC, 3, 50))
+        np.testing.assert_allclose(aic, [206.0, 406.0])
+        bic = np.asarray(stats.information_criterion(res, ll, ICType.BIC, 3, 50))
+        np.testing.assert_allclose(bic, -2 * np.asarray(ll) + 3 * np.log(50), rtol=1e-6)
+
+    def test_dispersion(self, res):
+        centroids = jnp.asarray([[0.0, 0.0], [2.0, 0.0]])
+        sizes = jnp.asarray([10, 10])
+        # global centroid (1,0); each center at distance 1 → sqrt(20)
+        np.testing.assert_allclose(
+            np.asarray(stats.dispersion(res, centroids, sizes)),
+            np.sqrt(20.0),
+            rtol=1e-5,
+        )
+
+
+class TestLabel:
+    def test_unique_and_monotonic(self, res):
+        labels = jnp.asarray([10, 20, 10, 99, 20])
+        u = np.asarray(label.get_unique_labels(res, labels))
+        np.testing.assert_array_equal(u, [10, 20, 99])
+        m = np.asarray(label.make_monotonic(res, labels))
+        np.testing.assert_array_equal(m, [0, 1, 0, 2, 1])
+
+    def test_ovr(self, res):
+        labels = jnp.asarray([1, 2, 1, 3])
+        np.testing.assert_array_equal(
+            np.asarray(label.ovr_labels(res, labels, 1)), [1, 0, 1, 0]
+        )
+
+    def test_merge_labels(self, res):
+        # two batches of connected components: rows 0-2 labeled {0,0,2} in a,
+        # rows 2-4 share group in b → all five rows should collapse to min
+        la = jnp.asarray([0, 0, 2, 3, 3])
+        lb = jnp.asarray([0, 1, 1, 1, 2])  # b links rows 1,2,3 together
+        merged = np.asarray(label.merge_labels(res, la, lb))
+        # rows 1,2,3 share b-group → min label 0 (via row1's a-label 0);
+        # row 0 shares a-label with row 1 → 0; row 4 shares a-label 3 with row 3
+        assert merged[0] == merged[1] == merged[2] == merged[3]
+        # row 4 linked to row 3 only through a-label 3; merge_labels merges
+        # via b-groups, a-continuity handled by chasing
+        assert merged.min() == 0

@@ -4,6 +4,7 @@ from raft_tpu.cluster import kmeans
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans import KMeansParams, InitMethod
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu.cluster.single_linkage import SingleLinkageOutput, single_linkage
 
 __all__ = [
     "kmeans",
@@ -11,4 +12,6 @@ __all__ = [
     "KMeansParams",
     "InitMethod",
     "KMeansBalancedParams",
+    "SingleLinkageOutput",
+    "single_linkage",
 ]

@@ -1,0 +1,142 @@
+"""Single-linkage agglomerative clustering — analog of
+``cluster/single_linkage.cuh`` + ``cluster/detail/{mst,connectivities,
+agglomerative}.cuh``: kNN-graph connectivity → MST → dendrogram → flat cut.
+
+TPU re-design: graph construction, symmetrization and Borůvka MST run as
+static-shape XLA programs (``raft_tpu.sparse``); the O(n) dendrogram
+build is an inherently sequential union-find over the n-1 sorted MST
+edges and runs on host (the reference also label-propagates on a serial
+dependency chain there — it is not a hot loop).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType
+
+
+@dataclasses.dataclass
+class SingleLinkageOutput:
+    """``linkage_output`` analog (``cluster/single_linkage_types.hpp``)."""
+
+    labels: np.ndarray        # (n,) flat cluster assignment
+    children: np.ndarray      # (n-1, 2) merged pair per dendrogram step
+    deltas: np.ndarray        # (n-1,) merge distances
+    sizes: np.ndarray         # (n-1,) size of the merged cluster
+    n_clusters: int
+
+
+def _mst_edges_connected(res, x, k, metric):
+    """kNN-graph MST; reconnects forest components with
+    cross_component_nn edges until a single tree remains (the reference's
+    connect_components loop in ``detail/mst.cuh``)."""
+    from raft_tpu.sparse.linalg import coo_symmetrize
+    from raft_tpu.sparse.convert import coo_to_csr
+    from raft_tpu.sparse.neighbors import cross_component_nn, knn_graph
+    from raft_tpu.sparse.solver import mst
+    from raft_tpu.sparse.types import COO
+
+    n = x.shape[0]
+    g = knn_graph(res, x, k, metric)
+    for _ in range(int(np.ceil(np.log2(max(n, 2)))) + 1):
+        sym = coo_symmetrize(g)
+        result = mst(res, coo_to_csr(sym))
+        color = np.asarray(result.color)
+        if len(np.unique(color)) == 1:
+            return result
+        extra = cross_component_nn(res, x, jnp.asarray(color), metric)
+        g = COO(
+            jnp.concatenate([g.rows, extra.rows]),
+            jnp.concatenate([g.cols, extra.cols]),
+            jnp.concatenate([g.vals, extra.vals]),
+            (n, n),
+        )
+    raise RuntimeError("single_linkage: could not connect kNN graph")
+
+
+def single_linkage(
+    res: Optional[Resources],
+    x,
+    n_clusters: int,
+    *,
+    metric: DistanceType = DistanceType.L2SqrtExpanded,
+    k: int = 15,
+) -> SingleLinkageOutput:
+    """Flat single-linkage clustering — ``cluster::single_linkage``
+    (``single_linkage.cuh``; the reference's KNN-graph 'connectivity'
+    mode with ``c``-neighborhood = k)."""
+    res = ensure_resources(res)
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    expect(1 <= n_clusters <= n, "single_linkage: bad n_clusters")
+
+    with tracing.range("raft_tpu.cluster.single_linkage"):
+        result = _mst_edges_connected(res, x, k, metric)
+        src = np.asarray(result.src)
+        dst = np.asarray(result.dst)
+        w = np.asarray(result.weights)
+        valid = src >= 0
+        src, dst, w = src[valid], dst[valid], w[valid]
+        order = np.argsort(w, kind="stable")
+        src, dst, w = src[order], dst[order], w[order]
+        expect(len(src) == n - 1, "single_linkage: MST is not a tree")
+
+        # sequential union-find dendrogram (scipy 'children' convention:
+        # cluster ids >= n denote merged clusters, id = n + step)
+        parent = np.arange(2 * n - 1)
+        cluster_of = np.arange(n)           # current cluster id per root
+        size = np.ones(2 * n - 1, dtype=np.int64)
+
+        def find(a):
+            root = a
+            while parent[root] != root:
+                root = parent[root]
+            while parent[a] != root:
+                parent[a], a = root, parent[a]
+            return root
+
+        children = np.zeros((n - 1, 2), dtype=np.int64)
+        sizes = np.zeros(n - 1, dtype=np.int64)
+        for step in range(n - 1):
+            ra, rb = find(src[step]), find(dst[step])
+            ca, cb = cluster_of[ra], cluster_of[rb]
+            new_id = n + step
+            children[step] = (min(ca, cb), max(ca, cb))
+            parent[ra] = parent[rb] = new_id
+            cluster_of = np.append(cluster_of, 0)  # grown lazily below
+            size[new_id] = size[ra] + size[rb]
+            sizes[step] = size[new_id]
+            cluster_of = cluster_of[: 2 * n - 1]
+            cluster_of[new_id] = new_id
+
+        # flat cut: drop the n_clusters-1 largest merges
+        keep = n - 1 - (n_clusters - 1)
+        parent2 = np.arange(n)
+
+        def find2(a):
+            while parent2[a] != a:
+                parent2[a] = parent2[parent2[a]]
+                a = parent2[a]
+            return a
+
+        for step in range(keep):
+            ra, rb = find2(src[step]), find2(dst[step])
+            parent2[ra] = rb
+        roots = np.array([find2(i) for i in range(n)])
+        _, labels = np.unique(roots, return_inverse=True)
+        return SingleLinkageOutput(
+            labels=labels.astype(np.int32),
+            children=children,
+            deltas=w,
+            sizes=sizes,
+            n_clusters=n_clusters,
+        )

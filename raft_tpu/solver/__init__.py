@@ -1,0 +1,7 @@
+"""Combinatorial solvers — analog of ``raft/solver/`` / ``raft/lap/``
+(``solver/linear_assignment.cuh``, the Date–Nagi GPU Hungarian variant).
+"""
+
+from raft_tpu.solver.lap import LinearAssignmentProblem, linear_assignment
+
+__all__ = ["LinearAssignmentProblem", "linear_assignment"]

@@ -1,0 +1,146 @@
+"""Linear assignment (LAP) — analog of ``solver::LinearAssignmentProblem``
+(``solver/linear_assignment.cuh``), the batched Date–Nagi Hungarian
+solver.
+
+TPU re-design: the Hungarian algorithm's zero-cover phases are
+pointer-chasing-heavy; the **auction algorithm** (Bertsekas) reaches the
+same optimum through dense, data-parallel bidding rounds — every round
+is a (n, n) matrix of values, a top-2 reduction per row, and a
+segment-max per column: pure VPU/MXU shapes inside one
+``lax.while_loop``. ε-scaling gives the standard optimality guarantee
+(exact for integer costs when ε < 1/n; within n·ε otherwise). Batched
+over problem instances with ``vmap`` exactly like the reference's
+batched API.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+
+_NEG = -1e30
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+def _auction_phase(benefit, prices, eps, max_iter: int):
+    """One ε-phase of the auction: bid until all rows are assigned."""
+    n = benefit.shape[0]
+
+    def cond(state):
+        assign_row, _, _, it = state
+        return (it < max_iter) & jnp.any(assign_row < 0)
+
+    def body(state):
+        assign_row, owner_col, prices, it = state
+        unassigned = assign_row < 0                       # (n,)
+        vals = benefit - prices[None, :]                  # (n, n)
+        top2, top2_idx = jax.lax.top_k(vals, 2)
+        w1, w2 = top2[:, 0], top2[:, 1]
+        jstar = top2_idx[:, 0]
+        bid = prices[jstar] + (w1 - w2) + eps             # (n,)
+
+        # column-wise max over bidders (one-hot scatter of bids)
+        onehot = jax.nn.one_hot(jstar, n, dtype=jnp.float32)
+        bids = jnp.where(unassigned[:, None], onehot * bid[:, None]
+                         + (1.0 - onehot) * _NEG, _NEG)   # (n, n)
+        col_best = jnp.max(bids, axis=0)                  # (n,)
+        col_winner = jnp.argmax(bids, axis=0)             # (n,)
+        has_bid = col_best > _NEG / 2
+
+        prices = jnp.where(has_bid, col_best, prices)
+        # unassign previous owners of re-auctioned columns (dummy index n
+        # + mode="drop" so no-bid columns cannot clobber row 0)
+        prev_owner = jnp.where(has_bid, owner_col, -1)
+        lost = jnp.zeros((n,), bool).at[
+            jnp.where(prev_owner >= 0, prev_owner, n)
+        ].set(True, mode="drop")
+        assign_row = jnp.where(lost, -1, assign_row)
+        owner_col = jnp.where(has_bid, col_winner, owner_col)
+        # winners take their columns
+        assign_row = assign_row.at[
+            jnp.where(has_bid, col_winner, n)
+        ].set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+        return assign_row, owner_col, prices, it + 1
+
+    assign0 = jnp.full((n,), -1, jnp.int32)
+    owner0 = jnp.full((n,), -1, jnp.int32)
+    assign, owner, prices, _ = jax.lax.while_loop(
+        cond, body, (assign0, owner0, prices, jnp.int32(0))
+    )
+    return assign, prices
+
+
+def linear_assignment(
+    res: Optional[Resources],
+    cost,
+    *,
+    maximize: bool = False,
+    eps_scaling_factor: float = 4.0,
+    max_iter_per_phase: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Solve min-cost (or max-benefit) one-to-one assignment on a square
+    cost matrix — the ``LinearAssignmentProblem::solve`` API.
+
+    Returns (row_assignment, total_cost) where ``row_assignment[i]`` is
+    the column assigned to row i.
+    """
+    ensure_resources(res)
+    cost = jnp.asarray(cost, jnp.float32)
+    expect(cost.ndim == 2 and cost.shape[0] == cost.shape[1],
+           "linear_assignment expects a square cost matrix")
+    n = cost.shape[0]
+    benefit = cost if maximize else -cost
+    max_iter = max_iter_per_phase or (50 * n + 1000)
+
+    with tracing.range("raft_tpu.solver.lap"):
+        # ε-scaling: from max|benefit|/2 down past 1/(n+1)
+        spread = float(jnp.max(jnp.abs(benefit)))
+        eps = max(spread / 2.0, 1.0 / (n + 1))
+        prices = jnp.zeros((n,), jnp.float32)
+        assign = jnp.full((n,), -1, jnp.int32)
+        while True:
+            assign, prices = _auction_phase(benefit, prices,
+                                            jnp.float32(eps), max_iter)
+            if eps <= 1.0 / (n + 1):
+                break
+            eps = max(eps / eps_scaling_factor, 1.0 / (n + 1))
+        total = jnp.sum(jnp.take_along_axis(cost, assign[:, None], 1)[:, 0])
+        return assign, total
+
+
+class LinearAssignmentProblem:
+    """Object API mirroring ``solver::LinearAssignmentProblem``
+    (``solver/linear_assignment.cuh``): batched solve with accessors."""
+
+    def __init__(self, res: Optional[Resources], size: int,
+                 batch_size: int = 1):
+        self._res = ensure_resources(res)
+        self.size = size
+        self.batch_size = batch_size
+        self._assignments = None
+        self._costs = None
+
+    def solve(self, cost_batch):
+        """cost_batch: (batch, n, n) or (n, n)."""
+        cost_batch = jnp.asarray(cost_batch, jnp.float32)
+        if cost_batch.ndim == 2:
+            cost_batch = cost_batch[None]
+        outs = [linear_assignment(self._res, c) for c in cost_batch]
+        self._assignments = jnp.stack([a for a, _ in outs])
+        self._costs = jnp.stack([c for _, c in outs])
+        return self._assignments
+
+    @property
+    def row_assignments(self):
+        return self._assignments
+
+    @property
+    def objective_values(self):
+        return self._costs

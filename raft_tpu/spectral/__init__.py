@@ -1,0 +1,20 @@
+"""Spectral clustering & graph partitioning — analog of ``raft/spectral/``
+(``partition.cuh``, ``modularity_maximization.cuh``, ``eigen_solvers.cuh``,
+``cluster_solvers.cuh``).
+"""
+
+from raft_tpu.spectral.partition import (
+    analyze_partition,
+    fit_embedding,
+    modularity,
+    modularity_maximization,
+    partition,
+)
+
+__all__ = [
+    "analyze_partition",
+    "fit_embedding",
+    "modularity",
+    "modularity_maximization",
+    "partition",
+]

@@ -1,0 +1,128 @@
+"""single-linkage / spectral / LAP tests (reference ``cpp/test/cluster``,
+``cpp/test/sparse/spectral_matrix``, ``cpp/test/lap``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.optimize
+import sklearn.metrics as skm
+from sklearn.datasets import make_blobs
+
+from raft_tpu.cluster.single_linkage import single_linkage
+from raft_tpu.solver import LinearAssignmentProblem, linear_assignment
+from raft_tpu import spectral
+from raft_tpu.sparse.types import CSR
+
+
+class TestSingleLinkage:
+    def test_blobs_recovery(self, res):
+        x, y = make_blobs(
+            n_samples=200, centers=4, n_features=8, cluster_std=0.4, random_state=0
+        )
+        out = single_linkage(res, x.astype(np.float32), 4)
+        assert out.labels.shape == (200,)
+        assert len(np.unique(out.labels)) == 4
+        assert skm.adjusted_rand_score(y, out.labels) > 0.95
+
+    def test_matches_sklearn_moons(self, res):
+        # non-convex shapes: exactly where single-linkage beats kmeans
+        from sklearn.datasets import make_moons
+        from sklearn.cluster import AgglomerativeClustering
+
+        x, y = make_moons(n_samples=150, noise=0.04, random_state=0)
+        out = single_linkage(res, x.astype(np.float32), 2, k=10)
+        sk = AgglomerativeClustering(n_clusters=2, linkage="single").fit(x)
+        assert skm.adjusted_rand_score(sk.labels_, out.labels) > 0.95
+
+    def test_dendrogram_shape(self, res):
+        x, _ = make_blobs(n_samples=40, centers=3, n_features=4, random_state=1)
+        out = single_linkage(res, x.astype(np.float32), 3)
+        assert out.children.shape == (39, 2)
+        assert out.deltas.shape == (39,)
+        # merge distances ascend (single linkage over sorted MST edges)
+        assert np.all(np.diff(out.deltas) >= -1e-6)
+
+
+def _two_cliques_csr(n_half=10, p_bridge=1):
+    """Two dense cliques joined by a single bridge edge."""
+    n = 2 * n_half
+    a = np.zeros((n, n), np.float32)
+    a[:n_half, :n_half] = 1.0
+    a[n_half:, n_half:] = 1.0
+    np.fill_diagonal(a, 0.0)
+    a[0, n_half] = a[n_half, 0] = 1.0
+    return CSR.from_dense(a), n
+
+
+class TestSpectral:
+    def test_partition_two_cliques(self, res):
+        adj, n = _two_cliques_csr()
+        labels, evals, emb = spectral.partition(res, adj, 2, seed=3)
+        labels = np.asarray(labels)
+        want = np.array([0] * 10 + [1] * 10)
+        assert skm.adjusted_rand_score(want, labels) == 1.0
+
+    def test_analyze_partition(self, res):
+        adj, n = _two_cliques_csr()
+        labels = jnp.asarray([0] * 10 + [1] * 10)
+        edge_cut, cost = spectral.analyze_partition(res, adj, labels)
+        np.testing.assert_allclose(float(edge_cut), 1.0, atol=1e-4)  # the bridge
+        np.testing.assert_allclose(float(cost), 2 * 1.0 / 10, rtol=1e-4)
+
+    def test_modularity_maximization(self, res):
+        adj, n = _two_cliques_csr()
+        labels, evals, emb = spectral.modularity_maximization(res, adj, 2, seed=0)
+        want = np.array([0] * 10 + [1] * 10)
+        assert skm.adjusted_rand_score(want, np.asarray(labels)) == 1.0
+        q = spectral.modularity(res, adj, jnp.asarray(want))
+        assert float(q) > 0.4  # two near-disconnected cliques
+
+    def test_fit_embedding_fiedler_sign_structure(self, res):
+        adj, n = _two_cliques_csr()
+        evals, evecs = spectral.fit_embedding(res, adj, 1, seed=1)
+        fiedler = np.asarray(evecs)[:, 0]
+        # Fiedler vector separates the cliques by sign
+        s1 = set(np.sign(fiedler[:10]))
+        s2 = set(np.sign(fiedler[10:]))
+        assert s1 == {1.0} and s2 == {-1.0} or s1 == {-1.0} and s2 == {1.0}
+
+
+class TestLAP:
+    @pytest.mark.parametrize("n", [5, 20, 64])
+    def test_matches_scipy(self, rng_np, res, n):
+        cost = rng_np.integers(0, 100, (n, n)).astype(np.float32)
+        assign, total = linear_assignment(res, cost)
+        assign = np.asarray(assign)
+        # valid permutation
+        assert sorted(assign.tolist()) == list(range(n))
+        ri, ci = scipy.optimize.linear_sum_assignment(cost)
+        np.testing.assert_allclose(float(total), cost[ri, ci].sum(), atol=1e-3)
+
+    def test_float_costs_near_optimal(self, rng_np, res):
+        n = 32
+        cost = rng_np.random((n, n)).astype(np.float32)
+        assign, total = linear_assignment(res, cost)
+        ri, ci = scipy.optimize.linear_sum_assignment(cost)
+        opt = cost[ri, ci].sum()
+        # auction with eps-scaling: within n*eps_final of optimum
+        assert float(total) <= opt + n * (1.0 / (n + 1)) + 1e-3
+
+    def test_maximize(self, rng_np, res):
+        n = 10
+        cost = rng_np.integers(0, 50, (n, n)).astype(np.float32)
+        assign, total = linear_assignment(res, cost, maximize=True)
+        ri, ci = scipy.optimize.linear_sum_assignment(cost, maximize=True)
+        np.testing.assert_allclose(float(total), cost[ri, ci].sum(), atol=1e-3)
+
+    def test_batched_object_api(self, rng_np, res):
+        n, b = 8, 3
+        costs = rng_np.integers(0, 30, (b, n, n)).astype(np.float32)
+        lap = LinearAssignmentProblem(res, n, b)
+        assigns = np.asarray(lap.solve(costs))
+        for i in range(b):
+            ri, ci = scipy.optimize.linear_sum_assignment(costs[i])
+            np.testing.assert_allclose(
+                float(np.asarray(lap.objective_values)[i]),
+                costs[i][ri, ci].sum(),
+                atol=1e-3,
+            )

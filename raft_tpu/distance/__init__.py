@@ -8,6 +8,7 @@ from raft_tpu.distance.fused_l2_nn import (
     fused_l2_nn_argmin_precomputed,
 )
 from raft_tpu.distance.kernels import KernelType, KernelParams, gram_matrix
+from raft_tpu.distance.masked_nn import compress_to_bits, masked_l2_nn
 
 __all__ = [
     "DistanceType",
@@ -17,6 +18,8 @@ __all__ = [
     "pairwise_distance_tiled",
     "fused_l2_nn_argmin",
     "fused_l2_nn_argmin_precomputed",
+    "compress_to_bits",
+    "masked_l2_nn",
     "KernelType",
     "KernelParams",
     "gram_matrix",

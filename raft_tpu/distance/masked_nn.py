@@ -1,0 +1,100 @@
+"""Masked L2 nearest neighbor — analog of ``distance/masked_nn.cuh``
+(``masked_l2_nn``) and its bitfield helper ``compress_to_bits``.
+
+The reference fuses a group-mask into its tiled fused-L2-argmin kernel so
+masked-out tiles are skipped. On TPU, skipping tiles data-dependently
+defeats XLA's static schedule; instead the mask becomes a ``+inf``
+select fused into the distance epilog — the MXU computes the full
+product either way, and the VPU applies the mask for free in the same
+fusion. Memory stays bounded by row tiling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+
+
+def compress_to_bits(res: Optional[Resources], mask) -> jax.Array:
+    """Pack a boolean matrix into uint32 bitfields along rows —
+    ``distance::compress_to_bits``. Layout: ``out[i, w]`` holds bits
+    ``[32w, 32w+32)`` of row i, LSB-first."""
+    ensure_resources(res)
+    mask = jnp.asarray(mask, bool)
+    m, n = mask.shape
+    n_words = (n + 31) // 32
+    pad = n_words * 32 - n
+    bits = jnp.pad(mask, ((0, 0), (0, pad))).reshape(m, n_words, 32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, None, :]
+    return jnp.sum(bits.astype(jnp.uint32) * weights, axis=2, dtype=jnp.uint32)
+
+
+def masked_l2_nn(
+    res: Optional[Resources],
+    x,
+    y,
+    adj,
+    group_idxs,
+    *,
+    sqrt: bool = False,
+    tile: int = 4096,
+) -> Tuple[jax.Array, jax.Array]:
+    """For every row of ``x``, the L2-nearest row of ``y`` among groups
+    enabled in ``adj`` — ``distance::masked_l2_nn``
+    (``masked_nn.cuh``).
+
+    Args:
+      adj: (m, n_groups) boolean — which y-groups each x row may match.
+      group_idxs: (n_groups,) int — *end offset* of each group in y's
+        rows (the reference's prefix-scan layout: group g spans
+        ``[group_idxs[g-1], group_idxs[g])``).
+
+    Returns (min_dists (m,), min_indices (m,)) — the reference's KVP
+    output split into two arrays; rows with no enabled group get
+    ``inf`` / ``-1``.
+    """
+    ensure_resources(res)
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    adj = jnp.asarray(adj, bool)
+    group_idxs = jnp.asarray(group_idxs, jnp.int32)
+    m, d = x.shape
+    n = y.shape[0]
+    n_groups = adj.shape[1]
+    expect(group_idxs.shape[0] == n_groups,
+           "masked_l2_nn: adj and group_idxs disagree on group count")
+
+    # group id of each y row from the end-offset table
+    group_of_y = jnp.searchsorted(group_idxs, jnp.arange(n), side="right")
+    group_of_y = jnp.clip(group_of_y, 0, n_groups - 1).astype(jnp.int32)
+
+    with tracing.range("raft_tpu.distance.masked_l2_nn"):
+        yn = jnp.sum(jnp.square(y.astype(jnp.float32)), axis=1)
+        outs_d, outs_i = [], []
+        for start in range(0, m, tile):
+            stop = min(start + tile, m)
+            xt = x[start:stop].astype(jnp.float32)
+            ip = jax.lax.dot_general(
+                xt, y.astype(jnp.float32), (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dist = jnp.sum(jnp.square(xt), axis=1)[:, None] + yn[None, :] \
+                - 2.0 * ip
+            dist = jnp.maximum(dist, 0.0)
+            allowed = adj[start:stop][:, group_of_y]       # (t, n)
+            dist = jnp.where(allowed, dist, jnp.inf)
+            best = jnp.min(dist, axis=1)
+            best_i = jnp.argmin(dist, axis=1).astype(jnp.int32)
+            best_i = jnp.where(jnp.isfinite(best), best_i, -1)
+            if sqrt:
+                best = jnp.sqrt(best)
+            outs_d.append(best)
+            outs_i.append(best_i)
+        md = jnp.concatenate(outs_d) if len(outs_d) > 1 else outs_d[0]
+        mi = jnp.concatenate(outs_i) if len(outs_i) > 1 else outs_i[0]
+        return md, mi

@@ -1,19 +1,25 @@
 """Vector-search algorithms — the flagship layer (reference
 ``raft/neighbors/``, SURVEY.md §2.5)."""
 
+from raft_tpu.neighbors import ball_cover
 from raft_tpu.neighbors import brute_force
 from raft_tpu.neighbors import cagra
+from raft_tpu.neighbors import epsilon_neighborhood
 from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors import ivf_pq
 from raft_tpu.neighbors import nn_descent
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
+from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
 # pylibraft parity: ``neighbors.refine`` is the function (the submodule
 # stays importable as ``raft_tpu.neighbors.refine`` via sys.modules)
 from raft_tpu.neighbors.refine import refine
 
 __all__ = [
+    "ball_cover",
     "brute_force",
     "cagra",
+    "epsilon_neighborhood",
+    "eps_neighbors",
     "ivf_flat",
     "ivf_pq",
     "nn_descent",

@@ -1,0 +1,184 @@
+"""Random ball cover — analog of ``neighbors/ball_cover-inl.cuh``
+(``ball_cover::build_index`` / ``knn_query`` / ``eps_nn_query``), the
+landmark-based exact/approx kNN for low-dim (2D/3D) euclidean and
+haversine data.
+
+Reference architecture: sample √n landmarks, assign every point to its
+nearest landmark, then prune landmark balls with the triangle inequality
+(``registers*.cu`` kernels). TPU re-design: per-landmark member lists
+become a **padded dense (L, M) table** (XLA needs static shapes); a query
+probes its ``n_probes`` nearest landmarks, gathers their members in one
+batched gather, and scores them with one batched MXU contraction.
+Landmark radii give the same triangle-inequality certificate the
+reference uses: if the kth-best distance is below the lower bound of
+every unprobed ball, the answer is provably exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.pairwise import _pairwise_distance_impl
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.matrix.select_k import merge_topk
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class BallCoverIndex:
+    """``BallCoverIndex`` analog (``ball_cover_types.hpp``)."""
+
+    dataset: jax.Array        # (n, d)
+    landmarks: jax.Array      # (L, d)
+    members: jax.Array        # (L, M) int32 dataset row ids, -1 padding
+    member_dists: jax.Array   # (L, M) distance of member to its landmark
+    radii: jax.Array          # (L,) max member distance per ball
+    metric: DistanceType
+
+    def tree_flatten(self):
+        return (
+            (self.dataset, self.landmarks, self.members,
+             self.member_dists, self.radii),
+            (self.metric,),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, metric=aux[0])
+
+    @property
+    def n_landmarks(self) -> int:
+        return self.landmarks.shape[0]
+
+
+def build_index(
+    res: Optional[Resources],
+    dataset,
+    metric: DistanceType = DistanceType.L2SqrtExpanded,
+    *,
+    n_landmarks: Optional[int] = None,
+) -> BallCoverIndex:
+    """Sample √n landmarks and bucket every point into its nearest
+    landmark's ball — ``ball_cover::build_index``."""
+    res = ensure_resources(res)
+    x = jnp.asarray(dataset)
+    n = x.shape[0]
+    L = n_landmarks or max(1, int(math.ceil(math.sqrt(n))))
+    expect(L <= n, "ball_cover: more landmarks than points")
+
+    with tracing.range("raft_tpu.neighbors.ball_cover.build"):
+        perm = jax.random.permutation(res.next_key(), n)[:L]
+        landmarks = x[perm]
+        d = _pairwise_distance_impl(x, landmarks, metric, 2.0, "highest")
+        owner = jnp.argmin(d, axis=1).astype(jnp.int32)          # (n,)
+        dist_own = jnp.min(d, axis=1)
+        # bucket into a padded (L, M) table, sorted by distance within
+        # the ball (the reference sorts each ball for pruning quality)
+        counts = np.bincount(np.asarray(owner), minlength=L)
+        M = int(counts.max())
+        order = np.lexsort((np.asarray(dist_own), np.asarray(owner)))
+        rows_sorted = np.asarray(owner)[order]
+        pos_in_row = np.arange(n) - np.concatenate(
+            [[0], np.cumsum(counts)[:-1]])[rows_sorted]
+        members = np.full((L, M), -1, np.int32)
+        mdists = np.full((L, M), np.inf, np.float32)
+        members[rows_sorted, pos_in_row] = order
+        mdists[rows_sorted, pos_in_row] = np.asarray(dist_own)[order]
+        radii = jax.ops.segment_max(dist_own, owner, num_segments=L)
+        return BallCoverIndex(
+            dataset=x,
+            landmarks=landmarks,
+            members=jnp.asarray(members),
+            member_dists=jnp.asarray(mdists),
+            radii=radii,
+            metric=metric,
+        )
+
+
+@partial(jax.jit, static_argnames=("k", "n_probes", "metric"))
+def _query_batch(queries, dataset, landmarks, members, radii,
+                 k: int, n_probes: int, metric: DistanceType):
+    q = queries.shape[0]
+    L, M = members.shape
+    d_ql = _pairwise_distance_impl(queries, landmarks, metric, 2.0,
+                                   "highest")                    # (q, L)
+    _, probe = jax.lax.top_k(-d_ql, n_probes)                    # (q, p)
+    cand = members[probe].reshape(q, n_probes * M)               # (q, pM)
+    valid = cand >= 0
+    cand_safe = jnp.where(valid, cand, 0)
+    cvecs = dataset[cand_safe]                                   # (q, pM, dim)
+    dist = jax.vmap(
+        lambda qv, cv: _pairwise_distance_impl(qv[None], cv, metric, 2.0,
+                                               "highest")[0]
+    )(queries, cvecs)                                            # (q, pM)
+    dist = jnp.where(valid, dist, jnp.inf)
+    topd, topi = jax.lax.top_k(-dist, k)
+    topd = -topd
+    idx = jnp.take_along_axis(cand_safe, topi, axis=1)
+    idx = jnp.where(jnp.isfinite(topd), idx, -1)
+    # exactness certificate: kth best vs lower bound of unprobed balls
+    lb = d_ql - radii[None, :]                                   # (q, L)
+    probed = jnp.zeros((q, L), bool).at[
+        jnp.arange(q)[:, None], probe].set(True)
+    min_unprobed_lb = jnp.min(jnp.where(probed, jnp.inf, lb), axis=1)
+    exact = topd[:, k - 1] <= min_unprobed_lb
+    return topd, idx, exact
+
+
+def knn_query(
+    res: Optional[Resources],
+    index: BallCoverIndex,
+    queries,
+    k: int,
+    *,
+    n_probes: int = 0,
+    tile: int = 1024,
+) -> Tuple[jax.Array, jax.Array]:
+    """k nearest neighbors via ball-cover pruning —
+    ``ball_cover::knn_query``. ``n_probes=0`` → probe √L + k balls
+    (typically exact on low-dim data; raise for a guarantee — probing
+    all L balls is exhaustive)."""
+    res = ensure_resources(res)
+    queries = jnp.asarray(queries)
+    L = index.n_landmarks
+    p = n_probes or min(L, int(math.ceil(math.sqrt(L))) + k)
+    p = min(p, L)
+    expect(k >= 1, "knn_query: k must be >= 1")
+
+    with tracing.range("raft_tpu.neighbors.ball_cover.knn"):
+        outs = []
+        for start in range(0, queries.shape[0], tile):
+            stop = min(start + tile, queries.shape[0])
+            outs.append(_query_batch(
+                queries[start:stop], index.dataset, index.landmarks,
+                index.members, index.radii, k, p, index.metric))
+        dists = jnp.concatenate([o[0] for o in outs], axis=0) \
+            if len(outs) > 1 else outs[0][0]
+        idx = jnp.concatenate([o[1] for o in outs], axis=0) \
+            if len(outs) > 1 else outs[0][1]
+        return dists, idx
+
+
+def eps_nn_query(
+    res: Optional[Resources],
+    index: BallCoverIndex,
+    queries,
+    eps: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """All neighbors within radius eps — ``ball_cover::eps_nn_query``.
+    Returns (adjacency (q, n) bool, vertex degrees)."""
+    from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
+
+    # ball pruning would only skip compute XLA already fuses; the dense
+    # epsilon pass reuses the tiled distance engine directly
+    return eps_neighbors(res, queries, index.dataset, eps)

@@ -1,0 +1,118 @@
+"""ball cover / epsilon neighborhood / masked NN tests
+(reference ``cpp/test/neighbors/ball_cover.cu``,
+``epsilon_neighborhood.cu``, ``cpp/test/distance/masked_nn.cu``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.distance.masked_nn import compress_to_bits, masked_l2_nn
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors import ball_cover, brute_force
+from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
+
+
+class TestEpsNeighborhood:
+    def test_against_naive(self, rng_np, res):
+        x = rng_np.standard_normal((50, 4)).astype(np.float32)
+        y = rng_np.standard_normal((70, 4)).astype(np.float32)
+        eps = 1.5
+        adj, vd = eps_neighbors(res, x, y, eps)
+        d2 = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        want = d2 <= eps * eps
+        np.testing.assert_array_equal(np.asarray(adj), want)
+        np.testing.assert_array_equal(np.asarray(vd), want.sum(axis=1))
+
+    def test_tiled_matches(self, rng_np, res):
+        x = rng_np.standard_normal((33, 3)).astype(np.float32)
+        adj1, _ = eps_neighbors(res, x, x, 1.0)
+        adj2, _ = eps_neighbors(res, x, x, 1.0, tile=7)
+        np.testing.assert_array_equal(np.asarray(adj1), np.asarray(adj2))
+
+
+class TestMaskedNN:
+    def test_compress_to_bits(self, res):
+        mask = jnp.asarray([[True, False, True] + [False] * 30 + [True]])
+        words = np.asarray(compress_to_bits(res, mask))
+        assert words.shape == (1, 2)
+        assert words[0, 0] == 0b101
+        assert words[0, 1] == 0b10  # bit 33 → bit 1 of word 1
+
+    def test_masked_l2_nn(self, rng_np, res):
+        m, n, d, g = 40, 60, 5, 3
+        x = rng_np.standard_normal((m, d)).astype(np.float32)
+        y = rng_np.standard_normal((n, d)).astype(np.float32)
+        # groups: y rows [0,20), [20,45), [45,60)
+        group_idxs = jnp.asarray([20, 45, 60])
+        groups = np.zeros(n, np.int64)
+        groups[20:45] = 1
+        groups[45:] = 2
+        adj = rng_np.random((m, g)) < 0.6
+        adj[0] = [True, False, False]  # deterministic row
+        md, mi = masked_l2_nn(res, x, y, jnp.asarray(adj), group_idxs)
+        md, mi = np.asarray(md), np.asarray(mi)
+        d2 = ((x[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        allowed = adj[:, groups]
+        d2m = np.where(allowed, d2, np.inf)
+        want_i = d2m.argmin(axis=1)
+        want_d = d2m.min(axis=1)
+        has = np.isfinite(want_d)
+        np.testing.assert_allclose(md[has], want_d[has], rtol=1e-3, atol=1e-4)
+        np.testing.assert_array_equal(mi[has], want_i[has])
+        assert np.all(mi[~has] == -1)
+
+    def test_no_enabled_groups(self, rng_np, res):
+        x = rng_np.standard_normal((4, 3)).astype(np.float32)
+        y = rng_np.standard_normal((6, 3)).astype(np.float32)
+        adj = jnp.zeros((4, 2), bool)
+        md, mi = masked_l2_nn(res, x, y, adj, jnp.asarray([3, 6]))
+        assert np.all(np.isinf(np.asarray(md)))
+        assert np.all(np.asarray(mi) == -1)
+
+
+class TestBallCover:
+    def test_exact_small_2d(self, rng_np, res):
+        # probing all landmarks must equal brute force exactly
+        x = rng_np.standard_normal((500, 2)).astype(np.float32)
+        q = rng_np.standard_normal((32, 2)).astype(np.float32)
+        idx = ball_cover.build_index(res, x)
+        d, i = ball_cover.knn_query(res, idx, q, 5, n_probes=idx.n_landmarks)
+        bd, bi = brute_force.knn(res, x, q, 5, DistanceType.L2SqrtExpanded)
+        np.testing.assert_allclose(np.asarray(d), np.asarray(bd), rtol=1e-3, atol=1e-4)
+        # indices may differ on ties; distances must match
+        recall = np.mean([
+            len(set(np.asarray(i)[r]) & set(np.asarray(bi)[r])) / 5
+            for r in range(32)
+        ])
+        assert recall > 0.99
+
+    def test_default_probes_high_recall(self, rng_np, res):
+        x = rng_np.standard_normal((2000, 3)).astype(np.float32)
+        q = rng_np.standard_normal((64, 3)).astype(np.float32)
+        idx = ball_cover.build_index(res, x)
+        d, i = ball_cover.knn_query(res, idx, q, 10)
+        bd, bi = brute_force.knn(res, x, q, 10, DistanceType.L2SqrtExpanded)
+        recall = np.mean([
+            len(set(np.asarray(i)[r]) & set(np.asarray(bi)[r])) / 10
+            for r in range(64)
+        ])
+        assert recall >= 0.95  # reference's statistical-recall pattern
+
+    def test_haversine(self, rng_np, res):
+        # lat/lon in radians
+        pts = np.stack([
+            rng_np.uniform(-np.pi / 2, np.pi / 2, 300),
+            rng_np.uniform(-np.pi, np.pi, 300),
+        ], axis=1).astype(np.float32)
+        qs = pts[:8] + 0.001
+        idx = ball_cover.build_index(res, pts, DistanceType.Haversine)
+        d, i = ball_cover.knn_query(res, idx, qs, 3, n_probes=idx.n_landmarks)
+        bd, bi = brute_force.knn(res, pts, qs, 3, DistanceType.Haversine)
+        np.testing.assert_allclose(np.asarray(d), np.asarray(bd), rtol=1e-2, atol=1e-4)
+
+    def test_eps_query(self, rng_np, res):
+        x = rng_np.standard_normal((200, 2)).astype(np.float32)
+        idx = ball_cover.build_index(res, x)
+        adj, vd = ball_cover.eps_nn_query(res, idx, x[:10], 0.5)
+        d2 = ((x[:10, None, :] - x[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_array_equal(np.asarray(adj), d2 <= 0.25)

@@ -130,6 +130,21 @@ def _knn_scan(queries, dataset, k: int, metric: DistanceType, metric_arg: float,
     return best_d, best_i
 
 
+def _use_fused_kernel(metric: DistanceType, k: int, q: int) -> bool:
+    """Dispatch to the Pallas fused scan (role of the reference's
+    fused-vs-tiled choice, ``detail/knn_brute_force.cuh:324``): TPU
+    hardware, an expanded metric the kernel supports, small-k (the
+    VPU merge is O(k·tile)), and a VMEM-resident query block."""
+    from raft_tpu.ops.fused_topk import _SUPPORTED_METRICS
+
+    return (
+        jax.default_backend() == "tpu"
+        and metric in _SUPPORTED_METRICS
+        and k <= 64
+        and q <= 512
+    )
+
+
 def search(
     res: Optional[Resources],
     index: BruteForceIndex,
@@ -142,7 +157,11 @@ def search(
     ``brute_force::knn`` / ``brute_force::search``.
 
     For ``InnerProduct`` the returned "distances" are similarities sorted
-    descending (``is_min_close`` semantics, matching the reference)."""
+    descending (``is_min_close`` semantics, matching the reference).
+
+    On TPU with small k and an expanded metric this dispatches to the
+    Pallas fused scan (``raft_tpu.ops.fused_knn`` — the ``fusedL2kNN``
+    analog); otherwise the XLA tile-scan path runs."""
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2, "queries must be (q, d)")
@@ -151,6 +170,11 @@ def search(
     db_tile = min(db_tile, max(128, index.size))
     with tracing.range("raft_tpu.brute_force.search"):
         q = queries.shape[0]
+        if _use_fused_kernel(index.metric, k, q):
+            from raft_tpu.ops.fused_topk import fused_knn
+
+            return fused_knn(queries, index.dataset, k, index.metric,
+                             tile=8192)
         if q <= query_tile:
             return _knn_scan(queries, index.dataset, k, index.metric,
                              index.metric_arg, db_tile)

@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the hot ops (SURVEY.md §7 stage 2).
+
+Each kernel has an interpret-mode path so the CPU test mesh can validate
+numerics; on TPU hardware they compile to Mosaic.
+"""
+
+from raft_tpu.ops.fused_topk import fused_knn, select_k_tiles
+
+__all__ = ["fused_knn", "select_k_tiles"]

@@ -1,0 +1,87 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh; the driver's TPU
+bench exercises the compiled path)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.ops import fused_knn, select_k_tiles
+
+
+def _naive_knn(q, x, k, metric):
+    d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    if metric == DistanceType.InnerProduct:
+        sim = q @ x.T
+        idx = np.argsort(-sim, axis=1, kind="stable")[:, :k]
+        return np.take_along_axis(sim, idx, 1), idx
+    if metric == DistanceType.CosineExpanded:
+        qn = np.linalg.norm(q, axis=1, keepdims=True)
+        xn = np.linalg.norm(x, axis=1, keepdims=True)
+        d = 1 - (q @ x.T) / (qn * xn.T)
+    elif metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+        d = np.sqrt(d2)
+    else:
+        d = d2
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(d, idx, 1), idx
+
+
+class TestFusedKnn:
+    @pytest.mark.parametrize(
+        "metric",
+        [
+            DistanceType.L2Expanded,
+            DistanceType.L2SqrtExpanded,
+            DistanceType.InnerProduct,
+            DistanceType.CosineExpanded,
+        ],
+    )
+    def test_matches_naive(self, rng_np, metric):
+        q = rng_np.standard_normal((9, 24)).astype(np.float32)
+        x = rng_np.standard_normal((500, 24)).astype(np.float32)
+        d, i = fused_knn(q, x, 7, metric, tile=128, interpret=True)
+        wd, wi = _naive_knn(q, x, 7, metric)
+        np.testing.assert_allclose(np.asarray(d), wd, rtol=1e-3, atol=1e-3)
+        # indices can differ on ties; distance agreement is the contract
+        same = (np.asarray(i) == wi).mean()
+        assert same > 0.95
+
+    def test_non_multiple_shapes(self, rng_np):
+        # n not a tile multiple, q not 8-multiple, d not 128-multiple
+        q = rng_np.standard_normal((3, 17)).astype(np.float32)
+        x = rng_np.standard_normal((301, 17)).astype(np.float32)
+        d, i = fused_knn(q, x, 5, tile=128, interpret=True)
+        wd, wi = _naive_knn(q, x, 5, DistanceType.L2Expanded)
+        np.testing.assert_allclose(np.asarray(d), wd, rtol=1e-3, atol=1e-3)
+
+    def test_k_larger_than_tile_fraction(self, rng_np):
+        q = rng_np.standard_normal((8, 16)).astype(np.float32)
+        x = rng_np.standard_normal((256, 16)).astype(np.float32)
+        d, i = fused_knn(q, x, 32, tile=128, interpret=True)
+        wd, _ = _naive_knn(q, x, 32, DistanceType.L2Expanded)
+        np.testing.assert_allclose(np.asarray(d), wd, rtol=1e-3, atol=1e-3)
+
+
+class TestSelectKTiles:
+    def test_matches_topk_min(self, rng_np):
+        v = rng_np.standard_normal((5, 700)).astype(np.float32)
+        d, i = select_k_tiles(v, 9, tile=256, interpret=True)
+        want = np.sort(v, axis=1)[:, :9]
+        np.testing.assert_allclose(np.asarray(d), want, rtol=1e-5)
+        np.testing.assert_array_equal(
+            np.take_along_axis(v, np.asarray(i), 1), np.asarray(d)
+        )
+
+    def test_matches_topk_max(self, rng_np):
+        v = rng_np.standard_normal((4, 300)).astype(np.float32)
+        d, i = select_k_tiles(v, 6, select_min=False, tile=128, interpret=True)
+        want = -np.sort(-v, axis=1)[:, :6]
+        np.testing.assert_allclose(np.asarray(d), want, rtol=1e-5)
+
+    def test_duplicate_values_first_occurrence(self):
+        v = jnp.asarray([[3.0, 1.0, 1.0, 2.0] * 64])
+        d, i = select_k_tiles(v, 3, tile=128, interpret=True)
+        np.testing.assert_allclose(np.asarray(d)[0], [1.0, 1.0, 1.0])
+        # ids must be valid positions holding the value 1.0
+        assert all(np.asarray(v)[0, j] == 1.0 for j in np.asarray(i)[0])

@@ -1,0 +1,21 @@
+"""ANN benchmark harness — analog of ``python/raft-ann-bench``
+(SURVEY.md §2.8): dataset preparation, run orchestration from JSON
+configs, CSV export, and recall-vs-QPS plotting.
+
+CLI::
+
+    python -m raft_tpu.bench get-dataset --kind random --n 100000 ...
+    python -m raft_tpu.bench run --dataset data/random-100k --config conf.json
+    python -m raft_tpu.bench data-export --results results/
+    python -m raft_tpu.bench plot --results results/ --out plot.png
+"""
+
+from raft_tpu.bench.datasets import convert_hdf5, make_dataset
+from raft_tpu.bench.runner import ALGO_REGISTRY, run_benchmark
+
+__all__ = [
+    "ALGO_REGISTRY",
+    "convert_hdf5",
+    "make_dataset",
+    "run_benchmark",
+]

@@ -1,0 +1,86 @@
+"""CLI for the ANN benchmark harness — the ``raft-ann-bench`` command
+surface (``run/__main__.py:70``: run / get-dataset / data-export / plot).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="raft_tpu.bench",
+        description="TPU ANN benchmark harness (raft-ann-bench analog)",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("get-dataset", help="generate or convert a dataset")
+    p.add_argument("--out-dir", default="datasets")
+    p.add_argument("--name", default=None)
+    p.add_argument("--kind", choices=["random", "blobs"], default="blobs")
+    p.add_argument("--n", type=int, default=100_000)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--n-queries", type=int, default=1000)
+    p.add_argument("--k", type=int, default=100)
+    p.add_argument("--metric", default="euclidean")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--hdf5", default=None,
+                   help="convert this ann-benchmarks HDF5 instead")
+
+    p = sub.add_parser("run", help="run benchmarks from a JSON config")
+    p.add_argument("--dataset", required=True, help="dataset directory")
+    p.add_argument("--config", required=True, help="JSON config path")
+    p.add_argument("--out-dir", default="results")
+    p.add_argument("-k", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=0)
+    p.add_argument("--search-iters", type=int, default=3)
+
+    p = sub.add_parser("data-export", help="results JSONL -> CSV")
+    p.add_argument("--results", required=True)
+    p.add_argument("--out", default=None)
+
+    p = sub.add_parser("plot", help="recall-vs-QPS plot")
+    p.add_argument("--results", required=True)
+    p.add_argument("--out", default=None)
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "get-dataset":
+        from raft_tpu.bench.datasets import convert_hdf5, make_dataset
+
+        if args.hdf5:
+            root = convert_hdf5(args.hdf5, args.out_dir, args.name)
+        else:
+            name = args.name or f"{args.kind}-{args.n}-{args.dim}"
+            root = make_dataset(
+                args.out_dir, name, n=args.n, dim=args.dim,
+                n_queries=args.n_queries, k=args.k, metric=args.metric,
+                seed=args.seed, kind=args.kind,
+            )
+        print(root)
+    elif args.cmd == "run":
+        from raft_tpu.bench.runner import run_benchmark
+
+        config = json.loads(pathlib.Path(args.config).read_text())
+        rows = run_benchmark(
+            args.dataset, config, args.out_dir, k=args.k,
+            batch_size=args.batch_size, search_iters=args.search_iters,
+        )
+        for r in rows:
+            print(json.dumps(r))
+    elif args.cmd == "data-export":
+        from raft_tpu.bench.runner import export_csv
+
+        print(export_csv(args.results, args.out))
+    elif args.cmd == "plot":
+        from raft_tpu.bench.runner import plot_results
+
+        print(plot_results(args.results, args.out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

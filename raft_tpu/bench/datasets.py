@@ -1,0 +1,102 @@
+"""Dataset preparation — analog of ``raft-ann-bench/get_dataset``
+(hdf5 → big-ann bin conversion) plus a synthetic generator for
+air-gapped runs (this environment has no egress; the reference
+downloads ann-benchmarks HDF5 files).
+
+Layout convention (the reference's, ``run/__main__.py``):
+``<dir>/<name>/base.fbin``, ``query.fbin``, ``groundtruth.neighbors.ibin``,
+``groundtruth.distances.fbin``.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Optional
+
+import numpy as np
+
+from raft_tpu.io import write_bin
+
+
+def _groundtruth(base: np.ndarray, queries: np.ndarray, k: int,
+                 metric: str = "euclidean"):
+    """Exact groundtruth via the framework's own brute force (on the
+    default backend)."""
+    from raft_tpu.distance.types import DistanceType
+    from raft_tpu.neighbors import brute_force
+
+    m = {
+        "euclidean": DistanceType.L2SqrtExpanded,
+        "sqeuclidean": DistanceType.L2Expanded,
+        "inner_product": DistanceType.InnerProduct,
+        "angular": DistanceType.CosineExpanded,
+    }[metric]
+    d, i = brute_force.knn(None, base, queries, k, m)
+    return np.asarray(d), np.asarray(i)
+
+
+def make_dataset(
+    out_dir,
+    name: str,
+    n: int = 100_000,
+    dim: int = 128,
+    n_queries: int = 1000,
+    k: int = 100,
+    metric: str = "euclidean",
+    seed: int = 0,
+    kind: str = "blobs",
+) -> pathlib.Path:
+    """Generate a synthetic dataset tree with exact groundtruth.
+
+    ``kind``: "random" (iid gaussian — worst case for ANN) or "blobs"
+    (clustered — the realistic regime)."""
+    rng = np.random.default_rng(seed)
+    if kind == "random":
+        base = rng.standard_normal((n, dim)).astype(np.float32)
+        queries = rng.standard_normal((n_queries, dim)).astype(np.float32)
+    elif kind == "blobs":
+        n_centers = max(10, int(np.sqrt(n) / 4))
+        centers = rng.standard_normal((n_centers, dim)).astype(np.float32) * 4
+        who = rng.integers(0, n_centers, n)
+        base = centers[who] + rng.standard_normal((n, dim)).astype(np.float32)
+        whoq = rng.integers(0, n_centers, n_queries)
+        queries = centers[whoq] + rng.standard_normal(
+            (n_queries, dim)).astype(np.float32)
+    else:
+        raise ValueError(f"unknown dataset kind {kind!r}")
+
+    root = pathlib.Path(out_dir) / name
+    root.mkdir(parents=True, exist_ok=True)
+    write_bin(root / "base.fbin", base)
+    write_bin(root / "query.fbin", queries)
+    gd, gi = _groundtruth(base, queries, k, metric)
+    write_bin(root / "groundtruth.neighbors.ibin", gi.astype(np.int32))
+    write_bin(root / "groundtruth.distances.fbin", gd.astype(np.float32))
+    (root / "metric.txt").write_text(metric + "\n")
+    return root
+
+
+def convert_hdf5(hdf5_path, out_dir, name: Optional[str] = None) -> pathlib.Path:
+    """Convert an ann-benchmarks HDF5 file (train/test/neighbors/distances
+    datasets) into the bin-file tree — ``get_dataset/__main__.py``'s
+    ``hdf5_to_fbin`` role."""
+    import h5py
+
+    hdf5_path = pathlib.Path(hdf5_path)
+    name = name or hdf5_path.stem
+    root = pathlib.Path(out_dir) / name
+    root.mkdir(parents=True, exist_ok=True)
+    with h5py.File(hdf5_path, "r") as f:
+        write_bin(root / "base.fbin", np.asarray(f["train"], np.float32))
+        write_bin(root / "query.fbin", np.asarray(f["test"], np.float32))
+        if "neighbors" in f:
+            write_bin(root / "groundtruth.neighbors.ibin",
+                      np.asarray(f["neighbors"], np.int32))
+        if "distances" in f:
+            write_bin(root / "groundtruth.distances.fbin",
+                      np.asarray(f["distances"], np.float32))
+        metric = f.attrs.get("distance", "euclidean")
+        if isinstance(metric, bytes):
+            metric = metric.decode()
+    (root / "metric.txt").write_text(str(metric) + "\n")
+    return root

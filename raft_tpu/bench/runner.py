@@ -1,0 +1,270 @@
+"""Benchmark orchestration — analog of ``raft-ann-bench/run``
+(``run/__main__.py:48-120``): an algorithm registry (the ``algos.yaml``
+role), JSON param-sweep configs, build+search timing, recall against
+groundtruth, and JSON-lines results the exporter/plotter consume.
+
+The reference shells out to gbench executables; here algorithms are
+in-process wrappers over the framework APIs (``bench/ann/src/common/
+ann_types.hpp:79`` ``ANN<T>`` interface analog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.io import read_bin
+from raft_tpu.utils.recall import eval_recall
+
+_METRICS = {
+    "euclidean": DistanceType.L2SqrtExpanded,
+    "sqeuclidean": DistanceType.L2Expanded,
+    "inner_product": DistanceType.InnerProduct,
+    "angular": DistanceType.CosineExpanded,
+}
+
+
+@dataclasses.dataclass
+class AlgoWrapper:
+    """The ``ANN<T>`` interface (``ann_types.hpp:79-93``): build once,
+    search per search-param set."""
+
+    name: str
+    build: Callable[..., Any]                 # (base, metric, **params) -> index
+    search: Callable[..., Any]                # (index, queries, k, **params) -> (d, i)
+
+
+def _brute_force_build(base, metric, **params):
+    from raft_tpu.neighbors import brute_force
+
+    return brute_force.build(None, base, metric)
+
+
+def _brute_force_search(index, queries, k, **params):
+    from raft_tpu.neighbors import brute_force
+
+    return brute_force.search(None, index, queries, k)
+
+
+def _ivf_flat_build(base, metric, *, n_lists=1024, **params):
+    from raft_tpu.neighbors import ivf_flat
+
+    p = ivf_flat.IvfFlatIndexParams(n_lists=n_lists, metric=metric, **params)
+    return ivf_flat.build(None, p, base)
+
+
+def _ivf_flat_search(index, queries, k, *, n_probes=32, **params):
+    from raft_tpu.neighbors import ivf_flat
+
+    p = ivf_flat.IvfFlatSearchParams(n_probes=n_probes, **params)
+    return ivf_flat.search(None, p, index, queries, k)
+
+
+def _ivf_pq_build(base, metric, *, n_lists=1024, pq_dim=0, pq_bits=8,
+                  **params):
+    from raft_tpu.neighbors import ivf_pq
+
+    p = ivf_pq.IvfPqIndexParams(n_lists=n_lists, pq_dim=pq_dim,
+                                pq_bits=pq_bits, metric=metric, **params)
+    # keep the raw dataset alongside: the refine re-ranking pass needs it
+    # (the reference's bench wrapper does the same for refine_ratio > 1)
+    return {"index": ivf_pq.build(None, p, base), "base": base,
+            "metric": metric}
+
+
+def _ivf_pq_search(bundle, queries, k, *, n_probes=32, refine_ratio=1.0,
+                   **params):
+    from raft_tpu.neighbors import ivf_pq, refine
+
+    p = ivf_pq.IvfPqSearchParams(n_probes=n_probes, **params)
+    if refine_ratio > 1.0:
+        k0 = max(k, int(k * refine_ratio))
+        _, cand = ivf_pq.search(None, p, bundle["index"], queries, k0)
+        return refine(None, bundle["base"], queries, cand, k,
+                      bundle["metric"])
+    return ivf_pq.search(None, p, bundle["index"], queries, k)
+
+
+def _cagra_build(base, metric, *, graph_degree=64,
+                 intermediate_graph_degree=128, **params):
+    from raft_tpu.neighbors import cagra
+
+    p = cagra.CagraIndexParams(
+        graph_degree=graph_degree,
+        intermediate_graph_degree=intermediate_graph_degree,
+        metric=metric, **params)
+    return cagra.build(None, p, base)
+
+
+def _cagra_search(index, queries, k, *, itopk_size=64, max_iterations=0,
+                  **params):
+    from raft_tpu.neighbors import cagra
+
+    p = cagra.CagraSearchParams(itopk_size=itopk_size,
+                                max_iterations=max_iterations, **params)
+    return cagra.search(None, p, index, queries, k)
+
+
+ALGO_REGISTRY: Dict[str, AlgoWrapper] = {
+    "raft_brute_force": AlgoWrapper("raft_brute_force",
+                                    _brute_force_build, _brute_force_search),
+    "raft_ivf_flat": AlgoWrapper("raft_ivf_flat",
+                                 _ivf_flat_build, _ivf_flat_search),
+    "raft_ivf_pq": AlgoWrapper("raft_ivf_pq", _ivf_pq_build, _ivf_pq_search),
+    "raft_cagra": AlgoWrapper("raft_cagra", _cagra_build, _cagra_search),
+}
+
+
+def _block(x):
+    import jax
+
+    jax.block_until_ready(x)
+    return x
+
+
+def run_benchmark(
+    dataset_dir,
+    config: Dict[str, Any],
+    out_dir,
+    *,
+    k: int = 10,
+    batch_size: int = 0,
+    max_base_rows: int = 0,
+    search_iters: int = 3,
+) -> List[Dict[str, Any]]:
+    """Run every (algo, build-params, search-params) combination in
+    ``config`` against the dataset tree; write JSON-lines results.
+
+    Config schema (the reference's ``conf/*.json`` shape)::
+
+        {"algos": [{"name": "raft_ivf_flat",
+                    "build": {"n_lists": 1024},
+                    "search": [{"n_probes": 16}, {"n_probes": 64}]}]}
+    """
+    dataset_dir = pathlib.Path(dataset_dir)
+    out_dir = pathlib.Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    base = read_bin(dataset_dir / "base.fbin")
+    queries = read_bin(dataset_dir / "query.fbin")
+    gt = read_bin(dataset_dir / "groundtruth.neighbors.ibin")
+    metric_name = (dataset_dir / "metric.txt").read_text().strip() \
+        if (dataset_dir / "metric.txt").exists() else "euclidean"
+    metric = _METRICS[metric_name]
+    if max_base_rows:
+        base = base[:max_base_rows]
+        gt = None  # groundtruth invalidated by truncation
+    if batch_size <= 0:
+        batch_size = queries.shape[0]
+
+    results = []
+    out_file = out_dir / "results.jsonl"
+    with open(out_file, "a") as fh:
+        for algo_cfg in config["algos"]:
+            algo = ALGO_REGISTRY[algo_cfg["name"]]
+            build_params = algo_cfg.get("build", {})
+            t0 = time.perf_counter()
+            index = _block(algo.build(base, metric, **build_params))
+            build_s = time.perf_counter() - t0
+
+            for search_params in algo_cfg.get("search", [{}]):
+                # warm (compile) on the first batch
+                qb = queries[:batch_size]
+                _block(algo.search(index, qb, k, **search_params))
+                t0 = time.perf_counter()
+                n_done = 0
+                all_i = []
+                for _ in range(search_iters):
+                    for s in range(0, queries.shape[0], batch_size):
+                        qb = queries[s : s + batch_size]
+                        d, i = algo.search(index, qb, k, **search_params)
+                        _block((d, i))
+                        n_done += qb.shape[0]
+                        if len(all_i) * batch_size < queries.shape[0]:
+                            all_i.append(np.asarray(i))
+                dt = time.perf_counter() - t0
+                qps = n_done / dt
+                got = np.concatenate(all_i)[: queries.shape[0]]
+                rec = (eval_recall(gt[:, :k], got)[0]
+                       if gt is not None else float("nan"))
+                row = {
+                    "dataset": dataset_dir.name,
+                    "algo": algo.name,
+                    "build_params": build_params,
+                    "search_params": search_params,
+                    "k": k,
+                    "batch_size": batch_size,
+                    "build_seconds": round(build_s, 4),
+                    "qps": round(qps, 2),
+                    "recall": None if np.isnan(rec) else round(float(rec), 4),
+                }
+                results.append(row)
+                fh.write(json.dumps(row) + "\n")
+                fh.flush()
+    return results
+
+
+def export_csv(results_dir, out_path=None) -> pathlib.Path:
+    """JSON-lines → CSV — the ``data_export`` subcommand."""
+    import csv
+
+    results_dir = pathlib.Path(results_dir)
+    out_path = pathlib.Path(out_path or results_dir / "results.csv")
+    rows = []
+    for f in sorted(results_dir.glob("*.jsonl")):
+        for line in f.read_text().splitlines():
+            if line.strip():
+                rows.append(json.loads(line))
+    if not rows:
+        raise FileNotFoundError(f"no results under {results_dir}")
+    cols = ["dataset", "algo", "build_params", "search_params", "k",
+            "batch_size", "build_seconds", "qps", "recall"]
+    with open(out_path, "w", newline="") as fh:
+        w = csv.DictWriter(fh, fieldnames=cols)
+        w.writeheader()
+        for r in rows:
+            w.writerow({c: json.dumps(r[c]) if isinstance(r[c], dict)
+                        else r[c] for c in cols})
+    return out_path
+
+
+def plot_results(results_dir, out_path=None) -> pathlib.Path:
+    """Recall-vs-QPS pareto plot — the ``plot`` subcommand
+    (``plot/__main__.py``; the reference's published artifact shape)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    results_dir = pathlib.Path(results_dir)
+    out_path = pathlib.Path(out_path or results_dir / "recall_vs_qps.png")
+    rows = []
+    for f in sorted(results_dir.glob("*.jsonl")):
+        for line in f.read_text().splitlines():
+            if line.strip():
+                rows.append(json.loads(line))
+    algos = sorted({r["algo"] for r in rows})
+    fig, ax = plt.subplots(figsize=(7, 5))
+    for algo in algos:
+        pts = sorted(
+            [(r["recall"], r["qps"]) for r in rows
+             if r["algo"] == algo and r["recall"] is not None]
+        )
+        if pts:
+            ax.plot([p[0] for p in pts], [p[1] for p in pts],
+                    marker="o", label=algo)
+    ax.set_xlabel(f"recall@k")
+    ax.set_ylabel("QPS")
+    ax.set_yscale("log")
+    ax.legend()
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path

@@ -1,0 +1,15 @@
+"""Dataset IO — big-ann-benchmarks binary formats via the native C++
+runtime (``native/io.cpp``), with a numpy fallback.
+
+Analog of the reference's ``bench/ann/src/common/dataset.hpp`` (C++
+``BinFile<T>`` mmap loader) and the ``raft-ann-bench`` dataset tooling.
+"""
+
+from raft_tpu.io.binfile import (
+    BinDataset,
+    native_available,
+    read_bin,
+    write_bin,
+)
+
+__all__ = ["BinDataset", "native_available", "read_bin", "write_bin"]

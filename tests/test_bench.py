@@ -1,0 +1,90 @@
+"""Benchmark harness tests — dataset tree, runner, export, plot
+(reference ``python/raft-ann-bench`` CLI behavior)."""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from raft_tpu.bench.datasets import convert_hdf5, make_dataset
+from raft_tpu.bench.runner import export_csv, plot_results, run_benchmark
+from raft_tpu.io import read_bin
+
+
+@pytest.fixture(scope="module")
+def dataset_dir(tmp_path_factory):
+    out = tmp_path_factory.mktemp("data")
+    return make_dataset(out, "tiny", n=3000, dim=16, n_queries=50, k=20)
+
+
+class TestDatasets:
+    def test_tree_layout(self, dataset_dir):
+        assert (dataset_dir / "base.fbin").exists()
+        assert (dataset_dir / "query.fbin").exists()
+        base = read_bin(dataset_dir / "base.fbin")
+        gt = read_bin(dataset_dir / "groundtruth.neighbors.ibin")
+        assert base.shape == (3000, 16)
+        assert gt.shape == (50, 20)
+        # groundtruth sanity: ids in range, first column is true NN
+        assert gt.min() >= 0 and gt.max() < 3000
+
+    def test_hdf5_conversion(self, tmp_path, rng_np):
+        import h5py
+
+        h5 = tmp_path / "toy.hdf5"
+        train = rng_np.standard_normal((200, 8)).astype(np.float32)
+        test = rng_np.standard_normal((10, 8)).astype(np.float32)
+        with h5py.File(h5, "w") as f:
+            f["train"] = train
+            f["test"] = test
+            f.attrs["distance"] = "euclidean"
+        root = convert_hdf5(h5, tmp_path / "out")
+        np.testing.assert_allclose(read_bin(root / "base.fbin"), train)
+        assert (root / "metric.txt").read_text().strip() == "euclidean"
+
+
+class TestRunner:
+    def test_run_export_plot(self, dataset_dir, tmp_path):
+        config = {
+            "algos": [
+                {"name": "raft_brute_force", "search": [{}]},
+                {
+                    "name": "raft_ivf_flat",
+                    "build": {"n_lists": 32},
+                    "search": [{"n_probes": 4}, {"n_probes": 32}],
+                },
+            ]
+        }
+        rows = run_benchmark(dataset_dir, config, tmp_path / "res",
+                             k=10, search_iters=1)
+        assert len(rows) == 3
+        bf = rows[0]
+        assert bf["algo"] == "raft_brute_force"
+        assert bf["recall"] > 0.999          # exact search
+        assert bf["qps"] > 0
+        # sweeping n_probes to all lists reaches ~exact recall
+        assert rows[2]["recall"] >= rows[1]["recall"]
+        assert rows[2]["recall"] > 0.95
+
+        csv_path = export_csv(tmp_path / "res")
+        text = csv_path.read_text()
+        assert "raft_ivf_flat" in text and "qps" in text
+
+        png = plot_results(tmp_path / "res")
+        assert png.exists() and png.stat().st_size > 1000
+
+    def test_cli(self, dataset_dir, tmp_path):
+        from raft_tpu.bench.__main__ import main
+
+        cfg = tmp_path / "cfg.json"
+        cfg.write_text(json.dumps(
+            {"algos": [{"name": "raft_brute_force", "search": [{}]}]}
+        ))
+        rc = main([
+            "run", "--dataset", str(dataset_dir), "--config", str(cfg),
+            "--out-dir", str(tmp_path / "res2"), "-k", "5",
+            "--search-iters", "1",
+        ])
+        assert rc == 0
+        assert (tmp_path / "res2" / "results.jsonl").exists()

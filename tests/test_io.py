@@ -1,0 +1,83 @@
+"""Native + fallback IO tests (reference ``bench/ann/src/common/
+dataset.hpp`` BinFile behavior)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.io import BinDataset, native_available, read_bin, write_bin
+
+
+@pytest.fixture(params=[True, False], ids=["native", "numpy"])
+def use_native(request):
+    if request.param and not native_available():
+        pytest.skip("native IO library not built")
+    return request.param
+
+
+class TestBinFile:
+    def test_roundtrip_fbin(self, tmp_path, rng_np, use_native):
+        data = rng_np.standard_normal((100, 16)).astype(np.float32)
+        p = tmp_path / "x.fbin"
+        write_bin(p, data, use_native=use_native)
+        with BinDataset(p, use_native=use_native) as ds:
+            assert ds.shape == (100, 16)
+            np.testing.assert_array_equal(ds.read(), data)
+
+    def test_roundtrip_u8bin_i8bin(self, tmp_path, rng_np, use_native):
+        for suffix, dt in [("u8bin", np.uint8), ("i8bin", np.int8)]:
+            data = rng_np.integers(0, 100, (37, 9)).astype(dt)
+            p = tmp_path / f"x.{suffix}"
+            write_bin(p, data, use_native=use_native)
+            np.testing.assert_array_equal(
+                read_bin(p, use_native=use_native), data
+            )
+
+    def test_windowed_read(self, tmp_path, rng_np, use_native):
+        data = rng_np.standard_normal((64, 8)).astype(np.float32)
+        p = tmp_path / "x.fbin"
+        write_bin(p, data, use_native=use_native)
+        with BinDataset(p, use_native=use_native) as ds:
+            np.testing.assert_array_equal(ds.read(10, 20), data[10:30])
+            np.testing.assert_array_equal(ds.read(63, 1), data[63:64])
+
+    def test_out_of_bounds(self, tmp_path, rng_np, use_native):
+        data = rng_np.standard_normal((10, 4)).astype(np.float32)
+        p = tmp_path / "x.fbin"
+        write_bin(p, data, use_native=use_native)
+        with BinDataset(p, use_native=use_native) as ds:
+            with pytest.raises(IndexError):
+                ds.read(5, 20)
+
+    def test_truncated_file_rejected(self, tmp_path, use_native):
+        p = tmp_path / "bad.fbin"
+        with open(p, "wb") as fh:
+            np.asarray([1000, 128], np.int32).tofile(fh)
+            np.zeros(10, np.float32).tofile(fh)  # far too few
+        with pytest.raises(IOError):
+            BinDataset(p, use_native=use_native)
+
+    def test_unknown_suffix(self, tmp_path):
+        with pytest.raises(ValueError):
+            BinDataset(tmp_path / "x.weird")
+
+    def test_cross_impl_compat(self, tmp_path, rng_np):
+        # files written by the native writer read back via numpy & vice versa
+        if not native_available():
+            pytest.skip("native IO library not built")
+        data = rng_np.standard_normal((50, 12)).astype(np.float32)
+        p1 = tmp_path / "a.fbin"
+        p2 = tmp_path / "b.fbin"
+        write_bin(p1, data, use_native=True)
+        write_bin(p2, data, use_native=False)
+        np.testing.assert_array_equal(read_bin(p1, use_native=False), data)
+        np.testing.assert_array_equal(read_bin(p2, use_native=True), data)
+
+    def test_threaded_large_read(self, tmp_path, rng_np):
+        if not native_available():
+            pytest.skip("native IO library not built")
+        # > 4 MB so the threaded path engages
+        data = rng_np.standard_normal((40000, 32)).astype(np.float32)
+        p = tmp_path / "big.fbin"
+        write_bin(p, data)
+        with BinDataset(p, use_native=True) as ds:
+            np.testing.assert_array_equal(ds.read(n_threads=8), data)

@@ -45,9 +45,11 @@ class RngState:
         self._counter += n
 
 
-def _key_of(rng: "RngState | jax.Array") -> jax.Array:
+def _key_of(rng: "RngState | jax.Array | int") -> jax.Array:
     if isinstance(rng, RngState):
         return rng.key()
+    if isinstance(rng, int):
+        return jax.random.key(rng)
     return rng
 
 

@@ -15,23 +15,26 @@ from typing import Optional
 
 import numpy as np
 
+from raft_tpu.distance.types import DistanceType
 from raft_tpu.io import write_bin
+
+# metric.txt name → framework metric; shared by the runner and the
+# groundtruth generator so the accepted sets can't drift apart
+METRICS = {
+    "euclidean": DistanceType.L2SqrtExpanded,
+    "sqeuclidean": DistanceType.L2Expanded,
+    "inner_product": DistanceType.InnerProduct,
+    "angular": DistanceType.CosineExpanded,
+}
 
 
 def _groundtruth(base: np.ndarray, queries: np.ndarray, k: int,
                  metric: str = "euclidean"):
     """Exact groundtruth via the framework's own brute force (on the
     default backend)."""
-    from raft_tpu.distance.types import DistanceType
     from raft_tpu.neighbors import brute_force
 
-    m = {
-        "euclidean": DistanceType.L2SqrtExpanded,
-        "sqeuclidean": DistanceType.L2Expanded,
-        "inner_product": DistanceType.InnerProduct,
-        "angular": DistanceType.CosineExpanded,
-    }[metric]
-    d, i = brute_force.knn(None, base, queries, k, m)
+    d, i = brute_force.knn(None, base, queries, k, METRICS[metric])
     return np.asarray(d), np.asarray(i)
 
 

@@ -18,16 +18,9 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
-from raft_tpu.distance.types import DistanceType
+from raft_tpu.bench.datasets import METRICS
 from raft_tpu.io import read_bin
 from raft_tpu.utils.recall import eval_recall
-
-_METRICS = {
-    "euclidean": DistanceType.L2SqrtExpanded,
-    "sqeuclidean": DistanceType.L2Expanded,
-    "inner_product": DistanceType.InnerProduct,
-    "angular": DistanceType.CosineExpanded,
-}
 
 
 @dataclasses.dataclass
@@ -156,7 +149,7 @@ def run_benchmark(
     gt = read_bin(dataset_dir / "groundtruth.neighbors.ibin")
     metric_name = (dataset_dir / "metric.txt").read_text().strip() \
         if (dataset_dir / "metric.txt").exists() else "euclidean"
-    metric = _METRICS[metric_name]
+    metric = METRICS[metric_name]
     if max_base_rows:
         base = base[:max_base_rows]
         gt = None  # groundtruth invalidated by truncation
@@ -165,7 +158,7 @@ def run_benchmark(
 
     results = []
     out_file = out_dir / "results.jsonl"
-    with open(out_file, "a") as fh:
+    with open(out_file, "w") as fh:
         for algo_cfg in config["algos"]:
             algo = ALGO_REGISTRY[algo_cfg["name"]]
             build_params = algo_cfg.get("build", {})
@@ -174,9 +167,14 @@ def run_benchmark(
             build_s = time.perf_counter() - t0
 
             for search_params in algo_cfg.get("search", [{}]):
-                # warm (compile) on the first batch
-                qb = queries[:batch_size]
-                _block(algo.search(index, qb, k, **search_params))
+                # warm (compile) every batch shape, including a ragged
+                # final batch, so no compile lands in the timed loop
+                _block(algo.search(index, queries[:batch_size], k,
+                                   **search_params))
+                tail = queries.shape[0] % batch_size
+                if tail:
+                    _block(algo.search(index, queries[-tail:], k,
+                                       **search_params))
                 t0 = time.perf_counter()
                 n_done = 0
                 all_i = []
@@ -210,17 +208,22 @@ def run_benchmark(
     return results
 
 
+def _load_rows(results_dir: pathlib.Path) -> List[Dict[str, Any]]:
+    rows = []
+    for f in sorted(results_dir.glob("*.jsonl")):
+        for line in f.read_text().splitlines():
+            if line.strip():
+                rows.append(json.loads(line))
+    return rows
+
+
 def export_csv(results_dir, out_path=None) -> pathlib.Path:
     """JSON-lines → CSV — the ``data_export`` subcommand."""
     import csv
 
     results_dir = pathlib.Path(results_dir)
     out_path = pathlib.Path(out_path or results_dir / "results.csv")
-    rows = []
-    for f in sorted(results_dir.glob("*.jsonl")):
-        for line in f.read_text().splitlines():
-            if line.strip():
-                rows.append(json.loads(line))
+    rows = _load_rows(results_dir)
     if not rows:
         raise FileNotFoundError(f"no results under {results_dir}")
     cols = ["dataset", "algo", "build_params", "search_params", "k",
@@ -244,11 +247,7 @@ def plot_results(results_dir, out_path=None) -> pathlib.Path:
 
     results_dir = pathlib.Path(results_dir)
     out_path = pathlib.Path(out_path or results_dir / "recall_vs_qps.png")
-    rows = []
-    for f in sorted(results_dir.glob("*.jsonl")):
-        for line in f.read_text().splitlines():
-            if line.strip():
-                rows.append(json.loads(line))
+    rows = _load_rows(results_dir)
     algos = sorted({r["algo"] for r in rows})
     fig, ax = plt.subplots(figsize=(7, 5))
     for algo in algos:

@@ -106,7 +106,13 @@ class BinDataset:
         self.dtype = _dtype_for(self.path)
         if use_native is None:
             use_native = native_available()
-        self._native = use_native and native_available()
+        elif use_native and not native_available():
+            raise IOError(
+                "use_native=True but the native IO library is unavailable "
+                "(build failed or no toolchain); pass use_native=None to "
+                "allow the numpy fallback"
+            )
+        self._native = use_native
         if self._native:
             lib = _load_native()
             handle = lib.rt_io_open(
@@ -193,7 +199,13 @@ def write_bin(path, data: np.ndarray, *,
         raise ValueError("write_bin expects (n, d) data")
     if use_native is None:
         use_native = native_available()
-    if use_native and native_available():
+    elif use_native and not native_available():
+        raise IOError(
+            "use_native=True but the native IO library is unavailable "
+            "(build failed or no toolchain); pass use_native=None to "
+            "allow the numpy fallback"
+        )
+    if use_native:
         lib = _load_native()
         h = lib.rt_io_create(str(path).encode(), data.shape[0],
                              data.shape[1], data.dtype.itemsize)

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Test orchestration — role of the reference's ci/test_python.sh /
+# test_cpp.sh (pytest + ctest). One suite here: the Python tests cover
+# the whole framework; the native IO library is built on demand by the
+# io module and exercised through tests/test_io.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m pytest tests/ -q "$@"

@@ -1,0 +1,43 @@
+"""CAGRA end-to-end example — analog of the reference template project's
+``cpp/template/src/cagra_example.cu``: build the graph index two ways
+(IVF-PQ batches vs NN-descent), search, and measure recall.
+
+Run:  PYTHONPATH=.. python cagra_example.py
+"""
+
+import numpy as np
+import scipy.spatial.distance as spd
+
+from raft_tpu import Resources
+from raft_tpu.neighbors import cagra
+from raft_tpu.utils import eval_recall
+
+N, DIM, N_QUERIES, K = 20_000, 64, 100, 10
+
+
+def main():
+    res = Resources(seed=0)
+    rng = np.random.default_rng(0)
+    centers = rng.standard_normal((64, DIM)).astype(np.float32) * 4
+    dataset = (centers[rng.integers(0, 64, N)]
+               + rng.standard_normal((N, DIM))).astype(np.float32)
+    queries = (centers[rng.integers(0, 64, N_QUERIES)]
+               + rng.standard_normal((N_QUERIES, DIM))).astype(np.float32)
+
+    gt = np.argsort(spd.cdist(queries, dataset, "sqeuclidean"),
+                    axis=1, kind="stable")[:, :K]
+
+    for algo in (cagra.BuildAlgo.NN_DESCENT, cagra.BuildAlgo.IVF_PQ):
+        params = cagra.CagraIndexParams(
+            graph_degree=32, intermediate_graph_degree=64, build_algo=algo)
+        index = cagra.build(res, params, dataset)
+        # search_width widens both the per-iteration expansion and the
+        # random seed pool — the lever that matters on clustered data
+        sp = cagra.CagraSearchParams(itopk_size=64, search_width=4)
+        dist, idx = cagra.search(res, sp, index, queries, K)
+        recall, _, _ = eval_recall(gt, np.asarray(idx))
+        print(f"cagra[{algo.value}] recall@{K} = {recall:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -32,7 +32,9 @@ def main(argv=None):
 
     p = sub.add_parser("run", help="run benchmarks from a JSON config")
     p.add_argument("--dataset", required=True, help="dataset directory")
-    p.add_argument("--config", required=True, help="JSON config path")
+    p.add_argument("--config", required=True,
+                   help="JSON config path, or the name of a bundled config "
+                        "under raft_tpu/bench/conf (e.g. sift-128-euclidean)")
     p.add_argument("--out-dir", default="results")
     p.add_argument("-k", type=int, default=10)
     p.add_argument("--batch-size", type=int, default=0)
@@ -64,7 +66,16 @@ def main(argv=None):
     elif args.cmd == "run":
         from raft_tpu.bench.runner import run_benchmark
 
-        config = json.loads(pathlib.Path(args.config).read_text())
+        cfg_path = pathlib.Path(args.config)
+        if not cfg_path.exists():
+            bundled = (pathlib.Path(__file__).parent / "conf"
+                       / f"{args.config}.json")
+            if bundled.exists():
+                cfg_path = bundled
+            else:
+                parser.error(f"config {args.config!r} not found (no such "
+                             f"file and no bundled conf/{args.config}.json)")
+        config = json.loads(cfg_path.read_text())
         rows = run_benchmark(
             args.dataset, config, args.out_dir, k=args.k,
             batch_size=args.batch_size, search_iters=args.search_iters,

@@ -16,6 +16,7 @@ from raft_tpu.core.serialize import (
     deserialize_scalar,
 )
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.core import operators
 from raft_tpu.core.validation import (
     expect,
     check_matrix,
@@ -34,6 +35,7 @@ __all__ = [
     "serialize_scalar",
     "deserialize_scalar",
     "Bitset",
+    "operators",
     "expect",
     "check_matrix",
     "check_vector",

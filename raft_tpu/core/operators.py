@@ -1,0 +1,171 @@
+"""Composable element-wise operators — analog of ``core/operators.hpp``
+(``sq_op``, ``add_op``, ``key_op``…), the lambda vocabulary the reference
+plugs into its kernels. In JAX these are plain callables usable with
+:func:`raft_tpu.linalg.unary_op` / ``map_reduce`` / ``reduce`` and
+directly inside jitted code.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "identity_op", "void_op", "sq_op", "abs_op", "sqrt_op", "nz_op",
+    "add_op", "sub_op", "mul_op", "div_op", "div_checkzero_op",
+    "pow_op", "min_op", "max_op", "mod_op", "equal_op", "notequal_op",
+    "greater_op", "less_op", "greater_or_equal_op", "less_or_equal_op",
+    "const_op", "plug_const_op", "add_const_op", "sub_const_op",
+    "mul_const_op", "div_const_op", "pow_const_op",
+    "key_op", "value_op", "compose_op", "map_args_op",
+]
+
+
+def identity_op(x, *_):
+    return x
+
+
+def void_op(*_):
+    return None
+
+
+def sq_op(x, *_):
+    return x * x
+
+
+def abs_op(x, *_):
+    return jnp.abs(x)
+
+
+def sqrt_op(x, *_):
+    return jnp.sqrt(x)
+
+
+def nz_op(x, *_):
+    """1 where nonzero else 0 (``nz_op``)."""
+    return (x != 0).astype(x.dtype)
+
+
+def add_op(a, b):
+    return a + b
+
+
+def sub_op(a, b):
+    return a - b
+
+
+def mul_op(a, b):
+    return a * b
+
+
+def div_op(a, b):
+    return a / b
+
+
+def div_checkzero_op(a, b):
+    """a / b, 0 where b == 0 (``div_checkzero_op``)."""
+    return jnp.where(b == 0, jnp.zeros_like(a / jnp.where(b == 0, 1, b)),
+                     a / jnp.where(b == 0, 1, b))
+
+
+def pow_op(a, b):
+    return jnp.power(a, b)
+
+
+def min_op(a, b):
+    return jnp.minimum(a, b)
+
+
+def max_op(a, b):
+    return jnp.maximum(a, b)
+
+
+def mod_op(a, b):
+    return jnp.mod(a, b)
+
+
+def equal_op(a, b):
+    return a == b
+
+
+def notequal_op(a, b):
+    return a != b
+
+
+def greater_op(a, b):
+    return a > b
+
+
+def less_op(a, b):
+    return a < b
+
+
+def greater_or_equal_op(a, b):
+    return a >= b
+
+
+def less_or_equal_op(a, b):
+    return a <= b
+
+
+def key_op(kvp, *_):
+    """First element of a (key, value) pair (``argmin_op``/``key_op``)."""
+    return kvp[0]
+
+
+def value_op(kvp, *_):
+    return kvp[1]
+
+
+def const_op(value):
+    """Ignore inputs, return ``value`` (``const_op``)."""
+    return lambda *_: value
+
+
+def plug_const_op(value, op, side: str = "right"):
+    """Bind one operand of a binary op (``plug_const_op``)."""
+    if side == "right":
+        return lambda x, *_: op(x, value)
+    return lambda x, *_: op(value, x)
+
+
+def add_const_op(value):
+    return plug_const_op(value, add_op)
+
+
+def sub_const_op(value):
+    return plug_const_op(value, sub_op)
+
+
+def mul_const_op(value):
+    return plug_const_op(value, mul_op)
+
+
+def div_const_op(value):
+    return plug_const_op(value, div_op)
+
+
+def pow_const_op(value):
+    return plug_const_op(value, pow_op)
+
+
+def compose_op(*ops):
+    """Apply ops innermost-last: ``compose_op(f, g)(x) == f(g(x))``
+    (``compose_op``)."""
+
+    def composed(*args):
+        out = ops[-1](*args)
+        for op in reversed(ops[:-1]):
+            out = op(out)
+        return out
+
+    return composed
+
+
+def map_args_op(op, *arg_ops):
+    """Feed each argument through its own unary op before ``op``
+    (``map_args_op``)."""
+
+    def mapped(*args):
+        return op(*(f(a) for f, a in zip(arg_ops, args)))
+
+    return mapped

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core import tracing
+from raft_tpu.core.bitset import Bitset, test_words
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.serialize import (
     check_version,
@@ -351,15 +352,22 @@ def _buffer_merge(ids, dists, explored, cand_ids, cand_d, L: int):
 
 
 @partial(jax.jit, static_argnames=("k", "L", "w", "max_iters", "metric"))
-def _search_batch(dataset, graph, queries, seed_ids, k: int, L: int, w: int,
-                  max_iters: int, metric: DistanceType):
+def _search_batch(dataset, graph, queries, seed_ids, filter_words,
+                  k: int, L: int, w: int, max_iters: int,
+                  metric: DistanceType):
     q, dim = queries.shape
     n, deg = graph.shape
     qf = queries.astype(jnp.float32)
     ip_metric = metric == DistanceType.InnerProduct
 
     def score(cand):                                     # (q, c) ids → dists
-        return gathered_distances(qf, dataset, cand, metric)
+        d = gathered_distances(qf, dataset, cand, metric)
+        if filter_words is not None:
+            # filtered-out samples never enter the itopk buffer, so they
+            # are neither returned nor expanded (the reference's
+            # search_with_filtering greenlight semantics)
+            d = jnp.where(test_words(filter_words, cand), d, jnp.inf)
+        return d
 
     # random seeding (role of the reference's random_samplings)
     seed_d = score(seed_ids)
@@ -395,7 +403,10 @@ def _search_batch(dataset, graph, queries, seed_ids, k: int, L: int, w: int,
         cond, body, (ids, dists, explored, jnp.zeros((), jnp.int32))
     )
 
-    out_d, out_i = dists[:, :k], ids[:, :k]
+    # entries never scored finite (e.g. everything a filter rejected)
+    # report index -1, like the ivf search paths
+    out_d = dists[:, :k]
+    out_i = jnp.where(jnp.isfinite(out_d), ids[:, :k], -1)
     if ip_metric:
         out_d = -out_d
     elif metric == DistanceType.L2SqrtExpanded:
@@ -410,9 +421,12 @@ def search(
     index: CagraIndex,
     queries,
     k: int,
+    sample_filter: Optional[Bitset] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Graph beam search — ``cagra::search`` → ``search_main``
-    (``detail/cagra/cagra_search.cuh:105``)."""
+    (``detail/cagra/cagra_search.cuh:105``). With ``sample_filter``,
+    only samples whose bit is set may be returned or expanded
+    (``cagra::search_with_filtering``, ``cagra.cuh:430``)."""
     res = ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -425,6 +439,7 @@ def search(
     max_iters = params.max_iterations or (L // w + 24)
     n_seeds = max(L, w * index.graph_degree) * max(1, params.num_random_samplings)
     n_seeds = min(n_seeds, n)
+    filter_words = None if sample_filter is None else sample_filter.words
 
     with tracing.range("raft_tpu.cagra.search"):
         outs_d, outs_i = [], []
@@ -438,7 +453,8 @@ def search(
                 key, (qt.shape[0], n_seeds), 0, n, jnp.int32
             )
             d, i = _search_batch(index.dataset, index.graph, qt, seeds,
-                                 k, L, w, max_iters, index.metric)
+                                 filter_words, k, L, w, max_iters,
+                                 index.metric)
             outs_d.append(d)
             outs_i.append(i)
         if len(outs_d) == 1:

@@ -1,0 +1,7 @@
+"""Legacy ``spatial.knn`` alias layer — the reference keeps a deprecated
+forwarding API (``raft/spatial/knn/knn.cuh:89,125``) so existing callers
+keep working after the ``neighbors`` rename. Same courtesy here."""
+
+from raft_tpu.spatial import knn
+
+__all__ = ["knn"]

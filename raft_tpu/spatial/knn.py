@@ -1,0 +1,28 @@
+"""Deprecated forwarding shims for the pre-``neighbors`` API surface
+(``raft/spatial/knn/knn.cuh``). New code should import from
+:mod:`raft_tpu.neighbors`."""
+
+import warnings
+
+from raft_tpu.neighbors.ball_cover import (  # noqa: F401
+    BallCoverIndex,
+    build_index as ball_cover_build_index,
+    knn_query as ball_cover_knn_query,
+)
+from raft_tpu.neighbors.brute_force import knn as _bf_knn
+
+
+def brute_force_knn(res, dataset, queries, k, metric=None, metric_arg=2.0):
+    """``spatial::knn::brute_force_knn`` → ``neighbors::brute_force::knn``."""
+    warnings.warn(
+        "raft_tpu.spatial.knn is deprecated; use raft_tpu.neighbors",
+        DeprecationWarning,
+        stacklevel=2,
+    )
+    from raft_tpu.distance.types import DistanceType
+
+    metric = DistanceType.L2Expanded if metric is None else metric
+    return _bf_knn(res, dataset, queries, k, metric, metric_arg)
+
+
+knn = brute_force_knn

@@ -157,3 +157,32 @@ class TestCagraSearch:
         loaded = cagra.load(None, buf, dataset=x)
         np.testing.assert_array_equal(np.asarray(index.graph),
                                       np.asarray(loaded.graph))
+
+
+class TestCagraFilter:
+    def test_sample_filter(self, dataset):
+        """search_with_filtering semantics: filtered-out ids never
+        returned; recall over the allowed subset stays high."""
+        from raft_tpu.core.bitset import Bitset
+
+        x, q = dataset
+        params = CagraIndexParams(graph_degree=32,
+                                  intermediate_graph_degree=64,
+                                  build_algo=BuildAlgo.NN_DESCENT)
+        index = cagra.build(None, params, x)
+        mask = np.ones(len(x), bool)
+        mask[::2] = False  # remove even ids
+        filt = Bitset.from_mask(mask)
+        sp = CagraSearchParams(itopk_size=64)
+        _, idx = cagra.search(None, sp, index, q, 10, sample_filter=filt)
+        idx = np.asarray(idx)
+        valid = idx[idx >= 0]
+        assert valid.size > 0
+        assert (valid % 2 == 1).all()
+
+        # recall against the filtered ground truth
+        d = spd.cdist(q, x, "sqeuclidean")
+        d[:, ~mask] = np.inf
+        gt = np.argsort(d, axis=1, kind="stable")[:, :10]
+        r, _, _ = eval_recall(gt, idx)
+        assert r >= 0.7, r

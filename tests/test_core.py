@@ -139,3 +139,39 @@ class TestInterruptible:
 
     def test_synchronize(self):
         interruptible.synchronize(jnp.ones((4,)))
+
+
+class TestOperators:
+    """core/operators.hpp functor vocabulary."""
+
+    def test_basic_ops(self):
+        import jax.numpy as jnp
+
+        from raft_tpu.core import operators as op
+
+        x = jnp.float32(-3.0)
+        assert float(op.sq_op(x)) == 9.0
+        assert float(op.abs_op(x)) == 3.0
+        assert float(op.nz_op(jnp.float32(0.0))) == 0.0
+        assert float(op.compose_op(op.sqrt_op, op.sq_op)(x)) == 3.0
+        assert float(op.div_checkzero_op(jnp.float32(4), jnp.float32(0))) == 0
+        assert float(op.plug_const_op(2.0, op.pow_op)(jnp.float32(3))) == 9.0
+        assert op.key_op((1, 2.5)) == 1 and op.value_op((1, 2.5)) == 2.5
+        add3 = op.map_args_op(op.add_op, op.sq_op, op.identity_op)
+        assert float(add3(jnp.float32(2), jnp.float32(1))) == 5.0
+
+
+class TestSpatialAlias:
+    def test_deprecated_forwarding(self):
+        import warnings
+
+        import numpy as np
+
+        from raft_tpu.spatial import knn as spatial_knn
+
+        x = np.random.default_rng(0).standard_normal((50, 8)).astype(np.float32)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            d, i = spatial_knn.brute_force_knn(None, x, x[:4], 3)
+            assert any(issubclass(x.category, DeprecationWarning) for x in w)
+        assert np.asarray(i)[:, 0].tolist() == [0, 1, 2, 3]

@@ -1,39 +1,60 @@
 #!/usr/bin/env python
-"""Headline benchmark — one JSON line for the driver.
+"""Headline benchmark — one JSON line on stdout for the driver.
 
-Current flagship config: exact brute-force kNN on SIFT-shaped synthetic
-data (1M × 128 float32, k=10, query batch 10 — the reference's
-"batch size 10" headline regime, ``docs/source/raft_ann_benchmarks.md``).
-Exact search ⇒ recall@10 is 1.0 by construction; the figure of merit is
-QPS.
+Flagship config: exact brute-force kNN on SIFT-shaped synthetic data
+(1M × 128 float32, k=10, query batch 10 — the reference's "batch size
+10" headline regime, ``docs/source/raft_ann_benchmarks.md``). Exact
+search ⇒ recall@10 is 1.0 by construction; the figure of merit is QPS.
 
 ``vs_baseline`` normalizes QPS by the single-chip HBM roofline for this
 config: each batch must stream the whole dataset (512 MB) from HBM, so
-roofline QPS = batch · BW / bytes  =  10 · 819e9 / 512e6 ≈ 16k QPS on
-TPU v5e. A value of 1.0 means memory-bound optimal; >1 means the cache/
-fusion behavior beats the naive stream estimate. (The reference repo
-publishes no numeric tables to compare against — see BASELINE.md.)
+roofline QPS = batch · BW / bytes = 10 · 819e9 / 512e6 ≈ 16k QPS on
+TPU v5e. A value of 1.0 means memory-bound optimal. (The reference
+repo publishes no numeric tables to compare against — see BASELINE.md.)
+
+Progress goes to stderr so a slow run is diagnosable; stdout carries
+exactly one JSON line. Env knobs: BENCH_N / BENCH_DIM / BENCH_BATCH /
+BENCH_K / BENCH_SECONDS (measurement budget, default 45).
 """
 
 import json
+import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+T0 = time.perf_counter()
 
-from raft_tpu.neighbors import brute_force
-
-N, D, K, BATCH = 1_000_000, 128, 10, 10
+N = int(os.environ.get("BENCH_N", 1_000_000))
+D = int(os.environ.get("BENCH_DIM", 128))
+BATCH = int(os.environ.get("BENCH_BATCH", 10))
+K = int(os.environ.get("BENCH_K", 10))
+BUDGET_S = float(os.environ.get("BENCH_SECONDS", 45))
 V5E_HBM_BYTES_PER_S = 819e9
 ROOFLINE_QPS = BATCH * V5E_HBM_BYTES_PER_S / (N * D * 4)
 
 
+def log(msg):
+    print(f"[bench +{time.perf_counter() - T0:.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
 def main():
+    log(f"importing jax (config {N}x{D}, batch {BATCH}, k {K})")
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import brute_force
+
+    log(f"backend: {jax.default_backend()}")
     key = jax.random.key(0)
     kd, kq = jax.random.split(key)
     dataset = jax.random.normal(kd, (N, D), jnp.float32)
     queries = jax.random.normal(kq, (BATCH, D), jnp.float32)
+    jax.block_until_ready((dataset, queries))
+    log("data generated")
     index = brute_force.build(None, dataset)
+    jax.block_until_ready(index.norms)
+    log("index built (norms cached)")
 
     def run():
         d, i = brute_force.search(None, index, queries, K, db_tile=262144)
@@ -41,15 +62,25 @@ def main():
         return d, i
 
     run()  # compile + warm
-    n_iters = 20
-    t0 = time.perf_counter()
-    for _ in range(n_iters):
+    log("compiled + warmed")
+
+    # time-boxed measurement: as many iterations as fit in the budget,
+    # minimum 3, maximum 50
+    times = []
+    t_meas = time.perf_counter()
+    while len(times) < 50 and (
+        len(times) < 3 or time.perf_counter() - t_meas < BUDGET_S
+    ):
+        t0 = time.perf_counter()
         run()
-    dt = (time.perf_counter() - t0) / n_iters
+        times.append(time.perf_counter() - t0)
+    dt = min(times)  # best-of: steady-state throughput
     qps = BATCH / dt
+    log(f"{len(times)} iters, best {dt * 1e3:.1f} ms, "
+        f"median {sorted(times)[len(times) // 2] * 1e3:.1f} ms")
 
     print(json.dumps({
-        "metric": "brute_force_knn_qps_sift1m_shape_b10_k10",
+        "metric": f"brute_force_knn_qps_sift1m_shape_b{BATCH}_k{K}",
         "value": round(qps, 2),
         "unit": "QPS",
         "vs_baseline": round(qps / ROOFLINE_QPS, 4),

@@ -137,19 +137,53 @@ class Resources:
 DeviceResources = Resources
 
 
-_default_resources: Optional[Resources] = None
-_default_resources_lock = threading.Lock()
+class ResourcesManager:
+    """Process-wide per-device pool of ``Resources`` handles — the analog
+    of ``raft::device_resources_manager`` (``core/
+    device_resources_manager.hpp:49-154``), which hands multi-threaded
+    servers a shared, pre-configured handle per GPU.
+
+    Defaults set via ``set_*`` before first use apply to every handle the
+    manager creates (mirroring the reference's set-then-freeze params);
+    later calls simply return the cached handle.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles: dict[Optional[int], Resources] = {}
+        self._defaults: dict[str, Any] = {}
+
+    def set_seed(self, seed: int) -> None:
+        self._defaults["seed"] = seed
+
+    def set_matmul_precision(self, precision: str) -> None:
+        self._defaults["matmul_precision"] = precision
+
+    def set_workspace_limit_bytes(self, n: int) -> None:
+        self._defaults["workspace_limit_bytes"] = n
+
+    def get_device_resources(
+        self, device: "Optional[jax.Device | int]" = None
+    ) -> Resources:
+        """The shared handle for ``device`` (an int id, a device object, or
+        None for default placement) — ``get_device_resources()``."""
+        if isinstance(device, int):
+            device = jax.devices()[device]
+        key = None if device is None else device.id
+        with self._lock:
+            if key not in self._handles:
+                self._handles[key] = Resources(device=device,
+                                               **self._defaults)
+            return self._handles[key]
+
+
+resources_manager = ResourcesManager()
 
 
 def get_default_resources() -> Resources:
-    """Process-wide default handle, analog of ``device_resources_manager``
-    (``core/device_resources_manager.hpp:49-154``): callers that do not
-    care about placement share one lazily-created ``Resources``."""
-    global _default_resources
-    with _default_resources_lock:
-        if _default_resources is None:
-            _default_resources = Resources()
-        return _default_resources
+    """Process-wide default handle: callers that do not care about
+    placement share one lazily-created ``Resources``."""
+    return resources_manager.get_device_resources(None)
 
 
 def ensure_resources(res: Optional[Resources]) -> Resources:

@@ -8,6 +8,7 @@ from raft_tpu.neighbors import epsilon_neighborhood
 from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors import ivf_pq
 from raft_tpu.neighbors import nn_descent
+from raft_tpu.neighbors import quantized
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
 # pylibraft parity: ``neighbors.refine`` is the function (the submodule
@@ -23,6 +24,7 @@ __all__ = [
     "ivf_flat",
     "ivf_pq",
     "nn_descent",
+    "quantized",
     "refine",
     "IndexParams",
     "SearchParams",

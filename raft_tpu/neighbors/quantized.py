@@ -1,0 +1,226 @@
+"""Scalar-quantized (8-bit) exact kNN — the role of the reference's
+``ann_quantized`` wrapper (``spatial/knn/detail/ann_quantized.cuh``),
+which trains an 8-bit quantizer over the dataset and searches in the
+compressed domain.
+
+TPU re-design: affine int8 quantization ``x ≈ scale · (q - zero)`` with a
+single global (scale, zero) pair fitted to the data range. Search runs
+the q·dataset inner products as an **int8 × int8 MXU matmul with int32
+accumulation** — the TPU's highest-throughput matmul mode — and expands
+the affine terms algebraically:
+
+    <x, y>  ≈ s² (<qx, qy> - z·Σqx - z·Σqy + d·z²)
+
+so L2/IP/cosine distances need only the int32 Gram tile plus cheap
+per-row sums. 4x less HBM traffic than fp32 brute force and ~4x more
+MACs per cycle; recall loss is the quantization error (tiny for k well
+below the distance-gap scale).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.serialize import (
+    check_version,
+    deserialize_array,
+    deserialize_scalar,
+    open_maybe_path,
+    serialize_array,
+    serialize_scalar,
+)
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.matrix.select_k import merge_topk
+
+_SERIALIZATION_VERSION = 1
+
+_SUPPORTED = (
+    DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded,
+    DistanceType.InnerProduct,
+)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class QuantizedIndex:
+    """int8 codes + affine parameters + cached code row sums."""
+
+    codes: jax.Array        # (n, d) int8
+    row_sums: jax.Array     # (n,) int32  Σ codes per row
+    scale: float
+    zero: float
+    metric: DistanceType
+
+    def tree_flatten(self):
+        return (self.codes, self.row_sums), (self.scale, self.zero,
+                                             self.metric)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux[0], aux[1], aux[2])
+
+    @property
+    def size(self) -> int:
+        return self.codes.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.codes.shape[1]
+
+
+def build(
+    res: Optional[Resources],
+    dataset,
+    metric: DistanceType = DistanceType.L2Expanded,
+) -> QuantizedIndex:
+    """Fit the affine quantizer and encode the dataset."""
+    res = ensure_resources(res)
+    dataset = jnp.asarray(dataset, jnp.float32)
+    expect(dataset.ndim == 2, "dataset must be (n, d)")
+    expect(DistanceType(metric) in _SUPPORTED,
+           f"quantized knn supports L2/InnerProduct, got {metric!r}")
+    with tracing.range("raft_tpu.quantized.build"):
+        lo = jnp.min(dataset)
+        hi = jnp.max(dataset)
+        scale = float(jnp.maximum(hi - lo, 1e-12)) / 254.0
+        zero = float(lo) / scale + 127.0  # maps lo → -127
+        codes = jnp.clip(jnp.round(dataset / scale - zero), -127, 127)
+        codes = codes.astype(jnp.int8)
+        row_sums = jnp.sum(codes.astype(jnp.int32), axis=1)
+        return QuantizedIndex(res.put(codes), res.put(row_sums),
+                              scale, zero, DistanceType(metric))
+
+
+@partial(jax.jit, static_argnames=("k", "metric", "tile"))
+def _search_impl(q_codes, q_sums, codes, row_sums, scale: float, zero: float,
+                 k: int, metric: DistanceType, tile: int):
+    nq, d = q_codes.shape
+    n = codes.shape[0]
+    select_min = metric != DistanceType.InnerProduct
+    pad_val = jnp.inf if select_min else -jnp.inf
+
+    pad = (-n) % tile
+    cp = jnp.pad(codes, ((0, pad), (0, 0)))
+    sp = jnp.pad(row_sums, (0, pad))
+    ctiles = cp.reshape(-1, tile, d)
+    stiles = sp.reshape(-1, tile)
+
+    s2 = scale * scale
+    z = zero
+
+    def step(carry, inp):
+        best_d, best_i = carry
+        t_idx, ct, st = inp
+        # int8 × int8 → int32 Gram tile on the MXU
+        gram = jax.lax.dot_general(
+            q_codes, ct,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)
+        ip = s2 * (gram - z * q_sums[:, None] - z * st[None, :] + d * z * z)
+        if select_min:
+            qn = s2 * (jnp.sum(
+                (q_codes.astype(jnp.float32) - z) ** 2, axis=1))
+            yn = s2 * (jnp.sum(
+                (ct.astype(jnp.float32) - z) ** 2, axis=1))
+            dist = qn[:, None] + yn[None, :] - 2.0 * ip
+            dist = jnp.maximum(dist, 0.0)
+        else:
+            dist = ip
+        col_ids = t_idx * tile + jnp.arange(tile)
+        dist = jnp.where((col_ids < n)[None, :], dist, pad_val)
+        kk = min(k, tile)
+        td, tp = jax.lax.top_k(-dist if select_min else dist, kk)
+        td = -td if select_min else td
+        tgi = (t_idx * tile + tp).astype(jnp.int32)
+        return merge_topk(best_d, best_i, td, tgi, k, select_min), None
+
+    init = (
+        jnp.full((nq, k), pad_val, jnp.float32),
+        jnp.full((nq, k), -1, jnp.int32),
+    )
+    (best_d, best_i), _ = jax.lax.scan(
+        step, init, (jnp.arange(ctiles.shape[0]), ctiles, stiles)
+    )
+    if metric == DistanceType.L2SqrtExpanded:
+        best_d = jnp.where(jnp.isfinite(best_d), jnp.sqrt(best_d), best_d)
+    return best_d, best_i
+
+
+def search(
+    res: Optional[Resources],
+    index: QuantizedIndex,
+    queries,
+    k: int,
+    db_tile: int = 32768,
+) -> Tuple[jax.Array, jax.Array]:
+    """Approximate kNN over the int8 codes (distances reported in the
+    de-quantized scale)."""
+    ensure_resources(res)
+    queries = jnp.asarray(queries, jnp.float32)
+    expect(queries.ndim == 2 and queries.shape[1] == index.dim,
+           "queries must be (q, dim)")
+    expect(0 < k <= index.size, f"k must be in (0, {index.size}]")
+    with tracing.range("raft_tpu.quantized.search"):
+        q_codes = jnp.clip(jnp.round(queries / index.scale - index.zero),
+                           -127, 127).astype(jnp.int8)
+        q_sums = jnp.sum(q_codes.astype(jnp.int32), axis=1)
+        tile = min(db_tile, max(128, index.size))
+        return _search_impl(q_codes, q_sums, index.codes, index.row_sums,
+                            index.scale, index.zero, k, index.metric, tile)
+
+
+def knn(
+    res: Optional[Resources],
+    dataset,
+    queries,
+    k: int,
+    metric: DistanceType = DistanceType.L2Expanded,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-shot build + search (the ``ann_quantized`` call shape)."""
+    index = build(res, dataset, metric)
+    return search(res, index, queries, k)
+
+
+# -- serialization ----------------------------------------------------------
+
+
+def save(index: QuantizedIndex, fh_or_path) -> None:
+    fh, own = open_maybe_path(fh_or_path, "wb")
+    try:
+        serialize_scalar(fh, _SERIALIZATION_VERSION, np.int32)
+        serialize_scalar(fh, int(index.metric), np.int32)
+        serialize_scalar(fh, index.scale, np.float64)
+        serialize_scalar(fh, index.zero, np.float64)
+        serialize_array(fh, index.codes)
+        serialize_array(fh, index.row_sums)
+    finally:
+        if own:
+            fh.close()
+
+
+def load(res: Optional[Resources], fh_or_path) -> QuantizedIndex:
+    res = ensure_resources(res)
+    fh, own = open_maybe_path(fh_or_path, "rb")
+    try:
+        check_version(deserialize_scalar(fh), _SERIALIZATION_VERSION,
+                      "quantized")
+        metric = DistanceType(int(deserialize_scalar(fh)))
+        scale = float(deserialize_scalar(fh))
+        zero = float(deserialize_scalar(fh))
+        codes = res.put(deserialize_array(fh))
+        row_sums = res.put(deserialize_array(fh))
+        return QuantizedIndex(codes, row_sums, scale, zero, metric)
+    finally:
+        if own:
+            fh.close()

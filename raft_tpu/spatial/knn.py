@@ -10,6 +10,7 @@ from raft_tpu.neighbors.ball_cover import (  # noqa: F401
     knn_query as ball_cover_knn_query,
 )
 from raft_tpu.neighbors.brute_force import knn as _bf_knn
+from raft_tpu.neighbors.quantized import knn as ann_quantized_knn  # noqa: F401
 
 
 def brute_force_knn(res, dataset, queries, k, metric=None, metric_arg=2.0):

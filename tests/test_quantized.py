@@ -1,0 +1,82 @@
+"""Scalar-quantized kNN tests — the ann_quantized wrapper role
+(spatial/knn/detail/ann_quantized.cuh): recall against exact brute
+force stays high because int8 quantization error is small relative to
+neighbor distance gaps."""
+
+import io
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as spd
+
+from raft_tpu.core.resources import resources_manager
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors import quantized
+from raft_tpu.utils import eval_recall
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((5000, 32)).astype(np.float32)
+    q = rng.standard_normal((64, 32)).astype(np.float32)
+    return x, q
+
+
+class TestQuantized:
+    def test_l2_recall(self, dataset):
+        x, q = dataset
+        d, i = quantized.knn(None, x, q, 10)
+        gt = np.argsort(spd.cdist(q, x, "sqeuclidean"), axis=1,
+                        kind="stable")[:, :10]
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        assert r >= 0.95, r
+        # distances close to exact after de-quantization
+        ref = np.take_along_axis(spd.cdist(q, x, "sqeuclidean"),
+                                 np.asarray(i), axis=1)
+        np.testing.assert_allclose(np.asarray(d), ref, rtol=0.05, atol=0.5)
+        # sorted ascending
+        assert (np.diff(np.asarray(d), axis=1) >= -1e-3).all()
+
+    def test_inner_product(self, dataset):
+        x, q = dataset
+        d, i = quantized.knn(None, x, q, 10, DistanceType.InnerProduct)
+        gt = np.argsort(-(q @ x.T), axis=1, kind="stable")[:, :10]
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        assert r >= 0.9, r
+        assert (np.diff(np.asarray(d), axis=1) <= 1e-3).all()
+
+    def test_l2sqrt(self, dataset):
+        x, q = dataset
+        index = quantized.build(None, x, DistanceType.L2SqrtExpanded)
+        d, i = quantized.search(None, index, q, 5)
+        ref = np.take_along_axis(spd.cdist(q, x), np.asarray(i), axis=1)
+        np.testing.assert_allclose(np.asarray(d), ref, rtol=0.05, atol=0.1)
+
+    def test_serialization_roundtrip(self, dataset):
+        x, q = dataset
+        index = quantized.build(None, x)
+        buf = io.BytesIO()
+        quantized.save(index, buf)
+        buf.seek(0)
+        index2 = quantized.load(None, buf)
+        d1, i1 = quantized.search(None, index, q, 10)
+        d2, i2 = quantized.search(None, index2, q, 10)
+        assert np.array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2))
+
+    def test_unsupported_metric(self, dataset):
+        x, _ = dataset
+        with pytest.raises(Exception):
+            quantized.build(None, x, DistanceType.Canberra)
+
+
+class TestResourcesManager:
+    def test_per_device_pooling(self):
+        import jax
+
+        r0 = resources_manager.get_device_resources(0)
+        assert r0 is resources_manager.get_device_resources(jax.devices()[0])
+        assert r0 is not resources_manager.get_device_resources(1)
+        assert resources_manager.get_device_resources(None) is \
+            resources_manager.get_device_resources(None)

@@ -12,11 +12,15 @@ Two composition patterns, mirroring the reference:
   and merged at query time — raft-dask's index-per-worker pattern.
 """
 
+from raft_tpu.distributed import ivf as ivf_flat
+from raft_tpu.distributed.ivf import DistributedIvfFlat
 from raft_tpu.distributed.kmeans import fit as kmeans_fit
 from raft_tpu.distributed.knn import brute_force_knn
 from raft_tpu.distributed.sharded_ann import ShardedIndex, build_sharded
 
 __all__ = [
+    "DistributedIvfFlat",
+    "ivf_flat",
     "kmeans_fit",
     "brute_force_knn",
     "ShardedIndex",

@@ -1,0 +1,244 @@
+"""SPMD distributed IVF-Flat — the index itself sharded over a mesh axis.
+
+The reference scales IVF via raft-dask's index-per-worker pattern (host
+orchestration + ``knn_merge_parts``). The TPU-native form keeps ONE
+logical index whose inverted lists are block-sharded over the mesh
+(``jax.sharding``): every chip owns ``n_lists / R`` lists, the coarse
+quantizer is replicated, and a single jitted ``shard_map`` program does
+
+    local coarse top-p  →  local probe scan  →  all_gather + merge
+
+so the collectives ride ICI and no host round-trips happen per query
+(SURVEY.md §5 "TPU equivalent" note; the merge is the
+``knn_merge_parts`` pattern inside the program).
+
+Probe semantics (``probe_mode``):
+
+- ``"global"`` (default, exact): every shard ranks ALL centers (they're
+  cheap and replicated through an all_gather of the local slices),
+  takes the global top-``n_probes``, and scans the probed lists it
+  owns, masking the rest. Results match the single-device index
+  exactly; per-chip wall-clock is ~the single-chip search, while HBM
+  capacity scales with the mesh — the point of sharding at 1B rows.
+- ``"local"`` (approximate, fast): each shard probes its own top
+  ``ceil(n_probes / R)`` local lists. Lists are dealt round-robin by
+  size at build time so relevant lists spread evenly; the union
+  closely tracks the global top-``n_probes`` (the approximation
+  sharded FAISS-IVF deployments make). Per-chip scan work drops by R.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms, allgather
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType, is_min_close
+from raft_tpu.matrix.select_k import merge_topk
+from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
+from raft_tpu.neighbors.brute_force import knn_merge_parts
+from raft_tpu.neighbors.ivf_flat import IvfFlatIndexParams, IvfFlatSearchParams
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedIvfFlat:
+    """List-sharded IVF-Flat index.
+
+    Arrays with a leading ``n_lists`` axis are sharded over ``comms``'s
+    mesh axis; ``centers`` is replicated (every shard needs the full
+    codebook only for its local slice — centers are stored sharded too,
+    matching the list assignment).
+    """
+
+    comms: Comms
+    centers: jax.Array        # (n_lists, d) sharded on axis 0
+    data: jax.Array           # (n_lists, max_list_size, d) sharded
+    data_norms: jax.Array     # (n_lists, max_list_size) sharded
+    indices: jax.Array        # (n_lists, max_list_size) int32 sharded
+    list_sizes: jax.Array     # (n_lists,) sharded
+    metric: DistanceType
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jax.device_get(self.list_sizes).sum())
+
+
+def build(
+    res: Optional[Resources],
+    comms: Comms,
+    params: IvfFlatIndexParams,
+    dataset,
+) -> DistributedIvfFlat:
+    """Build a list-sharded index: global balanced-kmeans quantizer, then
+    lists dealt round-robin by population and placed shard-local.
+
+    ``params.n_lists`` is rounded up to a multiple of the mesh-axis size.
+    """
+    res = ensure_resources(res)
+    r = comms.size
+    n_lists = -(-params.n_lists // r) * r
+    params = dataclasses.replace(params, n_lists=n_lists)
+
+    with tracing.range("raft_tpu.distributed.ivf_flat.build"):
+        # single-chip build (global quantizer + packed lists), then deal
+        index = ivf_flat_mod.build(res, params, dataset)
+
+        # order lists by size so the round-robin deal balances both the
+        # populated-list count and the scan work per shard
+        sizes = np.asarray(jax.device_get(index.list_sizes))
+        order = np.argsort(-sizes, kind="stable")
+        # shard s gets order[s], order[s+r], ... — blocked layout wants
+        # shard-contiguous rows, so permute to [shard0 lists..., shard1...]
+        deal = np.concatenate([order[s::r] for s in range(r)])
+        perm = jnp.asarray(deal, jnp.int32)
+
+        shard = comms.sharding(comms.axis)              # P(axis) on dim 0
+        def place(a):
+            return jax.device_put(jnp.take(a, perm, axis=0), shard)
+
+        return DistributedIvfFlat(
+            comms=comms,
+            centers=place(index.centers),
+            data=place(index.data),
+            data_norms=place(index.data_norms),
+            indices=place(index.indices),
+            list_sizes=place(index.list_sizes),
+            metric=index.metric,
+        )
+
+
+@partial(jax.jit, static_argnames=("axis", "mesh", "n_probes", "k", "metric",
+                                   "probe_mode"))
+def _dist_search(centers, data, data_norms, indices, queries,
+                 axis: str, mesh, n_probes: int, k: int,
+                 metric: DistanceType, probe_mode: str):
+    select_min = is_min_close(metric)
+    pad_val = jnp.inf if select_min else -jnp.inf
+
+    def body(centers_l, data_l, norms_l, ids_l, qs):
+        q = qs.shape[0]
+        n_local = centers_l.shape[0]
+        qf = qs.astype(jnp.float32)
+        my_rank = jax.lax.axis_index(axis)
+
+        # coarse distances to this shard's centers
+        ip = jax.lax.dot_general(
+            qf, centers_l, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        if metric == DistanceType.InnerProduct:
+            coarse = -ip
+        else:
+            cn = jnp.sum(jnp.square(centers_l), axis=1)
+            coarse = cn[None, :] - 2.0 * ip
+
+        if probe_mode == "global":
+            # rank ALL lists: gather every shard's coarse block, take the
+            # global top-n_probes, then scan only the locally-owned ones
+            coarse_all = allgather(coarse, axis)          # (R, q, L)
+            r = coarse_all.shape[0]
+            coarse_all = jnp.moveaxis(coarse_all, 0, 1)   # (q, R, L)
+            coarse_flat = coarse_all.reshape(q, r * n_local)
+            _, probes = jax.lax.top_k(-coarse_flat, n_probes)
+            probes = probes.astype(jnp.int32)             # global list ids
+            owner = probes // n_local
+            local = probes - owner * n_local
+            mine = owner == my_rank
+        else:
+            _, probes = jax.lax.top_k(-coarse, n_probes)  # local top-p
+            local = probes.astype(jnp.int32)
+            mine = jnp.ones(local.shape, jnp.bool_)
+
+        def step(carry, rank_i):
+            best_d, best_i = carry
+            lists = local[:, rank_i]
+            valid = mine[:, rank_i]
+            rows = jnp.take(data_l, lists, axis=0).astype(jnp.float32)
+            row_norms = jnp.take(norms_l, lists, axis=0)
+            row_ids = jnp.take(ids_l, lists, axis=0)
+            ipr = jax.lax.dot_general(
+                rows, qf, (((2,), (1,)), ((0,), (0,))),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )
+            if metric == DistanceType.InnerProduct:
+                dist = ipr
+            else:
+                dist = row_norms - 2.0 * ipr
+            dist = jnp.where((row_ids >= 0) & valid[:, None], dist, pad_val)
+            return merge_topk(best_d, best_i, dist, row_ids, k,
+                              select_min), None
+
+        init = (jnp.full((q, k), pad_val, jnp.float32),
+                jnp.full((q, k), -1, jnp.int32))
+        (best_d, best_i), _ = jax.lax.scan(
+            step, init, jnp.arange(local.shape[1]))
+
+        all_d = allgather(best_d, axis)                  # (R, q, k)
+        all_i = allgather(best_i, axis)
+        return knn_merge_parts(all_d, all_i, select_min)
+
+    out_d, out_i = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None, None), P(axis, None),
+                  P(axis, None), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(centers, data, data_norms, indices, queries)
+
+    if metric != DistanceType.InnerProduct:
+        q_sq = jnp.sum(jnp.square(queries.astype(jnp.float32)), axis=1,
+                       keepdims=True)
+        out_d = jnp.where(jnp.isfinite(out_d),
+                          jnp.maximum(out_d + q_sq, 0.0), out_d)
+        if metric == DistanceType.L2SqrtExpanded:
+            out_d = jnp.where(jnp.isfinite(out_d), jnp.sqrt(out_d), out_d)
+    return out_d, out_i
+
+
+def search(
+    res: Optional[Resources],
+    params: IvfFlatSearchParams,
+    index: DistributedIvfFlat,
+    queries,
+    k: int,
+    probe_mode: str = "global",
+) -> Tuple[jax.Array, jax.Array]:
+    """One-program distributed search; returns replicated (q, k) results
+    with global row ids. See the module docstring for ``probe_mode``."""
+    ensure_resources(res)
+    queries = jnp.asarray(queries)
+    expect(queries.ndim == 2 and queries.shape[1] == index.dim,
+           "queries must be (q, dim)")
+    expect(probe_mode in ("global", "local"),
+           f"probe_mode must be 'global' or 'local', got {probe_mode!r}")
+    comms = index.comms
+    local_lists = index.n_lists // comms.size
+    n_probes = min(params.n_probes, index.n_lists)
+    if probe_mode == "local":
+        n_probes = min(-(-n_probes // comms.size), local_lists)
+    queries = jax.device_put(queries, comms.replicated())
+    with tracing.range("raft_tpu.distributed.ivf_flat.search"):
+        return _dist_search(
+            index.centers, index.data, index.data_norms, index.indices,
+            queries, comms.axis, comms.mesh, n_probes, k, index.metric,
+            probe_mode,
+        )

@@ -1,0 +1,97 @@
+"""Index-introspection helpers — analogs of ``ivf_flat_helpers.cuh`` /
+``ivf_pq_helpers.cuh`` (pack/unpack list codes, reconstruct vectors,
+extract centers). The reference needs these because its lists are opaque
+interleaved device buffers; here the layouts are dense, so the helpers
+are thin views plus the PQ decoder.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.validation import expect
+from raft_tpu.neighbors.ivf_flat import IvfFlatIndex
+from raft_tpu.neighbors.ivf_pq import CodebookKind, IvfPqIndex
+
+
+# -- IVF-Flat (``ivf_flat_helpers.cuh`` / ``ivf_flat_codepacker.hpp``) ------
+
+
+def flat_unpack_list_data(index: IvfFlatIndex, label: int) -> Tuple[jax.Array, jax.Array]:
+    """Return (vectors (size, d), source ids (size,)) of one list —
+    ``helpers::codepacker::unpack`` without the interleave undo."""
+    expect(0 <= label < index.n_lists, "bad list id")
+    size = int(index.list_sizes[label])
+    return index.data[label, :size], index.indices[label, :size]
+
+
+def flat_pack_list_data(index: IvfFlatIndex, label: int, vectors,
+                        ids) -> IvfFlatIndex:
+    """Overwrite one list's contents (``helpers::codepacker::pack``).
+    Functional: returns a new index."""
+    import dataclasses
+
+    expect(0 <= label < index.n_lists, "bad list id")
+    vectors = jnp.asarray(vectors, index.data.dtype)
+    ids = jnp.asarray(ids, jnp.int32)
+    m = index.max_list_size
+    expect(vectors.shape[0] <= m, "list overflow — extend() instead")
+    n_new = vectors.shape[0]
+    pad = m - n_new
+    row_data = jnp.pad(vectors, ((0, pad), (0, 0)))
+    row_ids = jnp.pad(ids, (0, pad), constant_values=-1)
+    data = index.data.at[label].set(row_data)
+    indices = index.indices.at[label].set(row_ids)
+    norms = jnp.sum(jnp.square(row_data.astype(jnp.float32)), axis=1)
+    norms = jnp.where(row_ids >= 0, norms, jnp.inf)
+    return dataclasses.replace(
+        index,
+        data=data,
+        data_norms=index.data_norms.at[label].set(norms),
+        indices=indices,
+        list_sizes=index.list_sizes.at[label].set(n_new),
+    )
+
+
+# -- IVF-PQ (``ivf_pq_helpers.cuh``) ----------------------------------------
+
+
+def pq_unpack_list_data(index: IvfPqIndex, label: int) -> Tuple[jax.Array, jax.Array]:
+    """(codes (size, pq_dim) uint8, ids (size,)) of one list —
+    ``helpers::codepacker::unpack_list_data``."""
+    expect(0 <= label < index.n_lists, "bad list id")
+    size = int(index.list_sizes[label])
+    return index.codes[label, :size], index.indices[label, :size]
+
+
+def pq_reconstruct_list_data(index: IvfPqIndex, label: int) -> jax.Array:
+    """Decode one list back to approximate input-space vectors —
+    ``helpers::reconstruct_list_data``:
+
+        ŷ = c + R⁺ · concat_s codebook_s[code_s]
+
+    (R is orthogonal on its range so the pseudo-inverse is Rᵀ).
+    """
+    codes, _ = pq_unpack_list_data(index, label)
+    size = codes.shape[0]
+    if index.codebook_kind == CodebookKind.PER_SUBSPACE:
+        # (size, pq_dim, pq_len): codebooks[s, code[i, s]]
+        sub = jnp.take_along_axis(
+            index.codebooks[None, :, :, :],            # (1, s, J, L)
+            codes.astype(jnp.int32)[:, :, None, None],  # (size, s, 1, 1)
+            axis=2,
+        )[:, :, 0, :]
+    else:
+        cb = index.codebooks[label]                    # (J, L)
+        sub = cb[codes.astype(jnp.int32)]              # (size, s, L)
+    flat = sub.reshape(size, index.pq_dim * index.pq_len)
+    resid = flat[:, : index.dim_ext] @ index.rotation  # (size, dim)
+    return index.centers[label][None, :] + resid
+
+
+def pq_extract_centers(index: IvfPqIndex) -> jax.Array:
+    """Cluster centers (n_lists, dim) — ``helpers::extract_centers``."""
+    return index.centers

@@ -116,3 +116,50 @@ class TestBallCover:
         adj, vd = ball_cover.eps_nn_query(res, idx, x[:10], 0.5)
         d2 = ((x[:10, None, :] - x[None, :, :]) ** 2).sum(-1)
         np.testing.assert_array_equal(np.asarray(adj), d2 <= 0.25)
+
+
+class TestIvfHelpers:
+    """ivf_flat_helpers / ivf_pq_helpers analogs."""
+
+    def test_flat_pack_unpack(self, rng_np):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.neighbors.ivf_helpers import (
+            flat_pack_list_data,
+            flat_unpack_list_data,
+        )
+
+        x = rng_np.standard_normal((500, 8)).astype(np.float32)
+        index = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+        vecs, ids = flat_unpack_list_data(index, 0)
+        assert vecs.shape[0] == int(index.list_sizes[0])
+        assert (np.asarray(ids) >= 0).all()
+        # round-trip: packing the same data back changes nothing
+        index2 = flat_pack_list_data(index, 0, vecs, ids)
+        np.testing.assert_array_equal(np.asarray(index2.data),
+                                      np.asarray(index.data))
+        np.testing.assert_array_equal(np.asarray(index2.indices),
+                                      np.asarray(index.indices))
+        # original rows recoverable
+        np.testing.assert_allclose(np.asarray(vecs), x[np.asarray(ids)])
+
+    def test_pq_reconstruct(self, rng_np):
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.neighbors.ivf_helpers import (
+            pq_extract_centers,
+            pq_reconstruct_list_data,
+            pq_unpack_list_data,
+        )
+
+        x = rng_np.standard_normal((2000, 32)).astype(np.float32)
+        index = ivf_pq.build(
+            None, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=16), x)
+        codes, ids = pq_unpack_list_data(index, 3)
+        assert codes.shape == (int(index.list_sizes[3]), 16)
+        recon = pq_reconstruct_list_data(index, 3)
+        orig = x[np.asarray(ids)]
+        # PQ reconstruction error well below data norm
+        rel = (np.linalg.norm(np.asarray(recon) - orig, axis=1)
+               / np.linalg.norm(orig, axis=1))
+        assert np.median(rel) < 0.65, np.median(rel)
+        assert pq_extract_centers(index).shape == (8, 32)

@@ -89,9 +89,10 @@ def build(
     return BruteForceIndex(dataset, norms, DistanceType(metric), metric_arg)
 
 
-@partial(jax.jit, static_argnames=("k", "metric", "metric_arg", "tile"))
+@partial(jax.jit, static_argnames=("k", "metric", "metric_arg", "tile",
+                                   "precision"))
 def _knn_scan(queries, dataset, k: int, metric: DistanceType, metric_arg: float,
-              tile: int):
+              tile: int, precision: str = "highest"):
     """Scan database tiles, carrying running top-k (the global-merge loop of
     ``tiled_brute_force_knn``)."""
     n, d = dataset.shape
@@ -107,7 +108,8 @@ def _knn_scan(queries, dataset, k: int, metric: DistanceType, metric_arg: float,
     def step(carry, inp):
         best_d, best_i = carry
         t_idx, yt = inp
-        dist = _pairwise_distance_impl(queries, yt, metric, metric_arg, "highest")
+        dist = _pairwise_distance_impl(queries, yt, metric, metric_arg,
+                                       precision)
         # mask out padding rows of the final tile
         col_ids = t_idx * tile + jnp.arange(tile)
         dist = jnp.where((col_ids < n)[None, :], dist, pad_val)
@@ -162,12 +164,13 @@ def search(
     On TPU with small k and an expanded metric this dispatches to the
     Pallas fused scan (``raft_tpu.ops.fused_knn`` — the ``fusedL2kNN``
     analog); otherwise the XLA tile-scan path runs."""
-    ensure_resources(res)
+    res = ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2, "queries must be (q, d)")
     expect(queries.shape[1] == index.dim, "query dim mismatch")
     expect(0 < k <= index.size, f"k must be in (0, {index.size}]")
     db_tile = min(db_tile, max(128, index.size))
+    precision = res.matmul_precision
     with tracing.range("raft_tpu.brute_force.search"):
         q = queries.shape[0]
         if _use_fused_kernel(index.metric, k, q):
@@ -177,11 +180,12 @@ def search(
                              tile=8192)
         if q <= query_tile:
             return _knn_scan(queries, index.dataset, k, index.metric,
-                             index.metric_arg, db_tile)
+                             index.metric_arg, db_tile, precision)
         outs_d, outs_i = [], []
         for start in range(0, q, query_tile):
             dq, iq = _knn_scan(queries[start : start + query_tile], index.dataset,
-                               k, index.metric, index.metric_arg, db_tile)
+                               k, index.metric, index.metric_arg, db_tile,
+                               precision)
             outs_d.append(dq)
             outs_i.append(iq)
         return jnp.concatenate(outs_d), jnp.concatenate(outs_i)

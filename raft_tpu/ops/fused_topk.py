@@ -70,7 +70,11 @@ def _knn_kernel(q_ref, qn_ref, x_ref, xn_ref, outd_ref, outi_ref,
 
     xt = x_ref[:].astype(jnp.float32)                            # (t, d)
     qt = q_ref[:].astype(jnp.float32)                            # (q, d)
+    # HIGHEST: exact-kNN semantics need full f32 products (the default
+    # single-pass bf16 MXU mode loses ~8 mantissa bits); this stream is
+    # HBM-bound, so the extra passes are hidden behind the loads
     ip = jax.lax.dot_general(qt, xt, (((1,), (1,)), ((), ())),
+                             precision=jax.lax.Precision.HIGHEST,
                              preferred_element_type=jnp.float32)  # (q, t)
     xn = xn_ref[:]                                               # (1, t)
     qn = qn_ref[:]                                               # (q, 1)

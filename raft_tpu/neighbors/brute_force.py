@@ -79,12 +79,21 @@ def build(
     dataset,
     metric: DistanceType = DistanceType.L2Expanded,
     metric_arg: float = 2.0,
+    storage_dtype=None,
 ) -> BruteForceIndex:
     """Construct the index (norm caching only — exact search has no train
-    step). Analog of ``brute_force::build``."""
+    step). Analog of ``brute_force::build``.
+
+    ``storage_dtype=jnp.bfloat16`` stores the dataset half-width — the
+    TPU analog of the reference's fp16 dataset support: HBM traffic (the
+    search bottleneck) halves, and bf16×bf16 MXU products are exact in
+    f32, so distances are exact *for the quantized dataset*."""
     res = ensure_resources(res)
-    dataset = res.put(jnp.asarray(dataset))
+    dataset = jnp.asarray(dataset)
     expect(dataset.ndim == 2, "dataset must be (n, d)")
+    if storage_dtype is not None:
+        dataset = dataset.astype(storage_dtype)
+    dataset = res.put(dataset)
     norms = jnp.sum(jnp.square(dataset.astype(jnp.float32)), axis=1)
     return BruteForceIndex(dataset, norms, DistanceType(metric), metric_arg)
 
@@ -171,6 +180,11 @@ def search(
     expect(0 < k <= index.size, f"k must be in (0, {index.size}]")
     db_tile = min(db_tile, max(128, index.size))
     precision = res.matmul_precision
+    if index.dataset.dtype == jnp.bfloat16:
+        # bf16 products are exact in the f32 accumulator — extra MXU
+        # passes would only re-derive the same bits
+        queries = queries.astype(jnp.bfloat16)
+        precision = "default"
     with tracing.range("raft_tpu.brute_force.search"):
         q = queries.shape[0]
         if _use_fused_kernel(index.metric, k, q):

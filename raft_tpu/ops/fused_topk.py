@@ -68,13 +68,16 @@ def _knn_kernel(q_ref, qn_ref, x_ref, xn_ref, outd_ref, outi_ref,
         bestd[:] = jnp.full_like(bestd, jnp.inf)
         besti[:] = jnp.full_like(besti, -1)
 
-    xt = x_ref[:].astype(jnp.float32)                            # (t, d)
-    qt = q_ref[:].astype(jnp.float32)                            # (q, d)
-    # HIGHEST: exact-kNN semantics need full f32 products (the default
-    # single-pass bf16 MXU mode loses ~8 mantissa bits); this stream is
-    # HBM-bound, so the extra passes are hidden behind the loads
+    xt = x_ref[:]                                                # (t, d)
+    qt = q_ref[:]                                                # (q, d)
+    # f32 inputs: HIGHEST — exact-kNN semantics need full f32 products
+    # (default single-pass bf16 loses ~8 mantissa bits), and the stream
+    # is HBM-bound so the extra passes hide behind the loads. bf16
+    # inputs: their products are already exact in the f32 accumulator.
+    prec = (jax.lax.Precision.DEFAULT if xt.dtype == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
     ip = jax.lax.dot_general(qt, xt, (((1,), (1,)), ((), ())),
-                             precision=jax.lax.Precision.HIGHEST,
+                             precision=prec,
                              preferred_element_type=jnp.float32)  # (q, t)
     xn = xn_ref[:]                                               # (1, t)
     qn = qn_ref[:]                                               # (q, 1)
@@ -140,7 +143,8 @@ def fused_knn(
     expect(dataset.shape[1] == d, "fused_knn: dim mismatch")
     expect(0 < k <= n, "fused_knn: bad k")
 
-    pad_q = (-q) % 8
+    # sublane multiple: 8 for f32 blocks, 16 for bf16
+    pad_q = (-q) % (16 if dataset.dtype == jnp.bfloat16 else 8)
     pad_d = (-d) % 128
     # VMEM budget: double-buffered (tile, d) block + (q, tile) distance
     # must fit in ~12 MB alongside scratch
@@ -149,10 +153,17 @@ def fused_knn(
     vmem_cap = max(512, (12_000_000 // (d_pad * 8 + q_pad * 8)) // 128 * 128)
     tile = min(tile, vmem_cap, max(128, ((n + 127) // 128) * 128))
     pad_n = (-n) % tile
-    qs = jnp.pad(queries.astype(jnp.float32), ((0, pad_q), (0, pad_d)))
-    xs = jnp.pad(dataset.astype(jnp.float32), ((0, pad_n), (0, pad_d)))
-    qn = jnp.sum(jnp.square(qs), axis=1, keepdims=True)           # (Q, 1)
-    xn = jnp.sum(jnp.square(xs), axis=1)[None, :]                 # (1, N)
+    # bf16 datasets stay bf16 through HBM (the point of half storage);
+    # everything else runs f32
+    if dataset.dtype == jnp.bfloat16:
+        qs = jnp.pad(queries.astype(jnp.bfloat16), ((0, pad_q), (0, pad_d)))
+        xs = jnp.pad(dataset, ((0, pad_n), (0, pad_d)))
+    else:
+        qs = jnp.pad(queries.astype(jnp.float32), ((0, pad_q), (0, pad_d)))
+        xs = jnp.pad(dataset.astype(jnp.float32), ((0, pad_n), (0, pad_d)))
+    qn = jnp.sum(jnp.square(qs.astype(jnp.float32)), axis=1,
+                 keepdims=True)                                   # (Q, 1)
+    xn = jnp.sum(jnp.square(xs.astype(jnp.float32)), axis=1)[None, :]
     qp, npad = qs.shape[0], xs.shape[0]
     grid = npad // tile
 

@@ -100,3 +100,30 @@ class TestBruteForce:
         d1, i1 = brute_force.search(None, index, q, 3)
         d2, i2 = brute_force.search(None, loaded, q, 3)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+class TestBf16Storage:
+    """Half-width dataset storage (the reference's fp16 dataset analog)."""
+
+    def test_bf16_recall_and_dtype(self, rng_np):
+        import jax.numpy as jnp
+
+        from raft_tpu.neighbors import brute_force
+
+        x = rng_np.standard_normal((3000, 32)).astype(np.float32)
+        q = rng_np.standard_normal((16, 32)).astype(np.float32)
+        index = brute_force.build(None, x, storage_dtype=jnp.bfloat16)
+        assert index.dataset.dtype == jnp.bfloat16
+        d, i = brute_force.search(None, index, q, 10)
+        # vs exact fp32 ground truth: bf16 quantization may flip rare
+        # near-ties only
+        d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        gt = np.argsort(d2, axis=1, kind="stable")[:, :10]
+        overlap = np.mean([
+            len(set(gt[r]) & set(np.asarray(i)[r])) / 10
+            for r in range(len(q))
+        ])
+        assert overlap >= 0.97, overlap
+        # distances approximately exact
+        ref = np.take_along_axis(d2, np.asarray(i), axis=1)
+        np.testing.assert_allclose(np.asarray(d), ref, rtol=0.03, atol=0.5)

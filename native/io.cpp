@@ -17,9 +17,11 @@
 
 #include <atomic>
 #include <cerrno>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -223,6 +225,129 @@ int rt_io_append_rows(void* handle, const void* data, int64_t n) {
   }
   f->n_rows += n;
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Prefetching chunk pipeline: a background thread reads chunk i+1 while
+// the caller consumes chunk i (double-buffered). This is the streaming
+// ingestion path for 100M+-row datasets — the role the reference's
+// subset-window BinFile plays for its batched index builds, plus
+// read-ahead the reference leaves to the page cache.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Pipeline {
+  BinFile* file = nullptr;
+  int64_t chunk_rows = 0;
+  int n_threads = 0;
+  int64_t next_row = 0;          // next row the reader will fetch
+  std::vector<char> buf[2];
+  int64_t buf_rows[2] = {0, 0};  // rows in each buffer (0 = empty)
+  int64_t buf_first[2] = {-1, -1};
+  bool buf_ready[2] = {false, false};
+  int consume_slot = 0;          // next slot handed to the caller
+  int last_returned = -1;        // slot whose lifetime ends on next call
+  bool done = false;             // reader reached EOF
+  bool failed = false;
+  bool stop = false;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::thread reader;
+};
+
+void pipeline_reader(Pipeline* p) {
+  int fill_slot = 0;
+  for (;;) {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->cv.wait(lk, [&] { return p->stop || !p->buf_ready[fill_slot]; });
+    if (p->stop) return;
+    int64_t row = p->next_row;
+    if (row >= p->file->n_rows) {
+      p->done = true;
+      p->cv.notify_all();
+      return;
+    }
+    int64_t n = p->file->n_rows - row;
+    if (n > p->chunk_rows) n = p->chunk_rows;
+    p->next_row = row + n;
+    lk.unlock();
+
+    int rc = rt_io_read_rows(p->file, row, n, p->buf[fill_slot].data(),
+                             p->n_threads);
+
+    lk.lock();
+    if (rc != 0) {
+      p->failed = true;
+      p->cv.notify_all();
+      return;
+    }
+    p->buf_rows[fill_slot] = n;
+    p->buf_first[fill_slot] = row;
+    p->buf_ready[fill_slot] = true;
+    p->cv.notify_all();
+    fill_slot ^= 1;
+  }
+}
+
+}  // namespace
+
+// Start a prefetching reader over an open rt_io handle. The pipeline
+// owns read positions [0, n_rows) in chunk_rows steps.
+void* rt_io_pipeline_start(void* handle, int64_t chunk_rows, int n_threads) {
+  auto* f = static_cast<BinFile*>(handle);
+  if (chunk_rows <= 0) {
+    set_error(f, "pipeline chunk_rows must be positive");
+    return nullptr;
+  }
+  auto* p = new Pipeline();
+  p->file = f;
+  p->chunk_rows = chunk_rows;
+  p->n_threads = n_threads;
+  size_t bytes = static_cast<size_t>(chunk_rows) * f->dim * f->elem_size;
+  p->buf[0].resize(bytes);
+  p->buf[1].resize(bytes);
+  p->reader = std::thread(pipeline_reader, p);
+  return p;
+}
+
+// Block until the next chunk is ready. On success returns 0 and fills
+// (*data, *first_row, *n_rows); the buffer stays valid until the NEXT
+// rt_io_pipeline_next call. Returns 1 at end-of-file, -1 on read error.
+int rt_io_pipeline_next(void* pipe, void** data, int64_t* first_row,
+                        int64_t* n_rows) {
+  auto* p = static_cast<Pipeline*>(pipe);
+  std::unique_lock<std::mutex> lk(p->mu);
+  // the buffer handed out by the previous call dies now — release it
+  // so the reader can refill it
+  if (p->last_returned >= 0) {
+    p->buf_ready[p->last_returned] = false;
+    p->last_returned = -1;
+    p->cv.notify_all();
+  }
+  int slot = p->consume_slot;
+  p->cv.wait(lk, [&] {
+    return p->buf_ready[slot] || p->done || p->failed;
+  });
+  if (p->failed) return -1;
+  if (!p->buf_ready[slot]) return 1;  // done and nothing buffered
+  *data = p->buf[slot].data();
+  *first_row = p->buf_first[slot];
+  *n_rows = p->buf_rows[slot];
+  p->last_returned = slot;
+  p->consume_slot = slot ^ 1;
+  return 0;
+}
+
+void rt_io_pipeline_close(void* pipe) {
+  auto* p = static_cast<Pipeline*>(pipe);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+  }
+  p->cv.notify_all();
+  if (p->reader.joinable()) p->reader.join();
+  delete p;
 }
 
 int rt_io_close_writer(void* handle) {

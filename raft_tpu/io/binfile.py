@@ -88,6 +88,16 @@ def _load_native():
         ]
         lib.rt_io_close_writer.restype = ctypes.c_int
         lib.rt_io_close_writer.argtypes = [ctypes.c_void_p]
+        lib.rt_io_pipeline_start.restype = ctypes.c_void_p
+        lib.rt_io_pipeline_start.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+        ]
+        lib.rt_io_pipeline_next.restype = ctypes.c_int
+        lib.rt_io_pipeline_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.rt_io_pipeline_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -166,6 +176,50 @@ class BinDataset:
             out[:] = mm[row_start : row_start + n_rows]
             del mm
         return out
+
+    def iter_chunks(self, chunk_rows: int, n_threads: int = 0,
+                    copy: bool = True):
+        """Yield ``(first_row, array)`` chunks in order.
+
+        On the native path a background C++ thread prefetches chunk i+1
+        while chunk i is being consumed (double-buffered) — the streaming
+        ingestion path for datasets far larger than memory. With
+        ``copy=False`` the yielded array is a view into the prefetch
+        buffer and is only valid until the next iteration (fine when the
+        next step is an immediate ``jax.device_put``).
+        """
+        if chunk_rows <= 0:
+            raise ValueError("chunk_rows must be positive")
+        if not self._native:
+            for start in range(0, self.n_rows, chunk_rows):
+                n = min(chunk_rows, self.n_rows - start)
+                yield start, self.read(start, n)
+            return
+        lib = _load_native()
+        pipe = lib.rt_io_pipeline_start(self._handle, chunk_rows, n_threads)
+        if not pipe:
+            raise IOError(lib.rt_io_last_error().decode())
+        try:
+            data_p = ctypes.c_void_p()
+            first = ctypes.c_int64()
+            nrows = ctypes.c_int64()
+            while True:
+                rc = lib.rt_io_pipeline_next(
+                    pipe, ctypes.byref(data_p), ctypes.byref(first),
+                    ctypes.byref(nrows),
+                )
+                if rc == 1:
+                    return
+                if rc != 0:
+                    raise IOError(lib.rt_io_last_error().decode())
+                n = int(nrows.value)
+                buf = (ctypes.c_char * (n * self.dim
+                                        * self.dtype.itemsize)
+                       ).from_address(data_p.value)
+                arr = np.frombuffer(buf, self.dtype).reshape(n, self.dim)
+                yield int(first.value), (arr.copy() if copy else arr)
+        finally:
+            lib.rt_io_pipeline_close(pipe)
 
     def close(self):
         if self._native and self._handle is not None:

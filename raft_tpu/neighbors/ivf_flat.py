@@ -283,6 +283,104 @@ def extend(
         )
 
 
+def build_streaming(
+    res: Optional[Resources],
+    params: IvfFlatIndexParams,
+    source,
+    chunk_rows: int = 1 << 20,
+    train_rows: int = 1 << 18,
+) -> IvfFlatIndex:
+    """Build from a dataset that never fully materializes in host memory
+    — the 100M+-row ingestion path (role of the reference's
+    managed-memory trainset spill, ``ivf_pq_build.cuh:1542-1554``, plus
+    its batched extend).
+
+    ``source`` is a :class:`raft_tpu.io.BinDataset` (or any object with
+    ``n_rows``/``dim``/``iter_chunks``). Three streamed passes over the
+    native prefetch pipeline:
+
+    1. strided trainset sample → balanced-kmeans centers;
+    2. per-chunk label predict (device) + list-size count (host);
+    3. per-chunk scatter into the padded list tensor with **donated**
+       device buffers, so the big tensor is updated in place.
+    """
+    res = ensure_resources(res)
+    n, d = source.n_rows, source.dim
+    expect(params.n_lists <= n, "n_lists > n_rows")
+
+    with tracing.range("raft_tpu.ivf_flat.build_streaming"):
+        # -- pass 1: trainset sample + centers
+        train_rows = max(params.n_lists, min(train_rows, n))
+        stride = max(1, n // train_rows)
+        parts = []
+        for first, chunk in source.iter_chunks(chunk_rows):
+            offset = (-first) % stride
+            parts.append(np.asarray(chunk[offset::stride],
+                                    dtype=np.float32))
+        trainset = np.concatenate(parts)[:train_rows]
+        km_params = KMeansBalancedParams(
+            n_iters=params.kmeans_n_iters,
+            metric=(DistanceType.InnerProduct
+                    if params.metric == DistanceType.InnerProduct
+                    else DistanceType.L2Expanded),
+            seed=res.seed,
+        )
+        centers = kmeans_balanced.fit(res, km_params, jnp.asarray(trainset),
+                                      params.n_lists)
+
+        # -- pass 2: labels + sizes
+        labels_np = np.empty((n,), np.int32)
+        for first, chunk in source.iter_chunks(chunk_rows):
+            lab = kmeans_balanced.predict(
+                res, km_params, centers,
+                jnp.asarray(chunk, jnp.float32))
+            labels_np[first : first + chunk.shape[0]] = np.asarray(lab)
+        sizes_np = np.bincount(labels_np, minlength=params.n_lists)
+        max_size = max(8, -(-int(sizes_np.max()) // 8) * 8)
+
+        # -- pass 3: scatter chunks into donated padded buffers
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def scatter_chunk(flat_data, flat_idx, rows, ids, slots):
+            return (flat_data.at[slots].set(rows),
+                    flat_idx.at[slots].set(ids))
+
+        flat_data = jnp.zeros((params.n_lists * max_size, d), jnp.float32)
+        flat_idx = jnp.full((params.n_lists * max_size,), -1, jnp.int32)
+        fill = np.zeros((params.n_lists,), np.int64)
+        for first, chunk in source.iter_chunks(chunk_rows):
+            m = chunk.shape[0]
+            lab = labels_np[first : first + m]
+            order = np.argsort(lab, kind="stable")
+            sl = lab[order]
+            first_pos = np.searchsorted(sl, np.arange(params.n_lists))
+            rank = np.arange(m) - first_pos[sl]
+            slot_sorted = sl.astype(np.int64) * max_size + fill[sl] + rank
+            slots = np.empty((m,), np.int64)
+            slots[order] = slot_sorted
+            np.add.at(fill, lab, 1)
+            flat_data, flat_idx = scatter_chunk(
+                flat_data, flat_idx,
+                jnp.asarray(chunk, jnp.float32),
+                jnp.asarray(first + np.arange(m, dtype=np.int32)),
+                jnp.asarray(slots),
+            )
+
+        data = flat_data.reshape(params.n_lists, max_size, d)
+        indices = flat_idx.reshape(params.n_lists, max_size)
+        norms = jnp.sum(jnp.square(data), axis=2)
+        norms = jnp.where(indices >= 0, norms, jnp.inf)
+        return IvfFlatIndex(
+            centers=centers,
+            center_norms=jnp.sum(jnp.square(centers), axis=1),
+            data=data,
+            data_norms=norms,
+            indices=indices,
+            list_sizes=jnp.asarray(sizes_np, jnp.int32),
+            metric=DistanceType(params.metric),
+            adaptive_centers=params.adaptive_centers,
+        )
+
+
 # ---------------------------------------------------------------------------
 # search
 # ---------------------------------------------------------------------------

@@ -45,8 +45,14 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
+from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
 from raft_tpu.neighbors.brute_force import knn_merge_parts
 from raft_tpu.neighbors.ivf_flat import IvfFlatIndexParams, IvfFlatSearchParams
+from raft_tpu.neighbors.ivf_pq import (
+    CodebookKind,
+    IvfPqIndexParams,
+    IvfPqSearchParams,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -241,4 +247,214 @@ def search(
             index.centers, index.data, index.data_norms, index.indices,
             queries, comms.axis, comms.mesh, n_probes, k, index.metric,
             probe_mode,
+        )
+
+
+# ---------------------------------------------------------------------------
+# distributed IVF-PQ — the SIFT-1B-scale configuration: compressed codes
+# sharded over the mesh, per-subspace codebooks replicated
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedIvfPq:
+    """List-sharded IVF-PQ index (codes + ids sharded on the list axis,
+    rotation/codebooks replicated)."""
+
+    comms: Comms
+    centers: jax.Array        # (n_lists, dim) sharded on axis 0
+    rotation: jax.Array       # (dim_ext, dim) replicated
+    codebooks: jax.Array      # (pq_dim, 2^bits, pq_len) replicated
+    codes: jax.Array          # (n_lists, max_list_size, pq_dim) u8 sharded
+    indices: jax.Array        # (n_lists, max_list_size) int32 sharded
+    list_sizes: jax.Array     # (n_lists,) sharded
+    metric: DistanceType
+    pq_bits: int
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def pq_dim(self) -> int:
+        return self.codes.shape[2]
+
+    @property
+    def pq_len(self) -> int:
+        return self.codebooks.shape[2]
+
+    @property
+    def size(self) -> int:
+        return int(jax.device_get(self.list_sizes).sum())
+
+
+def build_pq(
+    res: Optional[Resources],
+    comms: Comms,
+    params: IvfPqIndexParams,
+    dataset,
+) -> DistributedIvfPq:
+    """Build + deal, like :func:`build`. PER_SUBSPACE codebooks only (the
+    replicable kind; PER_CLUSTER would shard the codebooks with the
+    lists — unsupported here)."""
+    res = ensure_resources(res)
+    expect(params.codebook_kind == CodebookKind.PER_SUBSPACE,
+           "distributed IVF-PQ supports PER_SUBSPACE codebooks")
+    r = comms.size
+    n_lists = -(-params.n_lists // r) * r
+    params = dataclasses.replace(params, n_lists=n_lists)
+
+    with tracing.range("raft_tpu.distributed.ivf_pq.build"):
+        index = ivf_pq_mod.build(res, params, dataset)
+
+        sizes = np.asarray(jax.device_get(index.list_sizes))
+        order = np.argsort(-sizes, kind="stable")
+        deal = np.concatenate([order[s::r] for s in range(r)])
+        perm = jnp.asarray(deal, jnp.int32)
+
+        shard = comms.sharding(comms.axis)
+        def place(a):
+            return jax.device_put(jnp.take(a, perm, axis=0), shard)
+
+        rep = comms.replicated()
+        return DistributedIvfPq(
+            comms=comms,
+            centers=place(index.centers),
+            rotation=jax.device_put(index.rotation, rep),
+            codebooks=jax.device_put(index.codebooks, rep),
+            codes=place(index.codes),
+            indices=place(index.indices),
+            list_sizes=place(index.list_sizes),
+            metric=index.metric,
+            pq_bits=index.pq_bits,
+        )
+
+
+@partial(jax.jit, static_argnames=("axis", "mesh", "n_probes", "k", "metric",
+                                   "probe_mode"))
+def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
+                    axis: str, mesh, n_probes: int, k: int,
+                    metric: DistanceType, probe_mode: str):
+    select_min = is_min_close(metric)
+    pad_val = jnp.inf if select_min else -jnp.inf
+    pq_dim, book, pq_len = codebooks.shape
+    ip_metric = metric == DistanceType.InnerProduct
+
+    def body(centers_l, codes_l, ids_l, qs):
+        q = qs.shape[0]
+        n_local = centers_l.shape[0]
+        qf = qs.astype(jnp.float32)
+        my_rank = jax.lax.axis_index(axis)
+
+        ip = jax.lax.dot_general(
+            qf, centers_l, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        if ip_metric:
+            coarse = -ip
+        else:
+            cn = jnp.sum(jnp.square(centers_l), axis=1)
+            coarse = cn[None, :] - 2.0 * ip
+
+        if probe_mode == "global":
+            coarse_all = allgather(coarse, axis)          # (R, q, L)
+            r = coarse_all.shape[0]
+            coarse_flat = jnp.moveaxis(coarse_all, 0, 1).reshape(
+                q, r * n_local)
+            _, probes = jax.lax.top_k(-coarse_flat, n_probes)
+            probes = probes.astype(jnp.int32)
+            owner = probes // n_local
+            local = probes - owner * n_local
+            mine = owner == my_rank
+        else:
+            _, probes = jax.lax.top_k(-coarse, n_probes)
+            local = probes.astype(jnp.int32)
+            mine = jnp.ones(local.shape, jnp.bool_)
+
+        if ip_metric:
+            qsub_fixed = (qf @ rotation.T).reshape(q, pq_dim, pq_len)
+            lut_fixed = jnp.einsum("qsl,sjl->qsj", qsub_fixed, codebooks)
+
+        def step(carry, rank_i):
+            best_d, best_i = carry
+            lists = local[:, rank_i]
+            valid = mine[:, rank_i]
+            c = jnp.take(centers_l, lists, axis=0)        # (q, dim)
+            if ip_metric:
+                base = jnp.sum(qf * c, axis=1)
+                lut = lut_fixed
+            else:
+                qsub = ((qf - c) @ rotation.T).reshape(q, pq_dim, pq_len)
+                base = jnp.zeros((q,), jnp.float32)
+                lut = (
+                    jnp.sum(jnp.square(qsub), -1)[:, :, None]
+                    - 2.0 * jnp.einsum("qsl,sjl->qsj", qsub, codebooks)
+                    + jnp.sum(jnp.square(codebooks), -1)[None, :, :]
+                )
+            rows = jnp.take(codes_l, lists, axis=0)       # (q, m, s) u8
+            row_ids = jnp.take(ids_l, lists, axis=0)
+            gathered = jnp.take_along_axis(
+                lut[:, None, :, :],
+                rows.astype(jnp.int32)[:, :, :, None],
+                axis=3,
+            )[..., 0]
+            dist = jnp.sum(gathered, axis=2) + base[:, None]
+            dist = jnp.where((row_ids >= 0) & valid[:, None], dist, pad_val)
+            return merge_topk(best_d, best_i, dist, row_ids, k,
+                              select_min), None
+
+        init = (jnp.full((q, k), pad_val, jnp.float32),
+                jnp.full((q, k), -1, jnp.int32))
+        (best_d, best_i), _ = jax.lax.scan(
+            step, init, jnp.arange(local.shape[1]))
+
+        all_d = allgather(best_d, axis)
+        all_i = allgather(best_i, axis)
+        return knn_merge_parts(all_d, all_i, select_min)
+
+    out_d, out_i = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None, None), P(axis, None), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(centers, codes, indices, queries)
+
+    if metric == DistanceType.L2SqrtExpanded:
+        out_d = jnp.where(jnp.isfinite(out_d),
+                          jnp.sqrt(jnp.maximum(out_d, 0.0)), out_d)
+    return out_d, out_i
+
+
+def search_pq(
+    res: Optional[Resources],
+    params: IvfPqSearchParams,
+    index: DistributedIvfPq,
+    queries,
+    k: int,
+    probe_mode: str = "global",
+) -> Tuple[jax.Array, jax.Array]:
+    """One-program distributed PQ search (LUT scoring per shard, global
+    merge); semantics of :func:`search`."""
+    ensure_resources(res)
+    queries = jnp.asarray(queries)
+    expect(queries.ndim == 2 and queries.shape[1] == index.dim,
+           "queries must be (q, dim)")
+    expect(probe_mode in ("global", "local"),
+           f"probe_mode must be 'global' or 'local', got {probe_mode!r}")
+    comms = index.comms
+    local_lists = index.n_lists // comms.size
+    n_probes = min(params.n_probes, index.n_lists)
+    if probe_mode == "local":
+        n_probes = min(-(-n_probes // comms.size), local_lists)
+    queries = jax.device_put(queries, comms.replicated())
+    with tracing.range("raft_tpu.distributed.ivf_pq.search"):
+        return _dist_search_pq(
+            index.centers, index.rotation, index.codebooks, index.codes,
+            index.indices, queries, comms.axis, comms.mesh, n_probes, k,
+            index.metric, probe_mode,
         )

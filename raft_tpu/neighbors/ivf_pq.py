@@ -344,6 +344,104 @@ def build(
         return extend(res, empty, dataset, jnp.arange(n, dtype=jnp.int32))
 
 
+def build_streaming(
+    res: Optional[Resources],
+    params: IvfPqIndexParams,
+    source,
+    chunk_rows: int = 1 << 20,
+    train_rows: int = 1 << 18,
+) -> IvfPqIndex:
+    """Streamed PQ build over a :class:`raft_tpu.io.BinDataset` — the
+    dataset never fully materializes host-side (role of the reference's
+    managed-memory trainset spill, ``ivf_pq_build.cuh:1542-1554``).
+
+    Passes: (1) strided trainset sample → centers + rotation +
+    codebooks via the in-memory trainer; (2) per-chunk label predict +
+    size count; (3) per-chunk encode + scatter into donated code
+    buffers. Only the compressed codes live on device, so datasets many
+    times HBM fit."""
+    res = ensure_resources(res)
+    expect(params.codebook_kind == CodebookKind.PER_SUBSPACE,
+           "build_streaming supports PER_SUBSPACE codebooks")
+    n, dim = source.n_rows, source.dim
+    expect(params.n_lists <= n, "n_lists > n_rows")
+    pq_dim = params.pq_dim if params.pq_dim > 0 else _auto_pq_dim(dim)
+    pq_len = -(-dim // pq_dim)
+
+    with tracing.range("raft_tpu.ivf_pq.build_streaming"):
+        # -- pass 1: trainset sample → full training via build()
+        train_rows = max(params.n_lists * 2, 1 << params.pq_bits,
+                         min(train_rows, n))
+        stride = max(1, n // train_rows)
+        parts = []
+        for first, chunk in source.iter_chunks(chunk_rows):
+            offset = (-first) % stride
+            parts.append(np.asarray(chunk[offset::stride], np.float32))
+        trainset = np.concatenate(parts)[:train_rows]
+        empty = build(res, dataclasses.replace(params,
+                                               add_data_on_build=False),
+                      trainset)
+
+        km = KMeansBalancedParams(
+            metric=(DistanceType.InnerProduct
+                    if params.metric == DistanceType.InnerProduct
+                    else DistanceType.L2Expanded))
+
+        # -- pass 2: labels + sizes
+        labels_np = np.empty((n,), np.int32)
+        for first, chunk in source.iter_chunks(chunk_rows):
+            lab = kmeans_balanced.predict(
+                res, km, empty.centers, jnp.asarray(chunk, jnp.float32))
+            labels_np[first : first + chunk.shape[0]] = np.asarray(lab)
+        sizes_np = np.bincount(labels_np, minlength=params.n_lists)
+        max_size = max(8, -(-int(sizes_np.max()) // 8) * 8)
+
+        # -- pass 3: encode + scatter with donated buffers
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def encode_scatter(flat_codes, flat_idx, rows, labels, ids, slots):
+            rot = _rotate_residuals(rows, labels, empty.centers,
+                                    empty.rotation)
+            codes = _encode(rot, empty.codebooks, labels,
+                            CodebookKind.PER_SUBSPACE, pq_dim, pq_len)
+            return (flat_codes.at[slots].set(codes),
+                    flat_idx.at[slots].set(ids))
+
+        flat_codes = jnp.zeros((params.n_lists * max_size, pq_dim),
+                               jnp.uint8)
+        flat_idx = jnp.full((params.n_lists * max_size,), -1, jnp.int32)
+        fill = np.zeros((params.n_lists,), np.int64)
+        for first, chunk in source.iter_chunks(chunk_rows):
+            m = chunk.shape[0]
+            lab = labels_np[first : first + m]
+            order = np.argsort(lab, kind="stable")
+            sl = lab[order]
+            first_pos = np.searchsorted(sl, np.arange(params.n_lists))
+            rank = np.arange(m) - first_pos[sl]
+            slot_sorted = sl.astype(np.int64) * max_size + fill[sl] + rank
+            slots = np.empty((m,), np.int64)
+            slots[order] = slot_sorted
+            np.add.at(fill, lab, 1)
+            flat_codes, flat_idx = encode_scatter(
+                flat_codes, flat_idx,
+                jnp.asarray(chunk, jnp.float32),
+                jnp.asarray(lab),
+                jnp.asarray(first + np.arange(m, dtype=np.int32)),
+                jnp.asarray(slots),
+            )
+
+        return IvfPqIndex(
+            centers=empty.centers,
+            rotation=empty.rotation,
+            codebooks=empty.codebooks,
+            codes=flat_codes.reshape(params.n_lists, max_size, pq_dim),
+            indices=flat_idx.reshape(params.n_lists, max_size),
+            list_sizes=jnp.asarray(sizes_np, jnp.int32),
+            metric=DistanceType(params.metric),
+            codebook_kind=params.codebook_kind,
+            pq_bits=params.pq_bits,
+        )
+
+
 def extend(
     res: Optional[Resources],
     index: IvfPqIndex,

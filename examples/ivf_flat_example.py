@@ -40,8 +40,11 @@ def main():
     print("filtered search ok")
 
     # serialize / deserialize
-    ivf_flat.save(index, "ivf_flat.idx")
-    index2 = ivf_flat.load(res, "ivf_flat.idx")
+    import tempfile
+
+    path = tempfile.mktemp(suffix=".idx")
+    ivf_flat.save(index, path)
+    index2 = ivf_flat.load(res, path)
     d2, i2 = ivf_flat.search(res, sp, index2, queries, K)
     assert np.array_equal(np.asarray(idx), np.asarray(i2))
     print("serialization round-trip ok")

@@ -63,8 +63,8 @@ def div_op(a, b):
 
 def div_checkzero_op(a, b):
     """a / b, 0 where b == 0 (``div_checkzero_op``)."""
-    return jnp.where(b == 0, jnp.zeros_like(a / jnp.where(b == 0, 1, b)),
-                     a / jnp.where(b == 0, 1, b))
+    safe = a / jnp.where(b == 0, 1, b)
+    return jnp.where(b == 0, jnp.zeros_like(safe), safe)
 
 
 def pow_op(a, b):

@@ -338,14 +338,17 @@ def build_streaming(
         sizes_np = np.bincount(labels_np, minlength=params.n_lists)
         max_size = max(8, -(-int(sizes_np.max()) // 8) * 8)
 
-        # -- pass 3: scatter chunks into donated padded buffers
+        # -- pass 3: scatter chunks into donated padded buffers. Indexing
+        # is 2-D (list id, rank within list): a flat slot index would
+        # overflow int32 (jax default) past 2^31 total slots, well within
+        # the billion-row regime this path targets.
         @partial(jax.jit, donate_argnums=(0, 1))
-        def scatter_chunk(flat_data, flat_idx, rows, ids, slots):
-            return (flat_data.at[slots].set(rows),
-                    flat_idx.at[slots].set(ids))
+        def scatter_chunk(data, idx, rows, ids, list_ids, ranks):
+            return (data.at[list_ids, ranks].set(rows),
+                    idx.at[list_ids, ranks].set(ids))
 
-        flat_data = jnp.zeros((params.n_lists * max_size, d), jnp.float32)
-        flat_idx = jnp.full((params.n_lists * max_size,), -1, jnp.int32)
+        data = jnp.zeros((params.n_lists, max_size, d), jnp.float32)
+        indices = jnp.full((params.n_lists, max_size), -1, jnp.int32)
         fill = np.zeros((params.n_lists,), np.int64)
         for first, chunk in source.iter_chunks(chunk_rows):
             m = chunk.shape[0]
@@ -353,20 +356,18 @@ def build_streaming(
             order = np.argsort(lab, kind="stable")
             sl = lab[order]
             first_pos = np.searchsorted(sl, np.arange(params.n_lists))
-            rank = np.arange(m) - first_pos[sl]
-            slot_sorted = sl.astype(np.int64) * max_size + fill[sl] + rank
-            slots = np.empty((m,), np.int64)
-            slots[order] = slot_sorted
+            rank_sorted = np.arange(m) - first_pos[sl] + fill[sl]
+            ranks = np.empty((m,), np.int32)
+            ranks[order] = rank_sorted.astype(np.int32)
             np.add.at(fill, lab, 1)
-            flat_data, flat_idx = scatter_chunk(
-                flat_data, flat_idx,
+            data, indices = scatter_chunk(
+                data, indices,
                 jnp.asarray(chunk, jnp.float32),
                 jnp.asarray(first + np.arange(m, dtype=np.int32)),
-                jnp.asarray(slots),
+                jnp.asarray(lab),
+                jnp.asarray(ranks),
             )
 
-        data = flat_data.reshape(params.n_lists, max_size, d)
-        indices = flat_idx.reshape(params.n_lists, max_size)
         norms = jnp.sum(jnp.square(data), axis=2)
         norms = jnp.where(indices >= 0, norms, jnp.inf)
         return IvfFlatIndex(

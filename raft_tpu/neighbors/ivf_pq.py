@@ -396,19 +396,20 @@ def build_streaming(
         sizes_np = np.bincount(labels_np, minlength=params.n_lists)
         max_size = max(8, -(-int(sizes_np.max()) // 8) * 8)
 
-        # -- pass 3: encode + scatter with donated buffers
+        # -- pass 3: encode + scatter with donated buffers. 2-D
+        # (list, rank) indexing: flat slots would overflow int32 past
+        # 2^31 total slots (the billion-row regime this path targets).
         @partial(jax.jit, donate_argnums=(0, 1))
-        def encode_scatter(flat_codes, flat_idx, rows, labels, ids, slots):
+        def encode_scatter(codes_buf, idx_buf, rows, labels, ids, ranks):
             rot = _rotate_residuals(rows, labels, empty.centers,
                                     empty.rotation)
             codes = _encode(rot, empty.codebooks, labels,
                             CodebookKind.PER_SUBSPACE, pq_dim, pq_len)
-            return (flat_codes.at[slots].set(codes),
-                    flat_idx.at[slots].set(ids))
+            return (codes_buf.at[labels, ranks].set(codes),
+                    idx_buf.at[labels, ranks].set(ids))
 
-        flat_codes = jnp.zeros((params.n_lists * max_size, pq_dim),
-                               jnp.uint8)
-        flat_idx = jnp.full((params.n_lists * max_size,), -1, jnp.int32)
+        codes_buf = jnp.zeros((params.n_lists, max_size, pq_dim), jnp.uint8)
+        idx_buf = jnp.full((params.n_lists, max_size), -1, jnp.int32)
         fill = np.zeros((params.n_lists,), np.int64)
         for first, chunk in source.iter_chunks(chunk_rows):
             m = chunk.shape[0]
@@ -416,25 +417,24 @@ def build_streaming(
             order = np.argsort(lab, kind="stable")
             sl = lab[order]
             first_pos = np.searchsorted(sl, np.arange(params.n_lists))
-            rank = np.arange(m) - first_pos[sl]
-            slot_sorted = sl.astype(np.int64) * max_size + fill[sl] + rank
-            slots = np.empty((m,), np.int64)
-            slots[order] = slot_sorted
+            rank_sorted = np.arange(m) - first_pos[sl] + fill[sl]
+            ranks = np.empty((m,), np.int32)
+            ranks[order] = rank_sorted.astype(np.int32)
             np.add.at(fill, lab, 1)
-            flat_codes, flat_idx = encode_scatter(
-                flat_codes, flat_idx,
+            codes_buf, idx_buf = encode_scatter(
+                codes_buf, idx_buf,
                 jnp.asarray(chunk, jnp.float32),
                 jnp.asarray(lab),
                 jnp.asarray(first + np.arange(m, dtype=np.int32)),
-                jnp.asarray(slots),
+                jnp.asarray(ranks),
             )
 
         return IvfPqIndex(
             centers=empty.centers,
             rotation=empty.rotation,
             codebooks=empty.codebooks,
-            codes=flat_codes.reshape(params.n_lists, max_size, pq_dim),
-            indices=flat_idx.reshape(params.n_lists, max_size),
+            codes=codes_buf,
+            indices=idx_buf,
             list_sizes=jnp.asarray(sizes_np, jnp.int32),
             metric=DistanceType(params.metric),
             codebook_kind=params.codebook_kind,

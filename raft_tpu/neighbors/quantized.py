@@ -117,6 +117,9 @@ def _search_impl(q_codes, q_sums, codes, row_sums, scale: float, zero: float,
 
     s2 = scale * scale
     z = zero
+    # decode is x = scale * (code + zero)  (encode was x/scale - zero),
+    # so <x, y> = s²(qx·qy + z·Σqx + z·Σqy + d·z²)
+    qn = s2 * jnp.sum((q_codes.astype(jnp.float32) + z) ** 2, axis=1)
 
     def step(carry, inp):
         best_d, best_i = carry
@@ -127,12 +130,10 @@ def _search_impl(q_codes, q_sums, codes, row_sums, scale: float, zero: float,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.int32,
         ).astype(jnp.float32)
-        ip = s2 * (gram - z * q_sums[:, None] - z * st[None, :] + d * z * z)
+        ip = s2 * (gram + z * q_sums[:, None] + z * st[None, :] + d * z * z)
         if select_min:
-            qn = s2 * (jnp.sum(
-                (q_codes.astype(jnp.float32) - z) ** 2, axis=1))
             yn = s2 * (jnp.sum(
-                (ct.astype(jnp.float32) - z) ** 2, axis=1))
+                (ct.astype(jnp.float32) + z) ** 2, axis=1))
             dist = qn[:, None] + yn[None, :] - 2.0 * ip
             dist = jnp.maximum(dist, 0.0)
         else:

@@ -80,3 +80,31 @@ class TestResourcesManager:
         assert r0 is not resources_manager.get_device_resources(1)
         assert resources_manager.get_device_resources(None) is \
             resources_manager.get_device_resources(None)
+
+
+class TestAsymmetricData:
+    """Regression: encode is q = x/s - zero, decode must be s*(q + zero).
+    A sign slip cancels on L2 but destroys InnerProduct rankings on data
+    not centered at zero (e.g. SIFT's all-positive range)."""
+
+    def test_inner_product_positive_data(self, rng_np):
+        x = rng_np.uniform(0.0, 10.0, (3000, 24)).astype(np.float32)
+        q = rng_np.uniform(0.0, 10.0, (32, 24)).astype(np.float32)
+        d, i = quantized.knn(None, x, q, 10, DistanceType.InnerProduct)
+        gt = np.argsort(-(q @ x.T), axis=1, kind="stable")[:, :10]
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        assert r >= 0.9, r
+        # similarities close to exact
+        ref = np.take_along_axis(q @ x.T, np.asarray(i), axis=1)
+        np.testing.assert_allclose(np.asarray(d), ref, rtol=0.02, atol=1.0)
+
+    def test_l2_positive_data(self, rng_np):
+        x = rng_np.uniform(0.0, 10.0, (3000, 24)).astype(np.float32)
+        q = rng_np.uniform(0.0, 10.0, (16, 24)).astype(np.float32)
+        d, i = quantized.knn(None, x, q, 10)
+        from scipy.spatial.distance import cdist
+
+        gt = np.argsort(cdist(q, x, "sqeuclidean"), axis=1,
+                        kind="stable")[:, :10]
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        assert r >= 0.9, r

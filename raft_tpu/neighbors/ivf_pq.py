@@ -88,6 +88,10 @@ class IvfPqSearchParams(SearchParams):
 
     n_probes: int = 20
     lut_dtype: jnp.dtype = jnp.float32
+    # "gather": per-element LUT lookup; "onehot": gather-free MXU
+    # contraction (J-fold more FLOPs, no dynamic gathers — profile both
+    # on your chip; gathers lower poorly on TPU)
+    score_mode: str = "gather"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -501,11 +505,37 @@ def extend(
 # ---------------------------------------------------------------------------
 
 
+def _score_gather(lut, rows):
+    """dist contributions via per-element LUT gather —
+    O(q·m·s) dynamic gathers (the GPU's shared-mem LUT access pattern)."""
+    gathered = jnp.take_along_axis(
+        lut[:, None, :, :],                            # (q, 1, s, J)
+        rows.astype(jnp.int32)[:, :, :, None],         # (q, m, s, 1)
+        axis=3,
+    )[..., 0]                                          # (q, m, s)
+    return jnp.sum(gathered.astype(jnp.float32), axis=2)
+
+
+def _score_onehot(lut, rows):
+    """dist contributions via one-hot × LUT MXU contraction: trades a
+    J-fold FLOP inflation for gather-free systolic throughput — the
+    profitable trade on TPU when q is small (the VPU executes XLA
+    gathers element-at-a-time; the MXU does 256 MACs/cycle/lane).
+    dist[q, m] = Σ_{s,j} onehot(rows)[m? per q...]"""
+    q, s, J = lut.shape
+    oh = jax.nn.one_hot(rows.astype(jnp.int32), J,
+                        dtype=jnp.bfloat16)            # (q, m, s, J)
+    return jnp.einsum("qmsj,qsj->qm", oh,
+                      lut.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+
+
 @partial(jax.jit, static_argnames=("n_probes", "k", "metric", "codebook_kind",
-                                   "lut_dtype"))
+                                   "lut_dtype", "score_mode"))
 def _search_impl(queries, centers, rotation, codebooks, codes, indices,
                  filter_words, n_probes: int, k: int, metric: DistanceType,
-                 codebook_kind: CodebookKind, lut_dtype):
+                 codebook_kind: CodebookKind, lut_dtype,
+                 score_mode: str = "gather"):
     q, dim = queries.shape
     n_lists, max_size, pq_dim = codes.shape
     book_size = codebooks.shape[1]
@@ -576,12 +606,8 @@ def _search_impl(queries, centers, rotation, codebooks, codes, indices,
         rows = jnp.take(codes, lists, axis=0)          # (q, m, pq_dim) u8
         row_ids = jnp.take(indices, lists, axis=0)     # (q, m)
         # score codes: dist[q, m] = sum_s lut[q, s, rows[q, m, s]]
-        gathered = jnp.take_along_axis(
-            lut[:, None, :, :],                        # (q, 1, s, J)
-            rows.astype(jnp.int32)[:, :, :, None],     # (q, m, s, 1)
-            axis=3,
-        )[..., 0]                                      # (q, m, s)
-        dist = jnp.sum(gathered.astype(jnp.float32), axis=2) + base[:, None]
+        score = _score_onehot if score_mode == "onehot" else _score_gather
+        dist = score(lut, rows) + base[:, None]
         dist = jnp.where(row_ids >= 0, dist, pad_val)
         if filter_words is not None:
             bits = test_words(filter_words, row_ids)
@@ -627,7 +653,7 @@ def search(
             queries, index.centers, index.rotation, index.codebooks,
             index.codes, index.indices, filter_words,
             n_probes, k, index.metric, index.codebook_kind,
-            params.lut_dtype,
+            params.lut_dtype, params.score_mode,
         )
 
 

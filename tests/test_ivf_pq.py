@@ -164,3 +164,24 @@ class TestRefine:
         idx = np.asarray(idx)
         assert idx[0, 0] == 0  # self
         assert -1 not in idx[:, 0]
+
+
+class TestScoreModes:
+    def test_onehot_matches_gather(self, rng_np):
+        """Both scoring paths rank identically (onehot scores in bf16, so
+        compare rankings not raw floats)."""
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.utils import eval_recall
+
+        x = rng_np.standard_normal((2000, 32)).astype(np.float32)
+        q = rng_np.standard_normal((16, 32)).astype(np.float32)
+        index = ivf_pq.build(
+            None, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16), x)
+        _, i1 = ivf_pq.search(
+            None, ivf_pq.IvfPqSearchParams(n_probes=16), index, q, 10)
+        _, i2 = ivf_pq.search(
+            None, ivf_pq.IvfPqSearchParams(n_probes=16,
+                                           score_mode="onehot"),
+            index, q, 10)
+        r, _, _ = eval_recall(np.asarray(i1), np.asarray(i2))
+        assert r >= 0.95, r

@@ -85,3 +85,19 @@ class TestSelectKTiles:
         np.testing.assert_allclose(np.asarray(d)[0], [1.0, 1.0, 1.0])
         # ids must be valid positions holding the value 1.0
         assert all(np.asarray(v)[0, j] == 1.0 for j in np.asarray(i)[0])
+
+
+class TestBf16Kernel:
+    def test_fused_knn_bf16(self):
+        """bf16 dataset path: padding/alignment and dot dtype handling."""
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((700, 24)).astype(np.float32)
+        q = rng.standard_normal((5, 24)).astype(np.float32)
+        d, i = fused_knn(jnp.asarray(q, jnp.bfloat16),
+                         jnp.asarray(x, jnp.bfloat16), 9,
+                         tile=128, interpret=True)
+        xb = np.asarray(jnp.asarray(x, jnp.bfloat16), np.float32)
+        qb = np.asarray(jnp.asarray(q, jnp.bfloat16), np.float32)
+        gt_d, gt_i = _naive_knn(qb, xb, 9, DistanceType.L2Expanded)
+        assert np.array_equal(np.asarray(i), gt_i)
+        np.testing.assert_allclose(np.asarray(d), gt_d, rtol=1e-2, atol=1e-2)

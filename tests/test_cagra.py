@@ -186,3 +186,30 @@ class TestCagraFilter:
         gt = np.argsort(d, axis=1, kind="stable")[:, :10]
         r, _, _ = eval_recall(gt, idx)
         assert r >= 0.7, r
+
+
+class TestPooledSeeding:
+    def test_seed_pool_beats_random_on_clusters(self, dataset):
+        """Query-aware seeding removes the random-seed recall ceiling on
+        clustered data (pathological case: many tight clusters)."""
+        rng = np.random.default_rng(3)
+        centers = rng.standard_normal((64, 16)) * 6
+        x = (centers[rng.integers(0, 64, 8000)]
+             + rng.standard_normal((8000, 16))).astype(np.float32)
+        q = (centers[rng.integers(0, 64, 64)]
+             + rng.standard_normal((64, 16))).astype(np.float32)
+        params = CagraIndexParams(graph_degree=24,
+                                  intermediate_graph_degree=48,
+                                  build_algo=BuildAlgo.NN_DESCENT)
+        index = cagra.build(None, params, x)
+        gt = np.argsort(spd.cdist(q, x, "sqeuclidean"), axis=1,
+                        kind="stable")[:, :10]
+        sp_rand = CagraSearchParams(itopk_size=32, search_width=1)
+        _, i_rand = cagra.search(None, sp_rand, index, q, 10)
+        r_rand, _, _ = eval_recall(gt, np.asarray(i_rand))
+        sp_pool = CagraSearchParams(itopk_size=32, search_width=1,
+                                    seed_pool=2048)
+        _, i_pool = cagra.search(None, sp_pool, index, q, 10)
+        r_pool, _, _ = eval_recall(gt, np.asarray(i_pool))
+        assert r_pool >= r_rand, (r_pool, r_rand)
+        assert r_pool >= 0.95, (r_pool, r_rand)

@@ -384,10 +384,12 @@ def _search_batch(dataset, graph, queries, seed_ids, filter_words,
     def score(cand):                                     # (q, c) ids → dists
         d = gathered_distances(qf, dataset, cand, metric)
         if filter_words is not None:
+            from raft_tpu.neighbors.filters import test_filter
+
             # filtered-out samples never enter the itopk buffer, so they
             # are neither returned nor expanded (the reference's
             # search_with_filtering greenlight semantics)
-            d = jnp.where(test_words(filter_words, cand), d, jnp.inf)
+            d = jnp.where(test_filter(filter_words, cand), d, jnp.inf)
         return d
 
     # random seeding (role of the reference's random_samplings)
@@ -442,7 +444,7 @@ def search(
     index: CagraIndex,
     queries,
     k: int,
-    sample_filter: Optional[Bitset] = None,
+    sample_filter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Graph beam search — ``cagra::search`` → ``search_main``
     (``detail/cagra/cagra_search.cuh:105``). With ``sample_filter``,
@@ -460,7 +462,9 @@ def search(
     max_iters = params.max_iterations or (L // w + 24)
     n_seeds = max(L, w * index.graph_degree) * max(1, params.num_random_samplings)
     n_seeds = min(n_seeds, n)
-    filter_words = None if sample_filter is None else sample_filter.words
+    from raft_tpu.neighbors.filters import resolve_filter_words
+
+    filter_words = resolve_filter_words(sample_filter)
 
     with tracing.range("raft_tpu.cagra.search"):
         outs_d, outs_i = [], []

@@ -1,0 +1,89 @@
+"""Search-time sample filters — analog of ``neighbors/filtering``
+(``sample_filter_types.hpp:27-95``). The reference exposes none- and
+bitset-filters and documents a per-query bitmask pattern; all three are
+first-class here:
+
+- :class:`NoneSampleFilter` — allow everything (the default).
+- :class:`BitsetFilter` — one shared bitset over sample ids; bit set =
+  sample allowed (``filtering::bitset_filter``, used by
+  ``cagra::search_with_filtering``).
+- :class:`BitmapFilter` — an independent bitset **per query** (the
+  ``bitmask_ivf_sample_filter`` pattern): words shaped
+  ``(n_queries, ceil(n/32))``.
+
+Search functions accept a raw :class:`~raft_tpu.core.bitset.Bitset`
+(treated as a :class:`BitsetFilter`) or any of these wrappers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.bitset import WORD_BITS, Bitset, test_words
+
+
+@dataclasses.dataclass(frozen=True)
+class NoneSampleFilter:
+    """Allow every sample (``none_ivf_sample_filter`` /
+    ``none_cagra_sample_filter``)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BitsetFilter:
+    """Shared greenlight bitset over sample ids."""
+
+    bitset: Bitset
+
+
+@dataclasses.dataclass(frozen=True)
+class BitmapFilter:
+    """Per-query greenlight bits: ``words[q, id // 32]`` bit ``id % 32``."""
+
+    words: jax.Array  # (n_queries, n_words) uint32
+
+    @classmethod
+    def from_mask(cls, mask) -> "BitmapFilter":
+        """Build from a (n_queries, n_samples) boolean mask."""
+        mask = np.asarray(mask, bool)
+        q, n = mask.shape
+        n_words = -(-n // WORD_BITS)
+        padded = np.zeros((q, n_words * WORD_BITS), bool)
+        padded[:, :n] = mask
+        bits = padded.reshape(q, n_words, WORD_BITS)
+        words = (bits.astype(np.uint32)
+                 << np.arange(WORD_BITS, dtype=np.uint32)).sum(
+                     axis=2, dtype=np.uint32)
+        return cls(jnp.asarray(words))
+
+
+def resolve_filter_words(sample_filter):
+    """Normalize any accepted filter form to a words array (1-D shared,
+    2-D per-query) or None."""
+    if sample_filter is None or isinstance(sample_filter, NoneSampleFilter):
+        return None
+    if isinstance(sample_filter, Bitset):
+        return sample_filter.words
+    if isinstance(sample_filter, BitsetFilter):
+        return sample_filter.bitset.words
+    if isinstance(sample_filter, BitmapFilter):
+        return sample_filter.words
+    raise TypeError(
+        f"unsupported sample_filter type {type(sample_filter).__name__}; "
+        "pass a Bitset, BitsetFilter, BitmapFilter, or NoneSampleFilter"
+    )
+
+
+def test_filter(words, ids):
+    """Greenlight bits for ``ids`` (q, m) under shared (1-D) or
+    per-query (2-D) words."""
+    if words.ndim == 1:
+        return test_words(words, ids)
+    ids = jnp.asarray(ids)
+    safe = jnp.clip(ids, 0)
+    word = jnp.take_along_axis(words, safe // WORD_BITS, axis=1)
+    return ((word >> (safe % WORD_BITS).astype(jnp.uint32)) & 1).astype(
+        jnp.bool_)

@@ -429,7 +429,9 @@ def _search_impl(queries, centers, center_norms, data, data_norms, indices,
             dist = row_norms - 2.0 * ipr                         # +||q||^2 later
             dist = jnp.where(row_ids >= 0, dist, pad_val)
         if filter_words is not None:
-            bits = test_words(filter_words, row_ids)
+            from raft_tpu.neighbors.filters import test_filter
+
+            bits = test_filter(filter_words, row_ids)
             dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
 
         new_d, new_i = merge_topk(best_d, best_i, dist, row_ids, k, select_min)
@@ -456,22 +458,23 @@ def search(
     index: IvfFlatIndex,
     queries,
     k: int,
-    sample_filter: Optional[Bitset] = None,
+    sample_filter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """ANN search — ``ivf_flat::search``
     (``detail/ivf_flat_search-inl.cuh:38-210``).
 
-    Returns (distances, indices) of shape (q, k); missing slots (when
-    fewer than k valid candidates were probed) have index -1."""
+    ``sample_filter``: a Bitset or any :mod:`raft_tpu.neighbors.filters`
+    type. Returns (distances, indices) of shape (q, k); missing slots
+    (when fewer than k valid candidates were probed) have index -1."""
+    from raft_tpu.neighbors.filters import resolve_filter_words
+
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
            "queries must be (q, dim)")
     expect(index.max_list_size > 0, "index is empty — extend() it first")
     n_probes = min(params.n_probes, index.n_lists)
-    filter_words = None
-    if sample_filter is not None:
-        filter_words = sample_filter.words
+    filter_words = resolve_filter_words(sample_filter)
     with tracing.range("raft_tpu.ivf_flat.search"):
         return _search_impl(
             queries, index.centers, index.center_norms, index.data,

@@ -610,7 +610,9 @@ def _search_impl(queries, centers, rotation, codebooks, codes, indices,
         dist = score(lut, rows) + base[:, None]
         dist = jnp.where(row_ids >= 0, dist, pad_val)
         if filter_words is not None:
-            bits = test_words(filter_words, row_ids)
+            from raft_tpu.neighbors.filters import test_filter
+
+            bits = test_filter(filter_words, row_ids)
             dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
 
         new_d, new_i = merge_topk(best_d, best_i, dist, row_ids, k, select_min)
@@ -634,7 +636,7 @@ def search(
     index: IvfPqIndex,
     queries,
     k: int,
-    sample_filter: Optional[Bitset] = None,
+    sample_filter=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """ANN search — ``ivf_pq::search`` (``detail/ivf_pq_search.cuh:732``).
 
@@ -647,7 +649,9 @@ def search(
            "queries must be (q, dim)")
     expect(index.max_list_size > 0, "index is empty — extend() it first")
     n_probes = min(params.n_probes, index.n_lists)
-    filter_words = sample_filter.words if sample_filter is not None else None
+    from raft_tpu.neighbors.filters import resolve_filter_words
+
+    filter_words = resolve_filter_words(sample_filter)
     with tracing.range("raft_tpu.ivf_pq.search"):
         return _search_impl(
             queries, index.centers, index.rotation, index.codebooks,

@@ -141,3 +141,45 @@ class TestIvfFlat:
                                     index, q[:2], 500)
         idx = np.asarray(idx)
         assert (idx == -1).any()  # one small list can't fill k=500
+
+
+class TestFilterTypes:
+    def test_bitmap_per_query_filter(self, dataset):
+        """Per-query bitmap: each query greenlights a different id set."""
+        from raft_tpu.neighbors.filters import BitmapFilter, BitsetFilter, NoneSampleFilter
+
+        x, q = dataset
+        q = q[:6]
+        params = IvfFlatIndexParams(n_lists=16)
+        index = ivf_flat.build(None, params, x)
+        n = len(x)
+        mask = np.ones((6, n), bool)
+        for r in range(6):
+            mask[r, r::3] = False  # query r forbids ids ≡ r (mod 3)
+        filt = BitmapFilter.from_mask(mask)
+        _, idx = ivf_flat.search(None, IvfFlatSearchParams(n_probes=16),
+                                 index, q, 10, sample_filter=filt)
+        idx = np.asarray(idx)
+        for r in range(6):
+            valid = idx[r][idx[r] >= 0]
+            assert valid.size > 0
+            assert (valid % 3 != r % 3).all() or not np.any(valid % 3 == r % 3)
+            assert mask[r, valid].all()
+
+        # NoneSampleFilter == no filter
+        _, i_none = ivf_flat.search(None, IvfFlatSearchParams(n_probes=16),
+                                    index, q, 10,
+                                    sample_filter=NoneSampleFilter())
+        _, i_raw = ivf_flat.search(None, IvfFlatSearchParams(n_probes=16),
+                                   index, q, 10)
+        assert np.array_equal(np.asarray(i_none), np.asarray(i_raw))
+
+        # BitsetFilter wrapper == raw Bitset
+        m1 = np.ones(n, bool); m1[::2] = False
+        b = Bitset.from_mask(m1)
+        _, i_a = ivf_flat.search(None, IvfFlatSearchParams(n_probes=16),
+                                 index, q, 10, sample_filter=b)
+        _, i_b = ivf_flat.search(None, IvfFlatSearchParams(n_probes=16),
+                                 index, q, 10,
+                                 sample_filter=BitsetFilter(b))
+        assert np.array_equal(np.asarray(i_a), np.asarray(i_b))

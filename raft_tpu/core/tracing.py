@@ -35,3 +35,25 @@ def annotated(name: str):
         return wrapper
 
     return deco
+
+
+@contextlib.contextmanager
+def capture(log_dir: str):
+    """Capture an XLA profiler trace for the enclosed block — the role
+    the gbench micro-benchmarks play as profiling entry points in the
+    reference (SURVEY.md §5). View with TensorBoard or xprof:
+
+        with tracing.capture("/tmp/trace"):
+            index = ivf_flat.build(res, params, dataset)
+    """
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def start_server(port: int = 9999):
+    """Start the on-demand profiler server (``jax.profiler``) so a
+    running service can be traced remotely."""
+    return jax.profiler.start_server(port)

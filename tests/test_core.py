@@ -175,3 +175,17 @@ class TestSpatialAlias:
             d, i = spatial_knn.brute_force_knn(None, x, x[:4], 3)
             assert any(issubclass(x.category, DeprecationWarning) for x in w)
         assert np.asarray(i)[:, 0].tolist() == [0, 1, 2, 3]
+
+
+class TestTracingCapture:
+    def test_capture_writes_trace(self, tmp_path):
+        import jax.numpy as jnp
+
+        from raft_tpu.core import tracing
+
+        with tracing.capture(str(tmp_path)):
+            with tracing.range("test.block"):
+                jnp.square(jnp.arange(16.0)).block_until_ready()
+        # a plugins/profile dir with at least one artifact appears
+        found = list(tmp_path.rglob("*"))
+        assert any(p.is_file() for p in found), found

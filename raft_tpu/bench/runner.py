@@ -121,6 +121,58 @@ def _block(x):
     return x
 
 
+# reference raft-ann-bench param spellings → this framework's
+_BUILD_KEY_MAP = {
+    "nlist": "n_lists",
+    "niter": "kmeans_n_iters",
+    "pq_dim": "pq_dim",
+    "pq_bits": "pq_bits",
+    "graph_degree": "graph_degree",
+    "intermediate_graph_degree": "intermediate_graph_degree",
+}
+_SEARCH_KEY_MAP = {
+    "nprobe": "n_probes",
+    "n_probes": "n_probes",
+    "itopk": "itopk_size",
+    "itopk_size": "itopk_size",
+    "search_width": "search_width",
+    "max_iterations": "max_iterations",
+    "refine_ratio": "refine_ratio",
+}
+_ALGO_ALIASES = {"raft_bfknn": "raft_brute_force"}
+
+
+def normalize_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Accept the reference's ``conf/*.json`` schema (an ``index`` list
+    with ``build_param``/``search_params``, ``run/conf/`` files) as well
+    as the native ``algos`` schema; translate raft param spellings
+    (nlist/nprobe/itopk/ratio/…) and drop non-raft competitor entries
+    (hnswlib/faiss/ggnn wrappers benchmark OTHER libraries)."""
+    if "algos" in config:
+        return config
+    if "index" not in config:
+        raise ValueError("config needs an 'algos' or 'index' section")
+    algos = []
+    for entry in config["index"]:
+        algo = _ALGO_ALIASES.get(entry["algo"], entry["algo"])
+        if algo not in ALGO_REGISTRY:
+            continue  # competitor wrapper (hnswlib/faiss/...)
+        build = {}
+        for key, val in entry.get("build_param", {}).items():
+            if key == "ratio":  # subsample ratio → trainset fraction
+                build["kmeans_trainset_fraction"] = 1.0 / max(val, 1)
+            elif key in _BUILD_KEY_MAP:
+                build[_BUILD_KEY_MAP[key]] = val
+        search = []
+        for sp in entry.get("search_params", [{}]):
+            search.append({_SEARCH_KEY_MAP[k]: v for k, v in sp.items()
+                           if k in _SEARCH_KEY_MAP})
+        algos.append({"name": algo, "build": build, "search": search})
+    if not algos:
+        raise ValueError("config contained no raft algorithms")
+    return {"algos": algos}
+
+
 def run_benchmark(
     dataset_dir,
     config: Dict[str, Any],
@@ -140,6 +192,7 @@ def run_benchmark(
                     "build": {"n_lists": 1024},
                     "search": [{"n_probes": 16}, {"n_probes": 64}]}]}
     """
+    config = normalize_config(config)
     dataset_dir = pathlib.Path(dataset_dir)
     out_dir = pathlib.Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)

@@ -88,3 +88,55 @@ class TestRunner:
         ])
         assert rc == 0
         assert (tmp_path / "res2" / "results.jsonl").exists()
+
+
+class TestReferenceConfigSchema:
+    def test_normalize_reference_config(self):
+        from raft_tpu.bench.runner import normalize_config
+
+        ref = {
+            "dataset": {"name": "x", "distance": "euclidean"},
+            "index": [
+                {"algo": "raft_bfknn", "build_param": {},
+                 "search_params": [{"probe": 1}]},
+                {"algo": "hnswlib", "build_param": {"M": 12},
+                 "search_params": [{"ef": 10}]},
+                {"algo": "raft_ivf_pq",
+                 "build_param": {"niter": 25, "nlist": 1000, "pq_dim": 64,
+                                 "pq_bits": 8, "ratio": 2},
+                 "search_params": [{"nprobe": 20,
+                                    "internalDistanceDtype": "float"}]},
+                {"algo": "raft_cagra", "build_param": {"graph_degree": 32},
+                 "search_params": [{"itopk": 32}, {"itopk": 64}]},
+            ],
+        }
+        cfg = normalize_config(ref)
+        names = [a["name"] for a in cfg["algos"]]
+        assert names == ["raft_brute_force", "raft_ivf_pq", "raft_cagra"]
+        pq = cfg["algos"][1]
+        assert pq["build"] == {"kmeans_n_iters": 25, "n_lists": 1000,
+                               "pq_dim": 64, "pq_bits": 8,
+                               "kmeans_trainset_fraction": 0.5}
+        assert pq["search"] == [{"n_probes": 20}]
+        assert cfg["algos"][2]["search"] == [{"itopk_size": 32},
+                                             {"itopk_size": 64}]
+        # native schema passes through untouched
+        native = {"algos": [{"name": "raft_brute_force"}]}
+        assert normalize_config(native) is native
+
+    def test_runs_with_reference_schema(self, tmp_path):
+        import json
+
+        from raft_tpu.bench.datasets import make_dataset
+        from raft_tpu.bench.runner import run_benchmark
+
+        root = make_dataset(tmp_path, "tiny", n=2000, dim=16, n_queries=50,
+                            k=10)
+        ref_cfg = {"index": [
+            {"algo": "raft_ivf_flat", "build_param": {"nlist": 16},
+             "search_params": [{"nprobe": 8}, {"nprobe": 16}]},
+        ]}
+        rows = run_benchmark(root, ref_cfg, tmp_path / "out", k=10,
+                             search_iters=1)
+        assert len(rows) == 2
+        assert rows[1]["recall"] >= 0.99

@@ -213,3 +213,18 @@ class TestPooledSeeding:
         r_pool, _, _ = eval_recall(gt, np.asarray(i_pool))
         assert r_pool >= r_rand, (r_pool, r_rand)
         assert r_pool >= 0.95, (r_pool, r_rand)
+
+
+class TestIntDataset:
+    def test_int8_dataset_self_hit(self):
+        rng = np.random.default_rng(0)
+        x = rng.integers(-100, 100, (2000, 32)).astype(np.int8)
+        q = x[:8].astype(np.float32)
+        index = cagra.build(
+            None,
+            CagraIndexParams(graph_degree=16, intermediate_graph_degree=32,
+                             build_algo=BuildAlgo.NN_DESCENT), x)
+        _, i = cagra.search(
+            None, CagraSearchParams(itopk_size=32, search_width=4),
+            index, q, 5)
+        assert (np.asarray(i)[:, 0] == np.arange(8)).all()

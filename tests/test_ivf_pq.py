@@ -185,3 +185,21 @@ class TestScoreModes:
             index, q, 10)
         r, _, _ = eval_recall(np.asarray(i1), np.asarray(i2))
         assert r >= 0.95, r
+
+
+class TestIntDatasets:
+    """Reference supports float/int8/uint8 datasets (ivf_pq_types.hpp);
+    self-query must return itself first."""
+
+    @pytest.mark.parametrize("dtype,lo,hi", [(np.int8, -100, 100),
+                                             (np.uint8, 0, 200)])
+    def test_int_dataset_self_hit(self, rng_np, dtype, lo, hi):
+        from raft_tpu.neighbors import ivf_pq
+
+        x = rng_np.integers(lo, hi, (2000, 32)).astype(dtype)
+        q = x[:8].astype(np.float32)
+        idx = ivf_pq.build(
+            None, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16), x)
+        _, i = ivf_pq.search(
+            None, ivf_pq.IvfPqSearchParams(n_probes=16), idx, q, 5)
+        assert (np.asarray(i)[:, 0] == np.arange(8)).all()

@@ -40,6 +40,7 @@ from raft_tpu.linalg.reduce import (
     reduce_rows_by_key,
     strided_reduction,
 )
+from raft_tpu.sparse.solver import lanczos_smallest  # noqa: F401  (linalg/lanczos alias)
 from raft_tpu.linalg.solvers import (
     cholesky_rank_one_update,
     eig_dc,
@@ -81,6 +82,7 @@ __all__ = [
     "reduce_rows_by_key",
     "strided_reduction",
     "cholesky_rank_one_update",
+    "lanczos_smallest",
     "eig_dc",
     "eig_jacobi",
     "lstsq",

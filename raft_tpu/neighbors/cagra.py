@@ -41,7 +41,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core import tracing
-from raft_tpu.core.bitset import Bitset, test_words
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.serialize import (
     check_version,
@@ -56,6 +55,7 @@ from raft_tpu.distance.types import DistanceType
 from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
 from raft_tpu.neighbors import nn_descent as nn_descent_mod
 from raft_tpu.neighbors._exact import gathered_distances
+from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 from raft_tpu.neighbors.nn_descent import _reverse_sample
 from raft_tpu.neighbors.refine import refine
 
@@ -363,7 +363,7 @@ def _pooled_seeds(dataset, queries, pool: int, n_seeds: int,
     """Best ``n_seeds`` of a strided ``pool``-row sample per query — a
     one-GEMM routing stage replacing uniform-random seeding."""
     n = dataset.shape[0]
-    stride = max(1, n // pool)
+    stride = -(-n // pool)  # ceil: the pool must span the whole id range
     cand = (jnp.arange(pool, dtype=jnp.int32) * stride) % n
     qf = queries.astype(jnp.float32)
     d = gathered_distances(
@@ -384,8 +384,6 @@ def _search_batch(dataset, graph, queries, seed_ids, filter_words,
     def score(cand):                                     # (q, c) ids → dists
         d = gathered_distances(qf, dataset, cand, metric)
         if filter_words is not None:
-            from raft_tpu.neighbors.filters import test_filter
-
             # filtered-out samples never enter the itopk buffer, so they
             # are neither returned nor expanded (the reference's
             # search_with_filtering greenlight semantics)
@@ -462,15 +460,19 @@ def search(
     max_iters = params.max_iterations or (L // w + 24)
     n_seeds = max(L, w * index.graph_degree) * max(1, params.num_random_samplings)
     n_seeds = min(n_seeds, n)
-    from raft_tpu.neighbors.filters import resolve_filter_words
-
     filter_words = resolve_filter_words(sample_filter)
+    if filter_words is not None and filter_words.ndim == 2:
+        expect(filter_words.shape[0] == queries.shape[0],
+               "per-query BitmapFilter rows must match the query count")
 
     with tracing.range("raft_tpu.cagra.search"):
         outs_d, outs_i = [], []
         tile = max(1, params.query_tile)
         for start in range(0, queries.shape[0], tile):
             qt = queries[start : start + tile]
+            fw = filter_words
+            if fw is not None and fw.ndim == 2:
+                fw = fw[start : start + tile]
             if params.seed_pool > 0:
                 seeds = _pooled_seeds(index.dataset, qt,
                                       min(params.seed_pool, n), n_seeds,
@@ -483,8 +485,7 @@ def search(
                     key, (qt.shape[0], n_seeds), 0, n, jnp.int32
                 )
             d, i = _search_batch(index.dataset, index.graph, qt, seeds,
-                                 filter_words, k, L, w, max_iters,
-                                 index.metric)
+                                 fw, k, L, w, max_iters, index.metric)
             outs_d.append(d)
             outs_i.append(i)
         if len(outs_d) == 1:

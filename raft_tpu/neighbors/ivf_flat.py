@@ -35,7 +35,6 @@ import numpy as np
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.core import tracing
-from raft_tpu.core.bitset import Bitset, test_words
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.serialize import (
     check_version,
@@ -49,6 +48,7 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
+from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 
 _SERIALIZATION_VERSION = 4  # kept in step with the reference's v4 format id
 
@@ -429,8 +429,6 @@ def _search_impl(queries, centers, center_norms, data, data_norms, indices,
             dist = row_norms - 2.0 * ipr                         # +||q||^2 later
             dist = jnp.where(row_ids >= 0, dist, pad_val)
         if filter_words is not None:
-            from raft_tpu.neighbors.filters import test_filter
-
             bits = test_filter(filter_words, row_ids)
             dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
 
@@ -466,8 +464,6 @@ def search(
     ``sample_filter``: a Bitset or any :mod:`raft_tpu.neighbors.filters`
     type. Returns (distances, indices) of shape (q, k); missing slots
     (when fewer than k valid candidates were probed) have index -1."""
-    from raft_tpu.neighbors.filters import resolve_filter_words
-
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,

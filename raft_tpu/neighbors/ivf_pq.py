@@ -42,7 +42,6 @@ import numpy as np
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.core import tracing
-from raft_tpu.core.bitset import Bitset, test_words
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.serialize import (
     check_version,
@@ -56,6 +55,7 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
+from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 
 _SERIALIZATION_VERSION = 3  # kept in step with the reference's v3 format id
 
@@ -610,8 +610,6 @@ def _search_impl(queries, centers, rotation, codebooks, codes, indices,
         dist = score(lut, rows) + base[:, None]
         dist = jnp.where(row_ids >= 0, dist, pad_val)
         if filter_words is not None:
-            from raft_tpu.neighbors.filters import test_filter
-
             bits = test_filter(filter_words, row_ids)
             dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
 
@@ -649,8 +647,6 @@ def search(
            "queries must be (q, dim)")
     expect(index.max_list_size > 0, "index is empty — extend() it first")
     n_probes = min(params.n_probes, index.n_lists)
-    from raft_tpu.neighbors.filters import resolve_filter_words
-
     filter_words = resolve_filter_words(sample_filter)
     with tracing.range("raft_tpu.ivf_pq.search"):
         return _search_impl(

@@ -228,3 +228,29 @@ class TestIntDataset:
             None, CagraSearchParams(itopk_size=32, search_width=4),
             index, q, 5)
         assert (np.asarray(i)[:, 0] == np.arange(8)).all()
+
+
+class TestCagraBitmapTiling:
+    def test_per_query_bitmap_across_tiles(self, dataset):
+        """BitmapFilter rows must follow their queries through host-side
+        query tiling."""
+        from raft_tpu.neighbors.filters import BitmapFilter
+
+        x, q = dataset
+        n = len(x)
+        # 32 queries, force tiny tiles so tiling engages
+        mask = np.ones((len(q), n), bool)
+        for r in range(len(q)):
+            mask[r, r % 2 :: 2] = False   # each query forbids one parity
+        filt = BitmapFilter.from_mask(mask)
+        params = CagraIndexParams(graph_degree=16,
+                                  intermediate_graph_degree=32,
+                                  build_algo=BuildAlgo.NN_DESCENT)
+        index = cagra.build(None, params, x)
+        sp = CagraSearchParams(itopk_size=32, search_width=4, query_tile=8)
+        _, idx = cagra.search(None, sp, index, q, 5, sample_filter=filt)
+        idx = np.asarray(idx)
+        for r in range(len(q)):
+            valid = idx[r][idx[r] >= 0]
+            assert valid.size > 0
+            assert mask[r, valid].all(), r

@@ -14,7 +14,9 @@ repo publishes no numeric tables to compare against — see BASELINE.md.)
 
 Progress goes to stderr so a slow run is diagnosable; stdout carries
 exactly one JSON line. Env knobs: BENCH_N / BENCH_DIM / BENCH_BATCH /
-BENCH_K / BENCH_SECONDS (measurement budget, default 45).
+BENCH_K / BENCH_SECONDS (measurement budget, default 45) /
+BENCH_DTYPE (float32|bfloat16 dataset storage) /
+RAFT_TPU_DISABLE_FUSED=1 (force the XLA tile-scan path).
 """
 
 import json
@@ -52,9 +54,11 @@ def main():
     queries = jax.random.normal(kq, (BATCH, D), jnp.float32)
     jax.block_until_ready((dataset, queries))
     log("data generated")
-    index = brute_force.build(None, dataset)
+    storage = (jnp.bfloat16 if os.environ.get("BENCH_DTYPE") == "bfloat16"
+               else None)
+    index = brute_force.build(None, dataset, storage_dtype=storage)
     jax.block_until_ready(index.norms)
-    log("index built (norms cached)")
+    log(f"index built (storage {index.dataset.dtype}, norms cached)")
 
     def run():
         d, i = brute_force.search(None, index, queries, K, db_tile=262144)

@@ -145,11 +145,16 @@ def _use_fused_kernel(metric: DistanceType, k: int, q: int) -> bool:
     """Dispatch to the Pallas fused scan (role of the reference's
     fused-vs-tiled choice, ``detail/knn_brute_force.cuh:324``): TPU
     hardware, an expanded metric the kernel supports, small-k (the
-    VPU merge is O(k·tile)), and a VMEM-resident query block."""
+    VPU merge is O(k·tile)), and a VMEM-resident query block.
+    ``RAFT_TPU_DISABLE_FUSED=1`` forces the XLA tile-scan path
+    (A/B profiling knob)."""
+    import os
+
     from raft_tpu.ops.fused_topk import _SUPPORTED_METRICS
 
     return (
         jax.default_backend() == "tpu"
+        and os.environ.get("RAFT_TPU_DISABLE_FUSED") != "1"
         and metric in _SUPPORTED_METRICS
         and k <= 64
         and q <= 512

@@ -220,6 +220,28 @@ def cluster_cost(res, centroids, x) -> jax.Array:
     return jnp.sum(dist)
 
 
+def update_centroids(res, x, centroids, sample_weights=None):
+    """One M-step: assign points to their nearest centroid and return the
+    (weighted) per-cluster means — ``compute_new_centroids``
+    (``pylibraft.cluster.kmeans.compute_new_centroids``). Empty clusters
+    keep their previous centroid. Returns (new_centroids, labels)."""
+    ensure_resources(res)
+    x = jnp.asarray(x, jnp.float32)
+    centroids = jnp.asarray(centroids, jnp.float32)
+    k = centroids.shape[0]
+    _, labels = _predict_labels(x, centroids)
+    if sample_weights is None:
+        sums, sizes = _calc_centers_and_sizes(x, labels, k)
+        new = jnp.where((sizes > 0)[:, None], sums, centroids)
+    else:
+        w = jnp.asarray(sample_weights, jnp.float32)
+        sums = jax.ops.segment_sum(x * w[:, None], labels, num_segments=k)
+        wsum = jax.ops.segment_sum(w, labels, num_segments=k)
+        new = jnp.where((wsum > 0)[:, None],
+                        sums / jnp.maximum(wsum, 1e-30)[:, None], centroids)
+    return new, labels
+
+
 def find_k(
     res: Optional[Resources],
     x,

@@ -114,3 +114,33 @@ class TestKMeansBalanced:
                 np.testing.assert_allclose(
                     np.asarray(centers)[c], x[labels == c].mean(0), rtol=1e-4, atol=1e-4
                 )
+
+
+class TestUpdateCentroids:
+    def test_one_m_step(self, rng_np):
+        from raft_tpu.cluster.kmeans import update_centroids
+
+        x = rng_np.standard_normal((500, 8)).astype(np.float32)
+        c0 = x[:4]
+        new, labels = update_centroids(None, x, c0)
+        labels = np.asarray(labels)
+        ref = np.stack([
+            x[labels == j].mean(0) if (labels == j).any() else np.asarray(c0[j])
+            for j in range(4)
+        ])
+        np.testing.assert_allclose(np.asarray(new), ref, rtol=1e-5, atol=1e-5)
+
+    def test_weighted(self, rng_np):
+        from raft_tpu.cluster.kmeans import update_centroids
+
+        x = rng_np.standard_normal((200, 4)).astype(np.float32)
+        w = rng_np.uniform(0.1, 2.0, 200).astype(np.float32)
+        c0 = x[:3]
+        new, labels = update_centroids(None, x, c0, sample_weights=w)
+        labels = np.asarray(labels)
+        for j in range(3):
+            m = labels == j
+            if m.any():
+                ref = (x[m] * w[m, None]).sum(0) / w[m].sum()
+                np.testing.assert_allclose(np.asarray(new[j]), ref,
+                                           rtol=1e-4, atol=1e-4)

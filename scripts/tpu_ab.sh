@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Serial TPU A/B profiling session — run ONLY when the relay is healthy
+# and nothing else is using the chip. Never run two of these at once;
+# never kill one mid-flight (the relay wedges).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+N=${BENCH_N:-1000000}
+SECS=${BENCH_SECONDS:-20}
+
+run() {
+  echo "=== $* ===" >&2
+  env "$@" BENCH_N=$N BENCH_SECONDS=$SECS timeout 1800 python bench.py
+}
+
+# 1. default dispatch (fused Pallas kernel on TPU)
+run BENCH_TAG=fused
+# 2. XLA tile-scan path
+run RAFT_TPU_DISABLE_FUSED=1 BENCH_TAG=scan
+# 3. bf16 storage (half the HBM stream)
+run BENCH_DTYPE=bfloat16 BENCH_TAG=bf16
+# 4. bf16 + scan
+run BENCH_DTYPE=bfloat16 RAFT_TPU_DISABLE_FUSED=1 BENCH_TAG=bf16scan
+
+# 5. ANN mini-suite: ivf_flat / ivf_pq(gather|onehot) / cagra on 200k
+timeout 3600 python - << 'EOF'
+import json, time
+import jax, jax.numpy as jnp
+import numpy as np
+from raft_tpu.neighbors import ivf_flat, ivf_pq, cagra
+from raft_tpu.utils import eval_recall
+
+N, D, Q, K = 200_000, 128, 100, 10
+rng = np.random.default_rng(0)
+x = rng.standard_normal((N, D)).astype(np.float32)
+q = rng.standard_normal((Q, D)).astype(np.float32)
+d2 = ((q[:, :16][:, None, :] - x[:, :16][None, :, :]) ** 2)  # placeholder
+from raft_tpu.neighbors import brute_force
+gt_d, gt_i = brute_force.knn(None, x, q, K)
+gt = np.asarray(gt_i)
+
+def bench(name, fn, iters=10):
+    fn(); t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    d, i = out
+    r, _, _ = eval_recall(gt, np.asarray(i))
+    print(json.dumps({"bench": name, "qps": round(Q / dt, 1),
+                      "recall": round(float(r), 4)}), flush=True)
+
+fi = ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(n_lists=1024), x)
+for p in (32, 64):
+    sp = ivf_flat.IvfFlatSearchParams(n_probes=p)
+    bench(f"ivf_flat_p{p}", lambda sp=sp: tuple(
+        jax.block_until_ready(ivf_flat.search(None, sp, fi, q, K))))
+
+pi = ivf_pq.build(None, ivf_pq.IvfPqIndexParams(n_lists=1024, pq_dim=64), x)
+for mode in ("gather", "onehot"):
+    sp = ivf_pq.IvfPqSearchParams(n_probes=64, score_mode=mode)
+    bench(f"ivf_pq_{mode}", lambda sp=sp: tuple(
+        jax.block_until_ready(ivf_pq.search(None, sp, pi, q, K))))
+
+ci = cagra.build(None, cagra.CagraIndexParams(
+    graph_degree=32, intermediate_graph_degree=64,
+    build_algo=cagra.BuildAlgo.NN_DESCENT), x)
+for it in (64, 128):
+    sp = cagra.CagraSearchParams(itopk_size=it, search_width=4)
+    bench(f"cagra_itopk{it}", lambda sp=sp: tuple(
+        jax.block_until_ready(cagra.search(None, sp, ci, q, K))))
+EOF

@@ -30,6 +30,18 @@ def spmm(csr: CSR, dense, transpose_output: bool = False) -> jax.Array:
     return out.T if transpose_output else out
 
 
+def spgemm(a: CSR, b: CSR) -> CSR:
+    """Sparse × sparse → sparse (``sparse/linalg`` spgemm via cuSPARSE in
+    the reference). TPU-native form: densify the right operand and ride
+    the MXU, then re-sparsify — the product's structure is data-dependent
+    (dynamic nnz), which XLA cannot express natively, and at the graph
+    sizes this stack serves the dense intermediate is the fast path."""
+    from raft_tpu.sparse.convert import csr_to_dense, dense_to_csr
+
+    out = spmm(a, csr_to_dense(b))
+    return dense_to_csr(out)
+
+
 def spmv(csr: CSR, vec) -> jax.Array:
     """CSR × vector."""
     return spmm(csr, jnp.asarray(vec)[:, None])[:, 0]

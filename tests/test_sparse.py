@@ -247,3 +247,16 @@ class TestReviewRegressions:
             x.to_dense(), y.to_dense(), DistanceType.L2Expanded, 2.0, "highest"
         )
         np.testing.assert_allclose(np.asarray(d), np.asarray(dref), atol=1e-3)
+
+
+class TestSpgemm:
+    def test_matches_dense_product(self, rng_np):
+        from raft_tpu.sparse.convert import csr_to_dense, dense_to_csr
+        from raft_tpu.sparse.linalg import spgemm
+
+        a = rng_np.standard_normal((12, 8)) * (rng_np.random((12, 8)) < 0.3)
+        b = rng_np.standard_normal((8, 10)) * (rng_np.random((8, 10)) < 0.3)
+        a, b = a.astype(np.float32), b.astype(np.float32)
+        out = spgemm(dense_to_csr(a), dense_to_csr(b))
+        np.testing.assert_allclose(np.asarray(csr_to_dense(out)), a @ b,
+                                   rtol=1e-5, atol=1e-5)

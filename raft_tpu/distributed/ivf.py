@@ -250,6 +250,110 @@ def search(
         )
 
 
+def build_streaming(
+    res: Optional[Resources],
+    comms: Comms,
+    params: IvfFlatIndexParams,
+    source,
+    chunk_rows: int = 1 << 20,
+    train_rows: int = 1 << 18,
+) -> DistributedIvfFlat:
+    """Stream a dataset larger than any single chip's HBM directly into
+    the list-sharded index: the quantizer trains on a strided sample,
+    then every prefetched chunk is scattered into the ALREADY-SHARDED
+    device buffers (donated, so updates stay in place on their shards).
+    This is the capacity story of the distributed index — the dataset
+    never materializes on one device or in host memory.
+    """
+    res = ensure_resources(res)
+    r = comms.size
+    n_lists = -(-params.n_lists // r) * r
+    params = dataclasses.replace(params, n_lists=n_lists,
+                                 add_data_on_build=False)
+    n, d = source.n_rows, source.dim
+
+    with tracing.range("raft_tpu.distributed.ivf_flat.build_streaming"):
+        # quantizer on a strided sample (host-side, small)
+        train_rows = max(n_lists, min(train_rows, n))
+        stride = max(1, n // train_rows)
+        parts = []
+        for first, chunk in source.iter_chunks(chunk_rows):
+            offset = (-first) % stride
+            parts.append(np.asarray(chunk[offset::stride], np.float32))
+        trainset = np.concatenate(parts)[:train_rows]
+        quant = ivf_flat_mod.build(res, params, trainset)
+
+        # labels + sizes per chunk
+        from raft_tpu.cluster import kmeans_balanced
+        from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+
+        km = KMeansBalancedParams(
+            metric=(DistanceType.InnerProduct
+                    if params.metric == DistanceType.InnerProduct
+                    else DistanceType.L2Expanded))
+        labels_np = np.empty((n,), np.int32)
+        for first, chunk in source.iter_chunks(chunk_rows):
+            lab = kmeans_balanced.predict(
+                res, km, quant.centers, jnp.asarray(chunk, jnp.float32))
+            labels_np[first : first + chunk.shape[0]] = np.asarray(lab)
+        sizes_np = np.bincount(labels_np, minlength=n_lists)
+        max_size = max(8, -(-int(sizes_np.max()) // 8) * 8)
+
+        # deal lists round-robin by population; dealt[i] = original list
+        order = np.argsort(-sizes_np, kind="stable")
+        deal = np.concatenate([order[s::r] for s in range(r)])
+        dealt_pos = np.empty((n_lists,), np.int32)
+        dealt_pos[deal] = np.arange(n_lists, dtype=np.int32)
+
+        shard = comms.sharding(comms.axis)
+        data = jax.device_put(
+            jnp.zeros((n_lists, max_size, d), jnp.float32), shard)
+        indices = jax.device_put(
+            jnp.full((n_lists, max_size), -1, jnp.int32), shard)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def scatter_chunk(data, idx, rows, ids, list_ids, ranks):
+            return (data.at[list_ids, ranks].set(rows),
+                    idx.at[list_ids, ranks].set(ids))
+
+        fill = np.zeros((n_lists,), np.int64)
+        for first, chunk in source.iter_chunks(chunk_rows):
+            m = chunk.shape[0]
+            lab = labels_np[first : first + m]
+            corder = np.argsort(lab, kind="stable")
+            sl = lab[corder]
+            first_pos = np.searchsorted(sl, np.arange(n_lists))
+            rank_sorted = np.arange(m) - first_pos[sl] + fill[sl]
+            ranks = np.empty((m,), np.int32)
+            ranks[corder] = rank_sorted.astype(np.int32)
+            np.add.at(fill, lab, 1)
+            data, indices = scatter_chunk(
+                data, indices,
+                jnp.asarray(chunk, jnp.float32),
+                jnp.asarray(first + np.arange(m, dtype=np.int32)),
+                jnp.asarray(dealt_pos[lab]),
+                jnp.asarray(ranks),
+            )
+
+        @jax.jit
+        def make_norms(data, indices):
+            norms = jnp.sum(jnp.square(data), axis=2)
+            return jnp.where(indices >= 0, norms, jnp.inf)
+
+        perm = jnp.asarray(deal, jnp.int32)
+        return DistributedIvfFlat(
+            comms=comms,
+            centers=jax.device_put(jnp.take(quant.centers, perm, axis=0),
+                                   shard),
+            data=data,
+            data_norms=make_norms(data, indices),
+            indices=indices,
+            list_sizes=jax.device_put(
+                jnp.asarray(sizes_np[deal], jnp.int32), shard),
+            metric=DistanceType(params.metric),
+        )
+
+
 # ---------------------------------------------------------------------------
 # distributed IVF-PQ — the SIFT-1B-scale configuration: compressed codes
 # sharded over the mesh, per-subspace codebooks replicated

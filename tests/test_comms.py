@@ -303,3 +303,28 @@ class TestDistributedIvfPq:
         gt = np.argsort(d2, axis=1, kind="stable")[:, :10]
         r, _, _ = eval_recall(gt, np.asarray(i))
         assert r >= 0.9, r
+
+
+class TestDistributedStreamingBuild:
+    def test_streamed_equals_exact_at_full_probes(self, comms, rng_np,
+                                                  tmp_path):
+        from raft_tpu.distributed import ivf as dist_ivf
+        from raft_tpu.io import BinDataset, write_bin
+        from raft_tpu.neighbors.ivf_flat import (
+            IvfFlatIndexParams,
+            IvfFlatSearchParams,
+        )
+
+        x = rng_np.standard_normal((2048, 16)).astype(np.float32)
+        q = rng_np.standard_normal((8, 16)).astype(np.float32)
+        write_bin(tmp_path / "d.fbin", x)
+        with BinDataset(tmp_path / "d.fbin") as ds:
+            index = dist_ivf.build_streaming(
+                None, comms, IvfFlatIndexParams(n_lists=16), ds,
+                chunk_rows=512)
+        assert index.size == 2048
+        d, i = dist_ivf.search(None, IvfFlatSearchParams(n_probes=16),
+                               index, q, 5)
+        d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        gt = np.argsort(d2, axis=1, kind="stable")[:, :5]
+        assert np.array_equal(np.asarray(i), gt)

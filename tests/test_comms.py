@@ -328,3 +328,28 @@ class TestDistributedStreamingBuild:
         d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
         gt = np.argsort(d2, axis=1, kind="stable")[:, :5]
         assert np.array_equal(np.asarray(i), gt)
+
+
+class TestTwoDimGrid:
+    def test_list_by_query_grid(self, rng_np):
+        """2-D mesh: lists shard over one axis, queries over the other."""
+        import jax
+        from jax.sharding import Mesh
+
+        from raft_tpu.distributed import ivf as dist_ivf
+        from raft_tpu.neighbors.ivf_flat import (
+            IvfFlatIndexParams,
+            IvfFlatSearchParams,
+        )
+
+        devs = np.array(jax.devices()[:8]).reshape(4, 2)
+        comms = Comms(Mesh(devs, ("lists", "queries")), "lists")
+        x = rng_np.standard_normal((2048, 16)).astype(np.float32)
+        q = rng_np.standard_normal((16, 16)).astype(np.float32)
+        index = dist_ivf.build(None, comms, IvfFlatIndexParams(n_lists=16),
+                               x)
+        d, i = dist_ivf.search(None, IvfFlatSearchParams(n_probes=16),
+                               index, q, 5, query_axis="queries")
+        d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        gt = np.argsort(d2, axis=1, kind="stable")[:, :5]
+        assert np.array_equal(np.asarray(i), gt)

@@ -455,10 +455,11 @@ def build_pq(
 
 
 @partial(jax.jit, static_argnames=("axis", "mesh", "n_probes", "k", "metric",
-                                   "probe_mode"))
+                                   "probe_mode", "query_axis"))
 def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
                     axis: str, mesh, n_probes: int, k: int,
-                    metric: DistanceType, probe_mode: str):
+                    metric: DistanceType, probe_mode: str,
+                    query_axis: Optional[str] = None):
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
     pq_dim, book, pq_len = codebooks.shape
@@ -537,10 +538,11 @@ def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
         all_i = allgather(best_i, axis)
         return knn_merge_parts(all_d, all_i, select_min)
 
+    qspec = P() if query_axis is None else P(query_axis, None)
     out_d, out_i = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None, None), P(axis, None), P()),
-        out_specs=(P(), P()),
+        in_specs=(P(axis, None), P(axis, None, None), P(axis, None), qspec),
+        out_specs=(qspec, qspec),
         check_vma=False,
     )(centers, codes, indices, queries)
 
@@ -557,9 +559,10 @@ def search_pq(
     queries,
     k: int,
     probe_mode: str = "global",
+    query_axis: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """One-program distributed PQ search (LUT scoring per shard, global
-    merge); semantics of :func:`search`."""
+    merge); semantics of :func:`search` incl. the 2-D ``query_axis``."""
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -567,14 +570,21 @@ def search_pq(
     expect(probe_mode in ("global", "local"),
            f"probe_mode must be 'global' or 'local', got {probe_mode!r}")
     comms = index.comms
+    if query_axis is not None:
+        expect(query_axis in comms.mesh.axis_names and query_axis != comms.axis,
+               f"query_axis {query_axis!r} must be another mesh axis")
+        expect(queries.shape[0] % comms.mesh.shape[query_axis] == 0,
+               "query count must divide the query axis")
     local_lists = index.n_lists // comms.size
     n_probes = min(params.n_probes, index.n_lists)
     if probe_mode == "local":
         n_probes = min(-(-n_probes // comms.size), local_lists)
-    queries = jax.device_put(queries, comms.replicated())
+    qsharding = (comms.replicated() if query_axis is None
+                 else comms.sharding(query_axis))
+    queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_pq.search"):
         return _dist_search_pq(
             index.centers, index.rotation, index.codebooks, index.codes,
             index.indices, queries, comms.axis, comms.mesh, n_probes, k,
-            index.metric, probe_mode,
+            index.metric, probe_mode, query_axis,
         )

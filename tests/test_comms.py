@@ -353,3 +353,16 @@ class TestTwoDimGrid:
         d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
         gt = np.argsort(d2, axis=1, kind="stable")[:, :5]
         assert np.array_equal(np.asarray(i), gt)
+
+        # PQ variant on the same grid
+        from raft_tpu.neighbors.ivf_pq import (
+            IvfPqIndexParams,
+            IvfPqSearchParams,
+        )
+        pqi = dist_ivf.build_pq(
+            None, comms, IvfPqIndexParams(n_lists=16, pq_dim=16), x)
+        _, ip = dist_ivf.search_pq(
+            None, IvfPqSearchParams(n_probes=16), pqi, q, 5,
+            query_axis="queries")
+        r, _, _ = eval_recall(gt, np.asarray(ip))
+        assert r >= 0.5, r

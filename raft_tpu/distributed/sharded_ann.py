@@ -20,7 +20,6 @@ import jax.numpy as jnp
 from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.validation import expect
-from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors.brute_force import knn_merge_parts
 
 

@@ -30,7 +30,6 @@ from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.pairwise import _pairwise_distance_impl
 from raft_tpu.distance.types import DistanceType
-from raft_tpu.matrix.select_k import merge_topk
 
 
 @jax.tree_util.register_pytree_node_class

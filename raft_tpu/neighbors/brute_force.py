@@ -42,7 +42,6 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.pairwise import _pairwise_distance_impl
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
-from raft_tpu.neighbors.ann_types import IndexParams
 
 _SERIALIZATION_VERSION = 1
 

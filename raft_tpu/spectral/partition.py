@@ -21,7 +21,7 @@ import numpy as np
 from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.cluster import kmeans as _kmeans
-from raft_tpu.sparse.types import COO, CSR
+from raft_tpu.sparse.types import CSR
 
 
 def fit_embedding(

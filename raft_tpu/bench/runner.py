@@ -104,6 +104,18 @@ def _cagra_search(index, queries, k, *, itopk_size=64, max_iterations=0,
     return cagra.search(None, p, index, queries, k)
 
 
+def _quantized_build(base, metric, **params):
+    from raft_tpu.neighbors import quantized
+
+    return quantized.build(None, base, metric)
+
+
+def _quantized_search(index, queries, k, **params):
+    from raft_tpu.neighbors import quantized
+
+    return quantized.search(None, index, queries, k)
+
+
 ALGO_REGISTRY: Dict[str, AlgoWrapper] = {
     "raft_brute_force": AlgoWrapper("raft_brute_force",
                                     _brute_force_build, _brute_force_search),
@@ -111,6 +123,8 @@ ALGO_REGISTRY: Dict[str, AlgoWrapper] = {
                                  _ivf_flat_build, _ivf_flat_search),
     "raft_ivf_pq": AlgoWrapper("raft_ivf_pq", _ivf_pq_build, _ivf_pq_search),
     "raft_cagra": AlgoWrapper("raft_cagra", _cagra_build, _cagra_search),
+    "raft_quantized": AlgoWrapper("raft_quantized",
+                                  _quantized_build, _quantized_search),
 }
 
 

@@ -182,7 +182,12 @@ def search(
     expect(queries.ndim == 2, "queries must be (q, d)")
     expect(queries.shape[1] == index.dim, "query dim mismatch")
     expect(0 < k <= index.size, f"k must be in (0, {index.size}]")
-    db_tile = min(db_tile, max(128, index.size))
+    # bound the (q_tile, db_tile) distance buffer by the handle's
+    # workspace budget (the reference sizes its tiles from the workspace
+    # memory resource the same way, ``knn_brute_force.cuh:57-90``)
+    q_rows = min(queries.shape[0], query_tile)
+    budget_cols = max(128, res.workspace_limit_bytes // (4 * max(q_rows, 1)))
+    db_tile = min(db_tile, budget_cols, max(128, index.size))
     precision = res.matmul_precision
     if index.dataset.dtype == jnp.bfloat16:
         # bf16 products are exact in the f32 accumulator — extra MXU

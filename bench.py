@@ -40,7 +40,33 @@ def log(msg):
           file=sys.stderr, flush=True)
 
 
+def _backend_healthy(timeout_s: float) -> bool:
+    """Probe backend init in a subprocess: a wedged TPU relay blocks
+    ~25 min before erroring, which would eat the whole bench budget."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('OK')"],
+            capture_output=True, timeout=timeout_s, text=True,
+        )
+        return "OK" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", 300))
+    suffix = ""
+    if not _backend_healthy(init_timeout):
+        log(f"default backend failed/hung (> {init_timeout:.0f}s probe); "
+            "falling back to CPU — metric annotated accordingly")
+        suffix = "_cpu_fallback"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
     log(f"importing jax (config {N}x{D}, batch {BATCH}, k {K})")
     import jax
     import jax.numpy as jnp
@@ -84,7 +110,7 @@ def main():
         f"median {sorted(times)[len(times) // 2] * 1e3:.1f} ms")
 
     print(json.dumps({
-        "metric": f"brute_force_knn_qps_sift1m_shape_b{BATCH}_k{K}",
+        "metric": f"brute_force_knn_qps_sift1m_shape_b{BATCH}_k{K}{suffix}",
         "value": round(qps, 2),
         "unit": "QPS",
         "vs_baseline": round(qps / ROOFLINE_QPS, 4),

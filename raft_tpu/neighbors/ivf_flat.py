@@ -457,13 +457,16 @@ def search(
     queries,
     k: int,
     sample_filter=None,
+    query_tile: int = 4096,
 ) -> Tuple[jax.Array, jax.Array]:
     """ANN search — ``ivf_flat::search``
     (``detail/ivf_flat_search-inl.cuh:38-210``).
 
     ``sample_filter``: a Bitset or any :mod:`raft_tpu.neighbors.filters`
-    type. Returns (distances, indices) of shape (q, k); missing slots
-    (when fewer than k valid candidates were probed) have index -1."""
+    type. Large query sets are processed in ``query_tile`` batches (the
+    reference's max_queries=4096 batching loop). Returns (distances,
+    indices) of shape (q, k); missing slots (when fewer than k valid
+    candidates were probed) have index -1."""
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -472,11 +475,24 @@ def search(
     n_probes = min(params.n_probes, index.n_lists)
     filter_words = resolve_filter_words(sample_filter)
     with tracing.range("raft_tpu.ivf_flat.search"):
-        return _search_impl(
-            queries, index.centers, index.center_norms, index.data,
-            index.data_norms, index.indices, filter_words,
-            n_probes, k, index.metric,
-        )
+        def run(qt, fw):
+            return _search_impl(
+                qt, index.centers, index.center_norms, index.data,
+                index.data_norms, index.indices, fw,
+                n_probes, k, index.metric,
+            )
+
+        if queries.shape[0] <= query_tile:
+            return run(queries, filter_words)
+        outs_d, outs_i = [], []
+        for start in range(0, queries.shape[0], query_tile):
+            fw = filter_words
+            if fw is not None and fw.ndim == 2:
+                fw = fw[start : start + query_tile]
+            d, i = run(queries[start : start + query_tile], fw)
+            outs_d.append(d)
+            outs_i.append(i)
+        return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
 
 
 # ---------------------------------------------------------------------------

@@ -635,8 +635,11 @@ def search(
     queries,
     k: int,
     sample_filter=None,
+    query_tile: int = 4096,
 ) -> Tuple[jax.Array, jax.Array]:
     """ANN search — ``ivf_pq::search`` (``detail/ivf_pq_search.cuh:732``).
+    Large query sets run in ``query_tile`` batches (the reference's
+    max_queries=4096 loop, ``ivf_pq_search.cuh:790``).
 
     For L2 metrics the returned distances are approximate (residual-PQ)
     squared L2 (or sqrt thereof); use :func:`raft_tpu.neighbors.refine`
@@ -649,12 +652,25 @@ def search(
     n_probes = min(params.n_probes, index.n_lists)
     filter_words = resolve_filter_words(sample_filter)
     with tracing.range("raft_tpu.ivf_pq.search"):
-        return _search_impl(
-            queries, index.centers, index.rotation, index.codebooks,
-            index.codes, index.indices, filter_words,
-            n_probes, k, index.metric, index.codebook_kind,
-            params.lut_dtype, params.score_mode,
-        )
+        def run(qt, fw):
+            return _search_impl(
+                qt, index.centers, index.rotation, index.codebooks,
+                index.codes, index.indices, fw,
+                n_probes, k, index.metric, index.codebook_kind,
+                params.lut_dtype, params.score_mode,
+            )
+
+        if queries.shape[0] <= query_tile:
+            return run(queries, filter_words)
+        outs_d, outs_i = [], []
+        for start in range(0, queries.shape[0], query_tile):
+            fw = filter_words
+            if fw is not None and fw.ndim == 2:
+                fw = fw[start : start + query_tile]
+            d, i = run(queries[start : start + query_tile], fw)
+            outs_d.append(d)
+            outs_i.append(i)
+        return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
 
 
 # ---------------------------------------------------------------------------

@@ -183,3 +183,16 @@ class TestFilterTypes:
                                  index, q, 10,
                                  sample_filter=BitsetFilter(b))
         assert np.array_equal(np.asarray(i_a), np.asarray(i_b))
+
+
+class TestQueryTiling:
+    def test_tiled_matches_untiled(self, dataset):
+        x, _ = dataset
+        rng = np.random.default_rng(1)
+        q = rng.standard_normal((50, x.shape[1])).astype(np.float32)
+        index = ivf_flat.build(None, IvfFlatIndexParams(n_lists=16), x)
+        sp = IvfFlatSearchParams(n_probes=16)
+        d1, i1 = ivf_flat.search(None, sp, index, q, 10)
+        d2, i2 = ivf_flat.search(None, sp, index, q, 10, query_tile=16)
+        assert np.array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2))

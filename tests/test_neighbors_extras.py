@@ -163,3 +163,26 @@ class TestIvfHelpers:
                / np.linalg.norm(orig, axis=1))
         assert np.median(rel) < 0.65, np.median(rel)
         assert pq_extract_centers(index).shape == (8, 32)
+
+
+class TestOddDims:
+    """dim not a multiple of 8/128 exercises padding and the PQ
+    rotation's dim→dim_ext extension (reference supports arbitrary dims)."""
+
+    def test_dim17_all_families(self, rng_np):
+        from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+
+        x = rng_np.standard_normal((1500, 17)).astype(np.float32)
+        q = x[:6]
+        _, i = brute_force.knn(None, x, q, 5)
+        assert (np.asarray(i)[:, 0] == np.arange(6)).all()
+        fidx = ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+        _, i = ivf_flat.search(None, ivf_flat.IvfFlatSearchParams(n_probes=8),
+                               fidx, q, 5)
+        assert (np.asarray(i)[:, 0] == np.arange(6)).all()
+        pidx = ivf_pq.build(None, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=5),
+                            x)
+        assert pidx.dim_ext == 20 and pidx.pq_len == 4
+        _, i = ivf_pq.search(None, ivf_pq.IvfPqSearchParams(n_probes=8),
+                             pidx, q, 5)
+        assert (np.asarray(i)[:, 0] == np.arange(6)).all()

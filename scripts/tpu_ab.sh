@@ -34,16 +34,16 @@ N, D, Q, K = 200_000, 128, 100, 10
 rng = np.random.default_rng(0)
 x = rng.standard_normal((N, D)).astype(np.float32)
 q = rng.standard_normal((Q, D)).astype(np.float32)
-d2 = ((q[:, :16][:, None, :] - x[:, :16][None, :, :]) ** 2)  # placeholder
 from raft_tpu.neighbors import brute_force
 gt_d, gt_i = brute_force.knn(None, x, q, K)
 gt = np.asarray(gt_i)
 
 def bench(name, fn, iters=10):
-    fn(); t0 = time.perf_counter()
+    out = fn(); jax.block_until_ready(out)        # compile + warm
+    t0 = time.perf_counter()
     for _ in range(iters):
         out = fn()
-    jax.block_until_ready(out)
+        jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / iters
     d, i = out
     r, _, _ = eval_recall(gt, np.asarray(i))

@@ -196,3 +196,19 @@ class TestQueryTiling:
         d2, i2 = ivf_flat.search(None, sp, index, q, 10, query_tile=16)
         assert np.array_equal(np.asarray(i1), np.asarray(i2))
         np.testing.assert_allclose(np.asarray(d1), np.asarray(d2))
+
+
+class TestApproxCoarse:
+    def test_approx_coarse_recall(self, dataset):
+        x, q = dataset
+        index = ivf_flat.build(None, IvfFlatIndexParams(n_lists=32), x)
+        _, i1 = ivf_flat.search(None, IvfFlatSearchParams(n_probes=16),
+                                index, q, 10)
+        _, i2 = ivf_flat.search(
+            None, IvfFlatSearchParams(n_probes=16, coarse_algo="approx"),
+            index, q, 10)
+        overlap = np.mean([
+            len(set(np.asarray(i1)[r]) & set(np.asarray(i2)[r])) / 10
+            for r in range(len(q))
+        ])
+        assert overlap >= 0.9, overlap

@@ -98,11 +98,13 @@ def build(
 
 
 @partial(jax.jit, static_argnames=("k", "metric", "metric_arg", "tile",
-                                   "precision"))
+                                   "precision", "approx"))
 def _knn_scan(queries, dataset, k: int, metric: DistanceType, metric_arg: float,
-              tile: int, precision: str = "highest"):
+              tile: int, precision: str = "highest", approx: bool = False):
     """Scan database tiles, carrying running top-k (the global-merge loop of
-    ``tiled_brute_force_knn``)."""
+    ``tiled_brute_force_knn``). ``approx`` swaps the per-tile exact top-k
+    for the TPU's native approximate top-k unit (the TPU-KNN-paper
+    peak-FLOP/s recipe); the cross-tile merge stays exact."""
     n, d = dataset.shape
     q = queries.shape[0]
     select_min = is_min_close(metric)
@@ -122,7 +124,11 @@ def _knn_scan(queries, dataset, k: int, metric: DistanceType, metric_arg: float,
         col_ids = t_idx * tile + jnp.arange(tile)
         dist = jnp.where((col_ids < n)[None, :], dist, pad_val)
         kk = min(k, tile)
-        if select_min:
+        if approx:
+            sel = (jax.lax.approx_min_k if select_min
+                   else jax.lax.approx_max_k)
+            tile_d, tile_i = sel(dist, kk, recall_target=0.95)
+        elif select_min:
             tile_d, tile_i = jax.lax.top_k(-dist, kk)
             tile_d = -tile_d
         else:
@@ -167,9 +173,12 @@ def search(
     k: int,
     query_tile: int = 8192,
     db_tile: int = 32768,
+    approx: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN: returns (distances (q, k), indices (q, k) int32) —
-    ``brute_force::knn`` / ``brute_force::search``.
+    ``brute_force::knn`` / ``brute_force::search``. ``approx=True``
+    trades exactness for the TPU's approximate top-k unit in the
+    per-tile selection (recall ≈ 0.95 per tile; merge stays exact).
 
     For ``InnerProduct`` the returned "distances" are similarities sorted
     descending (``is_min_close`` semantics, matching the reference).
@@ -196,19 +205,19 @@ def search(
         precision = "default"
     with tracing.range("raft_tpu.brute_force.search"):
         q = queries.shape[0]
-        if _use_fused_kernel(index.metric, k, q):
+        if not approx and _use_fused_kernel(index.metric, k, q):
             from raft_tpu.ops.fused_topk import fused_knn
 
             return fused_knn(queries, index.dataset, k, index.metric,
                              tile=8192)
         if q <= query_tile:
             return _knn_scan(queries, index.dataset, k, index.metric,
-                             index.metric_arg, db_tile, precision)
+                             index.metric_arg, db_tile, precision, approx)
         outs_d, outs_i = [], []
         for start in range(0, q, query_tile):
             dq, iq = _knn_scan(queries[start : start + query_tile], index.dataset,
                                k, index.metric, index.metric_arg, db_tile,
-                               precision)
+                               precision, approx)
             outs_d.append(dq)
             outs_i.append(iq)
         return jnp.concatenate(outs_d), jnp.concatenate(outs_i)

@@ -127,3 +127,19 @@ class TestBf16Storage:
         # distances approximately exact
         ref = np.take_along_axis(d2, np.asarray(i), axis=1)
         np.testing.assert_allclose(np.asarray(d), ref, rtol=0.03, atol=0.5)
+
+
+class TestApproxScan:
+    def test_approx_overlaps_exact(self, rng_np):
+        from raft_tpu.neighbors import brute_force
+
+        x = rng_np.standard_normal((5000, 32)).astype(np.float32)
+        q = rng_np.standard_normal((16, 32)).astype(np.float32)
+        index = brute_force.build(None, x)
+        _, i1 = brute_force.search(None, index, q, 10)
+        _, i2 = brute_force.search(None, index, q, 10, approx=True)
+        overlap = np.mean([
+            len(set(np.asarray(i1)[r]) & set(np.asarray(i2)[r])) / 10
+            for r in range(len(q))
+        ])
+        assert overlap >= 0.9, overlap

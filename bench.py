@@ -109,8 +109,10 @@ def main():
     log(f"{len(times)} iters, best {dt * 1e3:.1f} ms, "
         f"median {sorted(times)[len(times) // 2] * 1e3:.1f} ms")
 
+    tag = os.environ.get("BENCH_TAG", "")
+    tag = f"_{tag}" if tag else ""
     print(json.dumps({
-        "metric": f"brute_force_knn_qps_sift1m_shape_b{BATCH}_k{K}{suffix}",
+        "metric": f"brute_force_knn_qps_sift1m_shape_b{BATCH}_k{K}{tag}{suffix}",
         "value": round(qps, 2),
         "unit": "QPS",
         "vs_baseline": round(qps / ROOFLINE_QPS, 4),

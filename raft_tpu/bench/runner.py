@@ -107,12 +107,16 @@ def _cagra_search(index, queries, k, *, itopk_size=64, max_iterations=0,
 def _quantized_build(base, metric, **params):
     from raft_tpu.neighbors import quantized
 
+    if params:
+        raise ValueError(f"raft_quantized build takes no params, got {params}")
     return quantized.build(None, base, metric)
 
 
 def _quantized_search(index, queries, k, **params):
     from raft_tpu.neighbors import quantized
 
+    if params:
+        raise ValueError(f"raft_quantized search takes no params, got {params}")
     return quantized.search(None, index, queries, k)
 
 

@@ -250,7 +250,7 @@ def search(
         expect(query_axis in comms.mesh.axis_names and query_axis != comms.axis,
                f"query_axis {query_axis!r} must be another mesh axis")
         expect(queries.shape[0] % comms.mesh.shape[query_axis] == 0,
-               "query count must divide the query axis")
+               "the query-axis size must divide the query count evenly")
     local_lists = index.n_lists // comms.size
     n_probes = min(params.n_probes, index.n_lists)
     if probe_mode == "local":
@@ -574,7 +574,7 @@ def search_pq(
         expect(query_axis in comms.mesh.axis_names and query_axis != comms.axis,
                f"query_axis {query_axis!r} must be another mesh axis")
         expect(queries.shape[0] % comms.mesh.shape[query_axis] == 0,
-               "query count must divide the query axis")
+               "the query-axis size must divide the query count evenly")
     local_lists = index.n_lists // comms.size
     n_probes = min(params.n_probes, index.n_lists)
     if probe_mode == "local":

@@ -1,0 +1,32 @@
+"""Shared host-side query batching for the search entry points — the
+reference's max_queries loop (``ivf_pq_search.cuh:790``), with per-tile
+slicing of 2-D (per-query) filter words."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def tile_queries(
+    run: Callable,
+    queries: jax.Array,
+    filter_words,
+    query_tile: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Apply ``run(queries_tile, filter_words_tile)`` over query tiles and
+    concatenate. 1-D (shared) filter words pass through unchanged; 2-D
+    (per-query) words are sliced with their queries."""
+    if queries.shape[0] <= query_tile:
+        return run(queries, filter_words)
+    outs_d, outs_i = [], []
+    for start in range(0, queries.shape[0], query_tile):
+        fw = filter_words
+        if fw is not None and fw.ndim == 2:
+            fw = fw[start : start + query_tile]
+        d, i = run(queries[start : start + query_tile], fw)
+        outs_d.append(d)
+        outs_i.append(i)
+    return jnp.concatenate(outs_d), jnp.concatenate(outs_i)

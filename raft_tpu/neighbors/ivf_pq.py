@@ -54,6 +54,7 @@ from raft_tpu.core.serialize import (
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
+from raft_tpu.neighbors._batching import tile_queries
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 
@@ -660,17 +661,7 @@ def search(
                 params.lut_dtype, params.score_mode,
             )
 
-        if queries.shape[0] <= query_tile:
-            return run(queries, filter_words)
-        outs_d, outs_i = [], []
-        for start in range(0, queries.shape[0], query_tile):
-            fw = filter_words
-            if fw is not None and fw.ndim == 2:
-                fw = fw[start : start + query_tile]
-            d, i = run(queries[start : start + query_tile], fw)
-            outs_d.append(d)
-            outs_i.append(i)
-        return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+        return tile_queries(run, queries, filter_words, query_tile)
 
 
 # ---------------------------------------------------------------------------

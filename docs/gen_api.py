@@ -1,0 +1,61 @@
+"""Regenerate docs/api.md from the live package.
+
+Run:  JAX_PLATFORMS=cpu python docs/gen_api.py
+"""
+
+import importlib
+import inspect
+import pathlib
+
+MODULES = [
+    "raft_tpu.core.resources", "raft_tpu.core.bitset", "raft_tpu.core.logger",
+    "raft_tpu.core.tracing", "raft_tpu.core.interruptible",
+    "raft_tpu.core.serialize", "raft_tpu.core.operators",
+    "raft_tpu.core.validation",
+    "raft_tpu.distance", "raft_tpu.linalg", "raft_tpu.matrix", "raft_tpu.ops",
+    "raft_tpu.random", "raft_tpu.stats", "raft_tpu.label",
+    "raft_tpu.sparse.convert", "raft_tpu.sparse.linalg",
+    "raft_tpu.sparse.distance", "raft_tpu.sparse.neighbors",
+    "raft_tpu.sparse.ops", "raft_tpu.sparse.solver",
+    "raft_tpu.cluster.kmeans", "raft_tpu.cluster.kmeans_balanced",
+    "raft_tpu.cluster.single_linkage", "raft_tpu.spectral", "raft_tpu.solver",
+    "raft_tpu.neighbors.brute_force", "raft_tpu.neighbors.ivf_flat",
+    "raft_tpu.neighbors.ivf_pq", "raft_tpu.neighbors.cagra",
+    "raft_tpu.neighbors.nn_descent", "raft_tpu.neighbors.refine",
+    "raft_tpu.neighbors.ball_cover", "raft_tpu.neighbors.epsilon_neighborhood",
+    "raft_tpu.neighbors.quantized", "raft_tpu.neighbors.filters",
+    "raft_tpu.neighbors.ivf_helpers",
+    "raft_tpu.comms", "raft_tpu.comms.bootstrap",
+    "raft_tpu.distributed.ivf", "raft_tpu.distributed.knn",
+    "raft_tpu.distributed.kmeans", "raft_tpu.distributed.sharded_ann",
+    "raft_tpu.io", "raft_tpu.bench", "raft_tpu.utils",
+]
+
+
+def main():
+    lines = ["# API index", "",
+             "Public callables and classes per module (generated from the "
+             "package; regenerate with `python docs/gen_api.py`).", ""]
+    for name in MODULES:
+        m = importlib.import_module(name)
+        pub = []
+        names = getattr(m, "__all__", None) or sorted(vars(m))
+        for s in names:
+            if s.startswith("_"):
+                continue
+            obj = getattr(m, s, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            if inspect.isfunction(obj) or inspect.isclass(obj):
+                if getattr(obj, "__module__", "").startswith("raft_tpu"):
+                    pub.append(s + ("()" if inspect.isfunction(obj) else ""))
+        if pub:
+            lines.append(f"- **`{name}`** — "
+                         + ", ".join(f"`{s}`" for s in pub))
+    out = pathlib.Path(__file__).parent / "api.md"
+    out.write_text("\n".join(lines) + "\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -430,6 +430,13 @@ def build_pq(
 
     with tracing.range("raft_tpu.distributed.ivf_pq.build"):
         index = ivf_pq_mod.build(res, params, dataset)
+        codes = index.codes
+        if index.packed:
+            # the distributed scan uses the unpacked layout
+            from raft_tpu.neighbors.ivf_pq import _unpack_nibbles
+
+            codes = _unpack_nibbles(codes)
+            index = dataclasses.replace(index, codes=codes, packed=False)
 
         sizes = np.asarray(jax.device_get(index.list_sizes))
         order = np.argsort(-sizes, kind="stable")

@@ -61,10 +61,16 @@ def flat_pack_list_data(index: IvfFlatIndex, label: int, vectors,
 
 def pq_unpack_list_data(index: IvfPqIndex, label: int) -> Tuple[jax.Array, jax.Array]:
     """(codes (size, pq_dim) uint8, ids (size,)) of one list —
-    ``helpers::codepacker::unpack_list_data``."""
+    ``helpers::codepacker::unpack_list_data``. Nibble-packed 4-bit
+    storage is expanded back to one code per byte."""
+    from raft_tpu.neighbors.ivf_pq import _unpack_nibbles
+
     expect(0 <= label < index.n_lists, "bad list id")
     size = int(index.list_sizes[label])
-    return index.codes[label, :size], index.indices[label, :size]
+    codes = index.codes[label, :size]
+    if index.packed:
+        codes = _unpack_nibbles(codes)
+    return codes, index.indices[label, :size]
 
 
 def pq_reconstruct_list_data(index: IvfPqIndex, label: int) -> jax.Array:

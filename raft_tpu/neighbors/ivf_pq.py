@@ -58,7 +58,7 @@ from raft_tpu.neighbors._batching import tile_queries
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 
-_SERIALIZATION_VERSION = 3  # kept in step with the reference's v3 format id
+_SERIALIZATION_VERSION = 4  # v4: adds the 4-bit nibble-packed codes flag
 
 
 class CodebookKind(enum.IntEnum):
@@ -104,22 +104,26 @@ class IvfPqIndex:
     rotation: jax.Array       # (dim_ext, dim) f32 orthogonal-ish map
     codebooks: jax.Array      # PER_SUBSPACE: (pq_dim, 2^bits, pq_len)
                               # PER_CLUSTER:  (n_lists, 2^bits, pq_len)
-    codes: jax.Array          # (n_lists, max_list_size, pq_dim) uint8
+    codes: jax.Array          # (n_lists, max_list_size, pq_dim) uint8 —
+                              # or (…, pq_dim // 2) nibble-packed when
+                              # ``packed`` (pq_bits == 4)
     indices: jax.Array        # (n_lists, max_list_size) int32, -1 pad
     list_sizes: jax.Array     # (n_lists,) int32
     metric: DistanceType
     codebook_kind: CodebookKind
     pq_bits: int
+    packed: bool = False      # two 4-bit codes per byte (halves HBM)
 
     def tree_flatten(self):
         return (
             self.centers, self.rotation, self.codebooks, self.codes,
             self.indices, self.list_sizes,
-        ), (self.metric, self.codebook_kind, self.pq_bits)
+        ), (self.metric, self.codebook_kind, self.pq_bits, self.packed)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, metric=aux[0], codebook_kind=aux[1], pq_bits=aux[2])
+        return cls(*children, metric=aux[0], codebook_kind=aux[1],
+                   pq_bits=aux[2], packed=aux[3])
 
     @property
     def n_lists(self) -> int:
@@ -135,7 +139,7 @@ class IvfPqIndex:
 
     @property
     def pq_dim(self) -> int:
-        return self.codes.shape[2]
+        return self.codes.shape[2] * 2 if self.packed else self.codes.shape[2]
 
     @property
     def pq_len(self) -> int:
@@ -237,6 +241,21 @@ def _encode(rot_residuals, codebooks, labels, codebook_kind: CodebookKind,
             + jnp.sum(jnp.square(cb), -1)[:, None, :]
         )
     return jnp.argmin(d, axis=2).astype(jnp.uint8)
+
+
+def _pack_nibbles(codes):
+    """Two 4-bit codes per byte along the last axis: even subspaces in
+    the low nibble (role of the reference's bit-packed 4-bit code
+    planes, ``ivf_pq_types.hpp`` list_spec)."""
+    return (codes[..., 0::2] | (codes[..., 1::2] << 4)).astype(jnp.uint8)
+
+
+def _unpack_nibbles(packed):
+    """Inverse of :func:`_pack_nibbles` → (..., 2 * packed.shape[-1])."""
+    lo = packed & jnp.uint8(0x0F)
+    hi = packed >> 4
+    stacked = jnp.stack([lo, hi], axis=-1)          # (..., s/2, 2)
+    return stacked.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
 
 
 def _pack_codes(codes, ids, labels, n_lists: int, max_list_size: int):
@@ -478,7 +497,9 @@ def extend(
                             index.pq_dim, index.pq_len)
 
         if index.max_list_size > 0:
-            old_codes = index.codes.reshape(-1, index.pq_dim)
+            stored = (_unpack_nibbles(index.codes) if index.packed
+                      else index.codes)
+            old_codes = stored.reshape(-1, index.pq_dim)
             old_ids = index.indices.reshape(-1)
             old_labels = jnp.repeat(jnp.arange(index.n_lists, dtype=jnp.int32),
                                     index.max_list_size)
@@ -497,8 +518,11 @@ def extend(
         max_size = max(8, -(-max_size // 8) * 8)
         codes, indices, sizes = _pack_codes(all_codes, all_ids, all_labels,
                                             index.n_lists, max_size)
+        should_pack = index.pq_bits == 4 and index.pq_dim % 2 == 0
+        if should_pack:
+            codes = _pack_nibbles(codes)
         return dataclasses.replace(index, codes=codes, indices=indices,
-                                   list_sizes=sizes)
+                                   list_sizes=sizes, packed=should_pack)
 
 
 # ---------------------------------------------------------------------------
@@ -532,13 +556,15 @@ def _score_onehot(lut, rows):
 
 
 @partial(jax.jit, static_argnames=("n_probes", "k", "metric", "codebook_kind",
-                                   "lut_dtype", "score_mode"))
+                                   "lut_dtype", "score_mode", "packed"))
 def _search_impl(queries, centers, rotation, codebooks, codes, indices,
                  filter_words, n_probes: int, k: int, metric: DistanceType,
                  codebook_kind: CodebookKind, lut_dtype,
-                 score_mode: str = "gather"):
+                 score_mode: str = "gather", packed: bool = False):
     q, dim = queries.shape
     n_lists, max_size, pq_dim = codes.shape
+    if packed:
+        pq_dim = pq_dim * 2
     book_size = codebooks.shape[1]
     pq_len = codebooks.shape[2]
     select_min = is_min_close(metric)
@@ -605,6 +631,10 @@ def _search_impl(queries, centers, rotation, codebooks, codes, indices,
         lut = lut.astype(lut_dtype)                    # (q, pq_dim, J)
 
         rows = jnp.take(codes, lists, axis=0)          # (q, m, pq_dim) u8
+        if packed:
+            # nibble-unpack in VMEM right after the HBM gather — the
+            # stream stays half-width end to end
+            rows = _unpack_nibbles(rows)
         row_ids = jnp.take(indices, lists, axis=0)     # (q, m)
         # score codes: dist[q, m] = sum_s lut[q, s, rows[q, m, s]]
         score = _score_onehot if score_mode == "onehot" else _score_gather
@@ -658,7 +688,7 @@ def search(
                 qt, index.centers, index.rotation, index.codebooks,
                 index.codes, index.indices, fw,
                 n_probes, k, index.metric, index.codebook_kind,
-                params.lut_dtype, params.score_mode,
+                params.lut_dtype, params.score_mode, index.packed,
             )
 
         return tile_queries(run, queries, filter_words, query_tile)
@@ -674,6 +704,7 @@ def save(index: IvfPqIndex, fh_or_path) -> None:
     fh, own = open_maybe_path(fh_or_path, "wb")
     try:
         serialize_scalar(fh, _SERIALIZATION_VERSION, np.int32)
+        serialize_scalar(fh, int(index.packed), np.int32)
         serialize_scalar(fh, int(index.metric), np.int32)
         serialize_scalar(fh, int(index.codebook_kind), np.int32)
         serialize_scalar(fh, index.pq_bits, np.int32)
@@ -693,6 +724,7 @@ def load(res: Optional[Resources], fh_or_path) -> IvfPqIndex:
     fh, own = open_maybe_path(fh_or_path, "rb")
     try:
         check_version(deserialize_scalar(fh), _SERIALIZATION_VERSION, "ivf_pq")
+        packed = bool(int(deserialize_scalar(fh)))
         metric = DistanceType(int(deserialize_scalar(fh)))
         kind = CodebookKind(int(deserialize_scalar(fh)))
         pq_bits = int(deserialize_scalar(fh))
@@ -704,5 +736,5 @@ def load(res: Optional[Resources], fh_or_path) -> IvfPqIndex:
     return IvfPqIndex(
         centers=centers, rotation=rotation, codebooks=codebooks,
         codes=codes, indices=indices, list_sizes=sizes,
-        metric=metric, codebook_kind=kind, pq_bits=pq_bits,
+        metric=metric, codebook_kind=kind, pq_bits=pq_bits, packed=packed,
     )

@@ -82,7 +82,14 @@ class TestIvfPq:
         params = IvfPqIndexParams(n_lists=20, pq_dim=16, pq_bits=4)
         index = ivf_pq.build(None, params, x)
         assert index.pq_book_size == 16
-        assert int(np.asarray(index.codes).max()) < 16
+        # 4-bit codes are nibble-packed: storage halves, logical pq_dim holds
+        assert index.packed and index.codes.shape[2] == 8
+        assert index.pq_dim == 16
+        from raft_tpu.neighbors.ivf_helpers import pq_unpack_list_data
+
+        codes0, _ = pq_unpack_list_data(index, 0)
+        assert codes0.shape[1] == 16
+        assert int(np.asarray(codes0).max()) < 16
         _, idx = ivf_pq.search(None, IvfPqSearchParams(n_probes=20), index, q, 10)
         _, gt_i = _gt(x, q, 10)
         r, _, _ = eval_recall(gt_i, np.asarray(idx))
@@ -203,3 +210,45 @@ class TestIntDatasets:
         _, i = ivf_pq.search(
             None, ivf_pq.IvfPqSearchParams(n_probes=16), idx, q, 5)
         assert (np.asarray(i)[:, 0] == np.arange(8)).all()
+
+
+class TestNibblePacking:
+    def test_roundtrip_and_extend(self, rng_np):
+        """Packed 4-bit index: save/load round-trips, extend preserves
+        packing, search results equal across the packed/unpacked forms."""
+        import io as _io
+
+        import dataclasses as _dc
+
+        from raft_tpu.neighbors.ivf_pq import _unpack_nibbles
+
+        x = rng_np.standard_normal((2000, 32)).astype(np.float32)
+        q = rng_np.standard_normal((16, 32)).astype(np.float32)
+        params = IvfPqIndexParams(n_lists=16, pq_dim=16, pq_bits=4)
+        index = ivf_pq.build(None, params, x)
+        assert index.packed
+
+        # search equivalence vs manually unpacked index
+        loose = _dc.replace(index, codes=_unpack_nibbles(index.codes),
+                            packed=False)
+        sp = IvfPqSearchParams(n_probes=16)
+        d1, i1 = ivf_pq.search(None, sp, index, q, 10)
+        d2, i2 = ivf_pq.search(None, sp, loose, q, 10)
+        assert np.array_equal(np.asarray(i1), np.asarray(i2))
+        # XLA fuses the two layouts differently; float association only
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2),
+                                   rtol=1e-5)
+
+        # serialization round-trip keeps packing
+        buf = _io.BytesIO()
+        ivf_pq.save(index, buf)
+        buf.seek(0)
+        index2 = ivf_pq.load(None, buf)
+        assert index2.packed
+        _, i3 = ivf_pq.search(None, sp, index2, q, 10)
+        assert np.array_equal(np.asarray(i1), np.asarray(i3))
+
+        # extend keeps packing and adds rows
+        index3 = ivf_pq.extend(None, index, x[:100],
+                               np.arange(2000, 2100, dtype=np.int32))
+        assert index3.packed and index3.size == 2100

@@ -12,6 +12,13 @@ roofline QPS = batch · BW / bytes = 10 · 819e9 / 512e6 ≈ 16k QPS on
 TPU v5e. A value of 1.0 means memory-bound optimal. (The reference
 repo publishes no numeric tables to compare against — see BASELINE.md.)
 
+Timing is pipelined (dispatch a run of iterations, fetch once):
+``block_until_ready`` does not block on relayed backends, and a
+per-iteration host fetch would pay the relay round-trip every call.
+Measured note: through the axon relay the achievable HBM stream rate is
+~200 GB/s (XLA rowsum over the same array measures slower than this
+kernel), so vs_baseline ≈ 0.25 is the practical ceiling there.
+
 Progress goes to stderr so a slow run is diagnosable; stdout carries
 exactly one JSON line. Env knobs: BENCH_N / BENCH_DIM / BENCH_BATCH /
 BENCH_K / BENCH_SECONDS (measurement budget, default 45) /
@@ -86,28 +93,43 @@ def main():
     jax.block_until_ready(index.norms)
     log(f"index built (storage {index.dataset.dtype}, norms cached)")
 
+    import numpy as np
+
     def run():
-        d, i = brute_force.search(None, index, queries, K, db_tile=262144)
-        jax.block_until_ready((d, i))
-        return d, i
+        return brute_force.search(None, index, queries, K, db_tile=262144)
 
-    run()  # compile + warm
-    log("compiled + warmed")
+    def sync(out):
+        # force completion by fetching a few result elements:
+        # block_until_ready does NOT block on relayed backends (axon),
+        # so wall-clock timing must be anchored on a host fetch
+        np.asarray(out[0][0, :1])
 
-    # time-boxed measurement: as many iterations as fit in the budget,
-    # minimum 3, maximum 50
-    times = []
+    sync(run())  # compile + warm
+    t1 = time.perf_counter()
+    sync(run())
+    est = time.perf_counter() - t1  # one synced iter (incl. relay RTT)
+    log(f"compiled + warmed; single-iter estimate {est * 1e3:.1f} ms")
+
+    # pipelined measurement: dispatch a batch of iterations and sync once
+    # at the end — executions run back-to-back on device, so the per-call
+    # host->device round-trip latency is amortized out and the figure is
+    # steady-state throughput. Batch length is sized so one batch fits in
+    # ~half the budget; repeat batches within the time budget.
+    PIPE = max(3, min(50, int(BUDGET_S / 2 / max(est, 1e-4))))
+    rates = []
     t_meas = time.perf_counter()
-    while len(times) < 50 and (
-        len(times) < 3 or time.perf_counter() - t_meas < BUDGET_S
+    while len(rates) < 6 and (
+        not rates or time.perf_counter() - t_meas < BUDGET_S
     ):
         t0 = time.perf_counter()
-        run()
-        times.append(time.perf_counter() - t0)
-    dt = min(times)  # best-of: steady-state throughput
+        for _ in range(PIPE):
+            out = run()
+        sync(out)
+        rates.append((time.perf_counter() - t0) / PIPE)
+    dt = min(rates)  # best batch: steady-state throughput
     qps = BATCH / dt
-    log(f"{len(times)} iters, best {dt * 1e3:.1f} ms, "
-        f"median {sorted(times)[len(times) // 2] * 1e3:.1f} ms")
+    log(f"{len(rates)} batches of {PIPE}, best {dt * 1e3:.2f} ms/iter, "
+        f"median {sorted(rates)[len(rates) // 2] * 1e3:.2f} ms/iter")
 
     tag = os.environ.get("BENCH_TAG", "")
     tag = f"_{tag}" if tag else ""

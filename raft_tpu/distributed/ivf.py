@@ -379,17 +379,20 @@ def build_streaming(
 @dataclasses.dataclass(frozen=True)
 class DistributedIvfPq:
     """List-sharded IVF-PQ index (codes + ids sharded on the list axis,
-    rotation/codebooks replicated)."""
+    rotation replicated). PER_SUBSPACE codebooks are replicated;
+    PER_CLUSTER codebooks are per-list data and shard with the lists."""
 
     comms: Comms
     centers: jax.Array        # (n_lists, dim) sharded on axis 0
     rotation: jax.Array       # (dim_ext, dim) replicated
-    codebooks: jax.Array      # (pq_dim, 2^bits, pq_len) replicated
+    codebooks: jax.Array      # PER_SUBSPACE: (pq_dim, 2^bits, pq_len) repl.
+                              # PER_CLUSTER:  (n_lists, 2^bits, pq_len) shard.
     codes: jax.Array          # (n_lists, max_list_size, pq_dim) u8 sharded
     indices: jax.Array        # (n_lists, max_list_size) int32 sharded
     list_sizes: jax.Array     # (n_lists,) sharded
     metric: DistanceType
     pq_bits: int
+    codebook_kind: CodebookKind = CodebookKind.PER_SUBSPACE
 
     @property
     def n_lists(self) -> int:
@@ -418,12 +421,10 @@ def build_pq(
     params: IvfPqIndexParams,
     dataset,
 ) -> DistributedIvfPq:
-    """Build + deal, like :func:`build`. PER_SUBSPACE codebooks only (the
-    replicable kind; PER_CLUSTER would shard the codebooks with the
-    lists — unsupported here)."""
+    """Build + deal, like :func:`build`. PER_SUBSPACE codebooks are
+    replicated; PER_CLUSTER codebooks are per-list data and are dealt +
+    sharded together with the lists they describe."""
     res = ensure_resources(res)
-    expect(params.codebook_kind == CodebookKind.PER_SUBSPACE,
-           "distributed IVF-PQ supports PER_SUBSPACE codebooks")
     r = comms.size
     n_lists = -(-params.n_lists // r) * r
     params = dataclasses.replace(params, n_lists=n_lists)
@@ -448,31 +449,42 @@ def build_pq(
             return jax.device_put(jnp.take(a, perm, axis=0), shard)
 
         rep = comms.replicated()
+        per_cluster = params.codebook_kind == CodebookKind.PER_CLUSTER
         return DistributedIvfPq(
             comms=comms,
             centers=place(index.centers),
             rotation=jax.device_put(index.rotation, rep),
-            codebooks=jax.device_put(index.codebooks, rep),
+            codebooks=(place(index.codebooks) if per_cluster
+                       else jax.device_put(index.codebooks, rep)),
             codes=place(index.codes),
             indices=place(index.indices),
             list_sizes=place(index.list_sizes),
             metric=index.metric,
             pq_bits=index.pq_bits,
+            codebook_kind=params.codebook_kind,
         )
 
 
 @partial(jax.jit, static_argnames=("axis", "mesh", "n_probes", "k", "metric",
-                                   "probe_mode", "query_axis"))
+                                   "probe_mode", "query_axis",
+                                   "codebook_kind", "score_mode", "lut_dtype"))
 def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
                     axis: str, mesh, n_probes: int, k: int,
                     metric: DistanceType, probe_mode: str,
-                    query_axis: Optional[str] = None):
+                    query_axis: Optional[str] = None,
+                    codebook_kind: CodebookKind = CodebookKind.PER_SUBSPACE,
+                    score_mode: str = "gather",
+                    lut_dtype=jnp.float32):
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
-    pq_dim, book, pq_len = codebooks.shape
+    pq_dim = codes.shape[2]
+    pq_len = codebooks.shape[2]
     ip_metric = metric == DistanceType.InnerProduct
+    per_cluster = codebook_kind == CodebookKind.PER_CLUSTER
+    score = (ivf_pq_mod._score_onehot if score_mode == "onehot"
+             else ivf_pq_mod._score_gather)
 
-    def body(centers_l, codes_l, ids_l, qs):
+    def body(centers_l, books_l, codes_l, ids_l, qs):
         q = qs.shape[0]
         n_local = centers_l.shape[0]
         qf = qs.astype(jnp.float32)
@@ -504,34 +516,22 @@ def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
             local = probes.astype(jnp.int32)
             mine = jnp.ones(local.shape, jnp.bool_)
 
-        if ip_metric:
-            qsub_fixed = (qf @ rotation.T).reshape(q, pq_dim, pq_len)
-            lut_fixed = jnp.einsum("qsl,sjl->qsj", qsub_fixed, codebooks)
+        qsub_fixed = (qf @ rotation.T).reshape(q, pq_dim, pq_len)
+        lut_fixed = (jnp.einsum("qsl,sjl->qsj", qsub_fixed, books_l)
+                     if ip_metric and not per_cluster else None)
 
         def step(carry, rank_i):
             best_d, best_i = carry
             lists = local[:, rank_i]
             valid = mine[:, rank_i]
             c = jnp.take(centers_l, lists, axis=0)        # (q, dim)
-            if ip_metric:
-                base = jnp.sum(qf * c, axis=1)
-                lut = lut_fixed
-            else:
-                qsub = ((qf - c) @ rotation.T).reshape(q, pq_dim, pq_len)
-                base = jnp.zeros((q,), jnp.float32)
-                lut = (
-                    jnp.sum(jnp.square(qsub), -1)[:, :, None]
-                    - 2.0 * jnp.einsum("qsl,sjl->qsj", qsub, codebooks)
-                    + jnp.sum(jnp.square(codebooks), -1)[None, :, :]
-                )
+            lut, base = ivf_pq_mod._probe_lut(
+                qf, c, qsub_fixed, lut_fixed, rotation, books_l, lists,
+                ip_metric, per_cluster)
+            lut = lut.astype(lut_dtype)
             rows = jnp.take(codes_l, lists, axis=0)       # (q, m, s) u8
             row_ids = jnp.take(ids_l, lists, axis=0)
-            gathered = jnp.take_along_axis(
-                lut[:, None, :, :],
-                rows.astype(jnp.int32)[:, :, :, None],
-                axis=3,
-            )[..., 0]
-            dist = jnp.sum(gathered, axis=2) + base[:, None]
+            dist = score(lut, rows) + base[:, None]
             dist = jnp.where((row_ids >= 0) & valid[:, None], dist, pad_val)
             return merge_topk(best_d, best_i, dist, row_ids, k,
                               select_min), None
@@ -546,12 +546,14 @@ def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
         return knn_merge_parts(all_d, all_i, select_min)
 
     qspec = P() if query_axis is None else P(query_axis, None)
+    bspec = P(axis, None, None) if per_cluster else P(None, None, None)
     out_d, out_i = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None, None), P(axis, None), qspec),
+        in_specs=(P(axis, None), bspec, P(axis, None, None), P(axis, None),
+                  qspec),
         out_specs=(qspec, qspec),
         check_vma=False,
-    )(centers, codes, indices, queries)
+    )(centers, codebooks, codes, indices, queries)
 
     if metric == DistanceType.L2SqrtExpanded:
         out_d = jnp.where(jnp.isfinite(out_d),
@@ -594,4 +596,5 @@ def search_pq(
             index.centers, index.rotation, index.codebooks, index.codes,
             index.indices, queries, comms.axis, comms.mesh, n_probes, k,
             index.metric, probe_mode, query_axis,
+            index.codebook_kind, params.score_mode, params.lut_dtype,
         )

@@ -110,19 +110,20 @@ def _knn_scan(queries, dataset, k: int, metric: DistanceType, metric_arg: float,
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
 
-    pad = (-n) % tile
-    dsp = jnp.pad(dataset, ((0, pad), (0, 0)))
-    tiles = dsp.reshape(-1, tile, d)
-    n_tiles = tiles.shape[0]
+    tile = min(tile, n)
+    n_tiles = -(-n // tile)
 
-    def step(carry, inp):
+    def step(carry, t_idx):
         best_d, best_i = carry
-        t_idx, yt = inp
+        # slice the dataset in place — no padded copy of the whole
+        # dataset per call; the ragged tail clamps to (n - tile, n) and
+        # the rows already seen by the previous tile are masked out
+        start = jnp.minimum(t_idx * tile, n - tile)
+        yt = jax.lax.dynamic_slice_in_dim(dataset, start, tile)
         dist = _pairwise_distance_impl(queries, yt, metric, metric_arg,
                                        precision)
-        # mask out padding rows of the final tile
-        col_ids = t_idx * tile + jnp.arange(tile)
-        dist = jnp.where((col_ids < n)[None, :], dist, pad_val)
+        col_ids = start + jnp.arange(tile)
+        dist = jnp.where((col_ids >= t_idx * tile)[None, :], dist, pad_val)
         kk = min(k, tile)
         if approx:
             sel = (jax.lax.approx_min_k if select_min
@@ -133,7 +134,7 @@ def _knn_scan(queries, dataset, k: int, metric: DistanceType, metric_arg: float,
             tile_d = -tile_d
         else:
             tile_d, tile_i = jax.lax.top_k(dist, kk)
-        tile_gi = t_idx * tile + tile_i
+        tile_gi = start + tile_i
         new_d, new_i = merge_topk(best_d, best_i, tile_d,
                                   tile_gi.astype(jnp.int32), k, select_min)
         return (new_d, new_i), None
@@ -142,7 +143,7 @@ def _knn_scan(queries, dataset, k: int, metric: DistanceType, metric_arg: float,
         jnp.full((q, k), pad_val, jnp.float32),
         jnp.full((q, k), -1, jnp.int32),
     )
-    (best_d, best_i), _ = jax.lax.scan(step, init, (jnp.arange(n_tiles), tiles))
+    (best_d, best_i), _ = jax.lax.scan(step, init, jnp.arange(n_tiles))
     return best_d, best_i
 
 
@@ -209,7 +210,7 @@ def search(
             from raft_tpu.ops.fused_topk import fused_knn
 
             return fused_knn(queries, index.dataset, k, index.metric,
-                             tile=8192)
+                             dataset_norms=index.norms, tile=8192)
         if q <= query_tile:
             return _knn_scan(queries, index.dataset, k, index.metric,
                              index.metric_arg, db_tile, precision, approx)

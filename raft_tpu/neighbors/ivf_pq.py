@@ -555,6 +555,42 @@ def _score_onehot(lut, rows):
                       preferred_element_type=jnp.float32)
 
 
+def _probe_lut(qf, c, qsub_fixed, lut_fixed, rotation, codebooks, lists,
+               ip_metric: bool, per_cluster: bool):
+    """Per-probe LUT + base score — the LUT-build half of the reference's
+    fused similarity kernel (``detail/ivf_pq_compute_similarity-inl.cuh:
+    125-177``), shared by the single-chip and distributed search paths.
+
+    ``qsub_fixed``/``lut_fixed`` are the probe-invariant precomputations
+    (rotated query; and, for replicated-codebook IP, the full LUT).
+    Returns ``(lut (q, pq_dim, book), base (q,))`` with
+    ``score = sum_s lut[q, s, code] + base``.
+    """
+    q = qf.shape[0]
+    pq_len = codebooks.shape[2]
+    cb = jnp.take(codebooks, lists, axis=0) if per_cluster else codebooks
+    if ip_metric:
+        base = jnp.sum(qf * c, axis=1)
+        lut = (jnp.einsum("qsl,qjl->qsj", qsub_fixed, cb) if per_cluster
+               else lut_fixed)
+    else:
+        qsub = ((qf - c) @ rotation.T).reshape(q, -1, pq_len)
+        base = jnp.zeros((q,), jnp.float32)
+        if per_cluster:
+            lut = (
+                jnp.sum(jnp.square(qsub), -1)[:, :, None]
+                - 2.0 * jnp.einsum("qsl,qjl->qsj", qsub, cb)
+                + jnp.sum(jnp.square(cb), -1)[:, None, :]
+            )
+        else:
+            lut = (
+                jnp.sum(jnp.square(qsub), -1)[:, :, None]
+                - 2.0 * jnp.einsum("qsl,sjl->qsj", qsub, cb)
+                + jnp.sum(jnp.square(cb), -1)[None, :, :]
+            )
+    return lut, base
+
+
 @partial(jax.jit, static_argnames=("n_probes", "k", "metric", "codebook_kind",
                                    "lut_dtype", "score_mode", "packed"))
 def _search_impl(queries, centers, rotation, codebooks, codes, indices,
@@ -604,30 +640,9 @@ def _search_impl(queries, centers, rotation, codebooks, codes, indices,
         best_d, best_i = carry
         lists = probes[:, rank]                        # (q,)
         c = centers[lists]                             # (q, dim)
-        if ip_query:
-            base = jnp.sum(qf * c, axis=1)             # (q,)
-            if lut_fixed is not None:
-                lut = lut_fixed
-            else:
-                cb = codebooks[lists]                  # (q, J, L)
-                lut = jnp.einsum("qsl,qjl->qsj", qsub_fixed, cb)
-        else:
-            qsub = ((qf - c) @ rotation.T).reshape(q, pq_dim, pq_len)
-            base = jnp.zeros((q,), jnp.float32)
-            if codebook_kind == CodebookKind.PER_SUBSPACE:
-                cb = codebooks                         # (pq_dim, J, L)
-                lut = (
-                    jnp.sum(jnp.square(qsub), -1)[:, :, None]
-                    - 2.0 * jnp.einsum("qsl,sjl->qsj", qsub, cb)
-                    + jnp.sum(jnp.square(cb), -1)[None, :, :]
-                )
-            else:
-                cb = codebooks[lists]                  # (q, J, L)
-                lut = (
-                    jnp.sum(jnp.square(qsub), -1)[:, :, None]
-                    - 2.0 * jnp.einsum("qsl,qjl->qsj", qsub, cb)
-                    + jnp.sum(jnp.square(cb), -1)[:, None, :]
-                )
+        lut, base = _probe_lut(
+            qf, c, qsub_fixed, lut_fixed, rotation, codebooks, lists,
+            ip_query, codebook_kind == CodebookKind.PER_CLUSTER)
         lut = lut.astype(lut_dtype)                    # (q, pq_dim, J)
 
         rows = jnp.take(codes, lists, axis=0)          # (q, m, pq_dim) u8

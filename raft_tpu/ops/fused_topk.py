@@ -127,14 +127,21 @@ def fused_knn(
     k: int,
     metric: DistanceType = DistanceType.L2Expanded,
     *,
-    tile: int = 2048,
+    dataset_norms=None,
+    tile: int = 8192,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN in one streamed Pallas pass: (q, k) distances + indices.
 
     Queries must be modest (they stay VMEM-resident: q·d + q·tile floats);
-    the caller tiles large query sets. Any n; dataset is zero-padded to a
-    tile multiple (padding masked with +inf).
+    the caller tiles large query sets. Any n — the ragged tail rides a
+    partial final block, masked with +inf in the kernel.
+
+    ``dataset_norms`` (f32 ``(n,)`` cached ||y||² as built by the
+    brute-force index) skips the per-call norm pass; without it one extra
+    full read of the dataset happens per call. The dataset itself is
+    consumed in place when its dim is lane-aligned (d % 128 == 0) —
+    per-call HBM traffic is then exactly one dataset stream.
     """
     expect(metric in _SUPPORTED_METRICS,
            f"fused_knn: unsupported metric {metric}")
@@ -152,20 +159,22 @@ def fused_knn(
     q_pad = q + pad_q
     vmem_cap = max(512, (12_000_000 // (d_pad * 8 + q_pad * 8)) // 128 * 128)
     tile = min(tile, vmem_cap, max(128, ((n + 127) // 128) * 128))
-    pad_n = (-n) % tile
     # bf16 datasets stay bf16 through HBM (the point of half storage);
     # everything else runs f32
     if dataset.dtype == jnp.bfloat16:
         qs = jnp.pad(queries.astype(jnp.bfloat16), ((0, pad_q), (0, pad_d)))
-        xs = jnp.pad(dataset, ((0, pad_n), (0, pad_d)))
+        xs = jnp.pad(dataset, ((0, 0), (0, pad_d)))
     else:
         qs = jnp.pad(queries.astype(jnp.float32), ((0, pad_q), (0, pad_d)))
-        xs = jnp.pad(dataset.astype(jnp.float32), ((0, pad_n), (0, pad_d)))
+        xs = jnp.pad(dataset.astype(jnp.float32), ((0, 0), (0, pad_d)))
     qn = jnp.sum(jnp.square(qs.astype(jnp.float32)), axis=1,
                  keepdims=True)                                   # (Q, 1)
-    xn = jnp.sum(jnp.square(xs.astype(jnp.float32)), axis=1)[None, :]
-    qp, npad = qs.shape[0], xs.shape[0]
-    grid = npad // tile
+    if dataset_norms is None:
+        xn = jnp.sum(jnp.square(xs.astype(jnp.float32)), axis=1)[None, :]
+    else:
+        xn = jnp.asarray(dataset_norms, jnp.float32).reshape(1, n)
+    qp = qs.shape[0]
+    grid = -(-n // tile)
 
     kernel = functools.partial(_knn_kernel, k=k, n=n, tile=tile,
                                metric=metric)

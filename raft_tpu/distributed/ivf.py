@@ -481,8 +481,7 @@ def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
     pq_len = codebooks.shape[2]
     ip_metric = metric == DistanceType.InnerProduct
     per_cluster = codebook_kind == CodebookKind.PER_CLUSTER
-    score = (ivf_pq_mod._score_onehot if score_mode == "onehot"
-             else ivf_pq_mod._score_gather)
+    score = ivf_pq_mod.score_fn(score_mode)
 
     def body(centers_l, books_l, codes_l, ids_l, qs):
         q = qs.shape[0]

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""ANN A/B profiling on the real TPU — ivf_flat / ivf_pq / cagra QPS+recall.
+
+Timing is pipelined (dispatch ITERS, fetch once at the end):
+``block_until_ready`` does not block on relayed backends, so wall-clock
+is anchored on a host fetch of the last result. Run serially — never
+two TPU processes at once. One JSON line per config on stdout.
+
+Env: PROF_N (200000), PROF_DIM (128), PROF_Q (100), PROF_K (10),
+PROF_ITERS (20).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+
+N = int(os.environ.get("PROF_N", 200_000))
+D = int(os.environ.get("PROF_DIM", 128))
+Q = int(os.environ.get("PROF_Q", 100))
+K = int(os.environ.get("PROF_K", 10))
+ITERS = int(os.environ.get("PROF_ITERS", 20))
+
+
+def main():
+    from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
+    from raft_tpu.utils import eval_recall
+
+    print(json.dumps({"prof": "config", "backend": jax.default_backend(),
+                      "n": N, "dim": D, "q": Q, "k": K}), flush=True)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((N, D)).astype(np.float32)
+    q = rng.standard_normal((Q, D)).astype(np.float32)
+    gt_d, gt_i = brute_force.knn(None, x, q, K)
+    gt = np.asarray(gt_i)
+
+    def bench(name, fn):
+        out = fn()
+        np.asarray(out[0][0, :1])              # compile + warm + drain
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            out = fn()
+        np.asarray(out[0][0, :1])              # one fetch drains the queue
+        dt = (time.perf_counter() - t0) / ITERS
+        r, _, _ = eval_recall(gt, np.asarray(out[1]))
+        print(json.dumps({"bench": name, "ms": round(dt * 1e3, 2),
+                          "qps": round(Q / dt, 1),
+                          "recall": round(float(r), 4)}), flush=True)
+
+    fi = ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(n_lists=1024), x)
+    for p in (32, 64, 128):
+        sp = ivf_flat.IvfFlatSearchParams(n_probes=p)
+        bench(f"ivf_flat_p{p}",
+              lambda sp=sp: ivf_flat.search(None, sp, fi, q, K))
+
+    pi = ivf_pq.build(None, ivf_pq.IvfPqIndexParams(n_lists=1024, pq_dim=64),
+                      x)
+    for mode in ("gather", "onehot"):
+        for p in (32, 64):
+            sp = ivf_pq.IvfPqSearchParams(n_probes=p, score_mode=mode)
+            bench(f"ivf_pq_{mode}_p{p}",
+                  lambda sp=sp: ivf_pq.search(None, sp, pi, q, K))
+
+    ci = cagra.build(None, cagra.CagraIndexParams(
+        graph_degree=32, intermediate_graph_degree=64,
+        build_algo=cagra.BuildAlgo.NN_DESCENT), x)
+    for it in (64, 128):
+        sp = cagra.CagraSearchParams(itopk_size=it, search_width=4)
+        bench(f"cagra_itopk{it}",
+              lambda sp=sp: cagra.search(None, sp, ci, q, K))
+
+
+if __name__ == "__main__":
+    main()

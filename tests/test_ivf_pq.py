@@ -193,6 +193,19 @@ class TestScoreModes:
         r, _, _ = eval_recall(np.asarray(i1), np.asarray(i2))
         assert r >= 0.95, r
 
+    def test_auto_resolution(self):
+        import jax
+
+        from raft_tpu.core.validation import RaftError
+        from raft_tpu.neighbors.ivf_pq import resolve_score_mode
+
+        expected = "onehot" if jax.default_backend() == "tpu" else "gather"
+        assert resolve_score_mode("auto") == expected
+        assert resolve_score_mode("gather") == "gather"
+        assert resolve_score_mode("onehot") == "onehot"
+        with pytest.raises(RaftError):
+            resolve_score_mode("bogus")
+
 
 class TestIntDatasets:
     """Reference supports float/int8/uint8 datasets (ivf_pq_types.hpp);

@@ -53,6 +53,10 @@ class NNDescentParams:
     termination_threshold: float = 0.0001
     metric: DistanceType = DistanceType.L2Expanded
     sample_size: int = 16         # neighbors-of-neighbors fan-out per node
+    # 2-hop pairs kept per node per round; 0 = all sample_size². Measured:
+    # subsampling trades quality-per-round for round speed at a net loss
+    # on random data — keep full unless rounds are latency-bound.
+    hop2_sample: int = 0
     seed: int = 0
 
 
@@ -87,7 +91,10 @@ def _distances_to(dataset, node_ids, cand_ids, metric: DistanceType):
 
 def _reverse_sample(graph, n: int, r: int):
     """Sampled reverse graph: rev[j] = up to r nodes i with j ∈ graph[i]
-    (sort-and-rank packing, no atomics)."""
+    (sort-and-rank packing, no atomics). Deterministic first-r-by-source
+    order — used by the one-shot CAGRA optimize, where the n·deg sort is
+    amortized. The per-round NN-descent loop uses the cheaper
+    :func:`_reverse_sample_random`."""
     deg = graph.shape[1]
     src = jnp.repeat(jnp.arange(n, dtype=jnp.int32), deg)
     dst = graph.reshape(-1)
@@ -104,17 +111,39 @@ def _reverse_sample(graph, n: int, r: int):
     return flat[: n * r].reshape(n, r)
 
 
-@partial(jax.jit, static_argnames=("k", "s", "metric", "tile"))
+@partial(jax.jit, static_argnames=("n", "r"))
+def _reverse_sample_random(graph, n: int, r: int, key):
+    """Sampled reverse graph without the n·deg sort: each edge scatters
+    its source into a RANDOM slot of the destination's r-wide row;
+    collisions drop edges — which is exactly the sampling this function
+    exists to do (the sort dominated per-round build cost)."""
+    src = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32)[:, None], graph.shape).reshape(-1)
+    dst = graph.reshape(-1)
+    slot_r = jax.random.randint(key, dst.shape, 0, r)
+    slot = jnp.where(dst >= 0, dst * r + slot_r, n * r)
+    flat = jnp.full((n * r + 1,), -1, jnp.int32)
+    flat = flat.at[slot].set(src, mode="drop")
+    return flat[: n * r].reshape(n, r)
+
+
+@partial(jax.jit, static_argnames=("k", "s", "s2", "metric", "tile"))
 def _nn_descent_round(dataset, graph, dists, rev, key, k: int, s: int,
-                      metric: DistanceType, tile: int):
+                      s2: int, metric: DistanceType, tile: int):
     """One expansion round over all nodes, tiled to bound the gather
     buffer (role of one GNND iteration, ``nn_descent.cuh:1369``)."""
     n = dataset.shape[0]
 
     # sample s of the current neighbors per node (random rank subset so
     # old/new mix over rounds, like the reference's new/old lists)
-    ranks = jax.random.randint(key, (n, s), 0, graph.shape[1])
+    k_rank, k_cols = jax.random.split(key)
+    ranks = jax.random.randint(k_rank, (n, s), 0, graph.shape[1])
     sampled = jnp.take_along_axis(graph, ranks, axis=1)      # (n, s)
+    # the s² 2-hop pairs may be subsampled to s2 columns per round (the
+    # reference's local join also meets only a sampled pair subset);
+    # candidate width — hence gather + dedup-sort cost — drops s²/s2-fold
+    cols = (None if s2 >= s * s
+            else jax.random.permutation(k_cols, s * s)[:s2])
 
     pad = (-n) % tile
     node_ids = jnp.arange(n + pad, dtype=jnp.int32) % n
@@ -124,10 +153,19 @@ def _nn_descent_round(dataset, graph, dists, rev, key, k: int, s: int,
         nid = jax.lax.dynamic_slice_in_dim(node_ids, t * tile, tile)
         cur_ids = jnp.take(g, nid, axis=0)                   # (t, k)
         cur_d = jnp.take(d, nid, axis=0)
-        # neighbors-of-(sampled)-neighbors: (t, s, s) → (t, s*s)
+        # neighbors-of-(sampled)-neighbors
         hop1 = jnp.take(sampled, nid, axis=0)                # (t, s)
-        hop2 = jnp.take(sampled, jnp.clip(hop1, 0), axis=0)  # (t, s, s)
-        hop2 = jnp.where((hop1 >= 0)[:, :, None], hop2, -1).reshape(tile, -1)
+        if cols is None:
+            hop2 = jnp.take(sampled, jnp.clip(hop1, 0), axis=0)  # (t, s, s)
+            hop2 = jnp.where((hop1 >= 0)[:, :, None], hop2,
+                             -1).reshape(tile, -1)
+        else:
+            # gather only the kept (i, j) pairs: hop2[t, m] =
+            # sampled[hop1[t, cols[m] // s], cols[m] % s]
+            h1c = jnp.take(hop1, cols // s, axis=1)          # (t, s2)
+            flat = jnp.clip(h1c, 0) * s + (cols % s)[None, :]
+            hop2 = jnp.take(sampled.reshape(-1), flat)       # (t, s2)
+            hop2 = jnp.where(h1c >= 0, hop2, -1)
         rcand = jnp.take(rev, nid, axis=0)                   # (t, r)
         cand = jnp.concatenate([hop1, hop2, rcand], axis=1)
         cand = jnp.where(cand == nid[:, None], -1, cand)     # no self loops
@@ -199,12 +237,15 @@ def build(
         graph, dists = _merge_dedup(init, jnp.concatenate(d0_parts), k)
 
         s = min(params.sample_size, k)
+        s2 = s * s if params.hop2_sample <= 0 else min(params.hop2_sample,
+                                                       s * s)
         total = n * k
         for it in range(params.max_iterations):
             k_it = jax.random.fold_in(key, it)
-            rev = _reverse_sample(graph, n, s)
+            k_rev, k_round = jax.random.split(k_it)
+            rev = _reverse_sample_random(graph, n, s, k_rev)
             graph, dists, changed = _nn_descent_round(
-                ds32, graph, dists, rev, k_it, k, s, metric, tile
+                ds32, graph, dists, rev, k_round, k, s, s2, metric, tile
             )
             if float(changed) / total < params.termination_threshold:
                 break

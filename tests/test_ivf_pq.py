@@ -193,18 +193,18 @@ class TestScoreModes:
         r, _, _ = eval_recall(np.asarray(i1), np.asarray(i2))
         assert r >= 0.95, r
 
-    def test_auto_resolution(self):
-        import jax
-
+    def test_auto_resolution(self, monkeypatch):
         from raft_tpu.core.validation import RaftError
-        from raft_tpu.neighbors.ivf_pq import resolve_score_mode
+        from raft_tpu.neighbors import ivf_pq as mod
 
-        expected = "onehot" if jax.default_backend() == "tpu" else "gather"
-        assert resolve_score_mode("auto") == expected
-        assert resolve_score_mode("gather") == "gather"
-        assert resolve_score_mode("onehot") == "onehot"
+        monkeypatch.setattr(mod.jax, "default_backend", lambda: "tpu")
+        assert mod.resolve_score_mode("auto") == "onehot"
+        monkeypatch.setattr(mod.jax, "default_backend", lambda: "cpu")
+        assert mod.resolve_score_mode("auto") == "gather"
+        assert mod.resolve_score_mode("gather") == "gather"
+        assert mod.resolve_score_mode("onehot") == "onehot"
         with pytest.raises(RaftError):
-            resolve_score_mode("bogus")
+            mod.resolve_score_mode("bogus")
 
 
 class TestIntDatasets:

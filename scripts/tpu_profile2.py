@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Second-round TPU profiling: stream-BW ceiling, bf16 fused anomaly,
+4-bit vs 8-bit PQ one-hot scoring, CAGRA search. Pipelined timing
+(fetch-anchored). Run serially on a healthy relay.
+"""
+
+import functools
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def timed(tag, fn, iters=20, payload=None):
+    out = fn()
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    dt = (time.perf_counter() - t0) / iters
+    rec = {"piece": tag, "ms": round(dt * 1e3, 3)}
+    if payload:
+        rec["gbps"] = round(payload / dt / 1e9, 1)
+    print(json.dumps(rec), flush=True)
+    return dt
+
+
+def _read_kernel(x_ref, o_ref, acc):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+
+    acc[:] += jnp.sum(x_ref[:], axis=0, keepdims=True)
+
+    @pl.when(step == pl.num_programs(0) - 1)
+    def _():
+        o_ref[:] = acc[:]
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def pallas_read(x, tile: int = 16384, interpret: bool = False):
+    n, d = x.shape
+    grid = n // tile
+    return pl.pallas_call(
+        _read_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((tile, d), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, d), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
+        interpret=interpret,
+    )(x)
+
+
+def main():
+    print(json.dumps({"prof": "round2", "backend": jax.default_backend()}),
+          flush=True)
+
+    # ---- 1. pure-read stream BW ceiling (Pallas reduce over 512 MB)
+    big = jax.random.normal(jax.random.key(0), (1 << 20, 128), jnp.float32)
+    timed("pallas_read_512MB_f32", lambda: pallas_read(big), payload=512e6)
+    bigb = big.astype(jnp.bfloat16)
+    timed("pallas_read_256MB_bf16", lambda: pallas_read(bigb), payload=256e6)
+
+    # ---- 2. fused kNN f32 vs bf16, and VPU-merge sensitivity via k
+    from raft_tpu.ops.fused_topk import fused_knn
+    from raft_tpu.distance.types import DistanceType
+
+    qs = jax.random.normal(jax.random.key(2), (10, 128), jnp.float32)
+    norms = jnp.sum(jnp.square(big), axis=1)
+    for tag, ds in (("f32", big), ("bf16", bigb)):
+        for k in (10, 64):
+            timed(f"fused_knn_{tag}_k{k}",
+                  lambda ds=ds, k=k: fused_knn(
+                      qs, ds, k, DistanceType.L2Expanded,
+                      dataset_norms=norms, tile=8192),
+                  payload=(512e6 if tag == "f32" else 256e6))
+
+    # ---- 3. PQ bits: 8-bit/pq64 vs 4-bit/pq128 (same bytes/row)
+    from raft_tpu.neighbors import brute_force, ivf_pq
+    from raft_tpu.utils import eval_recall
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((200_000, 128)).astype(np.float32)
+    q = rng.standard_normal((100, 128)).astype(np.float32)
+    _, gt_i = brute_force.knn(None, x, q, 10)
+    gt = np.asarray(gt_i)
+    for bits, pqd in ((8, 64), (4, 128), (4, 64)):
+        pi = ivf_pq.build(None, ivf_pq.IvfPqIndexParams(
+            n_lists=1024, pq_dim=pqd, pq_bits=bits), x)
+        sp = ivf_pq.IvfPqSearchParams(n_probes=32)
+        dt = timed(f"ivf_pq_b{bits}_d{pqd}_p32",
+                   lambda: ivf_pq.search(None, sp, pi, q, 10), iters=10)
+        _, i = ivf_pq.search(None, sp, pi, q, 10)
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        print(json.dumps({"piece": f"ivf_pq_b{bits}_d{pqd}_recall",
+                          "recall": round(float(r), 4),
+                          "qps": round(100 / dt, 1)}), flush=True)
+
+    # ---- 4. CAGRA: IVF-PQ-path build time + search QPS
+    from raft_tpu.neighbors import cagra
+
+    t0 = time.perf_counter()
+    ci = cagra.build(None, cagra.CagraIndexParams(
+        graph_degree=32, intermediate_graph_degree=64), x)
+    np.asarray(ci.graph[:1])
+    print(json.dumps({"piece": "cagra_build_ivfpq_200k",
+                      "s": round(time.perf_counter() - t0, 1)}), flush=True)
+    for it in (64, 128):
+        sp = cagra.CagraSearchParams(itopk_size=it, search_width=4)
+        dt = timed(f"cagra_search_itopk{it}",
+                   lambda sp=sp: cagra.search(None, sp, ci, q, 10), iters=10)
+        _, i = cagra.search(None, sp, ci, q, 10)
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        print(json.dumps({"piece": f"cagra_itopk{it}_recall",
+                          "recall": round(float(r), 4),
+                          "qps": round(100 / dt, 1)}), flush=True)
+
+    # ---- 5. NN-descent round cost after the scatter fix (50k)
+    from raft_tpu.neighbors import nn_descent as nnd
+
+    xs = jnp.asarray(x[:50_000])
+    t0 = time.perf_counter()
+    g = nnd.build(None, nnd.NNDescentParams(
+        graph_degree=64, intermediate_graph_degree=96, max_iterations=5), xs)
+    np.asarray(g[:1])
+    print(json.dumps({"piece": "nnd_build_5it_50k",
+                      "s": round(time.perf_counter() - t0, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

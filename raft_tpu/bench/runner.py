@@ -217,6 +217,8 @@ def run_benchmark(
                     "build": {"n_lists": 1024},
                     "search": [{"n_probes": 16}, {"n_probes": 64}]}]}
     """
+    if search_iters < 1:
+        raise ValueError(f"search_iters must be >= 1, got {search_iters}")
     config = normalize_config(config)
     dataset_dir = pathlib.Path(dataset_dir)
     out_dir = pathlib.Path(out_dir)
@@ -224,6 +226,8 @@ def run_benchmark(
 
     base = read_bin(dataset_dir / "base.fbin")
     queries = read_bin(dataset_dir / "query.fbin")
+    if queries.shape[0] == 0:
+        raise ValueError("query set is empty — qps would be undefined")
     gt = read_bin(dataset_dir / "groundtruth.neighbors.ibin")
     metric_name = (dataset_dir / "metric.txt").read_text().strip() \
         if (dataset_dir / "metric.txt").exists() else "euclidean"

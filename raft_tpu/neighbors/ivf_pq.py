@@ -576,12 +576,23 @@ def _score_onehot(lut, rows):
     J-fold FLOP inflation for gather-free systolic throughput — the
     profitable trade on TPU when q is small (the VPU executes XLA
     gathers element-at-a-time; the MXU does 256 MACs/cycle/lane).
-    dist[q, m] = Σ_{s,j} onehot(rows)[m? per q...]"""
+    dist[q, m] = Σ_{s} lut[q, s, rows[q, m, s]].
+
+    The LUT keeps its dtype (``lut_dtype``): bf16 LUTs get the native
+    one-pass MXU path; f32 LUTs stay f32, with internal matmul
+    precision governed by the platform default (wrap in
+    ``jax.default_matmul_precision('float32')`` for full-width f32 on
+    TPU). The one-hot operand is always bf16 — 0/1 are exact there, so
+    it carries no rounding and the dominant (q, m, s, J) intermediate
+    stays half-width; the only rounding is of the LUT entries
+    themselves, and accumulation is always f32 via
+    ``preferred_element_type``."""
     q, s, J = lut.shape
+    ctype = jnp.bfloat16 if lut.dtype == jnp.bfloat16 else jnp.float32
     oh = jax.nn.one_hot(rows.astype(jnp.int32), J,
                         dtype=jnp.bfloat16)            # (q, m, s, J)
     return jnp.einsum("qmsj,qsj->qm", oh,
-                      lut.astype(jnp.bfloat16),
+                      lut.astype(ctype),
                       preferred_element_type=jnp.float32)
 
 

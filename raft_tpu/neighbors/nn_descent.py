@@ -45,6 +45,13 @@ class NNDescentParams:
     ``graph_degree`` is the output k; ``intermediate_graph_degree`` the
     internal working degree; ``max_iterations``/``termination_threshold``
     bound the EM loop exactly like the reference.
+
+    Reproducibility note: the per-round reverse-edge sampling resolves
+    scatter collisions by XLA's (unspecified) duplicate ordering, so
+    builds are bit-reproducible only under the same compilation —
+    across jax/XLA versions or backends the sampled reverse lists (and
+    hence the exact round ``termination_threshold`` triggers on) may
+    differ. Graph quality is statistically unaffected.
     """
 
     graph_degree: int = 64
@@ -114,17 +121,28 @@ def _reverse_sample(graph, n: int, r: int):
 @partial(jax.jit, static_argnames=("n", "r"))
 def _reverse_sample_random(graph, n: int, r: int, key):
     """Sampled reverse graph without the n·deg sort: each edge scatters
-    its source into a RANDOM slot of the destination's r-wide row;
-    collisions drop edges — which is exactly the sampling this function
-    exists to do (the sort dominated per-round build cost)."""
+    its source into a RANDOM slot of the destination's row; collisions
+    drop edges — which is exactly the sampling this function exists to
+    do (the sort dominated per-round build cost).
+
+    To keep rows from running thin (with r slots and in-degree ~ r an
+    expected ~1/e of each row stays empty), edges scatter into 2·r slots
+    and the row is then compacted to its first r valid entries — a
+    per-row width-2r sort, still far cheaper than the global edge sort.
+    Which edge survives a colliding slot follows XLA's scatter duplicate
+    ordering, so sampled rows are reproducible only per compilation (see
+    the :class:`NNDescentParams` note)."""
+    r2 = 2 * r
     src = jnp.broadcast_to(
         jnp.arange(n, dtype=jnp.int32)[:, None], graph.shape).reshape(-1)
     dst = graph.reshape(-1)
-    slot_r = jax.random.randint(key, dst.shape, 0, r)
-    slot = jnp.where(dst >= 0, dst * r + slot_r, n * r)
-    flat = jnp.full((n * r + 1,), -1, jnp.int32)
+    slot_r = jax.random.randint(key, dst.shape, 0, r2)
+    slot = jnp.where(dst >= 0, dst * r2 + slot_r, n * r2)
+    flat = jnp.full((n * r2 + 1,), -1, jnp.int32)
     flat = flat.at[slot].set(src, mode="drop")
-    return flat[: n * r].reshape(n, r)
+    rows = flat[: n * r2].reshape(n, r2)
+    order = jnp.argsort(rows < 0, axis=1, stable=True)   # valid-first
+    return jnp.take_along_axis(rows, order[:, :r], axis=1)
 
 
 @partial(jax.jit, static_argnames=("k", "s", "s2", "metric", "tile"))

@@ -48,6 +48,10 @@ def _read_kernel(x_ref, o_ref, acc):
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
 def pallas_read(x, tile: int = 16384, interpret: bool = False):
     n, d = x.shape
+    # exact-tiling guard: a ragged tail would be silently dropped by
+    # grid = n // tile, overstating the streamed payload
+    if n % tile != 0:
+        raise ValueError(f"n={n} must be a multiple of tile={tile}")
     grid = n // tile
     return pl.pallas_call(
         _read_kernel,

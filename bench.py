@@ -131,7 +131,9 @@ def _spawn_child(cpu: bool):
 
 def _await_child(child, deadline_s: float):
     """Wait for the child's JSON line. On deadline: abandon (no kill —
-    an in-flight TPU process must never be killed, STATUS.md)."""
+    an in-flight TPU process must never be killed, STATUS.md). A child
+    that printed its result but wedged in runtime teardown still counts:
+    the captured lines are scanned either way."""
     import threading
 
     lines = []
@@ -143,10 +145,9 @@ def _await_child(child, deadline_s: float):
     t = threading.Thread(target=drain, daemon=True)
     t.start()
     t.join(deadline_s)
-    if t.is_alive():
-        return None
-    child.wait()
-    for line in reversed(lines):
+    if not t.is_alive():
+        child.wait()
+    for line in reversed(list(lines)):
         line = line.strip()
         if line.startswith("{"):
             try:
@@ -158,7 +159,10 @@ def _await_child(child, deadline_s: float):
 
 def parent_main():
     healthy = _backend_healthy()
-    deadline = float(os.environ.get("BENCH_CHILD_DEADLINE", 1200))
+    # default deadline scales with the measurement budget: data-gen +
+    # compile margin on top of the worst-case measurement loop
+    deadline = float(os.environ.get(
+        "BENCH_CHILD_DEADLINE", max(1200, 3 * BUDGET_S + 600)))
     if healthy:
         log("dispatching TPU measurement child")
         rec = _await_child(_spawn_child(cpu=False), deadline)
@@ -174,7 +178,10 @@ def parent_main():
     rec = _await_child(_spawn_child(cpu=True), deadline)
     if rec is None:
         log("CPU fallback child also failed — emitting error metric")
-        rec = {"metric": f"brute_force_knn_qps_b{BATCH}_k{K}_failed",
+        tag = os.environ.get("BENCH_TAG", "")
+        tag = f"_{tag}" if tag else ""
+        rec = {"metric": ("brute_force_knn_qps_sift1m_shape"
+                          f"_b{BATCH}_k{K}{tag}_failed"),
                "value": 0.0, "unit": "QPS", "vs_baseline": 0.0}
     print(json.dumps(rec))
 
@@ -204,43 +211,21 @@ def child_main():
     jax.block_until_ready(index.norms)
     log(f"index built (storage {index.dataset.dtype}, norms cached)")
 
-    import numpy as np
-
     def run():
         return brute_force.search(None, index, queries, K, db_tile=262144)
 
-    def sync(out):
-        # force completion by fetching a few result elements:
-        # block_until_ready does NOT block on relayed backends (axon),
-        # so wall-clock timing must be anchored on a host fetch
-        np.asarray(out[0][0, :1])
+    # shared pipelined fetch-anchored timing (raft_tpu.bench.prims is
+    # the single home of the methodology): dispatch a run of iterations
+    # and fetch once, so the per-call relay round-trip amortizes out
+    from raft_tpu.bench.prims import timeit_stats
 
-    sync(run())  # compile + warm
-    t1 = time.perf_counter()
-    sync(run())
-    est = time.perf_counter() - t1  # one synced iter (incl. relay RTT)
-    log(f"compiled + warmed; single-iter estimate {est * 1e3:.1f} ms")
-
-    # pipelined measurement: dispatch a batch of iterations and sync once
-    # at the end — executions run back-to-back on device, so the per-call
-    # host->device round-trip latency is amortized out and the figure is
-    # steady-state throughput. Batch length is sized so one batch fits in
-    # ~half the budget; repeat batches within the time budget.
-    PIPE = max(3, min(50, int(BUDGET_S / 2 / max(est, 1e-4))))
-    rates = []
-    t_meas = time.perf_counter()
-    while len(rates) < 6 and (
-        not rates or time.perf_counter() - t_meas < BUDGET_S
-    ):
-        t0 = time.perf_counter()
-        for _ in range(PIPE):
-            out = run()
-        sync(out)
-        rates.append((time.perf_counter() - t0) / PIPE)
-    dt = min(rates)  # best batch: steady-state throughput
+    stats = timeit_stats(run, BUDGET_S)
+    dt = stats["best_s"]
     qps = BATCH / dt
-    log(f"{len(rates)} batches of {PIPE}, best {dt * 1e3:.2f} ms/iter, "
-        f"median {sorted(rates)[len(rates) // 2] * 1e3:.2f} ms/iter")
+    log(f"single-iter estimate {stats['single_iter_est_s'] * 1e3:.1f} ms; "
+        f"{stats['batches']} batches of {stats['pipe']}, "
+        f"best {dt * 1e3:.2f} ms/iter, "
+        f"median {stats['median_s'] * 1e3:.2f} ms/iter")
 
     tag = os.environ.get("BENCH_TAG", "")
     tag = f"_{tag}" if tag else ""

@@ -290,3 +290,53 @@ def select_k_tiles(
         interpret=interpret,
     )(vs)
     return outd[:b], outi[:b]
+
+
+# ---------------------------------------------------------------------------
+# stream probe
+# ---------------------------------------------------------------------------
+
+
+def _stream_kernel(x_ref, o_ref, acc):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+
+    acc[:] += jnp.sum(x_ref[:].astype(jnp.float32), axis=0, keepdims=True)
+
+    @pl.when(step == pl.num_programs(0) - 1)
+    def _():
+        o_ref[:] = acc[:]
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def stream_read_sum(x, tile: int = 16384, interpret: bool = False):
+    """Column-sum of ``x`` as a pure streamed read — the HBM-bandwidth
+    ceiling probe every bandwidth-bound kernel is judged against (the
+    prims micro-bench and roofline claims in BASELINE.md use it).
+    Touches each element exactly once; compute is one VPU add per
+    element, far under the bandwidth bound. Ragged shapes are handled
+    by a zero-pad (padding adds 0 to the sum) — but the pad is a full
+    materialized copy INSIDE this jitted call, so for bandwidth
+    measurements use tile- and lane-aligned shapes (n % tile == 0,
+    d % 128 == 0), where the input streams in place."""
+    n, d = x.shape
+    tile = min(tile, max(8, ((n + 7) // 8) * 8))
+    pad_n = (-n) % tile
+    pad_d = (-d) % 128
+    if pad_n or pad_d:
+        x = jnp.pad(x, ((0, pad_n), (0, pad_d)))
+    npad, dpad = x.shape
+    return pl.pallas_call(
+        _stream_kernel,
+        grid=(npad // tile,),
+        in_specs=[pl.BlockSpec((tile, dpad), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, dpad), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, dpad), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, dpad), jnp.float32)],
+        interpret=interpret,
+    )(x)[:, :d]

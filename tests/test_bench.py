@@ -140,3 +140,28 @@ class TestReferenceConfigSchema:
                              search_iters=1)
         assert len(rows) == 2
         assert rows[1]["recall"] >= 0.99
+
+
+class TestPrims:
+    def test_suite_runs_and_reports(self):
+        from raft_tpu.bench.prims import run_prims
+
+        recs = run_prims(size="tiny", name_filter="pairwise", budget_s=0.5)
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["prim"] == "pairwise_l2"
+        for field in ("ms", "gbps", "bw_frac", "mfu", "shape", "backend"):
+            assert field in rec
+        assert rec["ms"] > 0 and rec["gbps"] > 0
+
+    def test_out_jsonl(self, tmp_path):
+        import json
+
+        from raft_tpu.bench.prims import run_prims
+
+        out = tmp_path / "prims.jsonl"
+        run_prims(size="tiny", name_filter="select_k_xla", budget_s=0.5,
+                  out_path=str(out))
+        lines = out.read_text().strip().splitlines()
+        assert len(lines) == 1
+        assert json.loads(lines[0])["prim"] == "select_k_xla"

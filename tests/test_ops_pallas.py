@@ -101,3 +101,27 @@ class TestBf16Kernel:
         gt_d, gt_i = _naive_knn(qb, xb, 9, DistanceType.L2Expanded)
         assert np.array_equal(np.asarray(i), gt_i)
         np.testing.assert_allclose(np.asarray(d), gt_d, rtol=1e-2, atol=1e-2)
+
+
+class TestStreamRead:
+    def test_matches_column_sum(self, rng_np):
+        import numpy as np
+
+        from raft_tpu.ops.fused_topk import stream_read_sum
+
+        x = rng_np.standard_normal((1000, 96)).astype(np.float32)
+        got = np.asarray(stream_read_sum(x, tile=256, interpret=True))
+        np.testing.assert_allclose(got[0], x.sum(axis=0), rtol=1e-4,
+                                   atol=1e-3)
+
+    def test_bf16_input(self, rng_np):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from raft_tpu.ops.fused_topk import stream_read_sum
+
+        x = rng_np.standard_normal((512, 128)).astype(np.float32)
+        got = np.asarray(stream_read_sum(jnp.asarray(x, jnp.bfloat16),
+                                         tile=128, interpret=True))
+        np.testing.assert_allclose(got[0], x.sum(axis=0), rtol=0.02,
+                                   atol=0.5)

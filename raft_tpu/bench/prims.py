@@ -227,22 +227,27 @@ def _pq_score_gather(size: str):
     return _pq_score_case(size, "gather")
 
 
-def _pq_score_case(size: str, mode: str):
-    from raft_tpu.neighbors.ivf_pq import _score_gather, _score_onehot
+@_register("pq_score_select4")
+def _pq_score_select4(size: str):
+    """The masked-sum path at its design point: 4-bit codes (J=16)."""
+    return _pq_score_case(size, "select", J=16)
 
-    q, m, s, J = _dims(size, (4, 1 << 10, 16, 256), (10, 1 << 15, 64, 256),
+
+def _pq_score_case(size: str, mode: str, J: int = 256):
+    from raft_tpu.neighbors.ivf_pq import score_fn
+
+    q, m, s, _ = _dims(size, (4, 1 << 10, 16, 256), (10, 1 << 15, 64, 256),
                        (10, 1 << 17, 64, 256))
     kl, kr = jax.random.split(jax.random.key(4))
     lut = jax.random.normal(kl, (q, s, J), jnp.float32)
     rows = jax.random.randint(kr, (q, m, s), 0, J, jnp.int32).astype(jnp.uint8)
     jax.block_until_ready((lut, rows))
-    score = _score_onehot if mode == "onehot" else _score_gather
-    jscore = jax.jit(score)
+    jscore = jax.jit(score_fn(mode, J))
     run = lambda: jscore(lut, rows)  # noqa: E731
-    # effective flops: the useful work is q·m·s adds; the one-hot path
-    # physically performs 2·q·m·s·J MACs — report the physical number so
-    # MFU reflects what the MXU executes
-    flops = 2 * q * m * s * J if mode == "onehot" else q * m * s
+    # effective flops: the useful work is q·m·s adds; the one-hot and
+    # select paths physically perform ~2·q·m·s·J ops — report the
+    # physical number so MFU reflects what the units execute
+    flops = 2 * q * m * s * J if mode in ("onehot", "select") else q * m * s
     nbytes = q * m * s + q * s * J * 4 + q * m * 4  # codes + LUT + out
     return (run, nbytes, flops, f"q={q} m={m} s={s} J={J}")
 

@@ -481,7 +481,7 @@ def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
     pq_len = codebooks.shape[2]
     ip_metric = metric == DistanceType.InnerProduct
     per_cluster = codebook_kind == CodebookKind.PER_CLUSTER
-    score = ivf_pq_mod.score_fn(score_mode)
+    score = ivf_pq_mod.score_fn(score_mode, codebooks.shape[1])
 
     def body(centers_l, books_l, codes_l, ids_l, qs):
         q = qs.shape[0]

@@ -210,7 +210,7 @@ def search(
             from raft_tpu.ops.fused_topk import fused_knn
 
             return fused_knn(queries, index.dataset, k, index.metric,
-                             dataset_norms=index.norms, tile=8192)
+                             dataset_norms=index.norms)
         if q <= query_tile:
             return _knn_scan(queries, index.dataset, k, index.metric,
                              index.metric_arg, db_tile, precision, approx)

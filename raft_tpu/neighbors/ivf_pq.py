@@ -542,22 +542,28 @@ def extend(
 # ---------------------------------------------------------------------------
 
 
-def resolve_score_mode(score_mode: str) -> str:
+def resolve_score_mode(score_mode: str, book_size: int = 256) -> str:
     """Resolve "auto" per backend: dynamic per-element gathers lower to
     the TPU scalar core (measured ~18x slower than the one-hot MXU
-    contraction on v5e), while on CPU/GPU the direct gather wins."""
-    expect(score_mode in ("auto", "gather", "onehot"),
-           f"score_mode must be auto|gather|onehot, got {score_mode!r}")
+    contraction on v5e), while on CPU/GPU the direct gather wins. For
+    small codebooks (pq_bits <= 5) the masked-sum "select" path beats
+    the one-hot contraction on TPU — J compare/select/add VPU ops per
+    element with no J-fold matmul inflation."""
+    expect(score_mode in ("auto", "gather", "onehot", "select"),
+           f"score_mode must be auto|gather|onehot|select, got {score_mode!r}")
     if score_mode == "auto":
-        return "onehot" if jax.default_backend() == "tpu" else "gather"
+        if jax.default_backend() == "tpu":
+            return "select" if book_size <= 32 else "onehot"
+        return "gather"
     return score_mode
 
 
-def score_fn(score_mode: str):
+def score_fn(score_mode: str, book_size: int = 256):
     """Resolve a score_mode string (incl. "auto") to its scoring
     function — the single place mapping modes to implementations."""
-    return (_score_onehot if resolve_score_mode(score_mode) == "onehot"
-            else _score_gather)
+    mode = resolve_score_mode(score_mode, book_size)
+    return {"onehot": _score_onehot, "gather": _score_gather,
+            "select": _score_select}[mode]
 
 
 def _score_gather(lut, rows):
@@ -594,6 +600,24 @@ def _score_onehot(lut, rows):
     return jnp.einsum("qmsj,qsj->qm", oh,
                       lut.astype(ctype),
                       preferred_element_type=jnp.float32)
+
+
+def _score_select(lut, rows):
+    """dist contributions via a masked sum over codewords:
+    ``acc[q, m, s] = Σ_j lut[q, s, j] · (rows[q, m, s] == j)`` — J
+    unrolled compare/select/add terms, entirely elementwise so XLA
+    fuses the whole chain (no per-element gathers, no one-hot
+    materialization, no J-fold MXU FLOP inflation). The profitable
+    TPU path for small codebooks (pq_bits <= 5)."""
+    q, s, J = lut.shape
+    expect(J <= 32, "score_mode='select' unrolls J terms — use "
+           f"onehot/gather for book_size {J} > 32")
+    lutf = lut.astype(jnp.float32)
+    acc = jnp.zeros(rows.shape, jnp.float32)           # (q, m, s)
+    for j in range(J):
+        plane = lutf[:, :, j][:, None, :]              # (q, 1, s)
+        acc = acc + jnp.where(rows == jnp.uint8(j), plane, 0.0)
+    return jnp.sum(acc, axis=2)
 
 
 def _probe_lut(qf, c, qsub_fixed, lut_fixed, rotation, codebooks, lists,
@@ -693,7 +717,7 @@ def _search_impl(queries, centers, rotation, codebooks, codes, indices,
             rows = _unpack_nibbles(rows)
         row_ids = jnp.take(indices, lists, axis=0)     # (q, m)
         # score codes: dist[q, m] = sum_s lut[q, s, rows[q, m, s]]
-        score = score_fn(score_mode)
+        score = score_fn(score_mode, book_size)
         dist = score(lut, rows) + base[:, None]
         dist = jnp.where(row_ids >= 0, dist, pad_val)
         if filter_words is not None:
@@ -738,7 +762,7 @@ def search(
     expect(index.max_list_size > 0, "index is empty — extend() it first")
     n_probes = min(params.n_probes, index.n_lists)
     filter_words = resolve_filter_words(sample_filter)
-    score_mode = resolve_score_mode(params.score_mode)
+    score_mode = resolve_score_mode(params.score_mode, index.pq_book_size)
     with tracing.range("raft_tpu.ivf_pq.search"):
         def run(qt, fw):
             return _search_impl(

@@ -119,8 +119,14 @@ def _knn_kernel(q_ref, qn_ref, x_ref, xn_ref, outd_ref, outi_ref,
         outi_ref[:] = besti[:]
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("k", "metric", "tile", "interpret"))
+def _default_vmem_mb() -> int:
+    """Per-kernel Mosaic VMEM budget (MB) — resolved OUTSIDE jit so the
+    env var is honored per call, not frozen into the first trace."""
+    import os
+
+    return int(os.environ.get("RAFT_TPU_VMEM_MB", "64"))
+
+
 def fused_knn(
     queries,
     dataset,
@@ -128,7 +134,8 @@ def fused_knn(
     metric: DistanceType = DistanceType.L2Expanded,
     *,
     dataset_norms=None,
-    tile: int = 8192,
+    tile: int = 0,
+    vmem_mb: int = 0,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN in one streamed Pallas pass: (q, k) distances + indices.
@@ -142,7 +149,33 @@ def fused_knn(
     full read of the dataset happens per call. The dataset itself is
     consumed in place when its dim is lane-aligned (d % 128 == 0) —
     per-call HBM traffic is then exactly one dataset stream.
-    """
+
+    ``tile=0`` auto-sizes database blocks to the VMEM budget
+    (``vmem_mb``, default from ``RAFT_TPU_VMEM_MB`` or 64). Measured on
+    v5e the stream is per-grid-step bound (~16 us/step) far below the
+    HBM roofline, so the right tile is the largest that fits — fewer,
+    bigger DMAs — not a fixed 8k."""
+    if vmem_mb <= 0:
+        vmem_mb = _default_vmem_mb()
+    return _fused_knn_impl(queries, dataset, k, metric,
+                           dataset_norms=dataset_norms, tile=tile,
+                           vmem_mb=vmem_mb, interpret=interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "metric", "tile", "vmem_mb",
+                                    "interpret"))
+def _fused_knn_impl(
+    queries,
+    dataset,
+    k: int,
+    metric: DistanceType,
+    *,
+    dataset_norms,
+    tile: int,
+    vmem_mb: int,
+    interpret: bool,
+) -> Tuple[jax.Array, jax.Array]:
     expect(metric in _SUPPORTED_METRICS,
            f"fused_knn: unsupported metric {metric}")
     q, d = queries.shape
@@ -153,12 +186,22 @@ def fused_knn(
     # sublane multiple: 8 for f32 blocks, 16 for bf16
     pad_q = (-q) % (16 if dataset.dtype == jnp.bfloat16 else 8)
     pad_d = (-d) % 128
-    # VMEM budget: double-buffered (tile, d) block + (q, tile) distance
-    # must fit in ~12 MB alongside scratch
     d_pad = d + pad_d
     q_pad = q + pad_q
-    vmem_cap = max(512, (12_000_000 // (d_pad * 8 + q_pad * 8)) // 128 * 128)
-    tile = min(tile, vmem_cap, max(128, ((n + 127) // 128) * 128))
+    # VMEM budget per database row: double-buffered (tile, d) dataset
+    # block + (1, tile) norms (f32, x2 buffers) + the kernel's live
+    # (q_pad, tile) intermediates — ip/dist f32, col iota i32, and the
+    # cat_d/cat_i concatenations in the merge — ~24 B per q_pad row.
+    # 2 MB flat margin covers queries, out/scratch (q_pad, k) pairs and
+    # compiler slack; cap at 65536 rows (past ~32 MB blocks the stream
+    # is byte-bound and bigger tiles stop paying).
+    itemsize = 2 if dataset.dtype == jnp.bfloat16 else 4
+    budget = vmem_mb * 1024 * 1024 - q_pad * d_pad * itemsize - (2 << 20)
+    per_row = 2 * (d_pad * itemsize + 4) + 24 * q_pad
+    vmem_cap = max(512, (budget // per_row) // 128 * 128)
+    if tile <= 0:
+        tile = vmem_cap
+    tile = min(tile, vmem_cap, 65536, max(128, ((n + 127) // 128) * 128))
     # bf16 datasets stay bf16 through HBM (the point of half storage);
     # everything else runs f32
     if dataset.dtype == jnp.bfloat16:
@@ -205,6 +248,8 @@ def fused_knn(
             pltpu.VMEM((qp, k), jnp.float32),
             pltpu.VMEM((qp, k), jnp.int32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_mb * 1024 * 1024),
         interpret=interpret,
     )(qs, qn, xs, xn)
     return outd[:q], outi[:q]
@@ -311,8 +356,8 @@ def _stream_kernel(x_ref, o_ref, acc):
         o_ref[:] = acc[:]
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
-def stream_read_sum(x, tile: int = 16384, interpret: bool = False):
+def stream_read_sum(x, tile: int = 0, vmem_mb: int = 0,
+                    interpret: bool = False):
     """Column-sum of ``x`` as a pure streamed read — the HBM-bandwidth
     ceiling probe every bandwidth-bound kernel is judged against (the
     prims micro-bench and roofline claims in BASELINE.md use it).
@@ -321,9 +366,35 @@ def stream_read_sum(x, tile: int = 16384, interpret: bool = False):
     by a zero-pad (padding adds 0 to the sum) — but the pad is a full
     materialized copy INSIDE this jitted call, so for bandwidth
     measurements use tile- and lane-aligned shapes (n % tile == 0,
-    d % 128 == 0), where the input streams in place."""
+    d % 128 == 0), where the input streams in place.
+
+    ``tile=0`` auto-sizes blocks to the VMEM budget (``vmem_mb``,
+    default ``RAFT_TPU_VMEM_MB`` or 64): the stream is per-grid-step
+    bound (~16 us/step on v5e) well below the HBM roofline, so the
+    probe uses the biggest block that fits — a small-block probe
+    measures step overhead, not bandwidth."""
+    if vmem_mb <= 0:
+        vmem_mb = _default_vmem_mb()
+    return _stream_read_impl(x, tile, vmem_mb, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "vmem_mb", "interpret"))
+def _stream_read_impl(x, tile: int, vmem_mb: int, interpret: bool):
     n, d = x.shape
-    tile = min(tile, max(8, ((n + 7) // 8) * 8))
+    dpad_cols = d + ((-d) % 128)
+    itemsize = x.dtype.itemsize
+    budget = vmem_mb * 1024 * 1024 - (1 << 20)
+    # per element: double-buffered input block + an f32-widened strip
+    # for the astype inside the kernel (sub-f32 inputs upcast to sum)
+    per_elem = 2 * itemsize + (4 if itemsize < 4 else 0)
+    cap = max(8, budget // (dpad_cols * per_elem))
+    # power-of-two tile: the probe shapes are powers of two, so the
+    # auto tile divides n exactly and the pad-copy path (which would
+    # corrupt the bandwidth measurement) never triggers
+    cap = 1 << (cap.bit_length() - 1)
+    if tile <= 0:
+        tile = cap
+    tile = min(tile, cap, max(8, ((n + 7) // 8) * 8))
     pad_n = (-n) % tile
     pad_d = (-d) % 128
     if pad_n or pad_d:
@@ -338,5 +409,7 @@ def stream_read_sum(x, tile: int = 16384, interpret: bool = False):
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((1, dpad), jnp.float32),
         scratch_shapes=[pltpu.VMEM((1, dpad), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_mb * 1024 * 1024),
         interpret=interpret,
     )(x)[:, :d]

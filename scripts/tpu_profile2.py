@@ -46,7 +46,7 @@ def _read_kernel(x_ref, o_ref, acc):
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
-def pallas_read(x, tile: int = 16384, interpret: bool = False):
+def pallas_read(x, tile: int = 4096, interpret: bool = False):
     n, d = x.shape
     # exact-tiling guard: a ragged tail would be silently dropped by
     # grid = n // tile, overstating the streamed payload

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Third-round TPU probes: grid-step overhead vs HBM bandwidth.
+
+Round-2 finding: pallas_read at tile=4096 (256 steps over 512 MB) and
+bf16 at the same step count take the SAME wall time (~4.2 ms) — the
+stream is per-step-overhead bound (~16 us/step), not byte bound. This
+probe sweeps block sizes (and the Mosaic vmem limit) to find the real
+bandwidth ceiling and the knee, for f32 and bf16, then re-checks
+fused_knn with the best tile. Also A/Bs PQ scoring modes standalone.
+
+Pipelined fetch-anchored timing; run serially on a healthy relay.
+"""
+
+import functools
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def timed(tag, fn, iters=20, payload=None, extra=None):
+    try:
+        out = fn()
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+        dt = (time.perf_counter() - t0) / iters
+    except Exception as e:  # noqa: BLE001 — probe must survive OOMs
+        print(json.dumps({"piece": tag, "error": str(e)[:200]}), flush=True)
+        return None
+    rec = {"piece": tag, "ms": round(dt * 1e3, 3)}
+    if payload:
+        rec["gbps"] = round(payload / dt / 1e9, 1)
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+    return dt
+
+
+def _read_kernel(x_ref, o_ref, acc):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+
+    acc[:] += jnp.sum(x_ref[:].astype(jnp.float32), axis=0, keepdims=True)
+
+    @pl.when(step == pl.num_programs(0) - 1)
+    def _():
+        o_ref[:] = acc[:]
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "vmem_mb"))
+def pallas_read(x, tile: int, vmem_mb: int = 0):
+    n, d = x.shape
+    assert n % tile == 0
+    params = {}
+    if vmem_mb:
+        params["compiler_params"] = pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_mb * 1024 * 1024)
+    return pl.pallas_call(
+        _read_kernel,
+        grid=(n // tile,),
+        in_specs=[pl.BlockSpec((tile, d), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, d), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
+        **params,
+    )(x)
+
+
+def main():
+    print(json.dumps({"prof": "round3", "backend": jax.default_backend()}),
+          flush=True)
+
+    big = jax.random.normal(jax.random.key(0), (1 << 20, 128), jnp.float32)
+    bigb = big.astype(jnp.bfloat16)
+
+    # ---- 1. tile sweep: is the stream step-bound or byte-bound?
+    for tile in (2048, 4096, 8192):
+        timed(f"read_f32_t{tile}", lambda t=tile: pallas_read(big, t),
+              payload=512e6, extra={"steps": (1 << 20) // tile})
+    for tile, mb in ((16384, 40), (32768, 72), (65536, 0)):
+        # 65536: 32 MB blocks — needs ~68 MB; v5e physical VMEM is 128 MB
+        timed(f"read_f32_t{tile}_v{mb or 128}",
+              lambda t=tile, m=mb or 128: pallas_read(big, t, m),
+              payload=512e6, extra={"steps": (1 << 20) // tile})
+    for tile, mb in ((8192, 0), (16384, 0), (32768, 40), (65536, 72)):
+        timed(f"read_bf16_t{tile}_v{mb or 16}",
+              lambda t=tile, m=mb: pallas_read(bigb, t, m),
+              payload=256e6, extra={"steps": (1 << 20) // tile})
+
+    # ---- 2. XLA-native streams for reference
+    js = jax.jit(lambda x: jnp.sum(x, axis=0))
+    timed("xla_colsum_f32", lambda: js(big), payload=512e6)
+    timed("xla_colsum_bf16", lambda: js(bigb), payload=256e6)
+
+    # ---- 3. fused_knn with bigger tiles (needs the code's tile param)
+    from raft_tpu.distance.types import DistanceType
+    from raft_tpu.ops.fused_topk import fused_knn
+
+    qs = jax.random.normal(jax.random.key(2), (10, 128), jnp.float32)
+    norms = jnp.sum(jnp.square(big), axis=1)
+    for tag, ds, tiles in (("f32", big, (8192, 16384, 32768)),
+                           ("bf16", bigb, (8192, 16384, 32768, 65536))):
+        for t in tiles:
+            timed(f"fused_knn_{tag}_t{t}",
+                  lambda ds=ds, t=t: fused_knn(
+                      qs, ds, 10, DistanceType.L2Expanded,
+                      dataset_norms=norms, tile=t),
+                  payload=(512e6 if tag == "f32" else 256e6))
+
+    # ---- 4. PQ scoring A/B standalone (q=100 m=256 s match profile cfg)
+    from raft_tpu.neighbors.ivf_pq import score_fn
+
+    kl, kr = jax.random.split(jax.random.key(4))
+    for J, s in ((256, 64), (16, 128)):
+        lut = jax.random.normal(kl, (100, s, J), jnp.float32)
+        rows = jax.random.randint(kr, (100, 256, s), 0, J,
+                                  jnp.int32).astype(jnp.uint8)
+        jax.block_until_ready((lut, rows))
+        modes = ("onehot", "select") if J <= 32 else ("onehot",)
+        for mode in modes:
+            f = jax.jit(score_fn(mode, J))
+            timed(f"pq_score_{mode}_J{J}_s{s}", lambda f=f: f(lut, rows))
+
+
+if __name__ == "__main__":
+    main()

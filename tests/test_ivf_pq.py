@@ -199,12 +199,57 @@ class TestScoreModes:
 
         monkeypatch.setattr(mod.jax, "default_backend", lambda: "tpu")
         assert mod.resolve_score_mode("auto") == "onehot"
+        # small codebooks route to the masked-sum select path on TPU
+        assert mod.resolve_score_mode("auto", 16) == "select"
+        assert mod.resolve_score_mode("auto", 32) == "select"
+        assert mod.resolve_score_mode("auto", 64) == "onehot"
         monkeypatch.setattr(mod.jax, "default_backend", lambda: "cpu")
         assert mod.resolve_score_mode("auto") == "gather"
+        assert mod.resolve_score_mode("auto", 16) == "gather"
         assert mod.resolve_score_mode("gather") == "gather"
         assert mod.resolve_score_mode("onehot") == "onehot"
+        assert mod.resolve_score_mode("select", 16) == "select"
         with pytest.raises(RaftError):
             mod.resolve_score_mode("bogus")
+
+    def test_select_matches_gather_exactly(self, rng_np):
+        """The masked-sum select path is pure f32 adds of the same LUT
+        entries the gather path reads — results must be bit-identical,
+        for every code value in the book."""
+        import jax
+        import jax.numpy as jnp
+        from raft_tpu.neighbors.ivf_pq import _score_gather, _score_select
+
+        for J, s, m in ((16, 8, 37), (32, 4, 21)):
+            kl, kr = jax.random.split(jax.random.key(J))
+            lut = jax.random.normal(kl, (5, s, J), jnp.float32)
+            rows = jax.random.randint(kr, (5, m, s), 0, J,
+                                      jnp.int32).astype(jnp.uint8)
+            # force coverage of every codeword incl. the J-1 edge
+            rows = rows.at[0, 0, :].set(J - 1).at[0, 1, :].set(0)
+            a = np.asarray(_score_gather(lut, rows))
+            b = np.asarray(_score_select(lut, rows))
+            np.testing.assert_array_equal(a, b)
+
+    def test_select_mode_end_to_end(self, rng_np):
+        """pq_bits=4 search via score_mode='select' returns the same
+        neighbors as the gather reference path."""
+        from raft_tpu.neighbors import ivf_pq
+
+        x = rng_np.standard_normal((3000, 32)).astype(np.float32)
+        q = rng_np.standard_normal((16, 32)).astype(np.float32)
+        index = ivf_pq.build(
+            None, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16,
+                                          pq_bits=4), x)
+        _, i1 = ivf_pq.search(
+            None, ivf_pq.IvfPqSearchParams(n_probes=16,
+                                           score_mode="gather"),
+            index, q, 10)
+        _, i2 = ivf_pq.search(
+            None, ivf_pq.IvfPqSearchParams(n_probes=16,
+                                           score_mode="select"),
+            index, q, 10)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
 
 class TestIntDatasets:

@@ -214,28 +214,72 @@ def child_main():
     def run():
         return brute_force.search(None, index, queries, K, db_tile=262144)
 
-    # shared pipelined fetch-anchored timing (raft_tpu.bench.prims is
-    # the single home of the methodology): dispatch a run of iterations
-    # and fetch once, so the per-call relay round-trip amortizes out
-    from raft_tpu.bench.prims import timeit_stats
-
-    stats = timeit_stats(run, BUDGET_S)
-    dt = stats["best_s"]
-    qps = BATCH / dt
-    log(f"single-iter estimate {stats['single_iter_est_s'] * 1e3:.1f} ms; "
-        f"{stats['batches']} batches of {stats['pipe']}, "
-        f"best {dt * 1e3:.2f} ms/iter, "
-        f"median {stats['median_s'] * 1e3:.2f} ms/iter")
+    # Two-stage measurement, robust to mid-measurement relay wedges
+    # (the parent keeps the LAST parseable JSON line captured, so a
+    # hang after the first print still yields a result):
+    #   1. pipelined dispatch timing — known-safe, printed immediately.
+    #      Its per-iteration number includes the relay's serialized
+    #      per-dispatch gap (~0.5-4 ms depending on session), so it
+    #      UNDERSTATES on-chip throughput.
+    #   2. slope timing — the fused kernel's `passes` mode repeats the
+    #      dataset stream M times inside ONE dispatch (grid wrap, same
+    #      compiled shape family as a normal call); per-pass time from
+    #      the slope between two pass counts cancels the overhead.
+    from raft_tpu.bench.prims import timeit_slope, timeit_stats
 
     tag = os.environ.get("BENCH_TAG", "")
     tag = f"_{tag}" if tag else ""
     suffix = os.environ.get("BENCH_SUFFIX", "")
-    print(json.dumps({
-        "metric": f"brute_force_knn_qps_sift1m_shape_b{BATCH}_k{K}{tag}{suffix}",
-        "value": round(qps, 2),
-        "unit": "QPS",
-        "vs_baseline": round(qps / ROOFLINE_QPS, 4),
-    }), flush=True)
+    metric = f"brute_force_knn_qps_sift1m_shape_b{BATCH}_k{K}{tag}{suffix}"
+
+    def emit(dt):
+        qps = BATCH / dt
+        print(json.dumps({
+            "metric": metric,
+            "value": round(qps, 2),
+            "unit": "QPS",
+            "vs_baseline": round(qps / ROOFLINE_QPS, 4),
+        }), flush=True)
+
+    stats = timeit_stats(run, BUDGET_S)
+    dt = stats["best_s"]
+    log(f"single-iter estimate {stats['single_iter_est_s'] * 1e3:.1f} ms; "
+        f"{stats['batches']} batches of {stats['pipe']}, "
+        f"best {dt * 1e3:.2f} ms/iter, "
+        f"median {stats['median_s'] * 1e3:.2f} ms/iter")
+    emit(dt)
+
+    from raft_tpu.neighbors.brute_force import _use_fused_kernel
+    from raft_tpu.ops.fused_topk import fused_knn
+
+    if not _use_fused_kernel(index.metric, K, BATCH):
+        log("fused kernel not in play for this config; keeping "
+            "pipelined result")
+        return
+
+    def make_passes(m):
+        return lambda: fused_knn(queries, index.dataset, K, index.metric,
+                                 dataset_norms=index.norms, passes=m)
+
+    try:
+        sl = timeit_slope(make_passes, 2, 8)
+        log(f"slope timing: T({sl['m1']})={sl['t1_s'] * 1e3:.1f} ms, "
+            f"T({sl['m2']})={sl['t2_s'] * 1e3:.1f} ms -> "
+            f"{sl['slope_s'] * 1e3:.2f} ms/iter")
+        # sanity gates: no slower than the dispatch-bound number it
+        # refines, and no faster than the HBM roofline allows (with
+        # slack for measured-above-nominal streams) — a noise-dominated
+        # slope must not overwrite the honest pipelined result
+        itemsize = 2 if os.environ.get("BENCH_DTYPE") == "bfloat16" else 4
+        floor_s = (N * D * itemsize) / 1.2e12
+        if floor_s <= sl["slope_s"] <= dt * 1.2:
+            emit(min(sl["slope_s"], dt))
+        else:
+            log(f"slope {sl['slope_s'] * 1e3:.3f} ms outside "
+                f"[{floor_s * 1e3:.3f}, {dt * 1.2 * 1e3:.3f}] ms; "
+                "keeping pipelined result")
+    except Exception as e:  # noqa: BLE001 — keep the pipelined result
+        log(f"slope timing failed ({e}); keeping pipelined result")
 
 
 if __name__ == "__main__":

@@ -86,6 +86,56 @@ def timeit(fn: Callable[[], object], budget_s: float = 10.0) -> float:
     return timeit_stats(fn, budget_s)["best_s"]
 
 
+def loop_queries(fn: Callable, queries, m: int) -> Callable[[], object]:
+    """Wrap a ``(d, i) = fn(q)`` search in an m-iteration in-program
+    loop whose carried query tile gets a data-dependent perturbation
+    each step — XLA can neither hoist nor CSE the body, so one dispatch
+    executes m real searches back-to-back."""
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(q0):
+        def body(_, carry):
+            acc, q = carry
+            d, _ = fn(q)
+            pert = jnp.tanh(jnp.nanmin(d)).astype(jnp.float32) * 1e-6
+            return (acc + pert, (q0 + pert).astype(q0.dtype))
+
+        acc, _ = jax.lax.fori_loop(0, m, body, (jnp.float32(0.0), q0))
+        return acc
+
+    return lambda: run(queries)
+
+
+def timeit_slope(make_fn: Callable[[int], Callable[[], object]],
+                 m1: int, m2: int, reps: int = 4) -> Dict:
+    """Per-iteration seconds from the slope between an m1- and an
+    m2-iteration in-program loop: slope = (T(m2) - T(m1)) / (m2 - m1).
+    Cancels per-dispatch overhead entirely — required on relayed
+    backends, where a ~4 ms serialized dispatch gap (measured round 2)
+    floors every single-dispatch number regardless of kernel cost.
+    Uses best-of-``reps`` walls for each loop length."""
+    f1, f2 = make_fn(m1), make_fn(m2)
+
+    def best_wall(f):
+        _fetch(f())  # compile + warm
+        walls = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            _fetch(f())
+            walls.append(time.perf_counter() - t0)
+        return min(walls)
+
+    t1, t2 = best_wall(f1), best_wall(f2)
+    return {
+        "slope_s": (t2 - t1) / (m2 - m1),
+        "t1_s": t1,
+        "t2_s": t2,
+        "m1": m1,
+        "m2": m2,
+    }
+
+
 @dataclasses.dataclass
 class Prim:
     """One registered micro-bench: ``make(size)`` returns

@@ -4,6 +4,7 @@
 from raft_tpu.neighbors import ball_cover
 from raft_tpu.neighbors import brute_force
 from raft_tpu.neighbors import cagra
+from raft_tpu.neighbors import cluster_join
 from raft_tpu.neighbors import epsilon_neighborhood
 from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors import ivf_pq
@@ -19,6 +20,7 @@ __all__ = [
     "ball_cover",
     "brute_force",
     "cagra",
+    "cluster_join",
     "epsilon_neighborhood",
     "eps_neighbors",
     "ivf_flat",

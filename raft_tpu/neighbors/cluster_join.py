@@ -1,0 +1,210 @@
+"""Cluster-join k-NN-graph construction — a TPU-first graph builder
+(no reference analog; role of ``nn_descent``/IVF-PQ batches as the
+CAGRA intermediate-graph source, ``detail/cagra/cagra_build.cuh:44``).
+
+Motivation: the reference's two graph-build paths are gather-heavy —
+NN-descent joins sampled neighbor lists (``detail/nn_descent.cuh:341``)
+and the IVF-PQ path streams per-query probed lists. On TPU, row gathers
+lower to the scalar core and dominate the build (measured: ~18 s per
+descent round at n=50k). This builder restates graph construction as
+dense MXU work:
+
+1. Partition the points with balanced k-means (cluster size ~
+   ``target_cluster_size``), pack each cluster's rows into a padded
+   (C, m, d) tensor — the IVF-Flat list layout.
+2. Within each cluster, run exact brute-force kNN: one (m, d) x (d, m)
+   MXU GEMM + top-k per cluster, batched over clusters in a scan.
+   No per-row gathers anywhere in the hot loop.
+3. Repeat for ``passes`` independent clusterings (different k-means
+   seeds) and merge per-node candidates — a true neighbor is recovered
+   unless every pass separates the pair.
+4. Optionally polish with a couple of standard NN-descent rounds seeded
+   from the merged graph (``nn_descent.build(init_graph=...)``), which
+   recovers the remaining cross-cluster-boundary edges at a fraction of
+   a from-scratch descent.
+
+FLOPs: passes · n · m · d MACs — e.g. n=1M, m=4k, d=128, 3 passes ≈
+3.2 TFLOP ≈ tens of milliseconds of MXU time; the build becomes
+k-means-bound instead of gather-bound.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors.nn_descent import NNDescentParams, _merge_dedup
+from raft_tpu.neighbors import nn_descent as nn_descent_mod
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterJoinParams:
+    """Knobs for the cluster-join graph builder."""
+
+    graph_degree: int = 64
+    passes: int = 3
+    target_cluster_size: int = 2048
+    kmeans_n_iters: int = 8
+    kmeans_trainset_fraction: float = 0.25
+    polish_rounds: int = 1
+    metric: DistanceType = DistanceType.L2Expanded
+    seed: int = 0
+
+
+def _pack_cluster_indices(labels, n_clusters: int, max_size: int):
+    """(C, m) int32 member ids per cluster, -1 padded (the IVF
+    sort-and-rank packing, minus the data scatter)."""
+    n = labels.shape[0]
+    labels = labels.astype(jnp.int32)
+    order = jnp.argsort(labels, stable=True)
+    sorted_labels = labels[order]
+    first_pos = jnp.searchsorted(sorted_labels, jnp.arange(n_clusters),
+                                 side="left")
+    rank = jnp.arange(n) - first_pos[sorted_labels]
+    slot = sorted_labels * max_size + rank
+    flat = jnp.full((n_clusters * max_size,), -1, jnp.int32)
+    flat = flat.at[slot].set(order.astype(jnp.int32))
+    return flat.reshape(n_clusters, max_size)
+
+
+@partial(jax.jit, static_argnames=("k", "metric"))
+def _one_pass(dataset, idx, k: int, metric: DistanceType):
+    """Within-cluster exact kNN for every cluster.
+
+    dataset (n, d) f32; idx (C, m) member ids (-1 pad).
+    Returns (n, k) global neighbor ids + distances (min-close form).
+    """
+    n, d = dataset.shape
+    C, m = idx.shape
+    ip_metric = metric == DistanceType.InnerProduct
+
+    out_ids = jnp.full((n + 1, k), -1, jnp.int32)
+    out_d = jnp.full((n + 1, k), jnp.inf, jnp.float32)
+
+    def step(carry, c):
+        o_ids, o_d = carry
+        members = idx[c]                                   # (m,)
+        rows = jnp.take(dataset, jnp.clip(members, 0), axis=0)  # (m, d)
+        valid = members >= 0
+        ip = jax.lax.dot_general(
+            rows, rows, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )                                                  # (m, m)
+        if ip_metric:
+            dist = -ip
+        else:
+            nr = jnp.sum(jnp.square(rows), axis=1)
+            dist = jnp.maximum(nr[:, None] + nr[None, :] - 2.0 * ip, 0.0)
+        eye = jnp.eye(m, dtype=bool)
+        dist = jnp.where(eye | ~valid[None, :], jnp.inf, dist)
+        kk = min(k, m)
+        neg, pos = jax.lax.top_k(-dist, kk)                # (m, kk)
+        nbr_ids = jnp.take(members, pos)                   # (m, kk) global
+        nbr_d = -neg
+        nbr_ids = jnp.where(jnp.isfinite(nbr_d), nbr_ids, -1)
+        if kk < k:
+            nbr_ids = jnp.pad(nbr_ids, ((0, 0), (0, k - kk)),
+                              constant_values=-1)
+            nbr_d = jnp.pad(nbr_d, ((0, 0), (0, k - kk)),
+                            constant_values=jnp.inf)
+        # scatter to the member rows; padded slots dump into row n
+        dest = jnp.where(valid, members, n)
+        return (o_ids.at[dest].set(nbr_ids), o_d.at[dest].set(nbr_d)), None
+
+    (out_ids, out_d), _ = jax.lax.scan(step, (out_ids, out_d),
+                                       jnp.arange(C))
+    return out_ids[:n], out_d[:n]
+
+
+def build(
+    res: Optional[Resources],
+    params: ClusterJoinParams,
+    dataset,
+    return_distances: bool = False,
+):
+    """Build an approximate k-NN graph by merged within-cluster
+    brute-force passes. Returns (n, graph_degree) int32 (+ distances)."""
+    res = ensure_resources(res)
+    dataset = jnp.asarray(dataset)
+    expect(dataset.ndim == 2, "dataset must be (n, d)")
+    n, d = dataset.shape
+    k = params.graph_degree
+    expect(k < n, "graph_degree must be < n_rows")
+    expect(params.metric in (DistanceType.L2Expanded,
+                             DistanceType.L2SqrtExpanded,
+                             DistanceType.InnerProduct),
+           f"cluster_join supports L2/InnerProduct, got {params.metric!r}")
+    metric = (DistanceType.InnerProduct
+              if params.metric == DistanceType.InnerProduct
+              else DistanceType.L2Expanded)
+    ds32 = dataset.astype(jnp.float32)
+
+    with tracing.range("raft_tpu.cluster_join.build"):
+        C = max(1, -(-n // params.target_cluster_size))
+        best_ids = jnp.full((n, k), -1, jnp.int32)
+        best_d = jnp.full((n, k), jnp.inf, jnp.float32)
+        for p in range(params.passes):
+            if C == 1:
+                idx = jnp.arange(n, dtype=jnp.int32)[None, :]
+            else:
+                km = KMeansBalancedParams(
+                    n_iters=params.kmeans_n_iters, metric=metric,
+                    seed=params.seed * 31 + p)
+                frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+                n_train = min(n, max(C * 32, int(n * frac)))
+                stride = max(1, n // n_train)
+                offset = (p * 17) % stride if stride > 1 else 0
+                centers = kmeans_balanced.fit(
+                    res, km, ds32[offset::stride][:n_train], C)
+                labels = kmeans_balanced.predict(res, km, centers, ds32)
+                sizes = jax.ops.segment_sum(
+                    jnp.ones((n,), jnp.int32), labels, num_segments=C)
+                max_size = int(jnp.max(sizes))
+                # coarse bucket (multiple of half the target size) so
+                # the data-dependent max cluster size lands on the same
+                # padded shape across passes — one _one_pass compile,
+                # not one per pass (remote compiles cost minutes)
+                bucket = max(8, params.target_cluster_size // 2)
+                max_size = max(8, -(-max_size // bucket) * bucket)
+                idx = _pack_cluster_indices(labels, C, max_size)
+            pass_ids, pass_d = _one_pass(ds32, idx, k, metric)
+            if p == 0:
+                best_ids, best_d = pass_ids, pass_d
+            else:
+                best_ids, best_d = _merge_dedup(
+                    jnp.concatenate([best_ids, pass_ids], axis=1),
+                    jnp.concatenate([best_d, pass_d], axis=1), k)
+            if C == 1:
+                break  # one pass IS exact brute force
+
+        if params.polish_rounds > 0 and C > 1:
+            nnd = NNDescentParams(
+                graph_degree=k,
+                intermediate_graph_degree=k,
+                max_iterations=params.polish_rounds,
+                termination_threshold=0.0,
+                metric=params.metric,
+                seed=params.seed,
+            )
+            return nn_descent_mod.build(res, nnd, dataset,
+                                        return_distances=return_distances,
+                                        init_graph=best_ids)
+
+        if params.metric == DistanceType.L2SqrtExpanded:
+            best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
+        elif params.metric == DistanceType.InnerProduct:
+            best_d = -best_d
+        if return_distances:
+            return best_ids, best_d
+        return best_ids

@@ -209,12 +209,19 @@ def build(
     params: NNDescentParams,
     dataset,
     return_distances: bool = False,
+    init_graph=None,
 ):
     """Build an approximate k-NN graph — ``nn_descent::build``.
 
     Returns graph (n, graph_degree) int32, optionally with distances.
     Self-edges are excluded (reference semantics: the graph used by CAGRA
     holds *other* nodes).
+
+    ``init_graph`` — optional (n, w) int32 candidate ids (-1 = empty) to
+    seed the working graph instead of pure random init; rows narrower
+    than ``intermediate_graph_degree`` are topped up with random ids.
+    With a good seed graph (e.g. the cluster-join builder) one or two
+    descent rounds replace the usual ~20.
     """
     res = ensure_resources(res)
     dataset = jnp.asarray(dataset)
@@ -239,6 +246,13 @@ def build(
         # random init (reference: random sampling into per-node queues)
         init = jax.random.randint(k_init, (n, k), 0, n - 1, jnp.int32)
         init = jnp.where(init >= jnp.arange(n)[:, None], init + 1, init)
+        if init_graph is not None:
+            seed_ids = jnp.asarray(init_graph, jnp.int32)
+            expect(seed_ids.ndim == 2 and seed_ids.shape[0] == n,
+                   "init_graph must be (n, w)")
+            w = min(seed_ids.shape[1], k)
+            init = jnp.concatenate([seed_ids[:, :w], init[:, w:]], axis=1)
+            init = jnp.where(init == jnp.arange(n)[:, None], -1, init)
         tile = max(64, min(1024, (1 << 22) // max(k * 4, 1)))
         # init distances through the same tiled path the rounds use, so
         # the (tile, k, d) gather buffer — not an (n, k, d) cube — is the

@@ -60,8 +60,11 @@ def _extract_topk(dist, ids, k: int):
 
 def _knn_kernel(q_ref, qn_ref, x_ref, xn_ref, outd_ref, outi_ref,
                 bestd, besti, *, k: int, n: int, tile: int,
-                metric: DistanceType):
-    step = pl.program_id(0)
+                steps: int, metric: DistanceType):
+    # position within the current pass — the grid runs `passes` full
+    # dataset streams back-to-back (pass > 1 only for slope timing:
+    # per-pass cost = d wall / d passes, immune to dispatch overhead)
+    step = pl.program_id(0) % steps
 
     @pl.when(step == 0)
     def _():
@@ -107,7 +110,7 @@ def _knn_kernel(q_ref, qn_ref, x_ref, xn_ref, outd_ref, outi_ref,
         bestd[:] = new_d
         besti[:] = new_i
 
-    @pl.when(step == pl.num_programs(0) - 1)
+    @pl.when(step == steps - 1)
     def _():
         out = bestd[:]
         if metric in (DistanceType.L2SqrtExpanded,
@@ -136,6 +139,7 @@ def fused_knn(
     dataset_norms=None,
     tile: int = 0,
     vmem_mb: int = 0,
+    passes: int = 1,
     interpret: bool = False,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN in one streamed Pallas pass: (q, k) distances + indices.
@@ -154,17 +158,24 @@ def fused_knn(
     (``vmem_mb``, default from ``RAFT_TPU_VMEM_MB`` or 64). Measured on
     v5e the stream is per-grid-step bound (~16 us/step) far below the
     HBM roofline, so the right tile is the largest that fits — fewer,
-    bigger DMAs — not a fixed 8k."""
+    bigger DMAs — not a fixed 8k.
+
+    ``passes > 1`` repeats the full dataset stream that many times in
+    ONE dispatch (the grid wraps around) — a benchmarking aid: per-pass
+    time from the slope between two pass counts cancels the dispatch
+    overhead that floors single-dispatch timing on relayed backends.
+    Results are identical to passes=1."""
     if vmem_mb <= 0:
         vmem_mb = _default_vmem_mb()
     return _fused_knn_impl(queries, dataset, k, metric,
                            dataset_norms=dataset_norms, tile=tile,
-                           vmem_mb=vmem_mb, interpret=interpret)
+                           vmem_mb=vmem_mb, passes=passes,
+                           interpret=interpret)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("k", "metric", "tile", "vmem_mb",
-                                    "interpret"))
+                                    "passes", "interpret"))
 def _fused_knn_impl(
     queries,
     dataset,
@@ -174,6 +185,7 @@ def _fused_knn_impl(
     dataset_norms,
     tile: int,
     vmem_mb: int,
+    passes: int,
     interpret: bool,
 ) -> Tuple[jax.Array, jax.Array]:
     expect(metric in _SUPPORTED_METRICS,
@@ -217,21 +229,21 @@ def _fused_knn_impl(
     else:
         xn = jnp.asarray(dataset_norms, jnp.float32).reshape(1, n)
     qp = qs.shape[0]
-    grid = -(-n // tile)
+    steps = -(-n // tile)
 
     kernel = functools.partial(_knn_kernel, k=k, n=n, tile=tile,
-                               metric=metric)
+                               steps=steps, metric=metric)
     outd, outi = pl.pallas_call(
         kernel,
-        grid=(grid,),
+        grid=(steps * passes,),
         in_specs=[
             pl.BlockSpec((qp, qs.shape[1]), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((qp, 1), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, xs.shape[1]), lambda i: (i, 0),
+            pl.BlockSpec((tile, xs.shape[1]), lambda i, s=steps: (i % s, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tile), lambda i: (0, i),
+            pl.BlockSpec((1, tile), lambda i, s=steps: (0, i % s),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Fourth-round TPU probes: TRUE on-chip rates via in-program iteration.
+
+Round-3 finding: every single-dispatch measurement bottoms out at
+~4.2 ms regardless of bytes/steps — the relay serializes dispatches
+with a ~4 ms gap, so per-dispatch timing cannot see anything faster.
+Fix: iterate the kernel INSIDE one jitted program and fit a slope:
+per-iter cost = (T(M2) - T(M1)) / (M2 - M1), which cancels both the
+dispatch overhead and the compile-cached constant term.
+
+- Pure read bandwidth: one pallas_call whose grid revisits the same
+  array M times (index_map i -> (i % steps, 0)) — M full dataset
+  streams in a single dispatch.
+- Search kernels: lax.fori_loop whose carried query tile is perturbed
+  by a data-dependent epsilon each iteration, so XLA can neither hoist
+  nor CSE the body.
+
+Run serially on a healthy relay; pipelined fetch-anchored timing.
+"""
+
+import functools
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def wall(fn):
+    out = fn()
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = fn()
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    return (time.perf_counter() - t0) / 5
+
+
+def slope(tag, make_fn, m1, m2, payload_per_iter=None, extra=None):
+    """Per-iteration time from two loop lengths."""
+    try:
+        f1, f2 = make_fn(m1), make_fn(m2)
+        t1, t2 = wall(f1), wall(f2)
+        dt = (t2 - t1) / (m2 - m1)
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"piece": tag, "error": str(e)[:200]}), flush=True)
+        return None
+    rec = {"piece": tag, "iter_ms": round(dt * 1e3, 4),
+           "t1_ms": round(t1 * 1e3, 2), "t2_ms": round(t2 * 1e3, 2)}
+    if payload_per_iter and dt > 0:
+        rec["gbps"] = round(payload_per_iter / dt / 1e9, 1)
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+    return dt
+
+
+# ---- repeated-read kernel: grid revisits the array M times ---------------
+
+
+def _mread_kernel(x_ref, o_ref, acc):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+
+    acc[:] += jnp.sum(x_ref[:].astype(jnp.float32), axis=0, keepdims=True)
+
+    @pl.when(step == pl.num_programs(0) - 1)
+    def _():
+        o_ref[:] = acc[:]
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "m", "vmem_mb"))
+def multi_read(x, tile: int, m: int, vmem_mb: int = 72):
+    n, d = x.shape
+    assert n % tile == 0
+    steps = n // tile
+    return pl.pallas_call(
+        _mread_kernel,
+        grid=(steps * m,),
+        in_specs=[pl.BlockSpec((tile, d), lambda i, s=steps: (i % s, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, d), lambda i: (0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((1, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_mb * 1024 * 1024),
+    )(x)
+
+
+# the in-program loop + perturbation trick lives in the shared
+# methodology module — one copy, shared with bench.py
+from raft_tpu.bench.prims import loop_queries  # noqa: E402
+
+
+def main():
+    print(json.dumps({"prof": "round4", "backend": jax.default_backend()}),
+          flush=True)
+
+    big = jax.random.normal(jax.random.key(0), (1 << 20, 128), jnp.float32)
+    bigb = big.astype(jnp.bfloat16)
+
+    # ---- 1. true sustained read bandwidth
+    for tag, x, payload in (("f32", big, 512e6), ("bf16", bigb, 256e6)):
+        for tile in (4096, 16384):
+            slope(f"mread_{tag}_t{tile}",
+                  lambda m, x=x, t=tile: (lambda: multi_read(x, t, m)),
+                  2, 10, payload_per_iter=payload,
+                  extra={"steps_per_iter": (1 << 20) // tile})
+
+    # ---- 2. fused_knn true per-iter cost
+    from raft_tpu.distance.types import DistanceType
+    from raft_tpu.ops.fused_topk import fused_knn
+    qs = jax.random.normal(jax.random.key(2), (10, 128), jnp.float32)
+    norms = jnp.sum(jnp.square(big), axis=1)
+    for tag, ds, payload in (("f32", big, 512e6), ("bf16", bigb, 256e6)):
+        for tile in (8192, 16384, 32768):
+            fn = lambda q, ds=ds, t=tile: fused_knn(  # noqa: E731
+                q.astype(ds.dtype), ds, 10, DistanceType.L2Expanded,
+                dataset_norms=norms, tile=t)
+            slope(f"fknn_{tag}_t{tile}",
+                  lambda m, fn=fn: loop_queries(fn, qs, m),
+                  2, 8, payload_per_iter=payload)
+
+    # ---- 3. IVF-Flat / IVF-PQ search true per-iter cost
+    from raft_tpu.neighbors import ivf_flat, ivf_pq
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((200_000, 128)).astype(np.float32)
+    q100 = jnp.asarray(rng.standard_normal((100, 128)), jnp.float32)
+
+    fi = ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(n_lists=1024), x)
+    for p in (32, 64):
+        sp = ivf_flat.IvfFlatSearchParams(n_probes=p)
+        fn = lambda q, sp=sp: ivf_flat.search(None, sp, fi, q, 10)  # noqa: E731
+        slope(f"ivf_flat_p{p}", lambda m, fn=fn: loop_queries(fn, q100, m),
+              1, 5, payload_per_iter=100 * p * 200 * 128 * 4)
+
+    pi4 = ivf_pq.build(None, ivf_pq.IvfPqIndexParams(
+        n_lists=1024, pq_dim=128, pq_bits=4), x)
+    for mode in ("select", "onehot"):
+        sp = ivf_pq.IvfPqSearchParams(n_probes=32, score_mode=mode)
+        fn = lambda q, sp=sp: ivf_pq.search(None, sp, pi4, q, 10)  # noqa: E731
+        slope(f"ivf_pq_b4_{mode}_p32",
+              lambda m, fn=fn: loop_queries(fn, q100, m), 1, 5)
+
+    pi8 = ivf_pq.build(None, ivf_pq.IvfPqIndexParams(
+        n_lists=1024, pq_dim=64, pq_bits=8), x)
+    sp = ivf_pq.IvfPqSearchParams(n_probes=32)
+    fn = lambda q, sp=sp: ivf_pq.search(None, sp, pi8, q, 10)  # noqa: E731
+    slope("ivf_pq_b8_onehot_p32",
+          lambda m, fn=fn: loop_queries(fn, q100, m), 1, 3)
+
+    # ---- 4. brute-force XLA scan path (RAFT_TPU_DISABLE_FUSED analog)
+    from raft_tpu.neighbors.brute_force import _knn_scan
+    fn = lambda q: _knn_scan(q, big, 10, DistanceType.L2Expanded,  # noqa: E731
+                             2.0, 262144, "highest", False)
+    slope("bf_xla_scan_t262144", lambda m: loop_queries(fn, qs, m),
+          2, 6, payload_per_iter=512e6)
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,52 @@ class TestNNDescent:
         np.testing.assert_allclose(d[:50], ref, rtol=1e-3, atol=1e-3)
 
 
+class TestClusterJoin:
+    def test_graph_recall(self, dataset):
+        """Merged within-cluster passes + one polish round reach the
+        same recall bar as full NN-descent."""
+        from raft_tpu.neighbors import cluster_join
+
+        x, _ = dataset
+        params = cluster_join.ClusterJoinParams(
+            graph_degree=16, passes=3, target_cluster_size=400,
+            polish_rounds=1, seed=5)
+        graph = cluster_join.build(None, params, x)
+        g = np.asarray(graph)
+        assert g.shape == (len(x), 16)
+        assert not np.any(g == np.arange(len(x))[:, None])
+        assert g.max() < len(x)
+        r = _knn_graph_recall(x, g, 16)
+        assert r >= 0.85, f"graph recall {r}"
+
+    def test_single_cluster_is_exact(self):
+        """target >= n degenerates to one exact brute-force pass."""
+        from raft_tpu.neighbors import cluster_join
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((300, 16)).astype(np.float32)
+        params = cluster_join.ClusterJoinParams(
+            graph_degree=8, target_cluster_size=512, polish_rounds=0)
+        graph, dists = cluster_join.build(None, params, x,
+                                          return_distances=True)
+        r = _knn_graph_recall(x, np.asarray(graph), 8)
+        assert r == 1.0, r
+        d = np.asarray(dists)
+        assert np.all(np.diff(d, axis=1) >= -1e-4)
+
+    def test_cagra_build_algo(self, dataset):
+        """End-to-end CAGRA with the CLUSTER_JOIN source."""
+        x, q = dataset
+        index = cagra.build(None, CagraIndexParams(
+            graph_degree=16, intermediate_graph_degree=32,
+            build_algo=BuildAlgo.CLUSTER_JOIN), x)
+        d, i = cagra.search(None, CagraSearchParams(itopk_size=32), index,
+                            q, 10)
+        _, gt = _gt(x, q, 10)
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        assert r >= 0.9, r
+
+
 class TestCagraOptimize:
     def test_degree_and_validity(self, dataset):
         x, _ = dataset
@@ -89,6 +135,31 @@ class TestCagraOptimize:
             assert len(set(vals.tolist())) == len(vals)
         # pruning keeps the graph mostly full
         assert (g >= 0).mean() > 0.95
+
+
+class TestBufferMerge:
+    def test_dedup_and_priority(self):
+        """Buffer copies win over candidate copies (explored flags
+        survive); earlier candidates win over later duplicates; -1
+        candidates never enter."""
+        import jax.numpy as jnp
+        from raft_tpu.neighbors.cagra import _buffer_merge
+
+        ids = jnp.asarray([[5, 9, -1, -1]])
+        dists = jnp.asarray([[1.0, 2.0, np.inf, np.inf]])
+        explored = jnp.asarray([[True, False, False, False]])
+        # cand 5 duplicates buffer (worse d must NOT replace the
+        # explored flag), the two 7s dedup to the first, -1 is invalid
+        cand = jnp.asarray([[5, 7, 7, -1]])
+        cand_d = jnp.asarray([[0.5, 3.0, 0.1, 0.0]])
+        out_i, out_d, out_e = _buffer_merge(ids, dists, explored,
+                                            cand, cand_d, 4)
+        oi, od, oe = (np.asarray(out_i)[0], np.asarray(out_d)[0],
+                      np.asarray(out_e)[0])
+        assert oi[:3].tolist() == [5, 9, 7]
+        np.testing.assert_allclose(od[:3], [1.0, 2.0, 3.0])
+        assert oe[:3].tolist() == [True, False, False]
+        assert not np.isfinite(od[3])
 
 
 class TestCagraSearch:

@@ -62,6 +62,17 @@ class TestFusedKnn:
         wd, _ = _naive_knn(q, x, 32, DistanceType.L2Expanded)
         np.testing.assert_allclose(np.asarray(d), wd, rtol=1e-3, atol=1e-3)
 
+    def test_multi_pass_identical(self, rng_np):
+        """passes>1 (the slope-timing mode) repeats the stream in one
+        dispatch and must return exactly the passes=1 result — incl.
+        with a ragged tail block."""
+        q = rng_np.standard_normal((4, 20)).astype(np.float32)
+        x = rng_np.standard_normal((300, 20)).astype(np.float32)
+        d1, i1 = fused_knn(q, x, 6, tile=128, interpret=True)
+        d3, i3 = fused_knn(q, x, 6, tile=128, passes=3, interpret=True)
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d3))
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i3))
+
 
 class TestSelectKTiles:
     def test_matches_topk_min(self, rng_np):

@@ -1,4 +1,5 @@
-"""Distributed exact kNN — per-shard top-k + all-gather merge.
+"""Distributed exact kNN — per-shard top-k + all-gather merge, and a
+ring-pass variant for sharded query sets.
 
 This is the TPU-native form of the reference's MNMG search pattern:
 raft-dask shards the dataset one part per worker, each worker runs local
@@ -6,6 +7,14 @@ brute force, and ``knn_merge_parts`` (``detail/knn_merge_parts.cuh``)
 fuses the per-part results. Here the dataset is row-sharded over a mesh
 axis, the local scan runs per shard under ``shard_map``, and the merge is
 an ``all_gather`` + top-k — XLA rides the ICI ring for the gather.
+
+:func:`brute_force_knn_ring` is the sequence-parallel-style form (the
+ring-attention communication pattern applied to search): queries are
+ALSO sharded, and each query block circulates the mesh ring via
+``ppermute``, merging a running top-k against each dataset shard it
+visits. Per-device memory is O(n/R + q/R) with no replication, and the
+block transfer overlaps the local scan — the pattern that scales query
+batches to multi-host meshes.
 """
 
 from __future__ import annotations
@@ -79,6 +88,79 @@ def brute_force_knn(
         )(ds, qs)
 
     with tracing.range("raft_tpu.distributed.brute_force_knn"):
+        return _run(dataset, queries)
+
+
+def brute_force_knn_ring(
+    comms: Comms,
+    dataset,
+    queries,
+    k: int,
+    metric: DistanceType = DistanceType.L2Expanded,
+    metric_arg: float = 2.0,
+    db_tile: int = 32768,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN with BOTH dataset and queries row-sharded; query blocks
+    circulate the ring (``ppermute``) so nothing is ever replicated.
+
+    After R ring steps every query block has been scanned against every
+    dataset shard and is back on its home device carrying its merged
+    top-k. Returns (distances, global indices) sharded like the queries.
+    """
+    dataset = jnp.asarray(dataset)
+    queries = jnp.asarray(queries)
+    expect(dataset.ndim == 2 and queries.ndim == 2, "2-D inputs required")
+    R = comms.size
+    expect(dataset.shape[0] % R == 0,
+           "dataset rows must divide the mesh axis (pad the dataset)")
+    expect(queries.shape[0] % R == 0,
+           "query rows must divide the mesh axis (pad the queries)")
+    n_local = dataset.shape[0] // R
+    expect(0 < k <= n_local, "k must be <= rows per shard")
+    select_min = is_min_close(metric)
+    axis = comms.axis
+    tile = min(db_tile, max(128, n_local))
+    perm = [(i, (i + 1) % R) for i in range(R)]
+
+    dataset = jax.device_put(dataset, comms.row_sharded())
+    queries = jax.device_put(queries, comms.row_sharded())
+
+    @jax.jit
+    def _run(ds, qs):
+        def body(ds_local, qs_local):
+            pad_val = jnp.inf if select_min else -jnp.inf
+            qb = qs_local.shape[0]
+            state = (
+                qs_local,
+                jnp.full((qb, k), pad_val, jnp.float32),
+                jnp.full((qb, k), -1, jnp.int32),
+            )
+            my_base = rank(axis) * n_local
+            # R scan+merge rounds, each followed by one ring hop; after
+            # R hops the block is home with its full merge. A Python
+            # loop (R is static) keeps each ppermute visible to XLA for
+            # transfer/compute overlap.
+            for _ in range(R):
+                blk, best_d, best_i = state
+                d_loc, i_loc = _local_scan(blk, ds_local, k, metric,
+                                           metric_arg, tile, select_min,
+                                           axis)
+                best_d, best_i = merge_topk(
+                    best_d, best_i, d_loc,
+                    (i_loc + my_base).astype(jnp.int32), k, select_min)
+                state = jax.lax.ppermute((blk, best_d, best_i), axis,
+                                         perm)
+            _, best_d, best_i = state
+            return best_d, best_i
+
+        return jax.shard_map(
+            body, mesh=comms.mesh,
+            in_specs=(P(axis, None), P(axis, None)),
+            out_specs=(P(axis, None), P(axis, None)),
+            check_vma=False,
+        )(ds, qs)
+
+    with tracing.range("raft_tpu.distributed.brute_force_knn_ring"):
         return _run(dataset, queries)
 
 

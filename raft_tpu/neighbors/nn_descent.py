@@ -251,8 +251,12 @@ def build(
             expect(seed_ids.ndim == 2 and seed_ids.shape[0] == n,
                    "init_graph must be (n, w)")
             w = min(seed_ids.shape[1], k)
-            init = jnp.concatenate([seed_ids[:, :w], init[:, w:]], axis=1)
-            init = jnp.where(init == jnp.arange(n)[:, None], -1, init)
+            merged = jnp.concatenate([seed_ids[:, :w], init[:, w:]], axis=1)
+            # top up -1 padding inside seed rows with the random ids so
+            # sparse seeds never start from a thinner candidate pool
+            # than plain random init
+            merged = jnp.where(merged >= 0, merged, init)
+            init = jnp.where(merged == jnp.arange(n)[:, None], -1, merged)
         tile = max(64, min(1024, (1 << 22) // max(k * 4, 1)))
         # init distances through the same tiled path the rounds use, so
         # the (tile, k, d) gather buffer — not an (n, k, d) cube — is the

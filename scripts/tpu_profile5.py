@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Fifth-round TPU probes — only known-safe compositions (no fori_loop
+around pallas_call; that wedged the remote-compile helper in round 4).
+
+- fused_knn true per-pass cost via its `passes` grid-wrap mode (same
+  compile shape family as the multi-read kernel that compiled fine).
+- CAGRA search after the argsort-free merge rewrite (single dispatch —
+  its internal while_loop makes the number real, not dispatch-bound).
+- cluster_join full build wall time at 200k (vs 838 s for the old
+  IVF-PQ-path CAGRA build and ~92 s for NN-descent at 50k).
+- IVF-Flat / IVF-PQ single-dispatch timings for continuity with the
+  round-2 numbers (both include the session's dispatch floor).
+"""
+
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def wall(fn, iters=5):
+    out = fn()
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[:1])
+    return (time.perf_counter() - t0) / iters
+
+
+def emit(piece, **kw):
+    print(json.dumps({"piece": piece, **kw}), flush=True)
+
+
+def main():
+    emit("config", backend=jax.default_backend())
+
+    from raft_tpu.distance.types import DistanceType
+    from raft_tpu.ops.fused_topk import fused_knn
+
+    big = jax.random.normal(jax.random.key(0), (1 << 20, 128), jnp.float32)
+    bigb = big.astype(jnp.bfloat16)
+    qs = jax.random.normal(jax.random.key(2), (10, 128), jnp.float32)
+    norms = jnp.sum(jnp.square(big), axis=1)
+
+    # ---- 1. fused_knn per-pass slope via the passes mode
+    for tag, ds, payload in (("f32", big, 512e6), ("bf16", bigb, 256e6)):
+        for tile in (0, 16384):  # 0 = auto (VMEM-budget sized)
+            try:
+                t2 = wall(lambda: fused_knn(qs, ds, 10,
+                                            DistanceType.L2Expanded,
+                                            dataset_norms=norms, tile=tile,
+                                            passes=2))
+                t8 = wall(lambda: fused_knn(qs, ds, 10,
+                                            DistanceType.L2Expanded,
+                                            dataset_norms=norms, tile=tile,
+                                            passes=8))
+                dt = (t8 - t2) / 6
+                emit(f"fknn_{tag}_tile{tile}_slope",
+                     iter_ms=round(dt * 1e3, 3),
+                     gbps=round(payload / dt / 1e9, 1) if dt > 0 else -1,
+                     t2_ms=round(t2 * 1e3, 2), t8_ms=round(t8 * 1e3, 2))
+            except Exception as e:  # noqa: BLE001
+                emit(f"fknn_{tag}_tile{tile}_slope", error=str(e)[:160])
+
+    # ---- 2. datasets for the ANN pieces
+    from raft_tpu.neighbors import brute_force, cagra, cluster_join, ivf_flat, ivf_pq
+    from raft_tpu.utils import eval_recall
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((200_000, 128)).astype(np.float32)
+    q = rng.standard_normal((100, 128)).astype(np.float32)
+    _, gt_i = brute_force.knn(None, x, q, 10)
+    gt = np.asarray(gt_i)
+
+    # ---- 3. cluster_join graph build + CAGRA end-to-end
+    t0 = time.perf_counter()
+    ci = cagra.build(None, cagra.CagraIndexParams(
+        graph_degree=32, intermediate_graph_degree=64,
+        build_algo=cagra.BuildAlgo.CLUSTER_JOIN), x)
+    np.asarray(ci.graph[:1])
+    emit("cagra_build_cluster_join_200k",
+         s=round(time.perf_counter() - t0, 1))
+
+    for it in (64, 128):
+        sp = cagra.CagraSearchParams(itopk_size=it, search_width=4)
+        dt = wall(lambda sp=sp: cagra.search(None, sp, ci, q, 10), iters=10)
+        _, i = cagra.search(None, sp, ci, q, 10)
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        emit(f"cagra_search_itopk{it}", ms=round(dt * 1e3, 2),
+             qps=round(100 / dt, 1), recall=round(float(r), 4))
+
+    # seed_pool variant (query-aware seeding)
+    sp = cagra.CagraSearchParams(itopk_size=64, search_width=4,
+                                 seed_pool=4096)
+    dt = wall(lambda: cagra.search(None, sp, ci, q, 10), iters=10)
+    _, i = cagra.search(None, sp, ci, q, 10)
+    r, _, _ = eval_recall(gt, np.asarray(i))
+    emit("cagra_search_itopk64_pool", ms=round(dt * 1e3, 2),
+         qps=round(100 / dt, 1), recall=round(float(r), 4))
+
+    # ---- 4. IVF continuity numbers (dispatch-floor inflated)
+    fi = ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(n_lists=1024), x)
+    for p in (32, 64):
+        sp = ivf_flat.IvfFlatSearchParams(n_probes=p)
+        dt = wall(lambda sp=sp: ivf_flat.search(None, sp, fi, q, 10),
+                  iters=10)
+        emit(f"ivf_flat_p{p}", ms=round(dt * 1e3, 2),
+             qps=round(100 / dt, 1))
+
+    pi = ivf_pq.build(None, ivf_pq.IvfPqIndexParams(
+        n_lists=1024, pq_dim=128, pq_bits=4), x)
+    sp = ivf_pq.IvfPqSearchParams(n_probes=32)
+    dt = wall(lambda: ivf_pq.search(None, sp, pi, q, 10), iters=10)
+    _, i = ivf_pq.search(None, sp, pi, q, 10)
+    r, _, _ = eval_recall(gt, np.asarray(i))
+    emit("ivf_pq_b4_d128_p32", ms=round(dt * 1e3, 2),
+         qps=round(100 / dt, 1), recall=round(float(r), 4))
+
+
+if __name__ == "__main__":
+    main()

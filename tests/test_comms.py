@@ -170,6 +170,32 @@ class TestDistributedKnn:
                                    metric=DistanceType.InnerProduct)
         np.testing.assert_array_equal(np.asarray(i_dist), np.asarray(i_ref))
 
+    def test_ring_matches_single_device(self, rng_np):
+        """Ring-pass variant: sharded queries circulate via ppermute;
+        results must equal single-device brute force."""
+        from raft_tpu.distributed import brute_force_knn_ring
+
+        comms = local_comms()
+        x = rng_np.standard_normal((2048, 32)).astype(np.float32)
+        q = rng_np.standard_normal((64, 32)).astype(np.float32)
+        d_dist, i_dist = brute_force_knn_ring(comms, x, q, 10)
+        d_ref, i_ref = brute_force.knn(None, x, q, 10)
+        np.testing.assert_array_equal(np.asarray(i_dist), np.asarray(i_ref))
+        np.testing.assert_allclose(np.asarray(d_dist), np.asarray(d_ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_ring_inner_product(self, rng_np):
+        from raft_tpu.distributed import brute_force_knn_ring
+
+        comms = local_comms()
+        x = rng_np.standard_normal((1024, 16)).astype(np.float32)
+        q = rng_np.standard_normal((32, 16)).astype(np.float32)
+        _, i_dist = brute_force_knn_ring(comms, x, q, 5,
+                                         metric=DistanceType.InnerProduct)
+        _, i_ref = brute_force.knn(None, x, q, 5,
+                                   metric=DistanceType.InnerProduct)
+        np.testing.assert_array_equal(np.asarray(i_dist), np.asarray(i_ref))
+
 
 class TestShardedAnn:
     def test_ivf_flat_shards(self, rng_np):

@@ -15,6 +15,7 @@ from raft_tpu.linalg.elementwise import (
     add,
     binary_op,
     divide,
+    map,  # noqa: A004
     map_offset,
     multiply,
     power,
@@ -23,6 +24,7 @@ from raft_tpu.linalg.elementwise import (
     sqrt,
     subtract,
     ternary_op,
+    transpose,
     unary_op,
 )
 from raft_tpu.linalg.matrix_vector import matrix_vector_op
@@ -59,7 +61,9 @@ __all__ = [
     "add",
     "binary_op",
     "divide",
+    "map",
     "map_offset",
+    "transpose",
     "multiply",
     "power",
     "scalar_add",

@@ -41,6 +41,16 @@ def map_offset(res: Optional[Resources], shape, op: Callable, dtype=jnp.float32)
     return op(idx).astype(dtype).reshape(shape)
 
 
+def map(res: Optional[Resources], op: Callable, *arrays):  # noqa: A001
+    """Variadic elementwise map (``linalg::map``, ``linalg/map.cuh``)."""
+    return op(*arrays)
+
+
+def transpose(res: Optional[Resources], x):
+    """Matrix transpose (``linalg/transpose.cuh``)."""
+    return jnp.swapaxes(jnp.asarray(x), -1, -2)
+
+
 def add(res: Optional[Resources], x, y):
     return x + y
 

@@ -89,3 +89,85 @@ def matrix_print(matrix, name: str = "matrix", max_rows: int = 8, max_cols: int 
     arr = np.asarray(jax.device_get(matrix))
     print(f"{name} shape={arr.shape} dtype={arr.dtype}")
     print(np.array2string(arr[:max_rows, :max_cols], precision=4))
+
+
+def copy(matrix) -> jax.Array:
+    """Out-of-place copy (``matrix/copy.cuh``)."""
+    return jnp.array(jnp.asarray(matrix))
+
+
+def diagonal(matrix) -> jax.Array:
+    """Extract the main diagonal (``matrix/diagonal.cuh``)."""
+    return jnp.diagonal(jnp.asarray(matrix))
+
+
+def set_diagonal(matrix, values) -> jax.Array:
+    """Return a copy with the main diagonal replaced
+    (``matrix::set_diagonal``)."""
+    matrix = jnp.asarray(matrix)
+    n = min(matrix.shape[0], matrix.shape[1])
+    idx = jnp.arange(n)
+    return matrix.at[idx, idx].set(jnp.asarray(values)[:n])
+
+
+def fill(matrix, value) -> jax.Array:
+    """Constant-fill with the input's shape/dtype (``matrix/init.cuh``)."""
+    matrix = jnp.asarray(matrix)
+    return jnp.full_like(matrix, value)
+
+
+def eye(n: int, dtype=jnp.float32) -> jax.Array:
+    """Identity matrix (``matrix::eye``)."""
+    return jnp.eye(n, dtype=dtype)
+
+
+def power(matrix, exponent) -> jax.Array:
+    """Elementwise power (``matrix/power.cuh``)."""
+    return jnp.power(jnp.asarray(matrix), exponent)
+
+
+def sqrt(matrix) -> jax.Array:
+    """Elementwise square root (``matrix/sqrt.cuh``)."""
+    return jnp.sqrt(jnp.asarray(matrix))
+
+
+def reciprocal(matrix, scalar=1.0, thres: float = 0.0) -> jax.Array:
+    """``scalar / x`` with small-denominator guard
+    (``matrix/reciprocal.cuh``): entries with |x| <= thres map to 0."""
+    matrix = jnp.asarray(matrix)
+    out = scalar / matrix
+    return jnp.where(jnp.abs(matrix) <= thres, jnp.zeros_like(out), out)
+
+
+def ratio(matrix) -> jax.Array:
+    """Normalize so entries sum to one (``matrix/ratio.cuh``)."""
+    matrix = jnp.asarray(matrix)
+    return matrix / jnp.sum(matrix)
+
+
+def sign_flip(matrix) -> jax.Array:
+    """Flip each column's sign so its max-|value| entry is positive —
+    deterministic eigenvector orientation (``matrix/sign_flip.cuh``)."""
+    matrix = jnp.asarray(matrix)
+    pivot = jnp.take_along_axis(
+        matrix, jnp.argmax(jnp.abs(matrix), axis=0)[None, :], axis=0)
+    return matrix * jnp.where(pivot < 0, -1.0, 1.0)
+
+
+def zero_small_values(matrix, thres) -> jax.Array:
+    """Zero entries whose MAGNITUDE is <= thres (``matrix/threshold.cuh``
+    ``zero_small_values``: denoising that keeps large entries of either
+    sign)."""
+    matrix = jnp.asarray(matrix)
+    return jnp.where(jnp.abs(matrix) <= thres, jnp.zeros_like(matrix),
+                     matrix)
+
+
+# reference alias: the public header is matrix/threshold.cuh
+threshold = zero_small_values
+
+
+def l2_norm(matrix) -> jax.Array:
+    """Frobenius norm of the whole matrix (``matrix/norm.cuh``
+    ``l2_norm``)."""
+    return jnp.sqrt(jnp.sum(jnp.square(jnp.asarray(matrix, jnp.float32))))

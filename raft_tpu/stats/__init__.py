@@ -11,7 +11,9 @@ from raft_tpu.stats.summary import (
     histogram,
     mean,
     mean_center,
+    meanvar,
     minmax,
+    regression_metrics,
     stddev,
     sum_stat,
     var,
@@ -59,5 +61,11 @@ __all__ = [
     "rand_index",
     "silhouette_score",
     "trustworthiness",
+    "trustworthiness_score",
     "v_measure",
+    "meanvar",
+    "regression_metrics",
 ]
+
+# reference naming alias (``stats::trustworthiness_score``)
+trustworthiness_score = trustworthiness

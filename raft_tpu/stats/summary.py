@@ -43,6 +43,22 @@ def stddev(res: Optional[Resources], data, mu=None, *, sample: bool = True):
     return jnp.sqrt(var(res, data, mu, sample=sample))
 
 
+def meanvar(res: Optional[Resources], data, *, sample: bool = True):
+    """Fused mean + variance in one pass (``stats/meanvar.cuh``)."""
+    x = data.astype(jnp.float32)
+    mu = jnp.mean(x, axis=0)
+    return mu, var(res, data, mu, sample=sample)
+
+
+def regression_metrics(res: Optional[Resources], predictions, ref):
+    """Mean-absolute / mean-squared / median-absolute error
+    (``stats/regression_metrics.cuh``). Returns (mae, mse, medae)."""
+    p = jnp.asarray(predictions, jnp.float32).ravel()
+    r = jnp.asarray(ref, jnp.float32).ravel()
+    err = jnp.abs(p - r)
+    return (jnp.mean(err), jnp.mean(jnp.square(p - r)), jnp.median(err))
+
+
 def mean_center(res: Optional[Resources], data, mu=None):
     """``stats::meanCenter``: subtract column means."""
     x = data.astype(jnp.float32)

@@ -88,3 +88,50 @@ class TestMatrixOps:
         v = rng_np.standard_normal(5).astype(np.float32)
         out = np.asarray(matrix.linewise_op(m, v, True, jnp.add))
         np.testing.assert_allclose(out, m + v[None, :], rtol=1e-6)
+
+
+class TestMatrixMath:
+    """The small `raft/matrix/*.cuh` math headers: copy/diagonal/init/
+    power/sqrt/reciprocal/ratio/sign_flip/threshold/norm."""
+
+    def test_copy_fill_eye_diag(self, rng_np):
+        m = rng_np.standard_normal((4, 6)).astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(matrix.copy(m)), m)
+        np.testing.assert_array_equal(np.asarray(matrix.fill(m, 3.0)),
+                                      np.full_like(m, 3.0))
+        np.testing.assert_array_equal(np.asarray(matrix.eye(3)), np.eye(3))
+        np.testing.assert_array_equal(np.asarray(matrix.diagonal(m)),
+                                      np.diagonal(m))
+        d = rng_np.standard_normal(4).astype(np.float32)
+        out = np.asarray(matrix.set_diagonal(m, d))
+        np.testing.assert_array_equal(np.diagonal(out), d)
+
+    def test_elementwise_math(self, rng_np):
+        m = np.abs(rng_np.standard_normal((3, 4))).astype(np.float32) + 0.1
+        np.testing.assert_allclose(np.asarray(matrix.power(m, 2.0)), m ** 2,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(matrix.sqrt(m)), np.sqrt(m),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(matrix.ratio(m)), m / m.sum(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(matrix.l2_norm(m)),
+                                   np.linalg.norm(m), rtol=1e-5)
+
+    def test_reciprocal_guard_threshold_signflip(self):
+        x = np.array([[0.0, 2.0, -4.0]], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(matrix.reciprocal(x, 1.0, 1e-6)),
+            [[0.0, 0.5, -0.25]])
+        # zero_small_values semantics: zero by MAGNITUDE — large
+        # negative entries survive
+        np.testing.assert_array_equal(
+            np.asarray(matrix.threshold(x, 1.0)), [[0.0, 2.0, -4.0]])
+        np.testing.assert_array_equal(
+            np.asarray(matrix.zero_small_values(
+                np.array([[0.5, -0.5, 3.0]], np.float32), 0.5)),
+            [[0.0, 0.0, 3.0]])
+        m = np.array([[1.0, -3.0], [-2.0, 1.0]], np.float32)
+        out = np.asarray(matrix.sign_flip(m))
+        # max-|value| entry of each column must come out positive
+        piv = np.abs(out).argmax(axis=0)
+        assert (out[piv, np.arange(2)] > 0).all()

@@ -229,3 +229,33 @@ class TestLabel:
         # row 4 linked to row 3 only through a-label 3; merge_labels merges
         # via b-groups, a-continuity handled by chasing
         assert merged.min() == 0
+
+
+class TestMeanVarRegression:
+    def test_meanvar_matches_separate(self, rng_np):
+        from raft_tpu import stats
+
+        x = rng_np.standard_normal((50, 6)).astype(np.float32)
+        mu, v = stats.meanvar(None, x)
+        np.testing.assert_allclose(np.asarray(mu), x.mean(0), rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(v), x.var(0, ddof=1),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_regression_metrics(self, rng_np):
+        from raft_tpu import stats
+
+        p = rng_np.standard_normal(64).astype(np.float32)
+        r = rng_np.standard_normal(64).astype(np.float32)
+        mae, mse, med = stats.regression_metrics(None, p, r)
+        np.testing.assert_allclose(float(mae), np.abs(p - r).mean(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(mse), ((p - r) ** 2).mean(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(med), np.median(np.abs(p - r)),
+                                   rtol=1e-5)
+
+    def test_trustworthiness_alias(self):
+        from raft_tpu import stats
+
+        assert stats.trustworthiness_score is stats.trustworthiness

@@ -212,3 +212,28 @@ class TestApproxCoarse:
             for r in range(len(q))
         ])
         assert overlap >= 0.9, overlap
+
+
+class TestBf16Storage:
+    def test_bf16_dataset_recall(self, rng_np):
+        """bf16 list storage (the reference's fp16 dataset analog): the
+        padded lists keep the storage dtype, norms/scan run f32, and
+        recall matches the f32 index on well-separated data."""
+        import jax.numpy as jnp
+        from raft_tpu.neighbors import brute_force
+        from raft_tpu.utils import eval_recall
+
+        centers = rng_np.standard_normal((8, 32)) * 6
+        x = (centers[rng_np.integers(0, 8, 4000)]
+             + rng_np.standard_normal((4000, 32))).astype(np.float32)
+        q = (centers[rng_np.integers(0, 8, 16)]
+             + rng_np.standard_normal((16, 32))).astype(np.float32)
+        _, gt = brute_force.knn(None, x, q, 10)
+
+        idx = ivf_flat.build(None, IvfFlatIndexParams(n_lists=32),
+                             jnp.asarray(x, jnp.bfloat16))
+        assert idx.data.dtype == jnp.bfloat16
+        _, i = ivf_flat.search(None, IvfFlatSearchParams(n_probes=16),
+                               idx, q, 10)
+        r, _, _ = eval_recall(np.asarray(gt), np.asarray(i))
+        assert r >= 0.95, r

@@ -316,6 +316,110 @@ def _fused_l2_nn(size: str):
             n * d * 4, 2 * n * c * d, f"{n}x{c}x{d} f32")
 
 
+@_register("norm_rows")
+def _norm_rows(size: str):
+    """Row L2 norms (``cpp/bench/prims/linalg`` norm family)."""
+    from raft_tpu.linalg import L2Norm, norm
+
+    n, d = _dims(size, (1 << 13, 128), (1 << 18, 128), (1 << 20, 128))
+    x = jax.random.normal(jax.random.key(6), (n, d), jnp.float32)
+    jax.block_until_ready(x)
+    jn = jax.jit(lambda v: norm(None, v, L2Norm))
+    return (lambda: jn(x), n * d * 4, 2 * n * d, f"{n}x{d} f32")
+
+
+@_register("matrix_gather")
+def _matrix_gather(size: str):
+    """Row gather (``cpp/bench/prims/matrix/gather.cu``) — the op whose
+    TPU scalar-core lowering motivated the gather-free redesigns."""
+    n, m, d = _dims(size, (1 << 13, 1 << 10, 128), (1 << 18, 1 << 15, 128),
+                    (1 << 20, 1 << 17, 128))
+    from raft_tpu.matrix import gather
+
+    kx, ki = jax.random.split(jax.random.key(7))
+    x = jax.random.normal(kx, (n, d), jnp.float32)
+    idx = jax.random.randint(ki, (m,), 0, n, jnp.int32)
+    jax.block_until_ready((x, idx))
+    jg = jax.jit(gather)
+    return (lambda: jg(x, idx), m * d * 4, 0, f"{m} of {n}x{d}")
+
+
+@_register("rng_normal")
+def _rng_normal(size: str):
+    """RNG throughput (``cpp/bench/prims/random``)."""
+    from raft_tpu.random import RngState, normal
+
+    n, d = _dims(size, (1 << 13, 128), (1 << 18, 128), (1 << 20, 128))
+    jr = jax.jit(lambda: normal(RngState(0), (n, d)))
+    return (lambda: jr(), n * d * 4, 0, f"{n}x{d} f32")
+
+
+@_register("permute")
+def _permute(size: str):
+    from raft_tpu.random import RngState, permute
+
+    n, _ = _dims(size, (1 << 16, 0), (1 << 20, 0), (1 << 22, 0))
+    jp = jax.jit(lambda: permute(RngState(1), n))
+    return (lambda: jp(), n * 4, 0, f"perm of {n}")
+
+
+@_register("bitset_test")
+def _bitset_test(size: str):
+    """core bitset test throughput (``cpp/bench/prims/core/bitset``)."""
+    from raft_tpu.core.bitset import Bitset, test_words
+
+    n, m = _dims(size, (1 << 16, 1 << 13), (1 << 22, 1 << 18),
+                 (1 << 24, 1 << 20))
+    bs = Bitset.from_mask(jnp.ones((n,), bool))
+    idx = jax.random.randint(jax.random.key(9), (m,), 0, n, jnp.int32)
+    jax.block_until_ready((bs.words, idx))
+    jt = jax.jit(test_words)
+    # bytes: a 4-byte index read + a 4-byte gathered word per test
+    return (lambda: jt(bs.words, idx), m * 8, 0, f"{m} tests of {n} bits")
+
+
+@_register("sparse_spmm")
+def _sparse_spmm(size: str):
+    """CSR x dense (``cpp/bench/prims/sparse``)."""
+    import scipy.sparse as sps
+
+    from raft_tpu.sparse import CSR
+    from raft_tpu.sparse.linalg import spmm
+
+    n, d, nnz_per = _dims(size, (1 << 10, 64, 16), (1 << 14, 128, 32),
+                          (1 << 16, 128, 32))
+    rng = np.random.default_rng(10)
+    rows = np.repeat(np.arange(n), nnz_per)
+    cols = rng.integers(0, n, n * nnz_per)
+    vals = rng.standard_normal(n * nnz_per).astype(np.float32)
+    csr = CSR.from_scipy(sps.csr_matrix((vals, (rows, cols)), shape=(n, n)))
+    dense = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+    jax.block_until_ready(dense)
+    js = jax.jit(lambda mat: spmm(csr, mat))
+    return (lambda: js(dense), n * nnz_per * 8 + n * d * 4,
+            2 * n * nnz_per * d, f"{n}x{n} nnz/row={nnz_per} x {n}x{d}")
+
+
+@_register("ivf_flat_search")
+def _ivf_flat_search(size: str):
+    """End-to-end IVF-Flat probe scan (``cpp/bench/prims/neighbors``)."""
+    from raft_tpu.neighbors import ivf_flat
+
+    n, d, q, p = _dims(size, (1 << 13, 64, 32, 8), (1 << 17, 128, 100, 32),
+                       (1 << 20, 128, 100, 32))
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    idx = ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(
+        n_lists=max(32, n // 256)), x)
+    qs = jnp.asarray(rng.standard_normal((q, d)), jnp.float32)
+    jax.block_until_ready((idx.data, qs))
+    sp = ivf_flat.IvfFlatSearchParams(n_probes=p)
+    avg_m = idx.max_list_size
+    return (lambda: ivf_flat.search(None, sp, idx, qs, 10),
+            q * p * avg_m * d * 4, 2 * q * p * avg_m * d,
+            f"{n}x{d} p={p} q={q}")
+
+
 @_register("kmeans_iter")
 def _kmeans_iter(size: str):
     """One balanced-EM iteration: predict labels + recompute centers —

@@ -189,3 +189,31 @@ class TestTracingCapture:
         # a plugins/profile dir with at least one artifact appears
         found = list(tmp_path.rglob("*"))
         assert any(p.is_file() for p in found), found
+
+
+class TestInterop:
+    """Array-interop parity with pylibraft.common's cai/ai wrappers
+    (``common/cai_wrapper.py:21,43``): any ``__array_interface__`` /
+    dlpack producer — numpy, torch (cpu) — is accepted by the public
+    APIs without copies being forced on the caller."""
+
+    def test_torch_tensor_inputs(self):
+        torch = pytest.importorskip("torch")
+        import numpy as np
+
+        from raft_tpu.neighbors import brute_force
+
+        t = torch.randn(64, 8, dtype=torch.float32)
+        q = t[:4]
+        d, i = brute_force.knn(None, t, q, 3)
+        assert np.asarray(i)[:, 0].tolist() == [0, 1, 2, 3]
+
+    def test_numpy_and_jax_mixed(self):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from raft_tpu.distance import pairwise_distance
+
+        x = np.random.default_rng(0).standard_normal((8, 4)).astype(np.float32)
+        out = pairwise_distance(None, x, jnp.asarray(x))
+        assert np.allclose(np.asarray(out).diagonal(), 0.0, atol=1e-4)

@@ -1,0 +1,174 @@
+"""Checkpoint / resume for sharded indexes — the MNMG analog of the
+per-index ``serialize``/``deserialize`` the reference only offers
+single-GPU (``detail/ivf_flat_serialize.cuh:37``,
+``detail/ivf_pq_serialize.cuh:39``; raft-dask has no distributed index
+persistence — SURVEY.md §5 "Checkpoint / resume").
+
+Format: the same versioned ``.npy``-record stream the single-chip
+indexes use, with the arrays written in their global (dealt) list
+order. ``load`` takes a ``Comms`` and RE-DEALS the lists round-robin
+by population for the target mesh (the same balancing ``build`` does)
+before block-sharding them, so the shard count may differ between save
+and load — a checkpoint taken on an 8-chip mesh restores onto 4 or 16
+with per-chip scan balance (and ``probe_mode='local'`` spread)
+preserved.
+
+Single-controller scope: arrays are gathered to the host process for
+writing (``jax.device_get``), which requires them to be fully
+addressable — true in single-process multi-device deployments. On
+multi-host meshes, gather-to-host0 or a per-process scheme (e.g.
+orbax) is needed; this module raises a clear error in that case
+rather than writing a partial file.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from raft_tpu.comms.comms import Comms
+from raft_tpu.core import tracing
+from raft_tpu.core.serialize import (
+    check_version,
+    deserialize_array,
+    deserialize_scalar,
+    open_maybe_path,
+    serialize_array,
+    serialize_scalar,
+)
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.distributed.ivf import DistributedIvfFlat, DistributedIvfPq
+from raft_tpu.neighbors.ivf_pq import CodebookKind
+
+_FLAT_VERSION = 1
+_PQ_VERSION = 1
+
+
+def _fetch(a) -> np.ndarray:
+    expect(a.is_fully_addressable,
+           "distributed checkpointing requires fully addressable arrays "
+           "(single-controller); use a per-process scheme on multi-host "
+           "meshes")
+    return np.asarray(jax.device_get(a))
+
+
+def save_flat(index: DistributedIvfFlat, fh_or_path) -> None:
+    """Write a sharded IVF-Flat index; list order is the dealt order."""
+    fh, own = open_maybe_path(fh_or_path, "wb")
+    try:
+        with tracing.range("raft_tpu.distributed.checkpoint.save_flat"):
+            serialize_scalar(fh, _FLAT_VERSION, np.int32)
+            serialize_scalar(fh, int(index.metric), np.int32)
+            serialize_array(fh, _fetch(index.centers))
+            serialize_array(fh, _fetch(index.data))
+            serialize_array(fh, _fetch(index.data_norms))
+            serialize_array(fh, _fetch(index.indices))
+            serialize_array(fh, _fetch(index.list_sizes))
+    finally:
+        if own:
+            fh.close()
+
+
+def _deal_order(sizes: np.ndarray, r: int) -> np.ndarray:
+    """Round-robin deal by descending population (the layout ``build``
+    produces): shard s gets every r-th list of the size-sorted order,
+    so per-shard scan work and list relevance stay balanced at any r."""
+    order = np.argsort(-sizes, kind="stable")
+    return np.concatenate([order[s::r] for s in range(r)])
+
+
+def load_flat(res, comms: Comms, fh_or_path) -> DistributedIvfFlat:
+    """Restore onto ``comms``'s mesh. The shard count may differ from
+    save time; the mesh-axis size must divide ``n_lists``."""
+    fh, own = open_maybe_path(fh_or_path, "rb")
+    try:
+        check_version(deserialize_scalar(fh), _FLAT_VERSION,
+                      "distributed ivf_flat")
+        metric = DistanceType(int(deserialize_scalar(fh)))
+        arrays = [deserialize_array(fh) for _ in range(5)]
+    finally:
+        if own:
+            fh.close()
+    centers, data, norms, indices, sizes = arrays
+    expect(centers.shape[0] % comms.size == 0,
+           f"the mesh axis ({comms.size}) must divide n_lists "
+           f"{centers.shape[0]}")
+    shard = comms.sharding(comms.axis)
+    deal = _deal_order(np.asarray(sizes), comms.size)
+
+    def place(a):
+        # host-side permute + direct sharded device_put: each shard
+        # transfers straight from host, never materializing the global
+        # array on one device (the at-scale case this module serves)
+        return jax.device_put(np.ascontiguousarray(a[deal]), shard)
+
+    return DistributedIvfFlat(
+        comms=comms,
+        centers=place(centers),
+        data=place(data),
+        data_norms=place(norms),
+        indices=place(indices),
+        list_sizes=place(sizes),
+        metric=metric,
+    )
+
+
+def save_pq(index: DistributedIvfPq, fh_or_path) -> None:
+    """Write a sharded IVF-PQ index (codes always in unpacked layout —
+    the distributed scan's working format)."""
+    fh, own = open_maybe_path(fh_or_path, "wb")
+    try:
+        with tracing.range("raft_tpu.distributed.checkpoint.save_pq"):
+            serialize_scalar(fh, _PQ_VERSION, np.int32)
+            serialize_scalar(fh, int(index.metric), np.int32)
+            serialize_scalar(fh, int(index.codebook_kind), np.int32)
+            serialize_scalar(fh, index.pq_bits, np.int32)
+            serialize_array(fh, _fetch(index.centers))
+            serialize_array(fh, _fetch(index.rotation))
+            serialize_array(fh, _fetch(index.codebooks))
+            serialize_array(fh, _fetch(index.codes))
+            serialize_array(fh, _fetch(index.indices))
+            serialize_array(fh, _fetch(index.list_sizes))
+    finally:
+        if own:
+            fh.close()
+
+
+def load_pq(res, comms: Comms, fh_or_path) -> DistributedIvfPq:
+    fh, own = open_maybe_path(fh_or_path, "rb")
+    try:
+        check_version(deserialize_scalar(fh), _PQ_VERSION,
+                      "distributed ivf_pq")
+        metric = DistanceType(int(deserialize_scalar(fh)))
+        kind = CodebookKind(int(deserialize_scalar(fh)))
+        pq_bits = int(deserialize_scalar(fh))
+        arrays = [deserialize_array(fh) for _ in range(6)]
+    finally:
+        if own:
+            fh.close()
+    centers, rotation, codebooks, codes, indices, sizes = arrays
+    expect(centers.shape[0] % comms.size == 0,
+           f"the mesh axis ({comms.size}) must divide n_lists "
+           f"{centers.shape[0]}")
+    shard = comms.sharding(comms.axis)
+    rep = comms.replicated()
+    deal = _deal_order(np.asarray(sizes), comms.size)
+
+    def place(a):
+        return jax.device_put(np.ascontiguousarray(a[deal]), shard)
+
+    per_cluster = kind == CodebookKind.PER_CLUSTER
+    return DistributedIvfPq(
+        comms=comms,
+        centers=place(centers),
+        rotation=jax.device_put(np.asarray(rotation), rep),
+        codebooks=(place(codebooks) if per_cluster
+                   else jax.device_put(np.asarray(codebooks), rep)),
+        codes=place(codes),
+        indices=place(indices),
+        list_sizes=place(sizes),
+        metric=metric,
+        pq_bits=pq_bits,
+        codebook_kind=kind,
+    )

@@ -38,11 +38,17 @@ from raft_tpu.core.serialize import (
 )
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType
-from raft_tpu.distributed.ivf import DistributedIvfFlat, DistributedIvfPq
+from raft_tpu.distributed.ivf import (
+    DistributedIvfFlat,
+    DistributedIvfPq,
+    deal_order,
+)
 from raft_tpu.neighbors.ivf_pq import CodebookKind
 
-_FLAT_VERSION = 1
-_PQ_VERSION = 1
+# distinct magic+version per kind so loading the wrong file kind fails
+# with a clear version mismatch instead of a shape error mid-parse
+_FLAT_VERSION = 0x4601  # 'F' << 8 | 1
+_PQ_VERSION = 0x5001    # 'P' << 8 | 1
 
 
 def _fetch(a) -> np.ndarray:
@@ -70,14 +76,6 @@ def save_flat(index: DistributedIvfFlat, fh_or_path) -> None:
             fh.close()
 
 
-def _deal_order(sizes: np.ndarray, r: int) -> np.ndarray:
-    """Round-robin deal by descending population (the layout ``build``
-    produces): shard s gets every r-th list of the size-sorted order,
-    so per-shard scan work and list relevance stay balanced at any r."""
-    order = np.argsort(-sizes, kind="stable")
-    return np.concatenate([order[s::r] for s in range(r)])
-
-
 def load_flat(res, comms: Comms, fh_or_path) -> DistributedIvfFlat:
     """Restore onto ``comms``'s mesh. The shard count may differ from
     save time; the mesh-axis size must divide ``n_lists``."""
@@ -95,7 +93,7 @@ def load_flat(res, comms: Comms, fh_or_path) -> DistributedIvfFlat:
            f"the mesh axis ({comms.size}) must divide n_lists "
            f"{centers.shape[0]}")
     shard = comms.sharding(comms.axis)
-    deal = _deal_order(np.asarray(sizes), comms.size)
+    deal = deal_order(np.asarray(sizes), comms.size)
 
     def place(a):
         # host-side permute + direct sharded device_put: each shard
@@ -153,7 +151,7 @@ def load_pq(res, comms: Comms, fh_or_path) -> DistributedIvfPq:
            f"{centers.shape[0]}")
     shard = comms.sharding(comms.axis)
     rep = comms.replicated()
-    deal = _deal_order(np.asarray(sizes), comms.size)
+    deal = deal_order(np.asarray(sizes), comms.size)
 
     def place(a):
         return jax.device_put(np.ascontiguousarray(a[deal]), shard)

@@ -86,6 +86,15 @@ class DistributedIvfFlat:
         return int(jax.device_get(self.list_sizes).sum())
 
 
+def deal_order(sizes: np.ndarray, r: int) -> np.ndarray:
+    """Round-robin deal by descending population — THE list-to-shard
+    layout policy, shared by build, build_pq and checkpoint restore:
+    shard s gets every r-th list of the size-sorted order, so per-shard
+    scan work and list relevance stay balanced at any shard count."""
+    order = np.argsort(-np.asarray(sizes), kind="stable")
+    return np.concatenate([order[s::r] for s in range(r)])
+
+
 def build(
     res: Optional[Resources],
     comms: Comms,
@@ -106,14 +115,10 @@ def build(
         # single-chip build (global quantizer + packed lists), then deal
         index = ivf_flat_mod.build(res, params, dataset)
 
-        # order lists by size so the round-robin deal balances both the
-        # populated-list count and the scan work per shard
+        # blocked layout wants shard-contiguous rows: permute to
+        # [shard0 lists..., shard1 lists...] per the shared deal policy
         sizes = np.asarray(jax.device_get(index.list_sizes))
-        order = np.argsort(-sizes, kind="stable")
-        # shard s gets order[s], order[s+r], ... — blocked layout wants
-        # shard-contiguous rows, so permute to [shard0 lists..., shard1...]
-        deal = np.concatenate([order[s::r] for s in range(r)])
-        perm = jnp.asarray(deal, jnp.int32)
+        perm = jnp.asarray(deal_order(sizes, r), jnp.int32)
 
         shard = comms.sharding(comms.axis)              # P(axis) on dim 0
         def place(a):
@@ -440,9 +445,7 @@ def build_pq(
             index = dataclasses.replace(index, codes=codes, packed=False)
 
         sizes = np.asarray(jax.device_get(index.list_sizes))
-        order = np.argsort(-sizes, kind="stable")
-        deal = np.concatenate([order[s::r] for s in range(r)])
-        perm = jnp.asarray(deal, jnp.int32)
+        perm = jnp.asarray(deal_order(sizes, r), jnp.int32)
 
         shard = comms.sharding(comms.axis)
         def place(a):

@@ -120,6 +120,30 @@ class TestClusterJoin:
 
 
 class TestCagraOptimize:
+    def test_detour_counts_match_naive(self):
+        """The sort+binary-search counting equals the O(k^3) definition:
+        count[i, r] = #{l < r : g[i, r] in graph[g[i, l]]}."""
+        import jax.numpy as jnp
+
+        from raft_tpu.neighbors.cagra import _detour_counts
+
+        rng = np.random.default_rng(3)
+        n, k = 60, 9
+        g = rng.integers(0, n, (n, k)).astype(np.int32)
+        g[rng.random((n, k)) < 0.15] = -1          # some invalid edges
+        want = np.zeros((n, k), np.int32)
+        for i in range(n):
+            for r in range(k):
+                if g[i, r] < 0:
+                    continue
+                for ell in range(r):
+                    if g[i, ell] >= 0 and g[i, r] in g[g[i, ell]]:
+                        want[i, r] += 1
+        for method in ("search", "compare"):
+            got = np.asarray(_detour_counts(jnp.asarray(g), tile=16,
+                                            method=method))
+            np.testing.assert_array_equal(got, want, err_msg=method)
+
     def test_degree_and_validity(self, dataset):
         x, _ = dataset
         params = NNDescentParams(graph_degree=32, intermediate_graph_degree=48,

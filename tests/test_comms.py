@@ -488,3 +488,18 @@ class TestDistributedCheckpoint:
         np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
         np.testing.assert_allclose(np.asarray(d0), np.asarray(d1),
                                    rtol=1e-5, atol=1e-5)
+
+    def test_wrong_kind_fails_clearly(self, rng_np, tmp_path):
+        """Loading a PQ checkpoint with the flat loader (or vice versa)
+        raises a version mismatch, not a shape error mid-parse."""
+        from raft_tpu.distributed import checkpoint, ivf_flat as divf
+        from raft_tpu.neighbors.ivf_pq import IvfPqIndexParams
+
+        comms = local_comms()
+        x = rng_np.standard_normal((2048, 32)).astype(np.float32)
+        idx = divf.build_pq(None, comms,
+                            IvfPqIndexParams(n_lists=16, pq_dim=16), x)
+        path = tmp_path / "pq.bin"
+        checkpoint.save_pq(idx, path)
+        with pytest.raises(ValueError, match="version mismatch"):
+            checkpoint.load_flat(None, comms, path)

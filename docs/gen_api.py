@@ -20,7 +20,8 @@ MODULES = [
     "raft_tpu.cluster.kmeans", "raft_tpu.cluster.kmeans_balanced",
     "raft_tpu.cluster.single_linkage", "raft_tpu.spectral", "raft_tpu.solver",
     "raft_tpu.neighbors.brute_force", "raft_tpu.neighbors.ivf_flat",
-    "raft_tpu.neighbors.ivf_pq", "raft_tpu.neighbors.cagra",
+    "raft_tpu.neighbors.ivf_pq", "raft_tpu.neighbors.ivf_bq",
+    "raft_tpu.neighbors.cagra",
     "raft_tpu.neighbors.nn_descent", "raft_tpu.neighbors.cluster_join",
     "raft_tpu.neighbors.refine",
     "raft_tpu.neighbors.ball_cover", "raft_tpu.neighbors.epsilon_neighborhood",

@@ -71,17 +71,44 @@ def _ivf_pq_build(base, metric, *, n_lists=1024, pq_dim=0, pq_bits=8,
             "metric": metric}
 
 
-def _ivf_pq_search(bundle, queries, k, *, n_probes=32, refine_ratio=1.0,
-                   **params):
-    from raft_tpu.neighbors import ivf_pq, refine
+def _search_with_refine(search_fn, bundle, queries, k, params,
+                        refine_ratio):
+    """Shared over-fetch + exact re-rank wrapper (the reference bench
+    wrappers' refine_ratio semantics), used by the PQ and BQ entries."""
+    from raft_tpu.neighbors import refine
 
-    p = ivf_pq.IvfPqSearchParams(n_probes=n_probes, **params)
     if refine_ratio > 1.0:
         k0 = max(k, int(k * refine_ratio))
-        _, cand = ivf_pq.search(None, p, bundle["index"], queries, k0)
+        _, cand = search_fn(None, params, bundle["index"], queries, k0)
         return refine(None, bundle["base"], queries, cand, k,
                       bundle["metric"])
-    return ivf_pq.search(None, p, bundle["index"], queries, k)
+    return search_fn(None, params, bundle["index"], queries, k)
+
+
+def _ivf_pq_search(bundle, queries, k, *, n_probes=32, refine_ratio=1.0,
+                   **params):
+    from raft_tpu.neighbors import ivf_pq
+
+    p = ivf_pq.IvfPqSearchParams(n_probes=n_probes, **params)
+    return _search_with_refine(ivf_pq.search, bundle, queries, k, p,
+                               refine_ratio)
+
+
+def _ivf_bq_build(base, metric, *, n_lists=1024, **params):
+    from raft_tpu.neighbors import ivf_bq
+
+    p = ivf_bq.IvfBqIndexParams(n_lists=n_lists, metric=metric, **params)
+    return {"index": ivf_bq.build(None, p, base), "base": base,
+            "metric": metric}
+
+
+def _ivf_bq_search(bundle, queries, k, *, n_probes=32, refine_ratio=4.0,
+                   **params):
+    from raft_tpu.neighbors import ivf_bq
+
+    p = ivf_bq.IvfBqSearchParams(n_probes=n_probes, **params)
+    return _search_with_refine(ivf_bq.search, bundle, queries, k, p,
+                               refine_ratio)
 
 
 def _cagra_build(base, metric, *, graph_degree=64,
@@ -126,6 +153,7 @@ ALGO_REGISTRY: Dict[str, AlgoWrapper] = {
     "raft_ivf_flat": AlgoWrapper("raft_ivf_flat",
                                  _ivf_flat_build, _ivf_flat_search),
     "raft_ivf_pq": AlgoWrapper("raft_ivf_pq", _ivf_pq_build, _ivf_pq_search),
+    "raft_ivf_bq": AlgoWrapper("raft_ivf_bq", _ivf_bq_build, _ivf_bq_search),
     "raft_cagra": AlgoWrapper("raft_cagra", _cagra_build, _cagra_search),
     "raft_quantized": AlgoWrapper("raft_quantized",
                                   _quantized_build, _quantized_search),

@@ -6,6 +6,7 @@ from raft_tpu.neighbors import brute_force
 from raft_tpu.neighbors import cagra
 from raft_tpu.neighbors import cluster_join
 from raft_tpu.neighbors import epsilon_neighborhood
+from raft_tpu.neighbors import ivf_bq
 from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors import ivf_pq
 from raft_tpu.neighbors import nn_descent
@@ -23,6 +24,7 @@ __all__ = [
     "cluster_join",
     "epsilon_neighborhood",
     "eps_neighbors",
+    "ivf_bq",
     "ivf_flat",
     "ivf_pq",
     "nn_descent",

@@ -1,0 +1,53 @@
+"""Shared padded-list packing — THE sort-and-rank scatter used by every
+IVF index type (role of the reference's per-list packing,
+``detail/ivf_flat_build.cuh:161`` extend; dense re-design per
+SURVEY.md §7.4: ragged ``ivf::list`` → one padded tensor).
+
+Stable-sort rows by label, compute each row's rank within its list,
+scatter into ``label * max_size + rank`` slots.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def pack_padded_lists(
+    labels,
+    n_lists: int,
+    max_size: int,
+    payloads: Sequence[Tuple[object, object]],
+):
+    """Scatter per-row payloads into padded ``[n_lists, max_size]``
+    layouts.
+
+    Args:
+      labels: (n,) int list assignment per row.
+      payloads: sequence of ``(array, fill)`` — each array is (n, ...)
+        and lands in a ``(n_lists, max_size, ...)`` output initialized
+        to ``fill``.
+
+    Returns ``([packed...], sizes)`` with sizes (n_lists,) int32.
+    """
+    labels = jnp.asarray(labels, jnp.int32)
+    n = labels.shape[0]
+    order = jnp.argsort(labels, stable=True)
+    sorted_labels = labels[order]
+    first = jnp.searchsorted(sorted_labels, jnp.arange(n_lists),
+                             side="left")
+    rank = jnp.arange(n) - first[sorted_labels]
+    slot = sorted_labels * max_size + rank
+
+    outs = []
+    for arr, fill in payloads:
+        arr = jnp.asarray(arr)
+        flat = jnp.full((n_lists * max_size,) + arr.shape[1:], fill,
+                        arr.dtype)
+        flat = flat.at[slot].set(arr[order])
+        outs.append(flat.reshape((n_lists, max_size) + arr.shape[1:]))
+    sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels,
+                                num_segments=n_lists)
+    return outs, sizes

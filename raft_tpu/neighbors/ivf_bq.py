@@ -1,0 +1,397 @@
+"""IVF-BQ — inverted file with 1-bit (binary) quantization, a TPU-first
+index with no reference analog (closest: ``ivf_pq`` with its smallest
+codebooks; the quantizer follows the RaBitQ line of work — sign codes
+under a random rotation with per-vector scalar correction, arXiv
+2405.12497 / the IVF-RaBitQ build in PAPERS.md).
+
+Why this exists on TPU: PQ scoring needs per-code LUT lookups — gathers
+(scalar-core serialized) or one-hot/masked-sum workarounds (J-fold FLOP
+inflation). A sign code has no lookup at all:
+
+    x ≈ c + Rᵀ(a · s),   s = sign(R(x − c)) ∈ {−1, +1}^D
+
+    ||q − x||² ≈ ||q − c||² − 2·a·(q̃ · s) + ||r||²,   q̃ = R(q − c)
+
+so scoring a whole probed list is ONE MXU GEMM of the rotated query
+against the ±1 code matrix (exact in bf16), plus two precomputed
+per-vector scalars (the least-squares scale ``a`` and the true residual
+norm ``||r||²``). Code storage is D bits/vector (16 B at D=128 — the
+same as pq_dim=64 @ 4 bits), unpacked to ±1 in VMEM right after the
+HBM gather. The estimator is coarse at 1 bit/dim; pair with
+:func:`raft_tpu.neighbors.refine` re-ranking (3-5x over-fetch) the way
+the reference pairs IVF-PQ with refinement.
+
+Supported metrics: L2Expanded / L2SqrtExpanded / InnerProduct.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.serialize import (
+    check_version,
+    deserialize_array,
+    deserialize_scalar,
+    open_maybe_path,
+    serialize_array,
+    serialize_scalar,
+)
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType, is_min_close
+from raft_tpu.matrix.select_k import merge_topk
+from raft_tpu.neighbors._batching import tile_queries
+from raft_tpu.neighbors._packing import pack_padded_lists
+from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
+from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
+from raft_tpu.neighbors.ivf_pq import make_rotation_matrix
+
+_SERIALIZATION_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class IvfBqIndexParams(IndexParams):
+    n_lists: int = 1024
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+
+
+@dataclasses.dataclass(frozen=True)
+class IvfBqSearchParams(SearchParams):
+    n_probes: int = 20
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass(frozen=True)
+class IvfBqIndex:
+    """Binary-quantized IVF index."""
+
+    centers: jax.Array        # (n_lists, dim) f32
+    rotation: jax.Array       # (dim_ext, dim) f32 random orthogonal
+    codes: jax.Array          # (n_lists, max_list_size, dim_ext//8) u8
+    scales: jax.Array         # (n_lists, max_list_size) f32 — LS scale a
+    rnorm2: jax.Array         # (n_lists, max_list_size) f32 — ||r||²
+    indices: jax.Array        # (n_lists, max_list_size) int32, -1 pad
+    list_sizes: jax.Array     # (n_lists,) int32
+    metric: DistanceType
+
+    def tree_flatten(self):
+        return (self.centers, self.rotation, self.codes, self.scales,
+                self.rnorm2, self.indices, self.list_sizes), (self.metric,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, metric=aux[0])
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def dim_ext(self) -> int:
+        return self.rotation.shape[0]
+
+    @property
+    def max_list_size(self) -> int:
+        return self.codes.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(self.list_sizes.sum())
+
+
+def _pack_bits(signs):
+    """(..., dim_ext) bool (sign >= 0) → (..., dim_ext // 8) uint8,
+    bit b of byte k = component 8k + b."""
+    b = signs.reshape(*signs.shape[:-1], -1, 8).astype(jnp.uint8)
+    weights = (1 << jnp.arange(8, dtype=jnp.uint8))
+    return jnp.sum(b * weights, axis=-1, dtype=jnp.uint8)
+
+
+def _unpack_pm1(bytes_, dtype=jnp.bfloat16):
+    """(..., n_bytes) uint8 → (..., 8·n_bytes) ±1 in ``dtype``."""
+    bits = (bytes_[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    pm1 = bits.astype(dtype) * 2 - 1
+    return pm1.reshape(*bytes_.shape[:-1], bytes_.shape[-1] * 8)
+
+
+def _encode(rot_residuals):
+    """residual r → (packed sign bits, scale a, ||r||²).
+
+    The scale is the collinearity-corrected ``a = ||r||² / ⟨r, s⟩``
+    (the RaBitQ estimator choice) rather than the least-squares
+    ``⟨r, s⟩/D``: it makes ⟨a·s, r⟩ = ||r||² exact, so the distance
+    estimate of a vector to ITSELF is exactly 0 — self-hits and
+    near-duplicates rank correctly, where the LS scale biases them
+    ~0.7·||r||² away."""
+    signs = rot_residuals >= 0
+    codes = _pack_bits(signs)
+    dot_rs = jnp.sum(jnp.abs(rot_residuals), axis=-1)   # ⟨r, sign(r)⟩
+    rn2 = jnp.sum(jnp.square(rot_residuals), axis=-1)
+    a = rn2 / jnp.maximum(dot_rs, 1e-20)
+    return codes, a.astype(jnp.float32), rn2.astype(jnp.float32)
+
+
+def _pack_lists(codes, scales, rn2, ids, labels, n_lists, max_size):
+    """Scatter rows into the padded [n_lists, max_list_size] layout
+    (the shared sort-and-rank packing)."""
+    (fc, fa, fr, fi), sizes = pack_padded_lists(
+        labels, n_lists, max_size,
+        [(codes, 0), (scales, 0.0), (rn2, 0.0), (ids, -1)])
+    return fc, fa, fr, fi, sizes
+
+
+def build(
+    res: Optional[Resources],
+    params: IvfBqIndexParams,
+    dataset,
+) -> IvfBqIndex:
+    """Train coarse centers + random rotation, sign-encode the dataset."""
+    res = ensure_resources(res)
+    dataset = jnp.asarray(dataset)
+    expect(dataset.ndim == 2, "dataset must be (n, d)")
+    n, dim = dataset.shape
+    expect(params.n_lists <= n, "n_lists > n_rows")
+    expect(params.metric in (DistanceType.L2Expanded,
+                             DistanceType.L2SqrtExpanded,
+                             DistanceType.InnerProduct),
+           f"ivf_bq supports L2/L2Sqrt/InnerProduct, got {params.metric!r}")
+    dim_ext = -(-dim // 8) * 8
+
+    with tracing.range("raft_tpu.ivf_bq.build"):
+        frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+        n_train = min(n, max(params.n_lists * 2, int(n * frac)))
+        stride = max(1, n // n_train)
+        trainset = dataset[::stride][:n_train].astype(jnp.float32)
+        km = KMeansBalancedParams(
+            n_iters=params.kmeans_n_iters,
+            metric=(DistanceType.InnerProduct
+                    if params.metric == DistanceType.InnerProduct
+                    else DistanceType.L2Expanded),
+            seed=res.seed,
+        )
+        centers = kmeans_balanced.fit(res, km, trainset, params.n_lists)
+        # the random rotation is what makes sign codes informative —
+        # always random, never identity
+        rotation = make_rotation_matrix(
+            jax.random.fold_in(jax.random.key(res.seed), 13),
+            dim_ext, dim, True)
+
+        empty = IvfBqIndex(
+            centers=centers, rotation=rotation,
+            codes=jnp.zeros((params.n_lists, 0, dim_ext // 8), jnp.uint8),
+            scales=jnp.zeros((params.n_lists, 0), jnp.float32),
+            rnorm2=jnp.zeros((params.n_lists, 0), jnp.float32),
+            indices=jnp.full((params.n_lists, 0), -1, jnp.int32),
+            list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
+            metric=DistanceType(params.metric),
+        )
+        if not params.add_data_on_build:
+            return empty
+        return extend(res, empty, dataset, jnp.arange(n, dtype=jnp.int32))
+
+
+def extend(
+    res: Optional[Resources],
+    index: IvfBqIndex,
+    new_vectors,
+    new_indices=None,
+) -> IvfBqIndex:
+    """Encode + add vectors (functional rebuild of the padded lists)."""
+    res = ensure_resources(res)
+    new_vectors = jnp.asarray(new_vectors)
+    expect(new_vectors.ndim == 2 and new_vectors.shape[1] == index.dim,
+           "new_vectors must be (n, dim)")
+    n_new = new_vectors.shape[0]
+    if new_indices is None:
+        start = index.size
+        new_indices = jnp.arange(start, start + n_new, dtype=jnp.int32)
+    else:
+        new_indices = jnp.asarray(new_indices, jnp.int32)
+
+    with tracing.range("raft_tpu.ivf_bq.extend"):
+        km = KMeansBalancedParams(
+            metric=(DistanceType.InnerProduct
+                    if index.metric == DistanceType.InnerProduct
+                    else DistanceType.L2Expanded))
+        labels = kmeans_balanced.predict(res, km, index.centers,
+                                         new_vectors.astype(jnp.float32))
+        resid = new_vectors.astype(jnp.float32) - index.centers[labels]
+        rot = resid @ index.rotation.T                   # (n, dim_ext)
+        codes, scales, rn2 = _encode(rot)
+
+        if index.max_list_size > 0:
+            keep = index.indices.reshape(-1) >= 0
+            old_labels = jnp.repeat(
+                jnp.arange(index.n_lists, dtype=jnp.int32),
+                index.max_list_size)
+            all_codes = jnp.concatenate(
+                [index.codes.reshape(-1, index.dim_ext // 8)[keep], codes])
+            all_scales = jnp.concatenate(
+                [index.scales.reshape(-1)[keep], scales])
+            all_rn2 = jnp.concatenate(
+                [index.rnorm2.reshape(-1)[keep], rn2])
+            all_ids = jnp.concatenate(
+                [index.indices.reshape(-1)[keep], new_indices])
+            all_labels = jnp.concatenate([old_labels[keep], labels])
+        else:
+            all_codes, all_scales, all_rn2 = codes, scales, rn2
+            all_ids, all_labels = new_indices, labels
+
+        sizes = jax.ops.segment_sum(
+            jnp.ones((all_codes.shape[0],), jnp.int32), all_labels,
+            num_segments=index.n_lists)
+        max_size = max(8, -(-int(jnp.max(sizes)) // 8) * 8)
+        c, a, r, i, s = _pack_lists(all_codes, all_scales, all_rn2,
+                                    all_ids, all_labels, index.n_lists,
+                                    max_size)
+        return dataclasses.replace(index, codes=c, scales=a, rnorm2=r,
+                                   indices=i, list_sizes=s)
+
+
+@partial(jax.jit, static_argnames=("n_probes", "k", "metric"))
+def _search_impl(queries, centers, rotation, codes, scales, rn2, indices,
+                 filter_words, n_probes: int, k: int, metric: DistanceType):
+    q, dim = queries.shape
+    select_min = is_min_close(metric)
+    qf = queries.astype(jnp.float32)
+    ip_metric = metric == DistanceType.InnerProduct
+
+    # coarse cluster selection (shared shape with ivf_flat/pq)
+    ip = jax.lax.dot_general(
+        qf, centers, (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    if ip_metric:
+        _, probes = jax.lax.top_k(ip, n_probes)
+        c_norms = None
+        qnorm = None
+    else:
+        c_norms = jnp.sum(jnp.square(centers), axis=1)
+        _, probes = jax.lax.top_k(-(c_norms[None, :] - 2.0 * ip), n_probes)
+        qnorm = jnp.sum(jnp.square(qf), axis=1)
+    probes = probes.astype(jnp.int32)
+    pad_val = jnp.inf if select_min else -jnp.inf
+
+    # probe-invariant precomputation: the rotated query never changes,
+    # and q̃ = R(q−c) = Rq − (Rc) needs only a rotated-centers table
+    qrot = qf @ rotation.T                             # (q, dim_ext)
+    centers_rot = None if ip_metric else centers @ rotation.T
+    qidx = jnp.arange(q)
+
+    def step(carry, rank):
+        best_d, best_i = carry
+        lists = probes[:, rank]                        # (q,)
+        byts = jnp.take(codes, lists, axis=0)          # (q, m, D/8) u8
+        pm1 = _unpack_pm1(byts)                        # (q, m, D) bf16 ±1
+        a = jnp.take(scales, lists, axis=0)            # (q, m)
+        row_ids = jnp.take(indices, lists, axis=0)     # (q, m)
+        if ip_metric:
+            # similarity (select_min is False for IP — no negation)
+            cross = jnp.einsum("qd,qmd->qm", qrot.astype(jnp.bfloat16),
+                               pm1, preferred_element_type=jnp.float32)
+            base = ip[qidx, lists]                     # q·c from coarse
+            dist = base[:, None] + a * cross
+        else:
+            qsub = qrot - centers_rot[lists]           # (q, dim_ext)
+            cross = jnp.einsum("qd,qmd->qm",
+                               qsub.astype(jnp.bfloat16), pm1,
+                               preferred_element_type=jnp.float32)
+            r2 = jnp.take(rn2, lists, axis=0)
+            # ||q−c||² from the coarse-stage terms (R is an isometry,
+            # so this equals Σ qsub² without re-reducing per probe)
+            qc2 = qnorm + c_norms[lists] - 2.0 * ip[qidx, lists]
+            dist = jnp.maximum(qc2, 0.0)[:, None] - 2.0 * a * cross + r2
+        dist = jnp.where(row_ids >= 0, dist, pad_val)
+        if filter_words is not None:
+            bits = test_filter(filter_words, row_ids)
+            dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
+        return merge_topk(best_d, best_i, dist, row_ids, k, select_min), None
+
+    init = (jnp.full((q, k), pad_val, jnp.float32),
+            jnp.full((q, k), -1, jnp.int32))
+    (best_d, best_i), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
+
+    if metric == DistanceType.L2SqrtExpanded:
+        best_d = jnp.where(jnp.isfinite(best_d),
+                           jnp.sqrt(jnp.maximum(best_d, 0.0)), best_d)
+    return best_d, best_i
+
+
+def search(
+    res: Optional[Resources],
+    params: IvfBqSearchParams,
+    index: IvfBqIndex,
+    queries,
+    k: int,
+    sample_filter=None,
+    query_tile: int = 4096,
+) -> Tuple[jax.Array, jax.Array]:
+    """ANN search over sign codes — estimated distances; re-rank with
+    :func:`raft_tpu.neighbors.refine` (fetch 3-5x k here) for high
+    recall, as with IVF-PQ."""
+    ensure_resources(res)
+    queries = jnp.asarray(queries)
+    expect(queries.ndim == 2 and queries.shape[1] == index.dim,
+           "queries must be (q, dim)")
+    expect(index.max_list_size > 0, "index is empty — extend() it first")
+    n_probes = min(params.n_probes, index.n_lists)
+    filter_words = resolve_filter_words(sample_filter)
+    with tracing.range("raft_tpu.ivf_bq.search"):
+        def run(qt, fw):
+            return _search_impl(
+                qt, index.centers, index.rotation, index.codes,
+                index.scales, index.rnorm2, index.indices, fw,
+                n_probes, k, index.metric)
+
+        return tile_queries(run, queries, filter_words, query_tile)
+
+
+def save(index: IvfBqIndex, fh_or_path) -> None:
+    fh, own = open_maybe_path(fh_or_path, "wb")
+    try:
+        serialize_scalar(fh, _SERIALIZATION_VERSION, np.int32)
+        serialize_scalar(fh, int(index.metric), np.int32)
+        serialize_array(fh, index.centers)
+        serialize_array(fh, index.rotation)
+        serialize_array(fh, index.codes)
+        serialize_array(fh, index.scales)
+        serialize_array(fh, index.rnorm2)
+        serialize_array(fh, index.indices)
+        serialize_array(fh, index.list_sizes)
+    finally:
+        if own:
+            fh.close()
+
+
+def load(res: Optional[Resources], fh_or_path) -> IvfBqIndex:
+    res = ensure_resources(res)
+    fh, own = open_maybe_path(fh_or_path, "rb")
+    try:
+        check_version(deserialize_scalar(fh), _SERIALIZATION_VERSION,
+                      "ivf_bq")
+        metric = DistanceType(int(deserialize_scalar(fh)))
+        arrays = [res.put(deserialize_array(fh)) for _ in range(7)]
+    finally:
+        if own:
+            fh.close()
+    centers, rotation, codes, scales, rn2, indices, sizes = map(
+        jnp.asarray, arrays)
+    return IvfBqIndex(
+        centers=centers, rotation=rotation, codes=codes, scales=scales,
+        rnorm2=rn2, indices=indices, list_sizes=sizes, metric=metric,
+    )

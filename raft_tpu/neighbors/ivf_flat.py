@@ -48,6 +48,7 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors._batching import tile_queries
+from raft_tpu.neighbors._packing import pack_padded_lists
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 
@@ -123,31 +124,12 @@ class IvfFlatIndex:
 
 
 def _pack_lists(dataset, ids, labels, n_lists: int, max_list_size: int):
-    """Scatter rows into the padded [n_lists, max_list_size] layout.
-
-    Dense formulation of the reference's per-list packing
-    (``detail/ivf_flat_build.cuh:161`` extend): stable-sort rows by label,
-    compute each row's rank within its list, scatter into flat slots.
-    """
-    n, d = dataset.shape
-    labels = labels.astype(jnp.int32)
-    order = jnp.argsort(labels, stable=True)
-    sorted_labels = labels[order]
-    # rank within list = position - first position of this label
-    first_pos = jnp.searchsorted(sorted_labels, jnp.arange(n_lists), side="left")
-    rank = jnp.arange(n) - first_pos[sorted_labels]
-    slot = sorted_labels * max_list_size + rank
-
-    flat_data = jnp.zeros((n_lists * max_list_size, d), dataset.dtype)
-    flat_idx = jnp.full((n_lists * max_list_size,), -1, jnp.int32)
-    flat_data = flat_data.at[slot].set(dataset[order])
-    flat_idx = flat_idx.at[slot].set(ids[order].astype(jnp.int32))
-
-    data = flat_data.reshape(n_lists, max_list_size, d)
-    indices = flat_idx.reshape(n_lists, max_list_size)
-    sizes = jax.ops.segment_sum(
-        jnp.ones((n,), jnp.int32), labels, num_segments=n_lists
-    )
+    """Scatter rows into the padded [n_lists, max_list_size] layout —
+    the shared sort-and-rank packing (dense formulation of the
+    reference's per-list packing, ``detail/ivf_flat_build.cuh:161``)."""
+    (data, indices), sizes = pack_padded_lists(
+        labels, n_lists, max_list_size,
+        [(dataset, 0), (jnp.asarray(ids, jnp.int32), -1)])
     # per-slot norms; +inf on padding so padded slots never win the top-k
     norms = jnp.sum(jnp.square(data.astype(jnp.float32)), axis=2)
     norms = jnp.where(indices >= 0, norms, jnp.inf)

@@ -55,6 +55,7 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors._batching import tile_queries
+from raft_tpu.neighbors._packing import pack_padded_lists
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 
@@ -272,23 +273,10 @@ def _unpack_nibbles(packed):
 
 def _pack_codes(codes, ids, labels, n_lists: int, max_list_size: int):
     """Scatter code rows into the padded [n_lists, max_list_size] layout
-    (same dense packing as ivf_flat)."""
-    n, pq_dim = codes.shape
-    labels = labels.astype(jnp.int32)
-    order = jnp.argsort(labels, stable=True)
-    sorted_labels = labels[order]
-    first_pos = jnp.searchsorted(sorted_labels, jnp.arange(n_lists), side="left")
-    rank = jnp.arange(n) - first_pos[sorted_labels]
-    slot = sorted_labels * max_list_size + rank
-
-    flat_codes = jnp.zeros((n_lists * max_list_size, pq_dim), jnp.uint8)
-    flat_idx = jnp.full((n_lists * max_list_size,), -1, jnp.int32)
-    flat_codes = flat_codes.at[slot].set(codes[order])
-    flat_idx = flat_idx.at[slot].set(ids[order])
-    sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels,
-                                num_segments=n_lists)
-    return (flat_codes.reshape(n_lists, max_list_size, pq_dim),
-            flat_idx.reshape(n_lists, max_list_size), sizes)
+    (the shared sort-and-rank packing)."""
+    (packed, indices), sizes = pack_padded_lists(
+        labels, n_lists, max_list_size, [(codes, 0), (ids, -1)])
+    return packed, indices, sizes
 
 
 # ---------------------------------------------------------------------------

@@ -15,11 +15,18 @@ import jax
 import jax.numpy as jnp
 
 
+def padded_extent(sizes) -> int:
+    """Shared max-list-size rounding: the largest list, rounded up to
+    the sublane multiple (8). One host sync per build/extend."""
+    return max(8, -(-int(jnp.max(jnp.asarray(sizes))) // 8) * 8)
+
+
 def pack_padded_lists(
     labels,
     n_lists: int,
     max_size: int,
     payloads: Sequence[Tuple[object, object]],
+    sizes=None,
 ):
     """Scatter per-row payloads into padded ``[n_lists, max_size]``
     layouts.
@@ -29,6 +36,9 @@ def pack_padded_lists(
       payloads: sequence of ``(array, fill)`` — each array is (n, ...)
         and lands in a ``(n_lists, max_size, ...)`` output initialized
         to ``fill``.
+      sizes: optional precomputed per-list populations (callers usually
+        have them already — they sized ``max_size`` from them); when
+        omitted they are recomputed here.
 
     Returns ``([packed...], sizes)`` with sizes (n_lists,) int32.
     """
@@ -48,6 +58,7 @@ def pack_padded_lists(
                         arr.dtype)
         flat = flat.at[slot].set(arr[order])
         outs.append(flat.reshape((n_lists, max_size) + arr.shape[1:]))
-    sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels,
-                                num_segments=n_lists)
-    return outs, sizes
+    if sizes is None:
+        sizes = jax.ops.segment_sum(jnp.ones((n,), jnp.int32), labels,
+                                    num_segments=n_lists)
+    return outs, jnp.asarray(sizes, jnp.int32)

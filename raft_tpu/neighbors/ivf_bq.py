@@ -50,7 +50,10 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors._batching import tile_queries
-from raft_tpu.neighbors._packing import pack_padded_lists
+from raft_tpu.neighbors._packing import (
+    pack_padded_lists,
+    padded_extent,
+)
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 from raft_tpu.neighbors.ivf_pq import make_rotation_matrix
@@ -145,12 +148,13 @@ def _encode(rot_residuals):
     return codes, a.astype(jnp.float32), rn2.astype(jnp.float32)
 
 
-def _pack_lists(codes, scales, rn2, ids, labels, n_lists, max_size):
+def _pack_lists(codes, scales, rn2, ids, labels, n_lists, max_size,
+                sizes=None):
     """Scatter rows into the padded [n_lists, max_list_size] layout
     (the shared sort-and-rank packing)."""
     (fc, fa, fr, fi), sizes = pack_padded_lists(
         labels, n_lists, max_size,
-        [(codes, 0), (scales, 0.0), (rn2, 0.0), (ids, -1)])
+        [(codes, 0), (scales, 0.0), (rn2, 0.0), (ids, -1)], sizes=sizes)
     return fc, fa, fr, fi, sizes
 
 
@@ -254,10 +258,10 @@ def extend(
         sizes = jax.ops.segment_sum(
             jnp.ones((all_codes.shape[0],), jnp.int32), all_labels,
             num_segments=index.n_lists)
-        max_size = max(8, -(-int(jnp.max(sizes)) // 8) * 8)
+        max_size = padded_extent(sizes)
         c, a, r, i, s = _pack_lists(all_codes, all_scales, all_rn2,
                                     all_ids, all_labels, index.n_lists,
-                                    max_size)
+                                    max_size, sizes=sizes)
         return dataclasses.replace(index, codes=c, scales=a, rnorm2=r,
                                    indices=i, list_sizes=s)
 

@@ -48,7 +48,10 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors._batching import tile_queries
-from raft_tpu.neighbors._packing import pack_padded_lists
+from raft_tpu.neighbors._packing import (
+    pack_padded_lists,
+    padded_extent,
+)
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 
@@ -123,13 +126,14 @@ class IvfFlatIndex:
 # ---------------------------------------------------------------------------
 
 
-def _pack_lists(dataset, ids, labels, n_lists: int, max_list_size: int):
+def _pack_lists(dataset, ids, labels, n_lists: int, max_list_size: int,
+                sizes=None):
     """Scatter rows into the padded [n_lists, max_list_size] layout —
     the shared sort-and-rank packing (dense formulation of the
     reference's per-list packing, ``detail/ivf_flat_build.cuh:161``)."""
     (data, indices), sizes = pack_padded_lists(
         labels, n_lists, max_list_size,
-        [(dataset, 0), (jnp.asarray(ids, jnp.int32), -1)])
+        [(dataset, 0), (jnp.asarray(ids, jnp.int32), -1)], sizes=sizes)
     # per-slot norms; +inf on padding so padded slots never win the top-k
     norms = jnp.sum(jnp.square(data.astype(jnp.float32)), axis=2)
     norms = jnp.where(indices >= 0, norms, jnp.inf)
@@ -237,11 +241,11 @@ def extend(
             num_segments=index.n_lists,
         )
         # one host sync at build/extend time to fix the padded extent
-        max_size = int(jnp.max(sizes))
-        max_size = max(8, -(-max_size // 8) * 8)  # round up to sublane multiple
+        max_size = padded_extent(sizes)
 
         data, norms, indices, sizes = _pack_lists(
-            all_vecs, all_ids, all_labels, index.n_lists, max_size
+            all_vecs, all_ids, all_labels, index.n_lists, max_size,
+            sizes=sizes,
         )
 
         centers = index.centers

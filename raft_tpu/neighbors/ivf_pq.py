@@ -55,7 +55,10 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors._batching import tile_queries
-from raft_tpu.neighbors._packing import pack_padded_lists
+from raft_tpu.neighbors._packing import (
+    pack_padded_lists,
+    padded_extent,
+)
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 
@@ -271,11 +274,13 @@ def _unpack_nibbles(packed):
     return stacked.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
 
 
-def _pack_codes(codes, ids, labels, n_lists: int, max_list_size: int):
+def _pack_codes(codes, ids, labels, n_lists: int, max_list_size: int,
+                sizes=None):
     """Scatter code rows into the padded [n_lists, max_list_size] layout
     (the shared sort-and-rank packing)."""
     (packed, indices), sizes = pack_padded_lists(
-        labels, n_lists, max_list_size, [(codes, 0), (ids, -1)])
+        labels, n_lists, max_list_size, [(codes, 0), (ids, -1)],
+        sizes=sizes)
     return packed, indices, sizes
 
 
@@ -514,10 +519,10 @@ def extend(
             jnp.ones((all_codes.shape[0],), jnp.int32), all_labels,
             num_segments=index.n_lists,
         )
-        max_size = int(jnp.max(sizes))
-        max_size = max(8, -(-max_size // 8) * 8)
+        max_size = padded_extent(sizes)
         codes, indices, sizes = _pack_codes(all_codes, all_ids, all_labels,
-                                            index.n_lists, max_size)
+                                            index.n_lists, max_size,
+                                            sizes=sizes)
         should_pack = index.pq_bits == 4 and index.pq_dim % 2 == 0
         if should_pack:
             codes = _pack_nibbles(codes)

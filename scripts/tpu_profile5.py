@@ -120,6 +120,27 @@ def main():
     emit("ivf_pq_b4_d128_p32", ms=round(dt * 1e3, 2),
          qps=round(100 / dt, 1), recall=round(float(r), 4))
 
+    # ---- 5. IVF-BQ: the pure-MXU 1-bit index vs the PQ paths
+    from raft_tpu.neighbors import ivf_bq
+    from raft_tpu.neighbors.refine import refine as refine_fn
+
+    bi = ivf_bq.build(None, ivf_bq.IvfBqIndexParams(n_lists=1024), x)
+    xd = jnp.asarray(x)
+
+    def bq_full(sp):
+        # the end-to-end pipeline BOTH the ms and the recall describe:
+        # estimate search (over-fetch 40) + exact refine to k=10
+        _, cand = ivf_bq.search(None, sp, bi, q, 40)
+        return refine_fn(None, xd, q, cand, 10)
+
+    for p in (32, 64):
+        sp = ivf_bq.IvfBqSearchParams(n_probes=p)
+        dt = wall(lambda sp=sp: bq_full(sp), iters=10)
+        _, i = bq_full(sp)
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        emit(f"ivf_bq_p{p}_refined", ms=round(dt * 1e3, 2),
+             qps=round(100 / dt, 1), recall=round(float(r), 4))
+
 
 if __name__ == "__main__":
     main()

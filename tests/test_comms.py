@@ -222,6 +222,36 @@ class TestShardedAnn:
         # merged distances ascending
         assert np.all(np.diff(np.asarray(d), axis=1) >= -1e-4)
 
+    def test_ivf_bq_shards(self, rng_np):
+        """The 1-bit index composes with the index-per-shard pattern
+        (shard-local over-fetch + global merge, then exact refine)."""
+        from raft_tpu.neighbors import ivf_bq
+        from raft_tpu.neighbors.refine import refine
+
+        centers = rng_np.standard_normal((10, 32)) * 5
+        x = (centers[rng_np.integers(0, 10, 4000)]
+             + rng_np.standard_normal((4000, 32))).astype(np.float32)
+        q = (centers[rng_np.integers(0, 10, 24)]
+             + rng_np.standard_normal((24, 32))).astype(np.float32)
+
+        def build_fn(res, part):
+            return ivf_bq.build(
+                res, ivf_bq.IvfBqIndexParams(n_lists=8), part)
+
+        def search_fn(res, index, queries, k):
+            return ivf_bq.search(
+                res, ivf_bq.IvfBqSearchParams(n_probes=8), index,
+                queries, k)
+
+        sharded = build_sharded(None, build_fn, search_fn, x, n_shards=4)
+        # deep over-fetch before the exact re-rank: 1-bit estimates are
+        # noisy, and the cross-shard merge keeps only estimate-ranked ids
+        _, cand = sharded.search(None, q, 120)
+        _, i = refine(None, x, q, cand, 10)
+        _, gt_i = brute_force.knn(None, x, q, 10)
+        r, _, _ = eval_recall(np.asarray(gt_i), np.asarray(i))
+        assert r >= 0.9, f"sharded bq recall {r}"
+
 
 class TestDistributedIvfFlat:
     """SPMD list-sharded IVF: recall vs exact, parity with the

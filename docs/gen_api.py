@@ -50,7 +50,14 @@ def main():
             if obj is None or inspect.ismodule(obj):
                 continue
             if inspect.isfunction(obj) or inspect.isclass(obj):
-                if getattr(obj, "__module__", "").startswith("raft_tpu"):
+                defmod = getattr(obj, "__module__", "")
+                # list a symbol where it is DEFINED (or explicitly
+                # re-exported via __all__) — cross-module imports like
+                # serialize helpers or private packing utilities are
+                # not part of that module's public surface
+                explicit = s in (getattr(m, "__all__", None) or ())
+                if defmod == name or (explicit
+                                      and defmod.startswith("raft_tpu")):
                     pub.append(s + ("()" if inspect.isfunction(obj) else ""))
         if pub:
             lines.append(f"- **`{name}`** — "

@@ -1,0 +1,51 @@
+"""IVF-BQ walkthrough — the 1-bit sign-quantized index (TPU-first, no
+reference analog; RaBitQ-style quantizer): probe scoring is a single
+MXU GEMM against the ±1 code matrix, the deepest compression in the
+library (D bits + 8 scalar bytes per vector), recovered to high recall
+by exact re-ranking.
+
+Run:  PYTHONPATH=.. python ivf_bq_example.py
+"""
+
+import numpy as np
+import scipy.spatial.distance as spd
+
+from raft_tpu import Resources
+from raft_tpu.neighbors import ivf_bq, refine
+from raft_tpu.utils import eval_recall
+
+N, DIM, N_QUERIES, K = 50_000, 96, 100, 10
+
+
+def main():
+    res = Resources(seed=0)
+    rng = np.random.default_rng(0)
+    centers = rng.standard_normal((64, DIM)) * 4
+    dataset = (centers[rng.integers(0, 64, N)]
+               + rng.standard_normal((N, DIM))).astype(np.float32)
+    queries = (centers[rng.integers(0, 64, N_QUERIES)]
+               + rng.standard_normal((N_QUERIES, DIM))).astype(np.float32)
+    gt = np.argsort(spd.cdist(queries, dataset, "sqeuclidean"),
+                    axis=1, kind="stable")[:, :K]
+
+    index = ivf_bq.build(res, ivf_bq.IvfBqIndexParams(n_lists=256), dataset)
+    code_bytes = index.codes.shape[2] + 8
+    print(f"compression ratio ≈ {DIM * 4 / code_bytes:.1f}x "
+          f"({code_bytes} B/vector)")
+
+    sp = ivf_bq.IvfBqSearchParams(n_probes=64)
+
+    # raw 1-bit estimates: coarse by design
+    _, idx_raw = ivf_bq.search(res, sp, index, queries, K)
+    r_raw, _, _ = eval_recall(gt, np.asarray(idx_raw))
+
+    # over-fetch 5x, exact re-rank — the intended usage
+    _, cand = ivf_bq.search(res, sp, index, queries, 5 * K)
+    _, idx_ref = refine(res, dataset, queries, cand, K)
+    r_ref, _, _ = eval_recall(gt, np.asarray(idx_ref))
+
+    print(f"recall@{K}: raw 1-bit {r_raw:.3f} -> refined {r_ref:.3f}")
+
+
+if __name__ == "__main__":
+    main()

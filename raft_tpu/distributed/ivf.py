@@ -46,6 +46,7 @@ from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
 from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+from raft_tpu.neighbors._packing import padded_extent
 from raft_tpu.neighbors.brute_force import knn_merge_parts
 from raft_tpu.neighbors.ivf_flat import IvfFlatIndexParams, IvfFlatSearchParams
 from raft_tpu.neighbors.ivf_pq import (
@@ -318,7 +319,7 @@ def build_streaming(
                 res, km, quant.centers, jnp.asarray(chunk, jnp.float32))
             labels_np[first : first + chunk.shape[0]] = np.asarray(lab)
         sizes_np = np.bincount(labels_np, minlength=n_lists)
-        max_size = max(8, -(-int(sizes_np.max()) // 8) * 8)
+        max_size = padded_extent(sizes_np)
 
         # deal lists round-robin by population; dealt[i] = original list
         order = np.argsort(-sizes_np, kind="stable")

@@ -327,7 +327,7 @@ def build_streaming(
                 jnp.asarray(chunk, jnp.float32))
             labels_np[first : first + chunk.shape[0]] = np.asarray(lab)
         sizes_np = np.bincount(labels_np, minlength=params.n_lists)
-        max_size = max(8, -(-int(sizes_np.max()) // 8) * 8)
+        max_size = padded_extent(sizes_np)
 
         # -- pass 3: scatter chunks into donated padded buffers. Indexing
         # is 2-D (list id, rank within list): a flat slot index would

@@ -30,7 +30,7 @@ MODULES = [
     "raft_tpu.comms", "raft_tpu.comms.bootstrap",
     "raft_tpu.distributed.ivf", "raft_tpu.distributed.knn",
     "raft_tpu.distributed.kmeans", "raft_tpu.distributed.sharded_ann",
-    "raft_tpu.distributed.checkpoint",
+    "raft_tpu.distributed.checkpoint", "raft_tpu.distributed.bq",
     "raft_tpu.io", "raft_tpu.bench", "raft_tpu.utils",
 ]
 

@@ -13,16 +13,20 @@ Two composition patterns, mirroring the reference:
 """
 
 from raft_tpu.distributed import ivf as ivf_flat
+from raft_tpu.distributed import bq as ivf_bq
 from raft_tpu.distributed import checkpoint
+from raft_tpu.distributed.bq import DistributedIvfBq
 from raft_tpu.distributed.ivf import DistributedIvfFlat, DistributedIvfPq
 from raft_tpu.distributed.kmeans import fit as kmeans_fit
 from raft_tpu.distributed.knn import brute_force_knn, brute_force_knn_ring
 from raft_tpu.distributed.sharded_ann import ShardedIndex, build_sharded
 
 __all__ = [
+    "DistributedIvfBq",
     "DistributedIvfFlat",
     "DistributedIvfPq",
     "checkpoint",
+    "ivf_bq",
     "ivf_flat",
     "kmeans_fit",
     "brute_force_knn",

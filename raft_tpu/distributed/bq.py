@@ -1,0 +1,222 @@
+"""SPMD distributed IVF-BQ — the 1-bit index list-sharded over a mesh
+axis (same layout policy as :mod:`raft_tpu.distributed.ivf`: lists
+dealt round-robin by population, coarse quantizer sharded with its
+lists, rotation replicated). Search is one jitted ``shard_map``
+program: local coarse top-p → local MXU sign-code scan →
+all_gather + ``knn_merge_parts``.
+
+Probe semantics (``probe_mode``) match the IVF-Flat/PQ paths:
+``"global"`` ranks all centers for exact list selection; ``"local"``
+probes each shard's own top lists (deeper over-fetch recommended — the
+1-bit estimates are already noisy, see :mod:`raft_tpu.neighbors.ivf_bq`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import Comms, allgather
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType, is_min_close
+from raft_tpu.matrix.select_k import merge_topk
+from raft_tpu.neighbors import ivf_bq as ivf_bq_mod
+from raft_tpu.neighbors._batching import tile_queries
+from raft_tpu.neighbors.brute_force import knn_merge_parts
+from raft_tpu.neighbors.ivf_bq import (
+    IvfBqIndexParams,
+    IvfBqSearchParams,
+    _unpack_pm1,
+)
+from raft_tpu.distributed.ivf import (
+    deal_order,
+    resolve_probe_budget,
+    select_probes_sharded,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedIvfBq:
+    """List-sharded IVF-BQ index."""
+
+    comms: Comms
+    centers: jax.Array        # (n_lists, dim) sharded on axis 0
+    rotation: jax.Array       # (dim_ext, dim) replicated
+    codes: jax.Array          # (n_lists, max_list_size, D/8) u8 sharded
+    scales: jax.Array         # (n_lists, max_list_size) f32 sharded
+    rnorm2: jax.Array         # (n_lists, max_list_size) f32 sharded
+    indices: jax.Array        # (n_lists, max_list_size) int32 sharded
+    list_sizes: jax.Array     # (n_lists,) sharded
+    metric: DistanceType
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jax.device_get(self.list_sizes).sum())
+
+
+def build_bq(
+    res: Optional[Resources],
+    comms: Comms,
+    params: IvfBqIndexParams,
+    dataset,
+) -> DistributedIvfBq:
+    """Single-chip build, then deal + shard (the shared layout policy).
+    ``params.n_lists`` is rounded up to a multiple of the mesh axis."""
+    res = ensure_resources(res)
+    r = comms.size
+    n_lists = -(-params.n_lists // r) * r
+    params = dataclasses.replace(params, n_lists=n_lists)
+
+    with tracing.range("raft_tpu.distributed.ivf_bq.build"):
+        index = ivf_bq_mod.build(res, params, dataset)
+        sizes = np.asarray(jax.device_get(index.list_sizes))
+        perm = jnp.asarray(deal_order(sizes, r), jnp.int32)
+        shard = comms.sharding(comms.axis)
+
+        def place(a):
+            return jax.device_put(jnp.take(a, perm, axis=0), shard)
+
+        return DistributedIvfBq(
+            comms=comms,
+            centers=place(index.centers),
+            rotation=jax.device_put(index.rotation, comms.replicated()),
+            codes=place(index.codes),
+            scales=place(index.scales),
+            rnorm2=place(index.rnorm2),
+            indices=place(index.indices),
+            list_sizes=place(index.list_sizes),
+            metric=index.metric,
+        )
+
+
+@partial(jax.jit, static_argnames=("axis", "mesh", "n_probes", "k", "metric",
+                                   "probe_mode"))
+def _dist_search_bq(centers, rotation, codes, scales, rn2, indices, queries,
+                    axis: str, mesh, n_probes: int, k: int,
+                    metric: DistanceType, probe_mode: str):
+    select_min = is_min_close(metric)
+    pad_val = jnp.inf if select_min else -jnp.inf
+    ip_metric = metric == DistanceType.InnerProduct
+
+    def body(centers_l, codes_l, scales_l, rn2_l, ids_l, qs):
+        q = qs.shape[0]
+        qf = qs.astype(jnp.float32)
+
+        ip = jax.lax.dot_general(
+            qf, centers_l, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )
+        if ip_metric:
+            coarse = -ip
+            cn = None
+            qnorm = None
+        else:
+            cn = jnp.sum(jnp.square(centers_l), axis=1)
+            coarse = cn[None, :] - 2.0 * ip
+            qnorm = jnp.sum(jnp.square(qf), axis=1)
+
+        local, mine = select_probes_sharded(coarse, n_probes, axis,
+                                            probe_mode)
+
+        qrot = qf @ rotation.T
+        centers_rot = None if ip_metric else centers_l @ rotation.T
+        qidx = jnp.arange(q)
+
+        def step(carry, rank_i):
+            best_d, best_i = carry
+            lists = local[:, rank_i]
+            valid = mine[:, rank_i]
+            byts = jnp.take(codes_l, lists, axis=0)
+            pm1 = _unpack_pm1(byts)
+            a = jnp.take(scales_l, lists, axis=0)
+            row_ids = jnp.take(ids_l, lists, axis=0)
+            if ip_metric:
+                cross = jnp.einsum("qd,qmd->qm",
+                                   qrot.astype(jnp.bfloat16), pm1,
+                                   preferred_element_type=jnp.float32)
+                base = ip[qidx, lists]
+                dist = base[:, None] + a * cross
+            else:
+                qsub = qrot - centers_rot[lists]
+                cross = jnp.einsum("qd,qmd->qm",
+                                   qsub.astype(jnp.bfloat16), pm1,
+                                   preferred_element_type=jnp.float32)
+                r2 = jnp.take(rn2_l, lists, axis=0)
+                qc2 = qnorm + cn[lists] - 2.0 * ip[qidx, lists]
+                dist = (jnp.maximum(qc2, 0.0)[:, None]
+                        - 2.0 * a * cross + r2)
+            dist = jnp.where((row_ids >= 0) & valid[:, None], dist,
+                             pad_val)
+            return merge_topk(best_d, best_i, dist, row_ids, k,
+                              select_min), None
+
+        init = (jnp.full((q, k), pad_val, jnp.float32),
+                jnp.full((q, k), -1, jnp.int32))
+        (best_d, best_i), _ = jax.lax.scan(
+            step, init, jnp.arange(local.shape[1]))
+
+        all_d = allgather(best_d, axis)
+        all_i = allgather(best_i, axis)
+        return knn_merge_parts(all_d, all_i, select_min)
+
+    out_d, out_i = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None, None), P(axis, None),
+                  P(axis, None), P(axis, None), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )(centers, codes, scales, rn2, indices, queries)
+
+    if metric == DistanceType.L2SqrtExpanded:
+        out_d = jnp.where(jnp.isfinite(out_d),
+                          jnp.sqrt(jnp.maximum(out_d, 0.0)), out_d)
+    return out_d, out_i
+
+
+def search_bq(
+    res: Optional[Resources],
+    params: IvfBqSearchParams,
+    index: DistributedIvfBq,
+    queries,
+    k: int,
+    probe_mode: str = "global",
+    query_tile: int = 4096,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-program distributed BQ search (estimated distances — refine
+    host-side as with the single-chip index). Large query sets run in
+    ``query_tile`` batches, bounding the per-shard unpacked-code
+    intermediate like the single-chip path."""
+    ensure_resources(res)
+    queries = jnp.asarray(queries)
+    expect(queries.ndim == 2 and queries.shape[1] == index.dim,
+           "queries must be (q, dim)")
+    comms = index.comms
+    n_probes = resolve_probe_budget(params.n_probes, index.n_lists,
+                                    comms.size, probe_mode)
+    queries = jax.device_put(queries, comms.replicated())
+    with tracing.range("raft_tpu.distributed.ivf_bq.search"):
+        def run(qt, _fw):
+            return _dist_search_bq(
+                index.centers, index.rotation, index.codes, index.scales,
+                index.rnorm2, index.indices, qt, comms.axis, comms.mesh,
+                n_probes, k, index.metric, probe_mode,
+            )
+
+        return tile_queries(run, queries, None, query_tile)

@@ -49,6 +49,7 @@ from raft_tpu.neighbors.ivf_pq import CodebookKind
 # with a clear version mismatch instead of a shape error mid-parse
 _FLAT_VERSION = 0x4601  # 'F' << 8 | 1
 _PQ_VERSION = 0x5001    # 'P' << 8 | 1
+_BQ_VERSION = 0x4201    # 'B' << 8 | 1
 
 
 def _fetch(a) -> np.ndarray:
@@ -169,4 +170,61 @@ def load_pq(res, comms: Comms, fh_or_path) -> DistributedIvfPq:
         metric=metric,
         pq_bits=pq_bits,
         codebook_kind=kind,
+    )
+
+
+def save_bq(index, fh_or_path) -> None:
+    """Write a sharded IVF-BQ index (sign codes + per-vector scalars)."""
+    fh, own = open_maybe_path(fh_or_path, "wb")
+    try:
+        with tracing.range("raft_tpu.distributed.checkpoint.save_bq"):
+            serialize_scalar(fh, _BQ_VERSION, np.int32)
+            serialize_scalar(fh, int(index.metric), np.int32)
+            serialize_array(fh, _fetch(index.centers))
+            serialize_array(fh, _fetch(index.rotation))
+            serialize_array(fh, _fetch(index.codes))
+            serialize_array(fh, _fetch(index.scales))
+            serialize_array(fh, _fetch(index.rnorm2))
+            serialize_array(fh, _fetch(index.indices))
+            serialize_array(fh, _fetch(index.list_sizes))
+    finally:
+        if own:
+            fh.close()
+
+
+def load_bq(res, comms: Comms, fh_or_path):
+    """Restore onto ``comms``'s mesh with the shared re-deal (shard
+    count may differ from save time)."""
+    from raft_tpu.distributed.bq import DistributedIvfBq
+
+    fh, own = open_maybe_path(fh_or_path, "rb")
+    try:
+        check_version(deserialize_scalar(fh), _BQ_VERSION,
+                      "distributed ivf_bq")
+        metric = DistanceType(int(deserialize_scalar(fh)))
+        arrays = [deserialize_array(fh) for _ in range(7)]
+    finally:
+        if own:
+            fh.close()
+    centers, rotation, codes, scales, rn2, indices, sizes = arrays
+    expect(centers.shape[0] % comms.size == 0,
+           f"the mesh axis ({comms.size}) must divide n_lists "
+           f"{centers.shape[0]}")
+    shard = comms.sharding(comms.axis)
+    deal = deal_order(np.asarray(sizes), comms.size)
+
+    def place(a):
+        return jax.device_put(np.ascontiguousarray(a[deal]), shard)
+
+    return DistributedIvfBq(
+        comms=comms,
+        centers=place(centers),
+        rotation=jax.device_put(np.asarray(rotation),
+                                comms.replicated()),
+        codes=place(codes),
+        scales=place(scales),
+        rnorm2=place(rn2),
+        indices=place(indices),
+        list_sizes=place(sizes),
+        metric=metric,
     )

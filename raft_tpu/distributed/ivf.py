@@ -96,6 +96,50 @@ def deal_order(sizes: np.ndarray, r: int) -> np.ndarray:
     return np.concatenate([order[s::r] for s in range(r)])
 
 
+def select_probes_sharded(coarse, n_probes: int, axis: str,
+                          probe_mode: str):
+    """Shared probe selection inside a shard_map body — THE
+    probe-ownership arithmetic for every list-sharded index family.
+
+    ``coarse`` is this shard's (q, n_local) min-close coarse distances.
+    Returns ``(local, mine)``: per-(query, probe-rank) local list ids
+    and a mask of the probes this shard owns.
+
+    - ``"global"``: all_gather every shard's coarse block, take the
+      global top-``n_probes``, keep the locally-owned ones.
+    - ``"local"``: each shard probes its own top-``n_probes`` lists.
+    """
+    q, n_local = coarse.shape
+    if probe_mode == "global":
+        coarse_all = allgather(coarse, axis)              # (R, q, L)
+        r = coarse_all.shape[0]
+        coarse_flat = jnp.moveaxis(coarse_all, 0, 1).reshape(
+            q, r * n_local)
+        _, probes = jax.lax.top_k(-coarse_flat, n_probes)
+        probes = probes.astype(jnp.int32)
+        owner = probes // n_local
+        local = probes - owner * n_local
+        mine = owner == jax.lax.axis_index(axis)
+        return local, mine
+    _, probes = jax.lax.top_k(-coarse, n_probes)
+    return probes.astype(jnp.int32), jnp.ones(probes.shape, jnp.bool_)
+
+
+def resolve_probe_budget(n_probes: int, n_lists: int, mesh_size: int,
+                         probe_mode: str) -> int:
+    """Shared probe-budget clamp for the list-sharded search entries:
+    validates ``probe_mode`` and converts the user's global probe count
+    into this mode's per-program budget (local mode probes each shard's
+    own ``ceil(n_probes / R)`` lists)."""
+    expect(probe_mode in ("global", "local"),
+           f"probe_mode must be 'global' or 'local', got {probe_mode!r}")
+    local_lists = n_lists // mesh_size
+    n_probes = min(n_probes, n_lists)
+    if probe_mode == "local":
+        n_probes = min(-(-n_probes // mesh_size), local_lists)
+    return n_probes
+
+
 def build(
     res: Optional[Resources],
     comms: Comms,
@@ -149,7 +193,6 @@ def _dist_search(centers, data, data_norms, indices, queries,
         q = qs.shape[0]
         n_local = centers_l.shape[0]
         qf = qs.astype(jnp.float32)
-        my_rank = jax.lax.axis_index(axis)
 
         # coarse distances to this shard's centers
         ip = jax.lax.dot_general(
@@ -163,22 +206,8 @@ def _dist_search(centers, data, data_norms, indices, queries,
             cn = jnp.sum(jnp.square(centers_l), axis=1)
             coarse = cn[None, :] - 2.0 * ip
 
-        if probe_mode == "global":
-            # rank ALL lists: gather every shard's coarse block, take the
-            # global top-n_probes, then scan only the locally-owned ones
-            coarse_all = allgather(coarse, axis)          # (R, q, L)
-            r = coarse_all.shape[0]
-            coarse_all = jnp.moveaxis(coarse_all, 0, 1)   # (q, R, L)
-            coarse_flat = coarse_all.reshape(q, r * n_local)
-            _, probes = jax.lax.top_k(-coarse_flat, n_probes)
-            probes = probes.astype(jnp.int32)             # global list ids
-            owner = probes // n_local
-            local = probes - owner * n_local
-            mine = owner == my_rank
-        else:
-            _, probes = jax.lax.top_k(-coarse, n_probes)  # local top-p
-            local = probes.astype(jnp.int32)
-            mine = jnp.ones(local.shape, jnp.bool_)
+        local, mine = select_probes_sharded(coarse, n_probes, axis,
+                                            probe_mode)
 
         def step(carry, rank_i):
             best_d, best_i = carry
@@ -249,18 +278,14 @@ def search(
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
            "queries must be (q, dim)")
-    expect(probe_mode in ("global", "local"),
-           f"probe_mode must be 'global' or 'local', got {probe_mode!r}")
     comms = index.comms
     if query_axis is not None:
         expect(query_axis in comms.mesh.axis_names and query_axis != comms.axis,
                f"query_axis {query_axis!r} must be another mesh axis")
         expect(queries.shape[0] % comms.mesh.shape[query_axis] == 0,
                "the query-axis size must divide the query count evenly")
-    local_lists = index.n_lists // comms.size
-    n_probes = min(params.n_probes, index.n_lists)
-    if probe_mode == "local":
-        n_probes = min(-(-n_probes // comms.size), local_lists)
+    n_probes = resolve_probe_budget(params.n_probes, index.n_lists,
+                                    comms.size, probe_mode)
     qsharding = (comms.replicated() if query_axis is None
                  else comms.sharding(query_axis))
     queries = jax.device_put(queries, qsharding)
@@ -491,7 +516,6 @@ def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
         q = qs.shape[0]
         n_local = centers_l.shape[0]
         qf = qs.astype(jnp.float32)
-        my_rank = jax.lax.axis_index(axis)
 
         ip = jax.lax.dot_general(
             qf, centers_l, (((1,), (1,)), ((), ())),
@@ -504,20 +528,8 @@ def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
             cn = jnp.sum(jnp.square(centers_l), axis=1)
             coarse = cn[None, :] - 2.0 * ip
 
-        if probe_mode == "global":
-            coarse_all = allgather(coarse, axis)          # (R, q, L)
-            r = coarse_all.shape[0]
-            coarse_flat = jnp.moveaxis(coarse_all, 0, 1).reshape(
-                q, r * n_local)
-            _, probes = jax.lax.top_k(-coarse_flat, n_probes)
-            probes = probes.astype(jnp.int32)
-            owner = probes // n_local
-            local = probes - owner * n_local
-            mine = owner == my_rank
-        else:
-            _, probes = jax.lax.top_k(-coarse, n_probes)
-            local = probes.astype(jnp.int32)
-            mine = jnp.ones(local.shape, jnp.bool_)
+        local, mine = select_probes_sharded(coarse, n_probes, axis,
+                                            probe_mode)
 
         qsub_fixed = (qf @ rotation.T).reshape(q, pq_dim, pq_len)
         lut_fixed = (jnp.einsum("qsl,sjl->qsj", qsub_fixed, books_l)
@@ -579,18 +591,14 @@ def search_pq(
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
            "queries must be (q, dim)")
-    expect(probe_mode in ("global", "local"),
-           f"probe_mode must be 'global' or 'local', got {probe_mode!r}")
     comms = index.comms
     if query_axis is not None:
         expect(query_axis in comms.mesh.axis_names and query_axis != comms.axis,
                f"query_axis {query_axis!r} must be another mesh axis")
         expect(queries.shape[0] % comms.mesh.shape[query_axis] == 0,
                "the query-axis size must divide the query count evenly")
-    local_lists = index.n_lists // comms.size
-    n_probes = min(params.n_probes, index.n_lists)
-    if probe_mode == "local":
-        n_probes = min(-(-n_probes // comms.size), local_lists)
+    n_probes = resolve_probe_budget(params.n_probes, index.n_lists,
+                                    comms.size, probe_mode)
     qsharding = (comms.replicated() if query_axis is None
                  else comms.sharding(query_axis))
     queries = jax.device_put(queries, qsharding)

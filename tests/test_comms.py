@@ -533,3 +533,76 @@ class TestDistributedCheckpoint:
         checkpoint.save_pq(idx, path)
         with pytest.raises(ValueError, match="version mismatch"):
             checkpoint.load_flat(None, comms, path)
+
+
+class TestDistributedIvfBq:
+    def test_global_matches_single_chip(self, rng_np):
+        """probe_mode='global' distributed BQ returns the same estimated
+        ranking as the single-chip index built with the same params."""
+        from raft_tpu.distributed import bq as dist_bq
+        from raft_tpu.neighbors import brute_force, ivf_bq
+        from raft_tpu.neighbors.refine import refine
+
+        comms = local_comms()
+        centers = rng_np.standard_normal((10, 32)) * 5
+        x = (centers[rng_np.integers(0, 10, 4096)]
+             + rng_np.standard_normal((4096, 32))).astype(np.float32)
+        q = (centers[rng_np.integers(0, 10, 16)]
+             + rng_np.standard_normal((16, 32))).astype(np.float32)
+
+        didx = dist_bq.build_bq(
+            None, comms, ivf_bq.IvfBqIndexParams(n_lists=16), x)
+        sp = ivf_bq.IvfBqSearchParams(n_probes=16)
+        d_dist, i_dist = dist_bq.search_bq(None, sp, didx, q, 120)
+
+        sidx = ivf_bq.build(None, ivf_bq.IvfBqIndexParams(n_lists=16), x)
+        d_single, i_single = ivf_bq.search(None, sp, sidx, q, 120)
+        np.testing.assert_array_equal(np.asarray(i_dist),
+                                      np.asarray(i_single))
+        np.testing.assert_allclose(np.asarray(d_dist),
+                                   np.asarray(d_single),
+                                   rtol=1e-4, atol=1e-4)
+
+        # end-to-end recall with exact re-rank
+        _, gt = brute_force.knn(None, x, q, 10)
+        _, i = refine(None, x, q, i_dist, 10)
+        r, _, _ = eval_recall(np.asarray(gt), np.asarray(i))
+        assert r >= 0.9, r
+
+    def test_local_probe_mode(self, rng_np):
+        from raft_tpu.distributed import bq as dist_bq
+        from raft_tpu.neighbors import ivf_bq
+
+        comms = local_comms()
+        x = rng_np.standard_normal((2048, 32)).astype(np.float32)
+        didx = dist_bq.build_bq(
+            None, comms, ivf_bq.IvfBqIndexParams(n_lists=16), x)
+        d, i = dist_bq.search_bq(
+            None, ivf_bq.IvfBqSearchParams(n_probes=16), didx, x[:4], 20,
+            probe_mode="local")
+        assert np.asarray(i).shape == (4, 20)
+        assert np.isfinite(np.asarray(d)).all()
+
+    def test_checkpoint_roundtrip_reshard(self, rng_np, tmp_path):
+        """BQ checkpoint restores onto a different shard count with
+        identical search results."""
+        from raft_tpu.comms.bootstrap import make_mesh
+        from raft_tpu.distributed import bq as dist_bq, checkpoint
+        from raft_tpu.neighbors import ivf_bq
+
+        comms = local_comms()
+        x = rng_np.standard_normal((2048, 32)).astype(np.float32)
+        q = rng_np.standard_normal((8, 32)).astype(np.float32)
+        didx = dist_bq.build_bq(
+            None, comms, ivf_bq.IvfBqIndexParams(n_lists=16), x)
+        sp = ivf_bq.IvfBqSearchParams(n_probes=8)
+        d0, i0 = dist_bq.search_bq(None, sp, didx, q, 20)
+
+        path = tmp_path / "bq_dist.bin"
+        checkpoint.save_bq(didx, path)
+        comms4 = Comms(make_mesh(devices=jax.devices()[:4]), "data")
+        didx4 = checkpoint.load_bq(None, comms4, path)
+        d1, i1 = dist_bq.search_bq(None, sp, didx4, q, 20)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(np.asarray(d0), np.asarray(d1),
+                                   rtol=1e-4, atol=1e-4)

@@ -34,7 +34,7 @@ from raft_tpu.neighbors.brute_force import knn_merge_parts
 from raft_tpu.neighbors.ivf_bq import (
     IvfBqIndexParams,
     IvfBqSearchParams,
-    _unpack_pm1,
+    score_probe,
 )
 from raft_tpu.distributed.ivf import (
     deal_order,
@@ -137,33 +137,13 @@ def _dist_search_bq(centers, rotation, codes, scales, rn2, indices, queries,
 
         qrot = qf @ rotation.T
         centers_rot = None if ip_metric else centers_l @ rotation.T
-        qidx = jnp.arange(q)
 
         def step(carry, rank_i):
             best_d, best_i = carry
-            lists = local[:, rank_i]
-            valid = mine[:, rank_i]
-            byts = jnp.take(codes_l, lists, axis=0)
-            pm1 = _unpack_pm1(byts)
-            a = jnp.take(scales_l, lists, axis=0)
-            row_ids = jnp.take(ids_l, lists, axis=0)
-            if ip_metric:
-                cross = jnp.einsum("qd,qmd->qm",
-                                   qrot.astype(jnp.bfloat16), pm1,
-                                   preferred_element_type=jnp.float32)
-                base = ip[qidx, lists]
-                dist = base[:, None] + a * cross
-            else:
-                qsub = qrot - centers_rot[lists]
-                cross = jnp.einsum("qd,qmd->qm",
-                                   qsub.astype(jnp.bfloat16), pm1,
-                                   preferred_element_type=jnp.float32)
-                r2 = jnp.take(rn2_l, lists, axis=0)
-                qc2 = qnorm + cn[lists] - 2.0 * ip[qidx, lists]
-                dist = (jnp.maximum(qc2, 0.0)[:, None]
-                        - 2.0 * a * cross + r2)
-            dist = jnp.where((row_ids >= 0) & valid[:, None], dist,
-                             pad_val)
+            dist, row_ids = score_probe(
+                local[:, rank_i], qrot, centers_rot, ip, cn, qnorm,
+                codes_l, scales_l, rn2_l, ids_l, ip_metric, pad_val,
+                valid=mine[:, rank_i])
             return merge_topk(best_d, best_i, dist, row_ids, k,
                               select_min), None
 

@@ -266,6 +266,45 @@ def extend(
                                    indices=i, list_sizes=s)
 
 
+def score_probe(lists, qrot, centers_rot, ip, cn, qnorm, codes, scales,
+                rn2, indices, ip_metric: bool, pad_val, valid=None):
+    """THE per-probe scoring step, shared by the single-chip and
+    distributed searches: gather one probed list per query, unpack the
+    sign codes, one MXU GEMM cross term, estimator assembly. Rows that
+    are padding (or, distributed, probes this shard does not own via
+    ``valid``) score ``pad_val``. Returns ``(dist (q, m), row_ids)``.
+
+    Inputs are the probe-invariant precomputations: ``qrot = R q``,
+    ``centers_rot = R c`` (L2 only), the coarse-stage ``ip = q·c``
+    matrix and norms (L2 only).
+    """
+    q = qrot.shape[0]
+    qidx = jnp.arange(q)
+    byts = jnp.take(codes, lists, axis=0)          # (q, m, D/8) u8
+    pm1 = _unpack_pm1(byts)                        # (q, m, D) bf16 ±1
+    a = jnp.take(scales, lists, axis=0)            # (q, m)
+    row_ids = jnp.take(indices, lists, axis=0)     # (q, m)
+    if ip_metric:
+        # similarity (select_min is False for IP — no negation)
+        cross = jnp.einsum("qd,qmd->qm", qrot.astype(jnp.bfloat16), pm1,
+                           preferred_element_type=jnp.float32)
+        base = ip[qidx, lists]                     # q·c from coarse
+        dist = base[:, None] + a * cross
+    else:
+        qsub = qrot - centers_rot[lists]           # (q, dim_ext)
+        cross = jnp.einsum("qd,qmd->qm", qsub.astype(jnp.bfloat16), pm1,
+                           preferred_element_type=jnp.float32)
+        r2 = jnp.take(rn2, lists, axis=0)
+        # ||q−c||² from the coarse-stage terms (R is an isometry, so
+        # this equals Σ qsub² without re-reducing per probe)
+        qc2 = qnorm + cn[lists] - 2.0 * ip[qidx, lists]
+        dist = jnp.maximum(qc2, 0.0)[:, None] - 2.0 * a * cross + r2
+    ok = row_ids >= 0
+    if valid is not None:
+        ok = ok & valid[:, None]
+    return jnp.where(ok, dist, pad_val), row_ids
+
+
 @partial(jax.jit, static_argnames=("n_probes", "k", "metric"))
 def _search_impl(queries, centers, rotation, codes, scales, rn2, indices,
                  filter_words, n_probes: int, k: int, metric: DistanceType):
@@ -295,32 +334,13 @@ def _search_impl(queries, centers, rotation, codes, scales, rn2, indices,
     # and q̃ = R(q−c) = Rq − (Rc) needs only a rotated-centers table
     qrot = qf @ rotation.T                             # (q, dim_ext)
     centers_rot = None if ip_metric else centers @ rotation.T
-    qidx = jnp.arange(q)
 
     def step(carry, rank):
         best_d, best_i = carry
         lists = probes[:, rank]                        # (q,)
-        byts = jnp.take(codes, lists, axis=0)          # (q, m, D/8) u8
-        pm1 = _unpack_pm1(byts)                        # (q, m, D) bf16 ±1
-        a = jnp.take(scales, lists, axis=0)            # (q, m)
-        row_ids = jnp.take(indices, lists, axis=0)     # (q, m)
-        if ip_metric:
-            # similarity (select_min is False for IP — no negation)
-            cross = jnp.einsum("qd,qmd->qm", qrot.astype(jnp.bfloat16),
-                               pm1, preferred_element_type=jnp.float32)
-            base = ip[qidx, lists]                     # q·c from coarse
-            dist = base[:, None] + a * cross
-        else:
-            qsub = qrot - centers_rot[lists]           # (q, dim_ext)
-            cross = jnp.einsum("qd,qmd->qm",
-                               qsub.astype(jnp.bfloat16), pm1,
-                               preferred_element_type=jnp.float32)
-            r2 = jnp.take(rn2, lists, axis=0)
-            # ||q−c||² from the coarse-stage terms (R is an isometry,
-            # so this equals Σ qsub² without re-reducing per probe)
-            qc2 = qnorm + c_norms[lists] - 2.0 * ip[qidx, lists]
-            dist = jnp.maximum(qc2, 0.0)[:, None] - 2.0 * a * cross + r2
-        dist = jnp.where(row_ids >= 0, dist, pad_val)
+        dist, row_ids = score_probe(
+            lists, qrot, centers_rot, ip, c_norms, qnorm, codes, scales,
+            rn2, indices, ip_metric, pad_val)
         if filter_words is not None:
             bits = test_filter(filter_words, row_ids)
             dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)

@@ -186,3 +186,55 @@ class TestOddDims:
         _, i = ivf_pq.search(None, ivf_pq.IvfPqSearchParams(n_probes=8),
                              pidx, q, 5)
         assert (np.asarray(i)[:, 0] == np.arange(6)).all()
+
+
+class TestDegenerateData:
+    """Duplicate-heavy and all-zero inputs must not produce NaN/inf
+    results or invalid ids in any index family (real-world datasets
+    contain exact duplicates and zero rows)."""
+
+    def test_all_families_finite(self):
+        import numpy as np
+
+        from raft_tpu.neighbors import (
+            brute_force,
+            cagra,
+            ivf_bq,
+            ivf_flat,
+            ivf_pq,
+        )
+
+        rng = np.random.default_rng(0)
+        base = rng.standard_normal((10, 16)).astype(np.float32)
+        x = np.concatenate([np.repeat(base, 90, axis=0),
+                            np.zeros((100, 16), np.float32)])
+        q = np.concatenate([base[:3],
+                            np.zeros((1, 16), np.float32)]).astype(np.float32)
+
+        cases = [
+            lambda: brute_force.knn(None, x, q, 5),
+            lambda: ivf_flat.search(
+                None, ivf_flat.IvfFlatSearchParams(n_probes=8),
+                ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(n_lists=8),
+                               x), q, 5),
+            lambda: ivf_pq.search(
+                None, ivf_pq.IvfPqSearchParams(n_probes=8),
+                ivf_pq.build(None, ivf_pq.IvfPqIndexParams(n_lists=8,
+                                                           pq_dim=8), x),
+                q, 5),
+            lambda: ivf_bq.search(
+                None, ivf_bq.IvfBqSearchParams(n_probes=8),
+                ivf_bq.build(None, ivf_bq.IvfBqIndexParams(n_lists=8), x),
+                q, 5),
+        ]
+        for fn in cases:
+            d, i = fn()
+            assert np.isfinite(np.asarray(d)).all()
+            assert (np.asarray(i) >= 0).all()
+
+        ci = cagra.build(None, cagra.CagraIndexParams(
+            graph_degree=8, intermediate_graph_degree=16,
+            build_algo=cagra.BuildAlgo.NN_DESCENT), x)
+        d, _ = cagra.search(None, cagra.CagraSearchParams(itopk_size=16),
+                            ci, q, 5)
+        assert np.isfinite(np.asarray(d)).all()

@@ -39,6 +39,7 @@ from raft_tpu.neighbors.ivf_bq import (
 from raft_tpu.distributed.ivf import (
     deal_order,
     resolve_probe_budget,
+    resolve_query_sharding,
     select_probes_sharded,
 )
 
@@ -106,10 +107,11 @@ def build_bq(
 
 
 @partial(jax.jit, static_argnames=("axis", "mesh", "n_probes", "k", "metric",
-                                   "probe_mode"))
+                                   "probe_mode", "query_axis"))
 def _dist_search_bq(centers, rotation, codes, scales, rn2, indices, queries,
                     axis: str, mesh, n_probes: int, k: int,
-                    metric: DistanceType, probe_mode: str):
+                    metric: DistanceType, probe_mode: str,
+                    query_axis=None):
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
     ip_metric = metric == DistanceType.InnerProduct
@@ -156,11 +158,12 @@ def _dist_search_bq(centers, rotation, codes, scales, rn2, indices, queries,
         all_i = allgather(best_i, axis)
         return knn_merge_parts(all_d, all_i, select_min)
 
+    qspec = P() if query_axis is None else P(query_axis, None)
     out_d, out_i = jax.shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None, None), P(axis, None),
-                  P(axis, None), P(axis, None), P()),
-        out_specs=(P(), P()),
+                  P(axis, None), P(axis, None), qspec),
+        out_specs=(qspec, qspec),
         check_vma=False,
     )(centers, codes, scales, rn2, indices, queries)
 
@@ -177,26 +180,35 @@ def search_bq(
     queries,
     k: int,
     probe_mode: str = "global",
+    query_axis: Optional[str] = None,
     query_tile: int = 4096,
 ) -> Tuple[jax.Array, jax.Array]:
     """One-program distributed BQ search (estimated distances — refine
     host-side as with the single-chip index). Large query sets run in
     ``query_tile`` batches, bounding the per-shard unpacked-code
-    intermediate like the single-chip path."""
+    intermediate like the single-chip path. ``query_axis`` names a
+    second mesh axis to shard queries over (the 2-D list×query grid,
+    matching :func:`raft_tpu.distributed.ivf.search_pq`)."""
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
            "queries must be (q, dim)")
     comms = index.comms
+    qsharding = resolve_query_sharding(comms, queries, query_axis)
     n_probes = resolve_probe_budget(params.n_probes, index.n_lists,
                                     comms.size, probe_mode)
-    queries = jax.device_put(queries, comms.replicated())
+    queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_bq.search"):
         def run(qt, _fw):
             return _dist_search_bq(
                 index.centers, index.rotation, index.codes, index.scales,
                 index.rnorm2, index.indices, qt, comms.axis, comms.mesh,
-                n_probes, k, index.metric, probe_mode,
+                n_probes, k, index.metric, probe_mode, query_axis,
             )
 
+        if query_axis is not None:
+            # already query-sharded: tiling would slice across the
+            # shard layout and force a reshard per tile — run whole
+            # (the 2-D grid is itself the large-batch mechanism)
+            return run(queries, None)
         return tile_queries(run, queries, None, query_tile)

@@ -125,6 +125,20 @@ def select_probes_sharded(coarse, n_probes: int, axis: str,
     return probes.astype(jnp.int32), jnp.ones(probes.shape, jnp.bool_)
 
 
+def resolve_query_sharding(comms: Comms, queries, query_axis):
+    """Shared ``query_axis`` validation + placement for the 2-D
+    list×query grids: returns the sharding the replicated-or-sharded
+    queries should be placed with."""
+    if query_axis is not None:
+        expect(query_axis in comms.mesh.axis_names
+               and query_axis != comms.axis,
+               f"query_axis {query_axis!r} must be another mesh axis")
+        expect(queries.shape[0] % comms.mesh.shape[query_axis] == 0,
+               "the query-axis size must divide the query count evenly")
+        return comms.sharding(query_axis)
+    return comms.replicated()
+
+
 def resolve_probe_budget(n_probes: int, n_lists: int, mesh_size: int,
                          probe_mode: str) -> int:
     """Shared probe-budget clamp for the list-sharded search entries:
@@ -279,15 +293,9 @@ def search(
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
            "queries must be (q, dim)")
     comms = index.comms
-    if query_axis is not None:
-        expect(query_axis in comms.mesh.axis_names and query_axis != comms.axis,
-               f"query_axis {query_axis!r} must be another mesh axis")
-        expect(queries.shape[0] % comms.mesh.shape[query_axis] == 0,
-               "the query-axis size must divide the query count evenly")
+    qsharding = resolve_query_sharding(comms, queries, query_axis)
     n_probes = resolve_probe_budget(params.n_probes, index.n_lists,
                                     comms.size, probe_mode)
-    qsharding = (comms.replicated() if query_axis is None
-                 else comms.sharding(query_axis))
     queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_flat.search"):
         return _dist_search(
@@ -592,15 +600,9 @@ def search_pq(
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
            "queries must be (q, dim)")
     comms = index.comms
-    if query_axis is not None:
-        expect(query_axis in comms.mesh.axis_names and query_axis != comms.axis,
-               f"query_axis {query_axis!r} must be another mesh axis")
-        expect(queries.shape[0] % comms.mesh.shape[query_axis] == 0,
-               "the query-axis size must divide the query count evenly")
+    qsharding = resolve_query_sharding(comms, queries, query_axis)
     n_probes = resolve_probe_budget(params.n_probes, index.n_lists,
                                     comms.size, probe_mode)
-    qsharding = (comms.replicated() if query_axis is None
-                 else comms.sharding(query_axis))
     queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_pq.search"):
         return _dist_search_pq(

@@ -28,14 +28,15 @@ def main():
     gt = np.argsort(spd.cdist(queries, dataset, "sqeuclidean"),
                     axis=1, kind="stable")[:, :K]
 
-    index = ivf_bq.build(res, ivf_bq.IvfBqIndexParams(n_lists=256), dataset)
-    code_bytes = index.codes.shape[2] + 8
+    index = ivf_bq.build(res, ivf_bq.IvfBqIndexParams(n_lists=256, bits=2),
+                         dataset)
+    code_bytes = index.codes.shape[2] + 4 * (index.bits + 1)
     print(f"compression ratio ≈ {DIM * 4 / code_bytes:.1f}x "
           f"({code_bytes} B/vector)")
 
     sp = ivf_bq.IvfBqSearchParams(n_probes=64)
 
-    # raw 1-bit estimates: coarse by design
+    # raw sign-code estimates: coarse by design
     _, idx_raw = ivf_bq.search(res, sp, index, queries, K)
     r_raw, _, _ = eval_recall(gt, np.asarray(idx_raw))
 
@@ -44,7 +45,7 @@ def main():
     _, idx_ref = refine(res, dataset, queries, cand, K)
     r_ref, _, _ = eval_recall(gt, np.asarray(idx_ref))
 
-    print(f"recall@{K}: raw 1-bit {r_raw:.3f} -> refined {r_ref:.3f}")
+    print(f"recall@{K}: raw {index.bits}-bit {r_raw:.3f} -> refined {r_ref:.3f}")
 
 
 if __name__ == "__main__":

@@ -1,4 +1,5 @@
-"""SPMD distributed IVF-BQ — the 1-bit index list-sharded over a mesh
+"""SPMD distributed IVF-BQ — the residual sign-code index (1-4
+bits/dim) list-sharded over a mesh
 axis (same layout policy as :mod:`raft_tpu.distributed.ivf`: lists
 dealt round-robin by population, coarse quantizer sharded with its
 lists, rotation replicated). Search is one jitted ``shard_map``
@@ -7,8 +8,8 @@ all_gather + ``knn_merge_parts``.
 
 Probe semantics (``probe_mode``) match the IVF-Flat/PQ paths:
 ``"global"`` ranks all centers for exact list selection; ``"local"``
-probes each shard's own top lists (deeper over-fetch recommended — the
-1-bit estimates are already noisy, see :mod:`raft_tpu.neighbors.ivf_bq`).
+probes each shard's own top lists (deeper over-fetch recommended —
+sign-code estimates are noisy, see :mod:`raft_tpu.neighbors.ivf_bq`).
 """
 
 from __future__ import annotations
@@ -51,8 +52,8 @@ class DistributedIvfBq:
     comms: Comms
     centers: jax.Array        # (n_lists, dim) sharded on axis 0
     rotation: jax.Array       # (dim_ext, dim) replicated
-    codes: jax.Array          # (n_lists, max_list_size, D/8) u8 sharded
-    scales: jax.Array         # (n_lists, max_list_size) f32 sharded
+    codes: jax.Array          # (n_lists, max_list_size, bits·D/8) u8 shard.
+    scales: jax.Array         # (n_lists, max_list_size, bits) f32 sharded
     rnorm2: jax.Array         # (n_lists, max_list_size) f32 sharded
     indices: jax.Array        # (n_lists, max_list_size) int32 sharded
     list_sizes: jax.Array     # (n_lists,) sharded
@@ -65,6 +66,10 @@ class DistributedIvfBq:
     @property
     def dim(self) -> int:
         return self.centers.shape[1]
+
+    @property
+    def bits(self) -> int:
+        return self.scales.shape[2]
 
     @property
     def size(self) -> int:
@@ -161,8 +166,9 @@ def _dist_search_bq(centers, rotation, codes, scales, rn2, indices, queries,
     qspec = P() if query_axis is None else P(query_axis, None)
     out_d, out_i = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None, None), P(axis, None),
-                  P(axis, None), P(axis, None), qspec),
+        in_specs=(P(axis, None), P(axis, None, None),
+                  P(axis, None, None), P(axis, None), P(axis, None),
+                  qspec),
         out_specs=(qspec, qspec),
         check_vma=False,
     )(centers, codes, scales, rn2, indices, queries)

@@ -49,7 +49,7 @@ from raft_tpu.neighbors.ivf_pq import CodebookKind
 # with a clear version mismatch instead of a shape error mid-parse
 _FLAT_VERSION = 0x4601  # 'F' << 8 | 1
 _PQ_VERSION = 0x5001    # 'P' << 8 | 1
-_BQ_VERSION = 0x4201    # 'B' << 8 | 1
+_BQ_VERSION = 0x4202    # 'B' << 8 | 2 (v2: multi-level scales)
 
 
 def _fetch(a) -> np.ndarray:
@@ -180,6 +180,7 @@ def save_bq(index, fh_or_path) -> None:
         with tracing.range("raft_tpu.distributed.checkpoint.save_bq"):
             serialize_scalar(fh, _BQ_VERSION, np.int32)
             serialize_scalar(fh, int(index.metric), np.int32)
+            serialize_scalar(fh, index.bits, np.int32)
             serialize_array(fh, _fetch(index.centers))
             serialize_array(fh, _fetch(index.rotation))
             serialize_array(fh, _fetch(index.codes))
@@ -202,6 +203,7 @@ def load_bq(res, comms: Comms, fh_or_path):
         check_version(deserialize_scalar(fh), _BQ_VERSION,
                       "distributed ivf_bq")
         metric = DistanceType(int(deserialize_scalar(fh)))
+        int(deserialize_scalar(fh))  # bits — recorded; shape-derivable
         arrays = [deserialize_array(fh) for _ in range(7)]
     finally:
         if own:

@@ -153,3 +153,54 @@ class TestIvfBqLifecycle:
         assert index.size == 0
         with pytest.raises(Exception):
             ivf_bq.search(None, IvfBqSearchParams(), index, x[:2], 5)
+
+
+class TestMultiBit:
+    def test_more_bits_higher_recall(self, dataset):
+        """Residual levels monotonically improve the raw estimator, and
+        2 bits clears a high refined bar."""
+        x, q = dataset
+        _, gt = brute_force.knn(None, x, q, 10)
+        raws = []
+        for bits in (1, 2):
+            index = ivf_bq.build(
+                None, IvfBqIndexParams(n_lists=16, bits=bits), x)
+            assert index.bits == bits
+            _, cand = ivf_bq.search(
+                None, IvfBqSearchParams(n_probes=16), index, q, 80)
+            raw, _, _ = eval_recall(np.asarray(gt),
+                                    np.asarray(cand)[:, :10])
+            raws.append(float(raw))
+        assert raws[1] > raws[0], raws
+        _, i = refine(None, x, q, cand, 10)
+        r, _, _ = eval_recall(np.asarray(gt), np.asarray(i))
+        assert r >= 0.9, r
+
+    def test_bits2_self_distance_zero(self, rng_np):
+        """The global collinearity rescale keeps self-estimates exact
+        at every bit depth."""
+        x = rng_np.standard_normal((500, 32)).astype(np.float32)
+        index = ivf_bq.build(None, IvfBqIndexParams(n_lists=8, bits=2), x)
+        d, i = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
+                             index, x[:8], 1)
+        assert (np.asarray(i)[:, 0] == np.arange(8)).all()
+        # exact in f32; the bf16 cross-term cast leaves rounding
+        # proportional to the residual energy
+        scale = float(np.asarray(index.rnorm2).max())
+        assert np.abs(np.asarray(d)[:, 0]).max() <= 0.02 * scale
+
+    def test_bits2_roundtrip_and_extend(self, rng_np, tmp_path):
+        x = rng_np.standard_normal((2000, 24)).astype(np.float32)
+        index = ivf_bq.build(None, IvfBqIndexParams(n_lists=8, bits=2),
+                             x[:1500])
+        index = ivf_bq.extend(None, index, x[1500:])
+        assert index.size == 2000 and index.bits == 2
+        path = tmp_path / "bq2.bin"
+        ivf_bq.save(index, path)
+        index2 = ivf_bq.load(None, path)
+        assert index2.bits == 2
+        d1, i1 = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
+                               index, x[:4], 5)
+        d2, i2 = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
+                               index2, x[:4], 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))

@@ -13,6 +13,24 @@ from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def streaming_ranks(labels_chunk, fill, n_lists: int):
+    """Host-side within-list rank assignment for the streaming builds:
+    given a chunk's list labels and the running per-list fill counts
+    (np.int64, updated IN PLACE), return each row's destination rank
+    within its padded list."""
+    lab = np.asarray(labels_chunk)
+    m = lab.shape[0]
+    order = np.argsort(lab, kind="stable")
+    sl = lab[order]
+    first_pos = np.searchsorted(sl, np.arange(n_lists))
+    rank_sorted = np.arange(m) - first_pos[sl] + fill[sl]
+    ranks = np.empty((m,), np.int32)
+    ranks[order] = rank_sorted.astype(np.int32)
+    np.add.at(fill, lab, 1)
+    return ranks
 
 
 def padded_extent(sizes) -> int:

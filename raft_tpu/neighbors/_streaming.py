@@ -1,0 +1,39 @@
+"""Shared passes for the streaming index builds (flat / PQ / BQ) —
+the three-pass structure over a :class:`raft_tpu.io.BinDataset`:
+strided trainset sample, per-chunk label predict + size count, then
+each index's own encode+scatter pass (whose rank bookkeeping is
+:func:`raft_tpu.neighbors._packing.streaming_ranks`)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans_balanced
+
+
+def sample_trainset(source, train_rows: int, chunk_rows: int) -> np.ndarray:
+    """Pass 1: a strided ``train_rows``-row sample spanning the whole
+    dataset, assembled chunk by chunk (the stride keeps phase across
+    chunk boundaries)."""
+    n = source.n_rows
+    stride = max(1, n // train_rows)
+    parts = []
+    for first, chunk in source.iter_chunks(chunk_rows):
+        offset = (-first) % stride
+        parts.append(np.asarray(chunk[offset::stride], np.float32))
+    return np.concatenate(parts)[:train_rows]
+
+
+def label_pass(res, km_params, centers, source, chunk_rows: int,
+               n_lists: int):
+    """Pass 2: per-chunk nearest-center labels (device) + per-list
+    population counts (host). Returns ``(labels_np, sizes_np)``."""
+    n = source.n_rows
+    labels_np = np.empty((n,), np.int32)
+    for first, chunk in source.iter_chunks(chunk_rows):
+        lab = kmeans_balanced.predict(
+            res, km_params, centers, jnp.asarray(chunk, jnp.float32))
+        labels_np[first : first + chunk.shape[0]] = np.asarray(lab)
+    sizes_np = np.bincount(labels_np, minlength=n_lists)
+    return labels_np, sizes_np

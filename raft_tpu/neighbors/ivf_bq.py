@@ -52,9 +52,11 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors._batching import tile_queries
+from raft_tpu.neighbors._streaming import label_pass, sample_trainset
 from raft_tpu.neighbors._packing import (
     pack_padded_lists,
     padded_extent,
+    streaming_ranks,
 )
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
@@ -235,6 +237,85 @@ def build(
         if not params.add_data_on_build:
             return empty
         return extend(res, empty, dataset, jnp.arange(n, dtype=jnp.int32))
+
+
+def build_streaming(
+    res: Optional[Resources],
+    params: IvfBqIndexParams,
+    source,
+    chunk_rows: int = 1 << 20,
+    train_rows: int = 1 << 18,
+) -> IvfBqIndex:
+    """Streamed BQ build over a :class:`raft_tpu.io.BinDataset` — the
+    dataset never fully materializes host-side (same three passes as
+    the flat/PQ streaming builds: trainset sample → label count →
+    encode + scatter into donated buffers). Only the sign codes and
+    per-vector scalars live in HBM, so datasets many times HBM fit."""
+    res = ensure_resources(res)
+    n, dim = source.n_rows, source.dim
+    expect(params.n_lists <= n, "n_lists > n_rows")
+
+    with tracing.range("raft_tpu.ivf_bq.build_streaming"):
+        # -- pass 1: trainset sample → centers + rotation via build()
+        train_rows = max(params.n_lists * 2, min(train_rows, n))
+        trainset = sample_trainset(source, train_rows, chunk_rows)
+        empty = build(res, dataclasses.replace(params,
+                                               add_data_on_build=False),
+                      trainset)
+
+        km = KMeansBalancedParams(
+            metric=(DistanceType.InnerProduct
+                    if params.metric == DistanceType.InnerProduct
+                    else DistanceType.L2Expanded))
+
+        # -- pass 2: labels + sizes
+        labels_np, sizes_np = label_pass(res, km, empty.centers, source,
+                                         chunk_rows, params.n_lists)
+        max_size = padded_extent(sizes_np)
+
+        # -- pass 3: encode + scatter with donated buffers
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def encode_scatter(codes_buf, scales_buf, rn2_buf, idx_buf,
+                           rows, labels, ids, ranks):
+            resid = rows - empty.centers[labels]
+            rot = resid @ empty.rotation.T
+            codes, scales, rn2 = _encode(rot, params.bits)
+            return (codes_buf.at[labels, ranks].set(codes),
+                    scales_buf.at[labels, ranks].set(scales),
+                    rn2_buf.at[labels, ranks].set(rn2),
+                    idx_buf.at[labels, ranks].set(ids))
+
+        dim_ext = empty.dim_ext
+        codes_buf = jnp.zeros(
+            (params.n_lists, max_size, params.bits * dim_ext // 8),
+            jnp.uint8)
+        scales_buf = jnp.zeros((params.n_lists, max_size, params.bits),
+                               jnp.float32)
+        rn2_buf = jnp.zeros((params.n_lists, max_size), jnp.float32)
+        idx_buf = jnp.full((params.n_lists, max_size), -1, jnp.int32)
+        fill = np.zeros((params.n_lists,), np.int64)
+        for first, chunk in source.iter_chunks(chunk_rows):
+            m = chunk.shape[0]
+            lab = labels_np[first : first + m]
+            ranks = streaming_ranks(lab, fill, params.n_lists)
+            codes_buf, scales_buf, rn2_buf, idx_buf = encode_scatter(
+                codes_buf, scales_buf, rn2_buf, idx_buf,
+                jnp.asarray(chunk, jnp.float32),
+                jnp.asarray(lab),
+                jnp.asarray(first + np.arange(m, dtype=np.int32)),
+                jnp.asarray(ranks),
+            )
+
+        return IvfBqIndex(
+            centers=empty.centers,
+            rotation=empty.rotation,
+            codes=codes_buf,
+            scales=scales_buf,
+            rnorm2=rn2_buf,
+            indices=idx_buf,
+            list_sizes=jnp.asarray(sizes_np, jnp.int32),
+            metric=DistanceType(params.metric),
+        )
 
 
 def extend(

@@ -48,9 +48,11 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors._batching import tile_queries
+from raft_tpu.neighbors._streaming import label_pass, sample_trainset
 from raft_tpu.neighbors._packing import (
     pack_padded_lists,
     padded_extent,
+    streaming_ranks,
 )
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
@@ -302,13 +304,7 @@ def build_streaming(
     with tracing.range("raft_tpu.ivf_flat.build_streaming"):
         # -- pass 1: trainset sample + centers
         train_rows = max(params.n_lists, min(train_rows, n))
-        stride = max(1, n // train_rows)
-        parts = []
-        for first, chunk in source.iter_chunks(chunk_rows):
-            offset = (-first) % stride
-            parts.append(np.asarray(chunk[offset::stride],
-                                    dtype=np.float32))
-        trainset = np.concatenate(parts)[:train_rows]
+        trainset = sample_trainset(source, train_rows, chunk_rows)
         km_params = KMeansBalancedParams(
             n_iters=params.kmeans_n_iters,
             metric=(DistanceType.InnerProduct
@@ -320,13 +316,8 @@ def build_streaming(
                                       params.n_lists)
 
         # -- pass 2: labels + sizes
-        labels_np = np.empty((n,), np.int32)
-        for first, chunk in source.iter_chunks(chunk_rows):
-            lab = kmeans_balanced.predict(
-                res, km_params, centers,
-                jnp.asarray(chunk, jnp.float32))
-            labels_np[first : first + chunk.shape[0]] = np.asarray(lab)
-        sizes_np = np.bincount(labels_np, minlength=params.n_lists)
+        labels_np, sizes_np = label_pass(res, km_params, centers, source,
+                                         chunk_rows, params.n_lists)
         max_size = padded_extent(sizes_np)
 
         # -- pass 3: scatter chunks into donated padded buffers. Indexing
@@ -344,13 +335,7 @@ def build_streaming(
         for first, chunk in source.iter_chunks(chunk_rows):
             m = chunk.shape[0]
             lab = labels_np[first : first + m]
-            order = np.argsort(lab, kind="stable")
-            sl = lab[order]
-            first_pos = np.searchsorted(sl, np.arange(params.n_lists))
-            rank_sorted = np.arange(m) - first_pos[sl] + fill[sl]
-            ranks = np.empty((m,), np.int32)
-            ranks[order] = rank_sorted.astype(np.int32)
-            np.add.at(fill, lab, 1)
+            ranks = streaming_ranks(lab, fill, params.n_lists)
             data, indices = scatter_chunk(
                 data, indices,
                 jnp.asarray(chunk, jnp.float32),

@@ -166,3 +166,35 @@ class TestPipeline:
                               mem, q, 10)
         r2, _, _ = eval_recall(gt, np.asarray(i2))
         assert abs(r - r2) < 0.12, (r, r2)
+
+    def test_bq_build_streaming(self, tmp_path, rng_np):
+        """Streamed BQ build matches the in-memory build's search
+        results (same trainer shapes, same encoding)."""
+        from raft_tpu.io import BinDataset, write_bin
+        from raft_tpu.neighbors import ivf_bq
+        from raft_tpu.neighbors.refine import refine
+        from raft_tpu.utils import eval_recall
+
+        x = rng_np.standard_normal((4000, 32)).astype(np.float32)
+        q = rng_np.standard_normal((16, 32)).astype(np.float32)
+        path = tmp_path / "d.fbin"
+        write_bin(path, x)
+        with BinDataset(path) as ds:
+            index = ivf_bq.build_streaming(
+                None, ivf_bq.IvfBqIndexParams(n_lists=16, bits=2), ds,
+                chunk_rows=1024)
+        assert index.size == 4000 and index.bits == 2
+
+        mem = ivf_bq.build(None, ivf_bq.IvfBqIndexParams(
+            n_lists=16, bits=2), x)
+        sp = ivf_bq.IvfBqSearchParams(n_probes=16)
+        _, i1 = ivf_bq.search(None, sp, index, q, 20)
+        _, i2 = ivf_bq.search(None, sp, mem, q, 20)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+        # end-to-end recall with refine
+        d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        gt = np.argsort(d2, axis=1, kind="stable")[:, :10]
+        _, i = refine(None, x, q, i1, 10)
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        assert r >= 0.8, r

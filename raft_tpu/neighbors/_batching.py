@@ -30,3 +30,17 @@ def tile_queries(
         outs_d.append(d)
         outs_i.append(i)
     return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+
+
+def coarse_select(score, n_probes: int, coarse_algo: str,
+                  recall_target: float = 0.95):
+    """Shared coarse cluster selection for the IVF search entries:
+    larger-is-better ``score`` (q, n_lists) → (q, n_probes) int32 list
+    ids, via exact ``top_k`` or the TPU's native approximate top-k
+    unit (``coarse_algo="approx"`` — worthwhile at 10k+ lists)."""
+    if coarse_algo == "approx":
+        _, probes = jax.lax.approx_max_k(score, n_probes,
+                                         recall_target=recall_target)
+    else:
+        _, probes = jax.lax.top_k(score, n_probes)
+    return probes.astype(jnp.int32)

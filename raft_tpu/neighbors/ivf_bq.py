@@ -51,7 +51,7 @@ from raft_tpu.core.serialize import (
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
-from raft_tpu.neighbors._batching import tile_queries
+from raft_tpu.neighbors._batching import coarse_select, tile_queries
 from raft_tpu.neighbors._streaming import label_pass, sample_trainset
 from raft_tpu.neighbors._packing import (
     pack_padded_lists,
@@ -80,6 +80,9 @@ class IvfBqIndexParams(IndexParams):
 @dataclasses.dataclass(frozen=True)
 class IvfBqSearchParams(SearchParams):
     n_probes: int = 20
+    # "approx" routes cluster selection through the TPU's native
+    # approximate top-k unit (same knob as the flat/PQ params)
+    coarse_algo: str = "exact"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -420,9 +423,11 @@ def score_probe(lists, qrot, centers_rot, ip, cn, qnorm, codes, scales,
     return jnp.where(ok, dist, pad_val), row_ids
 
 
-@partial(jax.jit, static_argnames=("n_probes", "k", "metric"))
+@partial(jax.jit, static_argnames=("n_probes", "k", "metric",
+                                   "coarse_algo"))
 def _search_impl(queries, centers, rotation, codes, scales, rn2, indices,
-                 filter_words, n_probes: int, k: int, metric: DistanceType):
+                 filter_words, n_probes: int, k: int, metric: DistanceType,
+                 coarse_algo: str = "exact"):
     q, dim = queries.shape
     select_min = is_min_close(metric)
     qf = queries.astype(jnp.float32)
@@ -435,14 +440,14 @@ def _search_impl(queries, centers, rotation, codes, scales, rn2, indices,
         preferred_element_type=jnp.float32,
     )
     if ip_metric:
-        _, probes = jax.lax.top_k(ip, n_probes)
+        score = ip
         c_norms = None
         qnorm = None
     else:
         c_norms = jnp.sum(jnp.square(centers), axis=1)
-        _, probes = jax.lax.top_k(-(c_norms[None, :] - 2.0 * ip), n_probes)
+        score = -(c_norms[None, :] - 2.0 * ip)
         qnorm = jnp.sum(jnp.square(qf), axis=1)
-    probes = probes.astype(jnp.int32)
+    probes = coarse_select(score, n_probes, coarse_algo)
     pad_val = jnp.inf if select_min else -jnp.inf
 
     # probe-invariant precomputation: the rotated query never changes,
@@ -489,13 +494,16 @@ def search(
            "queries must be (q, dim)")
     expect(index.max_list_size > 0, "index is empty — extend() it first")
     n_probes = min(params.n_probes, index.n_lists)
+    expect(params.coarse_algo in ("exact", "approx"),
+           f"coarse_algo must be 'exact' or 'approx', got "
+           f"{params.coarse_algo!r}")
     filter_words = resolve_filter_words(sample_filter)
     with tracing.range("raft_tpu.ivf_bq.search"):
         def run(qt, fw):
             return _search_impl(
                 qt, index.centers, index.rotation, index.codes,
                 index.scales, index.rnorm2, index.indices, fw,
-                n_probes, k, index.metric)
+                n_probes, k, index.metric, params.coarse_algo)
 
         return tile_queries(run, queries, filter_words, query_tile)
 

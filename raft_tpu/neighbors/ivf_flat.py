@@ -47,7 +47,7 @@ from raft_tpu.core.serialize import (
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
-from raft_tpu.neighbors._batching import tile_queries
+from raft_tpu.neighbors._batching import coarse_select, tile_queries
 from raft_tpu.neighbors._streaming import label_pass, sample_trainset
 from raft_tpu.neighbors._packing import (
     pack_padded_lists,
@@ -382,12 +382,7 @@ def _search_impl(queries, centers, center_norms, data, data_norms, indices,
     )
     score = (ip if metric == DistanceType.InnerProduct
              else -(center_norms[None, :] - 2.0 * ip))          # larger=better
-    if coarse_algo == "approx":
-        _, probes = jax.lax.approx_max_k(score, n_probes,
-                                         recall_target=0.95)
-    else:
-        _, probes = jax.lax.top_k(score, n_probes)
-    probes = probes.astype(jnp.int32)                           # (q, n_probes)
+    probes = coarse_select(score, n_probes, coarse_algo)
 
     pad_val = jnp.inf if select_min else -jnp.inf
 

@@ -54,7 +54,7 @@ from raft_tpu.core.serialize import (
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
-from raft_tpu.neighbors._batching import tile_queries
+from raft_tpu.neighbors._batching import coarse_select, tile_queries
 from raft_tpu.neighbors._streaming import label_pass, sample_trainset
 from raft_tpu.neighbors._packing import (
     pack_padded_lists,
@@ -94,6 +94,10 @@ class IvfPqSearchParams(SearchParams):
     reference's fp32/fp16/fp8 LUT variants."""
 
     n_probes: int = 20
+    # "approx" routes cluster selection through the TPU's native
+    # approximate top-k unit — worthwhile at 10k+ lists (same knob as
+    # IvfFlatSearchParams.coarse_algo)
+    coarse_algo: str = "exact"
     lut_dtype: jnp.dtype = jnp.float32
     # "gather": per-element LUT lookup; "onehot": gather-free MXU
     # contraction (J-fold more FLOPs, no dynamic gathers). "auto"
@@ -637,11 +641,13 @@ def _probe_lut(qf, c, qsub_fixed, lut_fixed, rotation, codebooks, lists,
 
 
 @partial(jax.jit, static_argnames=("n_probes", "k", "metric", "codebook_kind",
-                                   "lut_dtype", "score_mode", "packed"))
+                                   "lut_dtype", "score_mode", "packed",
+                                   "coarse_algo"))
 def _search_impl(queries, centers, rotation, codebooks, codes, indices,
                  filter_words, n_probes: int, k: int, metric: DistanceType,
                  codebook_kind: CodebookKind, lut_dtype,
-                 score_mode: str = "gather", packed: bool = False):
+                 score_mode: str = "gather", packed: bool = False,
+                 coarse_algo: str = "exact"):
     q, dim = queries.shape
     n_lists, max_size, pq_dim = codes.shape
     if packed:
@@ -658,12 +664,9 @@ def _search_impl(queries, centers, rotation, codebooks, codes, indices,
         precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32,
     )
-    if metric == DistanceType.InnerProduct:
-        _, probes = jax.lax.top_k(ip, n_probes)
-    else:
-        c_norms = jnp.sum(jnp.square(centers), axis=1)
-        _, probes = jax.lax.top_k(-(c_norms[None, :] - 2.0 * ip), n_probes)
-    probes = probes.astype(jnp.int32)
+    score = (ip if metric == DistanceType.InnerProduct
+             else -(jnp.sum(jnp.square(centers), axis=1)[None, :] - 2.0 * ip))
+    probes = coarse_select(score, n_probes, coarse_algo)
 
     pad_val = jnp.inf if select_min else -jnp.inf
 
@@ -741,6 +744,9 @@ def search(
            "queries must be (q, dim)")
     expect(index.max_list_size > 0, "index is empty — extend() it first")
     n_probes = min(params.n_probes, index.n_lists)
+    expect(params.coarse_algo in ("exact", "approx"),
+           f"coarse_algo must be 'exact' or 'approx', got "
+           f"{params.coarse_algo!r}")
     filter_words = resolve_filter_words(sample_filter)
     score_mode = resolve_score_mode(params.score_mode, index.pq_book_size)
     with tracing.range("raft_tpu.ivf_pq.search"):
@@ -750,6 +756,7 @@ def search(
                 index.codes, index.indices, fw,
                 n_probes, k, index.metric, index.codebook_kind,
                 params.lut_dtype, score_mode, index.packed,
+                params.coarse_algo,
             )
 
         return tile_queries(run, queries, filter_words, query_tile)

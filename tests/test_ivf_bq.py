@@ -204,3 +204,19 @@ class TestMultiBit:
         d2, i2 = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
                                index2, x[:4], 5)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+
+class TestApproxCoarse:
+    def test_approx_coarse(self, dataset):
+        x, q = dataset
+        index = ivf_bq.build(None, IvfBqIndexParams(n_lists=16), x)
+        _, i1 = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
+                              index, q, 10)
+        _, i2 = ivf_bq.search(
+            None, IvfBqSearchParams(n_probes=8, coarse_algo="approx"),
+            index, q, 10)
+        r, _, _ = eval_recall(np.asarray(i1), np.asarray(i2))
+        assert r >= 0.9, r
+        with pytest.raises(Exception):
+            ivf_bq.search(None, IvfBqSearchParams(coarse_algo="bogus"),
+                          index, q, 5)

@@ -310,3 +310,26 @@ class TestNibblePacking:
         index3 = ivf_pq.extend(None, index, x[:100],
                                np.arange(2000, 2100, dtype=np.int32))
         assert index3.packed and index3.size == 2100
+
+
+class TestApproxCoarse:
+    def test_approx_coarse_matches_exact_closely(self, dataset):
+        """coarse_algo='approx' (TPU approximate top-k unit; exact
+        fallback semantics on CPU) returns near-identical results."""
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.utils import eval_recall
+
+        x, q = dataset
+        index = ivf_pq.build(
+            None, ivf_pq.IvfPqIndexParams(n_lists=16, pq_dim=16), x)
+        _, i1 = ivf_pq.search(
+            None, ivf_pq.IvfPqSearchParams(n_probes=8), index, q, 10)
+        _, i2 = ivf_pq.search(
+            None, ivf_pq.IvfPqSearchParams(n_probes=8,
+                                           coarse_algo="approx"),
+            index, q, 10)
+        r, _, _ = eval_recall(np.asarray(i1), np.asarray(i2))
+        assert r >= 0.9, r
+        with pytest.raises(Exception):
+            ivf_pq.search(None, ivf_pq.IvfPqSearchParams(
+                coarse_algo="bogus"), index, q, 5)

@@ -1,9 +1,19 @@
 #!/usr/bin/env bash
-# Test orchestration — role of the reference's ci/test_python.sh /
-# test_cpp.sh (pytest + ctest). One suite here: the Python tests cover
-# the whole framework; the native IO library is built on demand by the
-# io module and exercised through tests/test_io.py.
+# CI orchestration — role of the reference's ci/ tree:
+#   ci/checks/check_style.sh  -> ci/check_style.py (AST lint, no deps)
+#   ci/test_python.sh / ctest -> pytest (tests cover the whole framework;
+#                                native IO is built on demand via tests/test_io.py)
+#   wheel smoke tests         -> editable install + bare import + CLI --help
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== style =="
+python ci/check_style.py
+
+echo "== packaging smoke =="
+python -m pip install -e . --no-deps --no-build-isolation --quiet
+(cd /tmp && JAX_PLATFORMS=cpu python -c "import raft_tpu; print('import OK', raft_tpu.__name__)")
+JAX_PLATFORMS=cpu python -m raft_tpu.bench --help > /dev/null && echo "bench CLI OK"
+
+echo "== tests =="
 python -m pytest tests/ -q "$@"

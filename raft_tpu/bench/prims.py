@@ -30,7 +30,6 @@ import argparse
 import dataclasses
 import json
 import os
-import sys
 import time
 from typing import Callable, Dict, List, Optional
 

@@ -14,7 +14,7 @@ import dataclasses
 import json
 import pathlib
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List
 
 import numpy as np
 

@@ -8,7 +8,7 @@ every public entry point (host-side, zero cost under jit tracing).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np

@@ -112,11 +112,11 @@ def build_bq(
 
 
 @partial(jax.jit, static_argnames=("axis", "mesh", "n_probes", "k", "metric",
-                                   "probe_mode", "query_axis"))
+                                   "probe_mode", "query_axis", "coarse_algo"))
 def _dist_search_bq(centers, rotation, codes, scales, rn2, indices, queries,
                     axis: str, mesh, n_probes: int, k: int,
                     metric: DistanceType, probe_mode: str,
-                    query_axis=None):
+                    query_axis=None, coarse_algo: str = "exact"):
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
     ip_metric = metric == DistanceType.InnerProduct
@@ -140,7 +140,7 @@ def _dist_search_bq(centers, rotation, codes, scales, rn2, indices, queries,
             qnorm = jnp.sum(jnp.square(qf), axis=1)
 
         local, mine = select_probes_sharded(coarse, n_probes, axis,
-                                            probe_mode)
+                                            probe_mode, coarse_algo)
 
         qrot = qf @ rotation.T
         centers_rot = None if ip_metric else centers_l @ rotation.T
@@ -203,6 +203,9 @@ def search_bq(
     qsharding = resolve_query_sharding(comms, queries, query_axis)
     n_probes = resolve_probe_budget(params.n_probes, index.n_lists,
                                     comms.size, probe_mode)
+    expect(params.coarse_algo in ("exact", "approx"),
+           f"coarse_algo must be 'exact' or 'approx', got "
+           f"{params.coarse_algo!r}")
     queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_bq.search"):
         def run(qt, _fw):
@@ -210,6 +213,7 @@ def search_bq(
                 index.centers, index.rotation, index.codes, index.scales,
                 index.rnorm2, index.indices, qt, comms.axis, comms.mesh,
                 n_probes, k, index.metric, probe_mode, query_axis,
+                params.coarse_algo,
             )
 
         if query_axis is not None:

@@ -46,6 +46,7 @@ from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
 from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
+from raft_tpu.neighbors._batching import coarse_select
 from raft_tpu.neighbors._packing import padded_extent
 from raft_tpu.neighbors.brute_force import knn_merge_parts
 from raft_tpu.neighbors.ivf_flat import IvfFlatIndexParams, IvfFlatSearchParams
@@ -97,7 +98,7 @@ def deal_order(sizes: np.ndarray, r: int) -> np.ndarray:
 
 
 def select_probes_sharded(coarse, n_probes: int, axis: str,
-                          probe_mode: str):
+                          probe_mode: str, coarse_algo: str = "exact"):
     """Shared probe selection inside a shard_map body — THE
     probe-ownership arithmetic for every list-sharded index family.
 
@@ -108,6 +109,11 @@ def select_probes_sharded(coarse, n_probes: int, axis: str,
     - ``"global"``: all_gather every shard's coarse block, take the
       global top-``n_probes``, keep the locally-owned ones.
     - ``"local"``: each shard probes its own top-``n_probes`` lists.
+
+    ``coarse_algo="approx"`` swaps the probe top-k for the TPU's
+    native approximate top-k unit, via the same
+    :func:`raft_tpu.neighbors._batching.coarse_select` dispatch the
+    single-chip searches use.
     """
     q, n_local = coarse.shape
     if probe_mode == "global":
@@ -115,14 +121,13 @@ def select_probes_sharded(coarse, n_probes: int, axis: str,
         r = coarse_all.shape[0]
         coarse_flat = jnp.moveaxis(coarse_all, 0, 1).reshape(
             q, r * n_local)
-        _, probes = jax.lax.top_k(-coarse_flat, n_probes)
-        probes = probes.astype(jnp.int32)
+        probes = coarse_select(-coarse_flat, n_probes, coarse_algo)
         owner = probes // n_local
         local = probes - owner * n_local
         mine = owner == jax.lax.axis_index(axis)
         return local, mine
-    _, probes = jax.lax.top_k(-coarse, n_probes)
-    return probes.astype(jnp.int32), jnp.ones(probes.shape, jnp.bool_)
+    probes = coarse_select(-coarse, n_probes, coarse_algo)
+    return probes, jnp.ones(probes.shape, jnp.bool_)
 
 
 def resolve_query_sharding(comms: Comms, queries, query_axis):
@@ -195,11 +200,12 @@ def build(
 
 
 @partial(jax.jit, static_argnames=("axis", "mesh", "n_probes", "k", "metric",
-                                   "probe_mode", "query_axis"))
+                                   "probe_mode", "query_axis", "coarse_algo"))
 def _dist_search(centers, data, data_norms, indices, queries,
                  axis: str, mesh, n_probes: int, k: int,
                  metric: DistanceType, probe_mode: str,
-                 query_axis: Optional[str] = None):
+                 query_axis: Optional[str] = None,
+                 coarse_algo: str = "exact"):
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
 
@@ -221,7 +227,7 @@ def _dist_search(centers, data, data_norms, indices, queries,
             coarse = cn[None, :] - 2.0 * ip
 
         local, mine = select_probes_sharded(coarse, n_probes, axis,
-                                            probe_mode)
+                                            probe_mode, coarse_algo)
 
         def step(carry, rank_i):
             best_d, best_i = carry
@@ -296,12 +302,15 @@ def search(
     qsharding = resolve_query_sharding(comms, queries, query_axis)
     n_probes = resolve_probe_budget(params.n_probes, index.n_lists,
                                     comms.size, probe_mode)
+    expect(params.coarse_algo in ("exact", "approx"),
+           f"coarse_algo must be 'exact' or 'approx', got "
+           f"{params.coarse_algo!r}")
     queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_flat.search"):
         return _dist_search(
             index.centers, index.data, index.data_norms, index.indices,
             queries, comms.axis, comms.mesh, n_probes, k, index.metric,
-            probe_mode, query_axis,
+            probe_mode, query_axis, params.coarse_algo,
         )
 
 
@@ -504,14 +513,16 @@ def build_pq(
 
 @partial(jax.jit, static_argnames=("axis", "mesh", "n_probes", "k", "metric",
                                    "probe_mode", "query_axis",
-                                   "codebook_kind", "score_mode", "lut_dtype"))
+                                   "codebook_kind", "score_mode", "lut_dtype",
+                                   "coarse_algo"))
 def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
                     axis: str, mesh, n_probes: int, k: int,
                     metric: DistanceType, probe_mode: str,
                     query_axis: Optional[str] = None,
                     codebook_kind: CodebookKind = CodebookKind.PER_SUBSPACE,
                     score_mode: str = "gather",
-                    lut_dtype=jnp.float32):
+                    lut_dtype=jnp.float32,
+                    coarse_algo: str = "exact"):
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
     pq_dim = codes.shape[2]
@@ -537,7 +548,7 @@ def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
             coarse = cn[None, :] - 2.0 * ip
 
         local, mine = select_probes_sharded(coarse, n_probes, axis,
-                                            probe_mode)
+                                            probe_mode, coarse_algo)
 
         qsub_fixed = (qf @ rotation.T).reshape(q, pq_dim, pq_len)
         lut_fixed = (jnp.einsum("qsl,sjl->qsj", qsub_fixed, books_l)
@@ -551,10 +562,13 @@ def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
             lut, base = ivf_pq_mod._probe_lut(
                 qf, c, qsub_fixed, lut_fixed, rotation, books_l, lists,
                 ip_metric, per_cluster)
-            lut = lut.astype(lut_dtype)
+            lut, lut_scale = ivf_pq_mod.quantize_lut(lut, lut_dtype)
             rows = jnp.take(codes_l, lists, axis=0)       # (q, m, s) u8
             row_ids = jnp.take(ids_l, lists, axis=0)
-            dist = score(lut, rows) + base[:, None]
+            dist = score(lut, rows)
+            if lut_scale is not None:
+                dist = dist * lut_scale
+            dist = dist + base[:, None]
             dist = jnp.where((row_ids >= 0) & valid[:, None], dist, pad_val)
             return merge_topk(best_d, best_i, dist, row_ids, k,
                               select_min), None
@@ -603,6 +617,9 @@ def search_pq(
     qsharding = resolve_query_sharding(comms, queries, query_axis)
     n_probes = resolve_probe_budget(params.n_probes, index.n_lists,
                                     comms.size, probe_mode)
+    expect(params.coarse_algo in ("exact", "approx"),
+           f"coarse_algo must be 'exact' or 'approx', got "
+           f"{params.coarse_algo!r}")
     queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_pq.search"):
         return _dist_search_pq(
@@ -610,4 +627,5 @@ def search_pq(
             index.indices, queries, comms.axis, comms.mesh, n_probes, k,
             index.metric, probe_mode, query_axis,
             index.codebook_kind, params.score_mode, params.lut_dtype,
+            params.coarse_algo,
         )

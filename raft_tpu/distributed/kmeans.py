@@ -12,7 +12,7 @@ inertia reduction (``cluster/detail/kmeans.cuh``).
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

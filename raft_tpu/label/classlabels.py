@@ -8,7 +8,7 @@ jumping over a static min-label table — ``ceil(log2 n)`` fixed rounds.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -61,7 +61,9 @@ __all__ = [
     "add",
     "binary_op",
     "divide",
-    "map",
+    # ``map`` stays importable (reference parity: raft/linalg/map.cuh) but is
+    # deliberately omitted from __all__ so star-imports don't shadow the
+    # Python builtin.
     "map_offset",
     "transpose",
     "multiply",

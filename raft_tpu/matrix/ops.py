@@ -8,7 +8,7 @@ linewise op, reverse, triangular, print).
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -87,8 +87,8 @@ def triangular_lower(matrix) -> jax.Array:
 def matrix_print(matrix, name: str = "matrix", max_rows: int = 8, max_cols: int = 8):
     """Host-side pretty print (``matrix/print.cuh``)."""
     arr = np.asarray(jax.device_get(matrix))
-    print(f"{name} shape={arr.shape} dtype={arr.dtype}")
-    print(np.array2string(arr[:max_rows, :max_cols], precision=4))
+    print(f"{name} shape={arr.shape} dtype={arr.dtype}")  # noqa: print is the op
+    print(np.array2string(arr[:max_rows, :max_cols], precision=4))  # noqa
 
 
 def copy(matrix) -> jax.Array:

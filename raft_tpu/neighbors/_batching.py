@@ -4,7 +4,7 @@ slicing of 2-D (per-query) filter words."""
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -20,7 +20,6 @@ The index object precomputes database norms, mirroring
 from __future__ import annotations
 
 import dataclasses
-import io
 from functools import partial
 from typing import Optional, Tuple
 

@@ -172,9 +172,10 @@ def build(
                     jnp.ones((n,), jnp.int32), labels, num_segments=C)
                 max_size = int(jnp.max(sizes))
                 # coarse bucket (multiple of half the target size) so
-                # the data-dependent max cluster size lands on the same
-                # padded shape across passes — one _one_pass compile,
-                # not one per pass (remote compiles cost minutes)
+                # nearby data-dependent max cluster sizes land on the
+                # same padded shape — passes recompile _one_pass only
+                # when their max size crosses a bucket boundary, not on
+                # every fluctuation (remote compiles cost minutes)
                 bucket = max(8, params.target_cluster_size // 2)
                 max_size = max(8, -(-max_size // bucket) * bucket)
                 idx = _pack_cluster_indices(labels, C, max_size)

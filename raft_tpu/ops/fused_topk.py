@@ -20,7 +20,7 @@ MXU distance work, and free of gathers/sorts that Mosaic lowers poorly.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -124,10 +124,25 @@ def _knn_kernel(q_ref, qn_ref, x_ref, xn_ref, outd_ref, outi_ref,
 
 def _default_vmem_mb() -> int:
     """Per-kernel Mosaic VMEM budget (MB) — resolved OUTSIDE jit so the
-    env var is honored per call, not frozen into the first trace."""
+    env var is honored per call, not frozen into the first trace.
+
+    The default is derived from the attached device generation: v4+
+    parts carry 128 MB of physical VMEM per core (64 MB budget leaves
+    headroom, measured safe on v5e), while v2/v3 and unrecognized
+    kinds fall back to a conservative 16 MB so Mosaic compiles where a
+    64 MB request would be rejected. ``RAFT_TPU_VMEM_MB`` overrides."""
     import os
 
-    return int(os.environ.get("RAFT_TPU_VMEM_MB", "64"))
+    env = os.environ.get("RAFT_TPU_VMEM_MB")
+    if env:
+        return int(env)
+    try:
+        kind = jax.local_devices()[0].device_kind.lower()
+    except Exception:
+        return 16
+    if any(g in kind for g in ("v4", "v5", "v6", "v7")):
+        return 64
+    return 16
 
 
 def fused_knn(

@@ -10,7 +10,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from raft_tpu.random.rng import RngState, _key_of
+from raft_tpu.random.rng import _key_of
 
 
 def make_blobs(

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
-from raft_tpu.sparse.types import COO, CSR
+from raft_tpu.sparse.types import CSR
 
 
 @dataclasses.dataclass

@@ -16,7 +16,6 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources

@@ -67,7 +67,7 @@ def main():
                 emit(f"fknn_{tag}_tile{tile}_slope", error=str(e)[:160])
 
     # ---- 2. datasets for the ANN pieces
-    from raft_tpu.neighbors import brute_force, cagra, cluster_join, ivf_flat, ivf_pq
+    from raft_tpu.neighbors import brute_force, cagra, ivf_flat, ivf_pq
     from raft_tpu.utils import eval_recall
 
     rng = np.random.default_rng(0)

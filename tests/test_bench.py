@@ -2,7 +2,6 @@
 (reference ``python/raft-ann-bench`` CLI behavior)."""
 
 import json
-import pathlib
 
 import numpy as np
 import pytest

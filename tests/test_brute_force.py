@@ -2,7 +2,6 @@
 tiled_knn.cu): exact match vs numpy ground truth, tiling invariance,
 merge_parts, serialization."""
 
-import io
 
 import numpy as np
 import pytest

@@ -10,7 +10,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu import comms as comms_mod
 from raft_tpu.comms import Comms, Op, local_comms
 from raft_tpu.comms.comms import (
     allgather,
@@ -24,7 +23,6 @@ from raft_tpu.comms.comms import (
 )
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.distributed import (
-    ShardedIndex,
     brute_force_knn,
     build_sharded,
     kmeans_fit,
@@ -531,6 +529,62 @@ class TestDistributedCheckpoint:
         checkpoint.save_pq(idx, path)
         idx2 = checkpoint.load_pq(None, comms, path)
         d1, i1 = divf.search_pq(None, sp, idx2, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(np.asarray(d0), np.asarray(d1),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("kind", ["flat", "pq", "pq_cluster", "bq"])
+    def test_multihost_scheme_roundtrip(self, rng_np, tmp_path, kind):
+        """The per-process part-file scheme on a single-process mesh
+        (one part): results identical through save -> reshard 8->4 ->
+        load, for all three index families (the cross-process case is
+        tests/test_multiprocess.py)."""
+        import jax
+        from raft_tpu.comms import Comms
+        from raft_tpu.comms.bootstrap import make_mesh
+        from raft_tpu.distributed import bq as dist_bq
+        from raft_tpu.distributed import checkpoint, ivf_flat as divf
+        from raft_tpu.neighbors import ivf_bq
+        from raft_tpu.neighbors.ivf_flat import (
+            IvfFlatIndexParams,
+            IvfFlatSearchParams,
+        )
+        from raft_tpu.neighbors.ivf_pq import (
+            CodebookKind,
+            IvfPqIndexParams,
+            IvfPqSearchParams,
+        )
+
+        comms = local_comms()
+        x = rng_np.standard_normal((4096, 32)).astype(np.float32)
+        q = rng_np.standard_normal((8, 32)).astype(np.float32)
+        if kind == "flat":
+            idx = divf.build(None, comms, IvfFlatIndexParams(n_lists=16), x)
+            sp = IvfFlatSearchParams(n_probes=8)
+            search = lambda c, i: divf.search(None, sp, i, q, 5)
+            save, load = checkpoint.save_flat_multihost, checkpoint.load_flat_multihost
+        elif kind in ("pq", "pq_cluster"):
+            ck = (CodebookKind.PER_CLUSTER if kind == "pq_cluster"
+                  else CodebookKind.PER_SUBSPACE)
+            idx = divf.build_pq(
+                None, comms,
+                IvfPqIndexParams(n_lists=16, pq_dim=16, codebook_kind=ck), x)
+            sp = IvfPqSearchParams(n_probes=8)
+            search = lambda c, i: divf.search_pq(None, sp, i, q, 5)
+            save, load = checkpoint.save_pq_multihost, checkpoint.load_pq_multihost
+        else:
+            idx = dist_bq.build_bq(
+                None, comms, ivf_bq.IvfBqIndexParams(n_lists=16), x)
+            sp = ivf_bq.IvfBqSearchParams(n_probes=8)
+            search = lambda c, i: dist_bq.search_bq(None, sp, i, q, 5)
+            save, load = checkpoint.save_bq_multihost, checkpoint.load_bq_multihost
+
+        d0, i0 = search(comms, idx)
+        ckpt = str(tmp_path / "mh")
+        save(idx, ckpt)
+        comms4 = Comms(make_mesh(devices=jax.devices()[:4]), "data")
+        idx4 = load(None, comms4, ckpt)
+        d1, i1 = search(comms4, idx4)
         np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
         np.testing.assert_allclose(np.asarray(d0), np.asarray(d1),
                                    rtol=1e-5, atol=1e-5)

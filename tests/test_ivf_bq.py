@@ -3,7 +3,6 @@ reference analog; quantizer follows the RaBitQ line). Pattern matches
 the IVF-PQ suite: recall floor with refinement rescue, exhaustive-probe
 sanity, filters, serialization round-trip, packing invariants."""
 
-import io
 
 import numpy as np
 import pytest

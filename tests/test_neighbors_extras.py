@@ -4,7 +4,6 @@
 
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from raft_tpu.distance.masked_nn import compress_to_bits, masked_l2_nn
 from raft_tpu.distance.types import DistanceType

@@ -4,6 +4,7 @@
 from raft_tpu.neighbors import ball_cover
 from raft_tpu.neighbors import brute_force
 from raft_tpu.neighbors import cagra
+from raft_tpu.neighbors import hnsw
 from raft_tpu.neighbors import cluster_join
 from raft_tpu.neighbors import epsilon_neighborhood
 from raft_tpu.neighbors import ivf_bq
@@ -21,6 +22,7 @@ __all__ = [
     "ball_cover",
     "brute_force",
     "cagra",
+    "hnsw",
     "cluster_join",
     "epsilon_neighborhood",
     "eps_neighbors",

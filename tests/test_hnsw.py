@@ -1,0 +1,174 @@
+"""CAGRA <-> hnswlib interop tests — role of the reference's hnswlib
+bridge tests (serialize_to_hnswlib round-trip + recall-after-load).
+hnswlib isn't shipped in this image, so the file-format contract is
+enforced two ways: a byte-level header check against the layout
+hnswlib's ``loadIndex`` requires, and a full round-trip through
+``load_hnswlib`` (an independent parser of the same format) verifying
+the graph, the vectors, and the search recall survive. When hnswlib IS
+importable the load_index check runs for real."""
+
+import struct
+
+import numpy as np
+import pytest
+import scipy.spatial.distance as spd
+
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors import cagra, hnsw
+from raft_tpu.neighbors.cagra import (
+    BuildAlgo,
+    CagraIndexParams,
+    CagraSearchParams,
+)
+from raft_tpu.utils import eval_recall
+
+try:
+    import hnswlib as hnswlib_mod
+except ImportError:
+    hnswlib_mod = None
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(11)
+    centers = rng.standard_normal((12, 24)) * 4
+    labels = rng.integers(0, 12, 2000)
+    x = (centers[labels] + rng.standard_normal((2000, 24))).astype(np.float32)
+    q = (centers[rng.integers(0, 12, 32)]
+         + rng.standard_normal((32, 24))).astype(np.float32)
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def index(dataset):
+    x, _ = dataset
+    params = CagraIndexParams(graph_degree=16, intermediate_graph_degree=32,
+                              build_algo=BuildAlgo.NN_DESCENT)
+    return cagra.build(None, params, x)
+
+
+def _gt(x, q, k):
+    d = spd.cdist(q, x, "sqeuclidean")
+    return np.argsort(d, axis=1, kind="stable")[:, :k]
+
+
+class TestSaveHnswlib:
+    def test_header_layout(self, index, tmp_path):
+        path = str(tmp_path / "cagra.hnsw")
+        hnsw.save_hnswlib(None, index, path)
+        raw = open(path, "rb").read()
+        hdr = struct.Struct("<QQQQQQiIQQQdQ")
+        (off0, max_elems, count, per_elem, label_off, data_off,
+         maxlevel, entry, max_m, max_m0, m, mult, efc) = \
+            hdr.unpack_from(raw, 0)
+        n, degree = index.graph.shape
+        dim = index.dataset.shape[1]
+        # exactly the arithmetic hnswlib's loadIndex recomputes and asserts
+        assert off0 == 0 and max_elems == n and count == n
+        assert max_m0 == degree and m == max_m == degree // 2
+        assert data_off == 4 + 4 * degree
+        assert label_off == data_off + 4 * dim
+        assert per_elem == label_off + 8
+        assert maxlevel == 0 and entry == 0
+        assert mult == pytest.approx(1.0 / np.log(degree // 2))
+        assert efc > 0
+        # file length: header + n elements + n u32 zero link-list sizes
+        assert len(raw) == hdr.size + n * per_elem + 4 * n
+        # the trailing per-element upper-level sizes are all zero
+        tail = np.frombuffer(raw, dtype="<u4", offset=hdr.size + n * per_elem)
+        assert (tail == 0).all()
+
+    def test_round_trip_graph_and_data(self, index, tmp_path):
+        path = str(tmp_path / "cagra.hnsw")
+        hnsw.save_hnswlib(None, index, path)
+        loaded = hnsw.load_hnswlib(None, path, index.dataset.shape[1],
+                                   metric=index.metric)
+        np.testing.assert_array_equal(np.asarray(loaded.graph),
+                                      np.asarray(index.graph))
+        np.testing.assert_array_equal(np.asarray(loaded.dataset),
+                                      np.asarray(index.dataset))
+
+    def test_search_after_round_trip(self, dataset, index, tmp_path):
+        x, q = dataset
+        path = str(tmp_path / "cagra.hnsw")
+        hnsw.save_hnswlib(None, index, path)
+        loaded = hnsw.load_hnswlib(None, path, x.shape[1])
+        sp = CagraSearchParams(itopk_size=64)
+        _, ids = cagra.search(None, sp, loaded, q, 10)
+        r, _, _ = eval_recall(_gt(x, q, 10), np.asarray(ids))
+        assert r >= 0.9
+
+    def test_wrong_dim_rejected(self, index, tmp_path):
+        path = str(tmp_path / "cagra.hnsw")
+        hnsw.save_hnswlib(None, index, path)
+        with pytest.raises(Exception, match="layout mismatch"):
+            hnsw.load_hnswlib(None, path, index.dataset.shape[1] + 3)
+
+    def test_int8_dataset(self, index, tmp_path):
+        rng = np.random.default_rng(3)
+        x8 = rng.integers(-100, 100, (64, 16), dtype=np.int8)
+        g = np.tile(np.arange(16, dtype=np.int32), (64, 1))
+        idx8 = cagra.CagraIndex(dataset=x8, graph=g,
+                                metric=DistanceType.L2Expanded)
+        path = str(tmp_path / "int8.hnsw")
+        hnsw.save_hnswlib(None, idx8, path)
+        loaded = hnsw.load_hnswlib(None, path, 16, dtype=np.int8)
+        np.testing.assert_array_equal(np.asarray(loaded.dataset), x8)
+
+    @pytest.mark.skipif(hnswlib_mod is None, reason="hnswlib not installed")
+    def test_hnswlib_loads_and_searches(self, dataset, index, tmp_path):
+        x, q = dataset
+        path = str(tmp_path / "cagra.hnsw")
+        hnsw.save_hnswlib(None, index, path)
+        h = hnswlib_mod.Index(space="l2", dim=x.shape[1])
+        h.load_index(path)
+        h.set_ef(64)
+        ids, _ = h.knn_query(q, k=10)
+        r, _, _ = eval_recall(_gt(x, q, 10), ids)
+        assert r >= 0.9
+
+
+class TestLoadForeign:
+    """load_hnswlib on a file that mimics hnswlib's own output: permuted
+    insertion order (labels != internal ids) and ragged link counts."""
+
+    def test_permuted_ragged_file(self, tmp_path):
+        rng = np.random.default_rng(5)
+        n, dim, max_m0 = 50, 8, 6
+        vecs = rng.standard_normal((n, dim)).astype(np.float32)
+        labels = rng.permutation(n).astype(np.uint64)
+        counts = rng.integers(1, max_m0 + 1, n)
+        links = rng.integers(0, n, (n, max_m0)).astype(np.uint32)
+
+        hdr = struct.Struct("<QQQQQQiIQQQdQ")
+        data_off = 4 + 4 * max_m0
+        label_off = data_off + 4 * dim
+        per_elem = label_off + 8
+        path = str(tmp_path / "foreign.hnsw")
+        with open(path, "wb") as f:
+            f.write(hdr.pack(0, n, n, per_elem, label_off, data_off,
+                             2, 17, max_m0 // 2, max_m0, max_m0 // 2,
+                             1.0, 200))
+            for i in range(n):
+                f.write(struct.pack("<I", counts[i]))
+                f.write(links[i].tobytes())
+                f.write(vecs[i].tobytes())
+                f.write(struct.pack("<Q", labels[i]))
+            # pretend some nodes have upper levels hnswlib would read;
+            # load_hnswlib only needs level 0 so sizes may be nonzero
+            f.write(np.zeros(n, dtype="<u4").tobytes())
+
+        loaded = hnsw.load_hnswlib(None, path, dim)
+        got = np.asarray(loaded.dataset)
+        # row for label L must hold the vector inserted with label L
+        inv = np.argsort(labels)
+        np.testing.assert_allclose(got, vecs[inv])
+        g = np.asarray(loaded.graph)
+        assert g.shape == (n, max_m0)
+        assert g.min() >= 0 and g.max() < n
+        # padded entries repeat the first link (label space)
+        i0 = inv[0]  # internal id whose label is 0
+        expected_first = labels[links[i0, 0]]
+        assert g[0, 0] == expected_first
+        if counts[i0] < max_m0:
+            assert (g[0, counts[i0]:] == expected_first).all()

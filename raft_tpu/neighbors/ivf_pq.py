@@ -98,6 +98,10 @@ class IvfPqSearchParams(SearchParams):
     # approximate top-k unit — worthwhile at 10k+ lists (same knob as
     # IvfFlatSearchParams.coarse_algo)
     coarse_algo: str = "exact"
+    # f32 / bf16 / float8_e4m3fn — the reference's fp32/fp16/fp8 LUT
+    # ladder (ivf_pq_compute_similarity-inl.cuh:125-177). fp8 quarters
+    # the LUT's VMEM footprint (the probe-tile bound); scoring upcasts
+    # to bf16 on the fly, so only LUT entries round
     lut_dtype: jnp.dtype = jnp.float32
     # "gather": per-element LUT lookup; "onehot": gather-free MXU
     # contraction (J-fold more FLOPs, no dynamic gathers). "auto"
@@ -578,7 +582,9 @@ def _score_onehot(lut, rows):
     themselves, and accumulation is always f32 via
     ``preferred_element_type``."""
     q, s, J = lut.shape
-    ctype = jnp.bfloat16 if lut.dtype == jnp.bfloat16 else jnp.float32
+    # bf16/fp8 LUTs contract in bf16 (fp8 -> bf16 is exact; rounding
+    # already happened at the lut_dtype cast); f32 stays f32
+    ctype = (jnp.float32 if lut.dtype == jnp.float32 else jnp.bfloat16)
     oh = jax.nn.one_hot(rows.astype(jnp.int32), J,
                         dtype=jnp.bfloat16)            # (q, m, s, J)
     return jnp.einsum("qmsj,qsj->qm", oh,
@@ -640,6 +646,31 @@ def _probe_lut(qf, c, qsub_fixed, lut_fixed, rotation, codebooks, lists,
     return lut, base
 
 
+_FP8_DTYPES = tuple(
+    getattr(jnp, name) for name in ("float8_e4m3fn", "float8_e5m2")
+    if hasattr(jnp, name))
+_FP8_MAX = {"float8_e4m3fn": 448.0, "float8_e5m2": 57344.0}
+
+
+def quantize_lut(lut, lut_dtype):
+    """Cast the per-probe LUT to ``lut_dtype`` — the reference's
+    fp32/fp16/fp8 LUT ladder (``ivf_pq_compute_similarity-inl.cuh:125-177``).
+    fp8's ±448 range can't hold raw squared-distance contributions, so
+    (like the reference's fp8 path) entries are scaled per query into
+    range; returns ``(lut, scale)`` where ``scale`` is ``(q, 1)`` to
+    multiply back into the summed scores, or ``None`` when no scaling
+    happened. Scaling is per *query*, not per subspace, so the
+    Σ_s lut[q, s, code_s] accumulation stays a plain sum."""
+    expect(lut_dtype in (jnp.float32, jnp.bfloat16) + _FP8_DTYPES,
+           f"lut_dtype must be float32/bfloat16/float8, got {lut_dtype}")
+    if lut_dtype in _FP8_DTYPES:
+        fmax = _FP8_MAX[jnp.dtype(lut_dtype).name]
+        scale = jnp.max(jnp.abs(lut), axis=(1, 2), keepdims=True) / fmax
+        scale = jnp.maximum(scale, 1e-30)
+        return (lut / scale).astype(lut_dtype), scale[:, :, 0]
+    return lut.astype(lut_dtype), None
+
+
 @partial(jax.jit, static_argnames=("n_probes", "k", "metric", "codebook_kind",
                                    "lut_dtype", "score_mode", "packed",
                                    "coarse_algo"))
@@ -691,7 +722,7 @@ def _search_impl(queries, centers, rotation, codebooks, codes, indices,
         lut, base = _probe_lut(
             qf, c, qsub_fixed, lut_fixed, rotation, codebooks, lists,
             ip_query, codebook_kind == CodebookKind.PER_CLUSTER)
-        lut = lut.astype(lut_dtype)                    # (q, pq_dim, J)
+        lut, lut_scale = quantize_lut(lut, lut_dtype)  # (q, pq_dim, J)
 
         rows = jnp.take(codes, lists, axis=0)          # (q, m, pq_dim) u8
         if packed:
@@ -701,7 +732,10 @@ def _search_impl(queries, centers, rotation, codebooks, codes, indices,
         row_ids = jnp.take(indices, lists, axis=0)     # (q, m)
         # score codes: dist[q, m] = sum_s lut[q, s, rows[q, m, s]]
         score = score_fn(score_mode, book_size)
-        dist = score(lut, rows) + base[:, None]
+        dist = score(lut, rows)
+        if lut_scale is not None:
+            dist = dist * lut_scale
+        dist = dist + base[:, None]
         dist = jnp.where(row_ids >= 0, dist, pad_val)
         if filter_words is not None:
             bits = test_filter(filter_words, row_ids)
@@ -747,6 +781,9 @@ def search(
     expect(params.coarse_algo in ("exact", "approx"),
            f"coarse_algo must be 'exact' or 'approx', got "
            f"{params.coarse_algo!r}")
+    expect(params.lut_dtype in (jnp.float32, jnp.bfloat16) + _FP8_DTYPES,
+           f"lut_dtype must be float32/bfloat16/float8, got "
+           f"{params.lut_dtype}")
     filter_words = resolve_filter_words(sample_filter)
     score_mode = resolve_score_mode(params.score_mode, index.pq_book_size)
     with tracing.range("raft_tpu.ivf_pq.search"):

@@ -193,6 +193,45 @@ class TestScoreModes:
         r, _, _ = eval_recall(np.asarray(i1), np.asarray(i2))
         assert r >= 0.95, r
 
+    def test_lut_dtypes_rank_alike(self, dataset):
+        """The fp32/bf16/fp8 LUT ladder (reference
+        ivf_pq_compute_similarity-inl.cuh:125-177): lower-precision LUTs
+        trade a little recall for VMEM; rankings must stay close and the
+        fp8 path must not collapse (per-query scaling keeps entries in
+        e4m3's +-448 range)."""
+        import jax.numpy as jnp
+        from raft_tpu.utils import eval_recall
+
+        x, q = dataset
+        params = IvfPqIndexParams(n_lists=20, pq_dim=16, pq_bits=8,
+                                  kmeans_n_iters=10)
+        index = ivf_pq.build(None, params, x)
+        ids = {}
+        for dt in (jnp.float32, jnp.bfloat16, jnp.float8_e4m3fn):
+            _, i = ivf_pq.search(
+                None, IvfPqSearchParams(n_probes=20, lut_dtype=dt),
+                index, q, 10)
+            ids[dt] = np.asarray(i)
+        r_bf16, _, _ = eval_recall(ids[jnp.float32], ids[jnp.bfloat16])
+        r_fp8, _, _ = eval_recall(ids[jnp.float32], ids[jnp.float8_e4m3fn])
+        assert r_bf16 >= 0.95, r_bf16
+        assert r_fp8 >= 0.85, r_fp8
+        # and against ground truth the fp8 path still finds neighbors
+        _, gt = _gt(x, q, 10)
+        r_gt, _, _ = eval_recall(gt, ids[jnp.float8_e4m3fn])
+        assert r_gt >= 0.7, r_gt
+
+    def test_bad_lut_dtype_rejected(self, dataset):
+        import jax.numpy as jnp
+        from raft_tpu.core.validation import RaftError
+
+        x, q = dataset
+        params = IvfPqIndexParams(n_lists=8, pq_dim=8)
+        index = ivf_pq.build(None, params, x[:500])
+        with pytest.raises(RaftError, match="lut_dtype"):
+            ivf_pq.search(None, IvfPqSearchParams(lut_dtype=jnp.int8),
+                          index, q, 5)
+
     def test_auto_resolution(self, monkeypatch):
         from raft_tpu.core.validation import RaftError
         from raft_tpu.neighbors import ivf_pq as mod

@@ -2,16 +2,29 @@
 (``distance/distance.cuh:38-58`` supported-metric set).
 
 The reference computes CSR×CSR distances with expanded (SPMV-based) and
-unexpanded (nested-loop) CUDA paths. TPU re-design: densify row *tiles*
-of both operands (static tile shapes) and reuse the dense 20-metric
-engine — on TPU the MXU eats dense tiles far faster than any
-gather-heavy sparse inner loop, and the tiling bounds memory at
-``tile × n_cols``. This supports every metric the dense engine does,
-a superset of the reference's sparse set.
+unexpanded (nested-loop) CUDA paths. TPU re-design, two regimes:
+
+- **full-width tiles** (default at moderate ``n_cols``): densify row
+  *tiles* of both operands (static tile shapes) and reuse the dense
+  20-metric engine — the MXU eats dense tiles far faster than any
+  gather-heavy sparse inner loop. Memory is ``tile × n_cols``.
+
+- **column-tiled expanded path** (the SPMV role, for text-scale widths):
+  the expanded metrics (L2/IP/cosine) are functions of ``x·yᵀ``,
+  ``‖x‖²``, ``‖y‖²`` only, so the Gram block accumulates over
+  ``col_tile``-wide dense column slabs under ``lax.scan`` — memory is
+  ``tile × col_tile`` regardless of ``n_cols``, matching the bound of
+  the reference's SPMV path (``distance/detail/l2_distance.cuh``).
+
+Non-decomposable metrics on very wide inputs fail loudly with the
+memory bound (``RAFT_TPU_SPARSE_TILE_MB`` raises it) instead of
+silently allocating ``tile × n_cols``.
 """
 
 from __future__ import annotations
 
+import os
+from functools import partial
 from typing import Optional
 
 import jax
@@ -19,10 +32,79 @@ import jax.numpy as jnp
 
 from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
 from raft_tpu.distance.pairwise import _pairwise_distance_impl
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.sparse.ops import row_slice
 from raft_tpu.sparse.types import CSR
+
+# expanded metrics: computable from (x·yT, |x|^2, |y|^2) alone, hence
+# column-tileable. L2Unexpanded equals L2Expanded in exact arithmetic.
+_DECOMPOSABLE = (
+    DistanceType.InnerProduct,
+    DistanceType.L2Expanded,
+    DistanceType.L2SqrtExpanded,
+    DistanceType.L2Unexpanded,
+    DistanceType.L2SqrtUnexpanded,
+    DistanceType.CosineExpanded,
+)
+
+
+def _tile_budget_mb() -> int:
+    return int(os.environ.get("RAFT_TPU_SPARSE_TILE_MB", "2048"))
+
+
+def _dense_cols(csr: CSR, row_ids, cs, col_tile: int):
+    """Dense (rows, col_tile) slab of the columns [cs, cs+col_tile) of a
+    row-sliced CSR — ``cs`` may be traced (scan carry)."""
+    ind = csr.indices
+    valid = (row_ids >= 0) & (ind >= cs) & (ind < cs + col_tile)
+    out = jnp.zeros((csr.shape[0], col_tile), csr.data.dtype)
+    return out.at[
+        jnp.where(valid, row_ids, 0),
+        jnp.where(valid, ind - cs, 0),
+    ].add(jnp.where(valid, csr.data, 0))
+
+
+@partial(jax.jit, static_argnames=("metric", "col_tile", "n_cols"))
+def _expanded_block(xt: CSR, yt: CSR, metric: DistanceType,
+                    col_tile: int, n_cols: int):
+    """One (x-tile, y-tile) distance block, Gram-accumulated over dense
+    column slabs — never materializes a full-width dense tile."""
+    xr = xt.row_ids()
+    yr = yt.row_ids()
+    nb = -(-n_cols // col_tile)
+    init = (
+        jnp.zeros((xt.shape[0], yt.shape[0]), jnp.float32),
+        jnp.zeros((xt.shape[0],), jnp.float32),
+        jnp.zeros((yt.shape[0],), jnp.float32),
+    )
+
+    def step(carry, cs):
+        ip, xn, yn = carry
+        xd = _dense_cols(xt, xr, cs, col_tile).astype(jnp.float32)
+        yd = _dense_cols(yt, yr, cs, col_tile).astype(jnp.float32)
+        ip = ip + jax.lax.dot_general(
+            xd, yd, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+        xn = xn + jnp.sum(jnp.square(xd), axis=1)
+        yn = yn + jnp.sum(jnp.square(yd), axis=1)
+        return (ip, xn, yn), None
+
+    starts = jnp.arange(nb, dtype=jnp.int32) * col_tile
+    (ip, xn, yn), _ = jax.lax.scan(step, init, starts)
+
+    if metric == DistanceType.InnerProduct:
+        return ip
+    if metric == DistanceType.CosineExpanded:
+        denom = jnp.sqrt(jnp.maximum(xn[:, None] * yn[None, :], 1e-30))
+        return 1.0 - ip / denom
+    d2 = jnp.maximum(xn[:, None] + yn[None, :] - 2.0 * ip, 0.0)
+    if metric in (DistanceType.L2SqrtExpanded,
+                  DistanceType.L2SqrtUnexpanded):
+        return jnp.sqrt(d2)
+    return d2
 
 
 def pairwise_distance(
@@ -32,26 +114,55 @@ def pairwise_distance(
     metric: DistanceType = DistanceType.L2Expanded,
     metric_arg: float = 2.0,
     tile: int = 2048,
+    col_tile: Optional[int] = None,
 ) -> jax.Array:
     """Dense (m, n) distance matrix between CSR row sets —
-    ``sparse::distance::pairwiseDistance``."""
+    ``sparse::distance::pairwiseDistance``.
+
+    ``col_tile`` bounds the dense slab width for the expanded metrics:
+    ``None`` auto-enables column tiling (slab width 8192) once a
+    full-width tile would exceed the ``RAFT_TPU_SPARSE_TILE_MB``
+    budget; pass an int to force it. Non-decomposable metrics (L1,
+    Hamming, …) need full rows and are bounded by the same budget —
+    past it they fail with the bound rather than allocate."""
     ensure_resources(res)
     assert x.shape[1] == y.shape[1], "column dims must match"
     m = x.shape[0]
     n = y.shape[0]
+    n_cols = x.shape[1]
+    itemsize = jnp.dtype(x.data.dtype).itemsize
+    # ceil, not floor: a sub-MB tile must still compare > a 0 MB budget
+    full_tile_mb = -(-(min(tile, max(m, n)) * n_cols * itemsize) // (1 << 20))
+    decomposable = metric in _DECOMPOSABLE
+    if col_tile is None and decomposable and full_tile_mb > _tile_budget_mb():
+        col_tile = 8192
+    if col_tile is not None:
+        expect(decomposable,
+               f"column tiling needs an expanded metric (got {metric!r}); "
+               "L1/Lp/Hamming-family metrics need full rows")
+        col_tile = min(col_tile, n_cols)
+    else:
+        expect(full_tile_mb <= _tile_budget_mb(),
+               f"a {tile}×{n_cols} dense tile is ~{full_tile_mb} MB, over "
+               f"the {_tile_budget_mb()} MB RAFT_TPU_SPARSE_TILE_MB budget "
+               "— use an expanded metric (column-tiled) or shrink `tile`")
+
     with tracing.range("raft_tpu.sparse.pairwise_distance"):
         rows = []
         for xs in range(0, m, tile):
             xe = min(xs + tile, m)
-            xd = row_slice(x, xs, xe).to_dense()
+            xt = row_slice(x, xs, xe)
+            xd = None if col_tile is not None else xt.to_dense()
             cols = []
             for ys in range(0, n, tile):
                 ye = min(ys + tile, n)
-                yd = row_slice(y, ys, ye).to_dense()
-                cols.append(
-                    _pairwise_distance_impl(xd, yd, metric, metric_arg,
-                                            "highest")
-                )
+                yt = row_slice(y, ys, ye)
+                if col_tile is not None:
+                    cols.append(_expanded_block(xt, yt, metric,
+                                                col_tile, n_cols))
+                else:
+                    cols.append(_pairwise_distance_impl(
+                        xd, yt.to_dense(), metric, metric_arg, "highest"))
             rows.append(cols[0] if len(cols) == 1
                         else jnp.concatenate(cols, axis=1))
         return rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=0)

@@ -132,6 +132,47 @@ class TestDistanceAndNeighbors:
         want = spd.cdist(ra.toarray(), rb.toarray(), "sqeuclidean")
         np.testing.assert_allclose(np.asarray(d), want, rtol=1e-3, atol=1e-3)
 
+    @pytest.mark.parametrize("metric,scipy_name", [
+        (DistanceType.L2Expanded, "sqeuclidean"),
+        (DistanceType.L2SqrtExpanded, "euclidean"),
+        (DistanceType.InnerProduct, None),
+        (DistanceType.CosineExpanded, "cosine"),
+    ])
+    def test_pairwise_column_tiled(self, rand_csr, metric, scipy_name):
+        """The SPMV-role path: forcing col_tile far below n_cols must
+        reproduce the full-width result for every expanded metric."""
+        from raft_tpu.sparse.distance import pairwise_distance
+        a, ra = rand_csr(m=20, seed=7)
+        b, rb = rand_csr(m=16, seed=8)
+        d = pairwise_distance(None, a, b, metric, tile=8, col_tile=5)
+        if scipy_name is None:  # InnerProduct returns raw similarity
+            want = ra.toarray() @ rb.toarray().T
+        else:
+            want = spd.cdist(ra.toarray(), rb.toarray(), scipy_name)
+        np.testing.assert_allclose(np.asarray(d), want,
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_pairwise_column_tiled_rejects_unexpanded(self, rand_csr):
+        from raft_tpu.core.validation import RaftError
+        from raft_tpu.sparse.distance import pairwise_distance
+        a, _ = rand_csr(m=8, seed=9)
+        with pytest.raises(RaftError, match="expanded metric"):
+            pairwise_distance(None, a, a, DistanceType.L1, col_tile=4)
+
+    def test_pairwise_wide_budget_guard(self, rand_csr, monkeypatch):
+        """Past the tile budget: decomposable metrics auto-switch to
+        column tiling; L1-family fails loudly with the bound."""
+        from raft_tpu.core.validation import RaftError
+        from raft_tpu.sparse import distance as sdist
+        a, ra = rand_csr(m=12, seed=10)
+        monkeypatch.setenv("RAFT_TPU_SPARSE_TILE_MB", "0")
+        d = sdist.pairwise_distance(None, a, a, DistanceType.L2Expanded)
+        want = spd.cdist(ra.toarray(), ra.toarray(), "sqeuclidean")
+        np.testing.assert_allclose(np.asarray(d), want, rtol=1e-3,
+                                   atol=1e-3)
+        with pytest.raises(RaftError, match="budget"):
+            sdist.pairwise_distance(None, a, a, DistanceType.L1)
+
     def test_sparse_knn(self, rand_csr):
         db, rdb = rand_csr(m=64, seed=5)
         q, rq = rand_csr(m=10, seed=6)

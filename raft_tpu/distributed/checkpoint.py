@@ -13,15 +13,27 @@ and load — a checkpoint taken on an 8-chip mesh restores onto 4 or 16
 with per-chip scan balance (and ``probe_mode='local'`` spread)
 preserved.
 
-Single-controller scope: arrays are gathered to the host process for
-writing (``jax.device_get``), which requires them to be fully
-addressable — true in single-process multi-device deployments. On
-multi-host meshes, gather-to-host0 or a per-process scheme (e.g.
-orbax) is needed; this module raises a clear error in that case
-rather than writing a partial file.
+Two storage schemes:
+
+- ``save_*`` / ``load_*`` — single-controller: arrays are gathered to
+  the host process (``jax.device_get``), which requires them to be
+  fully addressable. One file; raises a clear error on multi-host
+  meshes rather than writing a partial file.
+
+- ``save_*_multihost`` / ``load_*_multihost`` — per-process: each
+  process writes ONLY its addressable block of every list-sharded
+  array to ``<dir>/part<rank>.bin`` (rank 0 adds ``meta.bin`` with the
+  scalars + replicated arrays), so nothing is ever gathered across the
+  DCN to one host. Load reads all parts from the shared filesystem,
+  reassembles the global (dealt) order by block offset, and re-deals
+  for the target comms — the shard count AND process count may both
+  differ between save and load.
 """
 
 from __future__ import annotations
+
+import glob
+import os
 
 import jax
 import numpy as np
@@ -230,3 +242,185 @@ def load_bq(res, comms: Comms, fh_or_path):
         list_sizes=place(sizes),
         metric=metric,
     )
+
+
+# ---------------------------------------------------------------------------
+# multi-host per-process scheme
+# ---------------------------------------------------------------------------
+
+def _local_block(a):
+    """This process's contiguous dim-0 block of a list-sharded array,
+    plus its global start offset (shards arrive device-ordered)."""
+    shards = sorted(a.addressable_shards,
+                    key=lambda s: int(s.index[0].start or 0))
+    start = int(shards[0].index[0].start or 0)
+    pos = start
+    for s in shards:
+        st = int(s.index[0].start or 0)
+        expect(st == pos,
+               "this process's shards are not one contiguous list block "
+               f"(gap at row {pos}, next shard starts at {st}) — the "
+               "multihost scheme requires a process-contiguous mesh "
+               "(bootstrap.make_mesh default order)")
+        pos = st + s.data.shape[0]
+    block = np.concatenate(
+        [np.asarray(jax.device_get(s.data)) for s in shards], axis=0)
+    return start, block
+
+
+def _save_parts(dirpath, version: int, comms: Comms, sharded,
+                meta_scalars, meta_arrays) -> None:
+    """Write this process's part file (+ meta on rank 0). ``sharded``
+    arrays must share one dim-0 sharding (the list axis)."""
+    os.makedirs(dirpath, exist_ok=True)
+    rank = comms.process_rank
+    with open(os.path.join(dirpath, f"part{rank:05d}.bin"), "wb") as fh:
+        serialize_scalar(fh, version, np.int32)
+        start = None
+        for a in sharded:
+            st, block = _local_block(a)
+            start = st if start is None else start
+            serialize_array(fh, block)
+        serialize_scalar(fh, start, np.int64)
+    if rank == 0:
+        with open(os.path.join(dirpath, "meta.bin"), "wb") as fh:
+            serialize_scalar(fh, version, np.int32)
+            serialize_scalar(fh, jax.process_count(), np.int32)
+            for s in meta_scalars:
+                serialize_scalar(fh, int(s), np.int32)
+            for a in meta_arrays:
+                serialize_array(fh, np.asarray(jax.device_get(a)))
+
+
+def _load_parts(dirpath, version: int, what: str, n_sharded: int,
+                n_scalars: int, n_meta_arrays: int):
+    """Read meta + every part; returns (scalars, meta_arrays, fields)
+    with each field reassembled into the global dealt order."""
+    with open(os.path.join(dirpath, "meta.bin"), "rb") as fh:
+        check_version(deserialize_scalar(fh), version, what)
+        n_parts = int(deserialize_scalar(fh))
+        scalars = [int(deserialize_scalar(fh)) for _ in range(n_scalars)]
+        metas = [deserialize_array(fh) for _ in range(n_meta_arrays)]
+    paths = sorted(glob.glob(os.path.join(dirpath, "part*.bin")))
+    expect(len(paths) == n_parts,
+           f"checkpoint dir has {len(paths)} part files, meta says "
+           f"{n_parts} — mixed checkpoints in one directory?")
+    parts = []
+    for p in paths:
+        with open(p, "rb") as fh:
+            check_version(deserialize_scalar(fh), version, what)
+            arrays = [deserialize_array(fh) for _ in range(n_sharded)]
+            start = int(deserialize_scalar(fh))
+        parts.append((start, arrays))
+    parts.sort(key=lambda t: t[0])
+    fields = [np.concatenate([p[1][i] for p in parts], axis=0)
+              for i in range(n_sharded)]
+    return scalars, metas, fields
+
+
+def _deal_place(comms: Comms, sizes: np.ndarray):
+    """The shared restore placement: re-deal by population for the
+    target mesh, then block-shard straight from host."""
+    expect(len(sizes) % comms.size == 0,
+           f"the mesh axis ({comms.size}) must divide n_lists "
+           f"{len(sizes)}")
+    shard = comms.sharding(comms.axis)
+    deal = deal_order(np.asarray(sizes), comms.size)
+
+    def place(a):
+        return jax.device_put(np.ascontiguousarray(a[deal]), shard)
+
+    return place
+
+
+def save_flat_multihost(index: DistributedIvfFlat, dirpath) -> None:
+    """Per-process IVF-Flat checkpoint (see module docstring)."""
+    with tracing.range("raft_tpu.distributed.checkpoint.save_flat_mh"):
+        _save_parts(dirpath, _FLAT_VERSION, index.comms,
+                    [index.centers, index.data, index.data_norms,
+                     index.indices, index.list_sizes],
+                    meta_scalars=[int(index.metric)], meta_arrays=[])
+
+
+def load_flat_multihost(res, comms: Comms, dirpath) -> DistributedIvfFlat:
+    scalars, _, fields = _load_parts(
+        dirpath, _FLAT_VERSION, "distributed ivf_flat", 5, 1, 0)
+    centers, data, norms, indices, sizes = fields
+    place = _deal_place(comms, sizes)
+    return DistributedIvfFlat(
+        comms=comms, centers=place(centers), data=place(data),
+        data_norms=place(norms), indices=place(indices),
+        list_sizes=place(sizes), metric=DistanceType(scalars[0]))
+
+
+def save_pq_multihost(index: DistributedIvfPq, dirpath) -> None:
+    """Per-process IVF-PQ checkpoint. PER_CLUSTER codebooks shard with
+    the lists (into the parts); PER_SUBSPACE books ride meta.bin."""
+    per_cluster = index.codebook_kind == CodebookKind.PER_CLUSTER
+    sharded = [index.centers, index.codes, index.indices,
+               index.list_sizes]
+    metas = [index.rotation]
+    (sharded if per_cluster else metas).append(index.codebooks)
+    with tracing.range("raft_tpu.distributed.checkpoint.save_pq_mh"):
+        _save_parts(dirpath, _PQ_VERSION, index.comms, sharded,
+                    meta_scalars=[int(index.metric),
+                                  int(index.codebook_kind),
+                                  index.pq_bits],
+                    meta_arrays=metas)
+
+
+def load_pq_multihost(res, comms: Comms, dirpath) -> DistributedIvfPq:
+    with open(os.path.join(dirpath, "meta.bin"), "rb") as fh:
+        check_version(deserialize_scalar(fh), _PQ_VERSION,
+                      "distributed ivf_pq")
+        deserialize_scalar(fh)  # n_parts — re-read by _load_parts
+        deserialize_scalar(fh)  # metric
+        kind = CodebookKind(int(deserialize_scalar(fh)))
+    per_cluster = kind == CodebookKind.PER_CLUSTER
+    scalars, metas, fields = _load_parts(
+        dirpath, _PQ_VERSION, "distributed ivf_pq",
+        5 if per_cluster else 4, 3, 1 if per_cluster else 2)
+    metric = DistanceType(scalars[0])
+    pq_bits = scalars[2]
+    if per_cluster:
+        centers, codes, indices, sizes, codebooks = fields
+        rotation = metas[0]
+    else:
+        centers, codes, indices, sizes = fields
+        rotation, codebooks = metas
+    place = _deal_place(comms, sizes)
+    rep = comms.replicated()
+    return DistributedIvfPq(
+        comms=comms, centers=place(centers),
+        rotation=jax.device_put(np.asarray(rotation), rep),
+        codebooks=(place(codebooks) if per_cluster
+                   else jax.device_put(np.asarray(codebooks), rep)),
+        codes=place(codes), indices=place(indices),
+        list_sizes=place(sizes), metric=metric, pq_bits=pq_bits,
+        codebook_kind=kind)
+
+
+def save_bq_multihost(index, dirpath) -> None:
+    """Per-process IVF-BQ checkpoint."""
+    with tracing.range("raft_tpu.distributed.checkpoint.save_bq_mh"):
+        _save_parts(dirpath, _BQ_VERSION, index.comms,
+                    [index.centers, index.codes, index.scales,
+                     index.rnorm2, index.indices, index.list_sizes],
+                    meta_scalars=[int(index.metric), index.bits],
+                    meta_arrays=[index.rotation])
+
+
+def load_bq_multihost(res, comms: Comms, dirpath):
+    from raft_tpu.distributed.bq import DistributedIvfBq
+
+    scalars, metas, fields = _load_parts(
+        dirpath, _BQ_VERSION, "distributed ivf_bq", 6, 2, 1)
+    centers, codes, scales, rn2, indices, sizes = fields
+    place = _deal_place(comms, sizes)
+    return DistributedIvfBq(
+        comms=comms, centers=place(centers),
+        rotation=jax.device_put(np.asarray(metas[0]),
+                                comms.replicated()),
+        codes=place(codes), scales=place(scales), rnorm2=place(rn2),
+        indices=place(indices), list_sizes=place(sizes),
+        metric=DistanceType(scalars[0]))

@@ -12,6 +12,23 @@ import jax.numpy as jnp
 from raft_tpu.distance.types import DistanceType
 
 
+def dedup_candidate_mask(cand_ids, buf_ids):
+    """Beam-merge dedup shared by BOTH CAGRA search engines (the XLA
+    ``_buffer_merge`` and the Pallas kernel — their visited semantics
+    must not drift): True where a candidate duplicates a live buffer id
+    (buffer copy wins) or an earlier candidate (first proposal wins).
+
+    ``buf_ids`` must already encode dead slots as a value no candidate
+    can take (e.g. -2). Pure jnp, Mosaic-compatible (iota, not tril)."""
+    q, C = cand_ids.shape
+    dup_b = jnp.any(cand_ids[:, :, None] == buf_ids[:, None, :], axis=2)
+    eq = cand_ids[:, :, None] == cand_ids[:, None, :]
+    r = jax.lax.broadcasted_iota(jnp.int32, (C, C), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (C, C), 1)
+    dup_c = jnp.any(eq & ((c < r)[None]), axis=2)
+    return dup_b | dup_c
+
+
 def gathered_distances(x, dataset, cand_ids, metric: DistanceType):
     """Distance from each row of ``x`` to its candidate dataset rows.
 

@@ -54,7 +54,7 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType
 from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
 from raft_tpu.neighbors import nn_descent as nn_descent_mod
-from raft_tpu.neighbors._exact import gathered_distances
+from raft_tpu.neighbors._exact import dedup_candidate_mask, gathered_distances
 from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 from raft_tpu.neighbors.nn_descent import _reverse_sample
 from raft_tpu.neighbors.refine import refine
@@ -105,6 +105,12 @@ class CagraSearchParams:
     # tile; on clustered data it removes the "did a random seed land in
     # the right cluster" recall ceiling. 0 = reference behavior.
     seed_pool: int = 0
+    # "pallas": the one-dispatch VMEM-resident beam-search kernel
+    # (ops/beam_search, role of the reference's persistent single-CTA
+    # kernel); "xla": the lax.while_loop path; "auto": pallas on TPU
+    # when its constraints hold (supported metric, no filter,
+    # dim % 128 == 0, dataset fits the VMEM budget), else xla.
+    algo: str = "auto"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -380,15 +386,11 @@ def _buffer_merge(ids, dists, explored, cand_ids, cand_d, L: int):
     candidate-vs-earlier-candidate (C, C)) feeding one ``top_k`` — no
     argsort in the search hot loop (TPU sorts have poor constants; the
     masks are cheap VPU compares)."""
-    q, C = cand_ids.shape
-    # candidate duplicating a live buffer id → the buffer copy wins
+    # buffer copy wins over duplicates; first proposal wins among
+    # candidates (shared helper — the Pallas engine uses the same one)
     buf_ids = jnp.where(ids >= 0, ids, -2)               # -2 ≠ any cand -1
-    dup_b = jnp.any(cand_ids[:, :, None] == buf_ids[:, None, :], axis=2)
-    # candidate duplicating an EARLIER candidate → first proposal wins
-    eq = cand_ids[:, :, None] == cand_ids[:, None, :]    # (q, c, c')
-    earlier = jnp.tril(jnp.ones((C, C), bool), k=-1)     # c' < c
-    dup_c = jnp.any(eq & earlier[None], axis=2)
-    cd = jnp.where(dup_b | dup_c | (cand_ids < 0), jnp.inf, cand_d)
+    dup = dedup_candidate_mask(cand_ids, buf_ids)
+    cd = jnp.where(dup | (cand_ids < 0), jnp.inf, cand_d)
 
     all_d = jnp.concatenate([dists, cd], axis=1)
     all_i = jnp.concatenate([ids, cand_ids], axis=1)
@@ -482,6 +484,30 @@ def _search_batch(dataset, graph, queries, seed_ids, filter_words,
     return out_d, out_i
 
 
+def _resolve_search_algo(params: CagraSearchParams, index: CagraIndex,
+                         filter_words) -> bool:
+    """True → the one-dispatch Pallas beam kernel; False → XLA path."""
+    from raft_tpu.ops import beam_search as bs
+
+    if params.algo == "xla":
+        return False
+    expect(params.algo in ("auto", "pallas"),
+           f"algo must be 'auto'/'pallas'/'xla', got {params.algo!r}")
+    itemsize = 2 if index.dataset.dtype == jnp.bfloat16 else 4
+    ok = (index.metric in bs._SUPPORTED
+          and filter_words is None
+          and index.dim % 128 == 0
+          and index.dataset.dtype in (jnp.float32, jnp.bfloat16)
+          and bs.beam_search_fits(index.size, index.dim, itemsize))
+    if params.algo == "pallas":
+        expect(ok, "algo='pallas' needs: L2/IP metric, no sample_filter, "
+               "dim % 128 == 0, f32/bf16 dataset fitting the VMEM budget "
+               f"(n={index.size}, dim={index.dim}, "
+               f"dtype={index.dataset.dtype})")
+        return True
+    return ok and jax.default_backend() == "tpu"
+
+
 def search(
     res: Optional[Resources],
     params: CagraSearchParams,
@@ -493,7 +519,12 @@ def search(
     """Graph beam search — ``cagra::search`` → ``search_main``
     (``detail/cagra/cagra_search.cuh:105``). With ``sample_filter``,
     only samples whose bit is set may be returned or expanded
-    (``cagra::search_with_filtering``, ``cagra.cuh:430``)."""
+    (``cagra::search_with_filtering``, ``cagra.cuh:430``).
+
+    Two engines behind ``params.algo``: the ``lax.while_loop`` XLA path
+    and the one-dispatch Pallas kernel with the dataset VMEM-resident
+    (``ops/beam_search``, role of the reference's persistent
+    single-CTA kernel)."""
     res = ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -504,9 +535,15 @@ def search(
     L = max(params.itopk_size, k)
     w = max(1, params.search_width)
     max_iters = params.max_iterations or (L // w + 24)
-    n_seeds = max(L, w * index.graph_degree) * max(1, params.num_random_samplings)
-    n_seeds = min(n_seeds, n)
     filter_words = resolve_filter_words(sample_filter)
+    use_kernel = _resolve_search_algo(params, index, filter_words)
+    if use_kernel:
+        # the kernel's seed round runs at candidate width
+        n_seeds = w * index.graph_degree
+    else:
+        n_seeds = max(L, w * index.graph_degree) * max(
+            1, params.num_random_samplings)
+        n_seeds = min(n_seeds, n)
     if filter_words is not None and filter_words.ndim == 2:
         expect(filter_words.shape[0] == queries.shape[0],
                "per-query BitmapFilter rows must match the query count")
@@ -521,8 +558,13 @@ def search(
                 fw = fw[start : start + tile]
             if params.seed_pool > 0:
                 seeds = _pooled_seeds(index.dataset, qt,
-                                      min(params.seed_pool, n), n_seeds,
+                                      min(params.seed_pool, n),
+                                      min(n_seeds, params.seed_pool, n),
                                       index.metric)
+                if seeds.shape[1] < n_seeds:
+                    # kernel wants exactly w*deg: repeat the best seeds
+                    reps = -(-n_seeds // seeds.shape[1])
+                    seeds = jnp.tile(seeds, (1, reps))[:, :n_seeds]
             else:
                 key = jax.random.fold_in(
                     jax.random.key(res.seed ^ params.rand_xor_mask), start
@@ -530,8 +572,21 @@ def search(
                 seeds = jax.random.randint(
                     key, (qt.shape[0], n_seeds), 0, n, jnp.int32
                 )
-            d, i = _search_batch(index.dataset, index.graph, qt, seeds,
-                                 fw, k, L, w, max_iters, index.metric)
+            if use_kernel:
+                from raft_tpu.ops.beam_search import beam_search
+
+                d, i = beam_search(
+                    qt, index.dataset, index.graph, seeds, k, L, w,
+                    max_iters, index.metric,
+                    interpret=jax.default_backend() != "tpu")
+                if index.metric == DistanceType.InnerProduct:
+                    d = -d
+                elif index.metric == DistanceType.L2SqrtExpanded:
+                    d = jnp.where(jnp.isfinite(d),
+                                  jnp.sqrt(jnp.maximum(d, 0.0)), d)
+            else:
+                d, i = _search_batch(index.dataset, index.graph, qt, seeds,
+                                     fw, k, L, w, max_iters, index.metric)
             outs_d.append(d)
             outs_i.append(i)
         if len(outs_d) == 1:

@@ -1,0 +1,241 @@
+"""Single-dispatch CAGRA beam search — the TPU re-design of the
+reference's persistent single-CTA search kernel
+(``detail/cagra/search_single_cta_kernel-inl.cuh``; plan notes
+``search_plan.cuh:40-49``).
+
+The XLA path (``neighbors/cagra._search_batch``) walks the graph with a
+``lax.while_loop`` whose every iteration gathers ``w·deg`` dataset rows
+from HBM — row gathers and per-iteration loop sync are exactly what TPUs
+do worst. This kernel instead runs the WHOLE walk in one ``pallas_call``:
+
+- the (quantizable) **dataset lives in VMEM** for the kernel's lifetime
+  (v5e has 128 MB; 200k×128 bf16 = 51 MB) — candidate rows become
+  dynamic VMEM loads, ~cycles each, no HBM latency, no XLA gather op;
+- the **graph stays in HBM**; only the ``w`` chosen parents' adjacency
+  rows are DMA'd per iteration (w·deg·4 B per query — hundreds of bytes,
+  latency hidden behind scoring);
+- parent selection, id-dedup, and the top-L merge are the same
+  extract-min VPU network as ``ops/fused_topk`` — no sorts anywhere;
+- queries run in blocks of ``block_q`` per grid step, so scoring is a
+  few small MXU contractions per iteration rather than scalar work.
+
+Scope (the wrapper in ``neighbors/cagra`` falls back to the XLA path
+otherwise): L2Expanded/L2SqrtExpanded/InnerProduct, f32/bf16 dataset,
+``dim % 128 == 0``, no sample filter, dataset must fit the VMEM budget.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors._exact import dedup_candidate_mask
+from raft_tpu.ops.fused_topk import _default_vmem_mb, _extract_topk
+
+_SUPPORTED = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+              DistanceType.InnerProduct)
+
+
+def beam_search_fits(n: int, dim: int, itemsize: int,
+                     vmem_mb: int = 0) -> bool:
+    """Whether (n, dim) fits the VMEM-resident dataset budget (with
+    ~8 MB headroom for the kernel's scratch and queries)."""
+    if vmem_mb <= 0:
+        vmem_mb = _default_vmem_mb()
+    return n * dim * itemsize <= (vmem_mb - 8) * 1024 * 1024
+
+
+def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
+                 cand_ref, cand_sm, dist_ref, rows_ref, gsm, sem,
+                 *, L: int, w: int, k: int, C: int, deg: int,
+                 max_iters: int, ip_metric: bool):
+    B, d = q_ref.shape
+    qf = q_ref[:].astype(jnp.float32)                       # (B, d)
+    qn = jnp.sum(jnp.square(qf), axis=1, keepdims=True)     # (B, 1)
+    # bf16-origin rows multiply exactly in the f32 accumulator at
+    # DEFAULT; f32 rows need HIGHEST — the same exact-kNN choice as
+    # fused_topk._knn_kernel and _exact.gathered_distances
+    prec = (jax.lax.Precision.DEFAULT if ds_ref.dtype == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST)
+
+    def score_cand(cand):
+        """(B, C) candidate ids -> (B, C) min-form distances, via a
+        VMEM row-gather + two small MXU contractions per query row."""
+        # ids must be scalars for dynamic addressing: VMEM -> SMEM.
+        # Invalid ids (-1) are clamped for the gather only — compiled
+        # Mosaic has no OOB clamp; masking happens on the way out.
+        cand_ref[:] = jnp.maximum(cand, 0)
+        cp = pltpu.make_async_copy(cand_ref, cand_sm, sem)
+        cp.start()
+        cp.wait()
+        for b in range(B):
+            def gather(c, _):
+                rid = cand_sm[b, c]
+                rows_ref[pl.ds(c, 1), :] = ds_ref[pl.ds(rid, 1), :]
+                return 0
+            jax.lax.fori_loop(0, C, gather, 0, unroll=8)
+            rows = rows_ref[:].astype(jnp.float32)          # (C, d)
+            ip = jax.lax.dot_general(
+                qf[b:b + 1], rows, (((1,), (1,)), ((), ())),
+                precision=prec,
+                preferred_element_type=jnp.float32)         # (1, C)
+            if ip_metric:
+                dist_ref[pl.ds(b, 1), :] = -ip
+            else:
+                rn = jax.lax.dot_general(
+                    jnp.ones((1, d), jnp.float32), rows * rows,
+                    (((1,), (1,)), ((), ())),
+                    precision=prec,
+                    preferred_element_type=jnp.float32)     # (1, C)
+                dist_ref[pl.ds(b, 1), :] = jnp.maximum(
+                    rn - 2.0 * ip + qn[b], 0.0)
+        return jnp.where(cand < 0, jnp.inf, dist_ref[:])
+
+    def merge(ids, dvals, expl, cand, cd):
+        """Dedup-aware top-L merge (the XLA path's _buffer_merge with
+        lax.top_k replaced by the extract-min network; same shared
+        dedup mask as that engine)."""
+        buf_ids = jnp.where(ids >= 0, ids, -2)
+        dup = dedup_candidate_mask(cand, buf_ids)
+        cd = jnp.where(dup | (cand < 0), jnp.inf, cd)
+
+        all_d = jnp.concatenate([dvals, cd], axis=1)        # (B, L+C)
+        all_i = jnp.concatenate([ids, cand], axis=1)
+        new_d, new_i = _extract_topk(all_d, all_i, L)
+        # explored flags follow ids (buffer ids are unique post-dedup;
+        # fresh candidates enter unexplored)
+        keep = jnp.any(
+            (new_i[:, :, None] == buf_ids[:, None, :]) & (expl == 1)[:, None, :],
+            axis=2)
+        return new_i, new_d, keep.astype(jnp.int32)
+
+    # ---- seed round: the buffer starts as the best L of the seeds
+    seeds = seeds_ref[:]
+    sd = score_cand(seeds)
+    ids, dvals, expl = merge(
+        jnp.full((B, L), -1, jnp.int32), jnp.full((B, L), jnp.inf),
+        jnp.zeros((B, L), jnp.int32), seeds, sd)
+
+    def body(_, state):
+        ids, dvals, expl = state
+        # ---- pick w best unexplored as parents (extract-min rounds)
+        masked = jnp.where((expl == 1) | (ids < 0), jnp.inf, dvals)
+        _, parents = _extract_topk(masked, ids, w)          # (B, w)
+        pvalid = parents >= 0
+        # mark parents explored (ids are unique in the buffer)
+        expl = jnp.where(
+            jnp.any(ids[:, :, None] == jnp.where(
+                pvalid, parents, -3)[:, None, :], axis=2),
+            1, expl)
+
+        # ---- fetch the parents' adjacency rows from HBM
+        cand_ref[:, :w] = jnp.where(pvalid, parents, 0)
+        cp = pltpu.make_async_copy(cand_ref, cand_sm, sem)
+        cp.start()
+        cp.wait()
+        dmas = []
+        for b in range(B):
+            for j in range(w):
+                dmas.append(pltpu.make_async_copy(
+                    graph_ref.at[pl.ds(cand_sm[b, j], 1), :],
+                    gsm.at[pl.ds(b, 1), pl.ds(j * deg, deg)],
+                    sem))
+                dmas[-1].start()
+        for dma in dmas:
+            dma.wait()
+        cand = gsm[:]                                       # (B, C)
+        # lanes of an invalid parent are masked out
+        lane = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1) // deg
+        ok = jnp.zeros((B, C), jnp.bool_)
+        for j in range(w):
+            ok = ok | ((lane == j) & pvalid[:, j:j + 1])
+        cand = jnp.where(ok, cand, -1)
+
+        cd = score_cand(cand)
+        return merge(ids, dvals, expl, cand, cd)
+
+    ids, dvals, _ = jax.lax.fori_loop(0, max_iters, body,
+                                      (ids, dvals, expl))
+    outd_ref[:] = dvals[:, :k]
+    outi_ref[:] = jnp.where(jnp.isfinite(dvals[:, :k]), ids[:, :k], -1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "L", "w", "max_iters", "metric", "block_q",
+                     "interpret", "vmem_mb"))
+def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
+                max_iters: int, metric: DistanceType, *,
+                block_q: int = 8, interpret: bool = False,
+                vmem_mb: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """One-dispatch graph beam search (see module docstring).
+
+    ``seeds`` must be (q, w·deg) int32 — the seed round reuses the
+    candidate scoring path at its native width. Returns min-form (q, k)
+    distances + ids; the caller applies sqrt / IP negation."""
+    q, d = queries.shape
+    n, deg = graph.shape
+    C = w * deg
+    expect(metric in _SUPPORTED, f"beam_search: unsupported {metric}")
+    expect(d % 128 == 0, "beam_search: dim must be lane-aligned (128)")
+    expect(seeds.shape == (q, C), "beam_search: seeds must be (q, w*deg)")
+    expect(k <= L, "beam_search: k must be <= itopk L")
+    if vmem_mb <= 0:
+        vmem_mb = _default_vmem_mb()
+
+    B = block_q
+    pad_q = (-q) % B
+    if pad_q:
+        queries = jnp.pad(queries, ((0, pad_q), (0, 0)))
+        seeds = jnp.pad(seeds, ((0, pad_q), (0, 0)))
+    qp = q + pad_q
+    ds = dataset if dataset.dtype == jnp.bfloat16 else (
+        dataset.astype(jnp.float32))
+    qs = queries.astype(jnp.float32)
+
+    kernel = functools.partial(
+        _beam_kernel, L=L, w=w, k=k, C=C, deg=deg,
+        max_iters=max_iters,
+        ip_metric=metric == DistanceType.InnerProduct)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(qp // B,),
+        in_specs=[
+            pl.BlockSpec((B, d), lambda i: (i, 0)),                # queries
+            pl.BlockSpec((B, C), lambda i: (i, 0)),                # seeds
+            pl.BlockSpec((n, ds.shape[1]), lambda i: (0, 0)),      # dataset (VMEM-resident)
+            pl.BlockSpec(memory_space=pl.ANY),                     # graph (HBM)
+        ],
+        out_specs=[
+            pl.BlockSpec((B, k), lambda i: (i, 0)),
+            pl.BlockSpec((B, k), lambda i: (i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((B, C), jnp.int32),      # cand staging
+            pltpu.SMEM((B, C), jnp.int32),      # cand scalars
+            pltpu.VMEM((B, C), jnp.float32),    # distances
+            pltpu.VMEM((C, d), ds.dtype),       # gathered rows
+            pltpu.VMEM((B, C), jnp.int32),      # graph rows landing
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    outd, outi = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((qp, k), jnp.float32),
+            jax.ShapeDtypeStruct((qp, k), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=vmem_mb * 1024 * 1024),
+        interpret=interpret,
+    )(qs, seeds, ds, graph)
+    return outd[:q], outi[:q]

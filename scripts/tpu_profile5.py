@@ -85,13 +85,42 @@ def main():
     emit("cagra_build_cluster_join_200k",
          s=round(time.perf_counter() - t0, 1))
 
+    # engines: XLA while_loop at f32, and the Pallas VMEM-resident
+    # kernel on the bf16 index (200k x 128 f32 = 102 MB exceeds VMEM;
+    # bf16 = 51 MB fits the 64 MB budget — the kernel's design point)
+    ci16 = cagra.CagraIndex(dataset=ci.dataset.astype(jnp.bfloat16),
+                            graph=ci.graph, metric=ci.metric)
+    legs = [("xla_f32", ci, "xla"), ("pallas_bf16", ci16, "pallas"),
+            ("xla_bf16", ci16, "xla")]
     for it in (64, 128):
-        sp = cagra.CagraSearchParams(itopk_size=it, search_width=4)
-        dt = wall(lambda sp=sp: cagra.search(None, sp, ci, q, 10), iters=10)
-        _, i = cagra.search(None, sp, ci, q, 10)
-        r, _, _ = eval_recall(gt, np.asarray(i))
-        emit(f"cagra_search_itopk{it}", ms=round(dt * 1e3, 2),
-             qps=round(100 / dt, 1), recall=round(float(r), 4))
+        for tag, idx, algo in legs:
+            sp = cagra.CagraSearchParams(itopk_size=it, search_width=4,
+                                         algo=algo)
+            try:
+                dt = wall(lambda sp=sp, idx=idx:
+                          cagra.search(None, sp, idx, q, 10), iters=10)
+                _, i = cagra.search(None, sp, idx, q, 10)
+                r, _, _ = eval_recall(gt, np.asarray(i))
+                emit(f"cagra_search_itopk{it}_{tag}",
+                     ms=round(dt * 1e3, 2),
+                     qps=round(100 / dt, 1), recall=round(float(r), 4))
+            except Exception as e:  # noqa: BLE001
+                emit(f"cagra_search_itopk{it}_{tag}", error=str(e)[:200])
+
+    # a 100k f32 slice fits VMEM — the f32 kernel datapoint
+    try:
+        ci100 = cagra.build(None, cagra.CagraIndexParams(
+            graph_degree=32, intermediate_graph_degree=64,
+            build_algo=cagra.BuildAlgo.CLUSTER_JOIN), x[:100_000])
+        for algo in ("xla", "pallas"):
+            sp = cagra.CagraSearchParams(itopk_size=64, search_width=4,
+                                         algo=algo)
+            dt = wall(lambda sp=sp: cagra.search(None, sp, ci100, q, 10),
+                      iters=10)
+            emit(f"cagra_search_100k_f32_{algo}", ms=round(dt * 1e3, 2),
+                 qps=round(100 / dt, 1))
+    except Exception as e:  # noqa: BLE001
+        emit("cagra_search_100k_f32", error=str(e)[:200])
 
     # seed_pool variant (query-aware seeding)
     sp = cagra.CagraSearchParams(itopk_size=64, search_width=4,
@@ -140,6 +169,37 @@ def main():
         r, _, _ = eval_recall(gt, np.asarray(i))
         emit(f"ivf_bq_p{p}_refined", ms=round(dt * 1e3, 2),
              qps=round(100 / dt, 1), recall=round(float(r), 4))
+
+    # bits=2 (32 B/vec) — the multi-bit path added after round 2's
+    # relay death; A/B against 4-bit PQ at equal bytes
+    bi2 = ivf_bq.build(None, ivf_bq.IvfBqIndexParams(n_lists=1024, bits=2), x)
+
+    def bq2_full(sp):
+        _, cand = ivf_bq.search(None, sp, bi2, q, 40)
+        return refine_fn(None, xd, q, cand, 10)
+
+    for p in (32, 64):
+        sp = ivf_bq.IvfBqSearchParams(n_probes=p)
+        dt = wall(lambda sp=sp: bq2_full(sp), iters=10)
+        _, i = bq2_full(sp)
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        emit(f"ivf_bq2_p{p}_refined", ms=round(dt * 1e3, 2),
+             qps=round(100 / dt, 1), recall=round(float(r), 4))
+
+    # ---- 6. fp8 vs bf16 vs f32 LUT A/B at fixed probes
+    for dt_name in ("float32", "bfloat16", "float8_e4m3fn"):
+        lut_dt = getattr(jnp, dt_name)
+        sp = ivf_pq.IvfPqSearchParams(n_probes=32, lut_dtype=lut_dt,
+                                      score_mode="onehot")
+        try:
+            t = wall(lambda sp=sp: ivf_pq.search(None, sp, pi, q, 10),
+                     iters=10)
+            _, i = ivf_pq.search(None, sp, pi, q, 10)
+            r, _, _ = eval_recall(gt, np.asarray(i))
+            emit(f"ivf_pq_lut_{dt_name}", ms=round(t * 1e3, 2),
+                 recall=round(float(r), 4))
+        except Exception as e:  # noqa: BLE001
+            emit(f"ivf_pq_lut_{dt_name}", error=str(e)[:160])
 
 
 if __name__ == "__main__":

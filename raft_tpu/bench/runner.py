@@ -115,6 +115,11 @@ def _cagra_build(base, metric, *, graph_degree=64,
                  intermediate_graph_degree=128, **params):
     from raft_tpu.neighbors import cagra
 
+    if "build_algo" in params:
+        # native configs carry the enum value; reference confs spell it
+        # graph_build_algo: "IVF_PQ"/"NN_DESCENT" (raft_benchmark.cu:153)
+        params["build_algo"] = cagra.BuildAlgo(
+            str(params["build_algo"]).lower())
     p = cagra.CagraIndexParams(
         graph_degree=graph_degree,
         intermediate_graph_degree=intermediate_graph_degree,
@@ -182,6 +187,7 @@ _BUILD_KEY_MAP = {
     "pq_bits": "pq_bits",
     "graph_degree": "graph_degree",
     "intermediate_graph_degree": "intermediate_graph_degree",
+    "graph_build_algo": "build_algo",   # reference conf spelling
 }
 _SEARCH_KEY_MAP = {
     "nprobe": "n_probes",

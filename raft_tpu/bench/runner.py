@@ -128,12 +128,16 @@ def _cagra_build(base, metric, *, graph_degree=64,
 
 
 def _cagra_search(index, queries, k, *, itopk_size=64, max_iterations=0,
-                  **params):
+                  refine_ratio=1.0, **params):
     from raft_tpu.neighbors import cagra
 
     p = cagra.CagraSearchParams(itopk_size=itopk_size,
                                 max_iterations=max_iterations, **params)
-    return cagra.search(None, p, index, queries, k)
+    # CAGRA carries its own dataset — adapt to the shared refine helper
+    bundle = {"index": index, "base": index.dataset,
+              "metric": index.metric}
+    return _search_with_refine(cagra.search, bundle, queries, k, p,
+                               refine_ratio)
 
 
 def _quantized_build(base, metric, **params):

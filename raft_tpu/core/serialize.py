@@ -21,18 +21,45 @@ from numpy.lib import format as npy_format
 Writable = Union[BinaryIO, io.BufferedIOBase]
 
 
+# ml_dtypes extension dtypes (bfloat16, float8_*) have no .npy descr —
+# write_array stores them as raw void records ("|V2") that lose the
+# type. They ride the wire as a same-width uint view preceded by a
+# unicode marker record; plain numpy dtypes are written unmarked, so
+# old files read unchanged.
+_EXT_MARKER = "__raft_tpu_dtype__:"
+_UINT_FOR_WIDTH = {1: np.uint8, 2: np.uint16, 4: np.uint32}
+
+
 def serialize_array(fh: Writable, arr) -> None:
     """Write one array as an ``.npy`` record (host transfer if needed).
 
     Analog of ``raft::serialize_mdspan`` (``core/serialize.hpp:35``).
     """
     np_arr = np.asarray(jax.device_get(arr) if isinstance(arr, jax.Array) else arr)
+    if np_arr.dtype.kind == "V" and np_arr.dtype.names is None:
+        # bfloat16 / float8 extension dtype (NOT a structured record —
+        # those have .names and serialize natively)
+        import ml_dtypes
+
+        if hasattr(ml_dtypes, np_arr.dtype.name):
+            npy_format.write_array(
+                fh, np.asarray(_EXT_MARKER + np_arr.dtype.name),
+                allow_pickle=False)
+            np_arr = np_arr.view(_UINT_FOR_WIDTH[np_arr.dtype.itemsize])
     npy_format.write_array(fh, np_arr, allow_pickle=False)
 
 
 def deserialize_array(fh: BinaryIO) -> np.ndarray:
     """Read one ``.npy`` record (``raft::deserialize_mdspan``)."""
-    return npy_format.read_array(fh, allow_pickle=False)
+    arr = npy_format.read_array(fh, allow_pickle=False)
+    if (arr.dtype.kind == "U" and arr.ndim == 0
+            and str(arr).startswith(_EXT_MARKER)):
+        import ml_dtypes
+
+        dtype = np.dtype(getattr(ml_dtypes, str(arr)[len(_EXT_MARKER):]))
+        raw = npy_format.read_array(fh, allow_pickle=False)
+        return raw.view(dtype)
+    return arr
 
 
 def serialize_scalar(fh: Writable, value: Any, dtype=None) -> None:

@@ -34,7 +34,7 @@ from __future__ import annotations
 import dataclasses
 import enum
 from functools import partial
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -85,6 +85,13 @@ class CagraIndexParams:
     ivf_pq_n_lists: int = 0       # 0 → auto sqrt(n)
     ivf_pq_n_probes: int = 0      # 0 → auto
     refine_rate: float = 2.0      # gpu_top_k = degree * refine_rate
+    # dataset storage dtype for the built index: bf16 halves both the
+    # per-iteration gather bytes (XLA engine) and the VMEM residency
+    # (Pallas engine: 500k×128 bf16 fits where f32 does not); build
+    # math stays f32. Same contract as brute_force.build's
+    # storage_dtype: None keeps the input dtype; accepts a dtype or
+    # its name (JSON configs pass "bfloat16").
+    storage_dtype: Optional[Any] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -328,6 +335,13 @@ def build(
                              DistanceType.L2SqrtExpanded,
                              DistanceType.InnerProduct),
            f"cagra supports L2/InnerProduct, got {params.metric!r}")
+    if params.storage_dtype is not None:   # fail fast, before the build
+        expect(jnp.dtype(params.storage_dtype) in
+               (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)),
+               f"storage_dtype must be float32/bfloat16, got "
+               f"{params.storage_dtype!r}")
+        params = dataclasses.replace(
+            params, storage_dtype=jnp.dtype(params.storage_dtype))
     n = dataset.shape[0]
     ideg = min(params.intermediate_graph_degree, n - 1)
     odeg = min(params.graph_degree, ideg)
@@ -358,7 +372,10 @@ def build(
                 params.refine_rate,
             )
         graph = optimize(res, knn_graph, odeg)
-        return CagraIndex(dataset=res.put(dataset), graph=graph,
+        stored = dataset
+        if params.storage_dtype is not None:
+            stored = jnp.asarray(dataset).astype(params.storage_dtype)
+        return CagraIndex(dataset=res.put(stored), graph=graph,
                           metric=DistanceType(params.metric))
 
 

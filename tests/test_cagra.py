@@ -435,6 +435,31 @@ class TestBeamKernel:
 
 
 class TestBf16Dataset:
+    def test_store_dtype_build(self, dataset):
+        """build(store_dtype='bfloat16') halves storage; search quality
+        holds and serialization round-trips the half-width dataset."""
+        import io as _io
+
+        import jax.numpy as jnp
+
+        x, q = dataset
+        idx = cagra.build(None, CagraIndexParams(
+            graph_degree=16, intermediate_graph_degree=32,
+            build_algo=BuildAlgo.NN_DESCENT,
+            storage_dtype="bfloat16"), x)
+        assert idx.dataset.dtype == jnp.bfloat16
+        _, gt = _gt(x, q, 10)
+        _, i = cagra.search(None, CagraSearchParams(itopk_size=64,
+                                                    search_width=4),
+                            idx, q, 10)
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        assert r >= 0.9, r
+        buf = _io.BytesIO()
+        cagra.save(idx, buf)
+        buf.seek(0)
+        idx2 = cagra.load(None, buf)
+        assert idx2.dataset.dtype == jnp.bfloat16
+
     def test_bf16_search(self, dataset):
         """CAGRA over a bf16-stored dataset (halves the per-iteration
         gather bytes): search quality matches the f32 index."""

@@ -57,6 +57,34 @@ class TestSerialize:
         out = deserialize_array(buf)
         np.testing.assert_array_equal(out, arr)
 
+    @pytest.mark.parametrize("dtype_name", ["bfloat16", "float8_e4m3fn"])
+    def test_extension_dtype_roundtrip(self, rng_np, dtype_name):
+        """ml_dtypes arrays (bf16 datasets, fp8) have no .npy descr —
+        they ride as a marker record + uint view and come back typed."""
+        dtype = getattr(jnp, dtype_name)
+        buf = io.BytesIO()
+        arr = jnp.asarray(rng_np.standard_normal((6, 4)), dtype)
+        serialize_array(buf, arr)
+        buf.seek(0)
+        out = deserialize_array(buf)
+        assert out.dtype == np.dtype(dtype_name)
+        np.testing.assert_array_equal(out, np.asarray(arr))
+
+    def test_bf16_brute_force_index_roundtrip(self, rng_np):
+        """The end-to-end case that was broken: a bf16-storage index
+        must save/load (previously died with 'Dtype |V2')."""
+        from raft_tpu.neighbors import brute_force
+
+        x = rng_np.standard_normal((64, 16)).astype(np.float32)
+        idx = brute_force.build(None, x, storage_dtype=jnp.bfloat16)
+        buf = io.BytesIO()
+        brute_force.save(idx, buf)
+        buf.seek(0)
+        idx2 = brute_force.load(None, buf)
+        assert idx2.dataset.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(np.asarray(idx2.dataset),
+                                      np.asarray(idx.dataset))
+
     def test_scalar_roundtrip(self):
         buf = io.BytesIO()
         serialize_scalar(buf, 42, np.int64)

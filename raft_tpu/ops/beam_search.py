@@ -59,11 +59,12 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
     B, d = q_ref.shape
     qf = q_ref[:].astype(jnp.float32)                       # (B, d)
     qn = jnp.sum(jnp.square(qf), axis=1, keepdims=True)     # (B, 1)
-    # bf16-origin rows multiply exactly in the f32 accumulator at
-    # DEFAULT; f32 rows need HIGHEST — the same exact-kNN choice as
+    # bf16- and int8-origin rows multiply exactly in the f32
+    # accumulator at DEFAULT (|int8| <= 127 is bf16-exact); f32 rows
+    # need HIGHEST — the same exact-kNN choice as
     # fused_topk._knn_kernel and _exact.gathered_distances
-    prec = (jax.lax.Precision.DEFAULT if ds_ref.dtype == jnp.bfloat16
-            else jax.lax.Precision.HIGHEST)
+    prec = (jax.lax.Precision.HIGHEST if ds_ref.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
 
     def score_cand(cand):
         """(B, C) candidate ids -> (B, C) min-form distances, via a
@@ -196,8 +197,10 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
         queries = jnp.pad(queries, ((0, pad_q), (0, 0)))
         seeds = jnp.pad(seeds, ((0, pad_q), (0, 0)))
     qp = q + pad_q
-    ds = dataset if dataset.dtype == jnp.bfloat16 else (
-        dataset.astype(jnp.float32))
+    # bf16 halves and int8 quarters the VMEM residency (int8 is the
+    # CAGRA-Q role: quantized scan + exact refine outside)
+    ds = (dataset if dataset.dtype in (jnp.bfloat16, jnp.int8)
+          else dataset.astype(jnp.float32))
     qs = queries.astype(jnp.float32)
 
     kernel = functools.partial(

@@ -136,3 +136,93 @@ class TestStreamRead:
                                          tile=128, interpret=True))
         np.testing.assert_allclose(got[0], x.sum(axis=0), rtol=0.02,
                                    atol=0.5)
+
+
+class TestBeamSearchEdges:
+    """Edge shapes for the one-dispatch beam kernel (interpret mode);
+    the mainline parity tests live in test_cagra.TestBeamKernel."""
+
+    def _setup(self, rng, n=600, d=128, deg=8):
+        import scipy.spatial.distance as spd
+
+        x = rng.standard_normal((n, d)).astype(np.float32)
+        dm = spd.cdist(x, x, "sqeuclidean")
+        np.fill_diagonal(dm, np.inf)
+        graph = np.argsort(dm, 1)[:, :deg].astype(np.int32)
+        return x, graph
+
+    def test_query_padding_path(self, rng_np):
+        """q not a multiple of block_q exercises the pad+slice path;
+        results must match the same queries run in a full block."""
+        import jax.numpy as jnp
+
+        from raft_tpu.distance.types import DistanceType
+        from raft_tpu.ops.beam_search import beam_search
+
+        x, graph = self._setup(rng_np)
+        q = rng_np.standard_normal((8, 128)).astype(np.float32)
+        seeds = rng_np.integers(0, len(x), (8, 4 * 8)).astype(np.int32)
+        d8, i8 = beam_search(jnp.asarray(q), jnp.asarray(x),
+                             jnp.asarray(graph), jnp.asarray(seeds),
+                             5, 16, 4, 10, DistanceType.L2Expanded,
+                             interpret=True)
+        d3, i3 = beam_search(jnp.asarray(q[:3]), jnp.asarray(x),
+                             jnp.asarray(graph), jnp.asarray(seeds[:3]),
+                             5, 16, 4, 10, DistanceType.L2Expanded,
+                             interpret=True)
+        assert i3.shape == (3, 5)
+        np.testing.assert_array_equal(np.asarray(i3), np.asarray(i8)[:3])
+        np.testing.assert_allclose(np.asarray(d3), np.asarray(d8)[:3],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_L_exceeds_candidate_width(self, rng_np):
+        """itopk L > w*deg: the buffer starts partially empty (-1/inf
+        rows) and must still converge to exact top-k on a full graph."""
+        import jax.numpy as jnp
+        from raft_tpu.distance.types import DistanceType
+        from raft_tpu.ops.beam_search import beam_search
+
+        x, graph = self._setup(rng_np, n=300, deg=4)   # C = 16 < L = 48
+        q = rng_np.standard_normal((8, 128)).astype(np.float32)
+        seeds = rng_np.integers(0, len(x), (8, 16)).astype(np.int32)
+        d, i = beam_search(jnp.asarray(q), jnp.asarray(x),
+                           jnp.asarray(graph), jnp.asarray(seeds),
+                           10, 48, 4, 40, DistanceType.L2Expanded,
+                           interpret=True)
+        # parity with the XLA engine under the same partially-empty
+        # buffer (recall itself is bounded by the degree-4 graph)
+        from raft_tpu.neighbors.cagra import _search_batch
+
+        dx, ix = _search_batch(jnp.asarray(x), jnp.asarray(graph),
+                               jnp.asarray(q), jnp.asarray(seeds), None,
+                               10, 48, 4, 40, DistanceType.L2Expanded)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ix))
+        np.testing.assert_allclose(np.asarray(d), np.asarray(dx),
+                                   rtol=1e-5, atol=1e-5)
+        # returned distances sorted ascending, ids valid
+        dd = np.asarray(d)
+        assert (np.diff(dd, axis=1) >= -1e-5).all()
+        ii = np.asarray(i)
+        assert ii.min() >= 0 and ii.max() < len(x)
+
+    def test_bad_args_rejected(self, rng_np):
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        from raft_tpu.core.validation import RaftError
+        from raft_tpu.distance.types import DistanceType
+        from raft_tpu.ops.beam_search import beam_search
+
+        x, graph = self._setup(rng_np, n=100, d=128, deg=4)
+        q = rng_np.standard_normal((4, 128)).astype(np.float32)
+        seeds = rng_np.integers(0, 100, (4, 16)).astype(np.int32)
+        with _pytest.raises(RaftError, match="itopk"):
+            beam_search(jnp.asarray(q), jnp.asarray(x),
+                        jnp.asarray(graph), jnp.asarray(seeds),
+                        20, 10, 4, 5, DistanceType.L2Expanded,
+                        interpret=True)
+        with _pytest.raises(RaftError, match="seeds"):
+            beam_search(jnp.asarray(q), jnp.asarray(x),
+                        jnp.asarray(graph), jnp.asarray(seeds[:, :8]),
+                        5, 16, 4, 5, DistanceType.L2Expanded,
+                        interpret=True)

@@ -394,6 +394,39 @@ class TestDistributedIvfPq:
         assert r >= 0.9, r
 
 
+class TestDistributedCoarseAlgo:
+    def test_approx_coarse_close_to_exact(self, comms, rng_np):
+        """coarse_algo plumbs through the distributed searches (was
+        silently ignored — ADVICE r2): 'approx' routes the probe top-k
+        through approx_max_k and must stay close to exact; invalid
+        values fail loudly."""
+        import pytest as _pytest
+
+        from raft_tpu.core.validation import RaftError
+        from raft_tpu.distributed import ivf as dist_ivf
+        from raft_tpu.neighbors.ivf_flat import (
+            IvfFlatIndexParams,
+            IvfFlatSearchParams,
+        )
+        from raft_tpu.utils import eval_recall
+
+        x = rng_np.standard_normal((4096, 32)).astype(np.float32)
+        q = rng_np.standard_normal((16, 32)).astype(np.float32)
+        index = dist_ivf.build(None, comms,
+                               IvfFlatIndexParams(n_lists=32), x)
+        _, i_exact = dist_ivf.search(
+            None, IvfFlatSearchParams(n_probes=16), index, q, 10)
+        _, i_approx = dist_ivf.search(
+            None, IvfFlatSearchParams(n_probes=16, coarse_algo="approx"),
+            index, q, 10)
+        r, _, _ = eval_recall(np.asarray(i_exact), np.asarray(i_approx))
+        assert r >= 0.9, r
+        with _pytest.raises(RaftError, match="coarse_algo"):
+            dist_ivf.search(None,
+                            IvfFlatSearchParams(coarse_algo="bogus"),
+                            index, q, 10)
+
+
 class TestDistributedStreamingBuild:
     def test_streamed_equals_exact_at_full_probes(self, comms, rng_np,
                                                   tmp_path):

@@ -124,18 +124,18 @@ def _cagra_build(base, metric, *, graph_degree=64,
         graph_degree=graph_degree,
         intermediate_graph_degree=intermediate_graph_degree,
         metric=metric, **params)
-    return cagra.build(None, p, base)
+    # keep the RAW base for refine — with storage_dtype the index holds
+    # a quantized copy, and re-ranking against that recovers nothing
+    return {"index": cagra.build(None, p, base), "base": base,
+            "metric": metric}
 
 
-def _cagra_search(index, queries, k, *, itopk_size=64, max_iterations=0,
+def _cagra_search(bundle, queries, k, *, itopk_size=64, max_iterations=0,
                   refine_ratio=1.0, **params):
     from raft_tpu.neighbors import cagra
 
     p = cagra.CagraSearchParams(itopk_size=itopk_size,
                                 max_iterations=max_iterations, **params)
-    # CAGRA carries its own dataset — adapt to the shared refine helper
-    bundle = {"index": index, "base": index.dataset,
-              "metric": index.metric}
     return _search_with_refine(cagra.search, bundle, queries, k, p,
                                refine_ratio)
 

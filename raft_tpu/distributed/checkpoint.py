@@ -248,11 +248,21 @@ def load_bq(res, comms: Comms, fh_or_path):
 # multi-host per-process scheme
 # ---------------------------------------------------------------------------
 
+def _mesh_participants(comms: Comms):
+    """Process indices with devices in this comms mesh, sorted — the
+    save/load unit of the multihost scheme (NOT jax.process_count():
+    a sub-mesh may span fewer processes than the job)."""
+    return sorted({d.process_index for d in comms.mesh.devices.flat})
+
+
 def _local_block(a):
     """This process's contiguous dim-0 block of a list-sharded array,
     plus its global start offset (shards arrive device-ordered)."""
     shards = sorted(a.addressable_shards,
                     key=lambda s: int(s.index[0].start or 0))
+    expect(len(shards) > 0,
+           "this process holds no shard of the array — only mesh "
+           "participants may call the multihost save")
     start = int(shards[0].index[0].start or 0)
     pos = start
     for s in shards:
@@ -270,11 +280,17 @@ def _local_block(a):
 
 def _save_parts(dirpath, version: int, comms: Comms, sharded,
                 meta_scalars, meta_arrays) -> None:
-    """Write this process's part file (+ meta on rank 0). ``sharded``
-    arrays must share one dim-0 sharding (the list axis)."""
+    """Write this process's part file (+ meta from the first
+    participant). ``sharded`` arrays must share one dim-0 sharding
+    (the list axis). Non-participating processes are a no-op."""
+    participants = _mesh_participants(comms)
+    me = jax.process_index()
+    if me not in participants:
+        return
+    ordinal = participants.index(me)
+    n_parts = len(participants)
     os.makedirs(dirpath, exist_ok=True)
-    rank = comms.process_rank
-    with open(os.path.join(dirpath, f"part{rank:05d}.bin"), "wb") as fh:
+    with open(os.path.join(dirpath, f"part{ordinal:05d}.bin"), "wb") as fh:
         serialize_scalar(fh, version, np.int32)
         start = None
         for a in sharded:
@@ -282,10 +298,18 @@ def _save_parts(dirpath, version: int, comms: Comms, sharded,
             start = st if start is None else start
             serialize_array(fh, block)
         serialize_scalar(fh, start, np.int64)
-    if rank == 0:
+    if ordinal == 0:
+        # a re-save into an existing dir must not leave stale
+        # higher-ordinal parts behind — the loader would see a mixed
+        # checkpoint. Peers only write ordinals < n_parts, so removing
+        # the tail is race-free.
+        for stale in glob.glob(os.path.join(dirpath, "part*.bin")):
+            base = os.path.basename(stale)
+            if int(base[4:9]) >= n_parts:
+                os.remove(stale)
         with open(os.path.join(dirpath, "meta.bin"), "wb") as fh:
             serialize_scalar(fh, version, np.int32)
-            serialize_scalar(fh, jax.process_count(), np.int32)
+            serialize_scalar(fh, n_parts, np.int32)
             for s in meta_scalars:
                 serialize_scalar(fh, int(s), np.int32)
             for a in meta_arrays:

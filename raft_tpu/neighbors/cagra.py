@@ -555,13 +555,13 @@ def search(
     max_iters = params.max_iterations or (L // w + 24)
     filter_words = resolve_filter_words(sample_filter)
     use_kernel = _resolve_search_algo(params, index, filter_words)
-    if use_kernel:
-        # the kernel's seed round runs at candidate width
-        n_seeds = w * index.graph_degree
-    else:
-        n_seeds = max(L, w * index.graph_degree) * max(
-            1, params.num_random_samplings)
-        n_seeds = min(n_seeds, n)
+    # ONE seed-count formula for both engines (their parity depends on
+    # drawing identical seed sets): the XLA width, rounded up to a
+    # multiple of the kernel's chunk width C = w*graph_degree.
+    # Duplicate draws are harmless — the merge dedups them.
+    C = w * index.graph_degree
+    n_seeds = max(L, C) * max(1, params.num_random_samplings)
+    n_seeds = -(-n_seeds // C) * C
     if filter_words is not None and filter_words.ndim == 2:
         expect(filter_words.shape[0] == queries.shape[0],
                "per-query BitmapFilter rows must match the query count")
@@ -580,7 +580,8 @@ def search(
                                       min(n_seeds, params.seed_pool, n),
                                       index.metric)
                 if seeds.shape[1] < n_seeds:
-                    # kernel wants exactly w*deg: repeat the best seeds
+                    # pad to the shared width by repeating the best
+                    # seeds (dedup makes repeats free)
                     reps = -(-n_seeds // seeds.shape[1])
                     seeds = jnp.tile(seeds, (1, reps))[:, :n_seeds]
             else:

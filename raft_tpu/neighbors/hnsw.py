@@ -62,10 +62,13 @@ def _data_dtype(dtype) -> np.dtype:
 def save_hnswlib(res: Resources | None, index: CagraIndex, path: str,
                  ef_construction: int = 500) -> None:
     """Serialize ``index`` into hnswlib's native file format (see module
-    docstring for the exact layout). The result loads with
-    ``hnswlib.Index(space, dim).load_index(path)`` — use ``space='l2'``
-    for the L2 metrics and ``space='ip'`` for InnerProduct — and
-    searches at the recall of the CAGRA graph."""
+    docstring for the exact layout). Float32 exports load with stock
+    ``hnswlib.Index(space, dim).load_index(path)`` — ``space='l2'`` for
+    the L2 metrics, ``'ip'`` for InnerProduct — and search at the
+    recall of the CAGRA graph. int8/uint8 exports use the same layout
+    with 1-byte elements, which stock hnswlib's float spaces do NOT
+    understand (its data_size is dim*4) — they round-trip through
+    :func:`load_hnswlib` or custom-space builds only."""
     dataset = np.asarray(index.dataset)
     dt = _data_dtype(dataset.dtype)
     graph = np.asarray(index.graph, dtype=np.uint32)

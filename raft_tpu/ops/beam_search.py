@@ -117,12 +117,18 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
             axis=2)
         return new_i, new_d, keep.astype(jnp.int32)
 
-    # ---- seed round: the buffer starts as the best L of the seeds
-    seeds = seeds_ref[:]
-    sd = score_cand(seeds)
-    ids, dvals, expl = merge(
-        jnp.full((B, L), -1, jnp.int32), jnp.full((B, L), jnp.inf),
-        jnp.zeros((B, L), jnp.int32), seeds, sd)
+    # ---- seed rounds: the buffer starts as the best L of ALL seeds.
+    # Seeds arrive as a multiple of the candidate width C and merge in
+    # C-wide chunks, so any XLA-engine seed count (L > C, extra
+    # num_random_samplings draws) rides the same scoring path.
+    seeds = seeds_ref[:]                                    # (B, S)
+    ids = jnp.full((B, L), -1, jnp.int32)
+    dvals = jnp.full((B, L), jnp.inf)
+    expl = jnp.zeros((B, L), jnp.int32)
+    for chunk in range(seeds.shape[1] // C):
+        cand = seeds[:, chunk * C:(chunk + 1) * C]
+        ids, dvals, expl = merge(ids, dvals, expl, cand,
+                                 score_cand(cand))
 
     def body(_, state):
         ids, dvals, expl = state
@@ -178,15 +184,18 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
                 vmem_mb: int = 0) -> Tuple[jax.Array, jax.Array]:
     """One-dispatch graph beam search (see module docstring).
 
-    ``seeds`` must be (q, w·deg) int32 — the seed round reuses the
-    candidate scoring path at its native width. Returns min-form (q, k)
-    distances + ids; the caller applies sqrt / IP negation."""
+    ``seeds`` must be (q, m·w·deg) int32 for integer m ≥ 1 — the seed
+    rounds reuse the candidate scoring path in w·deg-wide chunks.
+    Returns min-form (q, k) distances + ids; the caller applies sqrt /
+    IP negation."""
     q, d = queries.shape
     n, deg = graph.shape
     C = w * deg
     expect(metric in _SUPPORTED, f"beam_search: unsupported {metric}")
     expect(d % 128 == 0, "beam_search: dim must be lane-aligned (128)")
-    expect(seeds.shape == (q, C), "beam_search: seeds must be (q, w*deg)")
+    expect(seeds.ndim == 2 and seeds.shape[0] == q
+           and seeds.shape[1] >= C and seeds.shape[1] % C == 0,
+           "beam_search: seeds must be (q, m*w*deg)")
     expect(k <= L, "beam_search: k must be <= itopk L")
     if vmem_mb <= 0:
         vmem_mb = _default_vmem_mb()
@@ -212,7 +221,7 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
         grid=(qp // B,),
         in_specs=[
             pl.BlockSpec((B, d), lambda i: (i, 0)),                # queries
-            pl.BlockSpec((B, C), lambda i: (i, 0)),                # seeds
+            pl.BlockSpec((B, seeds.shape[1]), lambda i: (i, 0)),   # seeds
             pl.BlockSpec((n, ds.shape[1]), lambda i: (0, 0)),      # dataset (VMEM-resident)
             pl.BlockSpec(memory_space=pl.ANY),                     # graph (HBM)
         ],

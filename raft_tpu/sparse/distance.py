@@ -15,6 +15,12 @@ unexpanded (nested-loop) CUDA paths. TPU re-design, two regimes:
   ``col_tile``-wide dense column slabs under ``lax.scan`` — memory is
   ``tile × col_tile`` regardless of ``n_cols``, matching the bound of
   the reference's SPMV path (``distance/detail/l2_distance.cuh``).
+  Row norms are one ``segment_sum`` per tile (hoisted out of the slab
+  loop; InnerProduct needs none).
+
+Row tiles are sliced with TIGHT nnz capacity (bucketed to a power of
+two so jit shapes stay bounded): every densify costs O(tile_nnz), not
+O(total_nnz) as a full-capacity ``row_slice`` would.
 
 Non-decomposable metrics on very wide inputs fail loudly with the
 memory bound (``RAFT_TPU_SPARSE_TILE_MB`` raises it) instead of
@@ -29,13 +35,13 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.pairwise import _pairwise_distance_impl
 from raft_tpu.distance.types import DistanceType
-from raft_tpu.sparse.ops import row_slice
 from raft_tpu.sparse.types import CSR
 
 # expanded metrics: computable from (x·yT, |x|^2, |y|^2) alone, hence
@@ -54,6 +60,22 @@ def _tile_budget_mb() -> int:
     return int(os.environ.get("RAFT_TPU_SPARSE_TILE_MB", "2048"))
 
 
+def _tight_row_slice(csr: CSR, indptr_host: np.ndarray, s: int,
+                     e: int) -> CSR:
+    """Rows [s, e) with nnz capacity bucketed to the next power of two
+    (bounded jit-shape count) — densifies in O(tile_nnz)."""
+    o = int(indptr_host[s])
+    n_keep = int(indptr_host[e]) - o
+    cap = max(8, 1 << (max(n_keep, 1) - 1).bit_length())
+    end = min(o + cap, csr.nnz)
+    pad = cap - (end - o)
+    idx = jnp.pad(csr.indices[o:end], (0, pad))
+    dat = jnp.pad(csr.data[o:end], (0, pad))
+    indptr = jnp.asarray(
+        np.clip(indptr_host[s:e + 1] - o, 0, n_keep), jnp.int32)
+    return CSR(indptr, idx, dat, (e - s, csr.shape[1]))
+
+
 def _dense_cols(csr: CSR, row_ids, cs, col_tile: int):
     """Dense (rows, col_tile) slab of the columns [cs, cs+col_tile) of a
     row-sliced CSR — ``cs`` may be traced (scan carry)."""
@@ -66,34 +88,36 @@ def _dense_cols(csr: CSR, row_ids, cs, col_tile: int):
     ].add(jnp.where(valid, csr.data, 0))
 
 
+@jax.jit
+def _row_sq_norms(csr: CSR):
+    """Per-row Σ data² — one segment_sum, independent of col tiling."""
+    r = csr.row_ids()
+    sq = jnp.where(r >= 0, jnp.square(csr.data.astype(jnp.float32)), 0.0)
+    return jax.ops.segment_sum(sq, jnp.clip(r, 0),
+                               num_segments=csr.shape[0])
+
+
 @partial(jax.jit, static_argnames=("metric", "col_tile", "n_cols"))
-def _expanded_block(xt: CSR, yt: CSR, metric: DistanceType,
+def _expanded_block(xt: CSR, yt: CSR, xn, yn, metric: DistanceType,
                     col_tile: int, n_cols: int):
     """One (x-tile, y-tile) distance block, Gram-accumulated over dense
-    column slabs — never materializes a full-width dense tile."""
+    column slabs — never materializes a full-width dense tile. Norms
+    arrive precomputed (hoisted out of the slab loop)."""
     xr = xt.row_ids()
     yr = yt.row_ids()
     nb = -(-n_cols // col_tile)
-    init = (
-        jnp.zeros((xt.shape[0], yt.shape[0]), jnp.float32),
-        jnp.zeros((xt.shape[0],), jnp.float32),
-        jnp.zeros((yt.shape[0],), jnp.float32),
-    )
+    init = jnp.zeros((xt.shape[0], yt.shape[0]), jnp.float32)
 
-    def step(carry, cs):
-        ip, xn, yn = carry
+    def step(ip, cs):
         xd = _dense_cols(xt, xr, cs, col_tile).astype(jnp.float32)
         yd = _dense_cols(yt, yr, cs, col_tile).astype(jnp.float32)
-        ip = ip + jax.lax.dot_general(
+        return ip + jax.lax.dot_general(
             xd, yd, (((1,), (1,)), ((), ())),
             precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32)
-        xn = xn + jnp.sum(jnp.square(xd), axis=1)
-        yn = yn + jnp.sum(jnp.square(yd), axis=1)
-        return (ip, xn, yn), None
+            preferred_element_type=jnp.float32), None
 
     starts = jnp.arange(nb, dtype=jnp.int32) * col_tile
-    (ip, xn, yn), _ = jax.lax.scan(step, init, starts)
+    ip, _ = jax.lax.scan(step, init, starts)
 
     if metric == DistanceType.InnerProduct:
         return ip
@@ -127,6 +151,7 @@ def pairwise_distance(
     past it they fail with the bound rather than allocate."""
     ensure_resources(res)
     assert x.shape[1] == y.shape[1], "column dims must match"
+    expect(tile > 0, f"tile must be positive, got {tile}")
     m = x.shape[0]
     n = y.shape[0]
     n_cols = x.shape[1]
@@ -137,6 +162,7 @@ def pairwise_distance(
     if col_tile is None and decomposable and full_tile_mb > _tile_budget_mb():
         col_tile = 8192
     if col_tile is not None:
+        expect(col_tile > 0, f"col_tile must be positive, got {col_tile}")
         expect(decomposable,
                f"column tiling needs an expanded metric (got {metric!r}); "
                "L1/Lp/Hamming-family metrics need full rows")
@@ -147,22 +173,30 @@ def pairwise_distance(
                f"the {_tile_budget_mb()} MB RAFT_TPU_SPARSE_TILE_MB budget "
                "— use an expanded metric (column-tiled) or shrink `tile`")
 
+    xip = np.asarray(jax.device_get(x.indptr))
+    yip = np.asarray(jax.device_get(y.indptr))
+    ip_metric = metric == DistanceType.InnerProduct
     with tracing.range("raft_tpu.sparse.pairwise_distance"):
+        # y tiles (and their norms) are reused across every x tile
+        ytiles = [_tight_row_slice(y, yip, ys, min(ys + tile, n))
+                  for ys in range(0, n, tile)]
+        yns = (None if col_tile is None or ip_metric
+               else [_row_sq_norms(yt) for yt in ytiles])
         rows = []
         for xs in range(0, m, tile):
             xe = min(xs + tile, m)
-            xt = row_slice(x, xs, xe)
-            xd = None if col_tile is not None else xt.to_dense()
-            cols = []
-            for ys in range(0, n, tile):
-                ye = min(ys + tile, n)
-                yt = row_slice(y, ys, ye)
-                if col_tile is not None:
-                    cols.append(_expanded_block(xt, yt, metric,
-                                                col_tile, n_cols))
-                else:
-                    cols.append(_pairwise_distance_impl(
-                        xd, yt.to_dense(), metric, metric_arg, "highest"))
+            xt = _tight_row_slice(x, xip, xs, xe)
+            if col_tile is not None:
+                xn = None if ip_metric else _row_sq_norms(xt)
+                cols = [_expanded_block(xt, yt, xn,
+                                        None if yns is None else yns[j],
+                                        metric, col_tile, n_cols)
+                        for j, yt in enumerate(ytiles)]
+            else:
+                xd = xt.to_dense()
+                cols = [_pairwise_distance_impl(
+                    xd, yt.to_dense(), metric, metric_arg, "highest")
+                    for yt in ytiles]
             rows.append(cols[0] if len(cols) == 1
                         else jnp.concatenate(cols, axis=1))
         return rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=0)

@@ -373,20 +373,25 @@ class TestBeamKernel:
             graph_degree=16, intermediate_graph_degree=32,
             build_algo=BuildAlgo.NN_DESCENT), x)
 
-    def test_matches_xla_engine_exactly(self, wide_dataset, wide_index):
-        """Same seeds (L == w*deg makes both engines draw identical
-        seed sets) -> identical ids, both metrics."""
+    @pytest.mark.parametrize("kw", [
+        dict(itopk_size=64, search_width=4),
+        # L (128) > w*deg (64): chunked seed rounds must keep parity
+        dict(itopk_size=128, search_width=4),
+        # extra seed draws ride the same chunked path
+        dict(itopk_size=64, search_width=4, num_random_samplings=2),
+    ])
+    def test_matches_xla_engine_exactly(self, wide_dataset, wide_index,
+                                        kw):
+        """Both engines draw one shared seed set -> identical ids."""
         x, q = wide_dataset
-        for metric, idx in [(DistanceType.L2Expanded, wide_index)]:
-            sp_x = CagraSearchParams(itopk_size=64, search_width=4,
-                                     algo="xla")
-            sp_p = CagraSearchParams(itopk_size=64, search_width=4,
-                                     algo="pallas")
-            dx, ix = cagra.search(None, sp_x, idx, q, 10)
-            dp, ip = cagra.search(None, sp_p, idx, q, 10)
-            np.testing.assert_array_equal(np.asarray(ix), np.asarray(ip))
-            np.testing.assert_allclose(np.asarray(dx), np.asarray(dp),
-                                       rtol=1e-4, atol=1e-4)
+        idx = wide_index
+        dx, ix = cagra.search(None, CagraSearchParams(algo="xla", **kw),
+                              idx, q, 10)
+        dp, ip = cagra.search(None, CagraSearchParams(algo="pallas", **kw),
+                              idx, q, 10)
+        np.testing.assert_array_equal(np.asarray(ix), np.asarray(ip))
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(dp),
+                                   rtol=1e-4, atol=1e-4)
 
     def test_recall_and_bf16(self, wide_dataset, wide_index):
         import jax.numpy as jnp

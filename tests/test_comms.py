@@ -622,6 +622,33 @@ class TestDistributedCheckpoint:
         np.testing.assert_allclose(np.asarray(d0), np.asarray(d1),
                                    rtol=1e-5, atol=1e-5)
 
+    def test_multihost_resave_clears_stale_parts(self, rng_np, tmp_path):
+        """Re-saving into a directory that previously held MORE parts
+        (a larger process count) must not leave stale part files the
+        loader would reject as a mixed checkpoint."""
+        from raft_tpu.distributed import checkpoint, ivf_flat as divf
+        from raft_tpu.neighbors.ivf_flat import (
+            IvfFlatIndexParams,
+            IvfFlatSearchParams,
+        )
+
+        comms = local_comms()
+        x = rng_np.standard_normal((2048, 16)).astype(np.float32)
+        q = rng_np.standard_normal((8, 16)).astype(np.float32)
+        idx = divf.build(None, comms, IvfFlatIndexParams(n_lists=16), x)
+        ckpt = str(tmp_path / "resave")
+        checkpoint.save_flat_multihost(idx, ckpt)
+        # plant stale higher-ordinal parts from an imaginary prior
+        # 3-process save
+        for stale in ("part00001.bin", "part00002.bin"):
+            (tmp_path / "resave" / stale).write_bytes(b"junk")
+        checkpoint.save_flat_multihost(idx, ckpt)
+        loaded = checkpoint.load_flat_multihost(None, comms, ckpt)
+        sp = IvfFlatSearchParams(n_probes=8)
+        d0, i0 = divf.search(None, sp, idx, q, 5)
+        d1, i1 = divf.search(None, sp, loaded, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
     def test_wrong_kind_fails_clearly(self, rng_np, tmp_path):
         """Loading a PQ checkpoint with the flat loader (or vice versa)
         raises a version mismatch, not a shape error mid-parse."""

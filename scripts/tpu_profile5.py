@@ -107,6 +107,23 @@ def main():
             except Exception as e:  # noqa: BLE001
                 emit(f"cagra_search_itopk{it}_{tag}", error=str(e)[:200])
 
+    # kernel block_q sweep (queries per grid step): the VMEM-resident
+    # design's main tunable — pin the default from this
+    try:
+        from raft_tpu.ops.beam_search import beam_search
+
+        seeds = jnp.asarray(
+            rng.integers(0, len(x), (100, 4 * 32)).astype(np.int32))
+        x16 = ci16.dataset
+        for bq in (4, 8, 16):
+            dt = wall(lambda bq=bq: beam_search(
+                jnp.asarray(q), x16, ci.graph, seeds, 10, 64, 4, 40,
+                ci.metric, block_q=bq), iters=10)
+            emit(f"beam_blockq{bq}", ms=round(dt * 1e3, 2),
+                 qps=round(100 / dt, 1))
+    except Exception as e:  # noqa: BLE001
+        emit("beam_blockq", error=str(e)[:200])
+
     # a 100k f32 slice fits VMEM — the f32 kernel datapoint
     try:
         ci100 = cagra.build(None, cagra.CagraIndexParams(

@@ -1,0 +1,129 @@
+"""Direct smoke coverage for public API entries that were only
+exercised indirectly (found by diffing docs/api.md against the test
+corpus) — each asserts real semantics, not just 'does not throw'."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class TestLinalgExtras:
+    def test_eig_jacobi_matches_dc(self, rng_np):
+        from raft_tpu.linalg import eig_dc, eig_jacobi
+
+        a = rng_np.standard_normal((12, 12)).astype(np.float32)
+        a = a @ a.T
+        vj, wj = eig_jacobi(None, a)      # (vectors, values) order
+        vd, wd = eig_dc(None, a)
+        np.testing.assert_allclose(np.asarray(wj), np.asarray(wd),
+                                   rtol=1e-4, atol=1e-4)
+        # eigenvector property: A v = w v
+        av = a @ np.asarray(vj)
+        np.testing.assert_allclose(av, np.asarray(vj) * np.asarray(wj),
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_map_reduce(self, rng_np):
+        from raft_tpu.linalg import map_reduce
+
+        x = rng_np.standard_normal((100,)).astype(np.float32)
+        got = map_reduce(None, jnp.asarray(x), jnp.square)
+        np.testing.assert_allclose(float(got), float((x ** 2).sum()),
+                                   rtol=1e-5)
+
+
+class TestFusedL2NNPrecomputed:
+    def test_matches_plain_variant(self, rng_np):
+        from raft_tpu.distance.fused_l2_nn import (
+            fused_l2_nn_argmin,
+            fused_l2_nn_argmin_precomputed,
+        )
+
+        x = rng_np.standard_normal((40, 16)).astype(np.float32)
+        y = rng_np.standard_normal((30, 16)).astype(np.float32)
+        d0, i0 = fused_l2_nn_argmin(None, x, y)
+        yn = (y.astype(np.float32) ** 2).sum(1)
+        d1, i1 = fused_l2_nn_argmin_precomputed(x, y, yn)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_allclose(np.asarray(d0), np.asarray(d1),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestSparseOpsExtras:
+    def test_coo_sort_orders_and_pads_last(self):
+        from raft_tpu.sparse.ops import coo_sort
+        from raft_tpu.sparse.types import COO
+
+        coo = COO(jnp.asarray([2, 0, -1, 1], jnp.int32),
+                  jnp.asarray([1, 3, 0, 2], jnp.int32),
+                  jnp.asarray([1.0, 2.0, 0.0, 3.0]), (3, 4))
+        out = coo_sort(coo)
+        assert np.asarray(out.rows).tolist() == [0, 1, 2, -1]
+        assert np.asarray(out.cols).tolist() == [3, 2, 1, 0]
+
+    def test_csr_row_op(self, rng_np):
+        import scipy.sparse as sp
+
+        from raft_tpu.sparse.ops import csr_row_op
+        from raft_tpu.sparse.types import CSR
+
+        m = sp.random(6, 8, density=0.4, random_state=0,
+                      format="csr", dtype=np.float32)
+        csr = CSR.from_scipy(m)
+        out = csr_row_op(csr, lambda r, v: v * (r + 1).astype(v.dtype))
+        want = m.toarray() * (np.arange(6) + 1)[:, None]
+        np.testing.assert_allclose(np.asarray(out.to_dense()), want,
+                                   rtol=1e-6)
+
+    def test_coo_dense_roundtrip(self, rng_np):
+        from raft_tpu.sparse.convert import coo_to_dense, dense_to_coo
+
+        d = rng_np.standard_normal((5, 7)).astype(np.float32)
+        d[d < 0.5] = 0
+        coo = dense_to_coo(d)
+        np.testing.assert_allclose(np.asarray(coo_to_dense(coo)), d)
+
+
+class TestMatrixPrint:
+    def test_prints_shape_and_values(self, capsys):
+        from raft_tpu.matrix.ops import matrix_print
+
+        matrix_print(jnp.arange(12.0).reshape(3, 4), name="m")
+        out = capsys.readouterr().out
+        assert "m shape=(3, 4)" in out
+        assert "0." in out
+
+
+class TestKmeansFitPredict:
+    def test_labels_match_predict(self, rng_np):
+        from raft_tpu.cluster import kmeans
+
+        c = rng_np.standard_normal((4, 8)) * 6
+        x = (c[rng_np.integers(0, 4, 400)]
+             + rng_np.standard_normal((400, 8))).astype(np.float32)
+        params = kmeans.KMeansParams(n_clusters=4, max_iter=20, seed=0)
+        centers, labels, inertia, n_iter = kmeans.fit_predict(None, params, x)
+        labels2, _ = kmeans.predict(None, params, centers, x)
+        np.testing.assert_array_equal(np.asarray(labels),
+                                      np.asarray(labels2))
+
+
+class TestCommsSendrecv:
+    def test_rotation(self):
+        from jax.sharding import PartitionSpec as P
+
+        from raft_tpu.comms.bootstrap import local_comms
+        from raft_tpu.comms.comms import device_sendrecv
+
+        comms = local_comms()
+        r = comms.size
+        x = jax.device_put(
+            jnp.arange(r, dtype=jnp.float32)[:, None],
+            comms.row_sharded())
+        perm = [(i, (i + 1) % r) for i in range(r)]
+        out = comms.run(lambda xl: device_sendrecv(xl, perm, "data"),
+                        x, in_specs=(P("data", None),),
+                        out_specs=P("data", None), check_vma=False)
+        got = np.asarray(out).ravel()
+        want = np.roll(np.arange(r, dtype=np.float32), 1)
+        np.testing.assert_array_equal(got, want)

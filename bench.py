@@ -72,10 +72,19 @@ def _probe_once(timeout_s: float) -> bool:
     try:
         r = subprocess.run(
             [sys.executable, "-c",
-             "import jax; jax.devices(); print('OK')"],
+             "import jax; jax.devices(); "
+             "print('BACKEND=' + jax.default_backend())"],
             capture_output=True, timeout=timeout_s, text=True,
         )
-        return "OK" in r.stdout
+        # a probe that "succeeds" via jax's silent CPU fallback is NOT
+        # a healthy accelerator — the metric would be CPU-measured but
+        # labeled as the TPU number
+        for line in r.stdout.splitlines():
+            if line.startswith("BACKEND="):
+                backend = line.split("=", 1)[1].strip()
+                log(f"probe backend: {backend}")
+                return backend != "cpu"
+        return False
     except subprocess.TimeoutExpired:
         return False
 
@@ -100,7 +109,31 @@ def _probe_plan():
     return out
 
 
+def _relay_port_open():
+    """Instant TCP pre-check of the relay's listener ports — when the
+    relay process is gone (round-2 failure mode) nothing in-container
+    can bring it back, so the multi-minute init probes are pointless.
+    Returns None (inconclusive) when the pool IP env var is unset —
+    then the full probe plan runs as before."""
+    import socket
+
+    host = (os.environ.get("PALLAS_AXON_POOL_IPS") or "").split(",")[0]
+    if not host:
+        return None
+    for port in (8082, 8083, 8093):
+        try:
+            with socket.create_connection((host, port), timeout=2):
+                return True
+        except OSError:
+            continue
+    return False
+
+
 def _backend_healthy() -> bool:
+    if _relay_port_open() is False:
+        log("relay listener ports closed — relay process is down; "
+            "one short probe then CPU fallback")
+        return _probe_once(60.0)
     for i, (timeout_s, sleep_s) in enumerate(_probe_plan()):
         log(f"probe attempt {i + 1}: init timeout {timeout_s:.0f}s")
         if _probe_once(timeout_s):

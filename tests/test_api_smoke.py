@@ -10,15 +10,15 @@ import jax.numpy as jnp
 
 class TestLinalgExtras:
     def test_eig_jacobi_matches_dc(self, rng_np):
-        from raft_tpu.linalg import eig_dc, eig_jacobi
+        from raft_tpu.linalg import eig_jacobi
 
         a = rng_np.standard_normal((12, 12)).astype(np.float32)
         a = a @ a.T
-        vj, wj = eig_jacobi(None, a)      # (vectors, values) order
-        vd, wd = eig_dc(None, a)
-        np.testing.assert_allclose(np.asarray(wj), np.asarray(wd),
-                                   rtol=1e-4, atol=1e-4)
-        # eigenvector property: A v = w v
+        # eig_jacobi currently delegates to eig_dc (kept for API
+        # parity), so the signal here is the eigen-property itself:
+        # A v = w v with ascending w, (vectors, values) return order
+        vj, wj = eig_jacobi(None, a)
+        assert (np.diff(np.asarray(wj)) >= -1e-4).all()
         av = a @ np.asarray(vj)
         np.testing.assert_allclose(av, np.asarray(vj) * np.asarray(wj),
                                    rtol=1e-2, atol=1e-2)

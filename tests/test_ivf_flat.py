@@ -132,6 +132,25 @@ class TestIvfFlat:
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
         np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
 
+    def test_bf16_serialization_roundtrip(self, dataset, tmp_path):
+        """bf16-stored lists survive save/load (regression: ml_dtypes
+        arrays previously wrote as untyped '|V2' npy records)."""
+        import jax.numpy as jnp
+
+        x, q = dataset
+        index = ivf_flat.build(None, IvfFlatIndexParams(n_lists=16),
+                               jnp.asarray(x, jnp.bfloat16))
+        assert index.data.dtype == jnp.bfloat16
+        path = tmp_path / "ivf_bf16.bin"
+        ivf_flat.save(index, path)
+        loaded = ivf_flat.load(None, path)
+        assert loaded.data.dtype == jnp.bfloat16
+        _, i1 = ivf_flat.search(None, IvfFlatSearchParams(n_probes=4),
+                                index, q, 5)
+        _, i2 = ivf_flat.search(None, IvfFlatSearchParams(n_probes=4),
+                                loaded, q, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
     def test_k_larger_than_probed(self, dataset):
         """k bigger than candidates in probed lists → -1 padding."""
         x, q = dataset

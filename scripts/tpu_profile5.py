@@ -13,9 +13,15 @@ around pallas_call; that wedged the remote-compile helper in round 4).
 """
 
 import json
+import os
 import time
 
 import numpy as np
+
+# the beam/fused kernels derive their VMEM budget from device_kind;
+# pin it here so an unrecognized relayed kind string can't silently
+# disable every pallas leg (v5e measured safe at 64 MB)
+os.environ.setdefault("RAFT_TPU_VMEM_MB", "64")
 
 import jax
 import jax.numpy as jnp
@@ -36,7 +42,9 @@ def emit(piece, **kw):
 
 
 def main():
-    emit("config", backend=jax.default_backend())
+    emit("config", backend=jax.default_backend(),
+         device=jax.devices()[0].device_kind,
+         vmem_mb=os.environ.get("RAFT_TPU_VMEM_MB"))
 
     from raft_tpu.distance.types import DistanceType
     from raft_tpu.ops.fused_topk import fused_knn

@@ -8,8 +8,11 @@ Run: PYTHONPATH=/root/repo:/root/.axon_site python scripts/tpu_smoke_kernels.py
 """
 
 import json
+import os
 
 import numpy as np
+
+os.environ.setdefault("RAFT_TPU_VMEM_MB", "64")  # see tpu_profile5.py
 
 import jax
 import jax.numpy as jnp

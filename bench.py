@@ -226,6 +226,11 @@ def parent_main():
 
 def child_main():
     log(f"child: importing jax (config {N}x{D}, batch {BATCH}, k {K})")
+    # fused_knn sizes tiles from a per-device-generation VMEM budget;
+    # a relayed backend with an unrecognized device_kind would fall to
+    # the conservative 16 MB and shrink tiles. Pin the measured-safe
+    # v5e budget (explicit env still wins).
+    os.environ.setdefault("RAFT_TPU_VMEM_MB", "64")
     import jax
     import jax.numpy as jnp
 

@@ -164,3 +164,32 @@ class TestPrims:
         lines = out.read_text().strip().splitlines()
         assert len(lines) == 1
         assert json.loads(lines[0])["prim"] == "select_k_xla"
+
+
+class TestCagraBundleRefine:
+    def test_refine_uses_raw_base(self, rng_np):
+        """Regression (review r3): with storage_dtype the index holds a
+        quantized copy — refine must re-rank against the RAW f32 base,
+        and refined distances must therefore be exact f32 L2."""
+        import jax.numpy as jnp
+
+        from raft_tpu.bench.runner import _cagra_build, _cagra_search
+        from raft_tpu.distance.types import DistanceType
+
+        c = rng_np.standard_normal((6, 128)) * 5
+        x = (c[rng_np.integers(0, 6, 1200)]
+             + rng_np.standard_normal((1200, 128))).astype(np.float32)
+        q = (c[rng_np.integers(0, 6, 8)]
+             + rng_np.standard_normal((8, 128))).astype(np.float32)
+        bundle = _cagra_build(x, DistanceType.L2Expanded,
+                              graph_degree=16,
+                              intermediate_graph_degree=32,
+                              build_algo="NN_DESCENT",
+                              storage_dtype="bfloat16")
+        assert bundle["index"].dataset.dtype == jnp.bfloat16
+        assert np.asarray(bundle["base"]).dtype == np.float32
+        d, i = _cagra_search(bundle, q, 5, itopk_size=32,
+                             search_width=4, refine_ratio=2.0)
+        ref = np.sum((q[:, None] - x[np.asarray(i)]) ** 2, axis=2)
+        np.testing.assert_allclose(np.asarray(d), ref, rtol=1e-4,
+                                   atol=1e-3)

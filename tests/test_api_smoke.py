@@ -9,7 +9,7 @@ import jax.numpy as jnp
 
 
 class TestLinalgExtras:
-    def test_eig_jacobi_matches_dc(self, rng_np):
+    def test_eig_jacobi_eigen_property(self, rng_np):
         from raft_tpu.linalg import eig_jacobi
 
         a = rng_np.standard_normal((12, 12)).astype(np.float32)

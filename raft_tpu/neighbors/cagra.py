@@ -150,6 +150,19 @@ class CagraIndex:
     def graph_degree(self) -> int:
         return self.graph.shape[1]
 
+    @property
+    def padded_graph(self) -> jax.Array:
+        """Adjacency rows padded to the Pallas kernel's 128-lane DMA
+        unit, computed lazily and cached on the index so repeated
+        ``search()`` calls don't re-copy the graph."""
+        cached = self.__dict__.get("_padded_graph")
+        if cached is None:
+            from raft_tpu.ops.beam_search import pad_graph
+
+            cached = pad_graph(self.graph)
+            object.__setattr__(self, "_padded_graph", cached)
+        return cached
+
 
 # ---------------------------------------------------------------------------
 # build
@@ -569,6 +582,9 @@ def search(
     with tracing.range("raft_tpu.cagra.search"):
         outs_d, outs_i = [], []
         tile = max(1, params.query_tile)
+        # padded once per index, not per search call or query tile
+        # (the kernel DMAs whole 128-lane-aligned adjacency rows)
+        padded_graph = index.padded_graph if use_kernel else None
         for start in range(0, queries.shape[0], tile):
             qt = queries[start : start + tile]
             fw = filter_words
@@ -595,8 +611,9 @@ def search(
                 from raft_tpu.ops.beam_search import beam_search
 
                 d, i = beam_search(
-                    qt, index.dataset, index.graph, seeds, k, L, w,
+                    qt, index.dataset, padded_graph, seeds, k, L, w,
                     max_iters, index.metric,
+                    deg=index.graph_degree,
                     interpret=jax.default_backend() != "tpu")
                 if index.metric == DistanceType.InnerProduct:
                     d = -d

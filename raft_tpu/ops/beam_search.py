@@ -52,9 +52,20 @@ def beam_search_fits(n: int, dim: int, itemsize: int,
     return n * dim * itemsize <= (vmem_mb - 8) * 1024 * 1024
 
 
+def pad_graph(graph) -> jax.Array:
+    """Pad adjacency rows to the next 128 multiple (lane-aligned DMA
+    unit) with -1 fill.  Call once per index when searching in query
+    tiles; ``beam_search`` pads unpadded graphs itself otherwise."""
+    deg = graph.shape[1]
+    Gp = -(-deg // 128) * 128
+    if Gp == deg:
+        return graph
+    return jnp.pad(graph, ((0, 0), (0, Gp - deg)), constant_values=-1)
+
+
 def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
                  cand_ref, cand_sm, dist_ref, rows_ref, gsm, sem,
-                 *, L: int, w: int, k: int, C: int, deg: int,
+                 *, L: int, w: int, k: int, C: int, deg: int, Gp: int,
                  max_iters: int, ip_metric: bool):
     B, d = q_ref.shape
     qf = q_ref[:].astype(jnp.float32)                       # (B, d)
@@ -81,7 +92,9 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
                 rid = cand_sm[b, c]
                 rows_ref[pl.ds(c, 1), :] = ds_ref[pl.ds(rid, 1), :]
                 return 0
-            jax.lax.fori_loop(0, C, gather, 0, unroll=8)
+            # Mosaic lowers fori_loop only at unroll=1 or a full
+            # unroll; partial unrolls are rejected at compile time.
+            jax.lax.fori_loop(0, C, gather, 0, unroll=1)
             rows = rows_ref[:].astype(jnp.float32)          # (C, d)
             ip = jax.lax.dot_general(
                 qf[b:b + 1], rows, (((1,), (1,)), ((), ())),
@@ -142,8 +155,15 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
                 pvalid, parents, -3)[:, None, :], axis=2),
             1, expl)
 
-        # ---- fetch the parents' adjacency rows from HBM
-        cand_ref[:, :w] = jnp.where(pvalid, parents, 0)
+        # ---- fetch the parents' adjacency rows from HBM.  Mosaic only
+        # allows lane-dim DMA slices at 128-aligned offsets/widths, so
+        # the graph arrives padded to Gp (= deg rounded up to 128),
+        # whole padded rows land at j*Gp offsets, and the compact
+        # (B, C) candidate block is re-assembled with aligned-start
+        # static value slices (both patterns verified on the compiler).
+        cand_ref[:] = jnp.concatenate(
+            [jnp.where(pvalid, parents, 0),
+             jnp.zeros((B, C - w), jnp.int32)], axis=1)
         cp = pltpu.make_async_copy(cand_ref, cand_sm, sem)
         cp.start()
         cp.wait()
@@ -152,12 +172,14 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
             for j in range(w):
                 dmas.append(pltpu.make_async_copy(
                     graph_ref.at[pl.ds(cand_sm[b, j], 1), :],
-                    gsm.at[pl.ds(b, 1), pl.ds(j * deg, deg)],
+                    gsm.at[pl.ds(b * w + j, 1), :],
                     sem))
                 dmas[-1].start()
         for dma in dmas:
             dma.wait()
-        cand = gsm[:]                                       # (B, C)
+        gv = gsm[:].reshape(B, w * Gp)
+        cand = jnp.concatenate(
+            [gv[:, j * Gp:j * Gp + deg] for j in range(w)], axis=1)
         # lanes of an invalid parent are masked out
         lane = jax.lax.broadcasted_iota(jnp.int32, (B, C), 1) // deg
         ok = jnp.zeros((B, C), jnp.bool_)
@@ -177,19 +199,27 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "w", "max_iters", "metric", "block_q",
-                     "interpret", "vmem_mb"))
+                     "interpret", "vmem_mb", "deg"))
 def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
                 max_iters: int, metric: DistanceType, *,
                 block_q: int = 8, interpret: bool = False,
-                vmem_mb: int = 0) -> Tuple[jax.Array, jax.Array]:
+                vmem_mb: int = 0,
+                deg: int = 0) -> Tuple[jax.Array, jax.Array]:
     """One-dispatch graph beam search (see module docstring).
 
     ``seeds`` must be (q, m·w·deg) int32 for integer m ≥ 1 — the seed
     rounds reuse the candidate scoring path in w·deg-wide chunks.
     Returns min-form (q, k) distances + ids; the caller applies sqrt /
-    IP negation."""
+    IP negation.
+
+    ``deg``: the graph's logical degree, when ``graph`` arrives with
+    its rows already padded to a 128 multiple (see ``pad_graph``) —
+    callers that search in query tiles pad once instead of per tile.
+    0 means the graph is unpadded and its width is the degree."""
     q, d = queries.shape
-    n, deg = graph.shape
+    n, gw = graph.shape
+    deg = deg or gw
+    expect(deg <= gw, "beam_search: deg exceeds graph width")
     C = w * deg
     expect(metric in _SUPPORTED, f"beam_search: unsupported {metric}")
     expect(d % 128 == 0, "beam_search: dim must be lane-aligned (128)")
@@ -211,9 +241,17 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
     ds = (dataset if dataset.dtype in (jnp.bfloat16, jnp.int8)
           else dataset.astype(jnp.float32))
     qs = queries.astype(jnp.float32)
+    # Lane-dim DMA slices must be 128-aligned: ship the graph with its
+    # rows padded to Gp and fetch whole padded rows (costs HBM
+    # bandwidth ~Gp/deg per fetch; candidate scoring stays at C wide).
+    Gp = -(-deg // 128) * 128
+    expect(gw in (deg, Gp),
+           "beam_search: graph width must be deg or deg padded to 128")
+    if gw != Gp:
+        graph = pad_graph(graph)
 
     kernel = functools.partial(
-        _beam_kernel, L=L, w=w, k=k, C=C, deg=deg,
+        _beam_kernel, L=L, w=w, k=k, C=C, deg=deg, Gp=Gp,
         max_iters=max_iters,
         ip_metric=metric == DistanceType.InnerProduct)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -234,7 +272,7 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
             pltpu.SMEM((B, C), jnp.int32),      # cand scalars
             pltpu.VMEM((B, C), jnp.float32),    # distances
             pltpu.VMEM((C, d), ds.dtype),       # gathered rows
-            pltpu.VMEM((B, C), jnp.int32),      # graph rows landing
+            pltpu.VMEM((B * w, Gp), jnp.int32),  # graph rows landing
             pltpu.SemaphoreType.DMA,
         ],
     )

@@ -118,15 +118,19 @@ def main():
     # kernel block_q sweep (queries per grid step): the VMEM-resident
     # design's main tunable — pin the default from this
     try:
-        from raft_tpu.ops.beam_search import beam_search
+        from raft_tpu.ops.beam_search import beam_search, pad_graph
 
         seeds = jnp.asarray(
             rng.integers(0, len(x), (100, 4 * 32)).astype(np.int32))
         x16 = ci16.dataset
+        # pad outside the timed loop, as cagra.search does — the sweep
+        # must time the kernel, not a per-call graph pad
+        pg = pad_graph(ci.graph)
+        deg = ci.graph.shape[1]
         for bq in (4, 8, 16):
             dt = wall(lambda bq=bq: beam_search(
-                jnp.asarray(q), x16, ci.graph, seeds, 10, 64, 4, 40,
-                ci.metric, block_q=bq), iters=10)
+                jnp.asarray(q), x16, pg, seeds, 10, 64, 4, 40,
+                ci.metric, block_q=bq, deg=deg), iters=10)
             emit(f"beam_blockq{bq}", ms=round(dt * 1e3, 2),
                  qps=round(100 / dt, 1))
     except Exception as e:  # noqa: BLE001

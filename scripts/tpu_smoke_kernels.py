@@ -1,8 +1,10 @@
 #!/usr/bin/env python
 """Compiled-Mosaic smoke for every Pallas kernel — VERDICT r2 weak #4:
 CI exercises the kernels in interpret mode only; this script runs each
-one COMPILED on the real chip at small shapes and asserts parity with
-an XLA reference. Commit its JSON output as the hardware evidence.
+one COMPILED on the real chip at small shapes and asserts parity —
+exact kNN against a host float64 reference (an on-device XLA reference
+would itself run at MXU default precision), beam search against the
+XLA engine. Commit its JSON output as the hardware evidence.
 
 Run: PYTHONPATH=/root/repo:/root/.axon_site python scripts/tpu_smoke_kernels.py
 """
@@ -35,11 +37,14 @@ def main():
     q = rng.standard_normal((16, 128)).astype(np.float32)
     xd, qd = jnp.asarray(x), jnp.asarray(q)
 
-    # XLA reference for exact kNN
-    d_full = (jnp.sum(qd**2, 1)[:, None] + jnp.sum(xd**2, 1)[None, :]
-              - 2.0 * qd @ xd.T)
-    ref_d, ref_i = jax.lax.top_k(-d_full, 10)
-    ref_d, ref_i = np.asarray(-ref_d), np.asarray(ref_i)
+    # Host float64 reference for exact kNN (an on-device XLA reference
+    # would itself run the matmul at MXU default precision and lose
+    # the tie-breaks the f32-HIGHEST kernel gets right).
+    x64, q64 = x.astype(np.float64), q.astype(np.float64)
+    d_full64 = (np.sum(q64**2, 1)[:, None] + np.sum(x64**2, 1)[None, :]
+                - 2.0 * q64 @ x64.T)
+    ref_i = np.argsort(d_full64, axis=1, kind="stable")[:, :10]
+    ref_d = np.take_along_axis(d_full64, ref_i, axis=1).astype(np.float32)
 
     # ---- fused_knn compiled
     try:

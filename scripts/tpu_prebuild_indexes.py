@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Pre-build the CAGRA indexes the TPU profile needs, ON CPU, and save
+them to disk. Rationale: round-2 AND round-3 relay deaths both struck
+during large multi-compile build phases; prebuilding on CPU means the
+hardware window only pays for (a) search-leg compiles, which are small
+and known-good from the kernel smoke, and (b) the one optional
+cluster_join-on-TPU timing leg, run last.
+
+The dataset is regenerated deterministically (default_rng(0)) so the
+profile script's queries/ground-truth match the saved index.
+
+Run: python scripts/tpu_prebuild_indexes.py   (CPU-only; safe anytime)
+"""
+
+import os
+import time
+
+import numpy as np
+
+os.environ.setdefault("RAFT_TPU_VMEM_MB", "64")
+
+import jax
+
+# the axon plugin forces jax_platforms via jax.config at import; override
+# back to CPU before any backend initializes (same trick as tests/conftest)
+jax.config.update("jax_platforms", "cpu")
+
+CACHE = os.path.join(os.path.dirname(__file__), "..", "results", "cache")
+
+
+def main():
+    os.makedirs(CACHE, exist_ok=True)
+    assert jax.devices()[0].platform == "cpu"
+    from raft_tpu.neighbors import cagra
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((200_000, 128)).astype(np.float32)
+
+    for n, tag in ((200_000, "200k"), (100_000, "100k")):
+        path = os.path.join(CACHE, f"cagra_cluster_join_{tag}.bin")
+        if os.path.exists(path):
+            print(f"{tag}: cached at {path}", flush=True)
+            continue
+        t0 = time.perf_counter()
+        ci = cagra.build(None, cagra.CagraIndexParams(
+            graph_degree=32, intermediate_graph_degree=64,
+            build_algo=cagra.BuildAlgo.CLUSTER_JOIN), x[:n])
+        np.asarray(ci.graph[:1])
+        dt = time.perf_counter() - t0
+        cagra.save(ci, path, include_dataset=False)
+        print(f"{tag}: built in {dt:.0f}s (CPU) -> {path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+#!/bin/bash
+# Staged round-3 profile: one process per piece, relay-checked between
+# pieces so a relay death loses at most the in-flight piece.
+# Usage: bash scripts/tpu_profile6.sh [out.jsonl] [pieces...]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-results/tpu_profile6_r3.jsonl}
+shift || true
+PIECES=("$@")
+[ ${#PIECES[@]} -eq 0 ] && PIECES=(fknn cagra ivf bq cjoin)
+
+relay_up() {
+  for p in 8082 8083 8093; do
+    (echo > /dev/tcp/127.0.0.1/$p) 2>/dev/null || return 1
+  done
+  return 0
+}
+
+for piece in "${PIECES[@]}"; do
+  if ! relay_up; then
+    echo "relay DOWN before piece $piece — stopping" >&2
+    exit 2
+  fi
+  echo "=== piece $piece ===" >&2
+  PYTHONPATH=/root/repo:/root/.axon_site RAFT_TPU_VMEM_MB=64 \
+    python scripts/tpu_profile6.py --piece "$piece" --out "$OUT" \
+    2>> "${OUT%.jsonl}.err"
+  echo "=== piece $piece rc=$? ===" >&2
+done

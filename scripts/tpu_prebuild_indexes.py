@@ -13,6 +13,7 @@ Run: python scripts/tpu_prebuild_indexes.py   (CPU-only; safe anytime)
 """
 
 import os
+import sys
 import time
 
 import numpy as np
@@ -33,10 +34,15 @@ def main():
     assert jax.devices()[0].platform == "cpu"
     from raft_tpu.neighbors import cagra
 
+    profile_n = int(os.environ.get("RAFT_TPU_PROFILE_N", 200_000))
     rng = np.random.default_rng(0)
-    x = rng.standard_normal((200_000, 128)).astype(np.float32)
+    x = rng.standard_normal((profile_n, 128)).astype(np.float32)
 
-    for n, tag in ((200_000, "200k"), (100_000, "100k")):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tpu_profile6 import size_tag
+
+    for n in (profile_n, profile_n // 2):
+        tag = size_tag(n)
         path = os.path.join(CACHE, f"cagra_cluster_join_{tag}.bin")
         if os.path.exists(path):
             print(f"{tag}: cached at {path}", flush=True)

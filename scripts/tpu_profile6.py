@@ -55,7 +55,20 @@ def wall(fn, iters=10):
     return (time.perf_counter() - t0) / iters
 
 
-def make_data(n=200_000, nq=100):
+# RAFT_TPU_PROFILE_N scales every piece down for a CPU rehearsal of the
+# exact code paths (the hardware window must not be spent on API typos);
+# the index-cache tag tracks it so rehearsal and real runs never mix.
+PROFILE_N = int(os.environ.get("RAFT_TPU_PROFILE_N", 200_000))
+
+
+def size_tag(n):
+    """Cache-file tag — exact row count so no two sizes ever share a
+    file (shared with tpu_prebuild_indexes; keep a single copy)."""
+    return str(n)
+
+
+def make_data(n=None, nq=100):
+    n = PROFILE_N if n is None else n
     rng = np.random.default_rng(0)
     x = rng.standard_normal((n, 128)).astype(np.float32)
     q = rng.standard_normal((nq, 128)).astype(np.float32)
@@ -75,16 +88,19 @@ def piece_fknn():
     from raft_tpu.distance.types import DistanceType
     from raft_tpu.ops.fused_topk import fused_knn
 
-    big = jax.random.normal(jax.random.key(0), (1 << 20, 128), jnp.float32)
+    n_big = (1 << 20) if PROFILE_N >= 200_000 else (1 << 16)
+    big = jax.random.normal(jax.random.key(0), (n_big, 128), jnp.float32)
     bigb = big.astype(jnp.bfloat16)
     qs = jax.random.normal(jax.random.key(2), (10, 128), jnp.float32)
     norms = jnp.sum(jnp.square(big), axis=1)
+    payload_f32 = n_big * 128 * 4
 
     # wider passes spread (2 vs 16) + iters=10: the r3 partial run's
     # 2-vs-8 spread at iters=5 was inside the relay's dispatch jitter
     # (two legs came out negative); 14 extra passes of >=0.6 ms each
     # puts the signal an order of magnitude above it
-    for tag, ds, payload in (("f32", big, 512e6), ("bf16", bigb, 256e6)):
+    for tag, ds, payload in (("f32", big, payload_f32),
+                             ("bf16", bigb, payload_f32 / 2)):
         for tile in (0, 16384):
             try:
                 t2 = wall(lambda: fused_knn(qs, ds, 10,
@@ -119,7 +135,8 @@ def piece_cagra():
 
     rng, x, q = make_data()
     gt = ground_truth(x, q)
-    path = load_index("200k")
+    tag_n = size_tag(PROFILE_N)
+    path = load_index(tag_n)
     if path is None:
         emit("cagra", error="no prebuilt index; run tpu_prebuild_indexes")
         return
@@ -160,21 +177,22 @@ def piece_cagra():
     except Exception as e:  # noqa: BLE001
         emit("beam_blockq", error=str(e)[:200])
 
-    # 100k f32 slice fits VMEM — the f32 kernel datapoint
-    path100 = load_index("100k")
-    if path100 is not None:
+    # half-size f32 slice fits VMEM — the f32 kernel datapoint
+    tag_h = size_tag(PROFILE_N // 2)
+    path_h = load_index(tag_h)
+    if path_h is not None:
         try:
-            ci100 = cagra.load(None, path100,
-                               dataset=jnp.asarray(x[:100_000]))
+            ci_h = cagra.load(None, path_h,
+                              dataset=jnp.asarray(x[:PROFILE_N // 2]))
             for algo in ("xla", "pallas"):
                 sp = cagra.CagraSearchParams(itopk_size=64, search_width=4,
                                              algo=algo)
-                dt = wall(lambda sp=sp: cagra.search(None, sp, ci100, q, 10),
+                dt = wall(lambda sp=sp: cagra.search(None, sp, ci_h, q, 10),
                           iters=10)
-                emit(f"cagra_search_100k_f32_{algo}", ms=round(dt * 1e3, 2),
-                     qps=round(100 / dt, 1))
+                emit(f"cagra_search_{tag_h}_f32_{algo}",
+                     ms=round(dt * 1e3, 2), qps=round(100 / dt, 1))
         except Exception as e:  # noqa: BLE001
-            emit("cagra_search_100k_f32", error=str(e)[:200])
+            emit(f"cagra_search_{tag_h}_f32", error=str(e)[:200])
 
     # seed_pool variant (query-aware seeding)
     sp = cagra.CagraSearchParams(itopk_size=64, search_width=4,
@@ -259,7 +277,7 @@ def piece_cjoin():
         graph_degree=32, intermediate_graph_degree=64,
         build_algo=cagra.BuildAlgo.CLUSTER_JOIN), x)
     np.asarray(ci.graph[:1])
-    emit("cagra_build_cluster_join_200k",
+    emit(f"cagra_build_cluster_join_{size_tag(PROFILE_N)}",
          s=round(time.perf_counter() - t0, 1))
 
 
@@ -274,7 +292,7 @@ def main():
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     OUT = args.out
-    emit("config", piece=args.piece, backend=jax.default_backend(),
+    emit(f"config_{args.piece}", backend=jax.default_backend(),
          device=jax.devices()[0].device_kind,
          vmem_mb=os.environ.get("RAFT_TPU_VMEM_MB"))
     PIECES[args.piece]()

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core import tracing
+from raft_tpu.core.logger import warn as _log_warn
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.serialize import (
     check_version,
@@ -357,7 +358,15 @@ def build(
             params, storage_dtype=jnp.dtype(params.storage_dtype))
     n = dataset.shape[0]
     ideg = min(params.intermediate_graph_degree, n - 1)
+    if ideg < params.intermediate_graph_degree:
+        _log_warn(
+            "Intermediate graph degree cannot be larger than dataset "
+            "size, reducing it to %d", ideg)
     odeg = min(params.graph_degree, ideg)
+    if odeg < params.graph_degree:
+        _log_warn(
+            "Graph degree (%d) cannot be larger than intermediate graph "
+            "degree (%d), reducing graph_degree", params.graph_degree, ideg)
 
     with tracing.range("raft_tpu.cagra.build"):
         if params.build_algo == BuildAlgo.CLUSTER_JOIN:

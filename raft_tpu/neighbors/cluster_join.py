@@ -40,6 +40,7 @@ import jax.numpy as jnp
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
 from raft_tpu.core import tracing
+from raft_tpu.core.logger import info as _log_info
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType
@@ -165,8 +166,12 @@ def build(
                 n_train = min(n, max(C * 32, int(n * frac)))
                 stride = max(1, n // n_train)
                 offset = (p * 17) % stride if stride > 1 else 0
+                _log_info("cluster_join pass %d/%d: kmeans fit "
+                          "(C=%d, n_train=%d)", p + 1, params.passes,
+                          C, n_train)
                 centers = kmeans_balanced.fit(
                     res, km, ds32[offset::stride][:n_train], C)
+                _log_info("cluster_join pass %d: predict", p + 1)
                 labels = kmeans_balanced.predict(res, km, centers, ds32)
                 sizes = jax.ops.segment_sum(
                     jnp.ones((n,), jnp.int32), labels, num_segments=C)
@@ -179,6 +184,8 @@ def build(
                 bucket = max(8, params.target_cluster_size // 2)
                 max_size = max(8, -(-max_size // bucket) * bucket)
                 idx = _pack_cluster_indices(labels, C, max_size)
+            _log_info("cluster_join pass %d: within-cluster kNN "
+                      "(C=%d, m=%d)", p + 1, idx.shape[0], idx.shape[1])
             pass_ids, pass_d = _one_pass(ds32, idx, k, metric)
             if p == 0:
                 best_ids, best_d = pass_ids, pass_d
@@ -190,6 +197,8 @@ def build(
                 break  # one pass IS exact brute force
 
         if params.polish_rounds > 0 and C > 1:
+            _log_info("cluster_join: NN-descent polish (%d rounds)",
+                        params.polish_rounds)
             nnd = NNDescentParams(
                 graph_degree=k,
                 intermediate_graph_degree=k,

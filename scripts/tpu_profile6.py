@@ -270,7 +270,11 @@ def piece_bq():
 
 def piece_cjoin():
     from raft_tpu.neighbors import cagra
+    from raft_tpu.core.logger import LogLevel, set_level
 
+    # stage-level stderr logs: if the relay dies mid-build again, the
+    # last line names the stage whose compile killed it
+    set_level(LogLevel.INFO)
     _, x, _ = make_data()
     t0 = time.perf_counter()
     ci = cagra.build(None, cagra.CagraIndexParams(

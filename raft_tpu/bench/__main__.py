@@ -39,6 +39,9 @@ def main(argv=None):
     p.add_argument("-k", type=int, default=10)
     p.add_argument("--batch-size", type=int, default=0)
     p.add_argument("--search-iters", type=int, default=3)
+    p.add_argument("--force-rebuild", action="store_true",
+                   help="rebuild indexes even if a cached index file "
+                        "exists under <out-dir>/indexes/")
 
     p = sub.add_parser("data-export", help="results JSONL -> CSV")
     p.add_argument("--results", required=True)
@@ -79,6 +82,7 @@ def main(argv=None):
         rows = run_benchmark(
             args.dataset, config, args.out_dir, k=args.k,
             batch_size=args.batch_size, search_iters=args.search_iters,
+            force_rebuild=args.force_rebuild,
         )
         for r in rows:
             print(json.dumps(r))

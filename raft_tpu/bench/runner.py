@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import pathlib
+import re
 import time
 from typing import Any, Callable, Dict, List
 
@@ -26,11 +27,16 @@ from raft_tpu.utils.recall import eval_recall
 @dataclasses.dataclass
 class AlgoWrapper:
     """The ``ANN<T>`` interface (``ann_types.hpp:79-93``): build once,
-    search per search-param set."""
+    search per search-param set. ``save``/``load`` (optional) enable the
+    reference harness's build/search separation with on-disk index files
+    (``benchmark.hpp`` build phase saves, search phase loads) — a rerun
+    on the same dataset+build-params reloads instead of rebuilding."""
 
     name: str
     build: Callable[..., Any]                 # (base, metric, **params) -> index
     search: Callable[..., Any]                # (index, queries, k, **params) -> (d, i)
+    save: Callable[..., None] = None          # (index, path)
+    load: Callable[..., Any] = None           # (path, base, metric, **params) -> index
 
 
 def _brute_force_build(base, metric, **params):
@@ -156,17 +162,73 @@ def _quantized_search(index, queries, k, **params):
     return quantized.search(None, index, queries, k)
 
 
+def _ivf_flat_save(index, path):
+    from raft_tpu.neighbors import ivf_flat
+
+    ivf_flat.save(index, path)
+
+
+def _ivf_flat_load(path, base, metric, **params):
+    from raft_tpu.neighbors import ivf_flat
+
+    return ivf_flat.load(None, path)
+
+
+def _bundle_save(mod_name):
+    def save_fn(bundle, path):
+        import importlib
+
+        importlib.import_module(mod_name).save(bundle["index"], path)
+    return save_fn
+
+
+def _bundle_load(mod_name):
+    def load_fn(path, base, metric, **params):
+        import importlib
+
+        index = importlib.import_module(mod_name).load(None, path)
+        return {"index": index, "base": base, "metric": metric}
+    return load_fn
+
+
+def _cagra_save(bundle, path):
+    from raft_tpu.neighbors import cagra
+
+    cagra.save(bundle["index"], path, include_dataset=True)
+
+
 ALGO_REGISTRY: Dict[str, AlgoWrapper] = {
     "raft_brute_force": AlgoWrapper("raft_brute_force",
                                     _brute_force_build, _brute_force_search),
     "raft_ivf_flat": AlgoWrapper("raft_ivf_flat",
-                                 _ivf_flat_build, _ivf_flat_search),
-    "raft_ivf_pq": AlgoWrapper("raft_ivf_pq", _ivf_pq_build, _ivf_pq_search),
-    "raft_ivf_bq": AlgoWrapper("raft_ivf_bq", _ivf_bq_build, _ivf_bq_search),
-    "raft_cagra": AlgoWrapper("raft_cagra", _cagra_build, _cagra_search),
+                                 _ivf_flat_build, _ivf_flat_search,
+                                 _ivf_flat_save, _ivf_flat_load),
+    "raft_ivf_pq": AlgoWrapper("raft_ivf_pq", _ivf_pq_build, _ivf_pq_search,
+                               _bundle_save("raft_tpu.neighbors.ivf_pq"),
+                               _bundle_load("raft_tpu.neighbors.ivf_pq")),
+    "raft_ivf_bq": AlgoWrapper("raft_ivf_bq", _ivf_bq_build, _ivf_bq_search,
+                               _bundle_save("raft_tpu.neighbors.ivf_bq"),
+                               _bundle_load("raft_tpu.neighbors.ivf_bq")),
+    "raft_cagra": AlgoWrapper("raft_cagra", _cagra_build, _cagra_search,
+                              _cagra_save,
+                              _bundle_load("raft_tpu.neighbors.cagra")),
     "raft_quantized": AlgoWrapper("raft_quantized",
                                   _quantized_build, _quantized_search),
 }
+
+
+def _index_cache_key(algo: str, dataset_name: str, n: int, dim: int,
+                     metric_name: str,
+                     build_params: Dict[str, Any]) -> str:
+    """Deterministic readable filename for a (dataset, algo, build
+    params) combination — the role of the reference's per-index
+    ``index.file`` naming in its conf files. ``dataset_name`` is in the
+    key so same-shaped datasets can't reuse each other's indexes."""
+    parts = [algo, dataset_name, f"{n}x{dim}", metric_name]
+    for key in sorted(build_params):
+        parts.append(f"{key}={build_params[key]}")
+    raw = "-".join(parts)
+    return re.sub(r"[^A-Za-z0-9_.=-]", "_", raw)
 
 
 def _block(x):
@@ -245,6 +307,7 @@ def run_benchmark(
     batch_size: int = 0,
     max_base_rows: int = 0,
     search_iters: int = 3,
+    force_rebuild: bool = False,
 ) -> List[Dict[str, Any]]:
     """Run every (algo, build-params, search-params) combination in
     ``config`` against the dataset tree; write JSON-lines results.
@@ -282,9 +345,35 @@ def run_benchmark(
         for algo_cfg in config["algos"]:
             algo = ALGO_REGISTRY[algo_cfg["name"]]
             build_params = algo_cfg.get("build", {})
+            cache = None
+            if algo.save is not None and algo.load is not None:
+                key = _index_cache_key(
+                    algo.name, dataset_dir.name, base.shape[0],
+                    base.shape[1], metric_name, build_params)
+                cache = out_dir / "indexes" / f"{key}.bin"
+            index = None
+            build_cached = False
             t0 = time.perf_counter()
-            index = _block(algo.build(base, metric, **build_params))
+            if (cache is not None and cache.exists()
+                    and not force_rebuild):
+                try:
+                    index = _block(algo.load(str(cache), base, metric,
+                                             **build_params))
+                    build_cached = True
+                except Exception:  # noqa: BLE001 — truncated file from
+                    # a crash mid-save: fall through to a fresh build
+                    index = None
+            if index is None:
+                index = _block(algo.build(base, metric, **build_params))
             build_s = time.perf_counter() - t0
+            if cache is not None and not build_cached:
+                # atomic save AFTER timing: the write (which for cagra
+                # includes the dataset copy) must inflate neither
+                # build_seconds nor, on a crash, the next run
+                cache.parent.mkdir(parents=True, exist_ok=True)
+                tmp = cache.with_suffix(".tmp")
+                algo.save(index, str(tmp))
+                tmp.replace(cache)
 
             for search_params in algo_cfg.get("search", [{}]):
                 # warm (compile) every batch shape, including a ragged
@@ -326,6 +415,7 @@ def run_benchmark(
                     "k": k,
                     "batch_size": batch_size,
                     "build_seconds": round(build_s, 4),
+                    "build_cached": build_cached,
                     "qps": round(qps, 2),
                     "recall": None if np.isnan(rec) else round(float(rec), 4),
                 }
@@ -354,13 +444,15 @@ def export_csv(results_dir, out_path=None) -> pathlib.Path:
     if not rows:
         raise FileNotFoundError(f"no results under {results_dir}")
     cols = ["dataset", "algo", "build_params", "search_params", "k",
-            "batch_size", "build_seconds", "qps", "recall"]
+            "batch_size", "build_seconds", "build_cached", "qps",
+            "recall"]
     with open(out_path, "w", newline="") as fh:
         w = csv.DictWriter(fh, fieldnames=cols)
         w.writeheader()
         for r in rows:
-            w.writerow({c: json.dumps(r[c]) if isinstance(r[c], dict)
-                        else r[c] for c in cols})
+            # .get: rows from pre-cache runs lack build_cached
+            w.writerow({c: json.dumps(r.get(c)) if isinstance(r.get(c), dict)
+                        else r.get(c) for c in cols})
     return out_path
 
 

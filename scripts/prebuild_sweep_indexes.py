@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Pre-build the expensive (CAGRA) sweep indexes ON CPU into the sweep
+run's index cache, using the runner's own cache-key function so the TPU
+sweep reloads them instead of re-running the build leg that killed the
+relay. Safe to run while the relay is down.
+
+Usage: python scripts/prebuild_sweep_indexes.py \
+    [--config blobs-1M-128] [--dataset datasets/blobs-1000000-128] \
+    [--out-dir results/sweep-1M] [--algos raft_cagra]
+"""
+
+import argparse
+import importlib.resources
+import json
+import pathlib
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # same trick as the conftest
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="blobs-1M-128")
+    ap.add_argument("--dataset", default="datasets/blobs-1000000-128")
+    ap.add_argument("--out-dir", default="results/sweep-1M")
+    ap.add_argument("--algos", default="raft_cagra",
+                    help="comma-separated algo names to prebuild")
+    args = ap.parse_args()
+
+    assert jax.devices()[0].platform == "cpu"
+    from raft_tpu.bench.datasets import METRICS
+    from raft_tpu.bench.runner import (
+        ALGO_REGISTRY,
+        _index_cache_key,
+        normalize_config,
+    )
+    from raft_tpu.io import read_bin
+
+    cfg_path = pathlib.Path(args.config)
+    if not cfg_path.exists():
+        cfg_path = (importlib.resources.files("raft_tpu.bench") / "conf"
+                    / f"{args.config}.json")
+    config = normalize_config(json.loads(cfg_path.read_text()))
+
+    dataset_dir = pathlib.Path(args.dataset)
+    base = read_bin(dataset_dir / "base.fbin")
+    metric_name = (dataset_dir / "metric.txt").read_text().strip() \
+        if (dataset_dir / "metric.txt").exists() else "euclidean"
+    metric = METRICS[metric_name]
+
+    wanted = set(args.algos.split(","))
+    index_dir = pathlib.Path(args.out_dir) / "indexes"
+    for algo_cfg in config["algos"]:
+        if algo_cfg["name"] not in wanted:
+            continue
+        algo = ALGO_REGISTRY[algo_cfg["name"]]
+        if algo.save is None:
+            print(f"{algo_cfg['name']}: no save support, skipping")
+            continue
+        build_params = algo_cfg.get("build", {})
+        key = _index_cache_key(algo.name, dataset_dir.name, base.shape[0],
+                               base.shape[1], metric_name, build_params)
+        path = index_dir / f"{key}.bin"
+        if path.exists():
+            print(f"cached: {path}", flush=True)
+            continue
+        t0 = time.perf_counter()
+        index = algo.build(base, metric, **build_params)
+        jax.block_until_ready(jax.tree_util.tree_leaves(index)[0])
+        dt = time.perf_counter() - t0
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        algo.save(index, str(tmp))
+        tmp.replace(path)
+        print(f"built {key} in {dt:.0f}s (CPU) -> {path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

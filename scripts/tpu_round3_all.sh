@@ -1,0 +1,68 @@
+#!/bin/bash
+# Master round-3 hardware plan: run EVERYTHING in value order with a
+# relay port check between steps, so however short the relay window is,
+# the highest-value evidence lands first. Each step is its own process
+# (never two TPU processes at once); a relay death stops the chain
+# cleanly instead of wedging.
+#
+# Usage: bash scripts/tpu_round3_all.sh   (logs under results/)
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH=/root/repo:/root/.axon_site
+export RAFT_TPU_VMEM_MB=64
+TS=$(date +%H%M%S)
+LOG=results/round3_all_$TS.log
+echo "round3_all start $(date)" | tee -a "$LOG"
+
+relay_up() {
+  for p in 8082 8083 8093; do
+    (echo > /dev/tcp/127.0.0.1/$p) 2>/dev/null || return 1
+  done
+  return 0
+}
+
+step() {  # step <name> <cmd...>
+  local name=$1; shift
+  if ! relay_up; then
+    echo "RELAY DOWN before step $name — stopping $(date)" | tee -a "$LOG"
+    exit 2
+  fi
+  echo "=== step $name start $(date) ===" | tee -a "$LOG"
+  "$@" >> "$LOG" 2>&1
+  echo "=== step $name rc=$? end $(date) ===" | tee -a "$LOG"
+}
+
+# 1. kernel smoke (fast; proves the window is healthy)
+step smoke python scripts/tpu_smoke_kernels.py
+
+# 2. the headline bench (driver-format JSON line -> committed evidence)
+step bench env BENCH_SECONDS=45 python bench.py
+
+# 3. flagship A/B: CAGRA engines on the prebuilt index + fknn slopes
+step profile_fknn  python scripts/tpu_profile6.py --piece fknn  --out results/tpu_profile6_r3.jsonl
+step profile_cagra python scripts/tpu_profile6.py --piece cagra --out results/tpu_profile6_r3.jsonl
+
+# 4. recall-vs-QPS pareto sweep on blobs-1M (the reference's headline
+#    artifact form)
+step sweep python -m raft_tpu.bench run \
+  --dataset datasets/blobs-1000000-128 --config blobs-1M-128 \
+  --out-dir results/sweep-1M
+step sweep_export python -m raft_tpu.bench data-export \
+  --results results/sweep-1M --out results/sweep-1M/export.csv
+step sweep_plot python -m raft_tpu.bench plot \
+  --results results/sweep-1M --out results/sweep-1M/pareto.png
+
+# 5. IVF continuity + LUT ladder + BQ
+step profile_ivf python scripts/tpu_profile6.py --piece ivf --out results/tpu_profile6_r3.jsonl
+step profile_bq  python scripts/tpu_profile6.py --piece bq  --out results/tpu_profile6_r3.jsonl
+
+# 6. per-primitive table
+step prims python -m raft_tpu.bench.prims --size full --out results/prims_full_r3.jsonl
+
+# 7. 100M streaming scale build (long)
+step scale python scripts/tpu_scale_build.py
+
+# 8. cluster_join build timing — the leg that killed the relay; LAST
+step profile_cjoin python scripts/tpu_profile6.py --piece cjoin --out results/tpu_profile6_r3.jsonl
+
+echo "round3_all COMPLETE $(date)" | tee -a "$LOG"

@@ -73,6 +73,38 @@ class TestRunner:
         png = plot_results(tmp_path / "res")
         assert png.exists() and png.stat().st_size > 1000
 
+    def test_index_cache_round_trip(self, dataset_dir, tmp_path):
+        """Second run on the same out_dir reloads the saved index
+        (reference benchmark.hpp build/search phase separation) with
+        identical search quality; --force-rebuild rebuilds."""
+        config = {
+            "algos": [
+                {"name": "raft_ivf_flat", "build": {"n_lists": 32},
+                 "search": [{"n_probes": 32}]},
+                {"name": "raft_cagra",
+                 "build": {"graph_degree": 16,
+                           "intermediate_graph_degree": 24,
+                           "build_algo": "cluster_join"},
+                 "search": [{"itopk_size": 32}]},
+            ]
+        }
+        out = tmp_path / "res"
+        first = run_benchmark(dataset_dir, config, out, k=10,
+                              search_iters=1)
+        assert all(not r["build_cached"] for r in first)
+        idx_files = sorted((out / "indexes").glob("*.bin"))
+        assert len(idx_files) == 2, idx_files
+
+        second = run_benchmark(dataset_dir, config, out, k=10,
+                               search_iters=1)
+        assert all(r["build_cached"] for r in second)
+        for a, b in zip(first, second):
+            assert a["recall"] == b["recall"], (a, b)
+
+        third = run_benchmark(dataset_dir, config, out, k=10,
+                              search_iters=1, force_rebuild=True)
+        assert all(not r["build_cached"] for r in third)
+
     def test_cli(self, dataset_dir, tmp_path):
         from raft_tpu.bench.__main__ import main
 

@@ -20,6 +20,7 @@ from typing import Any, Callable, Dict, List
 import numpy as np
 
 from raft_tpu.bench.datasets import METRICS
+from raft_tpu.core.logger import warn as _log_warn
 from raft_tpu.io import read_bin
 from raft_tpu.utils.recall import eval_recall
 
@@ -217,6 +218,18 @@ ALGO_REGISTRY: Dict[str, AlgoWrapper] = {
 }
 
 
+def save_index_atomic(algo: AlgoWrapper, index: Any,
+                      cache: pathlib.Path) -> None:
+    """Write an index cache file atomically (tmp + rename) so a crash
+    mid-save can never leave a half-written file at the cache path.
+    Shared by the runner and the CPU prebuild script — the two must
+    keep one write protocol."""
+    cache.parent.mkdir(parents=True, exist_ok=True)
+    tmp = cache.with_suffix(".tmp")
+    algo.save(index, str(tmp))
+    tmp.replace(cache)
+
+
 def _index_cache_key(algo: str, dataset_name: str, n: int, dim: int,
                      metric_name: str,
                      build_params: Dict[str, Any]) -> str:
@@ -360,20 +373,25 @@ def run_benchmark(
                     index = _block(algo.load(str(cache), base, metric,
                                              **build_params))
                     build_cached = True
-                except Exception:  # noqa: BLE001 — truncated file from
-                    # a crash mid-save: fall through to a fresh build
+                except Exception as e:  # noqa: BLE001 — truncated file
+                    # from a crash mid-save: fall through to a fresh
+                    # build, but say so (a silent fall-through would
+                    # hide a never-hitting cache)
+                    _log_warn("index cache load failed (%s: %s) — "
+                              "rebuilding", cache.name, e)
                     index = None
             if index is None:
                 index = _block(algo.build(base, metric, **build_params))
             build_s = time.perf_counter() - t0
             if cache is not None and not build_cached:
-                # atomic save AFTER timing: the write (which for cagra
-                # includes the dataset copy) must inflate neither
-                # build_seconds nor, on a crash, the next run
-                cache.parent.mkdir(parents=True, exist_ok=True)
-                tmp = cache.with_suffix(".tmp")
-                algo.save(index, str(tmp))
-                tmp.replace(cache)
+                # save AFTER timing: the write (which for cagra includes
+                # the dataset copy) must not inflate build_seconds, and
+                # a save failure must not discard the finished build
+                try:
+                    save_index_atomic(algo, index, cache)
+                except Exception as e:  # noqa: BLE001
+                    _log_warn("index cache save failed (%s: %s) — "
+                              "continuing without cache", cache.name, e)
 
             for search_params in algo_cfg.get("search", [{}]):
                 # warm (compile) every batch shape, including a ragged

@@ -35,6 +35,7 @@ def main():
         ALGO_REGISTRY,
         _index_cache_key,
         normalize_config,
+        save_index_atomic,
     )
     from raft_tpu.io import read_bin
 
@@ -68,12 +69,9 @@ def main():
             continue
         t0 = time.perf_counter()
         index = algo.build(base, metric, **build_params)
-        jax.block_until_ready(jax.tree_util.tree_leaves(index)[0])
+        jax.block_until_ready(index)  # the whole tree, not leaves[0]
         dt = time.perf_counter() - t0
-        path.parent.mkdir(parents=True, exist_ok=True)
-        tmp = path.with_suffix(".tmp")
-        algo.save(index, str(tmp))
-        tmp.replace(path)
+        save_index_atomic(algo, index, path)
         print(f"built {key} in {dt:.0f}s (CPU) -> {path}", flush=True)
 
 

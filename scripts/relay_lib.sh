@@ -1,0 +1,13 @@
+# Shared relay helpers for the TPU scripts. Source, don't execute:
+#   . "$(dirname "$0")/relay_lib.sh"
+# One definition of the relay port set — tpu_profile6.sh and
+# tpu_round3_all.sh must agree on what "relay up" means.
+RELAY_PORTS=(8082 8083 8093)
+
+relay_up() {
+  local p
+  for p in "${RELAY_PORTS[@]}"; do
+    (echo > "/dev/tcp/127.0.0.1/$p") 2>/dev/null || return 1
+  done
+  return 0
+}

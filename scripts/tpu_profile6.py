@@ -276,12 +276,28 @@ def piece_cjoin():
     # last line names the stage whose compile killed it
     set_level(LogLevel.INFO)
     _, x, _ = make_data()
+    tag = size_tag(PROFILE_N)
+
+    # stage 1 — cluster passes only (no NN-descent polish): fewer and
+    # smaller XLA programs; its number lands in the file even if the
+    # polish leg below takes the relay down
+    from raft_tpu.neighbors import cluster_join
+
+    t0 = time.perf_counter()
+    ids = cluster_join.build(None, cluster_join.ClusterJoinParams(
+        graph_degree=64, polish_rounds=0), x)
+    np.asarray(ids[:1])
+    emit(f"cluster_join_nopolish_{tag}",
+         s=round(time.perf_counter() - t0, 1))
+
+    # stage 2 — the full default build (polish + optimize), the leg in
+    # flight when the r3 relay died
     t0 = time.perf_counter()
     ci = cagra.build(None, cagra.CagraIndexParams(
         graph_degree=32, intermediate_graph_degree=64,
         build_algo=cagra.BuildAlgo.CLUSTER_JOIN), x)
     np.asarray(ci.graph[:1])
-    emit(f"cagra_build_cluster_join_{size_tag(PROFILE_N)}",
+    emit(f"cagra_build_cluster_join_{tag}",
          s=round(time.perf_counter() - t0, 1))
 
 

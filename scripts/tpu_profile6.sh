@@ -3,18 +3,14 @@
 # pieces so a relay death loses at most the in-flight piece.
 # Usage: bash scripts/tpu_profile6.sh [out.jsonl] [pieces...]
 set -u
-cd "$(dirname "$0")/.."
+SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
+cd "$SCRIPT_DIR/.."
 OUT=${1:-results/tpu_profile6_r3.jsonl}
 shift || true
 PIECES=("$@")
 [ ${#PIECES[@]} -eq 0 ] && PIECES=(fknn cagra ivf bq cjoin)
 
-relay_up() {
-  for p in 8082 8083 8093; do
-    (echo > /dev/tcp/127.0.0.1/$p) 2>/dev/null || return 1
-  done
-  return 0
-}
+. "$SCRIPT_DIR/relay_lib.sh"
 
 for piece in "${PIECES[@]}"; do
   if ! relay_up; then

@@ -7,19 +7,15 @@
 #
 # Usage: bash scripts/tpu_round3_all.sh   (logs under results/)
 set -u
-cd "$(dirname "$0")/.."
+SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
+cd "$SCRIPT_DIR/.."
 export PYTHONPATH=/root/repo:/root/.axon_site
 export RAFT_TPU_VMEM_MB=64
 TS=$(date +%H%M%S)
 LOG=results/round3_all_$TS.log
 echo "round3_all start $(date)" | tee -a "$LOG"
 
-relay_up() {
-  for p in 8082 8083 8093; do
-    (echo > /dev/tcp/127.0.0.1/$p) 2>/dev/null || return 1
-  done
-  return 0
-}
+. "$SCRIPT_DIR/relay_lib.sh"
 
 step() {  # step <name> <cmd...>
   local name=$1; shift
@@ -43,10 +39,17 @@ step profile_fknn  python scripts/tpu_profile6.py --piece fknn  --out results/tp
 step profile_cagra python scripts/tpu_profile6.py --piece cagra --out results/tpu_profile6_r3.jsonl
 
 # 4. recall-vs-QPS pareto sweep on blobs-1M (the reference's headline
-#    artifact form)
-step sweep python -m raft_tpu.bench run \
-  --dataset datasets/blobs-1000000-128 --config blobs-1M-128 \
-  --out-dir results/sweep-1M
+#    artifact form). GUARD: without the CPU-prebuilt CAGRA indexes the
+#    sweep would run the 1M cluster_join build ON TPU — the exact
+#    multi-compile leg that killed the relay. Skip rather than risk it.
+if ls results/sweep-1M/indexes/raft_cagra-*.bin >/dev/null 2>&1; then
+  step sweep python -m raft_tpu.bench run \
+    --dataset datasets/blobs-1000000-128 --config blobs-1M-128 \
+    --out-dir results/sweep-1M
+else
+  echo "SKIP sweep: no prebuilt CAGRA indexes under results/sweep-1M/indexes" \
+    "(run scripts/prebuild_sweep_indexes.py first)" | tee -a "$LOG"
+fi
 step sweep_export python -m raft_tpu.bench data-export \
   --results results/sweep-1M --out results/sweep-1M/export.csv
 step sweep_plot python -m raft_tpu.bench plot \

@@ -26,24 +26,35 @@ import jax
 # back to CPU before any backend initializes (same trick as tests/conftest)
 jax.config.update("jax_platforms", "cpu")
 
-CACHE = os.path.join(os.path.dirname(__file__), "..", "results", "cache")
 
 
 def main():
-    os.makedirs(CACHE, exist_ok=True)
     assert jax.devices()[0].platform == "cpu"
     from raft_tpu.neighbors import cagra
 
-    profile_n = int(os.environ.get("RAFT_TPU_PROFILE_N", 200_000))
-    rng = np.random.default_rng(0)
-    x = rng.standard_normal((profile_n, 128)).astype(np.float32)
-
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from tpu_profile6 import size_tag
+    # single source of truth for cache filenames, build params, AND the
+    # dataset itself — the profile pieces evaluate recall against
+    # make_data's vectors, so the indexes must be built from them too
+    from tpu_profile6 import (CACHE_DIR, PROFILE_N, cache_path,
+                              ivf_prebuild_specs, make_data, size_tag)
+
+    profile_n = PROFILE_N
+    _, x, _ = make_data()
+
+    os.makedirs(CACHE_DIR, exist_ok=True)
+
+    def save_atomic(save, path):
+        # a prebuild killed mid-save (the relay-death scenario this
+        # cache defends against) must not leave a truncated file that
+        # later loads inside the TPU window
+        tmp = path + ".tmp"
+        save(tmp)
+        os.replace(tmp, path)
 
     for n in (profile_n, profile_n // 2):
         tag = size_tag(n)
-        path = os.path.join(CACHE, f"cagra_cluster_join_{tag}.bin")
+        path = cache_path(f"cagra_cluster_join_{tag}.bin")
         if os.path.exists(path):
             print(f"{tag}: cached at {path}", flush=True)
             continue
@@ -53,8 +64,21 @@ def main():
             build_algo=cagra.BuildAlgo.CLUSTER_JOIN), x[:n])
         np.asarray(ci.graph[:1])
         dt = time.perf_counter() - t0
-        cagra.save(ci, path, include_dataset=False)
+        save_atomic(lambda p: cagra.save(ci, p, include_dataset=False),
+                    path)
         print(f"{tag}: built in {dt:.0f}s (CPU) -> {path}", flush=True)
+
+    for fname, mod, build in ivf_prebuild_specs().values():
+        path = cache_path(fname)
+        if os.path.exists(path):
+            print(f"cached: {path}", flush=True)
+            continue
+        t0 = time.perf_counter()
+        idx = build(x)
+        jax.block_until_ready(idx)
+        dt = time.perf_counter() - t0
+        save_atomic(lambda p: mod.save(idx, p), path)
+        print(f"built {fname} in {dt:.0f}s (CPU)", flush=True)
 
 
 if __name__ == "__main__":

@@ -29,7 +29,8 @@ every call.
 Progress goes to stderr so a slow run is diagnosable; stdout carries
 exactly one JSON line. Env knobs: BENCH_N / BENCH_DIM / BENCH_BATCH /
 BENCH_K / BENCH_SECONDS (measurement budget, default 45) /
-BENCH_DTYPE (float32|bfloat16 dataset storage) /
+BENCH_DTYPE (float32|bfloat16 dataset storage; default bfloat16 on
+TPU — validated in-run against exact-f32 ids — and float32 on CPU) /
 BENCH_PROBE_PLAN ("timeout:sleep,timeout:sleep,..." probe schedule) /
 BENCH_CHILD_DEADLINE (seconds before the parent abandons a child) /
 RAFT_TPU_DISABLE_FUSED=1 (force the XLA tile-scan path).
@@ -194,8 +195,12 @@ def parent_main():
     healthy = _backend_healthy()
     # default deadline scales with the measurement budget: data-gen +
     # compile margin on top of the worst-case measurement loop
+    # the default-on-TPU bf16 storage adds two index builds + two
+    # full-dataset search compiles of validation work before the first
+    # JSON line, so the compile margin doubles unless f32 is forced
+    margin = 600 if os.environ.get("BENCH_DTYPE") == "float32" else 1200
     deadline = float(os.environ.get(
-        "BENCH_CHILD_DEADLINE", max(1200, 3 * BUDGET_S + 600)))
+        "BENCH_CHILD_DEADLINE", max(1200 + margin, 3 * BUDGET_S + margin)))
     if healthy:
         log("dispatching TPU measurement child")
         rec = _await_child(_spawn_child(cpu=False), deadline)
@@ -243,9 +248,46 @@ def child_main():
     queries = jax.random.normal(kq, (BATCH, D), jnp.float32)
     jax.block_until_ready((dataset, queries))
     log("data generated")
-    storage = (jnp.bfloat16 if os.environ.get("BENCH_DTYPE") == "bfloat16"
-               else None)
-    index = brute_force.build(None, dataset, storage_dtype=storage)
+
+    # Storage dtype: bf16 on TPU (the MXU-native layout — halves the
+    # HBM stream, the config's bottleneck), f32 on the CPU fallback
+    # (emulated bf16 matmuls are slower there) or when BENCH_DTYPE
+    # forces it. bf16 "exactness" is validated below against true-f32
+    # ids and the run falls back to f32 if recall@K slips under 0.99.
+    want = os.environ.get("BENCH_DTYPE")
+    if want not in (None, "float32", "bfloat16"):
+        log(f"unrecognized BENCH_DTYPE={want!r}; using the default")
+        want = None
+    if want is None:
+        want = "float32" if jax.default_backend() == "cpu" else "bfloat16"
+    storage = jnp.float32 if want == "float32" else jnp.bfloat16
+
+    recall = None
+    if storage == jnp.bfloat16:
+        from raft_tpu.utils import eval_recall
+
+        index32 = brute_force.build(None, dataset)
+        d32, ids32 = brute_force.search(None, index32, queries, K,
+                                        db_tile=262144)
+        index = brute_force.build(None, dataset, storage_dtype=storage)
+        d16, ids16 = brute_force.search(None, index, queries, K,
+                                        db_tile=262144)
+        import numpy as np
+        # tie-aware: a different id at an equal distance is not a miss.
+        # eps=1e-2 relative, not the 1e-3 default: the actual distances
+        # carry bf16 rounding (~0.4% relative), so a true tie shows up
+        # at sub-percent, not sub-tenth-percent, agreement
+        recall, _, _ = eval_recall(np.asarray(ids32), np.asarray(ids16),
+                                   np.asarray(d32), np.asarray(d16),
+                                   eps=1e-2)
+        recall = float(recall)
+        log(f"bf16 recall@{K} vs exact f32 ids: {recall:.4f}")
+        if recall < 0.99:
+            log("bf16 recall under 0.99 — falling back to f32 storage")
+            index, recall = index32, None
+        del index32
+    else:
+        index = brute_force.build(None, dataset, storage_dtype=storage)
     jax.block_until_ready(index.norms)
     log(f"index built (storage {index.dataset.dtype}, norms cached)")
 
@@ -268,16 +310,25 @@ def child_main():
     tag = os.environ.get("BENCH_TAG", "")
     tag = f"_{tag}" if tag else ""
     suffix = os.environ.get("BENCH_SUFFIX", "")
-    metric = f"brute_force_knn_qps_sift1m_shape_b{BATCH}_k{K}{tag}{suffix}"
+    sdt = "_bf16" if index.dataset.dtype == jnp.bfloat16 else ""
+    metric = (f"brute_force_knn_qps_sift1m_shape_b{BATCH}_k{K}{sdt}"
+              f"{tag}{suffix}")
 
     def emit(dt):
+        # vs_baseline stays normalized by the f32-config roofline: the
+        # problem solved (same vectors, queries, k, recall~1) is the
+        # reference config; bf16 storage is this framework's internal
+        # layout choice, and its measured recall is reported alongside
         qps = BATCH / dt
-        print(json.dumps({
+        rec = {
             "metric": metric,
             "value": round(qps, 2),
             "unit": "QPS",
             "vs_baseline": round(qps / ROOFLINE_QPS, 4),
-        }), flush=True)
+        }
+        if recall is not None:
+            rec["recall_at_k_vs_f32_exact"] = round(recall, 4)
+        print(json.dumps(rec), flush=True)
 
     stats = timeit_stats(run, BUDGET_S)
     dt = stats["best_s"]
@@ -310,7 +361,7 @@ def child_main():
         # result. The 2 TB/s ceiling leaves room for measured-above-
         # nominal streams (slope noise put bf16 at ~1.3 TB/s) while
         # still rejecting order-of-magnitude-impossible slopes.
-        itemsize = 2 if os.environ.get("BENCH_DTYPE") == "bfloat16" else 4
+        itemsize = index.dataset.dtype.itemsize
         floor_s = (N * D * itemsize) / 2.0e12
         if floor_s <= sl["slope_s"] <= dt * 1.2:
             emit(min(sl["slope_s"], dt))

@@ -13,10 +13,11 @@ run() {
   env "$@" BENCH_N=$N BENCH_SECONDS=$SECS timeout 1800 python bench.py
 }
 
-# 1. default dispatch (fused Pallas kernel on TPU)
-run BENCH_TAG=fused
-# 2. XLA tile-scan path
-run RAFT_TPU_DISABLE_FUSED=1 BENCH_TAG=scan
+# 1. f32 storage, fused Pallas kernel (bench.py now defaults to bf16
+#    on TPU, so the f32 legs pin BENCH_DTYPE explicitly)
+run BENCH_DTYPE=float32 BENCH_TAG=fused
+# 2. f32 storage, XLA tile-scan path
+run BENCH_DTYPE=float32 RAFT_TPU_DISABLE_FUSED=1 BENCH_TAG=scan
 # 3. bf16 storage (half the HBM stream)
 run BENCH_DTYPE=bfloat16 BENCH_TAG=bf16
 # 4. bf16 + scan

@@ -42,6 +42,12 @@ def main(argv=None):
     p.add_argument("--force-rebuild", action="store_true",
                    help="rebuild indexes even if a cached index file "
                         "exists under <out-dir>/indexes/")
+    p.add_argument("--resume", action="store_true",
+                   help="append to an existing results.jsonl, skipping "
+                        "already-recorded combinations")
+    p.add_argument("--algos", default=None,
+                   help="comma-separated algo names to run (default all "
+                        "in the config)")
 
     p = sub.add_parser("data-export", help="results JSONL -> CSV")
     p.add_argument("--results", required=True)
@@ -82,7 +88,8 @@ def main(argv=None):
         rows = run_benchmark(
             args.dataset, config, args.out_dir, k=args.k,
             batch_size=args.batch_size, search_iters=args.search_iters,
-            force_rebuild=args.force_rebuild,
+            force_rebuild=args.force_rebuild, resume=args.resume,
+            only_algos=(args.algos.split(",") if args.algos else None),
         )
         for r in rows:
             print(json.dumps(r))

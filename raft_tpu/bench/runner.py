@@ -321,9 +321,19 @@ def run_benchmark(
     max_base_rows: int = 0,
     search_iters: int = 3,
     force_rebuild: bool = False,
+    resume: bool = False,
+    only_algos=None,
 ) -> List[Dict[str, Any]]:
     """Run every (algo, build-params, search-params) combination in
     ``config`` against the dataset tree; write JSON-lines results.
+
+    ``resume=True`` appends to an existing ``results.jsonl`` and skips
+    combinations already recorded there (same algo/build/search/k/
+    batch), so an interrupted sweep (this harness drives a TPU through
+    a relay that can die mid-run) continues where it stopped instead of
+    redoing finished measurements. ``only_algos`` (iterable of names)
+    restricts the sweep to those algo entries — the piece-at-a-time
+    pattern: one process per family bounds what a crash can lose.
 
     Config schema (the reference's ``conf/*.json`` shape)::
 
@@ -352,12 +362,57 @@ def run_benchmark(
     if batch_size <= 0:
         batch_size = queries.shape[0]
 
+    def _combo_key(algo_name, build_params, search_params):
+        return json.dumps(
+            [dataset_dir.name, int(max_base_rows), algo_name,
+             build_params, search_params, k, batch_size],
+            sort_keys=True)
+
+    if only_algos is not None:
+        only_algos = {a.strip() for a in only_algos}
+        in_config = {a["name"] for a in config["algos"]}
+        unknown = only_algos - in_config
+        if unknown:
+            raise ValueError(
+                f"only_algos entries {sorted(unknown)} not in the "
+                f"config (it has {sorted(in_config)})")
+
+    done = set()
     results = []
     out_file = out_dir / "results.jsonl"
-    with open(out_file, "w") as fh:
+    if resume and out_file.exists():
+        with open(out_file) as fh:
+            for line in fh:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # truncated tail from a killed run
+                # dataset/base-rows guard: rows from a different dataset
+                # sharing the out_dir must not satisfy this sweep
+                if (row.get("dataset") == dataset_dir.name
+                        and row.get("max_base_rows", 0)
+                        == int(max_base_rows)
+                        and row.get("k") == k
+                        and row.get("batch_size") == batch_size):
+                    done.add(_combo_key(row.get("algo"),
+                                        row.get("build_params"),
+                                        row.get("search_params")))
+                    results.append(row)
+        if done:
+            _log_warn("resume: %d finished combination(s) found in %s",
+                      len(done), out_file)
+    with open(out_file, "a" if resume else "w") as fh:
         for algo_cfg in config["algos"]:
+            if only_algos is not None and \
+                    algo_cfg["name"] not in only_algos:
+                continue
             algo = ALGO_REGISTRY[algo_cfg["name"]]
             build_params = algo_cfg.get("build", {})
+            pending = [sp for sp in algo_cfg.get("search", [{}])
+                       if _combo_key(algo.name, build_params, sp)
+                       not in done]
+            if not pending:
+                continue  # every search combo finished in a prior run
             cache = None
             if algo.save is not None and algo.load is not None:
                 key = _index_cache_key(
@@ -393,7 +448,7 @@ def run_benchmark(
                     _log_warn("index cache save failed (%s: %s) — "
                               "continuing without cache", cache.name, e)
 
-            for search_params in algo_cfg.get("search", [{}]):
+            for search_params in pending:
                 # warm (compile) every batch shape, including a ragged
                 # final batch, so no compile lands in the timed loop
                 _block(algo.search(index, queries[:batch_size], k,
@@ -427,6 +482,7 @@ def run_benchmark(
                        if gt is not None else float("nan"))
                 row = {
                     "dataset": dataset_dir.name,
+                    "max_base_rows": int(max_base_rows),
                     "algo": algo.name,
                     "build_params": build_params,
                     "search_params": search_params,

@@ -10,7 +10,10 @@ SECS=${BENCH_SECONDS:-20}
 
 run() {
   echo "=== $* ===" >&2
-  env "$@" BENCH_N=$N BENCH_SECONDS=$SECS timeout 1800 python bench.py
+  # 3600 > bench.py's largest default child deadline (2400 s for the
+  # bf16 legs): the parent's abandon-never-kill fallback must fire
+  # before the shell timeout, or the TPU child dies mid-flight
+  env "$@" BENCH_N=$N BENCH_SECONDS=$SECS timeout 3600 python bench.py
 }
 
 # 1. f32 storage, fused Pallas kernel (bench.py now defaults to bf16

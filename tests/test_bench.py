@@ -105,6 +105,40 @@ class TestRunner:
                               search_iters=1, force_rebuild=True)
         assert all(not r["build_cached"] for r in third)
 
+    def test_resume_and_algo_filter(self, dataset_dir, tmp_path):
+        """resume=True skips combinations already in results.jsonl and
+        appends the rest (the interrupted-TPU-sweep recovery path);
+        only_algos restricts the sweep to the named families."""
+        config = {
+            "algos": [
+                {"name": "raft_brute_force", "search": [{}]},
+                {"name": "raft_ivf_flat", "build": {"n_lists": 32},
+                 "search": [{"n_probes": 4}, {"n_probes": 32}]},
+            ]
+        }
+        out = tmp_path / "res"
+        only = run_benchmark(dataset_dir, config, out, k=10,
+                             search_iters=1,
+                             only_algos=["raft_brute_force"])
+        assert [r["algo"] for r in only] == ["raft_brute_force"]
+
+        # simulate the interrupted sweep: results.jsonl holds only the
+        # brute-force row; resume must keep it and add the ivf rows
+        resumed = run_benchmark(dataset_dir, config, out, k=10,
+                                search_iters=1, resume=True)
+        assert [r["algo"] for r in resumed] == [
+            "raft_brute_force", "raft_ivf_flat", "raft_ivf_flat"]
+        lines = [json.loads(line) for line in
+                 (out / "results.jsonl").read_text().splitlines()]
+        assert len(lines) == 3
+
+        # resuming a complete sweep is a no-op that reports every row
+        again = run_benchmark(dataset_dir, config, out, k=10,
+                              search_iters=1, resume=True)
+        assert len(again) == 3
+        assert len((out / "results.jsonl").read_text()
+                   .splitlines()) == 3
+
     def test_cli(self, dataset_dir, tmp_path):
         from raft_tpu.bench.__main__ import main
 

@@ -48,6 +48,10 @@ def main(argv=None):
     p.add_argument("--algos", default=None,
                    help="comma-separated algo names to run (default all "
                         "in the config)")
+    p.add_argument("--require-cached-index", action="store_true",
+                   help="fail instead of building when a saveable "
+                        "algo's index cache misses (for measurement "
+                        "devices where builds are not acceptable)")
 
     p = sub.add_parser("data-export", help="results JSONL -> CSV")
     p.add_argument("--results", required=True)
@@ -90,6 +94,7 @@ def main(argv=None):
             batch_size=args.batch_size, search_iters=args.search_iters,
             force_rebuild=args.force_rebuild, resume=args.resume,
             only_algos=(args.algos.split(",") if args.algos else None),
+            require_cached_index=args.require_cached_index,
         )
         for r in rows:
             print(json.dumps(r))

@@ -323,17 +323,22 @@ def run_benchmark(
     force_rebuild: bool = False,
     resume: bool = False,
     only_algos=None,
+    require_cached_index: bool = False,
 ) -> List[Dict[str, Any]]:
     """Run every (algo, build-params, search-params) combination in
     ``config`` against the dataset tree; write JSON-lines results.
 
     ``resume=True`` appends to an existing ``results.jsonl`` and skips
-    combinations already recorded there (same algo/build/search/k/
-    batch), so an interrupted sweep (this harness drives a TPU through
-    a relay that can die mid-run) continues where it stopped instead of
-    redoing finished measurements. ``only_algos`` (iterable of names)
-    restricts the sweep to those algo entries — the piece-at-a-time
-    pattern: one process per family bounds what a crash can lose.
+    combinations already recorded there (same dataset/algo/build/
+    search/k/batch/search_iters), so an interrupted sweep (this harness
+    drives a TPU through a relay that can die mid-run) continues where
+    it stopped instead of redoing finished measurements. ``only_algos``
+    (iterable of names) restricts the sweep to those algo entries — the
+    piece-at-a-time pattern: one process per family bounds what a crash
+    can lose. ``require_cached_index=True`` raises instead of building
+    when a saveable algo's index cache misses — the guard for runs
+    where an index build on the measurement device is not acceptable
+    (e.g. the multi-compile 1M builds that wedge the TPU relay).
 
     Config schema (the reference's ``conf/*.json`` shape)::
 
@@ -365,7 +370,7 @@ def run_benchmark(
     def _combo_key(algo_name, build_params, search_params):
         return json.dumps(
             [dataset_dir.name, int(max_base_rows), algo_name,
-             build_params, search_params, k, batch_size],
+             build_params, search_params, k, batch_size, search_iters],
             sort_keys=True)
 
     if only_algos is not None:
@@ -387,17 +392,23 @@ def run_benchmark(
                     row = json.loads(line)
                 except json.JSONDecodeError:
                     continue  # truncated tail from a killed run
-                # dataset/base-rows guard: rows from a different dataset
-                # sharing the out_dir must not satisfy this sweep
+                # dataset/base-rows/iters guard: rows from a different
+                # dataset or measurement depth sharing the out_dir must
+                # not satisfy this sweep
                 if (row.get("dataset") == dataset_dir.name
                         and row.get("max_base_rows", 0)
                         == int(max_base_rows)
                         and row.get("k") == k
-                        and row.get("batch_size") == batch_size):
+                        and row.get("batch_size") == batch_size
+                        and row.get("search_iters") == search_iters):
                     done.add(_combo_key(row.get("algo"),
                                         row.get("build_params"),
                                         row.get("search_params")))
-                    results.append(row)
+                    # returned/printed rows honor only_algos: a
+                    # per-family step must not replay other families
+                    if (only_algos is None
+                            or row.get("algo") in only_algos):
+                        results.append(row)
         if done:
             _log_warn("resume: %d finished combination(s) found in %s",
                       len(done), out_file)
@@ -436,6 +447,11 @@ def run_benchmark(
                               "rebuilding", cache.name, e)
                     index = None
             if index is None:
+                if require_cached_index and cache is not None:
+                    raise RuntimeError(
+                        f"require_cached_index: no cached index for "
+                        f"{algo.name} {build_params} (expected "
+                        f"{cache}); prebuild it off-device first")
                 index = _block(algo.build(base, metric, **build_params))
             build_s = time.perf_counter() - t0
             if cache is not None and not build_cached:
@@ -488,6 +504,7 @@ def run_benchmark(
                     "search_params": search_params,
                     "k": k,
                     "batch_size": batch_size,
+                    "search_iters": search_iters,
                     "build_seconds": round(build_s, 4),
                     "build_cached": build_cached,
                     "qps": round(qps, 2),

@@ -17,12 +17,24 @@ echo "round3_all start $(date)" | tee -a "$LOG"
 
 . "$SCRIPT_DIR/relay_lib.sh"
 
+FIRST_STEP=1
 step() {  # step <name> <cmd...>
   local name=$1; shift
   if ! relay_up; then
     echo "RELAY DOWN before step $name — stopping $(date)" | tee -a "$LOG"
     exit 2
   fi
+  # r3s3 lesson: backend init racing the previous process's teardown
+  # can wedge the relay even with no compile in flight — leave a gap,
+  # then re-check so the launch itself is fresh
+  if [ "$FIRST_STEP" = 0 ]; then
+    sleep 150
+    if ! relay_up; then
+      echo "RELAY DOWN before step $name — stopping $(date)" | tee -a "$LOG"
+      exit 2
+    fi
+  fi
+  FIRST_STEP=0
   echo "=== step $name start $(date) ===" | tee -a "$LOG"
   "$@" >> "$LOG" 2>&1
   echo "=== step $name rc=$? end $(date) ===" | tee -a "$LOG"
@@ -39,20 +51,36 @@ step profile_fknn  python scripts/tpu_profile6.py --piece fknn  --out results/tp
 step profile_cagra python scripts/tpu_profile6.py --piece cagra --out results/tpu_profile6_r3.jsonl
 
 # 4. recall-vs-QPS pareto sweep on blobs-1M (the reference's headline
-#    artifact form). GUARD: without the CPU-prebuilt CAGRA indexes the
-#    sweep would run the 1M cluster_join build ON TPU — the exact
-#    multi-compile leg that killed the relay. Skip rather than risk it.
-if ls results/sweep-1M/indexes/raft_cagra-*.bin >/dev/null 2>&1; then
-  step sweep python -m raft_tpu.bench run \
+#    artifact form), piece-wise: one process per family with --resume,
+#    so a relay death loses one family, not the sweep.
+#    --require-cached-index: a config entry whose index isn't
+#    CPU-prebuilt fails fast host-side instead of running its 1M build
+#    ON TPU — the exact multi-compile leg that killed the relay.
+#    (brute_force has no index file and is exempt by design.)
+sweep_family() {  # sweep_family <step-name> <algo>
+  step "$1" python -m raft_tpu.bench run \
     --dataset datasets/blobs-1000000-128 --config blobs-1M-128 \
-    --out-dir results/sweep-1M
-else
-  echo "SKIP sweep: no prebuilt CAGRA indexes under results/sweep-1M/indexes" \
-    "(run scripts/prebuild_sweep_indexes.py first)" | tee -a "$LOG"
-fi
-step sweep_export python -m raft_tpu.bench data-export \
+    --out-dir results/sweep-1M --resume --algos "$2" \
+    --require-cached-index
+}
+sweep_family sweep_bf    raft_brute_force
+sweep_family sweep_flat  raft_ivf_flat
+sweep_family sweep_pq    raft_ivf_pq
+sweep_family sweep_bq    raft_ivf_bq
+sweep_family sweep_cagra raft_cagra
+
+# export/plot are CPU-only and cannot wedge the relay — no gap, no
+# relay gate, so harvested results always get exported even if the
+# relay died right after the sweep
+cpustep() {  # cpustep <name> <cmd...>
+  local name=$1; shift
+  echo "=== cpustep $name start $(date) ===" | tee -a "$LOG"
+  "$@" >> "$LOG" 2>&1
+  echo "=== cpustep $name rc=$? end $(date) ===" | tee -a "$LOG"
+}
+cpustep sweep_export python -m raft_tpu.bench data-export \
   --results results/sweep-1M --out results/sweep-1M/export.csv
-step sweep_plot python -m raft_tpu.bench plot \
+cpustep sweep_plot python -m raft_tpu.bench plot \
   --results results/sweep-1M --out results/sweep-1M/pareto.png
 
 # 5. IVF continuity + LUT ladder + BQ

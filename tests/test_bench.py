@@ -139,6 +139,50 @@ class TestRunner:
         assert len((out / "results.jsonl").read_text()
                    .splitlines()) == 3
 
+        # a resumed per-family step returns only that family's rows
+        one = run_benchmark(dataset_dir, config, out, k=10,
+                            search_iters=1, resume=True,
+                            only_algos=["raft_brute_force"])
+        assert [r["algo"] for r in one] == ["raft_brute_force"]
+
+        # rows measured at a different search_iters don't satisfy the
+        # resume (they re-measure and append)
+        deeper = run_benchmark(dataset_dir, config, out, k=10,
+                               search_iters=2, resume=True,
+                               only_algos=["raft_brute_force"])
+        assert len(deeper) == 1
+        assert len((out / "results.jsonl").read_text()
+                   .splitlines()) == 4
+
+    def test_require_cached_index(self, dataset_dir, tmp_path):
+        """require_cached_index fails fast (host-side) when a saveable
+        algo's cache misses, instead of building on the measurement
+        device; saveless brute_force is exempt; a cached family runs."""
+        config = {
+            "algos": [
+                {"name": "raft_brute_force", "search": [{}]},
+                {"name": "raft_ivf_flat", "build": {"n_lists": 32},
+                 "search": [{"n_probes": 4}]},
+            ]
+        }
+        out = tmp_path / "res"
+        with pytest.raises(RuntimeError, match="require_cached_index"):
+            run_benchmark(dataset_dir, config, out, k=10, search_iters=1,
+                          require_cached_index=True)
+        # brute force (no index file) ran and flushed before the raise
+        lines = (out / "results.jsonl").read_text().splitlines()
+        assert [json.loads(line)["algo"] for line in lines] == [
+            "raft_brute_force"]
+
+        # populate the cache, then the guarded run succeeds
+        run_benchmark(dataset_dir, config, out, k=10, search_iters=1,
+                      only_algos=["raft_ivf_flat"])
+        rows = run_benchmark(dataset_dir, config, out, k=10,
+                             search_iters=1, require_cached_index=True)
+        assert [r["algo"] for r in rows] == [
+            "raft_brute_force", "raft_ivf_flat"]
+        assert rows[1]["build_cached"]
+
     def test_cli(self, dataset_dir, tmp_path):
         from raft_tpu.bench.__main__ import main
 

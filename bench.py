@@ -236,6 +236,13 @@ def child_main():
     # the conservative 16 MB and shrink tiles. Pin the measured-safe
     # v5e budget (explicit env still wins).
     os.environ.setdefault("RAFT_TPU_VMEM_MB", "64")
+    # persistent compile cache shared with the profile/sweep scripts:
+    # re-runs (and the bf16-validation programs) skip recompiles, the
+    # relay's highest-risk phase. Non-fatal if the backend can't.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "results", "jaxcache"))
     import jax
     import jax.numpy as jnp
 

@@ -348,6 +348,10 @@ def run_benchmark(
     """
     if search_iters < 1:
         raise ValueError(f"search_iters must be >= 1, got {search_iters}")
+    if force_rebuild and require_cached_index:
+        raise ValueError(
+            "force_rebuild and require_cached_index are contradictory: "
+            "one demands a fresh build, the other forbids building")
     config = normalize_config(config)
     dataset_dir = pathlib.Path(dataset_dir)
     out_dir = pathlib.Path(out_dir)
@@ -535,8 +539,8 @@ def export_csv(results_dir, out_path=None) -> pathlib.Path:
     if not rows:
         raise FileNotFoundError(f"no results under {results_dir}")
     cols = ["dataset", "algo", "build_params", "search_params", "k",
-            "batch_size", "build_seconds", "build_cached", "qps",
-            "recall"]
+            "batch_size", "search_iters", "build_seconds",
+            "build_cached", "qps", "recall"]
     with open(out_path, "w", newline="") as fh:
         w = csv.DictWriter(fh, fieldnames=cols)
         w.writeheader()
@@ -558,16 +562,22 @@ def plot_results(results_dir, out_path=None) -> pathlib.Path:
     results_dir = pathlib.Path(results_dir)
     out_path = pathlib.Path(out_path or results_dir / "recall_vs_qps.png")
     rows = _load_rows(results_dir)
-    algos = sorted({r["algo"] for r in rows})
+    # rows measured at different search_iters (smoke vs full depth) are
+    # distinct series — mixing them would zigzag the pareto line
+    depths = {r.get("search_iters") for r in rows}
+    series = sorted({(r["algo"], r.get("search_iters")) for r in rows},
+                    key=lambda t: (t[0], str(t[1])))
     fig, ax = plt.subplots(figsize=(7, 5))
-    for algo in algos:
+    for algo, depth in series:
+        label = algo if len(depths) == 1 else f"{algo} (iters={depth})"
         pts = sorted(
             [(r["recall"], r["qps"]) for r in rows
-             if r["algo"] == algo and r["recall"] is not None]
+             if r["algo"] == algo and r.get("search_iters") == depth
+             and r["recall"] is not None]
         )
         if pts:
             ax.plot([p[0] for p in pts], [p[1] for p in pts],
-                    marker="o", label=algo)
+                    marker="o", label=label)
     ax.set_xlabel(f"recall@k")
     ax.set_ylabel("QPS")
     ax.set_yscale("log")

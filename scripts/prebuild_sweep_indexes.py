@@ -13,6 +13,7 @@ import argparse
 import importlib.resources
 import json
 import pathlib
+import sys
 import time
 
 import jax
@@ -27,6 +28,12 @@ def main():
     ap.add_argument("--out-dir", default="results/sweep-1M")
     ap.add_argument("--algos", default="raft_cagra",
                     help="comma-separated algo names to prebuild")
+    ap.add_argument("--check", action="store_true",
+                    help="build nothing; exit 0 iff every index this "
+                         "run would build is already cached (the "
+                         "host-side pre-gate the TPU sweep runs before "
+                         "burning an inter-process gap on a doomed "
+                         "family)")
     args = ap.parse_args()
 
     assert jax.devices()[0].platform == "cpu"
@@ -46,13 +53,21 @@ def main():
     config = normalize_config(json.loads(cfg_path.read_text()))
 
     dataset_dir = pathlib.Path(args.dataset)
-    base = read_bin(dataset_dir / "base.fbin")
+    if args.check:
+        # header only — the cache key needs just (rows, dim)
+        with open(dataset_dir / "base.fbin", "rb") as f:
+            import numpy as np
+            shape = tuple(np.fromfile(f, np.int32, 2))
+    else:
+        base = read_bin(dataset_dir / "base.fbin")
+        shape = base.shape
     metric_name = (dataset_dir / "metric.txt").read_text().strip() \
         if (dataset_dir / "metric.txt").exists() else "euclidean"
     metric = METRICS[metric_name]
 
     wanted = set(args.algos.split(","))
     index_dir = pathlib.Path(args.out_dir) / "indexes"
+    missing = 0
     for algo_cfg in config["algos"]:
         if algo_cfg["name"] not in wanted:
             continue
@@ -61,11 +76,15 @@ def main():
             print(f"{algo_cfg['name']}: no save support, skipping")
             continue
         build_params = algo_cfg.get("build", {})
-        key = _index_cache_key(algo.name, dataset_dir.name, base.shape[0],
-                               base.shape[1], metric_name, build_params)
+        key = _index_cache_key(algo.name, dataset_dir.name, shape[0],
+                               shape[1], metric_name, build_params)
         path = index_dir / f"{key}.bin"
         if path.exists():
             print(f"cached: {path}", flush=True)
+            continue
+        if args.check:
+            print(f"MISSING: {path}", flush=True)
+            missing += 1
             continue
         t0 = time.perf_counter()
         index = algo.build(base, metric, **build_params)
@@ -73,6 +92,8 @@ def main():
         dt = time.perf_counter() - t0
         save_index_atomic(algo, index, path)
         print(f"built {key} in {dt:.0f}s (CPU) -> {path}", flush=True)
+    if args.check and missing:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

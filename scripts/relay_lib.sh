@@ -11,3 +11,20 @@ relay_up() {
   done
   return 0
 }
+
+# relay_gate: call before launching each TPU process in a sequence.
+# Returns 1 when the relay is down (check BEFORE sleeping, so a dead
+# relay is reported instantly). From the second call on, inserts a
+# ${RELAY_GAP_S:-150}s gap first — the r3s3 lesson: backend init racing
+# the previous process's teardown can wedge the relay even with no
+# compile in flight — then re-checks so the launch itself is fresh.
+RELAY_GATE_FIRST=1
+relay_gate() {
+  relay_up || return 1
+  if [ "$RELAY_GATE_FIRST" = 0 ]; then
+    sleep "${RELAY_GAP_S:-150}"
+    relay_up || return 1
+  fi
+  RELAY_GATE_FIRST=0
+  return 0
+}

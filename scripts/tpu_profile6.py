@@ -30,6 +30,13 @@ import time
 import numpy as np
 
 os.environ.setdefault("RAFT_TPU_VMEM_MB", "64")
+# persistent compile cache: each piece is its own process, so without
+# this every piece re-pays its compiles — and long compile phases are
+# what kills the relay. Unsupported-backend failures are non-fatal.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                 "results", "jaxcache"))
 
 import jax
 import jax.numpy as jnp

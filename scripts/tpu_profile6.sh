@@ -12,26 +12,14 @@ PIECES=("$@")
 
 . "$SCRIPT_DIR/relay_lib.sh"
 
-FIRST=1
 for piece in "${PIECES[@]}"; do
-  if ! relay_up; then
+  if ! relay_gate; then
     echo "relay DOWN before piece $piece — stopping" >&2
     exit 2
   fi
-  # r3s3 lesson: backend init racing the previous process's teardown
-  # can wedge the relay even with no compile in flight — leave a gap
-  # (after the cheap check above so a dead relay exits immediately,
-  # re-checked after the sleep so the launch itself is fresh)
-  if [ "$FIRST" = 0 ]; then
-    sleep 150
-    if ! relay_up; then
-      echo "relay DOWN before piece $piece — stopping" >&2
-      exit 2
-    fi
-  fi
-  FIRST=0
   echo "=== piece $piece ===" >&2
   PYTHONPATH=/root/repo:/root/.axon_site RAFT_TPU_VMEM_MB=64 \
+    JAX_COMPILATION_CACHE_DIR="$PWD/results/jaxcache" \
     python scripts/tpu_profile6.py --piece "$piece" --out "$OUT" \
     2>> "${OUT%.jsonl}.err"
   echo "=== piece $piece rc=$? ===" >&2

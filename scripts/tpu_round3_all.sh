@@ -11,30 +11,21 @@ SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
 cd "$SCRIPT_DIR/.."
 export PYTHONPATH=/root/repo:/root/.axon_site
 export RAFT_TPU_VMEM_MB=64
+# cross-process persistent compile cache (the pieces/steps are separate
+# processes; compiles are the relay's highest-risk phase)
+export JAX_COMPILATION_CACHE_DIR="$PWD/results/jaxcache"
 TS=$(date +%H%M%S)
 LOG=results/round3_all_$TS.log
 echo "round3_all start $(date)" | tee -a "$LOG"
 
 . "$SCRIPT_DIR/relay_lib.sh"
 
-FIRST_STEP=1
 step() {  # step <name> <cmd...>
   local name=$1; shift
-  if ! relay_up; then
+  if ! relay_gate; then  # inter-process gap + checks: relay_lib.sh
     echo "RELAY DOWN before step $name — stopping $(date)" | tee -a "$LOG"
     exit 2
   fi
-  # r3s3 lesson: backend init racing the previous process's teardown
-  # can wedge the relay even with no compile in flight — leave a gap,
-  # then re-check so the launch itself is fresh
-  if [ "$FIRST_STEP" = 0 ]; then
-    sleep 150
-    if ! relay_up; then
-      echo "RELAY DOWN before step $name — stopping $(date)" | tee -a "$LOG"
-      exit 2
-    fi
-  fi
-  FIRST_STEP=0
   echo "=== step $name start $(date) ===" | tee -a "$LOG"
   "$@" >> "$LOG" 2>&1
   echo "=== step $name rc=$? end $(date) ===" | tee -a "$LOG"
@@ -58,6 +49,16 @@ step profile_cagra python scripts/tpu_profile6.py --piece cagra --out results/tp
 #    ON TPU — the exact multi-compile leg that killed the relay.
 #    (brute_force has no index file and is exempt by design.)
 sweep_family() {  # sweep_family <step-name> <algo>
+  # host-side pre-gate (CPU, no relay risk): skip a family whose
+  # indexes aren't all prebuilt instead of burning an inter-process
+  # gap + TPU launch on a run that --require-cached-index would kill
+  if [ "$2" != raft_brute_force ] && \
+      ! python scripts/prebuild_sweep_indexes.py --check --algos "$2" \
+        >/dev/null 2>&1; then
+    echo "SKIP $1: family $2 not fully prebuilt" \
+      "(run scripts/prebuild_sweep_indexes.py first)" | tee -a "$LOG"
+    return
+  fi
   step "$1" python -m raft_tpu.bench run \
     --dataset datasets/blobs-1000000-128 --config blobs-1M-128 \
     --out-dir results/sweep-1M --resume --algos "$2" \

@@ -20,6 +20,11 @@ Usage: python scripts/tpu_scale_build.py [--rows 100000000] [--dim 96]
 import argparse
 import json
 import os
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                 "results", "jaxcache"))
 import time
 
 import numpy as np

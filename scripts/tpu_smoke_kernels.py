@@ -15,6 +15,10 @@ import os
 import numpy as np
 
 os.environ.setdefault("RAFT_TPU_VMEM_MB", "64")  # see tpu_profile5.py
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                 "results", "jaxcache"))
 
 import jax
 import jax.numpy as jnp

@@ -358,7 +358,10 @@ def child_main():
                                  dataset_norms=index.norms, passes=m)
 
     try:
-        sl = timeit_slope(make_passes, 2, 8)
+        from raft_tpu.bench.prims import slope_passes
+
+        lo, hi = slope_passes(index.dataset.dtype)
+        sl = timeit_slope(make_passes, lo, hi)
         log(f"slope timing: T({sl['m1']})={sl['t1_s'] * 1e3:.1f} ms, "
             f"T({sl['m2']})={sl['t2_s'] * 1e3:.1f} ms -> "
             f"{sl['slope_s'] * 1e3:.2f} ms/iter")

@@ -106,6 +106,22 @@ def loop_queries(fn: Callable, queries, m: int) -> Callable[[], object]:
     return lambda: run(queries)
 
 
+# Slope pass spreads per dataset dtype, shared by bench.py and the
+# profile scripts so a jitter recalibration can't drift between them.
+# Calibration (r3): the relay's dispatch jitter is up to ~4 ms; a
+# 2-vs-8 spread at f32 (~0.9 ms/pass) was inside it, and bf16 passes
+# are ~2x faster, so bf16 gets twice the passes.
+SLOPE_PASSES = {"float32": (2, 16), "bfloat16": (2, 32)}
+
+
+def slope_passes(dtype) -> tuple:
+    """(low, high) in-program pass counts for slope timing of a
+    dataset-streaming kernel at ``dtype`` (jnp/np dtype, scalar type,
+    or name)."""
+    name = np.dtype(dtype).name
+    return SLOPE_PASSES.get(name, SLOPE_PASSES["float32"])
+
+
 def timeit_slope(make_fn: Callable[[int], Callable[[], object]],
                  m1: int, m2: int, reps: int = 4) -> Dict:
     """Per-iteration seconds from the slope between an m1- and an

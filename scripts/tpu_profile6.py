@@ -92,6 +92,7 @@ def ground_truth(x, q):
 
 
 def piece_fknn():
+    from raft_tpu.bench.prims import slope_passes
     from raft_tpu.distance.types import DistanceType
     from raft_tpu.ops.fused_topk import fused_knn
 
@@ -102,28 +103,32 @@ def piece_fknn():
     norms = jnp.sum(jnp.square(big), axis=1)
     payload_f32 = n_big * 128 * 4
 
-    # wider passes spread (2 vs 16) + iters=10: the r3 partial run's
-    # 2-vs-8 spread at iters=5 was inside the relay's dispatch jitter
-    # (two legs came out negative). bf16 gets 2-vs-32: its r3s3
-    # 2-vs-16 reading implied >roofline bandwidth, i.e. the 14-pass
-    # delta was still near the noise floor for the faster dtype
-    for tag, ds, payload, hi in (("f32", big, payload_f32, 16),
-                                 ("bf16", bigb, payload_f32 / 2, 32)):
-        for tile in (0, 16384):
+    # pass spreads + calibration rationale: prims.SLOPE_PASSES (shared
+    # with bench.py). RAFT_TPU_FKNN_TILES limits the tile legs — the
+    # VMEM-sweep rerun only needs the auto-sized tile=0 legs, not a
+    # recompile of the fixed-tile ones whose results can't change
+    tiles = tuple(int(t) for t in os.environ.get(
+        "RAFT_TPU_FKNN_TILES", "0,16384").split(","))
+    for tag, ds, payload in (("f32", big, payload_f32),
+                             ("bf16", bigb, payload_f32 / 2)):
+        lo, hi = slope_passes(ds.dtype)
+        for tile in tiles:
             try:
-                t2 = wall(lambda: fused_knn(qs, ds, 10,
-                                            DistanceType.L2Expanded,
-                                            dataset_norms=norms, tile=tile,
-                                            passes=2))
+                tlo = wall(lambda: fused_knn(qs, ds, 10,
+                                             DistanceType.L2Expanded,
+                                             dataset_norms=norms, tile=tile,
+                                             passes=lo))
                 thi = wall(lambda: fused_knn(qs, ds, 10,
                                              DistanceType.L2Expanded,
                                              dataset_norms=norms, tile=tile,
                                              passes=hi))
-                dt = (thi - t2) / (hi - 2)
+                dt = (thi - tlo) / (hi - lo)
                 emit(f"fknn_{tag}_tile{tile}_slope",
-                     iter_ms=round(dt * 1e3, 3), hi_passes=hi,
+                     iter_ms=round(dt * 1e3, 3), lo_passes=lo,
+                     hi_passes=hi,
                      gbps=round(payload / dt / 1e9, 1) if dt > 0 else -1,
-                     t2_ms=round(t2 * 1e3, 2), thi_ms=round(thi * 1e3, 2))
+                     tlo_ms=round(tlo * 1e3, 2),
+                     thi_ms=round(thi * 1e3, 2))
             except Exception as e:  # noqa: BLE001
                 emit(f"fknn_{tag}_tile{tile}_slope", error=str(e)[:160])
 

@@ -39,6 +39,13 @@ step bench env BENCH_SECONDS=45 python bench.py
 
 # 3. flagship A/B: CAGRA engines on the prebuilt index + fknn slopes
 step profile_fknn  python scripts/tpu_profile6.py --piece fknn  --out results/tpu_profile6_r3.jsonl
+# auto-tile legs only at a 96 MB VMEM budget (Mosaic accepts >=72 MB
+# on v5e, r2 evidence) — bigger stream tiles, fewer grid steps; the
+# f32 leg sits at 74% of roofline and tile sizing is the suspect.
+# Fixed-tile legs are excluded: their results can't change, and the
+# different vmem_limit_bytes would force fresh relay-risking compiles
+step profile_fknn96 env RAFT_TPU_VMEM_MB=96 RAFT_TPU_FKNN_TILES=0 \
+  python scripts/tpu_profile6.py --piece fknn --out results/tpu_profile6_r3_v96.jsonl
 step profile_cagra python scripts/tpu_profile6.py --piece cagra --out results/tpu_profile6_r3.jsonl
 
 # 4. recall-vs-QPS pareto sweep on blobs-1M (the reference's headline

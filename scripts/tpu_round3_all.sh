@@ -98,8 +98,11 @@ step profile_bq  python scripts/tpu_profile6.py --piece bq  --out results/tpu_pr
 # 6. per-primitive table
 step prims python -m raft_tpu.bench.prims --size full --out results/prims_full_r3.jsonl
 
-# 7. 100M streaming scale build (long)
-step scale python scripts/tpu_scale_build.py
+# 7. 100M streaming scale build (long). Params pinned explicitly so a
+#    rerun after a default change stays comparable with recorded rows
+#    (8-bit codes: the >=0.95-recall@10 regime, 0.988 refined in the
+#    2M CPU rehearsal vs 0.623 at 4-bit)
+step scale python scripts/tpu_scale_build.py --pq-bits 8
 
 # 8. cluster_join build timing — the leg that killed the relay; LAST
 step profile_cjoin python scripts/tpu_profile6.py --piece cjoin --out results/tpu_profile6_r3.jsonl

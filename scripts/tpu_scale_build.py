@@ -89,6 +89,12 @@ def main():
     ap.add_argument("--queries", type=int, default=100)
     ap.add_argument("--n-lists", type=int, default=0,
                     help="0 = auto (~sqrt(n) rounded to 1k)")
+    ap.add_argument("--pq-bits", type=int, default=8,
+                    help="codebook bits (8 = the reference's high-"
+                         "recall regime; 4 halves the code bytes)")
+    ap.add_argument("--pq-dim", type=int, default=0,
+                    help="0 = dim/2 (codes dim/2 bytes/vector at 8 "
+                         "bits)")
     ap.add_argument("--rehearsal", action="store_true",
                     help="2M rows — the CPU dry run of the same path")
     args = ap.parse_args()
@@ -96,8 +102,9 @@ def main():
         args.rows = min(args.rows, 2_000_000)
 
     import jax
+    pq_dim = args.pq_dim or args.dim // 2
     emit("config", backend=jax.default_backend(), rows=args.rows,
-         dim=args.dim)
+         dim=args.dim, pq_dim=pq_dim, pq_bits=args.pq_bits)
 
     from raft_tpu.io import BinDataset
     from raft_tpu.neighbors import ivf_pq
@@ -113,15 +120,19 @@ def main():
     n_lists = args.n_lists or max(1024,
                                   int(round((args.rows ** 0.5) / 1024)) * 1024)
     params = ivf_pq.IvfPqIndexParams(
-        n_lists=n_lists, pq_dim=args.dim // 2, pq_bits=4,
+        n_lists=n_lists, pq_dim=pq_dim, pq_bits=args.pq_bits,
         kmeans_n_iters=10)
     t0 = time.perf_counter()
     index = ivf_pq.build_streaming(None, params, ds)
     np.asarray(index.list_sizes[:1])
     build_s = time.perf_counter() - t0
+    # stored bytes/vector, not logical: codes are one uint8 per
+    # sub-dim except the packed 4-bit/even-pq_dim layout (ivf_pq.py)
+    packed = args.pq_bits == 4 and pq_dim % 2 == 0
     emit("build_streaming", s=round(build_s, 1),
          vectors_per_s=round(args.rows / build_s),
-         n_lists=n_lists, pq_bytes=args.dim // 4)
+         n_lists=n_lists,
+         pq_stored_bytes=pq_dim // 2 if packed else pq_dim)
 
     gt_t0 = time.perf_counter()
     _, gt_i = exact_gt(ds, q, 10)

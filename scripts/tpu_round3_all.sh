@@ -34,8 +34,10 @@ step() {  # step <name> <cmd...>
 # 1. kernel smoke (fast; proves the window is healthy)
 step smoke python scripts/tpu_smoke_kernels.py
 
-# 2. the headline bench (driver-format JSON line -> committed evidence)
-step bench env BENCH_SECONDS=45 python bench.py
+# 2. the headline bench (driver-format JSON line -> committed evidence;
+#    teed to the file scripts/summarize_r3.py collects)
+step bench bash -c 'set -o pipefail
+  BENCH_SECONDS=45 python bench.py | tee -a results/bench_headline.json'
 
 # 3. flagship A/B: CAGRA engines on the prebuilt index + fknn slopes
 step profile_fknn  python scripts/tpu_profile6.py --piece fknn  --out results/tpu_profile6_r3.jsonl
@@ -102,7 +104,8 @@ step prims python -m raft_tpu.bench.prims --size full --out results/prims_full_r
 #    rerun after a default change stays comparable with recorded rows
 #    (8-bit codes: the >=0.95-recall@10 regime, 0.988 refined in the
 #    2M CPU rehearsal vs 0.623 at 4-bit)
-step scale python scripts/tpu_scale_build.py --pq-bits 8
+step scale bash -c 'set -o pipefail
+  python scripts/tpu_scale_build.py --pq-bits 8 | tee -a results/scale_tpu_r3.jsonl'
 
 # 8. cluster_join build timing — the leg that killed the relay; LAST
 step profile_cjoin python scripts/tpu_profile6.py --piece cjoin --out results/tpu_profile6_r3.jsonl

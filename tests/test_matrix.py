@@ -25,6 +25,16 @@ class TestSelectK:
             np.asarray(got_v), rtol=1e-6, atol=1e-6,
         )
 
+    def test_large_n_large_k(self, rng_np):
+        """The reference's extreme regime (matrix/detail/select_radix:
+        k up to 2048 over very wide rows) at CI-sized width."""
+        vals = rng_np.standard_normal((2, 200_000)).astype(np.float32)
+        k = 2048
+        got_v, _ = select_k(None, vals, k, select_min=True)
+        want_v = np.sort(vals, axis=1)[:, :k]
+        np.testing.assert_allclose(np.sort(np.asarray(got_v), 1), want_v,
+                                   rtol=1e-6, atol=1e-6)
+
     def test_index_payload(self, rng_np):
         vals = rng_np.standard_normal((4, 50)).astype(np.float32)
         payload = rng_np.integers(1000, 2000, (4, 50)).astype(np.int32)

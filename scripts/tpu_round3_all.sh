@@ -20,6 +20,45 @@ echo "round3_all start $(date)" | tee -a "$LOG"
 
 . "$SCRIPT_DIR/relay_lib.sh"
 
+# Archive whatever evidence landed — runs on EVERY exit (a relay death
+# mid-chain aborts with exit 2; the captured pieces must still be
+# summarized and committed, or a later workspace reset loses them).
+archive_evidence() {
+  python scripts/summarize_r3.py >> "$LOG" 2>&1
+  # record streams (JSONL) APPEND into ci/ so a partial session can
+  # never clobber a prior session's committed rows (summarize_r3
+  # dedupes by record key, newest wins); whole-artifact files
+  # (csv/png) are regenerated complete each run and may overwrite
+  while read -r mode src dst; do
+    if [ -s "$src" ]; then
+      case "$mode" in
+        # order-preserving exact-duplicate drop: summarize_r3's
+        # newest-wins dedupe needs chronological order kept
+        append) cat "$src" >> "ci/$dst" \
+                  && awk '!seen[$0]++' "ci/$dst" > "ci/$dst.tmp" \
+                  && mv "ci/$dst.tmp" "ci/$dst" ;;
+        copy)   cp "$src" "ci/$dst" ;;
+      esac
+    fi
+  done <<'EOF'
+append results/tpu_smoke_r3.jsonl tpu_smoke_kernels_r3.json
+append results/tpu_profile6_r3.jsonl tpu_profile6_r3.jsonl
+append results/tpu_profile6_r3_v96.jsonl tpu_profile6_r3_v96.jsonl
+append results/bench_headline.json bench_headline_r3.json
+append results/scale_tpu_r3.jsonl scale_tpu_r3.jsonl
+append results/prims_full_r3.jsonl prims_full_r3.jsonl
+append results/sweep-1M/results.jsonl sweep1m_results_r3.jsonl
+copy results/sweep-1M/export.csv sweep1m_export_r3.csv
+copy results/sweep-1M/pareto.png pareto_r3.png
+EOF
+  git add ci/ 2>>"$LOG"
+  [ -s RESULTS_r3.md ] && git add RESULTS_r3.md 2>>"$LOG"
+  git diff --cached --quiet -- ci/ RESULTS_r3.md 2>/dev/null || \
+    git commit -q -m "Round-3 hardware evidence (auto-archived by tpu_round3_all.sh)" \
+      -- ci/ RESULTS_r3.md
+}
+trap archive_evidence EXIT
+
 step() {  # step <name> <cmd...>
   local name=$1; shift
   if ! relay_gate; then  # inter-process gap + checks: relay_lib.sh
@@ -31,8 +70,10 @@ step() {  # step <name> <cmd...>
   echo "=== step $name rc=$? end $(date) ===" | tee -a "$LOG"
 }
 
-# 1. kernel smoke (fast; proves the window is healthy)
-step smoke python scripts/tpu_smoke_kernels.py
+# 1. kernel smoke (fast; proves the window is healthy); teed so the
+#    parity records reach the archive, not just the log
+step smoke bash -c 'set -o pipefail
+  python scripts/tpu_smoke_kernels.py | tee -a results/tpu_smoke_r3.jsonl'
 
 # 2. the headline bench (driver-format JSON line -> committed evidence;
 #    teed to the file scripts/summarize_r3.py collects)

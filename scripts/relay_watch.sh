@@ -1,0 +1,32 @@
+#!/bin/bash
+# Watch the relay ports; when they come up (and stay up through a
+# settle period), launch the full round-3 hardware plan exactly once.
+# Run detached: nohup bash scripts/relay_watch.sh > results/relay_watch.log 2>&1 &
+set -u
+SCRIPT_DIR=$(cd "$(dirname "$0")" && pwd)
+cd "$SCRIPT_DIR/.."
+. "$SCRIPT_DIR/relay_lib.sh"
+
+LOCK=results/round3_all.launched
+if [ -e "$LOCK" ]; then
+  echo "lock $LOCK exists — a plan already launched; refusing" >&2
+  exit 1
+fi
+
+echo "watching relay ports ${RELAY_PORTS[*]} $(date)"
+while true; do
+  if relay_up; then
+    echo "ports up $(date); settling 60s"
+    sleep 60
+    if relay_up; then
+      break
+    fi
+    echo "ports dropped during settle; resuming watch"
+  fi
+  sleep 30
+done
+
+date > "$LOCK"
+echo "launching tpu_round3_all.sh $(date)"
+bash scripts/tpu_round3_all.sh
+echo "plan finished rc=$? $(date)"

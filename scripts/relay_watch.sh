@@ -26,7 +26,13 @@ while true; do
   sleep 30
 done
 
-date > "$LOCK"
+# atomic claim (noclobber): if two watchers raced through the wait
+# loop, exactly one wins — two concurrent plans would mean two TPU
+# processes at once, the relay-wedging condition
+if ! { set -o noclobber; date > "$LOCK"; } 2>/dev/null; then
+  echo "lost lock race to another watcher; exiting" >&2
+  exit 1
+fi
 echo "launching tpu_round3_all.sh $(date)"
 bash scripts/tpu_round3_all.sh
 echo "plan finished rc=$? $(date)"

@@ -191,20 +191,24 @@ def piece_cagra():
                             graph=ci.graph, metric=ci.metric)
     legs = [("xla_f32", ci, "xla"), ("pallas_bf16", ci16, "pallas"),
             ("xla_bf16", ci16, "xla")]
+
+    def search_leg(name, idx, algo, it, qs, gts):
+        sp = cagra.CagraSearchParams(itopk_size=it, search_width=4,
+                                     algo=algo)
+        try:
+            dt = wall(lambda: cagra.search(None, sp, idx, qs, 10),
+                      iters=10)
+            _, i = cagra.search(None, sp, idx, qs, 10)
+            r, _, _ = eval_recall(gts, np.asarray(i))
+            emit(name, ms=round(dt * 1e3, 2),
+                 qps=round(len(qs) / dt, 1), recall=round(float(r), 4))
+        except Exception as e:  # noqa: BLE001
+            emit(name, error=str(e)[:200])
+
     for it in (64, 128):
         for tag, idx, algo in legs:
-            sp = cagra.CagraSearchParams(itopk_size=it, search_width=4,
-                                         algo=algo)
-            try:
-                dt = wall(lambda sp=sp, idx=idx:
-                          cagra.search(None, sp, idx, q, 10), iters=10)
-                _, i = cagra.search(None, sp, idx, q, 10)
-                r, _, _ = eval_recall(gt, np.asarray(i))
-                emit(f"cagra_search_itopk{it}_{tag}",
-                     ms=round(dt * 1e3, 2),
-                     qps=round(100 / dt, 1), recall=round(float(r), 4))
-            except Exception as e:  # noqa: BLE001
-                emit(f"cagra_search_itopk{it}_{tag}", error=str(e)[:200])
+            search_leg(f"cagra_search_itopk{it}_{tag}", idx, algo, it,
+                       q, gt)
 
     # kernel block_q sweep on the bf16 index
     try:
@@ -239,6 +243,13 @@ def piece_cagra():
                      ms=round(dt * 1e3, 2), qps=round(100 / dt, 1))
         except Exception as e:  # noqa: BLE001
             emit(f"cagra_search_{tag_h}_f32", error=str(e)[:200])
+
+    # batch-10 legs — the reference's headline regime
+    # (raft-vector-search-batch-10.png); q=100 above measures
+    # throughput, this measures the small-batch latency point
+    for tag, idx, algo in legs:
+        search_leg(f"cagra_search_b10_itopk64_{tag}", idx, algo, 64,
+                   q[:10], gt[:10])
 
     # seed_pool variant (query-aware seeding)
     sp = cagra.CagraSearchParams(itopk_size=64, search_width=4,

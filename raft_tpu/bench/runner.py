@@ -389,6 +389,9 @@ def run_benchmark(
     done = set()
     results = []
     out_file = out_dir / "results.jsonl"
+    import jax
+
+    backend = jax.default_backend()
     if resume and out_file.exists():
         with open(out_file) as fh:
             for line in fh:
@@ -399,12 +402,22 @@ def run_benchmark(
                 # dataset/base-rows/iters guard: rows from a different
                 # dataset or measurement depth sharing the out_dir must
                 # not satisfy this sweep
+                # .get defaults: rows written before the search_iters /
+                # max_base_rows fields existed carry the values those
+                # defaults had (3 / 0) — without this, resuming over a
+                # legacy results.jsonl re-measures every combination and
+                # the export doubles up (ADVICE r3)
                 if (row.get("dataset") == dataset_dir.name
                         and row.get("max_base_rows", 0)
                         == int(max_base_rows)
                         and row.get("k") == k
                         and row.get("batch_size") == batch_size
-                        and row.get("search_iters") == search_iters):
+                        and row.get("search_iters", 3) == search_iters
+                        # a row measured on another backend (e.g. a CPU
+                        # rehearsal sharing the out_dir) must not
+                        # satisfy this sweep; missing field = legacy
+                        # row, accepted as this backend's
+                        and row.get("backend", backend) == backend):
                     done.add(_combo_key(row.get("algo"),
                                         row.get("build_params"),
                                         row.get("search_params")))
@@ -503,6 +516,7 @@ def run_benchmark(
                 row = {
                     "dataset": dataset_dir.name,
                     "max_base_rows": int(max_base_rows),
+                    "backend": backend,
                     "algo": algo.name,
                     "build_params": build_params,
                     "search_params": search_params,
@@ -538,8 +552,8 @@ def export_csv(results_dir, out_path=None) -> pathlib.Path:
     rows = _load_rows(results_dir)
     if not rows:
         raise FileNotFoundError(f"no results under {results_dir}")
-    cols = ["dataset", "algo", "build_params", "search_params", "k",
-            "batch_size", "search_iters", "build_seconds",
+    cols = ["dataset", "backend", "algo", "build_params", "search_params",
+            "k", "batch_size", "search_iters", "build_seconds",
             "build_cached", "qps", "recall"]
     with open(out_path, "w", newline="") as fh:
         w = csv.DictWriter(fh, fieldnames=cols)

@@ -93,7 +93,11 @@ def main():
         save_index_atomic(algo, index, path)
         print(f"built {key} in {dt:.0f}s (CPU) -> {path}", flush=True)
     if args.check and missing:
-        sys.exit(1)
+        # 10, not 1: an unhandled exception (import error, missing
+        # dataset, config typo) exits 1, and the sweep gate must be able
+        # to tell "not prebuilt" (skip the family) from "broken" (abort
+        # loudly) — ADVICE r3
+        sys.exit(10)
 
 
 if __name__ == "__main__":

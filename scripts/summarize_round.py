@@ -1,21 +1,25 @@
 #!/usr/bin/env python
-"""Collect round-3 hardware evidence into one markdown report.
+"""Collect hardware evidence into one markdown report (round-aware).
 
-Reads whatever exists of:
-  ci/tpu_smoke_kernels_r3.json        kernel parity smoke
-  ci/tpu_profile6_r3.jsonl            committed profile pieces
-  results/tpu_profile6_r3.jsonl       this-session profile pieces
-  results/tpu_profile6_r3_v96.jsonl   VMEM-96 fknn legs
-  results/bench_headline.json         bench.py output (if saved)
-  results/sweep-1M/results.jsonl      pareto sweep rows
-  results/scale_*.jsonl / *.log       100M streaming build records
-  results/prims_full_r3.jsonl         per-primitive table
+For every evidence stream this reads BOTH the committed ci/ archives
+(from this round and prior rounds) AND the live results/ files, then
+dedupes newest-wins — so a workspace reset can never regress the report
+to fewer rows than what is already committed (ADVICE r3, medium).
 
-Writes RESULTS_r3.md (repo root). Purely host-side — safe anytime.
+Streams (any subset may exist):
+  smoke    ci/tpu_smoke_kernels_r{3,4}.json + results/tpu_smoke_r4.jsonl
+  profile  ci/tpu_profile6_r{3,4}.jsonl + results/tpu_profile6_r4.jsonl
+  bench    ci/bench_headline_r{3,4}.json + results/bench_headline.json
+  sweep    ci/sweep1m_results_r{3,4}.jsonl + results/sweep-1M/results.jsonl
+  scale    ci/scale_tpu_r{3,4}.jsonl + results/scale_tpu_r4.jsonl
+  prims    ci/prims_full_r{3,4}.jsonl + results/prims_full_r4.jsonl
 
-Run: python scripts/summarize_r3.py
+Writes RESULTS_r{N}.md (repo root). Purely host-side — safe anytime.
+
+Run: python scripts/summarize_round.py [--round 4]
 """
 
+import argparse
 import json
 import pathlib
 
@@ -42,8 +46,19 @@ def dedupe_last(rows, key_fields):
     """Keep the LAST record per key — reruns append, newest wins."""
     out = {}
     for r in rows:
-        out[tuple(r.get(k) for k in key_fields)] = r
+        out[tuple(str(r.get(k)) for k in key_fields)] = r
     return list(out.values())
+
+
+def read_all(paths, key_fields=None):
+    """Concatenate sources oldest-first and (optionally) dedupe so the
+    newest record per key wins."""
+    rows = []
+    for p in paths:
+        rows.extend(read_jsonl(p))
+    if key_fields:
+        rows = dedupe_last(rows, key_fields)
+    return rows
 
 
 def fmt_table(rows, cols, header=None):
@@ -58,15 +73,30 @@ def fmt_table(rows, cols, header=None):
     return "\n".join(lines) + "\n"
 
 
-def main():
-    out = ["# Round-3 hardware evidence (TPU v5e via relay)", ""]
+def sources(rnd, ci_tmpl, live):
+    """Paths for one stream: prior-round ci archives (oldest first),
+    this round's ci archive, then the live results file (newest)."""
+    out = [ci_tmpl.format(r) for r in range(3, rnd + 1)]
+    out += [live] if isinstance(live, str) else list(live)
+    return out
 
-    smoke = read_jsonl("ci/tpu_smoke_kernels_r3.json")  # JSON lines
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=4)
+    args = ap.parse_args()
+    rnd = args.round
+
+    out = [f"# Round-{rnd} hardware evidence (TPU v5e via relay)", ""]
+
+    smoke = read_all(
+        sources(rnd, "ci/tpu_smoke_kernels_r{}.json",
+                f"results/tpu_smoke_r{rnd}.jsonl"), ("piece",))
     if smoke:
         lines, used = [], 0
         for r in smoke:  # whole records only; never cut JSON mid-object
             s = json.dumps(r)
-            if used + len(s) > 2000:
+            if used + len(s) > 3000:
                 lines.append(f"... {len(smoke) - len(lines)} more records "
                              "truncated")
                 break
@@ -75,10 +105,13 @@ def main():
         out += ["## Pallas kernel parity smoke (compiled Mosaic)",
                 "", "```json", "\n".join(lines), "```", ""]
 
-    prof = dedupe_last(
-        read_jsonl("ci/tpu_profile6_r3.jsonl")
-        + read_jsonl("results/tpu_profile6_r3.jsonl"), ("piece",))
-    prof96 = read_jsonl("results/tpu_profile6_r3_v96.jsonl")
+    prof = read_all(
+        sources(rnd, "ci/tpu_profile6_r{}.jsonl",
+                f"results/tpu_profile6_r{rnd}.jsonl"), ("piece",))
+    prof96 = read_all(
+        ["ci/tpu_profile6_r3_v96.jsonl",
+         "results/tpu_profile6_r3_v96.jsonl",
+         f"results/tpu_profile6_r{rnd}_v96.jsonl"], ("piece",))
     if prof:
         out += ["## Profile pieces (slope-timed; per-dtype spreads)", "",
                 fmt_table(prof, ["piece", "iter_ms", "gbps", "ms", "qps",
@@ -87,24 +120,33 @@ def main():
         out += ["### fknn at RAFT_TPU_VMEM_MB=96 (auto tiles)", "",
                 fmt_table(prof96, ["piece", "iter_ms", "gbps", "error"])]
 
-    bench = read_jsonl("results/bench_headline.json")
+    bench = read_all(
+        sources(rnd, "ci/bench_headline_r{}.json",
+                "results/bench_headline.json"), ("metric",))
     if bench:
         out += ["## Headline bench (driver format)", "",
                 "```json", "\n".join(json.dumps(b) for b in bench), "```",
                 ""]
 
-    sweep = read_jsonl("results/sweep-1M/results.jsonl")
+    sweep = read_all(
+        sources(rnd, "ci/sweep1m_results_r{}.jsonl",
+                "results/sweep-1M/results.jsonl"))
+    sweep = dedupe_last(
+        [r for r in sweep if r.get("algo")],
+        ("algo", "build_params", "search_params"))
     if sweep:
         for r in sweep:
             r["build"] = json.dumps(r.get("build_params"))
             r["search"] = json.dumps(r.get("search_params"))
         out += ["## Recall-vs-QPS sweep, blobs-1M-128 (batch = full query "
                 "set unless noted)", "",
-                fmt_table(sweep, ["algo", "build", "search", "qps",
-                                  "recall", "build_seconds",
+                fmt_table(sweep, ["algo", "backend", "build", "search",
+                                  "qps", "recall", "build_seconds",
                                   "build_cached"])]
 
-    scale = read_jsonl("results/scale_tpu_r3.jsonl")
+    scale = read_all(
+        sources(rnd, "ci/scale_tpu_r{}.jsonl",
+                f"results/scale_tpu_r{rnd}.jsonl"), ("piece", "backend"))
     scale_note = ""
     if not scale:
         # fall back to the newest CPU rehearsal, clearly labeled
@@ -121,14 +163,17 @@ def main():
                                   "pq_bits", "s", "vectors_per_s", "ms",
                                   "qps", "recall"])]
 
-    prims = read_jsonl("results/prims_full_r3.jsonl")
+    prims = read_all(
+        sources(rnd, "ci/prims_full_r{}.jsonl",
+                f"results/prims_full_r{rnd}.jsonl"), ("prim", "shape"))
     if prims:
         out += ["## Per-primitive micro-bench (--size full)", "",
                 fmt_table(prims, ["prim", "shape", "ms", "gbps", "bw_frac",
                                   "mfu"])]
 
-    (ROOT / "RESULTS_r3.md").write_text("\n".join(out) + "\n")
-    print(f"wrote {ROOT / 'RESULTS_r3.md'} "
+    report = ROOT / f"RESULTS_r{rnd}.md"
+    report.write_text("\n".join(out) + "\n")
+    print(f"wrote {report} "
           f"({len(prof)} profile rows, {len(sweep)} sweep rows, "
           f"{len(scale)} scale rows, {len(prims)} prim rows)")
 

@@ -7,13 +7,18 @@ cd "$(dirname "$0")/.."
 
 N=${BENCH_N:-1000000}
 SECS=${BENCH_SECONDS:-20}
+# One child-deadline value drives both budgets: bench.py can run TWO
+# children back to back (TPU child abandoned at the deadline, then a
+# CPU fallback child with the same deadline), so the shell timeout must
+# cover 2x the child deadline plus probe/startup margin — otherwise the
+# fallback's JSON line is lost to the shell kill (ADVICE r3).
+DEADLINE=${BENCH_CHILD_DEADLINE:-2400}
+SHELL_TIMEOUT=$((2 * DEADLINE + 600))
 
 run() {
   echo "=== $* ===" >&2
-  # 3600 > bench.py's largest default child deadline (2400 s for the
-  # bf16 legs): the parent's abandon-never-kill fallback must fire
-  # before the shell timeout, or the TPU child dies mid-flight
-  env "$@" BENCH_N=$N BENCH_SECONDS=$SECS timeout 3600 python bench.py
+  env "$@" BENCH_N=$N BENCH_SECONDS=$SECS BENCH_CHILD_DEADLINE=$DEADLINE \
+    timeout $SHELL_TIMEOUT python bench.py
 }
 
 # 1. f32 storage, fused Pallas kernel (bench.py now defaults to bf16

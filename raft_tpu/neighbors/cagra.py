@@ -532,17 +532,17 @@ def _resolve_search_algo(params: CagraSearchParams, index: CagraIndex,
         return False
     expect(params.algo in ("auto", "pallas"),
            f"algo must be 'auto'/'pallas'/'xla', got {params.algo!r}")
-    itemsize = jnp.dtype(index.dataset.dtype).itemsize
+    # any dataset size qualifies: the kernel streams candidate rows
+    # from HBM when the dataset exceeds the VMEM budget (ds_mode auto)
     ok = (index.metric in bs._SUPPORTED
           and filter_words is None
           and index.dim % 128 == 0
           and index.dataset.dtype in (jnp.float32, jnp.bfloat16,
-                                      jnp.int8)
-          and bs.beam_search_fits(index.size, index.dim, itemsize))
+                                      jnp.int8))
     if params.algo == "pallas":
         expect(ok, "algo='pallas' needs: L2/IP metric, no sample_filter, "
-               "dim % 128 == 0, f32/bf16/int8 dataset fitting the VMEM "
-               f"budget (n={index.size}, dim={index.dim}, "
+               "dim % 128 == 0, f32/bf16/int8 dataset "
+               f"(n={index.size}, dim={index.dim}, "
                f"dtype={index.dataset.dtype})")
         return True
     return ok and jax.default_backend() == "tpu"

@@ -9,8 +9,13 @@ from HBM — row gathers and per-iteration loop sync are exactly what TPUs
 do worst. This kernel instead runs the WHOLE walk in one ``pallas_call``:
 
 - the (quantizable) **dataset lives in VMEM** for the kernel's lifetime
-  (v5e has 128 MB; 200k×128 bf16 = 51 MB) — candidate rows become
-  dynamic VMEM loads, ~cycles each, no HBM latency, no XLA gather op;
+  when it fits (v5e has 128 MB; 200k×128 bf16 = 51 MB) — candidate rows
+  become dynamic VMEM loads, ~cycles each, no HBM latency, no XLA
+  gather op. Bigger datasets (SIFT-1M and up) stay **HBM-resident**
+  (``ds_mode="hbm"``): candidate rows are DMA'd in per-query batches,
+  double-buffered so query ``b+1``'s row fetches fly while query ``b``
+  scores — the true analog of the reference's any-size persistent
+  kernel, which streams dataset rows from global memory the same way;
 - the **graph stays in HBM**; only the ``w`` chosen parents' adjacency
   rows are DMA'd per iteration (w·deg·4 B per query — hundreds of bytes,
   latency hidden behind scoring);
@@ -20,8 +25,9 @@ do worst. This kernel instead runs the WHOLE walk in one ``pallas_call``:
   few small MXU contractions per iteration rather than scalar work.
 
 Scope (the wrapper in ``neighbors/cagra`` falls back to the XLA path
-otherwise): L2Expanded/L2SqrtExpanded/InnerProduct, f32/bf16 dataset,
-``dim % 128 == 0``, no sample filter, dataset must fit the VMEM budget.
+otherwise): L2Expanded/L2SqrtExpanded/InnerProduct, f32/bf16/int8
+dataset, ``dim % 128 == 0``, no sample filter. Any dataset size: the
+VMEM budget only decides residency, not validity.
 """
 
 from __future__ import annotations
@@ -46,7 +52,9 @@ _SUPPORTED = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
 def beam_search_fits(n: int, dim: int, itemsize: int,
                      vmem_mb: int = 0) -> bool:
     """Whether (n, dim) fits the VMEM-resident dataset budget (with
-    ~8 MB headroom for the kernel's scratch and queries)."""
+    ~8 MB headroom for the kernel's scratch and queries). Since the
+    HBM-resident mode landed this decides *placement* (``ds_mode``
+    auto), not whether the kernel applies at all."""
     if vmem_mb <= 0:
         vmem_mb = _default_vmem_mb()
     return n * dim * itemsize <= (vmem_mb - 8) * 1024 * 1024
@@ -64,9 +72,9 @@ def pad_graph(graph) -> jax.Array:
 
 
 def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
-                 cand_ref, cand_sm, dist_ref, rows_ref, gsm, sem,
-                 *, L: int, w: int, k: int, C: int, deg: int, Gp: int,
-                 max_iters: int, ip_metric: bool):
+                 cand_ref, cand_sm, dist_ref, rows_ref, gsm, sem, *dsem,
+                 L: int, w: int, k: int, C: int, deg: int, Gp: int,
+                 max_iters: int, ip_metric: bool, ds_vmem: bool):
     B, d = q_ref.shape
     qf = q_ref[:].astype(jnp.float32)                       # (B, d)
     qn = jnp.sum(jnp.square(qf), axis=1, keepdims=True)     # (B, 1)
@@ -77,9 +85,31 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
     prec = (jax.lax.Precision.HIGHEST if ds_ref.dtype == jnp.float32
             else jax.lax.Precision.DEFAULT)
 
+    def score_rows(b, rows):
+        """(C, d) gathered rows -> min-form distances into dist_ref[b]
+        via two small MXU contractions."""
+        ip = jax.lax.dot_general(
+            qf[b:b + 1], rows, (((1,), (1,)), ((), ())),
+            precision=prec,
+            preferred_element_type=jnp.float32)             # (1, C)
+        if ip_metric:
+            dist_ref[pl.ds(b, 1), :] = -ip
+        else:
+            rn = jax.lax.dot_general(
+                jnp.ones((1, d), jnp.float32), rows * rows,
+                (((1,), (1,)), ((), ())),
+                precision=prec,
+                preferred_element_type=jnp.float32)         # (1, C)
+            dist_ref[pl.ds(b, 1), :] = jnp.maximum(
+                rn - 2.0 * ip + qn[b], 0.0)
+
     def score_cand(cand):
-        """(B, C) candidate ids -> (B, C) min-form distances, via a
-        VMEM row-gather + two small MXU contractions per query row."""
+        """(B, C) candidate ids -> (B, C) min-form distances.
+
+        VMEM-resident dataset: dynamic VMEM row loads (cycles each).
+        HBM-resident dataset: per-query DMA batches, double-buffered —
+        query b+1's C row fetches are in flight on the other
+        buffer/semaphore while query b's rows score on the MXU."""
         # ids must be scalars for dynamic addressing: VMEM -> SMEM.
         # Invalid ids (-1) are clamped for the gather only — compiled
         # Mosaic has no OOB clamp; masking happens on the way out.
@@ -87,29 +117,48 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
         cp = pltpu.make_async_copy(cand_ref, cand_sm, sem)
         cp.start()
         cp.wait()
-        for b in range(B):
-            def gather(c, _):
-                rid = cand_sm[b, c]
-                rows_ref[pl.ds(c, 1), :] = ds_ref[pl.ds(rid, 1), :]
-                return 0
-            # Mosaic lowers fori_loop only at unroll=1 or a full
-            # unroll; partial unrolls are rejected at compile time.
-            jax.lax.fori_loop(0, C, gather, 0, unroll=1)
-            rows = rows_ref[:].astype(jnp.float32)          # (C, d)
-            ip = jax.lax.dot_general(
-                qf[b:b + 1], rows, (((1,), (1,)), ((), ())),
-                precision=prec,
-                preferred_element_type=jnp.float32)         # (1, C)
-            if ip_metric:
-                dist_ref[pl.ds(b, 1), :] = -ip
-            else:
-                rn = jax.lax.dot_general(
-                    jnp.ones((1, d), jnp.float32), rows * rows,
-                    (((1,), (1,)), ((), ())),
-                    precision=prec,
-                    preferred_element_type=jnp.float32)     # (1, C)
-                dist_ref[pl.ds(b, 1), :] = jnp.maximum(
-                    rn - 2.0 * ip + qn[b], 0.0)
+        if ds_vmem:
+            for b in range(B):
+                def gather(c, _):
+                    rid = cand_sm[b, c]
+                    rows_ref[pl.ds(c, 1), :] = ds_ref[pl.ds(rid, 1), :]
+                    return 0
+                # Mosaic lowers fori_loop only at unroll=1 or a full
+                # unroll; partial unrolls are rejected at compile time.
+                jax.lax.fori_loop(0, C, gather, 0, unroll=1)
+                score_rows(b, rows_ref[:].astype(jnp.float32))
+        else:
+            dsem_ref = dsem[0]
+
+            def fetch(b, slot):
+                """Start query b's C row DMAs into buffer ``slot``."""
+                def start(c, _):
+                    rid = cand_sm[b, c]
+                    pltpu.make_async_copy(
+                        ds_ref.at[pl.ds(rid, 1), :],
+                        rows_ref.at[slot, pl.ds(c, 1), :],
+                        dsem_ref.at[slot]).start()
+                    return 0
+                jax.lax.fori_loop(0, C, start, 0, unroll=1)
+
+            def drain(slot):
+                """Retire the C row copies targeting ``slot`` with ONE
+                semaphore wait: DMA waits decrement by the descriptor's
+                byte count, and a (C, d) descriptor's bytes equal the
+                sum of the C (1, d) transfers that signalled the sem —
+                C serial scalar-core waits would sit on the hot path."""
+                pltpu.make_async_copy(
+                    ds_ref.at[pl.ds(0, C), :],
+                    rows_ref.at[slot],
+                    dsem_ref.at[slot]).wait()
+
+            fetch(0, 0)
+            for b in range(B):
+                slot = b % 2
+                if b + 1 < B:
+                    fetch(b + 1, (b + 1) % 2)
+                drain(slot)
+                score_rows(b, rows_ref[slot].astype(jnp.float32))
         return jnp.where(cand < 0, jnp.inf, dist_ref[:])
 
     def merge(ids, dvals, expl, cand, cd):
@@ -199,12 +248,13 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "w", "max_iters", "metric", "block_q",
-                     "interpret", "vmem_mb", "deg"))
+                     "interpret", "vmem_mb", "deg", "ds_mode"))
 def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
                 max_iters: int, metric: DistanceType, *,
                 block_q: int = 8, interpret: bool = False,
                 vmem_mb: int = 0,
-                deg: int = 0) -> Tuple[jax.Array, jax.Array]:
+                deg: int = 0,
+                ds_mode: str = "auto") -> Tuple[jax.Array, jax.Array]:
     """One-dispatch graph beam search (see module docstring).
 
     ``seeds`` must be (q, m·w·deg) int32 for integer m ≥ 1 — the seed
@@ -215,7 +265,11 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
     ``deg``: the graph's logical degree, when ``graph`` arrives with
     its rows already padded to a 128 multiple (see ``pad_graph``) —
     callers that search in query tiles pad once instead of per tile.
-    0 means the graph is unpadded and its width is the degree."""
+    0 means the graph is unpadded and its width is the degree.
+
+    ``ds_mode``: ``"vmem"`` pins the dataset VMEM-resident (must fit
+    the budget), ``"hbm"`` streams candidate rows by double-buffered
+    DMA from HBM (any size), ``"auto"`` picks by ``beam_search_fits``."""
     q, d = queries.shape
     n, gw = graph.shape
     deg = deg or gw
@@ -250,17 +304,40 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
     if gw != Gp:
         graph = pad_graph(graph)
 
+    expect(ds_mode in ("auto", "vmem", "hbm"),
+           f"beam_search: ds_mode must be auto/vmem/hbm, got {ds_mode!r}")
+    itemsize = jnp.dtype(ds.dtype).itemsize
+    if ds_mode == "auto":
+        ds_mode = ("vmem" if beam_search_fits(n, ds.shape[1], itemsize,
+                                              vmem_mb) else "hbm")
+    elif ds_mode == "vmem":
+        expect(beam_search_fits(n, ds.shape[1], itemsize, vmem_mb),
+               f"beam_search: dataset ({n}x{ds.shape[1]} {ds.dtype}) "
+               "exceeds the VMEM budget; use ds_mode='hbm' or 'auto'")
+    ds_vmem = ds_mode == "vmem"
+
     kernel = functools.partial(
         _beam_kernel, L=L, w=w, k=k, C=C, deg=deg, Gp=Gp,
         max_iters=max_iters,
-        ip_metric=metric == DistanceType.InnerProduct)
+        ip_metric=metric == DistanceType.InnerProduct,
+        ds_vmem=ds_vmem)
+    # HBM mode: candidate rows land in a (2, C, d) double buffer with a
+    # per-buffer DMA semaphore; VMEM mode gathers into one (C, d) block
+    if ds_vmem:
+        ds_spec = pl.BlockSpec((n, ds.shape[1]), lambda i: (0, 0))
+        rows_scratch = pltpu.VMEM((C, d), ds.dtype)
+        extra_scratch = []
+    else:
+        ds_spec = pl.BlockSpec(memory_space=pl.ANY)
+        rows_scratch = pltpu.VMEM((2, C, d), ds.dtype)
+        extra_scratch = [pltpu.SemaphoreType.DMA((2,))]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
         grid=(qp // B,),
         in_specs=[
             pl.BlockSpec((B, d), lambda i: (i, 0)),                # queries
             pl.BlockSpec((B, seeds.shape[1]), lambda i: (i, 0)),   # seeds
-            pl.BlockSpec((n, ds.shape[1]), lambda i: (0, 0)),      # dataset (VMEM-resident)
+            ds_spec,                                               # dataset
             pl.BlockSpec(memory_space=pl.ANY),                     # graph (HBM)
         ],
         out_specs=[
@@ -271,10 +348,10 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
             pltpu.VMEM((B, C), jnp.int32),      # cand staging
             pltpu.SMEM((B, C), jnp.int32),      # cand scalars
             pltpu.VMEM((B, C), jnp.float32),    # distances
-            pltpu.VMEM((C, d), ds.dtype),       # gathered rows
+            rows_scratch,                       # gathered rows
             pltpu.VMEM((B * w, Gp), jnp.int32),  # graph rows landing
             pltpu.SemaphoreType.DMA,
-        ],
+        ] + extra_scratch,
     )
     outd, outi = pl.pallas_call(
         kernel,

@@ -189,7 +189,16 @@ def piece_cagra():
     ci = cagra.load(None, path, dataset=jnp.asarray(x))
     ci16 = cagra.CagraIndex(dataset=ci.dataset.astype(jnp.bfloat16),
                             graph=ci.graph, metric=ci.metric)
-    legs = [("xla_f32", ci, "xla"), ("pallas_bf16", ci16, "pallas"),
+    # pallas legs: ds_mode auto picks placement from beam_search_fits,
+    # so the leg labels compute the SAME decision — at PROFILE_N=200k
+    # the f32 dataset (102 MB) streams from HBM and bf16 (51 MB) sits
+    # in VMEM, but a RAFT_TPU_PROFILE_N rehearsal or a different VMEM
+    # budget must not mislabel the engine measured
+    from raft_tpu.ops.beam_search import beam_search_fits
+    m32 = "vmem" if beam_search_fits(PROFILE_N, 128, 4) else "hbm"
+    m16 = "vmem" if beam_search_fits(PROFILE_N, 128, 2) else "hbm"
+    legs = [("xla_f32", ci, "xla"), (f"pallas_{m32}_f32", ci, "pallas"),
+            (f"pallas_{m16}_bf16", ci16, "pallas"),
             ("xla_bf16", ci16, "xla")]
 
     def search_leg(name, idx, algo, it, qs, gts):
@@ -223,6 +232,14 @@ def piece_cagra():
                 jnp.asarray(q), ci16.dataset, pg, seeds, 10, 64, 4, 40,
                 ci.metric, block_q=bq, deg=deg), iters=10)
             emit(f"beam_blockq{bq}", ms=round(dt * 1e3, 2),
+                 qps=round(100 / dt, 1))
+        # HBM-resident engine (double-buffered candidate-row DMA) on
+        # the same bf16 dataset: vmem-vs-hbm cost of the any-size path
+        for bq in (8, 16):
+            dt = wall(lambda bq=bq: beam_search(
+                jnp.asarray(q), ci16.dataset, pg, seeds, 10, 64, 4, 40,
+                ci.metric, block_q=bq, deg=deg, ds_mode="hbm"), iters=10)
+            emit(f"beam_hbm_blockq{bq}", ms=round(dt * 1e3, 2),
                  qps=round(100 / dt, 1))
     except Exception as e:  # noqa: BLE001
         emit("beam_blockq", error=str(e)[:200])

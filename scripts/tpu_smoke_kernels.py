@@ -109,6 +109,35 @@ def main():
         emit("beam_search", id_agreement_vs_xla=agree,
              max_d_err=float(np.nanmax(np.abs(
                  np.asarray(bd) - np.asarray(xd2)))))
+        # HBM-resident mode (the any-size engine: candidate rows DMA'd
+        # from HBM, double-buffered) — must match the VMEM engine's ids
+        try:
+            hd, hi = beam_search(qd, xd[:4000], graph, seeds, 10, L, w,
+                                 24, DistanceType.L2Expanded,
+                                 ds_mode="hbm")
+            emit("beam_search_hbm",
+                 id_agreement_vs_vmem=float(
+                     (np.asarray(hi) == np.asarray(bi)).mean()),
+                 max_d_err=float(np.nanmax(np.abs(
+                     np.asarray(hd) - np.asarray(bd)))))
+        except Exception as e:  # noqa: BLE001
+            emit("beam_search_hbm", error=str(e)[:300])
+        # int8 (CAGRA-Q role): the (1, d) int8 HBM row DMA has its own
+        # Mosaic tiling; prove it on real silicon, vmem vs hbm parity
+        try:
+            x8 = jnp.asarray(np.clip(x[:4000] * 30.0, -127, 127)
+                             .astype(np.int8))
+            vd8, vi8 = beam_search(qd, x8, graph, seeds, 10, L, w, 24,
+                                   DistanceType.L2Expanded,
+                                   ds_mode="vmem")
+            hd8, hi8 = beam_search(qd, x8, graph, seeds, 10, L, w, 24,
+                                   DistanceType.L2Expanded,
+                                   ds_mode="hbm")
+            emit("beam_search_hbm_int8",
+                 id_agreement_vs_vmem=float(
+                     (np.asarray(hi8) == np.asarray(vi8)).mean()))
+        except Exception as e:  # noqa: BLE001
+            emit("beam_search_hbm_int8", error=str(e)[:300])
     except Exception as e:  # noqa: BLE001
         emit("beam_search", error=str(e)[:300])
 

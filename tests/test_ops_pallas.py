@@ -205,6 +205,54 @@ class TestBeamSearchEdges:
         ii = np.asarray(i)
         assert ii.min() >= 0 and ii.max() < len(x)
 
+    def test_hbm_mode_matches_vmem(self, rng_np):
+        """ds_mode='hbm' (double-buffered candidate-row DMA from an
+        HBM-resident dataset — the any-size engine) must be
+        bit-identical to the VMEM-resident gather path on the same
+        inputs, for f32 and bf16 datasets."""
+        import jax.numpy as jnp
+
+        from raft_tpu.distance.types import DistanceType
+        from raft_tpu.ops.beam_search import beam_search
+
+        x, graph = self._setup(rng_np)
+        q = rng_np.standard_normal((8, 128)).astype(np.float32)
+        seeds = rng_np.integers(0, len(x), (8, 4 * 8)).astype(np.int32)
+        x8 = np.clip(x * 30.0, -127, 127).astype(np.int8)  # CAGRA-Q role
+        for ds in (jnp.asarray(x), jnp.asarray(x).astype(jnp.bfloat16),
+                   jnp.asarray(x8)):
+            dv, iv = beam_search(jnp.asarray(q), ds,
+                                 jnp.asarray(graph), jnp.asarray(seeds),
+                                 5, 16, 4, 10, DistanceType.L2Expanded,
+                                 interpret=True, ds_mode="vmem")
+            dh, ih = beam_search(jnp.asarray(q), ds,
+                                 jnp.asarray(graph), jnp.asarray(seeds),
+                                 5, 16, 4, 10, DistanceType.L2Expanded,
+                                 interpret=True, ds_mode="hbm")
+            np.testing.assert_array_equal(np.asarray(iv), np.asarray(ih))
+            # distances: allclose, not bit-equal — the two lowerings
+            # (2-D scratch read vs 3-D double-buffer slice) may fuse /
+            # reassociate the f32 dot reduction differently (1 ulp)
+            np.testing.assert_allclose(np.asarray(dv), np.asarray(dh),
+                                       rtol=1e-5, atol=1e-4)
+
+    def test_vmem_mode_rejects_oversized_dataset(self, rng_np):
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        from raft_tpu.core.validation import RaftError
+        from raft_tpu.distance.types import DistanceType
+        from raft_tpu.ops.beam_search import beam_search
+
+        x, graph = self._setup(rng_np, n=300, deg=4)
+        q = rng_np.standard_normal((4, 128)).astype(np.float32)
+        seeds = rng_np.integers(0, 300, (4, 16)).astype(np.int32)
+        with _pytest.raises(RaftError, match="VMEM budget"):
+            beam_search(jnp.asarray(q), jnp.asarray(x),
+                        jnp.asarray(graph), jnp.asarray(seeds),
+                        5, 16, 4, 5, DistanceType.L2Expanded,
+                        interpret=True, ds_mode="vmem", vmem_mb=8)
+
     def test_bad_args_rejected(self, rng_np):
         import jax.numpy as jnp
         import pytest as _pytest

@@ -198,6 +198,36 @@ def _cagra_save(bundle, path):
     cagra.save(bundle["index"], path, include_dataset=True)
 
 
+def _hnswlib_build(base, metric, *, M=16, ef_construction=200, **params):
+    from raft_tpu.bench import hnsw_cpu
+
+    if params:
+        raise ValueError(f"hnswlib build takes M/ef_construction, "
+                         f"got {params}")
+    return hnsw_cpu.build(base, metric, M=M,
+                          ef_construction=ef_construction)
+
+
+def _hnswlib_search(index, queries, k, *, ef=64, **params):
+    from raft_tpu.bench import hnsw_cpu
+
+    if params:
+        raise ValueError(f"hnswlib search takes ef, got {params}")
+    return hnsw_cpu.search(index, np.asarray(queries), k, ef=ef)
+
+
+def _hnswlib_save(index, path):
+    from raft_tpu.bench import hnsw_cpu
+
+    hnsw_cpu.save(index, path)
+
+
+def _hnswlib_load(path, base, metric, **params):
+    from raft_tpu.bench import hnsw_cpu
+
+    return hnsw_cpu.load(path, base.shape[1], metric)
+
+
 ALGO_REGISTRY: Dict[str, AlgoWrapper] = {
     "raft_brute_force": AlgoWrapper("raft_brute_force",
                                     _brute_force_build, _brute_force_search),
@@ -215,6 +245,11 @@ ALGO_REGISTRY: Dict[str, AlgoWrapper] = {
                               _bundle_load("raft_tpu.neighbors.cagra")),
     "raft_quantized": AlgoWrapper("raft_quantized",
                                   _quantized_build, _quantized_search),
+    # the comparison baseline (the reference's hnswlib competitor role,
+    # cpp/bench/ann/src/hnswlib/hnswlib_wrapper.h) — native C++ HNSW
+    # on the host CPU, not a TPU algorithm
+    "hnswlib": AlgoWrapper("hnswlib", _hnswlib_build, _hnswlib_search,
+                           _hnswlib_save, _hnswlib_load),
 }
 
 
@@ -267,6 +302,8 @@ _BUILD_KEY_MAP = {
     "graph_degree": "graph_degree",
     "intermediate_graph_degree": "intermediate_graph_degree",
     "graph_build_algo": "build_algo",   # reference conf spelling
+    "M": "M",                           # hnswlib spellings
+    "efConstruction": "ef_construction",
 }
 _SEARCH_KEY_MAP = {
     "nprobe": "n_probes",
@@ -276,6 +313,7 @@ _SEARCH_KEY_MAP = {
     "search_width": "search_width",
     "max_iterations": "max_iterations",
     "refine_ratio": "refine_ratio",
+    "ef": "ef",                         # hnswlib spelling
 }
 _ALGO_ALIASES = {"raft_bfknn": "raft_brute_force"}
 
@@ -283,9 +321,10 @@ _ALGO_ALIASES = {"raft_bfknn": "raft_brute_force"}
 def normalize_config(config: Dict[str, Any]) -> Dict[str, Any]:
     """Accept the reference's ``conf/*.json`` schema (an ``index`` list
     with ``build_param``/``search_params``, ``run/conf/`` files) as well
-    as the native ``algos`` schema; translate raft param spellings
-    (nlist/nprobe/itopk/ratio/…) and drop non-raft competitor entries
-    (hnswlib/faiss/ggnn wrappers benchmark OTHER libraries)."""
+    as the native ``algos`` schema; translate raft and hnswlib param
+    spellings (nlist/nprobe/itopk/ratio/M/efConstruction/ef/…) and drop
+    competitor entries with no wrapper here (faiss/ggnn benchmark OTHER
+    libraries; hnswlib maps onto the native C++ baseline)."""
     if "algos" in config:
         return config
     if "index" not in config:
@@ -441,6 +480,19 @@ def run_benchmark(
                        not in done]
             if not pending:
                 continue  # every search combo finished in a prior run
+            from raft_tpu.core import interruptible
+
+            interruptible.yield_()  # cancellation point per algo entry
+            if algo.name == "hnswlib":
+                # the CPU baseline needs the native toolchain; a host
+                # without it (bare wheel install) must lose the
+                # comparison series, not the whole sweep
+                from raft_tpu.bench import hnsw_cpu
+
+                if not hnsw_cpu.available():
+                    _log_warn("skipping hnswlib: native HNSW library "
+                              "unavailable (no C++ toolchain?)")
+                    continue
             cache = None
             if algo.save is not None and algo.load is not None:
                 key = _index_cache_key(
@@ -482,6 +534,7 @@ def run_benchmark(
                               "continuing without cache", cache.name, e)
 
             for search_params in pending:
+                interruptible.yield_()  # cancellation point per combo
                 # warm (compile) every batch shape, including a ragged
                 # final batch, so no compile lands in the timed loop
                 _block(algo.search(index, queries[:batch_size], k,

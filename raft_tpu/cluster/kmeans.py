@@ -162,7 +162,18 @@ def fit(
     init_centroids=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Train k-means; returns (centroids, inertia, n_iter)
-    (``kmeans::fit``, ``cluster/kmeans.cuh:88``)."""
+    (``kmeans::fit``, ``cluster/kmeans.cuh:88``).
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.cluster import kmeans
+    >>> x = np.asarray([[0.0], [0.1], [10.0], [10.1]], np.float32)
+    >>> c, inertia, n_iter = kmeans.fit(
+    ...     None, kmeans.KMeansParams(n_clusters=2, seed=0), x)
+    >>> sorted(round(float(v)) for v in np.asarray(c).ravel())
+    [0, 10]
+    """
     res = ensure_resources(res)
     x = jnp.asarray(x, jnp.float32)
     expect(x.ndim == 2, "x must be (n_samples, n_features)")

@@ -294,6 +294,15 @@ def pairwise_distance(
     Returns:
       float32 (m, n) distances. For ``InnerProduct`` larger means closer
       (``is_min_close``); everything else is a proper distance.
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.distance import pairwise_distance
+    >>> x = np.zeros((2, 3), np.float32)
+    >>> y = np.ones((1, 3), np.float32)
+    >>> np.asarray(pairwise_distance(None, x, y)).ravel().tolist()
+    [3.0, 3.0]
     """
     res = ensure_resources(res)
     x = jnp.asarray(x)

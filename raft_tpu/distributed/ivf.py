@@ -39,7 +39,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from raft_tpu.comms.comms import Comms, allgather
-from raft_tpu.core import tracing
+from raft_tpu.core import interruptible, tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
@@ -337,30 +337,25 @@ def build_streaming(
     n, d = source.n_rows, source.dim
 
     with tracing.range("raft_tpu.distributed.ivf_flat.build_streaming"):
-        # quantizer on a strided sample (host-side, small)
-        train_rows = max(n_lists, min(train_rows, n))
-        stride = max(1, n // train_rows)
-        parts = []
-        for first, chunk in source.iter_chunks(chunk_rows):
-            offset = (-first) % stride
-            parts.append(np.asarray(chunk[offset::stride], np.float32))
-        trainset = np.concatenate(parts)[:train_rows]
-        quant = ivf_flat_mod.build(res, params, trainset)
-
-        # labels + sizes per chunk
-        from raft_tpu.cluster import kmeans_balanced
+        # quantizer on a strided sample + per-chunk labels: the SAME
+        # passes as the single-chip streaming builds — shared helpers,
+        # not a re-implementation (each chunk a cancellation point)
         from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
+        from raft_tpu.neighbors._streaming import (
+            label_pass,
+            sample_trainset,
+        )
+
+        train_rows = max(n_lists, min(train_rows, n))
+        trainset = sample_trainset(source, train_rows, chunk_rows)
+        quant = ivf_flat_mod.build(res, params, trainset)
 
         km = KMeansBalancedParams(
             metric=(DistanceType.InnerProduct
                     if params.metric == DistanceType.InnerProduct
                     else DistanceType.L2Expanded))
-        labels_np = np.empty((n,), np.int32)
-        for first, chunk in source.iter_chunks(chunk_rows):
-            lab = kmeans_balanced.predict(
-                res, km, quant.centers, jnp.asarray(chunk, jnp.float32))
-            labels_np[first : first + chunk.shape[0]] = np.asarray(lab)
-        sizes_np = np.bincount(labels_np, minlength=n_lists)
+        labels_np, sizes_np = label_pass(res, km, quant.centers, source,
+                                         chunk_rows, n_lists)
         max_size = padded_extent(sizes_np)
 
         # deal lists round-robin by population; dealt[i] = original list
@@ -382,6 +377,7 @@ def build_streaming(
 
         fill = np.zeros((n_lists,), np.int64)
         for first, chunk in source.iter_chunks(chunk_rows):
+            interruptible.yield_()  # cancellation point per chunk
             m = chunk.shape[0]
             lab = labels_np[first : first + m]
             corder = np.argsort(lab, kind="stable")

@@ -114,6 +114,15 @@ def select_k(
 
     Returns:
       (values (batch, k), indices (batch, k) int32)
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.matrix import select_k
+    >>> v = np.asarray([[4.0, 1.0, 3.0, 2.0]], np.float32)
+    >>> vals, idx = select_k(None, v, 2)
+    >>> np.asarray(idx).ravel().tolist()
+    [1, 3]
     """
     ensure_resources(res)
     values = jnp.asarray(values)

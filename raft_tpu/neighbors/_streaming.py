@@ -10,16 +10,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.core import interruptible
 
 
 def sample_trainset(source, train_rows: int, chunk_rows: int) -> np.ndarray:
     """Pass 1: a strided ``train_rows``-row sample spanning the whole
     dataset, assembled chunk by chunk (the stride keeps phase across
-    chunk boundaries)."""
+    chunk boundaries). Each chunk is a cancellation point
+    (``interruptible.yield_``, ``core/interruptible.hpp:83`` role)."""
     n = source.n_rows
     stride = max(1, n // train_rows)
     parts = []
     for first, chunk in source.iter_chunks(chunk_rows):
+        interruptible.yield_()
         offset = (-first) % stride
         parts.append(np.asarray(chunk[offset::stride], np.float32))
     return np.concatenate(parts)[:train_rows]
@@ -28,10 +31,12 @@ def sample_trainset(source, train_rows: int, chunk_rows: int) -> np.ndarray:
 def label_pass(res, km_params, centers, source, chunk_rows: int,
                n_lists: int):
     """Pass 2: per-chunk nearest-center labels (device) + per-list
-    population counts (host). Returns ``(labels_np, sizes_np)``."""
+    population counts (host). Returns ``(labels_np, sizes_np)``.
+    Each chunk is a cancellation point."""
     n = source.n_rows
     labels_np = np.empty((n,), np.int32)
     for first, chunk in source.iter_chunks(chunk_rows):
+        interruptible.yield_()
         lab = kmeans_balanced.predict(
             res, km_params, centers, jnp.asarray(chunk, jnp.float32))
         labels_np[first : first + chunk.shape[0]] = np.asarray(lab)

@@ -231,7 +231,17 @@ def knn(
     metric: DistanceType = DistanceType.L2Expanded,
     metric_arg: float = 2.0,
 ) -> Tuple[jax.Array, jax.Array]:
-    """One-shot convenience matching ``brute_force::knn``."""
+    """One-shot convenience matching ``brute_force::knn``.
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.neighbors import brute_force
+    >>> x = np.eye(4, dtype=np.float32)
+    >>> d, i = brute_force.knn(None, x, x[:2], 1)
+    >>> np.asarray(i).ravel().tolist()
+    [0, 1]
+    """
     index = build(res, dataset, metric, metric_arg)
     return search(res, index, queries, k)
 

@@ -341,7 +341,22 @@ def build(
     params: CagraIndexParams,
     dataset,
 ) -> CagraIndex:
-    """knn-graph + optimize — ``cagra::build`` (``cagra.cuh:296-331``)."""
+    """knn-graph + optimize — ``cagra::build`` (``cagra.cuh:296-331``).
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.neighbors import cagra
+    >>> x = np.random.default_rng(0).standard_normal(
+    ...     (128, 16)).astype(np.float32)
+    >>> idx = cagra.build(None, cagra.CagraIndexParams(
+    ...     graph_degree=8, intermediate_graph_degree=16,
+    ...     build_algo=cagra.BuildAlgo.NN_DESCENT), x)
+    >>> _, i = cagra.search(None, cagra.CagraSearchParams(itopk_size=16),
+    ...                     idx, x[:4], 1)
+    >>> np.asarray(i).ravel().tolist()   # each point is its own NN
+    [0, 1, 2, 3]
+    """
     res = ensure_resources(res)
     dataset = jnp.asarray(dataset)
     expect(dataset.ndim == 2, "dataset must be (n, d)")

@@ -38,7 +38,7 @@ import numpy as np
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
-from raft_tpu.core import tracing
+from raft_tpu.core import interruptible, tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.serialize import (
     check_version,
@@ -298,6 +298,7 @@ def build_streaming(
         idx_buf = jnp.full((params.n_lists, max_size), -1, jnp.int32)
         fill = np.zeros((params.n_lists,), np.int64)
         for first, chunk in source.iter_chunks(chunk_rows):
+            interruptible.yield_()  # cancellation point per chunk
             m = chunk.shape[0]
             lab = labels_np[first : first + m]
             ranks = streaming_ranks(lab, fill, params.n_lists)

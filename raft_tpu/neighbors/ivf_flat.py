@@ -34,7 +34,7 @@ import numpy as np
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
-from raft_tpu.core import tracing
+from raft_tpu.core import interruptible, tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.serialize import (
     check_version,
@@ -148,7 +148,20 @@ def build(
     dataset,
 ) -> IvfFlatIndex:
     """Train the coarse quantizer and (optionally) fill the lists —
-    ``ivf_flat::build`` (``detail/ivf_flat_build.cuh:301``)."""
+    ``ivf_flat::build`` (``detail/ivf_flat_build.cuh:301``).
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.neighbors import ivf_flat
+    >>> x = np.arange(32, dtype=np.float32).reshape(16, 2)
+    >>> idx = ivf_flat.build(
+    ...     None, ivf_flat.IvfFlatIndexParams(n_lists=2), x)
+    >>> _, i = ivf_flat.search(
+    ...     None, ivf_flat.IvfFlatSearchParams(n_probes=2), idx, x[:1], 1)
+    >>> int(np.asarray(i)[0, 0])
+    0
+    """
     res = ensure_resources(res)
     dataset = jnp.asarray(dataset)
     expect(dataset.ndim == 2, "dataset must be (n, d)")
@@ -333,6 +346,7 @@ def build_streaming(
         indices = jnp.full((params.n_lists, max_size), -1, jnp.int32)
         fill = np.zeros((params.n_lists,), np.int64)
         for first, chunk in source.iter_chunks(chunk_rows):
+            interruptible.yield_()  # cancellation point per chunk
             m = chunk.shape[0]
             lab = labels_np[first : first + m]
             ranks = streaming_ranks(lab, fill, params.n_lists)

@@ -41,7 +41,7 @@ import numpy as np
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
-from raft_tpu.core import tracing
+from raft_tpu.core import interruptible, tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.serialize import (
     check_version,
@@ -305,7 +305,19 @@ def build(
     dataset,
 ) -> IvfPqIndex:
     """Train coarse centers, rotation, codebooks; encode the dataset —
-    ``ivf_pq::build`` (``detail/ivf_pq_build.cuh:1513-1723``)."""
+    ``ivf_pq::build`` (``detail/ivf_pq_build.cuh:1513-1723``).
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from raft_tpu.neighbors import ivf_pq
+    >>> x = np.random.default_rng(1).standard_normal(
+    ...     (256, 8)).astype(np.float32)
+    >>> idx = ivf_pq.build(
+    ...     None, ivf_pq.IvfPqIndexParams(n_lists=4, pq_dim=4), x)
+    >>> (idx.n_lists, idx.pq_dim, idx.size)
+    (4, 4, 256)
+    """
     res = ensure_resources(res)
     dataset = jnp.asarray(dataset)
     expect(dataset.ndim == 2, "dataset must be (n, d)")
@@ -442,6 +454,7 @@ def build_streaming(
         idx_buf = jnp.full((params.n_lists, max_size), -1, jnp.int32)
         fill = np.zeros((params.n_lists,), np.int64)
         for first, chunk in source.iter_chunks(chunk_rows):
+            interruptible.yield_()  # cancellation point per chunk
             m = chunk.shape[0]
             lab = labels_np[first : first + m]
             ranks = streaming_ranks(lab, fill, params.n_lists)

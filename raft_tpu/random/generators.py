@@ -25,7 +25,16 @@ def make_blobs(
     dtype=jnp.float32,
 ):
     """Isotropic Gaussian blobs (``random::make_blobs``). Returns (X, labels,
-    centers)."""
+    centers).
+
+    Examples
+    --------
+    >>> from raft_tpu import random as rrandom
+    >>> X, labels, centers = rrandom.make_blobs(
+    ...     rrandom.RngState(0), 30, 4, n_clusters=3)
+    >>> (X.shape, labels.shape, centers.shape)
+    ((30, 4), (30,), (3, 4))
+    """
     key = _key_of(rng)
     k_centers, k_labels, k_noise, k_shuffle = jax.random.split(key, 4)
     if centers is None:

@@ -333,6 +333,22 @@ def piece_ivf():
         except Exception as e:  # noqa: BLE001
             emit(f"ivf_pq_lut_{dt_name}", error=str(e)[:160])
 
+    # score-mode A/B on hardware (VERDICT r3 next #3: prove the XLA
+    # scoring path adequate or justify a Pallas probe-scan kernel).
+    # The prebuilt b4 index has J=16 books, so all three modes apply;
+    # the b4 'onehot' leg above doubles as this A/B's onehot point at
+    # 32 probes — these add select (the J<=32 VPU path) and gather
+    # (the scalar-core baseline the auto mode avoids on TPU).
+    for mode in ("select", "gather"):
+        sp = ivf_pq.IvfPqSearchParams(n_probes=32, score_mode=mode)
+        try:
+            t = wall(lambda sp=sp: ivf_pq.search(None, sp, pi, q, 10),
+                     iters=10)
+            emit(f"ivf_pq_score_{mode}", ms=round(t * 1e3, 2),
+                 qps=round(100 / t, 1))
+        except Exception as e:  # noqa: BLE001
+            emit(f"ivf_pq_score_{mode}", error=str(e)[:160])
+
 
 def piece_bq():
     from raft_tpu.neighbors import ivf_bq

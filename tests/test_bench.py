@@ -303,3 +303,65 @@ class TestCagraBundleRefine:
         ref = np.sum((q[:, None] - x[np.asarray(i)]) ** 2, axis=2)
         np.testing.assert_allclose(np.asarray(d), ref, rtol=1e-4,
                                    atol=1e-3)
+
+
+class TestHnswCpuBaseline:
+    """The native C++ HNSW competitor wrapper (the reference's hnswlib
+    comparison role, ``cpp/bench/ann/src/hnswlib/hnswlib_wrapper.h``)."""
+
+    def test_build_search_recall(self, dataset_dir, tmp_path):
+        pytest.importorskip("ctypes")
+        from raft_tpu.bench import hnsw_cpu
+
+        if not hnsw_cpu.available():
+            pytest.skip("native HNSW library could not be built")
+        config = {
+            "algos": [{
+                "name": "hnswlib",
+                "build": {"M": 8, "ef_construction": 100},
+                "search": [{"ef": 10}, {"ef": 100}],
+            }]
+        }
+        rows = run_benchmark(dataset_dir, config, tmp_path / "res",
+                             k=10, search_iters=1)
+        assert len(rows) == 2
+        assert all(r["algo"] == "hnswlib" for r in rows)
+        # higher ef -> higher recall; ef=100 on a 3000-row set is ~exact
+        assert rows[1]["recall"] >= rows[0]["recall"]
+        assert rows[1]["recall"] > 0.9
+        assert rows[1]["qps"] > 0
+
+    def test_index_cache_round_trip(self, dataset_dir, tmp_path):
+        from raft_tpu.bench import hnsw_cpu
+
+        if not hnsw_cpu.available():
+            pytest.skip("native HNSW library could not be built")
+        config = {
+            "algos": [{
+                "name": "hnswlib",
+                "build": {"M": 8, "ef_construction": 100},
+                "search": [{"ef": 50}],
+            }]
+        }
+        r1 = run_benchmark(dataset_dir, config, tmp_path / "res",
+                           k=10, search_iters=1)
+        assert not r1[0]["build_cached"]
+        r2 = run_benchmark(dataset_dir, config, tmp_path / "res",
+                           k=10, search_iters=1)
+        assert r2[0]["build_cached"]
+        assert abs(r2[0]["recall"] - r1[0]["recall"]) < 1e-6
+
+    def test_reference_schema_spellings(self):
+        from raft_tpu.bench.runner import normalize_config
+
+        cfg = normalize_config({
+            "index": [{
+                "algo": "hnswlib",
+                "build_param": {"M": 12, "efConstruction": 150},
+                "search_params": [{"ef": 20}],
+            }]
+        })
+        assert cfg["algos"][0]["name"] == "hnswlib"
+        assert cfg["algos"][0]["build"] == {"M": 12,
+                                            "ef_construction": 150}
+        assert cfg["algos"][0]["search"] == [{"ef": 20}]

@@ -26,6 +26,21 @@ echo "round4_all start $(date)" | tee -a "$LOG"
 
 . "$SCRIPT_DIR/relay_lib.sh"
 
+# Single-core host: pause any CPU-heavy background benchmark for the
+# duration of the hardware window — it would otherwise contend with TPU
+# backend init/compile on the one core. Match ONLY the hnswlib family
+# (CPU-only by construction): a bare "bench run" pattern could catch an
+# abandoned in-flight TPU process, and SIGSTOPping one of those is the
+# mid-transaction freeze the relay rules forbid. Resumed by the traps.
+PAUSED_PIDS=$(pgrep -f "raft_tpu.bench run.*--algos hnswlib" || true)
+if [ -n "$PAUSED_PIDS" ]; then
+  echo "pausing background bench pids: $PAUSED_PIDS" | tee -a "$LOG"
+  kill -STOP $PAUSED_PIDS 2>/dev/null
+fi
+resume_paused() {
+  [ -n "$PAUSED_PIDS" ] && kill -CONT $PAUSED_PIDS 2>/dev/null
+}
+
 # Archive whatever evidence landed — runs on EVERY exit (a relay death
 # mid-chain aborts with exit 2; the captured pieces must still be
 # summarized and committed, or a later workspace reset loses them).
@@ -64,8 +79,14 @@ EOF
   git diff --cached --quiet -- ci/ RESULTS_r4.md 2>/dev/null || \
     git commit -q -m "Round-4 hardware evidence (auto-archived by tpu_round4_all.sh)" \
       -- ci/ RESULTS_r4.md
+  resume_paused
 }
 trap archive_evidence EXIT
+# EXIT traps don't run on untrapped fatal signals — without these a
+# SIGTERM/HUP (session drop) would leave the background bench frozen
+trap 'exit 129' HUP
+trap 'exit 130' INT
+trap 'exit 143' TERM
 
 step() {  # step <name> <cmd...>
   local name=$1; shift

@@ -365,3 +365,21 @@ class TestHnswCpuBaseline:
         assert cfg["algos"][0]["build"] == {"M": 12,
                                             "ef_construction": 150}
         assert cfg["algos"][0]["search"] == [{"ef": 20}]
+
+    def test_sweep_survives_missing_toolchain(self, dataset_dir, tmp_path,
+                                              monkeypatch):
+        """A host without g++ must lose the hnswlib comparison series,
+        not the whole sweep (the raft algos still run)."""
+        from raft_tpu.bench import hnsw_cpu
+
+        monkeypatch.setattr(hnsw_cpu, "available", lambda: False)
+        config = {
+            "algos": [
+                {"name": "raft_brute_force", "search": [{}]},
+                {"name": "hnswlib", "build": {"M": 8},
+                 "search": [{"ef": 10}]},
+            ]
+        }
+        rows = run_benchmark(dataset_dir, config, tmp_path / "res",
+                             k=10, search_iters=1)
+        assert [r["algo"] for r in rows] == ["raft_brute_force"]

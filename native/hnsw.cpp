@@ -307,6 +307,17 @@ int64_t hnsw_size(void* ptr) {
   return ptr ? static_cast<Hnsw*>(ptr)->n : -1;
 }
 
+// accessors so a loader can cross-check a cache file's recorded
+// geometry/metric against what the caller expects — a mismatched file
+// would otherwise stride queries by the WRONG dim at search time
+int64_t hnsw_dim(void* ptr) {
+  return ptr ? static_cast<Hnsw*>(ptr)->dim : -1;
+}
+
+int hnsw_metric(void* ptr) {
+  return ptr ? static_cast<Hnsw*>(ptr)->metric : -1;
+}
+
 int hnsw_search(void* ptr, const float* queries, int64_t nq, int64_t k,
                 int64_t ef, float* out_d, int64_t* out_i) {
   if (!ptr || !queries || nq < 0 || k < 1) {
@@ -365,6 +376,18 @@ void* hnsw_load(const char* path) try {
   ok = ok && rd_vec(f, h->vecs) && rd_vec(f, h->levels) &&
        h->vecs.size() == size_t(h->n) * size_t(h->dim) &&
        h->levels.size() == size_t(h->n);
+  // max_level must be consistent with levels[]: greedy()/neighbors()
+  // index upper[entry][max_level-1], so a corrupt max_level above the
+  // entry's actual level list is an out-of-bounds read at SEARCH time —
+  // reject it here like every other corruption
+  // an empty index is always saved with max_level == -1; for n > 0 the
+  // levels[] cross-check below pins max_level (>= 0) exactly
+  ok = ok && (h->n > 0 || h->max_level == -1);
+  if (ok && h->n > 0) {
+    ok = h->entry >= 0 && h->levels[size_t(h->entry)] == h->max_level;
+    for (int64_t i = 0; ok && i < h->n; ++i)
+      ok = h->levels[size_t(i)] >= 0 && h->levels[size_t(i)] <= h->max_level;
+  }
   if (ok) {
     h->M0 = 2 * h->M;
     h->mult = 1.0 / std::log(double(h->M));

@@ -36,24 +36,44 @@ _METRIC_CODES = {
 }
 
 
+def _so_stale() -> bool:
+    """Missing, or older than the sources that produce it — a stale
+    library lacks newer symbols (hnsw_dim/…). Decided by mtime BEFORE
+    dlopen: rebuilding after a dlopen would truncate a mapped file."""
+    if not _SO_PATH.exists():
+        return True
+    so_m = _SO_PATH.stat().st_mtime
+    return any(src.exists() and src.stat().st_mtime > so_m
+               for src in (_NATIVE_DIR / "hnsw.cpp",
+                           _NATIVE_DIR / "Makefile"))
+
+
 def _load():
     global _lib, _build_attempted
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not _SO_PATH.exists() and not _build_attempted:
+        if _so_stale() and not _build_attempted:
             _build_attempted = True
             try:
                 subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True,
                                capture_output=True, timeout=300)
             except (OSError, subprocess.SubprocessError):
-                return None
+                pass  # an existing (possibly stale) .so may still do
         if not _SO_PATH.exists():
             return None
         try:
             lib = ctypes.CDLL(str(_SO_PATH))
         except OSError:
             return None
+        # a stale prebuilt .so (toolchain missing, make failed) must
+        # degrade to available() == False, not AttributeError out of
+        # every caller that relies on it to skip the baseline
+        for sym in ("hnsw_create", "hnsw_add", "hnsw_size", "hnsw_dim",
+                    "hnsw_metric", "hnsw_search", "hnsw_save",
+                    "hnsw_load", "hnsw_free", "hnsw_last_error"):
+            if not hasattr(lib, sym):
+                return None
         lib.hnsw_create.restype = ctypes.c_void_p
         lib.hnsw_create.argtypes = [ctypes.c_int64, ctypes.c_int64,
                                     ctypes.c_int64, ctypes.c_int,
@@ -63,6 +83,10 @@ def _load():
                                  ctypes.c_int64]
         lib.hnsw_size.restype = ctypes.c_int64
         lib.hnsw_size.argtypes = [ctypes.c_void_p]
+        lib.hnsw_dim.restype = ctypes.c_int64
+        lib.hnsw_dim.argtypes = [ctypes.c_void_p]
+        lib.hnsw_metric.restype = ctypes.c_int
+        lib.hnsw_metric.argtypes = [ctypes.c_void_p]
         lib.hnsw_search.restype = ctypes.c_int
         lib.hnsw_search.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                     ctypes.c_int64, ctypes.c_int64,
@@ -164,4 +188,19 @@ def load(path: str, dim: int, metric: DistanceType) -> HnswCpuIndex:
     h = lib.hnsw_load(str(path).encode())
     if not h:
         raise RuntimeError(f"hnsw_load failed: {_err(lib)}")
+    # cross-check the file's recorded geometry/metric against the
+    # caller's: search() validates queries against the caller-supplied
+    # dim while the native side strides by the FILE's dim, so accepting
+    # a mismatched cache (stale, hand-placed, name collision) would read
+    # past the query buffer or score under the wrong metric
+    stored_dim = lib.hnsw_dim(h)
+    stored_metric = lib.hnsw_metric(h)
+    want_metric = _METRIC_CODES.get(metric)
+    if stored_dim != dim or stored_metric != want_metric:
+        lib.hnsw_free(h)
+        raise RuntimeError(
+            f"hnsw_load: cache {path} holds dim={stored_dim} "
+            f"metric_code={stored_metric}, caller expects dim={dim} "
+            f"metric_code={want_metric} ({metric.name}) — stale or "
+            f"mismatched cache file")
     return HnswCpuIndex(h, dim, metric)

@@ -431,32 +431,47 @@ def run_benchmark(
     import jax
 
     backend = jax.default_backend()
+
+    def _same_sweep(row):
+        """Row belongs to this sweep's identity (dataset, depth, k,
+        batch, iters) — the shared predicate for both the resume
+        done-guard and the legacy-row cleanup.  .get defaults: rows
+        written before the search_iters / max_base_rows fields existed
+        carry the values those defaults had (3 / 0) — without this,
+        resuming over a legacy results.jsonl re-measures every
+        combination and the export doubles up (ADVICE r3)."""
+        return (row.get("dataset") == dataset_dir.name
+                and row.get("max_base_rows", 0) == int(max_base_rows)
+                and row.get("k") == k
+                and row.get("batch_size") == batch_size
+                and row.get("search_iters", 3) == search_iters)
+
+    # combos whose pre-backend-field rows this run has superseded: once
+    # the replacement row is FLUSHED, the legacy row is dropped in the
+    # end-of-run rewrite below (never before — a crash between an
+    # eager rewrite and the re-measurement would lose measured data)
+    superseded = set()
     if resume and out_file.exists():
+        legacy_seen = set()
         with open(out_file) as fh:
             for line in fh:
                 try:
                     row = json.loads(line)
                 except json.JSONDecodeError:
                     continue  # truncated tail from a killed run
-                # dataset/base-rows/iters guard: rows from a different
-                # dataset or measurement depth sharing the out_dir must
-                # not satisfy this sweep
-                # .get defaults: rows written before the search_iters /
-                # max_base_rows fields existed carry the values those
-                # defaults had (3 / 0) — without this, resuming over a
-                # legacy results.jsonl re-measures every combination and
-                # the export doubles up (ADVICE r3)
-                if (row.get("dataset") == dataset_dir.name
-                        and row.get("max_base_rows", 0)
-                        == int(max_base_rows)
-                        and row.get("k") == k
-                        and row.get("batch_size") == batch_size
-                        and row.get("search_iters", 3) == search_iters
-                        # a row measured on another backend (e.g. a CPU
-                        # rehearsal sharing the out_dir) must not
-                        # satisfy this sweep; missing field = legacy
-                        # row, accepted as this backend's
-                        and row.get("backend", backend) == backend):
+                if not _same_sweep(row):
+                    continue
+                # a row measured on another backend (e.g. a CPU
+                # rehearsal sharing the out_dir) must not satisfy this
+                # sweep; a missing backend field does NOT imply this
+                # backend (unlike search_iters there is no known
+                # default), so legacy rows are re-measured once and the
+                # stale line cleaned up after its replacement lands
+                if "backend" not in row:
+                    legacy_seen.add(_combo_key(row.get("algo"),
+                                               row.get("build_params"),
+                                               row.get("search_params")))
+                elif row.get("backend") == backend:
                     done.add(_combo_key(row.get("algo"),
                                         row.get("build_params"),
                                         row.get("search_params")))
@@ -465,6 +480,10 @@ def run_benchmark(
                     if (only_algos is None
                             or row.get("algo") in only_algos):
                         results.append(row)
+        # a legacy row whose combo already has a backend-bearing row is
+        # provably superseded even though this run won't re-measure it
+        # (e.g. the run that replaced it crashed before its own cleanup)
+        superseded |= legacy_seen & done
         if done:
             _log_warn("resume: %d finished combination(s) found in %s",
                       len(done), out_file)
@@ -584,7 +603,41 @@ def run_benchmark(
                 results.append(row)
                 fh.write(json.dumps(row) + "\n")
                 fh.flush()
+                superseded.add(_combo_key(algo.name, build_params,
+                                          search_params))
+    if resume and superseded:
+        _drop_superseded_legacy_rows(out_file, _same_sweep, _combo_key,
+                                     superseded)
     return results
+
+
+def _drop_superseded_legacy_rows(out_file, same_sweep, combo_key,
+                                 superseded) -> None:
+    """Rewrite ``results.jsonl`` without pre-backend-field rows whose
+    combos were re-measured this run.  Runs only AFTER the replacement
+    rows are flushed: a legacy row's backend is unknowable, so resume
+    re-measures its combo, and keeping both would double up the
+    export/plot — but dropping before the replacement lands would turn
+    a mid-sweep crash into silent data loss."""
+    kept, dropped = [], 0
+    for line in out_file.read_text().splitlines(keepends=True):
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # truncated tail from a killed run
+        if ("backend" not in row and same_sweep(row)
+                and combo_key(row.get("algo"), row.get("build_params"),
+                              row.get("search_params")) in superseded):
+            dropped += 1
+            continue
+        kept.append(line)
+    if dropped:
+        tmp = out_file.with_suffix(".jsonl.tmp")
+        tmp.write_text("".join(kept))
+        tmp.replace(out_file)
+        _log_warn("resume: dropped %d pre-backend-field row(s) from %s "
+                  "(re-measured this run with the backend field)",
+                  dropped, out_file)
 
 
 def _load_rows(results_dir: pathlib.Path) -> List[Dict[str, Any]]:

@@ -146,9 +146,13 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
                 semaphore wait: DMA waits decrement by the descriptor's
                 byte count, and a (C, d) descriptor's bytes equal the
                 sum of the C (1, d) transfers that signalled the sem —
-                C serial scalar-core waits would sit on the hot path."""
+                C serial scalar-core waits would sit on the hot path.
+                The descriptor is built from the (C, d) landing buffer
+                (src shape only feeds the byte count), not a dataset
+                slice — ds_ref[0:C] would be an invalid slice whenever
+                n < C (tiny dataset forced to hbm mode)."""
                 pltpu.make_async_copy(
-                    ds_ref.at[pl.ds(0, C), :],
+                    rows_ref.at[slot],
                     rows_ref.at[slot],
                     dsem_ref.at[slot]).wait()
 

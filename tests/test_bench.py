@@ -154,6 +154,54 @@ class TestRunner:
         assert len((out / "results.jsonl").read_text()
                    .splitlines()) == 4
 
+    def test_resume_rewrites_legacy_backendless_rows(self, dataset_dir,
+                                                     tmp_path):
+        """A row written before the backend field existed cannot prove
+        it was measured on THIS backend: resume re-measures the combo
+        and drops the stale row from the file (keeping both would
+        double up the export/plot)."""
+        config = {"algos": [
+            {"name": "raft_brute_force", "search": [{}]},
+            {"name": "raft_ivf_flat", "build": {"n_lists": 16},
+             "search": [{"n_probes": 4}]},
+        ]}
+        out = tmp_path / "res"
+        first = run_benchmark(dataset_dir, config, out, k=10,
+                              search_iters=1)
+        out_file = out / "results.jsonl"
+        bf_row, ivf_row = [json.loads(line) for line in
+                           out_file.read_text().splitlines()]
+        del bf_row["backend"]
+        # a backend-less row from some OTHER dataset must survive
+        foreign = dict(bf_row, dataset="other-ds")
+        out_file.write_text("\n".join(json.dumps(r) for r in
+                                      (bf_row, ivf_row, foreign)) + "\n")
+
+        # a combo the resumed invocation will NOT re-measure (filtered
+        # out by only_algos) must keep its legacy row: dropping without
+        # replacing would lose measured data
+        run_benchmark(dataset_dir, config, out, k=10, search_iters=1,
+                      resume=True, only_algos=["raft_ivf_flat"])
+        rows = [json.loads(line) for line in
+                out_file.read_text().splitlines()]
+        assert sum("backend" not in r for r in rows) == 2  # bf + foreign
+
+        resumed = run_benchmark(dataset_dir, config, out, k=10,
+                                search_iters=1, resume=True)
+        assert len(resumed) == 2
+        rows = [json.loads(line) for line in
+                out_file.read_text().splitlines()]
+        # this sweep's legacy brute-force row was replaced (with the
+        # backend field); the foreign dataset's stayed as-is
+        by_ds = {}
+        for r in rows:
+            by_ds.setdefault(r.get("dataset"), []).append(r)
+        assert len(by_ds["other-ds"]) == 1
+        assert "backend" not in by_ds["other-ds"][0]
+        this_ds = by_ds[dataset_dir.name]
+        assert len(this_ds) == 2
+        assert all(r["backend"] == first[0]["backend"] for r in this_ds)
+
     def test_require_cached_index(self, dataset_dir, tmp_path):
         """require_cached_index fails fast (host-side) when a saveable
         algo's cache misses, instead of building on the measurement
@@ -217,17 +265,27 @@ class TestReferenceConfigSchema:
                                     "internalDistanceDtype": "float"}]},
                 {"algo": "raft_cagra", "build_param": {"graph_degree": 32},
                  "search_params": [{"itopk": 32}, {"itopk": 64}]},
+                # competitor with no wrapper here: must be dropped
+                {"algo": "faiss_gpu_ivf_flat", "build_param": {"nlist": 64},
+                 "search_params": [{"nprobe": 4}]},
             ],
         }
         cfg = normalize_config(ref)
         names = [a["name"] for a in cfg["algos"]]
-        assert names == ["raft_brute_force", "raft_ivf_pq", "raft_cagra"]
-        pq = cfg["algos"][1]
+        # hnswlib has a wrapper (the native C++ baseline), so a
+        # reference conf naming it runs the competitor series; faiss/
+        # ggnn wrap other libraries and are dropped.
+        assert names == ["raft_brute_force", "hnswlib", "raft_ivf_pq",
+                         "raft_cagra"]
+        hnsw = cfg["algos"][1]
+        assert hnsw["build"] == {"M": 12}
+        assert hnsw["search"] == [{"ef": 10}]
+        pq = cfg["algos"][2]
         assert pq["build"] == {"kmeans_n_iters": 25, "n_lists": 1000,
                                "pq_dim": 64, "pq_bits": 8,
                                "kmeans_trainset_fraction": 0.5}
         assert pq["search"] == [{"n_probes": 20}]
-        assert cfg["algos"][2]["search"] == [{"itopk_size": 32},
+        assert cfg["algos"][3]["search"] == [{"itopk_size": 32},
                                              {"itopk_size": 64}]
         # native schema passes through untouched
         native = {"algos": [{"name": "raft_brute_force"}]}
@@ -350,6 +408,49 @@ class TestHnswCpuBaseline:
                            k=10, search_iters=1)
         assert r2[0]["build_cached"]
         assert abs(r2[0]["recall"] - r1[0]["recall"]) < 1e-6
+
+    def test_load_rejects_mismatched_cache(self, rng_np, tmp_path):
+        """A cache file whose recorded dim/metric differ from the
+        caller's must be refused — the native side strides queries by
+        the FILE's dim, so accepting it reads past the query buffer."""
+        from raft_tpu.bench import hnsw_cpu
+        from raft_tpu.distance.types import DistanceType
+
+        if not hnsw_cpu.available():
+            pytest.skip("native HNSW library could not be built")
+        base = rng_np.standard_normal((64, 16)).astype(np.float32)
+        idx = hnsw_cpu.build(base, DistanceType.L2Expanded, M=8,
+                             ef_construction=50)
+        path = tmp_path / "idx.bin"
+        hnsw_cpu.save(idx, path)
+        with pytest.raises(RuntimeError, match="dim"):
+            hnsw_cpu.load(path, 32, DistanceType.L2Expanded)
+        with pytest.raises(RuntimeError, match="metric"):
+            hnsw_cpu.load(path, 16, DistanceType.InnerProduct)
+        ok = hnsw_cpu.load(path, 16, DistanceType.L2Expanded)
+        assert ok.dim == 16
+
+    def test_load_rejects_corrupt_max_level(self, rng_np, tmp_path):
+        """max_level above the entry node's level list would index past
+        upper[entry] at search time; the loader must reject it."""
+        from raft_tpu.bench import hnsw_cpu
+        from raft_tpu.distance.types import DistanceType
+
+        if not hnsw_cpu.available():
+            pytest.skip("native HNSW library could not be built")
+        base = rng_np.standard_normal((64, 16)).astype(np.float32)
+        idx = hnsw_cpu.build(base, DistanceType.L2Expanded, M=8,
+                             ef_construction=50)
+        path = tmp_path / "idx.bin"
+        hnsw_cpu.save(idx, path)
+        # header: magic u32, dim i64, M i64, ef_construction i64,
+        # metric i32, n i64, max_level i32 — corrupt max_level
+        raw = bytearray(path.read_bytes())
+        off = 4 + 8 + 8 + 8 + 4 + 8
+        raw[off:off + 4] = (10 ** 6).to_bytes(4, "little")
+        path.write_bytes(bytes(raw))
+        with pytest.raises(RuntimeError, match="corrupt"):
+            hnsw_cpu.load(path, 16, DistanceType.L2Expanded)
 
     def test_reference_schema_spellings(self):
         from raft_tpu.bench.runner import normalize_config

@@ -191,7 +191,116 @@ def _await_child(child, deadline_s: float):
     return None
 
 
+def _is_cpu_hog(argv) -> bool:
+    """Known-CPU-only-by-construction background jobs: hnswlib /
+    ivf_flat_cpu competitor sweeps, the prebuild scripts (both pin
+    jax_platforms=cpu), pytest (the conftest forces CPU). Matching is
+    per-TOKEN equality/suffix, never
+    a substring scan of the joined cmdline — a process whose ARGUMENT
+    merely mentions one of these words (a shell -c script, an agent
+    prompt) must not be frozen. Deliberately narrow overall: a broad
+    'bench' pattern could catch an abandoned in-flight TPU process,
+    and SIGSTOPping one of those is the mid-transaction freeze the
+    relay rules forbid."""
+    toks = [t for t in argv if t]
+    short = {t for t in toks if len(t) < 64}
+    # basename equality (not suffix): a token with embedded spaces (a
+    # bash -c script mentioning these names) must not match
+    names = {t.rsplit("/", 1)[-1] for t in short}
+    if names & {"pytest", "prebuild_sweep_indexes.py",
+                "tpu_prebuild_indexes.py"}:
+        return True
+    if "raft_tpu.bench" not in short or "run" not in short:
+        return False
+    # --algos may arrive as "a" / "--algos=a" / a comma list; the sweep
+    # is CPU-only iff EVERY requested family is a CPU competitor (a
+    # mixed list includes raft algos that may run on the TPU)
+    competitors = {"hnswlib", "ivf_flat_cpu"}
+    for t in short:
+        if t.startswith("--algos="):
+            t = t[len("--algos="):]
+        parts = [p.strip() for p in t.split(",")]
+        if parts and all(p in competitors for p in parts):
+            return True
+    return False
+
+
+def _ancestor_pids():
+    """This process's ancestor chain — the shells running bench.py
+    must never be paused (their cmdline can embed arbitrary text)."""
+    out = set()
+    pid = os.getpid()
+    for _ in range(64):
+        try:
+            with open(f"/proc/{pid}/stat") as fh:
+                ppid = int(fh.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            break
+        if ppid <= 1:
+            break
+        out.add(ppid)
+        pid = ppid
+    return out
+
+
+def _pause_cpu_hogs():
+    """SIGSTOP known-CPU-only background jobs for the measurement's
+    duration — the single-core host: a background 1M hnswlib sweep
+    halved the round-4 headline capture (VERDICT r4). Returns only the
+    pids THIS process stopped: one already in state T was paused by an
+    outer guard (the round plan's window-wide stop) and must stay
+    paused when we exit."""
+    import signal
+
+    stopped = []
+    skip = _ancestor_pids() | {os.getpid()}
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit() or int(pid_s) in skip:
+            continue
+        try:
+            with open(f"/proc/{pid_s}/cmdline", "rb") as fh:
+                argv = fh.read().decode(errors="replace").split("\0")
+            if not _is_cpu_hog(argv):
+                continue
+            with open(f"/proc/{pid_s}/stat") as fh:
+                state = fh.read().rsplit(")", 1)[1].split()[0]
+            if state == "T":
+                continue  # an outer guard owns this pause
+            os.kill(int(pid_s), signal.SIGSTOP)
+            stopped.append(int(pid_s))
+            log(f"paused background CPU job {pid_s}: "
+                f"{' '.join(t for t in argv if t)[:80]}")
+        except (OSError, IndexError, ValueError):
+            continue  # raced with process exit / unreadable proc entry
+    return stopped
+
+
+def _resume_pids(pids):
+    import signal
+
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGCONT)
+        except OSError:
+            pass
+
+
 def parent_main():
+    import signal
+
+    # a finally: does not run on an unhandled fatal signal — without
+    # these, a driver-side SIGTERM would leave the background jobs
+    # frozen forever
+    for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+        signal.signal(sig, lambda s, f: sys.exit(128 + s))
+    paused = _pause_cpu_hogs()
+    try:
+        _parent_main_inner()
+    finally:
+        _resume_pids(paused)
+
+
+def _parent_main_inner():
     healthy = _backend_healthy()
     # default deadline scales with the measurement budget: data-gen +
     # compile margin on top of the worst-case measurement loop
@@ -395,8 +504,27 @@ def child_main():
         log(f"slope timing failed ({e}); keeping pipelined result")
 
 
+def _list_cpu_hogs():
+    """Print matching pids (no signals) — the shell plans reuse THIS
+    matcher for their window-wide pause instead of a pgrep substring
+    scan that could freeze a process merely mentioning these names."""
+    skip = _ancestor_pids() | {os.getpid()}
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit() or int(pid_s) in skip:
+            continue
+        try:
+            with open(f"/proc/{pid_s}/cmdline", "rb") as fh:
+                argv = fh.read().decode(errors="replace").split("\0")
+            if _is_cpu_hog(argv):
+                print(pid_s)
+        except OSError:
+            continue
+
+
 if __name__ == "__main__":
-    if os.environ.get("BENCH_CHILD"):
+    if "--list-cpu-hogs" in sys.argv[1:]:
+        _list_cpu_hogs()
+    elif os.environ.get("BENCH_CHILD"):
         child_main()
     else:
         parent_main()

@@ -228,6 +228,40 @@ def _hnswlib_load(path, base, metric, **params):
     return hnsw_cpu.load(path, base.shape[1], metric)
 
 
+def _ivf_flat_cpu_build(base, metric, *, n_lists=1024, train_iters=10,
+                        trainset_fraction=0.1, **params):
+    from raft_tpu.bench import ivf_flat_cpu
+
+    if params:
+        raise ValueError(f"ivf_flat_cpu build takes n_lists/train_iters/"
+                         f"trainset_fraction, got {params}")
+    return ivf_flat_cpu.build(np.asarray(base), metric, n_lists=n_lists,
+                              train_iters=train_iters,
+                              trainset_fraction=trainset_fraction)
+
+
+def _ivf_flat_cpu_search(index, queries, k, *, n_probes=32, **params):
+    from raft_tpu.bench import ivf_flat_cpu
+
+    if params:
+        raise ValueError(f"ivf_flat_cpu search takes n_probes, "
+                         f"got {params}")
+    return ivf_flat_cpu.search(index, np.asarray(queries), k,
+                               n_probes=n_probes)
+
+
+def _ivf_flat_cpu_save(index, path):
+    from raft_tpu.bench import ivf_flat_cpu
+
+    ivf_flat_cpu.save(index, path)
+
+
+def _ivf_flat_cpu_load(path, base, metric, **params):
+    from raft_tpu.bench import ivf_flat_cpu
+
+    return ivf_flat_cpu.load(path, base.shape[1], metric)
+
+
 ALGO_REGISTRY: Dict[str, AlgoWrapper] = {
     "raft_brute_force": AlgoWrapper("raft_brute_force",
                                     _brute_force_build, _brute_force_search),
@@ -250,6 +284,12 @@ ALGO_REGISTRY: Dict[str, AlgoWrapper] = {
     # on the host CPU, not a TPU algorithm
     "hnswlib": AlgoWrapper("hnswlib", _hnswlib_build, _hnswlib_search,
                            _hnswlib_save, _hnswlib_load),
+    # second comparison series (the reference's FAISS competitor role,
+    # cpp/bench/ann/src/faiss/faiss_benchmark.cu) — from-scratch numpy
+    # IVF-Flat exact scan on the host CPU, not a TPU algorithm
+    "ivf_flat_cpu": AlgoWrapper("ivf_flat_cpu", _ivf_flat_cpu_build,
+                                _ivf_flat_cpu_search, _ivf_flat_cpu_save,
+                                _ivf_flat_cpu_load),
 }
 
 

@@ -51,6 +51,13 @@ class ShardedIndex:
                 d, i = self.search_fn(res, index, queries, k)
                 parts_d.append(d)
                 parts_i.append(jnp.where(i >= 0, i + off, i))
+            # per-shard parts live on their shard's device; the merge
+            # needs them together (the raft-dask client-side
+            # knn_merge_parts role) — gather to the resources' device
+            # (default device when unset) before stacking
+            merge_dev = res.device or jax.devices()[0]
+            parts_d = [jax.device_put(p, merge_dev) for p in parts_d]
+            parts_i = [jax.device_put(p, merge_dev) for p in parts_i]
             return knn_merge_parts(
                 jnp.stack(parts_d), jnp.stack(parts_i), self.select_min
             )

@@ -6,24 +6,42 @@ For every evidence stream this reads BOTH the committed ci/ archives
 dedupes newest-wins — so a workspace reset can never regress the report
 to fewer rows than what is already committed (ADVICE r3, medium).
 
+Every row is stamped with the round it was CAPTURED in (VERDICT r4
+weak #3: a results file must never present old hardware data as new):
+a record's provenance is the earliest source file it appears in with
+identical content; the source files are round-named (ci/..._rN...), so
+carried-forward evidence keeps its original round label even after
+being re-archived, and only genuinely new records get this round's.
+
 Streams (any subset may exist):
-  smoke    ci/tpu_smoke_kernels_r{3,4}.json + results/tpu_smoke_r4.jsonl
-  profile  ci/tpu_profile6_r{3,4}.jsonl + results/tpu_profile6_r4.jsonl
-  bench    ci/bench_headline_r{3,4}.json + results/bench_headline.json
-  sweep    ci/sweep1m_results_r{3,4}.jsonl + results/sweep-1M/results.jsonl
-  scale    ci/scale_tpu_r{3,4}.jsonl + results/scale_tpu_r4.jsonl
-  prims    ci/prims_full_r{3,4}.jsonl + results/prims_full_r4.jsonl
+  smoke    ci/tpu_smoke_kernels_r{3..N}.json + results/tpu_smoke_rN.jsonl
+  profile  ci/tpu_profile6_r{3..N}.jsonl + results/tpu_profile6_rN.jsonl
+  bench    ci/bench_headline_r{3..N}.json + results/bench_headline.json
+  sweep    ci/sweep1m_results_r{3..N}.jsonl + results/sweep-1M/results.jsonl
+  scale    ci/scale_tpu_r{3..N}.jsonl + results/scale_tpu_rN.jsonl
+  prims    ci/prims_full_r{3..N}.jsonl + results/prims_full_rN.jsonl
 
 Writes RESULTS_r{N}.md (repo root). Purely host-side — safe anytime.
 
-Run: python scripts/summarize_round.py [--round 4]
+Run: python scripts/summarize_round.py [--round 5]
 """
 
 import argparse
 import json
 import pathlib
+import re
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+# rows from a live (round-unnamed) file can only have been captured
+# this round or — for files that predate the current round's first
+# archive pass — an earlier one; the caller passes the label to use
+_SRC_KEY = "_captured"
+
+
+def round_of_path(path: str, live_label: str) -> str:
+    m = re.search(r"_r(\d+)", pathlib.Path(path).name)
+    return f"r{m.group(1)}" if m else live_label
 
 
 def read_jsonl(path):
@@ -43,19 +61,34 @@ def read_jsonl(path):
 
 
 def dedupe_last(rows, key_fields):
-    """Keep the LAST record per key — reruns append, newest wins."""
+    """Keep the LAST record per key — reruns append, newest wins.
+    Provenance: when the newer record's content is identical to the
+    one it replaces, the original capture label is kept (the record
+    was merely re-archived); only a content change re-stamps it."""
     out = {}
     for r in rows:
-        out[tuple(str(r.get(k)) for k in key_fields)] = r
+        key = tuple(str(r.get(k)) for k in key_fields)
+        prev = out.get(key)
+        if prev is not None:
+            same = {k: v for k, v in prev.items() if k != _SRC_KEY} == \
+                   {k: v for k, v in r.items() if k != _SRC_KEY}
+            if same:
+                continue  # identical re-archive: keep first-seen stamp
+        out[key] = r
     return list(out.values())
 
 
-def read_all(paths, key_fields=None):
-    """Concatenate sources oldest-first and (optionally) dedupe so the
-    newest record per key wins."""
+def read_all(paths, key_fields=None, live_label="live"):
+    """Concatenate sources oldest-first, stamping each row with the
+    round its source file encodes, and (optionally) dedupe so the
+    newest record per key wins (identical re-archives keep their
+    original stamp)."""
     rows = []
     for p in paths:
-        rows.extend(read_jsonl(p))
+        label = round_of_path(p, live_label)
+        for r in read_jsonl(p):
+            r.setdefault(_SRC_KEY, label)
+            rows.append(r)
     if key_fields:
         rows = dedupe_last(rows, key_fields)
     return rows
@@ -64,7 +97,8 @@ def read_all(paths, key_fields=None):
 def fmt_table(rows, cols, header=None):
     if not rows:
         return "_no data captured_\n"
-    head = header or cols
+    cols = list(cols) + [_SRC_KEY]
+    head = (list(header) if header else list(cols[:-1])) + ["captured"]
     lines = ["| " + " | ".join(head) + " |",
              "|" + "|".join("---" for _ in head) + "|"]
     for r in rows:
@@ -83,15 +117,22 @@ def sources(rnd, ci_tmpl, live):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--round", type=int, default=4)
+    ap.add_argument("--round", type=int, default=5)
     args = ap.parse_args()
     rnd = args.round
+    live = f"r{rnd}"  # rows in round-unnamed live files are this round's
 
-    out = [f"# Round-{rnd} hardware evidence (TPU v5e via relay)", ""]
+    out = [f"# Round-{rnd} hardware evidence (TPU v5e via relay)", "",
+           "Every row/record carries a `captured` stamp: the round whose "
+           "capture produced it. `r{N}` for N < " + str(rnd) +
+           " is carried-forward evidence (committed in that round, "
+           "re-read from its ci/ archive); only rows stamped "
+           f"`r{rnd}` are new this round.", ""]
 
     smoke = read_all(
         sources(rnd, "ci/tpu_smoke_kernels_r{}.json",
-                f"results/tpu_smoke_r{rnd}.jsonl"), ("piece",))
+                f"results/tpu_smoke_r{rnd}.jsonl"), ("piece",),
+        live_label=live)
     if smoke:
         lines, used = [], 0
         for r in smoke:  # whole records only; never cut JSON mid-object
@@ -107,11 +148,13 @@ def main():
 
     prof = read_all(
         sources(rnd, "ci/tpu_profile6_r{}.jsonl",
-                f"results/tpu_profile6_r{rnd}.jsonl"), ("piece",))
+                f"results/tpu_profile6_r{rnd}.jsonl"), ("piece",),
+        live_label=live)
     prof96 = read_all(
-        ["ci/tpu_profile6_r3_v96.jsonl",
-         "results/tpu_profile6_r3_v96.jsonl",
-         f"results/tpu_profile6_r{rnd}_v96.jsonl"], ("piece",))
+        sources(rnd, "ci/tpu_profile6_r{}_v96.jsonl",
+                ["results/tpu_profile6_r3_v96.jsonl",
+                 f"results/tpu_profile6_r{rnd}_v96.jsonl"]), ("piece",),
+        live_label=live)
     if prof:
         out += ["## Profile pieces (slope-timed; per-dtype spreads)", "",
                 fmt_table(prof, ["piece", "iter_ms", "gbps", "ms", "qps",
@@ -122,7 +165,8 @@ def main():
 
     bench = read_all(
         sources(rnd, "ci/bench_headline_r{}.json",
-                "results/bench_headline.json"), ("metric",))
+                "results/bench_headline.json"), ("metric",),
+        live_label=live)
     if bench:
         out += ["## Headline bench (driver format)", "",
                 "```json", "\n".join(json.dumps(b) for b in bench), "```",
@@ -130,10 +174,10 @@ def main():
 
     sweep = read_all(
         sources(rnd, "ci/sweep1m_results_r{}.jsonl",
-                "results/sweep-1M/results.jsonl"))
+                "results/sweep-1M/results.jsonl"), live_label=live)
     sweep = dedupe_last(
         [r for r in sweep if r.get("algo")],
-        ("algo", "build_params", "search_params"))
+        ("algo", "backend", "build_params", "search_params"))
     if sweep:
         for r in sweep:
             r["build"] = json.dumps(r.get("build_params"))
@@ -146,7 +190,8 @@ def main():
 
     scale = read_all(
         sources(rnd, "ci/scale_tpu_r{}.jsonl",
-                f"results/scale_tpu_r{rnd}.jsonl"), ("piece", "backend"))
+                f"results/scale_tpu_r{rnd}.jsonl"), ("piece", "backend"),
+        live_label=live)
     scale_note = ""
     if not scale:
         # fall back to the newest CPU rehearsal, clearly labeled
@@ -154,6 +199,8 @@ def main():
         if logs:
             newest = max(logs, key=lambda p: p.stat().st_mtime)
             scale = read_jsonl(newest.relative_to(ROOT))
+            for r in scale:
+                r.setdefault(_SRC_KEY, f"cpu-rehearsal (pre-r{rnd})")
             scale_note = (" — **CPU rehearsal only** (no TPU run "
                           "captured)")
     if scale:
@@ -165,7 +212,8 @@ def main():
 
     prims = read_all(
         sources(rnd, "ci/prims_full_r{}.jsonl",
-                f"results/prims_full_r{rnd}.jsonl"), ("prim", "shape"))
+                f"results/prims_full_r{rnd}.jsonl"), ("prim", "shape"),
+        live_label=live)
     if prims:
         out += ["## Per-primitive micro-bench (--size full)", "",
                 fmt_table(prims, ["prim", "shape", "ms", "gbps", "bw_frac",
@@ -173,9 +221,12 @@ def main():
 
     report = ROOT / f"RESULTS_r{rnd}.md"
     report.write_text("\n".join(out) + "\n")
+    new_rows = sum(1 for r in prof + prof96 + sweep + scale + prims
+                   + smoke + bench if r.get(_SRC_KEY) == live)
     print(f"wrote {report} "
           f"({len(prof)} profile rows, {len(sweep)} sweep rows, "
-          f"{len(scale)} scale rows, {len(prims)} prim rows)")
+          f"{len(scale)} scale rows, {len(prims)} prim rows; "
+          f"{new_rows} records captured this round)")
 
 
 if __name__ == "__main__":

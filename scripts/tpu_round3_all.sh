@@ -1,5 +1,5 @@
 #!/bin/bash
 # Forwarding shim: the round-3 relay watcher (scripts/relay_watch.sh)
 # may still be running detached and launches THIS path when the relay
-# returns; the current hardware plan lives in tpu_round4_all.sh.
-exec bash "$(cd "$(dirname "$0")" && pwd)/tpu_round4_all.sh" "$@"
+# returns; the current hardware plan lives in tpu_round5_all.sh.
+exec bash "$(cd "$(dirname "$0")" && pwd)/tpu_round5_all.sh" "$@"

@@ -363,6 +363,51 @@ class TestCagraBundleRefine:
                                    atol=1e-3)
 
 
+class TestBenchCpuHogMatcher:
+    """bench.py pauses CPU-only background jobs during the headline
+    capture (the round-4 contention lesson); the matcher must be
+    token-exact — freezing a process that merely MENTIONS these names
+    (an agent driver's prompt, a bash -c script) froze the whole
+    session once."""
+
+    def _matcher(self):
+        import importlib.util
+        import pathlib
+
+        root = pathlib.Path(__file__).resolve().parent.parent
+        spec = importlib.util.spec_from_file_location(
+            "bench_mod", root / "bench.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod._is_cpu_hog
+
+    @pytest.mark.parametrize("argv,want", [
+        (["python", "-m", "raft_tpu.bench", "run", "--algos",
+          "hnswlib,ivf_flat_cpu"], True),
+        (["python", "-m", "raft_tpu.bench", "run",
+          "--algos=ivf_flat_cpu"], True),
+        # a mixed list includes raft algos that may run on the TPU
+        (["python", "-m", "raft_tpu.bench", "run", "--algos",
+          "raft_ivf_flat,hnswlib"], False),
+        (["python", "-m", "raft_tpu.bench", "run", "--algos",
+          "raft_cagra"], False),
+        (["python", "-m", "pytest", "tests/"], True),
+        (["/usr/bin/pytest", "-q"], True),
+        (["python", "scripts/prebuild_sweep_indexes.py", "--check"],
+         True),
+        (["python", "scripts/tpu_prebuild_indexes.py"], True),
+        # argv that only MENTIONS the names must not match
+        (["bash", "-c",
+          "echo pytest hnswlib prebuild_sweep_indexes.py"], False),
+        (["claude", "--append-system-prompt",
+          "x" * 100 + " pytest hnswlib"], False),
+        (["python", "-m", "raft_tpu.bench", "run", "--dataset", "x"],
+         False),
+    ])
+    def test_is_cpu_hog(self, argv, want):
+        assert self._matcher()(argv) is want
+
+
 class TestHnswCpuBaseline:
     """The native C++ HNSW competitor wrapper (the reference's hnswlib
     comparison role, ``cpp/bench/ann/src/hnswlib/hnswlib_wrapper.h``)."""
@@ -408,6 +453,34 @@ class TestHnswCpuBaseline:
                            k=10, search_iters=1)
         assert r2[0]["build_cached"]
         assert abs(r2[0]["recall"] - r1[0]["recall"]) < 1e-6
+
+    def test_ivf_flat_cpu_cache_round_trip(self, rng_np, tmp_path):
+        """Second competitor's index cache: save -> load -> identical
+        search; mismatched/corrupt caches are refused (the hnsw_cpu
+        contract)."""
+        from raft_tpu.bench import ivf_flat_cpu
+        from raft_tpu.distance.types import DistanceType
+
+        base = rng_np.standard_normal((500, 16)).astype(np.float32)
+        q = rng_np.standard_normal((20, 16)).astype(np.float32)
+        idx = ivf_flat_cpu.build(base, DistanceType.L2Expanded,
+                                 n_lists=16, trainset_fraction=1.0)
+        d1, i1 = ivf_flat_cpu.search(idx, q, 5, n_probes=4)
+        path = tmp_path / "ivf.bin"
+        ivf_flat_cpu.save(idx, path)
+        idx2 = ivf_flat_cpu.load(path, 16, DistanceType.L2Expanded)
+        d2, i2 = ivf_flat_cpu.search(idx2, q, 5, n_probes=4)
+        assert np.array_equal(i1, i2) and np.allclose(d1, d2)
+        with pytest.raises(ValueError, match="dim"):
+            ivf_flat_cpu.load(path, 32, DistanceType.L2Expanded)
+        with pytest.raises(ValueError, match="metric"):
+            ivf_flat_cpu.load(path, 16, DistanceType.InnerProduct)
+        raw = bytearray(path.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF  # flip a byte mid-payload
+        bad = tmp_path / "bad.bin"
+        bad.write_bytes(bytes(raw[:len(raw) // 2]))  # truncate too
+        with pytest.raises((ValueError, OSError, EOFError)):
+            ivf_flat_cpu.load(bad, 16, DistanceType.L2Expanded)
 
     def test_load_rejects_mismatched_cache(self, rng_np, tmp_path):
         """A cache file whose recorded dim/metric differ from the
@@ -466,6 +539,30 @@ class TestHnswCpuBaseline:
         assert cfg["algos"][0]["build"] == {"M": 12,
                                             "ef_construction": 150}
         assert cfg["algos"][0]["search"] == [{"ef": 20}]
+
+    def test_two_competitor_series(self, dataset_dir, tmp_path):
+        """The pareto needs a second non-raft series (the reference
+        benches FAISS beside hnswlib): both competitors must produce
+        rows in one sweep."""
+        from raft_tpu.bench import hnsw_cpu
+
+        algos = [{"name": "ivf_flat_cpu",
+                  "build": {"n_lists": 64, "trainset_fraction": 0.5},
+                  "search": [{"n_probes": 4}, {"n_probes": 64}]}]
+        if hnsw_cpu.available():
+            algos.append({"name": "hnswlib", "build": {"M": 8},
+                          "search": [{"ef": 50}]})
+        rows = run_benchmark(dataset_dir, {"algos": algos},
+                             tmp_path / "res", k=10, search_iters=1)
+        by_algo = {}
+        for r in rows:
+            by_algo.setdefault(r["algo"], []).append(r)
+        ivf = by_algo["ivf_flat_cpu"]
+        assert len(ivf) == 2
+        # more probes -> higher recall; n_probes=64 of 64 lists = exact
+        assert ivf[1]["recall"] >= ivf[0]["recall"]
+        assert ivf[1]["recall"] > 0.99
+        assert all(r["qps"] > 0 for r in rows)
 
     def test_sweep_survives_missing_toolchain(self, dataset_dir, tmp_path,
                                               monkeypatch):

@@ -225,6 +225,31 @@ def _is_cpu_hog(argv) -> bool:
     return False
 
 
+def _is_cpu_pinned_bench(argv, environ) -> bool:
+    """A raft-family sweep is CPU-only when its own environment pins
+    jax to the host (the CPU-rehearsal launch convention:
+    JAX_PLATFORMS=cpu with the axon pool IP unset) — safe to pause no
+    matter which algo families it runs."""
+    toks = {t for t in argv if t and len(t) < 64}
+    return ("raft_tpu.bench" in toks and "run" in toks
+            and environ.get("JAX_PLATFORMS") == "cpu"
+            and "PALLAS_AXON_POOL_IPS" not in environ)
+
+
+def _proc_environ(pid_s: str):
+    try:
+        with open(f"/proc/{pid_s}/environ", "rb") as fh:
+            raw = fh.read().decode(errors="replace")
+    except OSError:
+        return {}
+    out = {}
+    for item in raw.split("\0"):
+        k, sep, v = item.partition("=")
+        if sep:
+            out[k] = v
+    return out
+
+
 def _ancestor_pids():
     """This process's ancestor chain — the shells running bench.py
     must never be paused (their cmdline can embed arbitrary text)."""
@@ -243,16 +268,15 @@ def _ancestor_pids():
     return out
 
 
-def _pause_cpu_hogs():
-    """SIGSTOP known-CPU-only background jobs for the measurement's
-    duration — the single-core host: a background 1M hnswlib sweep
-    halved the round-4 headline capture (VERDICT r4). Returns only the
-    pids THIS process stopped: one already in state T was paused by an
-    outer guard (the round plan's window-wide stop) and must stay
-    paused when we exit."""
-    import signal
-
-    stopped = []
+def _iter_cpu_hog_pids():
+    """Yield (pid_str, argv) for every running (not already-stopped)
+    CPU-only background job — ONE definition of the walk shared by the
+    per-bench pause and the shell plans' window-wide pause, so the two
+    can't drift. A pid already in state T is excluded: its pause is
+    owned by some outer guard and must not be listed, re-stopped, or
+    resumed by anyone else. The environ read (for the CPU-pinned-bench
+    rule) happens only after the argv prefilter — scanning every
+    process's environ on each call would be waste."""
     skip = _ancestor_pids() | {os.getpid()}
     for pid_s in os.listdir("/proc"):
         if not pid_s.isdigit() or int(pid_s) in skip:
@@ -260,18 +284,38 @@ def _pause_cpu_hogs():
         try:
             with open(f"/proc/{pid_s}/cmdline", "rb") as fh:
                 argv = fh.read().decode(errors="replace").split("\0")
-            if not _is_cpu_hog(argv):
+            toks = {t for t in argv if t and len(t) < 64}
+            maybe_bench = "raft_tpu.bench" in toks and "run" in toks
+            if not (_is_cpu_hog(argv)
+                    or (maybe_bench and _is_cpu_pinned_bench(
+                        argv, _proc_environ(pid_s)))):
                 continue
             with open(f"/proc/{pid_s}/stat") as fh:
                 state = fh.read().rsplit(")", 1)[1].split()[0]
             if state == "T":
                 continue  # an outer guard owns this pause
-            os.kill(int(pid_s), signal.SIGSTOP)
-            stopped.append(int(pid_s))
-            log(f"paused background CPU job {pid_s}: "
-                f"{' '.join(t for t in argv if t)[:80]}")
+            yield pid_s, argv
         except (OSError, IndexError, ValueError):
             continue  # raced with process exit / unreadable proc entry
+
+
+def _pause_cpu_hogs():
+    """SIGSTOP known-CPU-only background jobs for the measurement's
+    duration — the single-core host: a background 1M hnswlib sweep
+    halved the round-4 headline capture (VERDICT r4). Returns only the
+    pids THIS process stopped (already-stopped pids are excluded by
+    the walk) so the exit resume can't unpause someone else's guard."""
+    import signal
+
+    stopped = []
+    for pid_s, argv in _iter_cpu_hog_pids():
+        try:
+            os.kill(int(pid_s), signal.SIGSTOP)
+        except OSError:
+            continue  # raced with process exit
+        stopped.append(int(pid_s))
+        log(f"paused background CPU job {pid_s}: "
+            f"{' '.join(t for t in argv if t)[:80]}")
     return stopped
 
 
@@ -290,9 +334,12 @@ def parent_main():
 
     # a finally: does not run on an unhandled fatal signal — without
     # these, a driver-side SIGTERM would leave the background jobs
-    # frozen forever
+    # frozen forever. An inherited SIG_IGN disposition is respected:
+    # under nohup, SIGHUP must stay ignored or a terminal hangup kills
+    # the detached measurement this script is documented to survive
     for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
-        signal.signal(sig, lambda s, f: sys.exit(128 + s))
+        if signal.getsignal(sig) != signal.SIG_IGN:
+            signal.signal(sig, lambda s, f: sys.exit(128 + s))
     paused = _pause_cpu_hogs()
     try:
         _parent_main_inner()
@@ -507,18 +554,12 @@ def child_main():
 def _list_cpu_hogs():
     """Print matching pids (no signals) — the shell plans reuse THIS
     matcher for their window-wide pause instead of a pgrep substring
-    scan that could freeze a process merely mentioning these names."""
-    skip = _ancestor_pids() | {os.getpid()}
-    for pid_s in os.listdir("/proc"):
-        if not pid_s.isdigit() or int(pid_s) in skip:
-            continue
-        try:
-            with open(f"/proc/{pid_s}/cmdline", "rb") as fh:
-                argv = fh.read().decode(errors="replace").split("\0")
-            if _is_cpu_hog(argv):
-                print(pid_s)
-        except OSError:
-            continue
+    scan that could freeze a process merely mentioning these names.
+    Already-stopped pids are excluded (the shared walk's ownership
+    rule), so a plan's later blanket SIGCONT can't resume a pause some
+    other guard still owns."""
+    for pid_s, _ in _iter_cpu_hog_pids():
+        print(pid_s)
 
 
 if __name__ == "__main__":

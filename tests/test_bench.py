@@ -407,6 +407,30 @@ class TestBenchCpuHogMatcher:
     def test_is_cpu_hog(self, argv, want):
         assert self._matcher()(argv) is want
 
+    def test_cpu_pinned_bench_by_environ(self):
+        """A raft-family sweep pinned to CPU via its own environment
+        (the rehearsal launch convention) is pausable even though its
+        algo list names TPU families; the same argv without the pin is
+        not."""
+        import importlib.util
+        import pathlib
+
+        root = pathlib.Path(__file__).resolve().parent.parent
+        spec = importlib.util.spec_from_file_location(
+            "bench_mod2", root / "bench.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        argv = ["python", "-m", "raft_tpu.bench", "run", "--algos",
+                "raft_ivf_flat,raft_ivf_pq"]
+        assert mod._is_cpu_pinned_bench(
+            argv, {"JAX_PLATFORMS": "cpu"}) is True
+        assert mod._is_cpu_pinned_bench(
+            argv, {"JAX_PLATFORMS": "cpu",
+                   "PALLAS_AXON_POOL_IPS": "10.0.0.1"}) is False
+        assert mod._is_cpu_pinned_bench(argv, {}) is False
+        assert mod._is_cpu_pinned_bench(
+            ["python", "x.py"], {"JAX_PLATFORMS": "cpu"}) is False
+
 
 class TestHnswCpuBaseline:
     """The native C++ HNSW competitor wrapper (the reference's hnswlib

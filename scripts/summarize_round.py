@@ -199,8 +199,10 @@ def main():
         if logs:
             newest = max(logs, key=lambda p: p.stat().st_mtime)
             scale = read_jsonl(newest.relative_to(ROOT))
+            # a rehearsal log's capture round is not derivable from its
+            # name — stamp the source file instead of guessing a round
             for r in scale:
-                r.setdefault(_SRC_KEY, f"cpu-rehearsal (pre-r{rnd})")
+                r.setdefault(_SRC_KEY, f"cpu-rehearsal ({newest.name})")
             scale_note = (" — **CPU rehearsal only** (no TPU run "
                           "captured)")
     if scale:

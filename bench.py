@@ -24,7 +24,10 @@ fallback metric instead.
 Timing inside the child is pipelined (dispatch a run of iterations,
 fetch once): ``block_until_ready`` does not block on relayed backends,
 and a per-iteration host fetch would pay the ~65 ms relay round-trip
-every call.
+every call. The measured call goes through the serving path
+(``SearchExecutor``: bucketed batch, AOT-compiled executable), and the
+JSON line carries ``compile_count`` / ``cache_hits`` /
+``warmup_seconds`` so the trajectory catches recompile regressions.
 
 Progress goes to stderr so a slow run is diagnosable; stdout carries
 exactly one JSON line. Env knobs: BENCH_N / BENCH_DIM / BENCH_BATCH /
@@ -456,8 +459,23 @@ def child_main():
     jax.block_until_ready(index.norms)
     log(f"index built (storage {index.dataset.dtype}, norms cached)")
 
+    # Serving path: AOT-warm the batch's bucket, then measure through
+    # the compiled executable — the steady state a frontend would see.
+    # The executor's counters ride along in the JSON line so the bench
+    # trajectory catches recompile regressions (a healthy run compiles
+    # during warmup only; cache_hits ≈ the iteration count).
+    from raft_tpu import SearchExecutor
+
+    executor = SearchExecutor()
+    t_warm = time.perf_counter()
+    executor.warmup(index, buckets=(executor.bucket_for(BATCH),), k=K,
+                    db_tile=262144)
+    warmup_seconds = time.perf_counter() - t_warm
+    log(f"executor warmup: {warmup_seconds:.2f}s "
+        f"({executor.stats.compile_count} compiles)")
+
     def run():
-        return brute_force.search(None, index, queries, K, db_tile=262144)
+        return executor.search(index, queries, K, db_tile=262144)
 
     # Two-stage measurement, robust to mid-measurement relay wedges
     # (the parent keeps the LAST parseable JSON line captured, so a
@@ -500,6 +518,9 @@ def child_main():
             "unit": "QPS",
             "vs_baseline": round(qps / ROOFLINE_QPS, 4),
             "storage_dtype": str(index.dataset.dtype),
+            "compile_count": executor.stats.compile_count,
+            "cache_hits": executor.stats.cache_hits,
+            "warmup_seconds": round(warmup_seconds, 3),
         }
         if recall is not None:
             rec["recall_at_k_vs_f32_exact"] = round(recall, 4)

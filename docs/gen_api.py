@@ -12,7 +12,8 @@ import inspect
 import pathlib
 
 MODULES = [
-    "raft_tpu.core.resources", "raft_tpu.core.bitset", "raft_tpu.core.logger",
+    "raft_tpu.core.resources", "raft_tpu.core.executor",
+    "raft_tpu.core.bitset", "raft_tpu.core.logger",
     "raft_tpu.core.tracing", "raft_tpu.core.interruptible",
     "raft_tpu.core.serialize", "raft_tpu.core.operators",
     "raft_tpu.core.validation",

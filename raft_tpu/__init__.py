@@ -29,9 +29,11 @@ jit cache replaces it — and the Python API *is* the primary API (L7).
 __version__ = "0.1.0"
 
 from raft_tpu.core.resources import Resources, DeviceResources
+from raft_tpu.core.executor import SearchExecutor
 
 __all__ = [
     "Resources",
     "DeviceResources",
+    "SearchExecutor",
     "__version__",
 ]

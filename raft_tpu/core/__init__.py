@@ -8,6 +8,7 @@ holding the mesh, PRNG state and tunables shared by every algorithm.
 """
 
 from raft_tpu.core.resources import Resources, DeviceResources
+from raft_tpu.core.executor import SearchExecutor, ExecutorStats
 from raft_tpu.core.logger import logger, set_level, LogLevel
 from raft_tpu.core.serialize import (
     serialize_array,
@@ -27,6 +28,8 @@ from raft_tpu.core.validation import (
 __all__ = [
     "Resources",
     "DeviceResources",
+    "SearchExecutor",
+    "ExecutorStats",
     "logger",
     "set_level",
     "LogLevel",

@@ -1,0 +1,466 @@
+"""Shape-stable serving path: bucketed batching + AOT executable cache.
+
+The reference ships ahead-of-time-compiled kernels (RAFT's L6 explicit
+instantiation layer) so serving never compiles; under plain ``jax.jit``
+this repo instead paid a full XLA trace+compile (seconds) for every new
+query-batch shape — fatal for a frontend that sends varying batch
+sizes. ``SearchExecutor`` is the TPU-native answer, per the TPU-KNN
+peak-throughput recipe already cited in ``matrix/select_k.py``:
+
+- **Bucketing**: query batches are padded up to power-of-two buckets,
+  so every batch size in a bucket runs ONE compiled program. Search
+  results are per-query-row independent in every index family, so pad
+  rows cannot perturb real rows (their outputs are sliced away), and
+  results are bit-identical to the direct search path.
+- **AOT compilation**: each (index shapes, search params, bucket)
+  specialization is compiled once via ``jit(...).lower().compile()``
+  and cached; the steady-state hot path calls the compiled executable
+  directly — no tracing, no dispatch-cache lookup, no recompiles.
+  :meth:`warmup` builds the executables from abstract shapes before
+  traffic arrives, and a persistent compilation cache directory
+  (``Resources.compilation_cache_dir``) makes that warmup survive
+  process restarts.
+- **Donated top-k state**: the running (k-best values, ids) buffers are
+  owned by the executor and donated to each call, so the scan state
+  reuses one HBM allocation across calls instead of re-allocating (and
+  the result write aliases the donated input). Donation is on by
+  default on TPU/GPU backends; CPU ignores donation, so it is off
+  there unless forced.
+
+Counters (compile count, cache hits/misses, evictions, warmup seconds)
+are exported through :mod:`raft_tpu.core.tracing` under the
+``serving.`` prefix, and :func:`tracing.install_xla_compile_listener`
+provides the backend-compile ground truth that the tier-1 recompile
+regression test asserts on.
+
+Supported index types: ``BruteForceIndex``, ``IvfFlatIndex``,
+``IvfPqIndex``, ``IvfBqIndex``, ``CagraIndex``.
+
+Small print: padding/slicing a batch to/from its bucket executes tiny
+device ops whose programs XLA caches per distinct batch size — the
+*search* program itself never recompiles, and once a batch size has
+been seen, repeats are entirely compile-free.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+
+
+def _fused_entry_fn(queries, dataset, norms, *, k: int, metric):
+    """Serving wrapper for the Pallas fused brute-force kernel."""
+    from raft_tpu.ops.fused_topk import fused_knn
+
+    return fused_knn(queries, dataset, k, metric, dataset_norms=norms)
+
+
+@dataclasses.dataclass
+class ExecutorStats:
+    """Serving-path counters (also exported via ``tracing.counters``)."""
+
+    compile_count: int = 0
+    cache_hits: int = 0
+    cache_misses: int = 0
+    evictions: int = 0
+    warmup_seconds: float = 0.0
+
+
+@dataclasses.dataclass
+class _Plan:
+    """Everything needed to compile and call one bucket specialization.
+
+    Call argument order is ``(*pre, queries, *post, [filter_words],
+    [init_d, init_i])`` — matching each family's serving function
+    signature."""
+
+    key: tuple
+    fn: Callable
+    static: dict
+    pre: tuple = ()
+    post: tuple = ()
+    use_filter: bool = False
+    has_state: bool = True
+    qdtype: Any = jnp.float32
+    qdim: int = 0
+    # thread the tile's absolute row offset as a traced scalar (CAGRA
+    # seeds are per absolute row, so oversized batches tile through one
+    # executable and stay bit-identical to the direct path)
+    pass_row0: bool = False
+
+
+class _Entry:
+    __slots__ = ("compiled", "state")
+
+    def __init__(self, compiled, state):
+        self.compiled = compiled
+        self.state = state
+
+
+def _sds(x) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype)
+
+
+def _sig(*arrays) -> tuple:
+    return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+
+
+def _filter_spec(fw) -> tuple:
+    if fw is None:
+        return ("nofilter",)
+    return ("filter", fw.ndim, fw.shape[-1], str(fw.dtype))
+
+
+class SearchExecutor:
+    """Compile-free steady-state search across all ANN index families.
+
+    Example::
+
+        ex = SearchExecutor(res)
+        ex.warmup(index, buckets=(64, 256), k=10)   # cold-start, AOT
+        d, i = ex.search(index, queries, 10)        # never traces again
+
+    Constructor args:
+      res: shared :class:`Resources` (placement, workspace budget, and
+        the persistent ``compilation_cache_dir``).
+      min_bucket/max_bucket: power-of-two bucket ladder bounds. Batches
+        larger than ``max_bucket`` are tiled at ``max_bucket`` with the
+        ragged tail padded into the bucket (all tiles dispatched before
+        any result is fetched).
+      max_entries: LRU capacity of the executable cache.
+      donate: donate the running top-k state buffers to each call.
+        Default: enabled on backends that implement donation (not CPU).
+    """
+
+    def __init__(self, res: Optional[Resources] = None, *,
+                 min_bucket: int = 8, max_bucket: int = 4096,
+                 max_entries: int = 64, donate: Optional[bool] = None):
+        self.res = ensure_resources(res)
+        expect(0 < min_bucket <= max_bucket,
+               f"need 0 < min_bucket <= max_bucket, got "
+               f"({min_bucket}, {max_bucket})")
+        buckets = []
+        b = min_bucket
+        while b < max_bucket:
+            buckets.append(b)
+            b *= 2
+        buckets.append(max_bucket)
+        self.buckets: Tuple[int, ...] = tuple(buckets)
+        self.max_entries = max_entries
+        if donate is None:
+            donate = jax.default_backend() not in ("cpu",)
+        self.donate = donate
+        self.stats = ExecutorStats()
+        self._cache: "collections.OrderedDict[tuple, _Entry]" = (
+            collections.OrderedDict())
+        # multi-threaded frontends share one executor: the cache and
+        # the donated per-entry state buffers must hand off atomically
+        # (two threads donating the same state would hit jax's
+        # deleted-array error). Dispatch is async, so holding the lock
+        # through the executable call serializes only enqueueing.
+        self._lock = threading.RLock()
+
+    # -- bucketing ----------------------------------------------------------
+
+    def bucket_for(self, q: int) -> int:
+        """Smallest bucket >= q (the last bucket for anything larger)."""
+        for b in self.buckets:
+            if q <= b:
+                return b
+        return self.buckets[-1]
+
+    # -- public API ---------------------------------------------------------
+
+    def warmup(self, index, buckets=None, *, k: int, params=None,
+               sample_filter=None, **kw) -> float:
+        """AOT-compile the executables for ``buckets`` (default: the
+        whole ladder) so first-traffic latency is a cache *call*, not a
+        compile. Returns wall seconds spent (also accumulated into the
+        ``serving.warmup_seconds`` counter). With a persistent
+        compilation cache configured, a restarted process's warmup
+        loads artifacts instead of re-compiling."""
+        fw = self._resolve_filter(sample_filter)
+        t0 = time.perf_counter()
+        for b in (buckets if buckets is not None else self.buckets):
+            expect(b in self.buckets, f"bucket {b} not in {self.buckets}")
+            plan = self._plan(index, params, k, b, fw, kw)
+            self._get_entry(plan, b, k)
+        dt = time.perf_counter() - t0
+        self.stats.warmup_seconds += dt
+        tracing.inc_counter("serving.warmup_seconds", dt)
+        return dt
+
+    def search(self, index, queries, k: int, params=None,
+               sample_filter=None, **kw) -> Tuple[jax.Array, jax.Array]:
+        """Bucketed, compile-free search. Returns (distances (q, k),
+        indices (q, k) int32), bit-identical to the direct per-family
+        ``search`` entry point. Extra ``kw`` are family-specific knobs
+        (brute force: ``db_tile``, ``approx``)."""
+        expect(len(np.shape(queries)) == 2, "queries must be (q, dim)")
+        q = int(np.shape(queries)[0])
+        if q == 0:
+            return (jnp.zeros((0, k), jnp.float32),
+                    jnp.zeros((0, k), jnp.int32))
+        fw = self._resolve_filter(sample_filter)
+        max_b = self.buckets[-1]
+        if q <= max_b:
+            return self._run(index, queries, k, params, fw, kw)
+        # tile oversized batches at the top bucket; every tile runs the
+        # same executable and all tiles dispatch before any fetch
+        outs_d, outs_i = [], []
+        for start in range(0, q, max_b):
+            qt = queries[start:start + max_b]
+            fwt = fw[start:start + max_b] if (
+                fw is not None and fw.ndim == 2) else fw
+            d, i = self._run(index, qt, k, params, fwt, kw, row0=start)
+            outs_d.append(d)
+            outs_i.append(i)
+        return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+
+    # -- internals ----------------------------------------------------------
+
+    def _resolve_filter(self, sample_filter):
+        if sample_filter is None:
+            return None
+        from raft_tpu.neighbors.filters import resolve_filter_words
+
+        return resolve_filter_words(sample_filter)
+
+    def _run(self, index, queries, k, params, fw, kw, row0: int = 0):
+        q = int(np.shape(queries)[0])
+        bucket = self.bucket_for(q)
+        plan = self._plan(index, params, k, bucket, fw, kw)
+        expect(int(np.shape(queries)[1]) == plan.qdim, "query dim mismatch")
+
+        qp = self._pad(queries, bucket, plan.qdtype)
+        args = list(plan.pre) + [qp]
+        if plan.pass_row0:
+            args.append(jnp.asarray(row0, jnp.int32))
+        args.extend(plan.post)
+        if plan.use_filter:
+            fwp = fw
+            if fw is not None and fw.ndim == 2:
+                fwp = self._pad(fw, bucket, fw.dtype)
+            args.append(fwp)
+        with self._lock:
+            entry = self._get_entry(plan, bucket, k)
+            if plan.has_state:
+                args.extend(entry.state)
+            out_d, out_i = entry.compiled(*args)
+            if plan.has_state:
+                # outputs alias the donated state storage; keep them as
+                # the next call's state and hand the caller copies
+                entry.state = (out_d, out_i)
+                if q == bucket and self.donate:
+                    return jnp.copy(out_d), jnp.copy(out_i)
+        return out_d[:q], out_i[:q]
+
+    def _pad(self, arr, rows: int, dtype):
+        """Pad to ``rows`` along axis 0. numpy inputs (the serving
+        frontend case) are padded host-side — zero device ops; device
+        arrays pad with one tiny cached concat program."""
+        if isinstance(arr, np.ndarray):
+            out = np.zeros((rows,) + arr.shape[1:], dtype)
+            out[: arr.shape[0]] = arr
+            return out
+        from raft_tpu.neighbors._batching import pad_rows
+
+        arr = jnp.asarray(arr)
+        if arr.dtype != dtype:
+            arr = arr.astype(dtype)
+        return pad_rows(arr, rows)
+
+    def _get_entry(self, plan: _Plan, bucket: int, k: int) -> _Entry:
+        with self._lock:
+            return self._get_entry_locked(plan, bucket, k)
+
+    def _get_entry_locked(self, plan: _Plan, bucket: int, k: int) -> _Entry:
+        ent = self._cache.get(plan.key)
+        if ent is not None:
+            self._cache.move_to_end(plan.key)
+            self.stats.cache_hits += 1
+            tracing.inc_counter("serving.cache_hits")
+            return ent
+        self.stats.cache_misses += 1
+        tracing.inc_counter("serving.cache_misses")
+        t0 = time.perf_counter()
+        compiled = self._compile(plan, bucket, k)
+        dt = time.perf_counter() - t0
+        self.stats.compile_count += 1
+        tracing.inc_counter("serving.compile_count")
+        tracing.inc_counter("serving.compile_seconds", dt)
+        state = None
+        if plan.has_state:
+            state = (jnp.zeros((bucket, k), jnp.float32),
+                     jnp.zeros((bucket, k), jnp.int32))
+        ent = _Entry(compiled, state)
+        self._cache[plan.key] = ent
+        while len(self._cache) > self.max_entries:
+            self._cache.popitem(last=False)
+            self.stats.evictions += 1
+            tracing.inc_counter("serving.evictions")
+        return ent
+
+    def _compile(self, plan: _Plan, bucket: int, k: int):
+        donate = ()
+        if plan.has_state and self.donate:
+            donate = ("init_d", "init_i")
+        jitted = jax.jit(plan.fn, static_argnames=tuple(plan.static),
+                         donate_argnames=donate)
+        args = [_sds(a) for a in plan.pre]
+        args.append(jax.ShapeDtypeStruct((bucket, plan.qdim), plan.qdtype))
+        if plan.pass_row0:
+            args.append(jax.ShapeDtypeStruct((), jnp.int32))
+        args.extend(_sds(a) for a in plan.post)
+        if plan.use_filter:
+            fw_spec = plan.key[-1]  # _filter_spec tuple
+            if fw_spec[0] == "nofilter":
+                args.append(None)
+            else:
+                _, ndim, width, dt = fw_spec
+                shape = (bucket, width) if ndim == 2 else (width,)
+                args.append(jax.ShapeDtypeStruct(shape, np.dtype(dt)))
+        if plan.has_state:
+            args.append(jax.ShapeDtypeStruct((bucket, k), jnp.float32))
+            args.append(jax.ShapeDtypeStruct((bucket, k), jnp.int32))
+        return jitted.lower(*args, **plan.static).compile()
+
+    # -- per-family plans ---------------------------------------------------
+
+    def _plan(self, index, params, k: int, bucket: int, fw, kw) -> _Plan:
+        from raft_tpu.neighbors.brute_force import BruteForceIndex
+        from raft_tpu.neighbors.cagra import CagraIndex
+        from raft_tpu.neighbors.ivf_bq import IvfBqIndex
+        from raft_tpu.neighbors.ivf_flat import IvfFlatIndex
+        from raft_tpu.neighbors.ivf_pq import IvfPqIndex
+
+        if isinstance(index, BruteForceIndex):
+            return self._plan_brute_force(index, k, bucket, fw, kw)
+        if isinstance(index, IvfFlatIndex):
+            return self._plan_ivf_flat(index, params, k, bucket, fw, kw)
+        if isinstance(index, IvfPqIndex):
+            return self._plan_ivf_pq(index, params, k, bucket, fw, kw)
+        if isinstance(index, IvfBqIndex):
+            return self._plan_ivf_bq(index, params, k, bucket, fw, kw)
+        if isinstance(index, CagraIndex):
+            return self._plan_cagra(index, params, k, bucket, fw, kw)
+        raise TypeError(f"SearchExecutor does not support {type(index)!r}")
+
+    def _plan_brute_force(self, index, k, bucket, fw, kw) -> _Plan:
+        from raft_tpu.neighbors import brute_force as bf
+
+        expect(fw is None, "brute_force has no sample_filter support")
+        expect(0 < k <= index.size, f"k must be in (0, {index.size}]")
+        approx = bool(kw.get("approx", False))
+        if not approx and bf._use_fused_kernel(index.metric, k, bucket):
+            static = {"k": k, "metric": index.metric}
+            key = ("bf_fused", bucket, _sig(index.dataset, index.norms),
+                   tuple(sorted(static.items())), _filter_spec(None))
+            return _Plan(key=key, fn=_fused_entry_fn, static=static,
+                         post=(index.dataset, index.norms),
+                         has_state=False, qdtype=index.dataset.dtype,
+                         qdim=index.dim)
+        db_tile = int(kw.get("db_tile", 32768))
+        budget_cols = max(
+            128, self.res.workspace_limit_bytes // (4 * bucket))
+        db_tile = min(db_tile, budget_cols, max(128, index.size))
+        precision = self.res.matmul_precision
+        qdtype = jnp.float32
+        if index.dataset.dtype == jnp.bfloat16:
+            qdtype = jnp.bfloat16
+            precision = "default"
+        static = {"k": k, "metric": index.metric,
+                  "metric_arg": index.metric_arg, "tile": db_tile,
+                  "precision": precision, "approx": approx}
+        key = ("bf_scan", bucket, _sig(index.dataset),
+               tuple(sorted((n, str(v)) for n, v in static.items())),
+               _filter_spec(None))
+        return _Plan(key=key, fn=bf._knn_scan_fn, static=static,
+                     post=(index.dataset,), qdtype=qdtype, qdim=index.dim)
+
+    def _plan_ivf_flat(self, index, params, k, bucket, fw, kw) -> _Plan:
+        from raft_tpu.neighbors import ivf_flat as m
+
+        params = params or m.IvfFlatSearchParams()
+        expect(index.max_list_size > 0, "index is empty — extend() it first")
+        n_probes = min(params.n_probes, index.n_lists)
+        static = {"n_probes": n_probes, "k": k, "metric": index.metric,
+                  "coarse_algo": params.coarse_algo}
+        arrays = (index.centers, index.center_norms, index.data,
+                  index.data_norms, index.indices)
+        key = ("ivf_flat", bucket, _sig(*arrays),
+               tuple(sorted((n, str(v)) for n, v in static.items())),
+               _filter_spec(fw))
+        return _Plan(key=key, fn=m._search_impl_fn, static=static,
+                     post=arrays, use_filter=True, qdim=index.dim)
+
+    def _plan_ivf_pq(self, index, params, k, bucket, fw, kw) -> _Plan:
+        from raft_tpu.neighbors import ivf_pq as m
+
+        params = params or m.IvfPqSearchParams()
+        expect(index.max_list_size > 0, "index is empty — extend() it first")
+        score_mode = m.resolve_score_mode(params.score_mode,
+                                          index.pq_book_size)
+        static = {"n_probes": min(params.n_probes, index.n_lists), "k": k,
+                  "metric": index.metric,
+                  "codebook_kind": index.codebook_kind,
+                  "lut_dtype": params.lut_dtype, "score_mode": score_mode,
+                  "packed": index.packed, "coarse_algo": params.coarse_algo}
+        arrays = (index.centers, index.rotation, index.codebooks,
+                  index.codes, index.indices)
+        key = ("ivf_pq", bucket, _sig(*arrays),
+               tuple(sorted((n, str(v)) for n, v in static.items())),
+               _filter_spec(fw))
+        return _Plan(key=key, fn=m._search_impl_fn, static=static,
+                     post=arrays, use_filter=True, qdim=index.dim)
+
+    def _plan_ivf_bq(self, index, params, k, bucket, fw, kw) -> _Plan:
+        from raft_tpu.neighbors import ivf_bq as m
+
+        params = params or m.IvfBqSearchParams()
+        expect(index.max_list_size > 0, "index is empty — extend() it first")
+        static = {"n_probes": min(params.n_probes, index.n_lists), "k": k,
+                  "metric": index.metric, "coarse_algo": params.coarse_algo}
+        arrays = (index.centers, index.rotation, index.codes, index.scales,
+                  index.rnorm2, index.indices)
+        key = ("ivf_bq", bucket, _sig(*arrays),
+               tuple(sorted((n, str(v)) for n, v in static.items())),
+               _filter_spec(fw))
+        return _Plan(key=key, fn=m._search_impl_fn, static=static,
+                     post=arrays, use_filter=True, qdim=index.dim)
+
+    def _plan_cagra(self, index, params, k, bucket, fw, kw) -> _Plan:
+        from raft_tpu.neighbors import cagra as m
+
+        params = params or m.CagraSearchParams()
+        use_kernel = m._resolve_search_algo(params, index, fw)
+        static = dict(m.derive_search_config(params, index, k, self.res.seed),
+                      metric=index.metric, seed_pool=params.seed_pool)
+        if use_kernel:
+            static["deg"] = index.graph_degree
+            static["interpret"] = jax.default_backend() != "tpu"
+            arrays = (index.dataset, index.padded_graph)
+            key = ("cagra_kernel", bucket, _sig(*arrays),
+                   tuple(sorted((n, str(v)) for n, v in static.items())),
+                   _filter_spec(None))
+            return _Plan(key=key, fn=m._serving_kernel_fn, static=static,
+                         pre=arrays, has_state=False, qdim=index.dim,
+                         pass_row0=True)
+        arrays = (index.dataset, index.graph)
+        key = ("cagra_xla", bucket, _sig(*arrays),
+               tuple(sorted((n, str(v)) for n, v in static.items())),
+               _filter_spec(fw))
+        return _Plan(key=key, fn=m._serving_xla_fn, static=static,
+                     pre=arrays, use_filter=True, has_state=False,
+                     qdim=index.dim, pass_row0=True)

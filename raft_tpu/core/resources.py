@@ -23,6 +23,7 @@ function exactly like the reference's ``resources const&``.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 from typing import Any, Optional, Sequence
 
@@ -32,6 +33,33 @@ import numpy as np
 
 def _default_device() -> jax.Device:
     return jax.devices()[0]
+
+
+def apply_compilation_cache(path: str) -> None:
+    """Point XLA's persistent compilation cache at ``path`` (created if
+    missing) and drop the min-compile-time threshold so every serving
+    executable is persisted.
+
+    This is the process-restart half of the serving path's cold-start
+    story: ``SearchExecutor.warmup`` pays tracing + XLA compile once,
+    the artifacts land in ``path``, and the next process's warmup is a
+    cache *load* instead of a compile. Safe to call repeatedly."""
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except AttributeError:  # renamed across jax versions; dir alone suffices
+        pass
+    # jax memoizes "no cache configured" at the first compile; if any
+    # compile already ran (e.g. another handle's PRNG init), the new
+    # dir would be silently ignored without this reset
+    try:
+        from jax._src import compilation_cache
+
+        if compilation_cache._cache_initialized:  # noqa: SLF001
+            compilation_cache.reset_cache()
+    except Exception:  # pragma: no cover - private API moved
+        pass
 
 
 @dataclasses.dataclass
@@ -55,6 +83,10 @@ class Resources:
       workspace_limit_bytes: soft budget that batching heuristics use when
         deciding tile sizes (analog of the workspace memory resource,
         ``core/device_resources.hpp`` workspace accessors).
+      compilation_cache_dir: when set, XLA's persistent compilation
+        cache is pointed here (see :func:`apply_compilation_cache`) so
+        AOT warmup done by ``SearchExecutor`` survives process
+        restarts. Defaults to the ``RAFT_TPU_COMPILE_CACHE`` env var.
     """
 
     device: Optional[jax.Device] = None
@@ -63,9 +95,17 @@ class Resources:
     matmul_precision: str = "highest"
     workspace_limit_bytes: int = 2 * 1024**3
     comms: Optional[Any] = None
+    compilation_cache_dir: Optional[str] = None
 
     def __post_init__(self):
         self._lock = threading.Lock()
+        if self.compilation_cache_dir is None:
+            self.compilation_cache_dir = (
+                os.environ.get("RAFT_TPU_COMPILE_CACHE") or None)
+        if self.compilation_cache_dir:
+            # before the PRNG-key compile below, so even the process's
+            # very first executable lands in the persistent cache
+            apply_compilation_cache(self.compilation_cache_dir)
         self._key = jax.random.key(self.seed)
         self._subcomms: dict[str, Any] = {}
 
@@ -161,6 +201,9 @@ class ResourcesManager:
 
     def set_workspace_limit_bytes(self, n: int) -> None:
         self._defaults["workspace_limit_bytes"] = n
+
+    def set_compilation_cache_dir(self, path: str) -> None:
+        self._defaults["compilation_cache_dir"] = path
 
     def get_device_resources(
         self, device: "Optional[jax.Device | int]" = None

@@ -1,16 +1,26 @@
-"""Profiler ranges — analog of the reference's NVTX RAII ranges.
+"""Profiler ranges and serving counters — analog of the reference's
+NVTX RAII ranges plus a minimal metrics registry.
 
 Reference: ``core/nvtx.hpp:20-70`` inserts named ranges at every public
 entry point. The TPU-native equivalents are ``jax.named_scope`` (annotates
 the jaxpr/HLO so ranges appear in XLA profiler traces) plus
 ``jax.profiler.TraceAnnotation`` for host-side spans. ``range`` composes
 both so one decorator/context manager covers traced and untraced code.
+
+The counter registry is the export surface for the serving path
+(``core/executor.py``): compile counts, cache hits/evictions and warmup
+time land here so a frontend (or the bench harness) can scrape one
+place. ``install_xla_compile_listener`` additionally taps jax's
+monitoring events so *every* backend compile in the process — not just
+the executor's — is visible; that is what the tier-1 recompile
+regression test asserts on.
 """
 
 from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 
 import jax
 
@@ -57,3 +67,66 @@ def start_server(port: int = 9999):
     """Start the on-demand profiler server (``jax.profiler``) so a
     running service can be traced remotely."""
     return jax.profiler.start_server(port)
+
+
+# ---------------------------------------------------------------------------
+# counters — process-wide serving metrics registry
+# ---------------------------------------------------------------------------
+
+_counters: dict = {}
+_counters_lock = threading.Lock()
+
+
+def inc_counter(name: str, amount: float = 1.0) -> None:
+    """Add ``amount`` to a named process-wide counter (creates it at 0)."""
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0.0) + amount
+
+
+def get_counter(name: str) -> float:
+    """Current value of a counter (0.0 if never incremented)."""
+    with _counters_lock:
+        return _counters.get(name, 0.0)
+
+
+def counters(prefix: str = "") -> dict:
+    """Snapshot of all counters whose name starts with ``prefix``."""
+    with _counters_lock:
+        return {k: v for k, v in _counters.items() if k.startswith(prefix)}
+
+
+def reset_counters(prefix: str = "") -> None:
+    """Zero (remove) counters matching ``prefix`` — test isolation."""
+    with _counters_lock:
+        for k in [k for k in _counters if k.startswith(prefix)]:
+            del _counters[k]
+
+
+_compile_listener_installed = False
+
+# every XLA backend compile in the process lands in these two counters
+XLA_COMPILE_COUNT = "xla.backend_compile_count"
+XLA_COMPILE_SECONDS = "xla.backend_compile_seconds"
+
+
+def install_xla_compile_listener() -> None:
+    """Count every XLA backend compile into :data:`XLA_COMPILE_COUNT` /
+    :data:`XLA_COMPILE_SECONDS` via ``jax.monitoring``.
+
+    Idempotent and process-wide. This is the ground truth the serving
+    path's "steady state never compiles" guarantee is tested against:
+    jax emits ``/jax/core/compile/backend_compile_duration`` exactly
+    once per real (non-cached) executable build.
+    """
+    global _compile_listener_installed
+    with _counters_lock:
+        if _compile_listener_installed:
+            return
+        _compile_listener_installed = True
+
+    def _on_event(name: str, secs: float, **kw) -> None:
+        if name == "/jax/core/compile/backend_compile_duration":
+            inc_counter(XLA_COMPILE_COUNT)
+            inc_counter(XLA_COMPILE_SECONDS, secs)
+
+    jax.monitoring.register_event_duration_secs_listener(_on_event)

@@ -46,7 +46,20 @@ def _choose_algo(batch: int, n: int, k: int) -> SelectAlgo:
     """Heuristic dispatcher (role of ``choose_select_k_algorithm``,
     ``matrix/detail/select_k-inl.cuh:219``). AUTO always resolves to an
     *exact* algorithm — the reference's select_k is exact, so the
-    approximate TPU top-k (``lax.approx_min_k``) is strictly opt-in."""
+    approximate TPU top-k (``lax.approx_min_k``) is strictly opt-in.
+
+    - ``k == n``: every element survives, so a full-width ``top_k``
+      (O(n log n) with top-k's larger constants, then a gather) is
+      wasted work — one stable sort answers directly, and its stable
+      tie order matches the reference's "stable" warpsort variants.
+    - near-full selection (k > 3n/4): the ``top_k`` lowering still
+      materializes an order over essentially the whole row, so the
+      stable sort is no slower and gives deterministic ties.
+    - otherwise: ``lax.top_k``, which lowers onto the TPU's native
+      sort/top-k units (the TPU-KNN peak-FLOP/s recipe).
+    """
+    if k == n or k * 4 > n * 3:
+        return SelectAlgo.SORT
     return SelectAlgo.TOPK
 
 

@@ -1,6 +1,22 @@
 """Shared host-side query batching for the search entry points — the
 reference's max_queries loop (``ivf_pq_search.cuh:790``), with per-tile
-slicing of 2-D (per-query) filter words."""
+slicing of 2-D (per-query) filter words.
+
+Shape stability: the ragged final tile is PADDED up to ``query_tile``
+instead of tracing a second program specialization for the tail shape
+(the serving-path bucketing policy, ``core/executor.py``). Search
+results are per-query-row independent in every index family, so pad
+rows cannot perturb real rows; their outputs are sliced away. Per-query
+(2-D) filter words are padded with zeros — an all-rejected filter row —
+which only affects the discarded pad outputs.
+
+Pipelining: every tile is dispatched before any result is fetched. All
+device ops here (slices, the per-tile search programs, the final
+concatenate) are asynchronous under XLA, so a caller that blocks on the
+returned arrays pays ONE device synchronization per call, not one per
+tile — the same async-dispatch discipline as the reference's stream
+usage.
+"""
 
 from __future__ import annotations
 
@@ -10,26 +26,46 @@ import jax
 import jax.numpy as jnp
 
 
+def pad_rows(arr: jax.Array, rows: int) -> jax.Array:
+    """Pad ``arr`` with zero rows up to ``rows`` along axis 0 (no-op if
+    already that tall). Zeros are safe pad queries: search results are
+    rowwise, so pad rows only produce discarded outputs."""
+    q = arr.shape[0]
+    if q >= rows:
+        return arr
+    pad = jnp.zeros((rows - q,) + arr.shape[1:], arr.dtype)
+    return jnp.concatenate([arr, pad])
+
+
 def tile_queries(
     run: Callable,
     queries: jax.Array,
     filter_words,
     query_tile: int,
 ) -> Tuple[jax.Array, jax.Array]:
-    """Apply ``run(queries_tile, filter_words_tile)`` over query tiles and
-    concatenate. 1-D (shared) filter words pass through unchanged; 2-D
-    (per-query) words are sliced with their queries."""
-    if queries.shape[0] <= query_tile:
+    """Apply ``run(queries_tile, filter_words_tile)`` over uniform
+    ``query_tile``-row tiles and concatenate. 1-D (shared) filter words
+    pass through unchanged; 2-D (per-query) words are sliced with their
+    queries. The ragged tail is padded into the tile so every tile runs
+    the SAME compiled program (one specialization per tile shape, not
+    two), and all tiles are dispatched before anything is fetched."""
+    q = queries.shape[0]
+    if q <= query_tile:
         return run(queries, filter_words)
     outs_d, outs_i = [], []
-    for start in range(0, queries.shape[0], query_tile):
+    for start in range(0, q, query_tile):
+        qt = queries[start : start + query_tile]
         fw = filter_words
         if fw is not None and fw.ndim == 2:
             fw = fw[start : start + query_tile]
-        d, i = run(queries[start : start + query_tile], fw)
+        if qt.shape[0] < query_tile:  # ragged tail → pad into the tile
+            qt = pad_rows(qt, query_tile)
+            if fw is not None and fw.ndim == 2:
+                fw = pad_rows(fw, query_tile)
+        d, i = run(qt, fw)
         outs_d.append(d)
         outs_i.append(i)
-    return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+    return (jnp.concatenate(outs_d)[:q], jnp.concatenate(outs_i)[:q])
 
 
 def coarse_select(score, n_probes: int, coarse_algo: str,

@@ -41,6 +41,7 @@ from raft_tpu.core.validation import expect
 from raft_tpu.distance.pairwise import _pairwise_distance_impl
 from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
+from raft_tpu.neighbors._batching import tile_queries
 
 _SERIALIZATION_VERSION = 1
 
@@ -96,14 +97,19 @@ def build(
     return BruteForceIndex(dataset, norms, DistanceType(metric), metric_arg)
 
 
-@partial(jax.jit, static_argnames=("k", "metric", "metric_arg", "tile",
-                                   "precision", "approx"))
-def _knn_scan(queries, dataset, k: int, metric: DistanceType, metric_arg: float,
-              tile: int, precision: str = "highest", approx: bool = False):
+def _knn_scan_fn(queries, dataset, init_d=None, init_i=None, *, k: int,
+                 metric: DistanceType, metric_arg: float, tile: int,
+                 precision: str = "highest", approx: bool = False):
     """Scan database tiles, carrying running top-k (the global-merge loop of
     ``tiled_brute_force_knn``). ``approx`` swaps the per-tile exact top-k
     for the TPU's native approximate top-k unit (the TPU-KNN-paper
-    peak-FLOP/s recipe); the cross-tile merge stays exact."""
+    peak-FLOP/s recipe); the cross-tile merge stays exact.
+
+    ``init_d``/``init_i`` are optional (q, k) buffers whose *storage*
+    seeds the running top-k state; their values are reset here. The
+    serving path (``core/executor.py``) passes them with buffer
+    donation so the scan state reuses one HBM allocation across calls.
+    """
     n, d = dataset.shape
     q = queries.shape[0]
     select_min = is_min_close(metric)
@@ -139,11 +145,17 @@ def _knn_scan(queries, dataset, k: int, metric: DistanceType, metric_arg: float,
         return (new_d, new_i), None
 
     init = (
-        jnp.full((q, k), pad_val, jnp.float32),
-        jnp.full((q, k), -1, jnp.int32),
+        jnp.full((q, k), pad_val, jnp.float32) if init_d is None
+        else jnp.full_like(init_d, pad_val),
+        jnp.full((q, k), -1, jnp.int32) if init_i is None
+        else jnp.full_like(init_i, -1),
     )
     (best_d, best_i), _ = jax.lax.scan(step, init, jnp.arange(n_tiles))
     return best_d, best_i
+
+
+_knn_scan = partial(jax.jit, static_argnames=(
+    "k", "metric", "metric_arg", "tile", "precision", "approx"))(_knn_scan_fn)
 
 
 def _use_fused_kernel(metric: DistanceType, k: int, q: int) -> bool:
@@ -210,17 +222,12 @@ def search(
 
             return fused_knn(queries, index.dataset, k, index.metric,
                              dataset_norms=index.norms)
-        if q <= query_tile:
-            return _knn_scan(queries, index.dataset, k, index.metric,
-                             index.metric_arg, db_tile, precision, approx)
-        outs_d, outs_i = [], []
-        for start in range(0, q, query_tile):
-            dq, iq = _knn_scan(queries[start : start + query_tile], index.dataset,
-                               k, index.metric, index.metric_arg, db_tile,
-                               precision, approx)
-            outs_d.append(dq)
-            outs_i.append(iq)
-        return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
+        def run(qt, _fw):
+            return _knn_scan(qt, index.dataset, k=k, metric=index.metric,
+                             metric_arg=index.metric_arg, tile=db_tile,
+                             precision=precision, approx=approx)
+
+        return tile_queries(run, queries, None, query_tile)
 
 
 def knn(

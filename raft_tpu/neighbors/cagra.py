@@ -474,10 +474,80 @@ def _pooled_seeds(dataset, queries, pool: int, n_seeds: int,
     return cand[pos]
 
 
-@partial(jax.jit, static_argnames=("k", "L", "w", "max_iters", "metric"))
-def _search_batch(dataset, graph, queries, seed_ids, filter_words,
-                  k: int, L: int, w: int, max_iters: int,
-                  metric: DistanceType):
+@partial(jax.jit, static_argnames=("rows", "n_seeds", "n"))
+def _draw_seeds(base_key, row0, rows: int, n_seeds: int, n: int):
+    """Per-row seed draws, invariant to batching: row ``r`` of any call
+    derives everything from ``fold_in(base_key, row0 + r)``, so a query
+    at a given absolute position gets the same seeds no matter how the
+    batch was tiled, padded or bucketed — the property the serving
+    path's bit-identical-results guarantee rests on.
+
+    Each row takes a random offset plus an even stride over the id
+    space (iid uniform draws can leave whole clusters unsampled; the
+    stride guarantees coverage, the per-row random offset and jitter
+    keep rows decorrelated). Duplicate draws are harmless — the beam
+    merge dedups them."""
+    rids = row0 + jnp.arange(rows)
+    keys = jax.vmap(lambda r: jax.random.fold_in(base_key, r))(rids)
+    stride = max(1, n // n_seeds)
+
+    def one(kk):
+        off, jit_k = jax.random.split(kk)
+        base = jax.random.randint(off, (), 0, n, jnp.int32)
+        jitter = jax.random.randint(jit_k, (n_seeds,), 0, stride, jnp.int32)
+        lattice = jnp.arange(n_seeds, dtype=jnp.int32) * stride
+        return (base + lattice + jitter) % n
+
+    return jax.vmap(one)(keys)
+
+
+def derive_search_config(params: "CagraSearchParams", index: "CagraIndex",
+                         k: int, seed: int) -> dict:
+    """THE beam-search shape derivation (L, w, max_iters, n_seeds,
+    seed_salt), shared by :func:`search` and the serving path
+    (``core/executor.py``) — their bit-identity depends on these five
+    values agreeing, so they are derived in exactly one place.
+
+    One seed-count formula for both engines (their parity depends on
+    drawing identical seed sets): the XLA width, rounded up to a
+    multiple of the kernel's chunk width C = w*graph_degree. Duplicate
+    draws are harmless — the merge dedups them."""
+    L = max(params.itopk_size, k)
+    w = max(1, params.search_width)
+    C = w * index.graph_degree
+    n_seeds = max(L, C) * max(1, params.num_random_samplings)
+    n_seeds = -(-n_seeds // C) * C
+    return {
+        "k": k,
+        "L": L,
+        "w": w,
+        "max_iters": params.max_iterations or (L // w + 24),
+        "n_seeds": n_seeds,
+        "seed_salt": seed ^ params.rand_xor_mask,
+    }
+
+
+def _make_seeds(dataset, qt, row0, n_seeds: int, metric: DistanceType,
+                seed_pool: int, base_key):
+    """Shared seed policy for the direct and serving search paths:
+    query-aware pooled seeds when ``seed_pool > 0``, else per-row
+    uniform draws (both rowwise — pad rows cannot perturb real rows)."""
+    n = dataset.shape[0]
+    if seed_pool > 0:
+        seeds = _pooled_seeds(dataset, qt, min(seed_pool, n),
+                              min(n_seeds, seed_pool, n), metric)
+        if seeds.shape[1] < n_seeds:
+            # pad to the shared width by repeating the best seeds
+            # (dedup makes repeats free)
+            reps = -(-n_seeds // seeds.shape[1])
+            seeds = jnp.tile(seeds, (1, reps))[:, :n_seeds]
+        return seeds
+    return _draw_seeds(base_key, row0, qt.shape[0], n_seeds, n)
+
+
+def _search_batch_fn(dataset, graph, queries, seed_ids, filter_words, *,
+                     k: int, L: int, w: int, max_iters: int,
+                     metric: DistanceType):
     q, dim = queries.shape
     n, deg = graph.shape
     qf = queries.astype(jnp.float32)
@@ -538,6 +608,46 @@ def _search_batch(dataset, graph, queries, seed_ids, filter_words,
     return out_d, out_i
 
 
+_search_batch = partial(jax.jit, static_argnames=(
+    "k", "L", "w", "max_iters", "metric"))(_search_batch_fn)
+
+
+def _serving_xla_fn(dataset, graph, queries, row0, filter_words, *, k: int,
+                    L: int, w: int, max_iters: int, metric: DistanceType,
+                    n_seeds: int, seed_salt: int, seed_pool: int):
+    """One-program serving entry (seeds + beam search) for the XLA
+    engine — what ``core/executor.py`` AOT-compiles per bucket. Seeds
+    are drawn per absolute row ``row0 + r`` (``_draw_seeds``; ``row0``
+    is traced so oversized batches tile through ONE executable), so
+    results for real rows are bit-identical to the direct
+    :func:`search` path."""
+    base_key = jax.random.key(seed_salt)
+    seeds = _make_seeds(dataset, queries, row0, n_seeds, metric, seed_pool,
+                        base_key)
+    return _search_batch_fn(dataset, graph, queries, seeds, filter_words,
+                            k=k, L=L, w=w, max_iters=max_iters, metric=metric)
+
+
+def _serving_kernel_fn(dataset, padded_graph, queries, row0, *, k: int,
+                       L: int, w: int, max_iters: int, metric: DistanceType,
+                       deg: int, n_seeds: int, seed_salt: int,
+                       seed_pool: int, interpret: bool = False):
+    """Serving entry for the Pallas beam kernel (TPU), mirroring the
+    kernel branch of :func:`search` including its distance postprocess."""
+    from raft_tpu.ops.beam_search import beam_search
+
+    base_key = jax.random.key(seed_salt)
+    seeds = _make_seeds(dataset, queries, row0, n_seeds, metric, seed_pool,
+                        base_key)
+    d, i = beam_search(queries, dataset, padded_graph, seeds, k, L, w,
+                       max_iters, metric, deg=deg, interpret=interpret)
+    if metric == DistanceType.InnerProduct:
+        d = -d
+    elif metric == DistanceType.L2SqrtExpanded:
+        d = jnp.where(jnp.isfinite(d), jnp.sqrt(jnp.maximum(d, 0.0)), d)
+    return d, i
+
+
 def _resolve_search_algo(params: CagraSearchParams, index: CagraIndex,
                          filter_words) -> bool:
     """True → the one-dispatch Pallas beam kernel; False → XLA path."""
@@ -586,19 +696,11 @@ def search(
            "queries must be (q, dim)")
     if queries.shape[0] == 0:
         return (jnp.zeros((0, k), jnp.float32), jnp.zeros((0, k), jnp.int32))
-    n = index.size
-    L = max(params.itopk_size, k)
-    w = max(1, params.search_width)
-    max_iters = params.max_iterations or (L // w + 24)
+    cfg = derive_search_config(params, index, k, res.seed)
+    L, w, max_iters, n_seeds = (cfg["L"], cfg["w"], cfg["max_iters"],
+                                cfg["n_seeds"])
     filter_words = resolve_filter_words(sample_filter)
     use_kernel = _resolve_search_algo(params, index, filter_words)
-    # ONE seed-count formula for both engines (their parity depends on
-    # drawing identical seed sets): the XLA width, rounded up to a
-    # multiple of the kernel's chunk width C = w*graph_degree.
-    # Duplicate draws are harmless — the merge dedups them.
-    C = w * index.graph_degree
-    n_seeds = max(L, C) * max(1, params.num_random_samplings)
-    n_seeds = -(-n_seeds // C) * C
     if filter_words is not None and filter_words.ndim == 2:
         expect(filter_words.shape[0] == queries.shape[0],
                "per-query BitmapFilter rows must match the query count")
@@ -609,28 +711,14 @@ def search(
         # padded once per index, not per search call or query tile
         # (the kernel DMAs whole 128-lane-aligned adjacency rows)
         padded_graph = index.padded_graph if use_kernel else None
+        base_key = jax.random.key(cfg["seed_salt"])
         for start in range(0, queries.shape[0], tile):
             qt = queries[start : start + tile]
             fw = filter_words
             if fw is not None and fw.ndim == 2:
                 fw = fw[start : start + tile]
-            if params.seed_pool > 0:
-                seeds = _pooled_seeds(index.dataset, qt,
-                                      min(params.seed_pool, n),
-                                      min(n_seeds, params.seed_pool, n),
-                                      index.metric)
-                if seeds.shape[1] < n_seeds:
-                    # pad to the shared width by repeating the best
-                    # seeds (dedup makes repeats free)
-                    reps = -(-n_seeds // seeds.shape[1])
-                    seeds = jnp.tile(seeds, (1, reps))[:, :n_seeds]
-            else:
-                key = jax.random.fold_in(
-                    jax.random.key(res.seed ^ params.rand_xor_mask), start
-                )
-                seeds = jax.random.randint(
-                    key, (qt.shape[0], n_seeds), 0, n, jnp.int32
-                )
+            seeds = _make_seeds(index.dataset, qt, start, n_seeds,
+                                index.metric, params.seed_pool, base_key)
             if use_kernel:
                 from raft_tpu.ops.beam_search import beam_search
 
@@ -646,7 +734,8 @@ def search(
                                   jnp.sqrt(jnp.maximum(d, 0.0)), d)
             else:
                 d, i = _search_batch(index.dataset, index.graph, qt, seeds,
-                                     fw, k, L, w, max_iters, index.metric)
+                                     fw, k=k, L=L, w=w, max_iters=max_iters,
+                                     metric=index.metric)
             outs_d.append(d)
             outs_i.append(i)
         if len(outs_d) == 1:

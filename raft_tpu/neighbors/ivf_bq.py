@@ -424,11 +424,13 @@ def score_probe(lists, qrot, centers_rot, ip, cn, qnorm, codes, scales,
     return jnp.where(ok, dist, pad_val), row_ids
 
 
-@partial(jax.jit, static_argnames=("n_probes", "k", "metric",
-                                   "coarse_algo"))
-def _search_impl(queries, centers, rotation, codes, scales, rn2, indices,
-                 filter_words, n_probes: int, k: int, metric: DistanceType,
-                 coarse_algo: str = "exact"):
+def _search_impl_fn(queries, centers, rotation, codes, scales, rn2, indices,
+                    filter_words, init_d=None, init_i=None, *, n_probes: int,
+                    k: int, metric: DistanceType, coarse_algo: str = "exact"):
+    """Sign-code probe scan. ``init_d``/``init_i`` optionally provide
+    the (q, k) running-state storage (values are reset here); the
+    serving path donates them so the scan state reuses one HBM
+    allocation."""
     q, dim = queries.shape
     select_min = is_min_close(metric)
     qf = queries.astype(jnp.float32)
@@ -467,14 +469,20 @@ def _search_impl(queries, centers, rotation, codes, scales, rn2, indices,
             dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
         return merge_topk(best_d, best_i, dist, row_ids, k, select_min), None
 
-    init = (jnp.full((q, k), pad_val, jnp.float32),
-            jnp.full((q, k), -1, jnp.int32))
+    init = (jnp.full((q, k), pad_val, jnp.float32) if init_d is None
+            else jnp.full_like(init_d, pad_val),
+            jnp.full((q, k), -1, jnp.int32) if init_i is None
+            else jnp.full_like(init_i, -1))
     (best_d, best_i), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
 
     if metric == DistanceType.L2SqrtExpanded:
         best_d = jnp.where(jnp.isfinite(best_d),
                            jnp.sqrt(jnp.maximum(best_d, 0.0)), best_d)
     return best_d, best_i
+
+
+_search_impl = partial(jax.jit, static_argnames=(
+    "n_probes", "k", "metric", "coarse_algo"))(_search_impl_fn)
 
 
 def search(
@@ -504,7 +512,8 @@ def search(
             return _search_impl(
                 qt, index.centers, index.rotation, index.codes,
                 index.scales, index.rnorm2, index.indices, fw,
-                n_probes, k, index.metric, params.coarse_algo)
+                n_probes=n_probes, k=k, metric=index.metric,
+                coarse_algo=params.coarse_algo)
 
         return tile_queries(run, queries, filter_words, query_tile)
 

@@ -206,15 +206,39 @@ def build(
         return extend(res, empty, dataset, jnp.arange(n, dtype=jnp.int32))
 
 
+def _scatter_extend_fn(data, norms, indices, rows, row_norms, ids, list_ids,
+                       ranks):
+    """Scatter new rows into the padded list tensors — the incremental
+    half of ``extend``. With the donating wrapper the big (n_lists,
+    max_list_size, dim) tensor is updated in place: no full repack, no
+    second HBM allocation."""
+    return (data.at[list_ids, ranks].set(rows),
+            norms.at[list_ids, ranks].set(row_norms),
+            indices.at[list_ids, ranks].set(ids))
+
+
+_scatter_extend = jax.jit(_scatter_extend_fn)
+_scatter_extend_donated = jax.jit(_scatter_extend_fn, donate_argnums=(0, 1, 2))
+
+
 def extend(
     res: Optional[Resources],
     index: IvfFlatIndex,
     new_vectors,
     new_indices=None,
+    donate: bool = False,
 ) -> IvfFlatIndex:
     """Add vectors to the index — ``ivf_flat::extend``
     (``detail/ivf_flat_build.cuh:161``). Functional: returns a new index
     (XLA model; the reference mutates device lists in place).
+
+    When the new rows fit inside the existing padding, they are
+    scattered incrementally — O(new) work instead of a full O(total)
+    repack. With ``donate=True`` the old index's list tensors are
+    donated to that scatter, so the rebuild reuses their HBM in place —
+    the serving-ingestion mode; the *old* index object must not be used
+    afterwards. Only the incremental path can donate; a growing padded
+    extent always falls back to the full functional repack.
 
     With ``adaptive_centers`` the centers drift toward the running mean of
     their list (``ivf_flat_types.hpp:57-68``)."""
@@ -236,6 +260,30 @@ def extend(
                     else DistanceType.L2Expanded))
         new_labels = kmeans_balanced.predict(res, km_params, index.centers,
                                              new_vectors.astype(jnp.float32))
+
+        # -- incremental fast path: new rows fit the existing padding.
+        # Slot assignment matches the full repack bit-for-bit (old rows
+        # keep their slots; new rows land at the running fill ranks),
+        # so the two paths produce identical tensors.
+        if index.max_list_size > 0 and not index.adaptive_centers:
+            sizes_new = index.list_sizes + jax.ops.segment_sum(
+                jnp.ones((n_new,), jnp.int32), new_labels,
+                num_segments=index.n_lists)
+            if padded_extent(sizes_new) <= index.max_list_size:
+                lab_np = np.asarray(new_labels)
+                fill = np.asarray(index.list_sizes).astype(np.int64)
+                ranks = streaming_ranks(lab_np, fill, index.n_lists)
+                rows = new_vectors.astype(index.data.dtype)
+                row_norms = jnp.sum(
+                    jnp.square(rows.astype(jnp.float32)), axis=1)
+                scatter = _scatter_extend_donated if donate else _scatter_extend
+                data, norms, indices = scatter(
+                    index.data, index.data_norms, index.indices, rows,
+                    row_norms, new_indices, jnp.asarray(lab_np),
+                    jnp.asarray(ranks))
+                return dataclasses.replace(
+                    index, data=data, data_norms=norms, indices=indices,
+                    list_sizes=sizes_new)
 
         # gather existing rows back to flat form and re-pack everything
         if index.max_list_size > 0:
@@ -377,12 +425,14 @@ def build_streaming(
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("n_probes", "k", "metric",
-                                   "coarse_algo"))
-def _search_impl(queries, centers, center_norms, data, data_norms, indices,
-                 filter_words, n_probes: int, k: int, metric: DistanceType,
-                 coarse_algo: str = "exact"):
-    """Coarse select + probe scan with running top-k merge."""
+def _search_impl_fn(queries, centers, center_norms, data, data_norms, indices,
+                    filter_words, init_d=None, init_i=None, *, n_probes: int,
+                    k: int, metric: DistanceType, coarse_algo: str = "exact"):
+    """Coarse select + probe scan with running top-k merge.
+
+    ``init_d``/``init_i`` optionally provide the (q, k) running-state
+    storage (values are reset here); the serving path donates them so
+    the scan state reuses one HBM allocation across calls."""
     q, d = queries.shape
     n_lists, max_size, _ = data.shape
     select_min = is_min_close(metric)
@@ -425,8 +475,10 @@ def _search_impl(queries, centers, center_norms, data, data_norms, indices,
         return (new_d, new_i), None
 
     init = (
-        jnp.full((q, k), pad_val, jnp.float32),
-        jnp.full((q, k), -1, jnp.int32),
+        jnp.full((q, k), pad_val, jnp.float32) if init_d is None
+        else jnp.full_like(init_d, pad_val),
+        jnp.full((q, k), -1, jnp.int32) if init_i is None
+        else jnp.full_like(init_i, -1),
     )
     (best_d, best_i), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
 
@@ -437,6 +489,10 @@ def _search_impl(queries, centers, center_norms, data, data_norms, indices,
         if metric == DistanceType.L2SqrtExpanded:
             best_d = jnp.where(jnp.isfinite(best_d), jnp.sqrt(best_d), best_d)
     return best_d, best_i
+
+
+_search_impl = partial(jax.jit, static_argnames=(
+    "n_probes", "k", "metric", "coarse_algo"))(_search_impl_fn)
 
 
 def search(
@@ -470,7 +526,8 @@ def search(
             return _search_impl(
                 qt, index.centers, index.center_norms, index.data,
                 index.data_norms, index.indices, fw,
-                n_probes, k, index.metric, params.coarse_algo,
+                n_probes=n_probes, k=k, metric=index.metric,
+                coarse_algo=params.coarse_algo,
             )
 
         return tile_queries(run, queries, filter_words, query_tile)

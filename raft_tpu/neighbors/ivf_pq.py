@@ -479,14 +479,30 @@ def build_streaming(
         )
 
 
+def _scatter_codes_fn(codes, indices, new_codes, ids, list_ids, ranks):
+    """Incremental ``extend`` scatter (see ivf_flat._scatter_extend_fn):
+    new code rows land at the running fill ranks of their lists."""
+    return (codes.at[list_ids, ranks].set(new_codes),
+            indices.at[list_ids, ranks].set(ids))
+
+
+_scatter_codes = jax.jit(_scatter_codes_fn)
+_scatter_codes_donated = jax.jit(_scatter_codes_fn, donate_argnums=(0, 1))
+
+
 def extend(
     res: Optional[Resources],
     index: IvfPqIndex,
     new_vectors,
     new_indices=None,
+    donate: bool = False,
 ) -> IvfPqIndex:
     """Encode + add vectors — ``ivf_pq::extend``. Functional rebuild of the
-    padded code planes."""
+    padded code planes. When the new rows fit the existing padding they
+    are scattered incrementally (O(new), not O(total)); ``donate=True``
+    additionally donates the old code planes to that scatter so the
+    rebuild reuses their HBM in place (the old index object must not be
+    used afterwards)."""
     res = ensure_resources(res)
     new_vectors = jnp.asarray(new_vectors)
     expect(new_vectors.ndim == 2 and new_vectors.shape[1] == index.dim,
@@ -508,6 +524,26 @@ def extend(
         rot = _rotate_residuals(new_vectors, labels, index.centers, index.rotation)
         new_codes = _encode(rot, index.codebooks, labels, index.codebook_kind,
                             index.pq_dim, index.pq_len)
+
+        # -- incremental fast path: new codes fit the existing padding.
+        # Slot assignment matches the full repack bit-for-bit.
+        if index.max_list_size > 0:
+            sizes_new = index.list_sizes + jax.ops.segment_sum(
+                jnp.ones((n_new,), jnp.int32), labels,
+                num_segments=index.n_lists)
+            if padded_extent(sizes_new) <= index.max_list_size:
+                lab_np = np.asarray(labels)
+                fill = np.asarray(index.list_sizes).astype(np.int64)
+                ranks = streaming_ranks(lab_np, fill, index.n_lists)
+                rows = (_pack_nibbles(new_codes) if index.packed
+                        else new_codes)
+                scatter = _scatter_codes_donated if donate else _scatter_codes
+                codes, indices = scatter(
+                    index.codes, index.indices, rows, new_indices,
+                    jnp.asarray(lab_np), jnp.asarray(ranks))
+                return dataclasses.replace(index, codes=codes,
+                                           indices=indices,
+                                           list_sizes=sizes_new)
 
         if index.max_list_size > 0:
             stored = (_unpack_nibbles(index.codes) if index.packed
@@ -684,14 +720,15 @@ def quantize_lut(lut, lut_dtype):
     return lut.astype(lut_dtype), None
 
 
-@partial(jax.jit, static_argnames=("n_probes", "k", "metric", "codebook_kind",
-                                   "lut_dtype", "score_mode", "packed",
-                                   "coarse_algo"))
-def _search_impl(queries, centers, rotation, codebooks, codes, indices,
-                 filter_words, n_probes: int, k: int, metric: DistanceType,
-                 codebook_kind: CodebookKind, lut_dtype,
-                 score_mode: str = "gather", packed: bool = False,
-                 coarse_algo: str = "exact"):
+def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
+                    filter_words, init_d=None, init_i=None, *, n_probes: int,
+                    k: int, metric: DistanceType,
+                    codebook_kind: CodebookKind, lut_dtype,
+                    score_mode: str = "gather", packed: bool = False,
+                    coarse_algo: str = "exact"):
+    """ADC probe scan. ``init_d``/``init_i`` optionally provide the
+    (q, k) running-state storage (values are reset here); the serving
+    path donates them so the scan state reuses one HBM allocation."""
     q, dim = queries.shape
     n_lists, max_size, pq_dim = codes.shape
     if packed:
@@ -758,8 +795,10 @@ def _search_impl(queries, centers, rotation, codebooks, codes, indices,
         return (new_d, new_i), None
 
     init = (
-        jnp.full((q, k), pad_val, jnp.float32),
-        jnp.full((q, k), -1, jnp.int32),
+        jnp.full((q, k), pad_val, jnp.float32) if init_d is None
+        else jnp.full_like(init_d, pad_val),
+        jnp.full((q, k), -1, jnp.int32) if init_i is None
+        else jnp.full_like(init_i, -1),
     )
     (best_d, best_i), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
 
@@ -767,6 +806,11 @@ def _search_impl(queries, centers, rotation, codebooks, codes, indices,
         best_d = jnp.where(jnp.isfinite(best_d),
                            jnp.sqrt(jnp.maximum(best_d, 0.0)), best_d)
     return best_d, best_i
+
+
+_search_impl = partial(jax.jit, static_argnames=(
+    "n_probes", "k", "metric", "codebook_kind", "lut_dtype", "score_mode",
+    "packed", "coarse_algo"))(_search_impl_fn)
 
 
 def search(
@@ -804,9 +848,10 @@ def search(
             return _search_impl(
                 qt, index.centers, index.rotation, index.codebooks,
                 index.codes, index.indices, fw,
-                n_probes, k, index.metric, index.codebook_kind,
-                params.lut_dtype, score_mode, index.packed,
-                params.coarse_algo,
+                n_probes=n_probes, k=k, metric=index.metric,
+                codebook_kind=index.codebook_kind,
+                lut_dtype=params.lut_dtype, score_mode=score_mode,
+                packed=index.packed, coarse_algo=params.coarse_algo,
             )
 
         return tile_queries(run, queries, filter_words, query_tile)

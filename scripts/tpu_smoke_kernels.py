@@ -103,8 +103,9 @@ def main():
             rng.integers(0, 4000, (16, w * deg)).astype(np.int32))
         bd, bi = beam_search(qd, xd[:4000], graph, seeds, 10, L, w, 24,
                              DistanceType.L2Expanded)
-        xd2, xi2 = _search_batch(xd[:4000], graph, qd, seeds, None, 10,
-                                 L, w, 24, DistanceType.L2Expanded)
+        xd2, xi2 = _search_batch(xd[:4000], graph, qd, seeds, None, k=10,
+                                 L=L, w=w, max_iters=24,
+                                 metric=DistanceType.L2Expanded)
         agree = float((np.asarray(bi) == np.asarray(xi2)).mean())
         emit("beam_search", id_agreement_vs_xla=agree,
              max_d_err=float(np.nanmax(np.abs(

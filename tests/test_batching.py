@@ -1,0 +1,99 @@
+"""tile_queries tests: uniform (padded) tile shapes, ragged-tail
+correctness, and 2-D per-query filter-word slicing staying aligned with
+its query tile."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors._batching import pad_rows, tile_queries
+from raft_tpu.neighbors.filters import BitmapFilter
+
+
+class TestTileQueries:
+    def test_uniform_tile_shapes(self, rng_np):
+        """Every tile — including the ragged tail — must arrive at the
+        run callback with the same (query_tile, d) shape, so only ONE
+        program specialization ever compiles."""
+        q = rng_np.standard_normal((10, 3)).astype(np.float32)
+        seen = []
+
+        def run(qt, fw):
+            seen.append(qt.shape)
+            return qt[:, :1], jnp.ones((qt.shape[0], 1), jnp.int32)
+
+        d, i = tile_queries(run, jnp.asarray(q), None, 4)
+        assert seen == [(4, 3), (4, 3), (4, 3)]
+        assert d.shape == (10, 1) and i.shape == (10, 1)
+        np.testing.assert_allclose(np.asarray(d), q[:, :1])
+
+    def test_ragged_tail_correctness(self, rng_np):
+        """Tiled results (with the tail padded into the bucket) must
+        equal the single-shot run exactly."""
+        q = rng_np.standard_normal((11, 4)).astype(np.float32)
+
+        def run(qt, fw):
+            d = jnp.cumsum(qt, axis=1)[:, -2:]
+            return d, jnp.argsort(qt, axis=1)[:, :2].astype(jnp.int32)
+
+        d0, i0 = run(jnp.asarray(q), None)
+        d1, i1 = tile_queries(run, jnp.asarray(q), None, 4)
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_2d_filter_words_stay_aligned(self, rng_np):
+        """Per-query (2-D) filter words must be sliced AND padded with
+        their query tile; a misalignment would feed tile t's queries
+        with tile t±1's filter rows."""
+        q = rng_np.standard_normal((9, 4)).astype(np.float32)
+        fw = jnp.asarray(
+            rng_np.integers(0, 2**31, (9, 2)).astype(np.uint32))
+
+        def run(qt, fwt):
+            assert fwt.shape[0] == qt.shape[0]  # aligned rows
+            # a row-mixing function of (query, filter) so any row
+            # misalignment changes the output
+            d = qt[:, :1] + fwt.astype(jnp.float32).sum(1, keepdims=True)
+            return d, fwt[:, :1].astype(jnp.int32)
+
+        d0, i0 = run(jnp.asarray(q), fw)
+        d1, i1 = tile_queries(run, jnp.asarray(q), fw, 4)
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_1d_filter_words_pass_through(self, rng_np):
+        q = rng_np.standard_normal((7, 2)).astype(np.float32)
+        fw = jnp.asarray(np.array([123, 456], np.uint32))
+
+        def run(qt, fwt):
+            assert fwt is fw  # shared words: not sliced, not padded
+            return qt[:, :1], jnp.zeros((qt.shape[0], 1), jnp.int32)
+
+        d, _ = tile_queries(run, jnp.asarray(q), fw, 3)
+        assert d.shape == (7, 1)
+
+    def test_pad_rows(self):
+        x = jnp.ones((3, 2), jnp.float32)
+        p = pad_rows(x, 5)
+        assert p.shape == (5, 2)
+        np.testing.assert_array_equal(np.asarray(p[3:]), 0.0)
+        assert pad_rows(x, 3) is x
+
+
+class TestEndToEndTiling:
+    def test_ivf_flat_tiled_matches_untiled_with_bitmap(self, rng_np):
+        """Real-index regression: per-query BitmapFilter + small
+        query_tile (forcing a padded ragged tail) must equal the
+        untiled search bit-for-bit."""
+        x = rng_np.standard_normal((400, 8)).astype(np.float32)
+        q = rng_np.standard_normal((11, 8)).astype(np.float32)
+        index = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=8)
+        mask = rng_np.random((11, 400)) < 0.7
+        bm = BitmapFilter.from_mask(mask)
+        d0, i0 = ivf_flat.search(None, p, index, q, 5, sample_filter=bm)
+        d1, i1 = ivf_flat.search(None, p, index, q, 5, sample_filter=bm,
+                                 query_tile=4)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))

@@ -1,0 +1,225 @@
+"""Serving-path tests: SearchExecutor bucketing, AOT executable cache,
+compile-count regression (the steady-state-never-compiles guarantee),
+donated top-k state, and bit-identity with the direct search paths."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from raft_tpu import SearchExecutor
+from raft_tpu.core import tracing
+from raft_tpu.neighbors import brute_force, cagra, ivf_bq, ivf_flat, ivf_pq
+from raft_tpu.neighbors.filters import BitmapFilter, BitsetFilter
+from raft_tpu.core.bitset import Bitset
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((600, 16)).astype(np.float32)
+    q = rng.standard_normal((16, 16)).astype(np.float32)
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def indexes(data):
+    x, _ = data
+    return {
+        "brute_force": brute_force.build(None, x),
+        "ivf_flat": ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=8), x),
+        "ivf_pq": ivf_pq.build(
+            None, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8), x),
+        "ivf_bq": ivf_bq.build(
+            None, ivf_bq.IvfBqIndexParams(n_lists=8), x),
+        "cagra": cagra.build(None, cagra.CagraIndexParams(
+            graph_degree=8, intermediate_graph_degree=16,
+            build_algo=cagra.BuildAlgo.NN_DESCENT), x),
+    }
+
+
+def _direct(name, index, q, k):
+    if name == "brute_force":
+        return brute_force.search(None, index, q, k)
+    if name == "ivf_flat":
+        return ivf_flat.search(
+            None, ivf_flat.IvfFlatSearchParams(n_probes=8), index, q, k)
+    if name == "ivf_pq":
+        return ivf_pq.search(
+            None, ivf_pq.IvfPqSearchParams(n_probes=8), index, q, k)
+    if name == "ivf_bq":
+        return ivf_bq.search(
+            None, ivf_bq.IvfBqSearchParams(n_probes=8), index, q, k)
+    return cagra.search(
+        None, cagra.CagraSearchParams(itopk_size=16), index, q, k)
+
+
+def _params(name):
+    return {
+        "brute_force": None,
+        "ivf_flat": ivf_flat.IvfFlatSearchParams(n_probes=8),
+        "ivf_pq": ivf_pq.IvfPqSearchParams(n_probes=8),
+        "ivf_bq": ivf_bq.IvfBqSearchParams(n_probes=8),
+        "cagra": cagra.CagraSearchParams(itopk_size=16),
+    }[name]
+
+
+class TestBitIdentity:
+    """Acceptance: bucketed serving results are bit-identical to the
+    direct search path for every index family, at batch sizes that do
+    and do not fill their bucket."""
+
+    @pytest.mark.parametrize(
+        "name", ["brute_force", "ivf_flat", "ivf_pq", "ivf_bq", "cagra"])
+    @pytest.mark.parametrize("q_rows", [3, 11, 16])
+    def test_matches_direct(self, data, indexes, name, q_rows):
+        _, q = data
+        ex = SearchExecutor()
+        d0, i0 = _direct(name, indexes[name], q[:q_rows], 5)
+        d1, i1 = ex.search(indexes[name], q[:q_rows], 5,
+                           params=_params(name))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_oversized_batch_tiles(self, data, indexes):
+        x, _ = data
+        rng = np.random.default_rng(3)
+        big = rng.standard_normal((70, 16)).astype(np.float32)
+        ex = SearchExecutor(min_bucket=8, max_bucket=32)
+        d0, i0 = brute_force.search(None, indexes["brute_force"], big, 5)
+        d1, i1 = ex.search(indexes["brute_force"], big, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_oversized_batch_cagra_seeds_stay_aligned(self, data, indexes):
+        """CAGRA seeds are drawn per absolute row; tiles after the
+        first must pass their row offset through, or rows past
+        max_bucket would replay tile 0's seeds."""
+        rng = np.random.default_rng(4)
+        big = rng.standard_normal((70, 16)).astype(np.float32)
+        p = cagra.CagraSearchParams(itopk_size=16)
+        ex = SearchExecutor(min_bucket=8, max_bucket=32)
+        d0, i0 = cagra.search(None, p, indexes["cagra"], big, 5)
+        d1, i1 = ex.search(indexes["cagra"], big, 5, params=p)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_filtered_search(self, data, indexes):
+        x, q = data
+        # shared bitset filter: ban the first half of the ids
+        bs = Bitset.from_mask(
+            np.arange(x.shape[0]) >= x.shape[0] // 2)
+        ex = SearchExecutor()
+        p = ivf_flat.IvfFlatSearchParams(n_probes=8)
+        d0, i0 = ivf_flat.search(None, p, indexes["ivf_flat"], q[:9], 5,
+                                 sample_filter=BitsetFilter(bs))
+        d1, i1 = ex.search(indexes["ivf_flat"], q[:9], 5, params=p,
+                           sample_filter=BitsetFilter(bs))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        # per-query bitmap filter: pad rows get all-zero words
+        mask = np.ones((9, x.shape[0]), bool)
+        mask[:, ::3] = False
+        bm = BitmapFilter.from_mask(mask)
+        d0, i0 = ivf_flat.search(None, p, indexes["ivf_flat"], q[:9], 5,
+                                 sample_filter=bm)
+        d1, i1 = ex.search(indexes["ivf_flat"], q[:9], 5, params=p,
+                           sample_filter=bm)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+
+class TestCompileRegression:
+    """Tier-1 guarantee: within one bucket, steady-state serving
+    triggers ZERO new XLA compilations — asserted against jax's own
+    backend-compile monitoring events, not just the executor's
+    bookkeeping."""
+
+    def test_zero_recompiles_within_bucket(self, data, indexes):
+        _, q = data
+        tracing.install_xla_compile_listener()
+        ex = SearchExecutor()
+        # prime: each batch size once (the search executable compiles
+        # once per bucket; tiny pad/slice programs compile per size)
+        for n in (16, 13, 9):
+            ex.search(indexes["brute_force"], q[:n], 5)
+        compiles0 = ex.stats.compile_count
+        assert compiles0 == 1  # one bucket -> one search executable
+        backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        # steady state: repeats at varying batch sizes within the bucket
+        for n in (16, 13, 9, 13, 16, 9):
+            d, i = ex.search(indexes["brute_force"], q[:n], 5)
+        assert ex.stats.compile_count == compiles0
+        assert tracing.get_counter(tracing.XLA_COMPILE_COUNT) == backend0
+        assert ex.stats.cache_hits >= 8
+
+    def test_counters_exported_via_tracing(self, data, indexes):
+        _, q = data
+        base = tracing.get_counter("serving.compile_count")
+        ex = SearchExecutor()
+        ex.search(indexes["ivf_bq"], q, 5)
+        assert tracing.get_counter("serving.compile_count") >= base + 1
+
+
+class TestWarmup:
+    def test_warmup_precompiles(self, data, indexes):
+        _, q = data
+        ex = SearchExecutor()
+        secs = ex.warmup(indexes["ivf_flat"], buckets=(8, 16), k=5,
+                         params=ivf_flat.IvfFlatSearchParams(n_probes=8))
+        assert secs > 0 and ex.stats.warmup_seconds == secs
+        assert ex.stats.compile_count == 2
+        # first real traffic is a cache hit, not a compile
+        d, i = ex.search(indexes["ivf_flat"], q[:5], 5,
+                         params=ivf_flat.IvfFlatSearchParams(n_probes=8))
+        assert ex.stats.compile_count == 2
+        assert ex.stats.cache_hits == 1
+        assert np.isfinite(np.asarray(d)).all()
+
+    def test_warmup_rejects_unknown_bucket(self, indexes):
+        from raft_tpu.core.validation import RaftError
+
+        ex = SearchExecutor(min_bucket=8, max_bucket=32)
+        with pytest.raises(RaftError):
+            ex.warmup(indexes["brute_force"], buckets=(7,), k=5)
+
+
+class TestCacheAndState:
+    def test_lru_eviction(self, data, indexes):
+        _, q = data
+        ex = SearchExecutor(max_entries=1)
+        ex.search(indexes["brute_force"], q[:4], 5)
+        ex.search(indexes["ivf_flat"], q[:4], 5,
+                  params=ivf_flat.IvfFlatSearchParams(n_probes=8))
+        assert ex.stats.evictions == 1
+        # the evicted brute-force entry recompiles on return
+        ex.search(indexes["brute_force"], q[:4], 5)
+        assert ex.stats.compile_count == 3
+
+    def test_donated_state_keeps_results_valid(self, data, indexes):
+        """With donation forced on, results returned from call N must
+        survive call N+1 reusing the state storage."""
+        _, q = data
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # cpu ignores donation
+            ex = SearchExecutor(donate=True)
+            d1, i1 = ex.search(indexes["brute_force"], q[:16], 5)
+            d1c, i1c = np.asarray(d1).copy(), np.asarray(i1).copy()
+            d2, i2 = ex.search(indexes["brute_force"], q[:9], 5)
+            np.testing.assert_array_equal(np.asarray(d1), d1c)
+            np.testing.assert_array_equal(np.asarray(i1), i1c)
+            d0, i0 = brute_force.search(None, indexes["brute_force"],
+                                        q[:9], 5)
+            np.testing.assert_array_equal(np.asarray(i0), np.asarray(i2))
+            np.testing.assert_array_equal(np.asarray(d0), np.asarray(d2))
+
+    def test_empty_batch(self, indexes):
+        ex = SearchExecutor()
+        d, i = ex.search(indexes["brute_force"], np.zeros((0, 16),
+                                                          np.float32), 5)
+        assert d.shape == (0, 5) and i.shape == (0, 5)
+
+    def test_unsupported_index_type(self):
+        ex = SearchExecutor()
+        with pytest.raises(TypeError):
+            ex.search(object(), np.zeros((2, 4), np.float32), 1)

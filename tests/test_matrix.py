@@ -48,6 +48,18 @@ class TestSelectK:
         got_v, _ = select_k(None, vals, 8)
         np.testing.assert_allclose(np.asarray(got_v), np.sort(vals, 1), rtol=1e-6)
 
+    def test_auto_dispatcher(self):
+        """AUTO resolves per the documented heuristic: full sort when
+        the selection is (near-)full width, top_k otherwise — always an
+        exact algorithm."""
+        from raft_tpu.matrix.select_k import _choose_algo
+
+        assert _choose_algo(4, 100, 100) == SelectAlgo.SORT
+        assert _choose_algo(4, 100, 80) == SelectAlgo.SORT
+        assert _choose_algo(4, 100, 10) == SelectAlgo.TOPK
+        assert _choose_algo(1, 2, 1) == SelectAlgo.TOPK
+        assert _choose_algo(4, 100, 75) == SelectAlgo.TOPK
+
     def test_approx_recall(self, rng_np):
         vals = rng_np.standard_normal((4, 4096)).astype(np.float32)
         k = 10

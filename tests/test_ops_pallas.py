@@ -195,7 +195,8 @@ class TestBeamSearchEdges:
 
         dx, ix = _search_batch(jnp.asarray(x), jnp.asarray(graph),
                                jnp.asarray(q), jnp.asarray(seeds), None,
-                               10, 48, 4, 40, DistanceType.L2Expanded)
+                               k=10, L=48, w=4, max_iters=40,
+                               metric=DistanceType.L2Expanded)
         np.testing.assert_array_equal(np.asarray(i), np.asarray(ix))
         np.testing.assert_allclose(np.asarray(d), np.asarray(dx),
                                    rtol=1e-5, atol=1e-5)

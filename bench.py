@@ -506,6 +506,8 @@ def child_main():
     metric = (f"brute_force_knn_qps_sift1m_shape_b{BATCH}_k{K}{sdt}"
               f"{tag}{suffix}")
 
+    last_rec = {}
+
     def emit(dt):
         # vs_baseline stays normalized by the f32-config roofline: the
         # problem solved (same vectors, queries, k, recall~1) is the
@@ -524,6 +526,8 @@ def child_main():
         }
         if recall is not None:
             rec["recall_at_k_vs_f32_exact"] = round(recall, 4)
+        last_rec.clear()
+        last_rec.update(rec)
         print(json.dumps(rec), flush=True)
 
     stats = timeit_stats(run, BUDGET_S)
@@ -537,39 +541,132 @@ def child_main():
     from raft_tpu.neighbors.brute_force import _use_fused_kernel
     from raft_tpu.ops.fused_topk import fused_knn
 
-    if not _use_fused_kernel(index.metric, K, BATCH):
+    if _use_fused_kernel(index.metric, K, BATCH):
+        def make_passes(m):
+            return lambda: fused_knn(queries, index.dataset, K,
+                                     index.metric,
+                                     dataset_norms=index.norms, passes=m)
+
+        try:
+            from raft_tpu.bench.prims import slope_passes
+
+            lo, hi = slope_passes(index.dataset.dtype)
+            sl = timeit_slope(make_passes, lo, hi)
+            log(f"slope timing: T({sl['m1']})={sl['t1_s'] * 1e3:.1f} ms, "
+                f"T({sl['m2']})={sl['t2_s'] * 1e3:.1f} ms -> "
+                f"{sl['slope_s'] * 1e3:.2f} ms/iter")
+            # sanity gates: no slower than the dispatch-bound number it
+            # refines, and no faster than 1.1x the device HBM roofline
+            # in REAL bytes — a noise-dominated slope must not
+            # overwrite the honest pipelined result. (The old 2 TB/s
+            # ceiling let a physically impossible bf16 slope through in
+            # round 3; any stream "faster" than the roofline is jitter,
+            # not throughput.)
+            itemsize = index.dataset.dtype.itemsize
+            floor_s = (N * D * itemsize) / (1.1 * V5E_HBM_BYTES_PER_S)
+            if floor_s <= sl["slope_s"] <= dt * 1.2:
+                emit(min(sl["slope_s"], dt))
+            else:
+                log(f"slope {sl['slope_s'] * 1e3:.3f} ms outside "
+                    f"[{floor_s * 1e3:.3f}, {dt * 1.2 * 1e3:.3f}] ms; "
+                    "keeping pipelined result")
+        except Exception as e:  # noqa: BLE001 — keep pipelined result
+            log(f"slope timing failed ({e}); keeping pipelined result")
+    else:
         log("fused kernel not in play for this config; keeping "
             "pipelined result")
-        return
 
-    def make_passes(m):
-        return lambda: fused_knn(queries, index.dataset, K, index.metric,
-                                 dataset_norms=index.norms, passes=m)
+    # opt-in rider: IVF-Flat probe-scan engine sweep with
+    # distance-to-roofline annotations; the enriched record re-emits
+    # with the headline fields intact (the parent keeps the LAST line)
+    if os.environ.get("BENCH_IVF_SWEEP") == "1" and last_rec:
+        try:
+            sweep = _ivf_engine_sweep()
+            rec = dict(last_rec)
+            rec["ivf_sweep"] = sweep
+            print(json.dumps(rec), flush=True)
+        except Exception as e:  # noqa: BLE001 — keep headline record
+            log(f"ivf engine sweep failed ({e}); keeping headline record")
 
-    try:
-        from raft_tpu.bench.prims import slope_passes
 
-        lo, hi = slope_passes(index.dataset.dtype)
-        sl = timeit_slope(make_passes, lo, hi)
-        log(f"slope timing: T({sl['m1']})={sl['t1_s'] * 1e3:.1f} ms, "
-            f"T({sl['m2']})={sl['t2_s'] * 1e3:.1f} ms -> "
-            f"{sl['slope_s'] * 1e3:.2f} ms/iter")
-        # sanity gates: no slower than the dispatch-bound number it
-        # refines, and no faster than 1.1x the device HBM roofline in
-        # REAL bytes — a noise-dominated slope must not overwrite the
-        # honest pipelined result. (The old 2 TB/s ceiling let a
-        # physically impossible bf16 slope through in round 3; any
-        # stream "faster" than the roofline is jitter, not throughput.)
-        itemsize = index.dataset.dtype.itemsize
-        floor_s = (N * D * itemsize) / (1.1 * V5E_HBM_BYTES_PER_S)
-        if floor_s <= sl["slope_s"] <= dt * 1.2:
-            emit(min(sl["slope_s"], dt))
-        else:
-            log(f"slope {sl['slope_s'] * 1e3:.3f} ms outside "
-                f"[{floor_s * 1e3:.3f}, {dt * 1.2 * 1e3:.3f}] ms; "
-                "keeping pipelined result")
-    except Exception as e:  # noqa: BLE001 — keep the pipelined result
-        log(f"slope timing failed ({e}); keeping pipelined result")
+def _ivf_engine_sweep():
+    """BENCH_IVF_SWEEP=1 rider: A/B the IVF-Flat probe-scan engines
+    (pallas list-major / xla list-major / legacy rank-major) through
+    the serving path. Each case carries the modeled probe-scan HBM
+    bytes (gathered lists for rank-major, the probed-list union
+    streamed once for list-major) converted to achieved GB/s, next to
+    a ``stream_read_sum`` roofline probe of the same packed tensor —
+    so the BENCH json shows distance-to-roofline, not just wall time.
+    Env knobs: BENCH_IVF_N / BENCH_IVF_LISTS / BENCH_IVF_PROBES /
+    BENCH_IVF_SECONDS (per-case budget)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu import SearchExecutor
+    from raft_tpu.bench.prims import timeit_stats
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.ops.fused_topk import stream_read_sum
+    from raft_tpu.ops.ivf_scan import resolve_scan_engine, unique_lists
+
+    n = int(os.environ.get("BENCH_IVF_N", 200_000))
+    n_lists = int(os.environ.get("BENCH_IVF_LISTS", 256))
+    n_probes = int(os.environ.get("BENCH_IVF_PROBES", 20))
+    budget = float(os.environ.get("BENCH_IVF_SECONDS", 8))
+    kd, kq = jax.random.split(jax.random.key(1))
+    x = jax.random.normal(kd, (n, D), jnp.float32)
+    queries = jax.random.normal(kq, (BATCH, D), jnp.float32)
+    log(f"ivf sweep: building index ({n}x{D}, {n_lists} lists)")
+    index = ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(
+        n_lists=n_lists, kmeans_n_iters=10), x)
+    m = index.max_list_size
+    itemsize = index.data.dtype.itemsize
+    jax.block_until_ready(index.data)
+
+    # roofline: a pure streamed read of the packed list tensor — the
+    # ceiling every scan engine is judged against
+    flat = index.data.reshape(n_lists * m, D)
+    interp = jax.default_backend() != "tpu"
+    st = timeit_stats(lambda: stream_read_sum(flat, interpret=interp),
+                      min(budget, 6.0))
+    roof_gbps = flat.size * itemsize / st["best_s"] / 1e9
+    log(f"ivf sweep roofline (stream_read_sum): {roof_gbps:.1f} GB/s")
+
+    # probed-union size for the list-major bytes model
+    qf = queries.astype(jnp.float32)
+    ip = qf @ index.centers.T
+    score = -(index.center_norms[None, :] - 2.0 * ip)
+    probes = jax.lax.top_k(score, n_probes)[1].astype(jnp.int32)
+    n_union = int((np.asarray(unique_lists(probes, n_lists))
+                   < n_lists).sum())
+
+    slot_bytes = D * itemsize + 8          # data row + norm + id
+    cases = []
+    for engine in ("pallas", "xla", "rank"):
+        resolved = resolve_scan_engine(engine, data=index.data, k=K)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=n_probes,
+                                         scan_engine=engine)
+        ex = SearchExecutor()
+        ex.warmup(index, buckets=(ex.bucket_for(BATCH),), k=K, params=p)
+        stats = timeit_stats(
+            lambda: ex.search(index, queries, K, params=p), budget)
+        dt = stats["best_s"]
+        bytes_model = (BATCH * n_probes * m * slot_bytes
+                       if resolved == "rank"
+                       else n_union * m * slot_bytes)
+        gbps = bytes_model / dt / 1e9
+        cases.append({
+            "engine": engine, "resolved": resolved,
+            "best_s": round(dt, 6), "qps": round(BATCH / dt, 2),
+            "model_bytes": bytes_model,
+            "achieved_gbps": round(gbps, 2),
+            "vs_roofline": round(gbps / roof_gbps, 4),
+        })
+        log(f"ivf sweep {engine}->{resolved}: {dt * 1e3:.2f} ms/iter, "
+            f"{gbps:.1f} GB/s ({gbps / roof_gbps:.3f} of roofline)")
+    return {"n": n, "dim": D, "n_lists": n_lists, "n_probes": n_probes,
+            "batch": BATCH, "max_list_size": m, "union_lists": n_union,
+            "roofline_gbps": round(roof_gbps, 2), "cases": cases}
 
 
 def _list_cpu_hogs():

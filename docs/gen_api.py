@@ -10,6 +10,7 @@ import dataclasses
 import importlib
 import inspect
 import pathlib
+import re
 
 MODULES = [
     "raft_tpu.core.resources", "raft_tpu.core.executor",
@@ -59,9 +60,13 @@ def first_para(obj) -> str:
 
 def sig_of(obj) -> str:
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
+    # callable defaults repr with a process-specific address
+    # ("<function sum at 0x7f...>"); strip it so regeneration is
+    # byte-stable across runs/machines
+    return re.sub(r" at 0x[0-9a-f]+", "", sig)
 
 
 def public_symbols(m, name):

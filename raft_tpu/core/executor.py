@@ -391,19 +391,30 @@ class SearchExecutor:
 
     def _plan_ivf_flat(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import ivf_flat as m
+        from raft_tpu.ops.ivf_scan import resolve_scan_engine
 
         params = params or m.IvfFlatSearchParams()
         expect(index.max_list_size > 0, "index is empty — extend() it first")
         n_probes = min(params.n_probes, index.n_lists)
+        # the resolved engine is part of the static set and therefore of
+        # the AOT cache key: switching engines compiles a new executable
+        # instead of silently reusing the wrong one, and bucketing /
+        # warmup / donation behave per engine
+        engine = resolve_scan_engine(params.scan_engine, data=index.data,
+                                     filter_words=fw, k=k)
         static = {"n_probes": n_probes, "k": k, "metric": index.metric,
-                  "coarse_algo": params.coarse_algo}
+                  "coarse_algo": params.coarse_algo, "scan_engine": engine}
         arrays = (index.centers, index.center_norms, index.data,
                   index.data_norms, index.indices)
         key = ("ivf_flat", bucket, _sig(*arrays),
                tuple(sorted((n, str(v)) for n, v in static.items())),
                _filter_spec(fw))
+        # the rank-major and XLA list-major scans thread the donated
+        # (q, k) running state through HBM; the Pallas kernel keeps
+        # its state in VMEM scratch, so donated buffers would go unused
         return _Plan(key=key, fn=m._search_impl_fn, static=static,
-                     post=arrays, use_filter=True, qdim=index.dim)
+                     post=arrays, use_filter=True, qdim=index.dim,
+                     has_state=engine != "pallas")
 
     def _plan_ivf_pq(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import ivf_pq as m
@@ -412,16 +423,20 @@ class SearchExecutor:
         expect(index.max_list_size > 0, "index is empty — extend() it first")
         score_mode = m.resolve_score_mode(params.score_mode,
                                           index.pq_book_size)
+        engine = m.resolve_scan_engine(params.scan_engine)
         static = {"n_probes": min(params.n_probes, index.n_lists), "k": k,
                   "metric": index.metric,
                   "codebook_kind": index.codebook_kind,
                   "lut_dtype": params.lut_dtype, "score_mode": score_mode,
-                  "packed": index.packed, "coarse_algo": params.coarse_algo}
+                  "packed": index.packed, "coarse_algo": params.coarse_algo,
+                  "scan_engine": engine}
         arrays = (index.centers, index.rotation, index.codebooks,
                   index.codes, index.indices)
         key = ("ivf_pq", bucket, _sig(*arrays),
                tuple(sorted((n, str(v)) for n, v in static.items())),
                _filter_spec(fw))
+        # both PQ scan engines build their lax.scan carry from the
+        # donated init buffers — keep PR 1's donation on either path
         return _Plan(key=key, fn=m._search_impl_fn, static=static,
                      post=arrays, use_filter=True, qdim=index.dim)
 

@@ -40,9 +40,18 @@ class SelectAlgo(enum.Enum):
     TOPK = "topk"          # exact lax.top_k
     APPROX = "approx"      # lax.approx_min_k / approx_max_k
     SORT = "sort"          # full sort (exact + stable)
+    TILES = "tiles"        # streamed Pallas merge (ops.select_k_tiles)
 
 
-def _choose_algo(batch: int, n: int, k: int) -> SelectAlgo:
+# TILES routing thresholds: below this width lax.top_k's fused lowering
+# wins; above it the streamed merge reads the row once at HBM rate.
+# The merge network unrolls k rounds, so big k stays on top_k.
+_TILES_MIN_N = 16384
+_TILES_MAX_K = 64
+
+
+def _choose_algo(batch: int, n: int, k: int,
+                 dtype=jnp.float32) -> SelectAlgo:
     """Heuristic dispatcher (role of ``choose_select_k_algorithm``,
     ``matrix/detail/select_k-inl.cuh:219``). AUTO always resolves to an
     *exact* algorithm — the reference's select_k is exact, so the
@@ -55,16 +64,44 @@ def _choose_algo(batch: int, n: int, k: int) -> SelectAlgo:
     - near-full selection (k > 3n/4): the ``top_k`` lowering still
       materializes an order over essentially the whole row, so the
       stable sort is no slower and gives deterministic ties.
+    - wide rows on a real TPU (n >= 16k, small k, float input that the
+      kernel's f32 compare path represents exactly — f32/bf16/f16):
+      the streamed Pallas merge (``ops.select_k_tiles`` — the
+      radix/warpsort-select analog) reads the row exactly once at HBM
+      rate with a VMEM running state; ties keep the first occurrence,
+      like ``top_k``. Caveat it shares with the kNN kernels: a row
+      with fewer than k *finite* entries fills the remainder with
+      index -1 (top_k would return the positions of the non-finite
+      entries). Off-TPU (and thus under interpret) ``lax.top_k``
+      stays the dispatcher's choice — the merge is only forced via
+      ``algo=TILES`` there.
     - otherwise: ``lax.top_k``, which lowers onto the TPU's native
       sort/top-k units (the TPU-KNN peak-FLOP/s recipe).
     """
     if k == n or k * 4 > n * 3:
         return SelectAlgo.SORT
+    if (n >= _TILES_MIN_N and k <= _TILES_MAX_K
+            and jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
+                                     jnp.dtype(jnp.bfloat16),
+                                     jnp.dtype(jnp.float16))
+            and jax.default_backend() == "tpu"):
+        return SelectAlgo.TILES
     return SelectAlgo.TOPK
 
 
 @partial(jax.jit, static_argnames=("k", "select_min", "algo", "recall_target"))
 def _select_k_impl(values, k: int, select_min: bool, algo: SelectAlgo, recall_target: float):
+    if algo == SelectAlgo.TILES:
+        # lazy import: matrix.select_k is imported by the ops package's
+        # kernels, so a module-level import would be circular
+        from raft_tpu.ops.fused_topk import select_k_tiles
+
+        vals, idx = select_k_tiles(values, k, select_min,
+                                   interpret=jax.default_backend() != "tpu")
+        # the kernel streams in f32; hand back the caller's dtype so
+        # AUTO's route never flips the public output dtype (sub-f32
+        # inputs round-trip exactly through the f32 compare path)
+        return vals.astype(values.dtype), idx
     if algo == SelectAlgo.SORT:
         order = jnp.argsort(values, axis=-1, descending=not select_min, stable=True)
         idx = order[..., :k]
@@ -143,7 +180,7 @@ def select_k(
     n = values.shape[1]
     expect(0 < k <= n, f"k must be in (0, {n}], got {k}")
     if algo == SelectAlgo.AUTO:
-        algo = _choose_algo(values.shape[0], n, k)
+        algo = _choose_algo(values.shape[0], n, k, values.dtype)
     with tracing.range("raft_tpu.select_k"):
         vals, idx = _select_k_impl(values, k, select_min, algo, recall_target)
     if index_values is not None:

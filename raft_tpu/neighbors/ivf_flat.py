@@ -11,12 +11,16 @@ centers, then a fused ``interleaved_scan`` kernel over probed lists.
 TPU re-design (SURVEY.md §7.4): raggedness is the enemy of XLA, so lists
 live in ONE dense padded tensor ``data[n_lists, max_list_size, dim]``
 (max_list_size = padded max cluster population; balanced k-means keeps the
-overhead ≈2× worst case). The probe scan becomes a ``lax.scan`` over probe
-ranks: gather one probed list per query (a dense row gather), one batched
-MXU GEMM per rank, mask padding slots to +inf, and merge into a running
-top-k — the same streamed-merge shape as brute force. Per-slot squared
-norms are precomputed so the scan is a pure ``norms - 2 x·y`` epilog
-(the reference caches norms the same way, ``ivf_flat_types.hpp``).
+overhead ≈2× worst case). The probe scan is pluggable
+(``IvfFlatSearchParams.scan_engine``): the default **list-major**
+engines (:mod:`raft_tpu.ops.ivf_scan` — fused Pallas kernel on TPU,
+XLA scan elsewhere) stream each probed list once and score it against
+the whole query tile in one dense MXU GEMM, with a per-query
+membership mask; the legacy **rank-major** engine is a ``lax.scan``
+over probe ranks gathering one probed list per query into a batched
+GEMM. Per-slot squared norms are precomputed so every engine's scan is
+a pure ``norms - 2 x·y`` epilog (the reference caches norms the same
+way, ``ivf_flat_types.hpp``).
 
 int8/uint8 datasets are stored packed and upcast inside the scan
 (reference supports float/int8/uint8, ``ivf_flat_types.hpp:49-68``).
@@ -75,10 +79,18 @@ class IvfFlatSearchParams(SearchParams):
     """Mirrors ``ivf_flat::search_params``. ``coarse_algo="approx"``
     routes cluster selection through the TPU's native approximate top-k
     unit (``lax.approx_min_k``) — worthwhile at 10k+ lists where the
-    exact sort dominates the coarse stage."""
+    exact sort dominates the coarse stage.
+
+    ``scan_engine`` selects the probe-scan formulation
+    (:mod:`raft_tpu.ops.ivf_scan`): ``"auto"`` is the fused list-major
+    Pallas kernel on TPU and the list-major XLA scan elsewhere;
+    ``"pallas"``/``"xla"`` force an engine (pallas degrades to xla when
+    its preconditions fail — see ``resolve_scan_engine``); ``"rank"``
+    is the legacy rank-major gather scan."""
 
     n_probes: int = 20
     coarse_algo: str = "exact"   # "exact" | "approx"
+    scan_engine: str = "auto"    # "auto" | "pallas" | "xla" | "rank"
 
 
 @jax.tree_util.register_pytree_node_class
@@ -427,12 +439,18 @@ def build_streaming(
 
 def _search_impl_fn(queries, centers, center_norms, data, data_norms, indices,
                     filter_words, init_d=None, init_i=None, *, n_probes: int,
-                    k: int, metric: DistanceType, coarse_algo: str = "exact"):
+                    k: int, metric: DistanceType, coarse_algo: str = "exact",
+                    scan_engine: str = "rank"):
     """Coarse select + probe scan with running top-k merge.
 
     ``init_d``/``init_i`` optionally provide the (q, k) running-state
     storage (values are reset here); the serving path donates them so
-    the scan state reuses one HBM allocation across calls."""
+    the scan state reuses one HBM allocation across calls (rank-major
+    engine only — the list-major engines carry their state in VMEM).
+
+    ``scan_engine`` must arrive resolved (``rank``/``pallas``/``xla``,
+    via :func:`raft_tpu.ops.ivf_scan.resolve_scan_engine`): it is a jit
+    static, so an unresolved ``"auto"`` would fork the compile cache."""
     q, d = queries.shape
     n_lists, max_size, _ = data.shape
     select_min = is_min_close(metric)
@@ -450,37 +468,52 @@ def _search_impl_fn(queries, centers, center_norms, data, data_norms, indices,
 
     pad_val = jnp.inf if select_min else -jnp.inf
 
-    # ---- probe scan: one gathered list + one batched GEMM per probe rank
-    def step(carry, rank):
-        best_d, best_i = carry
-        lists = probes[:, rank]                                  # (q,)
-        rows = jnp.take(data, lists, axis=0).astype(jnp.float32)  # (q, m, d)
-        row_norms = jnp.take(data_norms, lists, axis=0)          # (q, m)
-        row_ids = jnp.take(indices, lists, axis=0)               # (q, m)
-        ipr = jax.lax.dot_general(
-            rows, qf, (((2,), (1,)), ((0,), (0,))),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32,
-        )                                                        # (q, m)
-        if metric == DistanceType.InnerProduct:
-            dist = jnp.where(row_ids >= 0, ipr, pad_val)
-        else:
-            dist = row_norms - 2.0 * ipr                         # +||q||^2 later
-            dist = jnp.where(row_ids >= 0, dist, pad_val)
-        if filter_words is not None:
-            bits = test_filter(filter_words, row_ids)
-            dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
+    if scan_engine != "rank":
+        # ---- list-major probe scan (ops/ivf_scan): stream each unique
+        # probed list once, one dense GEMM per list for the whole tile.
+        # The XLA engine reuses the donated running state; the Pallas
+        # kernel's state lives in VMEM scratch and ignores it.
+        from raft_tpu.ops.ivf_scan import list_major_scan
 
-        new_d, new_i = merge_topk(best_d, best_i, dist, row_ids, k, select_min)
-        return (new_d, new_i), None
+        best_d, best_i = list_major_scan(
+            qf, data, data_norms, indices, probes, filter_words,
+            init_d, init_i, k=k, metric=metric, engine=scan_engine,
+            interpret=jax.default_backend() != "tpu")
+    else:
+        # ---- rank-major probe scan: one gathered list + one batched
+        # GEMM per probe rank
+        def step(carry, rank):
+            best_d, best_i = carry
+            lists = probes[:, rank]                              # (q,)
+            rows = jnp.take(data, lists, axis=0).astype(
+                jnp.float32)                                     # (q, m, d)
+            row_norms = jnp.take(data_norms, lists, axis=0)      # (q, m)
+            row_ids = jnp.take(indices, lists, axis=0)           # (q, m)
+            ipr = jax.lax.dot_general(
+                rows, qf, (((2,), (1,)), ((0,), (0,))),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )                                                    # (q, m)
+            if metric == DistanceType.InnerProduct:
+                dist = jnp.where(row_ids >= 0, ipr, pad_val)
+            else:
+                dist = row_norms - 2.0 * ipr                     # +||q||^2 later
+                dist = jnp.where(row_ids >= 0, dist, pad_val)
+            if filter_words is not None:
+                bits = test_filter(filter_words, row_ids)
+                dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
 
-    init = (
-        jnp.full((q, k), pad_val, jnp.float32) if init_d is None
-        else jnp.full_like(init_d, pad_val),
-        jnp.full((q, k), -1, jnp.int32) if init_i is None
-        else jnp.full_like(init_i, -1),
-    )
-    (best_d, best_i), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
+            new_d, new_i = merge_topk(best_d, best_i, dist, row_ids, k,
+                                      select_min)
+            return (new_d, new_i), None
+
+        init = (
+            jnp.full((q, k), pad_val, jnp.float32) if init_d is None
+            else jnp.full_like(init_d, pad_val),
+            jnp.full((q, k), -1, jnp.int32) if init_i is None
+            else jnp.full_like(init_i, -1),
+        )
+        (best_d, best_i), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
 
     if metric != DistanceType.InnerProduct:
         q_sq = jnp.sum(jnp.square(qf), axis=1, keepdims=True)
@@ -492,7 +525,7 @@ def _search_impl_fn(queries, centers, center_norms, data, data_norms, indices,
 
 
 _search_impl = partial(jax.jit, static_argnames=(
-    "n_probes", "k", "metric", "coarse_algo"))(_search_impl_fn)
+    "n_probes", "k", "metric", "coarse_algo", "scan_engine"))(_search_impl_fn)
 
 
 def search(
@@ -509,9 +542,11 @@ def search(
 
     ``sample_filter``: a Bitset or any :mod:`raft_tpu.neighbors.filters`
     type. Large query sets are processed in ``query_tile`` batches (the
-    reference's max_queries=4096 batching loop). Returns (distances,
-    indices) of shape (q, k); missing slots (when fewer than k valid
-    candidates were probed) have index -1."""
+    reference's max_queries=4096 batching loop). The probe-scan engine
+    follows ``params.scan_engine`` (resolved per backend/shape by
+    :func:`raft_tpu.ops.ivf_scan.resolve_scan_engine`). Returns
+    (distances, indices) of shape (q, k); missing slots (when fewer
+    than k valid candidates were probed) have index -1."""
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -521,13 +556,17 @@ def search(
            f"coarse_algo must be 'exact' or 'approx', got {params.coarse_algo!r}")
     n_probes = min(params.n_probes, index.n_lists)
     filter_words = resolve_filter_words(sample_filter)
+    from raft_tpu.ops.ivf_scan import resolve_scan_engine
+
+    scan_engine = resolve_scan_engine(
+        params.scan_engine, data=index.data, filter_words=filter_words, k=k)
     with tracing.range("raft_tpu.ivf_flat.search"):
         def run(qt, fw):
             return _search_impl(
                 qt, index.centers, index.center_norms, index.data,
                 index.data_norms, index.indices, fw,
                 n_probes=n_probes, k=k, metric=index.metric,
-                coarse_algo=params.coarse_algo,
+                coarse_algo=params.coarse_algo, scan_engine=scan_engine,
             )
 
         return tile_queries(run, queries, filter_words, query_tile)

@@ -98,6 +98,13 @@ class IvfPqSearchParams(SearchParams):
     # approximate top-k unit — worthwhile at 10k+ lists (same knob as
     # IvfFlatSearchParams.coarse_algo)
     coarse_algo: str = "exact"
+    # probe-scan formulation (same knob as IvfFlatSearchParams):
+    # "rank" gathers one probed list per query per probe rank; "xla"
+    # scans the *union* of probed lists list-major (ops/ivf_scan) —
+    # each list's codes stream from HBM once and score against the
+    # whole query tile. "auto" = list-major on TPU (the gather is the
+    # scalar-core bottleneck there), rank-major elsewhere.
+    scan_engine: str = "auto"
     # f32 / bf16 / float8_e4m3fn — the reference's fp32/fp16/fp8 LUT
     # ladder (ivf_pq_compute_similarity-inl.cuh:125-177). fp8 quarters
     # the LUT's VMEM footprint (the probe-tile bound); scoring upcasts
@@ -579,6 +586,19 @@ def extend(
 # ---------------------------------------------------------------------------
 
 
+def resolve_scan_engine(engine: str) -> str:
+    """Resolve the PQ probe-scan formulation. ``auto`` is the
+    list-major union scan on TPU (per-query list gathers bottleneck on
+    the scalar core there) and the rank-major gather scan elsewhere.
+    There is no Pallas PQ engine (yet) — see ARCHITECTURE.md "IVF scan
+    engines" for the measured reasoning."""
+    expect(engine in ("auto", "xla", "rank"),
+           f"scan_engine must be auto|xla|rank, got {engine!r}")
+    if engine == "auto":
+        return "xla" if jax.default_backend() == "tpu" else "rank"
+    return engine
+
+
 def resolve_score_mode(score_mode: str, book_size: int = 256) -> str:
     """Resolve "auto" per backend: dynamic per-element gathers lower to
     the TPU scalar core (measured ~18x slower than the one-hot MXU
@@ -725,10 +745,18 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
                     k: int, metric: DistanceType,
                     codebook_kind: CodebookKind, lut_dtype,
                     score_mode: str = "gather", packed: bool = False,
-                    coarse_algo: str = "exact"):
+                    coarse_algo: str = "exact", scan_engine: str = "rank"):
     """ADC probe scan. ``init_d``/``init_i`` optionally provide the
     (q, k) running-state storage (values are reset here); the serving
-    path donates them so the scan state reuses one HBM allocation."""
+    path donates them so the scan state reuses one HBM allocation.
+
+    ``scan_engine`` must arrive resolved (``rank``/``xla`` via
+    :func:`resolve_scan_engine` — it is a jit static). ``rank`` scans
+    probe ranks with per-query gathered code rows; ``xla`` scans the
+    union of probed lists list-major (``ops/ivf_scan`` formulation):
+    each unique list's code plane streams once, scores against every
+    query in the tile, and a per-query membership predicate masks
+    queries that did not probe it."""
     q, dim = queries.shape
     n_lists, max_size, pq_dim = codes.shape
     if packed:
@@ -764,24 +792,18 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
         qsub_fixed = None
         lut_fixed = None
 
-    # ---- per-probe LUT + code scoring scan
-    def step(carry, rank):
-        best_d, best_i = carry
-        lists = probes[:, rank]                        # (q,)
+    # ---- shared per-probe scoring: LUT build + ADC code scan
+    score = score_fn(score_mode, book_size)
+
+    def probe_dist(lists, rows, row_ids):
+        """(q,) list ids + unpacked (q, m, pq_dim) code rows + (q, m)
+        ids -> masked (q, m) dist."""
         c = centers[lists]                             # (q, dim)
         lut, base = _probe_lut(
             qf, c, qsub_fixed, lut_fixed, rotation, codebooks, lists,
             ip_query, codebook_kind == CodebookKind.PER_CLUSTER)
         lut, lut_scale = quantize_lut(lut, lut_dtype)  # (q, pq_dim, J)
-
-        rows = jnp.take(codes, lists, axis=0)          # (q, m, pq_dim) u8
-        if packed:
-            # nibble-unpack in VMEM right after the HBM gather — the
-            # stream stays half-width end to end
-            rows = _unpack_nibbles(rows)
-        row_ids = jnp.take(indices, lists, axis=0)     # (q, m)
         # score codes: dist[q, m] = sum_s lut[q, s, rows[q, m, s]]
-        score = score_fn(score_mode, book_size)
         dist = score(lut, rows)
         if lut_scale is not None:
             dist = dist * lut_scale
@@ -790,17 +812,70 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
         if filter_words is not None:
             bits = test_filter(filter_words, row_ids)
             dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
+        return dist
 
-        new_d, new_i = merge_topk(best_d, best_i, dist, row_ids, k, select_min)
-        return (new_d, new_i), None
+    if scan_engine != "rank":
+        # list-major: scan the union of probed lists; one streamed
+        # code plane per unique list scores the whole query tile. The
+        # scan runs in min-space with the smallest-id tie-break merge
+        # (shared with the ivf_flat engines), so exact ADC ties — easy
+        # to hit after quantization — resolve deterministically and
+        # independently of the list visitation order; IP negates back
+        # after the scan (exact for floats).
+        from raft_tpu.ops.ivf_scan import _merge_smallest_id, unique_lists
 
-    init = (
-        jnp.full((q, k), pad_val, jnp.float32) if init_d is None
-        else jnp.full_like(init_d, pad_val),
-        jnp.full((q, k), -1, jnp.int32) if init_i is None
-        else jnp.full_like(init_i, -1),
-    )
-    (best_d, best_i), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
+        def step(carry, lid):
+            best_d, best_i = carry
+            lidc = jnp.minimum(lid, n_lists - 1)       # sentinel-safe
+            lists = jnp.full((q,), lidc, jnp.int32)
+            rows1 = jax.lax.dynamic_index_in_dim(codes, lidc, 0, False)
+            ids1 = jax.lax.dynamic_index_in_dim(indices, lidc, 0, False)
+            if packed:
+                rows1 = _unpack_nibbles(rows1)  # once, before broadcast
+            rows = jnp.broadcast_to(rows1[None], (q,) + rows1.shape)
+            row_ids = jnp.broadcast_to(ids1[None], (q, ids1.shape[0]))
+            dist = probe_dist(lists, rows, row_ids)
+            if not select_min:
+                dist = -dist                           # to min-space
+            probed = jnp.any(probes == lid, axis=1)    # (q,) membership
+            dist = jnp.where(probed[:, None], dist, jnp.inf)
+            return _merge_smallest_id(best_d, best_i, dist, row_ids,
+                                      k), None
+
+        init = (
+            jnp.full((q, k), jnp.inf, jnp.float32) if init_d is None
+            else jnp.full_like(init_d, jnp.inf),
+            jnp.full((q, k), -1, jnp.int32) if init_i is None
+            else jnp.full_like(init_i, -1),
+        )
+        (best_d, best_i), _ = jax.lax.scan(step, init,
+                                           unique_lists(probes, n_lists))
+        if not select_min:
+            best_d = -best_d       # inf (unfilled) -> -inf, like rank
+    else:
+
+        def step(carry, rank):
+            best_d, best_i = carry
+            lists = probes[:, rank]                    # (q,)
+            rows = jnp.take(codes, lists, axis=0)      # (q, m, pq_dim) u8
+            if packed:
+                # nibble-unpack right after the HBM gather — the
+                # stream stays half-width end to end
+                rows = _unpack_nibbles(rows)
+            row_ids = jnp.take(indices, lists, axis=0)  # (q, m)
+            dist = probe_dist(lists, rows, row_ids)
+            new_d, new_i = merge_topk(best_d, best_i, dist, row_ids, k,
+                                      select_min)
+            return (new_d, new_i), None
+
+        init = (
+            jnp.full((q, k), pad_val, jnp.float32) if init_d is None
+            else jnp.full_like(init_d, pad_val),
+            jnp.full((q, k), -1, jnp.int32) if init_i is None
+            else jnp.full_like(init_i, -1),
+        )
+        (best_d, best_i), _ = jax.lax.scan(step, init,
+                                           jnp.arange(n_probes))
 
     if metric == DistanceType.L2SqrtExpanded:
         best_d = jnp.where(jnp.isfinite(best_d),
@@ -810,7 +885,7 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
 
 _search_impl = partial(jax.jit, static_argnames=(
     "n_probes", "k", "metric", "codebook_kind", "lut_dtype", "score_mode",
-    "packed", "coarse_algo"))(_search_impl_fn)
+    "packed", "coarse_algo", "scan_engine"))(_search_impl_fn)
 
 
 def search(
@@ -843,6 +918,7 @@ def search(
            f"{params.lut_dtype}")
     filter_words = resolve_filter_words(sample_filter)
     score_mode = resolve_score_mode(params.score_mode, index.pq_book_size)
+    scan_engine = resolve_scan_engine(params.scan_engine)
     with tracing.range("raft_tpu.ivf_pq.search"):
         def run(qt, fw):
             return _search_impl(
@@ -852,6 +928,7 @@ def search(
                 codebook_kind=index.codebook_kind,
                 lut_dtype=params.lut_dtype, score_mode=score_mode,
                 packed=index.packed, coarse_algo=params.coarse_algo,
+                scan_engine=scan_engine,
             )
 
         return tile_queries(run, queries, filter_words, query_tile)

@@ -5,5 +5,16 @@ numerics; on TPU hardware they compile to Mosaic.
 """
 
 from raft_tpu.ops.fused_topk import fused_knn, select_k_tiles
+from raft_tpu.ops.ivf_scan import (
+    list_major_scan,
+    resolve_scan_engine,
+    unique_lists,
+)
 
-__all__ = ["fused_knn", "select_k_tiles"]
+__all__ = [
+    "fused_knn",
+    "select_k_tiles",
+    "list_major_scan",
+    "resolve_scan_engine",
+    "unique_lists",
+]

@@ -42,6 +42,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.ops.fused_topk import _COMPILER_PARAMS
 from raft_tpu.neighbors._exact import dedup_candidate_mask
 from raft_tpu.ops.fused_topk import _default_vmem_mb, _extract_topk
 
@@ -364,7 +365,7 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
             jax.ShapeDtypeStruct((qp, k), jnp.float32),
             jax.ShapeDtypeStruct((qp, k), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=vmem_mb * 1024 * 1024),
         interpret=interpret,

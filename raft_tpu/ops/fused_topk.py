@@ -30,6 +30,11 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType
 
+# jax renamed TPUCompilerParams -> CompilerParams (jax 0.5); accept both
+# so the kernels load on either side of the rename
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
 _SUPPORTED_METRICS = (
     DistanceType.L2Expanded,
     DistanceType.L2SqrtExpanded,
@@ -275,7 +280,7 @@ def _fused_knn_impl(
             pltpu.VMEM((qp, k), jnp.float32),
             pltpu.VMEM((qp, k), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             vmem_limit_bytes=vmem_mb * 1024 * 1024),
         interpret=interpret,
     )(qs, qn, xs, xn)
@@ -436,7 +441,7 @@ def _stream_read_impl(x, tile: int, vmem_mb: int, interpret: bool):
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((1, dpad), jnp.float32),
         scratch_shapes=[pltpu.VMEM((1, dpad), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             vmem_limit_bytes=vmem_mb * 1024 * 1024),
         interpret=interpret,
     )(x)[:, :d]

@@ -1,0 +1,384 @@
+"""List-major IVF probe scan — the TPU port of the reference's flagship
+``ivf_flat_interleaved_scan`` (``detail/ivf_flat_interleaved_scan-inl.cuh``),
+re-designed per the two papers the survey flags for this kernel:
+
+- TPU-KNN (arxiv 2206.14286): peak FLOP/s on TPU means expressing kNN as
+  large dense contractions with an in-register merge — never as gathers
+  feeding batched matvecs.
+- Ragged Paged Attention (arxiv 2604.15464): the TPU-native way to fetch
+  data-dependent pages is a **scalar-prefetched block index map** — the
+  page table (here: the probed-list union) rides ahead of the grid in
+  SMEM and steers each step's HBM->VMEM block DMA.
+
+The rank-major scan (``ivf_flat._search_impl_fn`` with
+``scan_engine="rank"``) gathers one probed list *per query* per probe
+rank: a `(q, m, d)` HBM materialization and a gather-bound batched
+matvec, repeated ``n_probes`` times. This module turns the scan
+**list-major**: compute the union of probed list ids for the whole
+query tile (sort/unique on device, padded to a static cap with a
+sentinel id ``n_lists``), then stream each unique list's
+``(max_list_size, d)`` block from the packed ``data`` tensor exactly
+once and contract it against the *entire* query tile in one MXU GEMM.
+A per-query "did this query probe this list" predicate masks rows out,
+so results match the rank-major scan (indices exactly; distances to
+XLA's dot-reassociation tolerance — the same caveat as
+``beam_search``'s two lowerings). Per-probe HBM traffic drops from
+``q * n_probes`` gathered lists to at most ``min(n_lists,
+q * n_probes)`` streamed lists, and the matvecs become dense GEMMs.
+
+Two engines share the formulation:
+
+- ``pallas``: the fused kernel. Grid ``(query_tiles, n_unique)``; the
+  unique-list array is the scalar-prefetch operand steering the
+  ``data``/``data_norms`` BlockSpec index maps; the running ``(q, k)``
+  top-k lives in VMEM scratch and merges via the
+  ``ops.fused_topk._extract_topk`` network with the ``any_better``
+  skip. Shared (1-D) bitset filters fold into the gathered id planes
+  before the kernel (a filtered slot becomes id -1 — padding).
+- ``xla``: the same union/mask/merge as a ``lax.scan`` over unique
+  lists, merging via one lexicographic two-key ``lax.sort`` (the same
+  smallest-id tie-break as the kernel, any k without unrolling) — the
+  portable fallback (CPU/GPU, 2-D per-query filters, int8 storage,
+  large k, misaligned layouts on TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.ops.fused_topk import (
+    _COMPILER_PARAMS,
+    _default_vmem_mb,
+    _extract_topk,
+)
+
+SCAN_ENGINES = ("auto", "pallas", "xla", "rank")
+
+# the merge network unrolls k rounds; past this the XLA merge wins
+_PALLAS_MAX_K = 128
+
+
+def resolve_scan_engine(engine: str, *, data=None, filter_words=None,
+                        k=None, vmem_mb: int = 0) -> str:
+    """Resolve a ``scan_engine`` search param to a concrete engine.
+
+    ``auto`` is the Pallas kernel on TPU and the list-major XLA scan
+    elsewhere. ``pallas`` degrades to ``xla`` when the kernel's
+    preconditions fail: per-query (2-D) filter words (the id-fold
+    trick needs one shared id plane), non-f32/bf16 storage (Mosaic
+    block tiling), ``k`` past the unrolled-merge budget, or a single
+    list block that cannot fit the VMEM budget double-buffered.
+    ``rank`` is the legacy rank-major gather scan, kept for parity
+    testing and as the small-``n_lists`` escape hatch."""
+    expect(engine in SCAN_ENGINES,
+           f"scan_engine must be one of {SCAN_ENGINES}, got {engine!r}")
+    if engine == "auto":
+        engine = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if engine != "pallas":
+        return engine
+    if filter_words is not None and getattr(filter_words, "ndim", 1) == 2:
+        return "xla"
+    if k is not None and k > _PALLAS_MAX_K:
+        return "xla"
+    if data is not None:
+        if data.dtype not in (jnp.float32, jnp.bfloat16):
+            return "xla"
+        itemsize = 2 if data.dtype == jnp.bfloat16 else 4
+        sub = 16 if itemsize == 2 else 8
+        m_pad = -(-data.shape[1] // sub) * sub
+        d_pad = -(-data.shape[2] // 128) * 128
+        # on real hardware a misaligned layout would force _scan_pallas
+        # to jnp.pad the WHOLE packed tensor per call — a full HBM
+        # read+write dwarfing the probe scan — so compiled runs demand
+        # build-time alignment (padded_extent gives m % 8; lane-aligned
+        # dims like 128/256 give d). Interpret mode (off-TPU) keeps the
+        # pad path: it exists so CPU CI can cover the kernel at any
+        # test shape.
+        if jax.default_backend() == "tpu" and (
+                m_pad != data.shape[1] or d_pad != data.shape[2]):
+            return "xla"
+        if vmem_mb <= 0:
+            vmem_mb = _default_vmem_mb()
+        # mirror _scan_pallas's budget: the list block + margin fixed
+        # cost must leave room for at least one minimal (8-row) query
+        # tile — otherwise the kernel's q_tile floor would overshoot
+        # vmem_limit_bytes and fail Mosaic compilation instead of
+        # degrading here. p_pad is unknown at resolve time; 256 covers
+        # n_probes up to 256 conservatively.
+        fixed = 3 * m_pad * (d_pad * itemsize + 8) + (2 << 20)
+        per_q = 4 * (d_pad + 256) + 24 * m_pad + 16 * (k or _PALLAS_MAX_K)
+        if fixed + 8 * per_q > vmem_mb << 20:
+            return "xla"
+    return engine
+
+
+def unique_lists(probes: jax.Array, n_lists: int) -> jax.Array:
+    """Sorted union of probed list ids, padded to the static cap
+    ``min(n_lists, q * n_probes)`` with the sentinel id ``n_lists``.
+
+    The sentinel never matches any row of ``probes``, so the per-query
+    membership predicate masks sentinel steps out wholesale — the
+    ragged union rides a fixed shape, the same tail-masking discipline
+    as ``fused_topk``'s partial final block."""
+    q, p = probes.shape
+    cap = min(n_lists, q * p)
+    flat = jnp.sort(probes.reshape(-1).astype(jnp.int32))
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), flat[1:] != flat[:-1]])
+    rank = jnp.cumsum(first) - 1          # unique slot of each element
+    slot = jnp.where(first, rank, cap)    # non-first -> out of range
+    uniq = jnp.full((cap,), n_lists, jnp.int32)
+    return uniq.at[slot].set(flat, mode="drop")
+
+
+def list_major_scan(qf, data, data_norms, indices, probes,
+                    filter_words=None, init_d=None, init_i=None, *,
+                    k: int, metric: DistanceType, engine: str = "xla",
+                    interpret: bool = False):
+    """Run the probe scan list-major; returns the pre-epilog running
+    top-k ``(best_d, best_i)`` in the rank-major scan's convention
+    (min-space ``norms - 2 x·y`` for L2 with +inf pads; raw inner
+    products for IP with -inf pads), so the caller's metric epilog is
+    shared across engines.
+
+    Both engines break distance ties by smallest dataset id (the
+    ``_extract_topk`` order), so their outputs are bit-identical to
+    each other even on exact duplicates. ``init_d``/``init_i``
+    optionally provide the (q, k) running-state storage for the XLA
+    engine (values are reset; the serving path donates them); the
+    Pallas engine keeps its state in VMEM scratch and ignores them."""
+    expect(engine in ("pallas", "xla"),
+           f"list_major_scan engine must be pallas|xla, got {engine!r}")
+    if engine == "pallas":
+        return _scan_pallas(qf, data, data_norms, indices, probes,
+                            filter_words, k=k, metric=metric,
+                            interpret=interpret)
+    return _scan_xla(qf, data, data_norms, indices, probes, filter_words,
+                     init_d, init_i, k=k, metric=metric)
+
+
+# ---------------------------------------------------------------------------
+# XLA list-major engine
+# ---------------------------------------------------------------------------
+
+
+def _merge_smallest_id(best_d, best_i, dist, ids, k: int):
+    """Min-space running top-k merge with the smallest-id tie-break —
+    the ``_extract_topk`` order as one lexicographic two-key sort, so
+    the XLA engine matches the Pallas kernel bit-for-bit on exact
+    ties (``merge_topk``'s positional tie-break would not), and any k
+    works without unrolling k rounds."""
+    cat_d = jnp.concatenate([best_d, dist], axis=1)
+    cat_i = jnp.concatenate([best_i, ids], axis=1)
+    sd, si = jax.lax.sort((cat_d, cat_i), dimension=1, num_keys=2)
+    sd, si = sd[:, :k], si[:, :k]
+    return sd, jnp.where(jnp.isfinite(sd), si, -1)
+
+
+def _scan_xla(qf, data, data_norms, indices, probes, filter_words,
+              init_d=None, init_i=None, *, k: int, metric: DistanceType):
+    from raft_tpu.neighbors.filters import test_filter
+
+    q = qf.shape[0]
+    n_lists = data.shape[0]
+    ip_metric = metric == DistanceType.InnerProduct
+    uniq = unique_lists(probes, n_lists)
+
+    # min-space scan like the Pallas kernel (IP negates back at the
+    # end — exact for floats), so the tie-break order is identical
+    def step(carry, lid):
+        best_d, best_i = carry
+        lidc = jnp.minimum(lid, n_lists - 1)      # sentinel-safe index
+        rows = jax.lax.dynamic_index_in_dim(
+            data, lidc, 0, False).astype(jnp.float32)         # (m, d)
+        row_ids = jax.lax.dynamic_index_in_dim(indices, lidc, 0, False)
+        ip = jax.lax.dot_general(
+            qf, rows, (((1,), (1,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        )                                                      # (q, m)
+        if ip_metric:
+            dist = -ip
+        else:
+            row_norms = jax.lax.dynamic_index_in_dim(
+                data_norms, lidc, 0, False)
+            dist = row_norms[None, :] - 2.0 * ip
+        ids_b = jnp.broadcast_to(row_ids[None, :], dist.shape)
+        probed = jnp.any(probes == lid, axis=1)                # (q,)
+        ok = (ids_b >= 0) & probed[:, None]
+        if filter_words is not None:
+            ok = ok & test_filter(filter_words, ids_b)
+        dist = jnp.where(ok, dist, jnp.inf)
+        return _merge_smallest_id(best_d, best_i, dist, ids_b, k), None
+
+    init = (
+        jnp.full((q, k), jnp.inf, jnp.float32) if init_d is None
+        else jnp.full_like(init_d, jnp.inf),
+        jnp.full((q, k), -1, jnp.int32) if init_i is None
+        else jnp.full_like(init_i, -1),
+    )
+    (best_d, best_i), _ = jax.lax.scan(step, init, uniq)
+    if ip_metric:
+        best_d = -best_d          # inf (unfilled) -> -inf, ip exact
+    return best_d, best_i
+
+
+# ---------------------------------------------------------------------------
+# Pallas list-major engine
+# ---------------------------------------------------------------------------
+
+
+def _ivf_scan_kernel(u_ref, probes_ref, q_ref, x_ref, xn_ref, ids_ref,
+                     outd_ref, outi_ref, bestd, besti, *, k: int,
+                     n_steps: int, ip_metric: bool):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        bestd[:] = jnp.full_like(bestd, jnp.inf)
+        besti[:] = jnp.full_like(besti, -1)
+
+    lid = u_ref[j]                        # scalar-prefetched list id
+    # ONE dense (q_tile, d) x (d, m) MXU contraction for the whole
+    # query tile against the whole list — the TPU-KNN shape. Storage
+    # upcasts to f32 so bf16 lists match the rank-major scan's math.
+    xt = x_ref[0].astype(jnp.float32)     # (m, d)
+    ip = jax.lax.dot_general(
+        q_ref[:], xt, (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )                                     # (q_tile, m)
+    # min-space distances; IP negates back at the final step
+    dist = -ip if ip_metric else xn_ref[:] - 2.0 * ip
+    ids = ids_ref[:]                      # (1, m) — -1 marks pad/filtered
+    # membership predicate: which tile rows actually probed this list
+    # (the sentinel id n_lists matches no row, masking ragged tails)
+    probed = jnp.any(probes_ref[:] == lid, axis=1, keepdims=True)
+    dist = jnp.where((ids >= 0) & probed, dist, jnp.inf)
+
+    # filtered merge: skip the k-round extraction when no row improves
+    kth = bestd[:, k - 1 : k]
+    any_better = jnp.any(dist < kth)
+
+    @pl.when(any_better)
+    def _():
+        cat_d = jnp.concatenate([bestd[:], dist], axis=1)
+        cat_i = jnp.concatenate(
+            [besti[:], jnp.broadcast_to(ids, dist.shape)], axis=1)
+        new_d, new_i = _extract_topk(cat_d, cat_i, k)
+        bestd[:] = new_d
+        besti[:] = new_i
+
+    @pl.when(j == n_steps - 1)
+    def _():
+        outd_ref[:] = -bestd[:] if ip_metric else bestd[:]
+        outi_ref[:] = besti[:]
+
+
+def _scan_pallas(qf, data, data_norms, indices, probes, filter_words, *,
+                 k: int, metric: DistanceType, interpret: bool,
+                 vmem_mb: int = 0):
+    from raft_tpu.neighbors.filters import test_filter
+
+    q, d = qf.shape
+    n_lists, m, _ = data.shape
+    ip_metric = metric == DistanceType.InnerProduct
+    if vmem_mb <= 0:
+        vmem_mb = _default_vmem_mb()
+    itemsize = 2 if data.dtype == jnp.bfloat16 else 4
+    sub = 16 if itemsize == 2 else 8
+
+    uniq = unique_lists(probes, n_lists)
+    n_steps = uniq.shape[0]
+
+    # gathered id planes, one per unique list (4 B/slot — 1/32 of the
+    # d=128 data stream); a shared bitset filter folds in here: a
+    # filtered slot becomes id -1, i.e. padding, so the kernel needs no
+    # per-element word gathers (Mosaic lowers those to the scalar core)
+    ids_g = jnp.take(indices, jnp.minimum(uniq, n_lists - 1), axis=0)
+    if filter_words is not None:
+        bits = test_filter(filter_words, ids_g)
+        ids_g = jnp.where(bits & (ids_g >= 0), ids_g, -1)
+
+    # lane/sublane alignment; all no-ops on aligned serving layouts
+    # (padded_extent rounds max_list_size to 8, d=128-multiples common)
+    m_pad = -(-m // sub) * sub
+    d_pad = -(-d // 128) * 128
+    if m_pad != m or d_pad != d:
+        data = jnp.pad(data, ((0, 0), (0, m_pad - m), (0, d_pad - d)))
+        data_norms = jnp.pad(data_norms, ((0, 0), (0, m_pad - m)))
+        ids_g = jnp.pad(ids_g, ((0, 0), (0, m_pad - m)),
+                        constant_values=-1)
+    p = probes.shape[1]
+    p_pad = -(-p // 128) * 128
+
+    # query-tile sizing from the VMEM budget: double-buffered list
+    # block + f32 upcast strip are the fixed cost; per query row the
+    # kernel keeps the query vector, the probe row, the (m) dist/cat
+    # intermediates (~24 B) and the (k) running state
+    budget = (vmem_mb << 20) - 3 * m_pad * (d_pad * itemsize + 8) - (2 << 20)
+    per_q = 4 * (d_pad + p_pad) + 24 * m_pad + 16 * k
+    q_tile = min(max(8, (budget // per_q) // 8 * 8), -(-q // 8) * 8)
+    q_pad = -(-q // q_tile) * q_tile
+
+    qs = jnp.pad(qf.astype(jnp.float32),
+                 ((0, q_pad - q), (0, d_pad - d)))
+    # pad probe rows/cols with -1: a pad query probes nothing, so its
+    # running state stays empty and its rows are sliced away
+    probes_p = jnp.pad(probes.astype(jnp.int32),
+                       ((0, q_pad - q), (0, p_pad - p)),
+                       constant_values=-1)
+
+    kernel = functools.partial(_ivf_scan_kernel, k=k, n_steps=n_steps,
+                               ip_metric=ip_metric)
+    clamp = n_lists - 1
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(q_pad // q_tile, n_steps),
+        in_specs=[
+            pl.BlockSpec((q_tile, p_pad), lambda i, j, u: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_tile, d_pad), lambda i, j, u: (i, 0),
+                         memory_space=pltpu.VMEM),
+            # the scalar-prefetched dynamic index map: step j streams
+            # list u[j]'s block; the sentinel clamps to a real list and
+            # is masked by the membership predicate
+            pl.BlockSpec((1, m_pad, d_pad),
+                         lambda i, j, u: (jnp.minimum(u[j], clamp), 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m_pad),
+                         lambda i, j, u: (jnp.minimum(u[j], clamp), 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, m_pad), lambda i, j, u: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((q_tile, k), lambda i, j, u: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((q_tile, k), lambda i, j, u: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((q_tile, k), jnp.float32),
+            pltpu.VMEM((q_tile, k), jnp.int32),
+        ],
+    )
+    outd, outi = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((q_pad, k), jnp.float32),
+            jax.ShapeDtypeStruct((q_pad, k), jnp.int32),
+        ),
+        compiler_params=_COMPILER_PARAMS(
+            vmem_limit_bytes=vmem_mb << 20),
+        interpret=interpret,
+    )(uniq, probes_p, qs, data, data_norms, ids_g)
+    return outd[:q], outi[:q]

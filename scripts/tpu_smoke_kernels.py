@@ -88,6 +88,39 @@ def main():
     except Exception as e:  # noqa: BLE001
         emit("stream_read_sum", error=str(e)[:300])
 
+    # ---- ivf_scan compiled: list-major Pallas probe scan vs the
+    # rank-major XLA scan on the same index (ids must agree exactly;
+    # distances to dot-reassociation tolerance), plus the pallas-vs-
+    # xla-list-major pair which shares one contraction and must match
+    # bit-for-bit
+    try:
+        from raft_tpu.neighbors import ivf_flat
+
+        xs = jnp.asarray(rng.standard_normal((20_000, 128), ).astype(
+            np.float32))
+        qs = jnp.asarray(rng.standard_normal((16, 128)).astype(np.float32))
+        index = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=64,
+                                              kmeans_n_iters=5), xs)
+        outs = {}
+        for eng in ("rank", "xla", "pallas"):
+            sp = ivf_flat.IvfFlatSearchParams(n_probes=8, scan_engine=eng)
+            d, i = ivf_flat.search(None, sp, index, qs, 10)
+            outs[eng] = (np.asarray(d), np.asarray(i))
+        emit("ivf_scan",
+             pallas_ids_vs_rank=float(
+                 (outs["pallas"][1] == outs["rank"][1]).mean()),
+             pallas_bits_vs_xla=bool(
+                 (outs["pallas"][0] == outs["xla"][0]).all()
+                 and (outs["pallas"][1] == outs["xla"][1]).all()),
+             max_d_err_vs_rank=float(np.nanmax(np.abs(
+                 np.where(np.isfinite(outs["pallas"][0]),
+                          outs["pallas"][0], 0.0)
+                 - np.where(np.isfinite(outs["rank"][0]),
+                            outs["rank"][0], 0.0)))))
+    except Exception as e:  # noqa: BLE001
+        emit("ivf_scan", error=str(e)[:300])
+
     # ---- beam_search compiled vs the XLA engine (same seeds)
     try:
         from raft_tpu.neighbors.cagra import _search_batch

@@ -51,7 +51,9 @@ class TestSelectK:
     def test_auto_dispatcher(self):
         """AUTO resolves per the documented heuristic: full sort when
         the selection is (near-)full width, top_k otherwise — always an
-        exact algorithm."""
+        exact algorithm. The TILES (Pallas streamed merge) route only
+        engages on a real TPU backend, so on the CPU test mesh wide
+        rows stay on top_k."""
         from raft_tpu.matrix.select_k import _choose_algo
 
         assert _choose_algo(4, 100, 100) == SelectAlgo.SORT
@@ -59,6 +61,22 @@ class TestSelectK:
         assert _choose_algo(4, 100, 10) == SelectAlgo.TOPK
         assert _choose_algo(1, 2, 1) == SelectAlgo.TOPK
         assert _choose_algo(4, 100, 75) == SelectAlgo.TOPK
+        assert _choose_algo(4, 1 << 20, 10) == SelectAlgo.TOPK  # cpu mesh
+
+    def test_tiles_matches_topk(self, rng_np):
+        """TILES (the Pallas streamed merge behind AUTO's wide-row TPU
+        route; interpret mode here) must match the top_k path exactly,
+        including the stable first-occurrence tie-break."""
+        v = rng_np.standard_normal((5, 20000)).astype(np.float32)
+        v[:, 1000] = v[:, 40]  # cross-tile duplicates exercise ties
+        v[:, 3] = v[:, 2]      # adjacent duplicates too
+        for select_min in (True, False):
+            d_t, i_t = select_k(None, v, 9, select_min=select_min,
+                                algo=SelectAlgo.TOPK)
+            d_p, i_p = select_k(None, v, 9, select_min=select_min,
+                                algo=SelectAlgo.TILES)
+            np.testing.assert_array_equal(np.asarray(i_t), np.asarray(i_p))
+            np.testing.assert_array_equal(np.asarray(d_t), np.asarray(d_p))
 
     def test_approx_recall(self, rng_np):
         vals = rng_np.standard_normal((4, 4096)).astype(np.float32)

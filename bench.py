@@ -36,7 +36,11 @@ BENCH_DTYPE (float32|bfloat16 dataset storage; default bfloat16 on
 TPU — validated in-run against exact-f32 ids — and float32 on CPU) /
 BENCH_PROBE_PLAN ("timeout:sleep,timeout:sleep,..." probe schedule) /
 BENCH_CHILD_DEADLINE (seconds before the parent abandons a child) /
-RAFT_TPU_DISABLE_FUSED=1 (force the XLA tile-scan path).
+RAFT_TPU_DISABLE_FUSED=1 (force the XLA tile-scan path). Opt-in
+riders: BENCH_IVF_SWEEP=1 (probe-scan engine A/B with roofline
+annotations), BENCH_MULTICHIP=1 (mesh-native serving: per-chip QPS,
+compile counts and modeled lean collective bytes for the list-sharded
+index across every visible chip).
 """
 
 import json
@@ -588,6 +592,17 @@ def child_main():
         except Exception as e:  # noqa: BLE001 — keep headline record
             log(f"ivf engine sweep failed ({e}); keeping headline record")
 
+    # opt-in rider: mesh-native serving — list-sharded IVF through the
+    # mesh-aware executor across every visible chip
+    if os.environ.get("BENCH_MULTICHIP") == "1" and last_rec:
+        try:
+            mc = _multichip_rider()
+            rec = dict(last_rec)
+            rec["multichip"] = mc
+            print(json.dumps(rec), flush=True)
+        except Exception as e:  # noqa: BLE001 — keep headline record
+            log(f"multichip rider failed ({e}); keeping headline record")
+
 
 def _ivf_engine_sweep():
     """BENCH_IVF_SWEEP=1 rider: A/B the IVF-Flat probe-scan engines
@@ -667,6 +682,89 @@ def _ivf_engine_sweep():
     return {"n": n, "dim": D, "n_lists": n_lists, "n_probes": n_probes,
             "batch": BATCH, "max_list_size": m, "union_lists": n_union,
             "roofline_gbps": round(roof_gbps, 2), "cases": cases}
+
+
+def _multichip_rider():
+    """BENCH_MULTICHIP=1 rider: the mesh-native serving path — a
+    list-sharded IVF-Flat index over EVERY visible chip, searched
+    through the mesh-aware ``SearchExecutor``. Emits per-chip and
+    aggregate QPS per scan engine, compile counts (executor bookkeeping
+    + jax's backend-compile ground truth, so a recompiling steady state
+    is machine-visible), and the modeled lean collective payloads
+    (O(q · n_probes) probe candidates, O(q · k) merge, per wire_dtype)
+    next to the dense coarse-block baseline they replaced. Env knobs:
+    BENCH_MC_N / BENCH_MC_LISTS / BENCH_MC_PROBES / BENCH_MC_SECONDS
+    (per-case budget)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu import SearchExecutor
+    from raft_tpu.bench.prims import timeit_stats
+    from raft_tpu.comms import local_comms
+    from raft_tpu.core import tracing
+    from raft_tpu.distributed import ivf as dist_ivf
+    from raft_tpu.neighbors import ivf_flat
+
+    n = int(os.environ.get("BENCH_MC_N", 200_000))
+    n_lists = int(os.environ.get("BENCH_MC_LISTS", 512))
+    n_probes = int(os.environ.get("BENCH_MC_PROBES", 20))
+    budget = float(os.environ.get("BENCH_MC_SECONDS", 8))
+    n_dev = len(jax.devices())
+    comms = local_comms()
+    tracing.install_xla_compile_listener()
+
+    kd, kq = jax.random.split(jax.random.key(2))
+    x = jax.random.normal(kd, (n, D), jnp.float32)
+    queries = jax.random.normal(kq, (BATCH, D), jnp.float32)
+    log(f"multichip: building sharded index ({n}x{D}, {n_lists} lists, "
+        f"{n_dev} chips)")
+    tracing.reset_counters("distributed.build.")
+    index = dist_ivf.build(None, comms, ivf_flat.IvfFlatIndexParams(
+        n_lists=n_lists, kmeans_n_iters=10), x)
+    build_peak = tracing.get_counter(
+        "distributed.build.peak_deal_block_bytes")
+
+    cases = []
+    for engine, wire in (("auto", "f32"), ("auto", "bf16"),
+                         ("rank", "f32")):
+        from raft_tpu.ops.ivf_scan import resolve_scan_engine
+
+        resolved = resolve_scan_engine(engine, data=index.data, k=K)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=n_probes,
+                                         scan_engine=engine)
+        ex = SearchExecutor()
+        ex.warmup(index, buckets=(ex.bucket_for(BATCH),), k=K, params=p,
+                  wire_dtype=wire)
+        # one primer call so the per-batch-size pad/place micro-programs
+        # compile outside the measured (and counted) window
+        ex.search(index, queries, K, params=p, wire_dtype=wire)
+        backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        stats = timeit_stats(
+            lambda: ex.search(index, queries, K, params=p,
+                              wire_dtype=wire), budget)
+        dt = stats["best_s"]
+        model = dist_ivf.collective_payload_model(
+            BATCH, K, n_probes, index.n_lists, comms.size, wire)
+        cases.append({
+            "engine": engine, "resolved": resolved, "wire_dtype": wire,
+            "best_s": round(dt, 6),
+            "qps": round(BATCH / dt, 2),
+            "qps_per_chip": round(BATCH / dt / n_dev, 2),
+            "compile_count": ex.stats.compile_count,
+            "backend_compiles_during_measure": (
+                tracing.get_counter(tracing.XLA_COMPILE_COUNT) - backend0),
+            "modeled_collective_bytes": model,
+        })
+        log(f"multichip {engine}/{wire}->{resolved}: "
+            f"{dt * 1e3:.2f} ms/iter, {BATCH / dt / n_dev:.1f} QPS/chip, "
+            f"coarse {model['coarse_bytes']}B vs dense "
+            f"{model['dense_coarse_bytes']}B, merge "
+            f"{model['merge_bytes']}B")
+    return {"n": n, "dim": D, "n_lists": n_lists, "n_probes": n_probes,
+            "batch": BATCH, "n_chips": n_dev,
+            "build_peak_deal_block_bytes": int(build_peak),
+            "cases": cases}
 
 
 def _list_cpu_hogs():

@@ -37,6 +37,31 @@ class Op(enum.Enum):
     MAX = "max"
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable ``shard_map`` — THE spelling every mesh program
+    in this repo goes through: jax >= 0.6 exposes ``jax.shard_map``
+    (validity-checking flag named ``check_vma``); 0.4.x/0.5.x ship it
+    as ``jax.experimental.shard_map.shard_map`` (``check_rep``). The
+    compat alias plays the same role as ``ops.fused_topk``'s
+    ``_COMPILER_PARAMS`` rename shim does for Pallas."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
+def axis_size(axis: str) -> int:
+    """Static mesh-axis size inside a mapped program. jax >= 0.6 has
+    ``jax.lax.axis_size``; earlier versions statically fold
+    ``psum(1, axis)`` — the classic idiom."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis)
+    return jax.lax.psum(1, axis)
+
+
 # ---------------------------------------------------------------------------
 # collectives — call inside shard_map over the named axis
 # ---------------------------------------------------------------------------
@@ -83,6 +108,33 @@ def allgather(x, axis: str = "data", tiled: bool = False):
     return jax.lax.all_gather(x, axis, tiled=tiled)
 
 
+# low-precision wire formats for result-carrying collectives — the
+# EQuARX move (PAPERS.md): the ICI payload shrinks, the math around the
+# collective stays full precision. "f32" is the identity.
+WIRE_DTYPES = ("f32", "bf16")
+
+
+def resolve_wire_dtype(wire_dtype: str):
+    """Map a ``wire_dtype`` param to its jnp dtype (validating)."""
+    if wire_dtype not in WIRE_DTYPES:
+        raise ValueError(
+            f"wire_dtype must be one of {WIRE_DTYPES}, got {wire_dtype!r}")
+    return jnp.float32 if wire_dtype == "f32" else jnp.bfloat16
+
+
+def allgather_wire(x, axis: str = "data", wire_dtype: str = "f32"):
+    """:func:`allgather` with an optional low-precision wire format:
+    the payload is cast to ``wire_dtype`` *before* the collective (so
+    the gather moves half the bytes for bf16) and upcast back after.
+    Callers that merge gathered candidates should re-rank the ties the
+    compression creates deterministically (the distributed searches
+    tie-break by exact id)."""
+    wd = resolve_wire_dtype(wire_dtype)
+    if x.dtype == wd:
+        return jax.lax.all_gather(x, axis)
+    return jax.lax.all_gather(x.astype(wd), axis).astype(x.dtype)
+
+
 def gather(x, root: int = 0, axis: str = "data", tiled: bool = False):
     """``comms_t::gather`` (valid on every rank, superset of reference;
     per-link cost on ICI matches a rooted gather — see
@@ -105,7 +157,7 @@ def reducescatter(x, op: Op = Op.SUM, axis: str = "data"):
     """``comms_t::reducescatter`` → psum_scatter over the leading dim."""
     if op != Op.SUM:
         gathered = allreduce(x, op, axis)
-        n = jax.lax.axis_size(axis)
+        n = axis_size(axis)
         rank = jax.lax.axis_index(axis)
         block = x.shape[0] // n
         return jax.lax.dynamic_slice_in_dim(gathered, rank * block, block)
@@ -115,7 +167,7 @@ def reducescatter(x, op: Op = Op.SUM, axis: str = "data"):
 def alltoall(x, axis: str = "data"):
     """``comms_t`` device_multicast/alltoall: exchange row blocks so rank
     r receives block r from every rank (``lax.all_to_all``)."""
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     blocks = x.reshape((n, x.shape[0] // n) + x.shape[1:])
     return jax.lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0)
 
@@ -124,7 +176,7 @@ def device_send(x, dest_offset: int = 1, axis: str = "data"):
     """Ring send: rank r's value moves to rank (r + dest_offset) % n —
     the p2p pattern expressible on the ICI torus (``comms_t::device_send``;
     arbitrary pairs route through :func:`device_sendrecv` perms)."""
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + dest_offset) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis, perm)
 
@@ -152,7 +204,7 @@ def rank(axis: str = "data"):
 
 def size(axis: str = "data"):
     """``comms_t::get_size``."""
-    return jax.lax.axis_size(axis)
+    return axis_size(axis)
 
 
 # ---------------------------------------------------------------------------
@@ -209,7 +261,7 @@ class Comms:
     ):
         """shard_map ``fn`` over this mesh: the body may call the module's
         collectives with ``axis=self.axis``."""
-        return jax.shard_map(
+        return shard_map(
             fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             check_vma=check_vma,
         )(*args)

@@ -34,7 +34,12 @@ provides the backend-compile ground truth that the tier-1 recompile
 regression test asserts on.
 
 Supported index types: ``BruteForceIndex``, ``IvfFlatIndex``,
-``IvfPqIndex``, ``IvfBqIndex``, ``CagraIndex``.
+``IvfPqIndex``, ``IvfBqIndex``, ``CagraIndex``, and the mesh-sharded
+``DistributedIvfFlat`` / ``DistributedIvfPq`` / ``DistributedIvfBq``
+(AOT-compiled per (mesh, index shapes, params, resolved scan engine,
+bucket): queries bucket exactly like the single-chip families, are
+placed replicated on the mesh, and the per-shard running top-k state
+is donated — steady-state multi-chip serving is zero-recompile).
 
 Small print: padding/slicing a batch to/from its bucket executes tiny
 device ops whose programs XLA caches per distinct batch size — the
@@ -98,6 +103,12 @@ class _Plan:
     # seeds are per absolute row, so oversized batches tile through one
     # executable and stay bit-identical to the direct path)
     pass_row0: bool = False
+    # mesh-sharded (distributed) plans: abstract avals carry the index
+    # arrays' NamedShardings, padded queries and the donated state
+    # buffers are placed with these shardings before the call
+    sharded: bool = False
+    qsharding: Any = None
+    state_sharding: Any = None
 
 
 class _Entry:
@@ -110,6 +121,24 @@ class _Entry:
 
 def _sds(x) -> jax.ShapeDtypeStruct:
     return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype)
+
+
+def _sds_sharded(x) -> jax.ShapeDtypeStruct:
+    """Abstract aval carrying the array's sharding — mesh-sharded plans
+    must lower with the real NamedShardings so the compiled executable
+    accepts (and keeps) the mesh placement."""
+    return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
+                                sharding=getattr(x, "sharding", None))
+
+
+def _mesh_key(comms) -> tuple:
+    """Cache-key component identifying a mesh precisely (axis, names,
+    shape, device ids) — ``str(mesh)`` alone would collide across
+    different device sets of the same shape."""
+    mesh = comms.mesh
+    return ("mesh", comms.axis, tuple(mesh.axis_names),
+            tuple(int(s) for s in mesh.devices.shape),
+            tuple(int(d.id) for d in mesh.devices.flat))
 
 
 def _sig(*arrays) -> tuple:
@@ -244,6 +273,8 @@ class SearchExecutor:
         expect(int(np.shape(queries)[1]) == plan.qdim, "query dim mismatch")
 
         qp = self._pad(queries, bucket, plan.qdtype)
+        if plan.qsharding is not None:
+            qp = jax.device_put(qp, plan.qsharding)
         args = list(plan.pre) + [qp]
         if plan.pass_row0:
             args.append(jnp.asarray(row0, jnp.int32))
@@ -304,6 +335,9 @@ class SearchExecutor:
         if plan.has_state:
             state = (jnp.zeros((bucket, k), jnp.float32),
                      jnp.zeros((bucket, k), jnp.int32))
+            if plan.state_sharding is not None:
+                state = tuple(jax.device_put(s, plan.state_sharding)
+                              for s in state)
         ent = _Entry(compiled, state)
         self._cache[plan.key] = ent
         while len(self._cache) > self.max_entries:
@@ -318,11 +352,13 @@ class SearchExecutor:
             donate = ("init_d", "init_i")
         jitted = jax.jit(plan.fn, static_argnames=tuple(plan.static),
                          donate_argnames=donate)
-        args = [_sds(a) for a in plan.pre]
-        args.append(jax.ShapeDtypeStruct((bucket, plan.qdim), plan.qdtype))
+        sds = _sds_sharded if plan.sharded else _sds
+        args = [sds(a) for a in plan.pre]
+        args.append(jax.ShapeDtypeStruct((bucket, plan.qdim), plan.qdtype,
+                                         sharding=plan.qsharding))
         if plan.pass_row0:
             args.append(jax.ShapeDtypeStruct((), jnp.int32))
-        args.extend(_sds(a) for a in plan.post)
+        args.extend(sds(a) for a in plan.post)
         if plan.use_filter:
             fw_spec = plan.key[-1]  # _filter_spec tuple
             if fw_spec[0] == "nofilter":
@@ -332,13 +368,20 @@ class SearchExecutor:
                 shape = (bucket, width) if ndim == 2 else (width,)
                 args.append(jax.ShapeDtypeStruct(shape, np.dtype(dt)))
         if plan.has_state:
-            args.append(jax.ShapeDtypeStruct((bucket, k), jnp.float32))
-            args.append(jax.ShapeDtypeStruct((bucket, k), jnp.int32))
+            args.append(jax.ShapeDtypeStruct((bucket, k), jnp.float32,
+                                             sharding=plan.state_sharding))
+            args.append(jax.ShapeDtypeStruct((bucket, k), jnp.int32,
+                                             sharding=plan.state_sharding))
         return jitted.lower(*args, **plan.static).compile()
 
     # -- per-family plans ---------------------------------------------------
 
     def _plan(self, index, params, k: int, bucket: int, fw, kw) -> _Plan:
+        from raft_tpu.distributed.bq import DistributedIvfBq
+        from raft_tpu.distributed.ivf import (
+            DistributedIvfFlat,
+            DistributedIvfPq,
+        )
         from raft_tpu.neighbors.brute_force import BruteForceIndex
         from raft_tpu.neighbors.cagra import CagraIndex
         from raft_tpu.neighbors.ivf_bq import IvfBqIndex
@@ -355,7 +398,120 @@ class SearchExecutor:
             return self._plan_ivf_bq(index, params, k, bucket, fw, kw)
         if isinstance(index, CagraIndex):
             return self._plan_cagra(index, params, k, bucket, fw, kw)
+        if isinstance(index, DistributedIvfFlat):
+            return self._plan_dist_ivf_flat(index, params, k, bucket, fw,
+                                            kw)
+        if isinstance(index, DistributedIvfPq):
+            return self._plan_dist_ivf_pq(index, params, k, bucket, fw, kw)
+        if isinstance(index, DistributedIvfBq):
+            return self._plan_dist_ivf_bq(index, params, k, bucket, fw, kw)
         raise TypeError(f"SearchExecutor does not support {type(index)!r}")
+
+    def _dist_statics(self, index, kw) -> tuple:
+        """Shared mesh-plan pieces: (comms, probe_mode, wire_dtype) —
+        validated; the mesh-aware executor serves the 1-D list-sharded
+        layout with replicated queries (``query_axis`` grids go through
+        the direct search entry points)."""
+        from raft_tpu.comms.comms import resolve_wire_dtype
+
+        comms = index.comms
+        probe_mode = kw.get("probe_mode", "global")
+        wire_dtype = kw.get("wire_dtype", "f32")
+        expect(probe_mode in ("global", "local"),
+               f"probe_mode must be 'global' or 'local', got {probe_mode!r}")
+        resolve_wire_dtype(wire_dtype)
+        expect(kw.get("query_axis") is None,
+               "SearchExecutor serves replicated queries; use the direct "
+               "distributed search entry points for query_axis grids")
+        return comms, probe_mode, wire_dtype
+
+    def _plan_dist_ivf_flat(self, index, params, k, bucket, fw, kw) -> _Plan:
+        from raft_tpu.distributed import ivf as dist_ivf
+        from raft_tpu.neighbors import ivf_flat as m
+        from raft_tpu.ops.ivf_scan import resolve_scan_engine
+
+        expect(fw is None,
+               "distributed searches have no sample_filter support")
+        params = params or m.IvfFlatSearchParams()
+        comms, probe_mode, wire_dtype = self._dist_statics(index, kw)
+        n_probes = dist_ivf.resolve_probe_budget(
+            params.n_probes, index.n_lists, comms.size, probe_mode)
+        engine = resolve_scan_engine(params.scan_engine, data=index.data,
+                                     k=k)
+        static = {"axis": comms.axis, "mesh": comms.mesh,
+                  "n_probes": n_probes, "k": k, "metric": index.metric,
+                  "probe_mode": probe_mode,
+                  "coarse_algo": params.coarse_algo,
+                  "scan_engine": engine, "wire_dtype": wire_dtype}
+        arrays = (index.centers, index.data, index.data_norms,
+                  index.indices)
+        key = ("dist_ivf_flat", bucket, _mesh_key(comms), _sig(*arrays),
+               tuple(sorted((n, str(v)) for n, v in static.items())),
+               _filter_spec(None))
+        # same engine/donation split as the single-chip ivf_flat plan:
+        # the rank and XLA list-major scans thread the donated per-shard
+        # (q, k) state through HBM; the Pallas kernel keeps it in VMEM
+        return _Plan(key=key, fn=dist_ivf._dist_search_fn, static=static,
+                     post=arrays, qdim=index.dim,
+                     has_state=engine != "pallas", sharded=True,
+                     qsharding=comms.replicated(),
+                     state_sharding=comms.replicated())
+
+    def _plan_dist_ivf_pq(self, index, params, k, bucket, fw, kw) -> _Plan:
+        from raft_tpu.distributed import ivf as dist_ivf
+        from raft_tpu.neighbors import ivf_pq as m
+
+        expect(fw is None,
+               "distributed searches have no sample_filter support")
+        params = params or m.IvfPqSearchParams()
+        comms, probe_mode, wire_dtype = self._dist_statics(index, kw)
+        n_probes = dist_ivf.resolve_probe_budget(
+            params.n_probes, index.n_lists, comms.size, probe_mode)
+        engine = m.resolve_scan_engine(params.scan_engine)
+        score_mode = m.resolve_score_mode(params.score_mode,
+                                          index.codebooks.shape[1])
+        static = {"axis": comms.axis, "mesh": comms.mesh,
+                  "n_probes": n_probes, "k": k, "metric": index.metric,
+                  "probe_mode": probe_mode,
+                  "codebook_kind": index.codebook_kind,
+                  "score_mode": score_mode, "lut_dtype": params.lut_dtype,
+                  "coarse_algo": params.coarse_algo,
+                  "scan_engine": engine, "wire_dtype": wire_dtype}
+        arrays = (index.centers, index.rotation, index.codebooks,
+                  index.codes, index.indices)
+        key = ("dist_ivf_pq", bucket, _mesh_key(comms), _sig(*arrays),
+               tuple(sorted((n, str(v)) for n, v in static.items())),
+               _filter_spec(None))
+        return _Plan(key=key, fn=dist_ivf._dist_search_pq_fn,
+                     static=static, post=arrays, qdim=index.dim,
+                     sharded=True, qsharding=comms.replicated(),
+                     state_sharding=comms.replicated())
+
+    def _plan_dist_ivf_bq(self, index, params, k, bucket, fw, kw) -> _Plan:
+        from raft_tpu.distributed import bq as dist_bq
+        from raft_tpu.distributed import ivf as dist_ivf
+        from raft_tpu.neighbors import ivf_bq as m
+
+        expect(fw is None,
+               "distributed searches have no sample_filter support")
+        params = params or m.IvfBqSearchParams()
+        comms, probe_mode, wire_dtype = self._dist_statics(index, kw)
+        n_probes = dist_ivf.resolve_probe_budget(
+            params.n_probes, index.n_lists, comms.size, probe_mode)
+        static = {"axis": comms.axis, "mesh": comms.mesh,
+                  "n_probes": n_probes, "k": k, "metric": index.metric,
+                  "probe_mode": probe_mode,
+                  "coarse_algo": params.coarse_algo,
+                  "wire_dtype": wire_dtype}
+        arrays = (index.centers, index.rotation, index.codes, index.scales,
+                  index.rnorm2, index.indices)
+        key = ("dist_ivf_bq", bucket, _mesh_key(comms), _sig(*arrays),
+               tuple(sorted((n, str(v)) for n, v in static.items())),
+               _filter_spec(None))
+        return _Plan(key=key, fn=dist_bq._dist_search_bq_fn, static=static,
+                     post=arrays, qdim=index.dim, sharded=True,
+                     qsharding=comms.replicated(),
+                     state_sharding=comms.replicated())
 
     def _plan_brute_force(self, index, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import brute_force as bf

@@ -83,6 +83,13 @@ def inc_counter(name: str, amount: float = 1.0) -> None:
         _counters[name] = _counters.get(name, 0.0) + amount
 
 
+def max_counter(name: str, value: float) -> None:
+    """Raise a named counter to ``value`` if it is below it (creates it
+    at ``value``) — high-water-mark counters like peak bytes."""
+    with _counters_lock:
+        _counters[name] = max(_counters.get(name, float("-inf")), value)
+
+
 def get_counter(name: str) -> float:
     """Current value of a counter (0.0 if never incremented)."""
     with _counters_lock:

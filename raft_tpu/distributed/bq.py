@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu.comms.comms import Comms, allgather
+from raft_tpu.comms.comms import Comms, resolve_wire_dtype, shard_map
 from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.validation import expect
@@ -31,7 +31,6 @@ from raft_tpu.distance.types import DistanceType, is_min_close
 from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors import ivf_bq as ivf_bq_mod
 from raft_tpu.neighbors._batching import tile_queries
-from raft_tpu.neighbors.brute_force import knn_merge_parts
 from raft_tpu.neighbors.ivf_bq import (
     IvfBqIndexParams,
     IvfBqSearchParams,
@@ -39,6 +38,8 @@ from raft_tpu.neighbors.ivf_bq import (
 )
 from raft_tpu.distributed.ivf import (
     deal_order,
+    merge_results_sharded,
+    place_dealt,
     resolve_probe_budget,
     resolve_query_sharding,
     select_probes_sharded,
@@ -92,11 +93,11 @@ def build_bq(
     with tracing.range("raft_tpu.distributed.ivf_bq.build"):
         index = ivf_bq_mod.build(res, params, dataset)
         sizes = np.asarray(jax.device_get(index.list_sizes))
-        perm = jnp.asarray(deal_order(sizes, r), jnp.int32)
-        shard = comms.sharding(comms.axis)
+        perm = deal_order(sizes, r)
 
         def place(a):
-            return jax.device_put(jnp.take(a, perm, axis=0), shard)
+            # streamed per-shard deal — no fully-permuted build-device copy
+            return place_dealt(a, perm, comms)
 
         return DistributedIvfBq(
             comms=comms,
@@ -111,18 +112,28 @@ def build_bq(
         )
 
 
-@partial(jax.jit, static_argnames=("axis", "mesh", "n_probes", "k", "metric",
-                                   "probe_mode", "query_axis", "coarse_algo"))
-def _dist_search_bq(centers, rotation, codes, scales, rn2, indices, queries,
-                    axis: str, mesh, n_probes: int, k: int,
-                    metric: DistanceType, probe_mode: str,
-                    query_axis=None, coarse_algo: str = "exact"):
+def _dist_search_bq_fn(queries, centers, rotation, codes, scales, rn2,
+                       indices, init_d=None, init_i=None, *, axis: str,
+                       mesh, n_probes: int, k: int, metric: DistanceType,
+                       probe_mode: str, query_axis=None,
+                       coarse_algo: str = "exact",
+                       wire_dtype: str = "f32"):
+    """Distributed sign-code probe scan: lean probe selection + local
+    MXU scan + O(q · k) result merge (``wire_dtype`` compresses the
+    gathered estimate distances; the positional ``knn_merge_parts``
+    tie-break is kept so results match the single-chip BQ index).
+    ``init_d``/``init_i`` optionally provide the (q, k) running top-k
+    storage (values are reset here; the serving path donates them)."""
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
     ip_metric = metric == DistanceType.InnerProduct
 
-    def body(centers_l, codes_l, scales_l, rn2_l, ids_l, qs):
-        q = qs.shape[0]
+    if init_d is None:
+        init_d = jnp.full((queries.shape[0], k), pad_val, jnp.float32)
+    if init_i is None:
+        init_i = jnp.full((queries.shape[0], k), -1, jnp.int32)
+
+    def body(centers_l, codes_l, scales_l, rn2_l, ids_l, qs, ind, ini):
         qf = qs.astype(jnp.float32)
 
         ip = jax.lax.dot_general(
@@ -154,29 +165,32 @@ def _dist_search_bq(centers, rotation, codes, scales, rn2, indices, queries,
             return merge_topk(best_d, best_i, dist, row_ids, k,
                               select_min), None
 
-        init = (jnp.full((q, k), pad_val, jnp.float32),
-                jnp.full((q, k), -1, jnp.int32))
+        init = (jnp.full_like(ind, pad_val), jnp.full_like(ini, -1))
         (best_d, best_i), _ = jax.lax.scan(
             step, init, jnp.arange(local.shape[1]))
 
-        all_d = allgather(best_d, axis)
-        all_i = allgather(best_i, axis)
-        return knn_merge_parts(all_d, all_i, select_min)
+        return merge_results_sharded(best_d, best_i, axis, select_min,
+                                     wire_dtype, smallest_id_ties=False)
 
     qspec = P() if query_axis is None else P(query_axis, None)
-    out_d, out_i = jax.shard_map(
+    out_d, out_i = shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None, None),
                   P(axis, None, None), P(axis, None), P(axis, None),
-                  qspec),
+                  qspec, qspec, qspec),
         out_specs=(qspec, qspec),
         check_vma=False,
-    )(centers, codes, scales, rn2, indices, queries)
+    )(centers, codes, scales, rn2, indices, queries, init_d, init_i)
 
     if metric == DistanceType.L2SqrtExpanded:
         out_d = jnp.where(jnp.isfinite(out_d),
                           jnp.sqrt(jnp.maximum(out_d, 0.0)), out_d)
     return out_d, out_i
+
+
+_dist_search_bq = partial(jax.jit, static_argnames=(
+    "axis", "mesh", "n_probes", "k", "metric", "probe_mode", "query_axis",
+    "coarse_algo", "wire_dtype"))(_dist_search_bq_fn)
 
 
 def search_bq(
@@ -188,13 +202,16 @@ def search_bq(
     probe_mode: str = "global",
     query_axis: Optional[str] = None,
     query_tile: int = 4096,
+    wire_dtype: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """One-program distributed BQ search (estimated distances — refine
     host-side as with the single-chip index). Large query sets run in
     ``query_tile`` batches, bounding the per-shard unpacked-code
     intermediate like the single-chip path. ``query_axis`` names a
     second mesh axis to shard queries over (the 2-D list×query grid,
-    matching :func:`raft_tpu.distributed.ivf.search_pq`)."""
+    matching :func:`raft_tpu.distributed.ivf.search_pq`);
+    ``wire_dtype="bf16"`` compresses the merge collective's distances
+    (sign-code estimates are already coarse — the cheap payload win)."""
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -206,14 +223,17 @@ def search_bq(
     expect(params.coarse_algo in ("exact", "approx"),
            f"coarse_algo must be 'exact' or 'approx', got "
            f"{params.coarse_algo!r}")
+    resolve_wire_dtype(wire_dtype)
     queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_bq.search"):
         def run(qt, _fw):
             return _dist_search_bq(
-                index.centers, index.rotation, index.codes, index.scales,
-                index.rnorm2, index.indices, qt, comms.axis, comms.mesh,
-                n_probes, k, index.metric, probe_mode, query_axis,
-                params.coarse_algo,
+                qt, index.centers, index.rotation, index.codes,
+                index.scales, index.rnorm2, index.indices,
+                axis=comms.axis, mesh=comms.mesh, n_probes=n_probes,
+                k=k, metric=index.metric, probe_mode=probe_mode,
+                query_axis=query_axis, coarse_algo=params.coarse_algo,
+                wire_dtype=wire_dtype,
             )
 
         if query_axis is not None:

@@ -6,20 +6,34 @@ logical index whose inverted lists are block-sharded over the mesh
 (``jax.sharding``): every chip owns ``n_lists / R`` lists, the coarse
 quantizer is replicated, and a single jitted ``shard_map`` program does
 
-    local coarse top-p  →  local probe scan  →  all_gather + merge
+    local coarse top-p  →  local probe scan  →  lean all_gather + merge
 
 so the collectives ride ICI and no host round-trips happen per query
 (SURVEY.md §5 "TPU equivalent" note; the merge is the
 ``knn_merge_parts`` pattern inside the program).
 
+The shard-local probe scan is the SAME pluggable engine set as the
+single-chip ``ivf_flat.search`` (``scan_engine: auto|pallas|xla|rank``,
+:mod:`raft_tpu.ops.ivf_scan`): the list-major engines compute each
+shard's probed-list union (not-owned probes masked to the sentinel id)
+and stream every owned unique list once through one MXU GEMM. The
+query hot path moves only lean payloads over ICI:
+
+- probe selection (``"global"``): each shard contributes its top
+  ``min(n_probes, n_local)`` (distance, id) candidates — an
+  O(q · n_probes) collective, not the O(q · n_lists / R) coarse block;
+- result merge: each shard's locally-reduced (q, k) top-k — O(q · k) —
+  with an opt-in ``wire_dtype="bf16"`` low-precision wire format for
+  the gathered distances (ids ride exact; ties re-rank by smallest id).
+
 Probe semantics (``probe_mode``):
 
-- ``"global"`` (default, exact): every shard ranks ALL centers (they're
-  cheap and replicated through an all_gather of the local slices),
-  takes the global top-``n_probes``, and scans the probed lists it
-  owns, masking the rest. Results match the single-device index
-  exactly; per-chip wall-clock is ~the single-chip search, while HBM
-  capacity scales with the mesh — the point of sharding at 1B rows.
+- ``"global"`` (default, exact): the global top-``n_probes`` lists are
+  selected from the gathered per-shard candidates; each shard scans the
+  probed lists it owns, masking the rest. Results match the
+  single-device index exactly; per-chip wall-clock is ~the single-chip
+  search, while HBM capacity scales with the mesh — the point of
+  sharding at 1B rows.
 - ``"local"`` (approximate, fast): each shard probes its own top
   ``ceil(n_probes / R)`` local lists. Lists are dealt round-robin by
   size at build time so relevant lists spread evenly; the union
@@ -38,7 +52,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu.comms.comms import Comms, allgather
+from raft_tpu.comms.comms import (
+    Comms,
+    allgather,
+    allgather_wire,
+    resolve_wire_dtype,
+    shard_map,
+)
 from raft_tpu.core import interruptible, tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.validation import expect
@@ -48,13 +68,13 @@ from raft_tpu.neighbors import ivf_flat as ivf_flat_mod
 from raft_tpu.neighbors import ivf_pq as ivf_pq_mod
 from raft_tpu.neighbors._batching import coarse_select
 from raft_tpu.neighbors._packing import padded_extent
-from raft_tpu.neighbors.brute_force import knn_merge_parts
 from raft_tpu.neighbors.ivf_flat import IvfFlatIndexParams, IvfFlatSearchParams
 from raft_tpu.neighbors.ivf_pq import (
     CodebookKind,
     IvfPqIndexParams,
     IvfPqSearchParams,
 )
+from raft_tpu.ops.ivf_scan import list_major_scan
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,6 +104,10 @@ class DistributedIvfFlat:
         return self.centers.shape[1]
 
     @property
+    def max_list_size(self) -> int:
+        return self.data.shape[1]
+
+    @property
     def size(self) -> int:
         return int(jax.device_get(self.list_sizes).sum())
 
@@ -97,6 +121,53 @@ def deal_order(sizes: np.ndarray, r: int) -> np.ndarray:
     return np.concatenate([order[s::r] for s in range(r)])
 
 
+_gather_rows = jax.jit(lambda a, rows: jnp.take(a, rows, axis=0))
+
+
+def place_dealt(a, perm: np.ndarray, comms: Comms):
+    """Deal + place ONE build-device tensor onto the mesh, streaming
+    per-shard blocks instead of materializing the fully-permuted tensor
+    on the build device: each shard's list block (1/R of the tensor) is
+    gathered on the build device, transferred to its device(s), and the
+    global sharded array assembled from the per-device pieces. Peak
+    extra build-device footprint drops from O(full tensor) to O(block);
+    the high-water mark is recorded in the
+    ``distributed.build.peak_deal_block_bytes`` tracing counter and the
+    total moved in ``distributed.build.deal_bytes_total``."""
+    perm = np.asarray(perm)
+    shard = comms.sharding(comms.axis)
+    shape = tuple(a.shape)
+    imap = shard.devices_indices_map(shape)
+    # group devices by their dim-0 block (a 2-D mesh replicates each
+    # list block across the other axis — gather it once)
+    groups: dict = {}
+    order = []
+    for dev, idx in imap.items():
+        sl = idx[0]
+        key = (sl.start or 0, sl.stop if sl.stop is not None else shape[0])
+        groups.setdefault(key, []).append(dev)
+        order.append((dev, key))
+    pieces = {}
+    for (start, stop), devs in groups.items():
+        rows = jnp.asarray(perm[start:stop], jnp.int32)
+        blk = _gather_rows(a, rows)          # ONE block on the build device
+        blk_bytes = blk.size * blk.dtype.itemsize
+        tracing.max_counter("distributed.build.peak_deal_block_bytes",
+                            blk_bytes)
+        tracing.inc_counter("distributed.build.deal_bytes_total",
+                            blk_bytes * len(devs))
+        puts = [jax.device_put(blk, d) for d in devs]
+        # block before gathering the next block so at most one block's
+        # worth of staging lives on the build device at a time
+        for p in puts:
+            p.block_until_ready()
+        for d, p in zip(devs, puts):
+            pieces[d] = p
+        del blk
+    return jax.make_array_from_single_device_arrays(
+        shape, shard, [pieces[dev] for dev, _ in order])
+
+
 def select_probes_sharded(coarse, n_probes: int, axis: str,
                           probe_mode: str, coarse_algo: str = "exact"):
     """Shared probe selection inside a shard_map body — THE
@@ -106,28 +177,112 @@ def select_probes_sharded(coarse, n_probes: int, axis: str,
     Returns ``(local, mine)``: per-(query, probe-rank) local list ids
     and a mask of the probes this shard owns.
 
-    - ``"global"``: all_gather every shard's coarse block, take the
-      global top-``n_probes``, keep the locally-owned ones.
+    - ``"global"``: LEAN candidate exchange — each shard ranks only its
+      own centers and contributes its top-``min(n_probes, n_local)``
+      (distance, global id) pairs to the all_gather: an O(q · n_probes)
+      payload instead of the O(q · n_local) coarse block (the global
+      top-``n_probes`` provably lies inside the union of per-shard
+      top-``n_probes``). The global probe set is the lexicographic
+      (distance, id) top-``n_probes`` of the gathered candidates, so
+      ties resolve deterministically at any shard count. When the
+      candidate payload would NOT be leaner (probing most of the index:
+      2 · min(n_probes, n_local) ≥ n_local), the dense coarse-block
+      gather is used instead — same probe set, fewer bytes.
     - ``"local"``: each shard probes its own top-``n_probes`` lists.
 
     ``coarse_algo="approx"`` swaps the probe top-k for the TPU's
     native approximate top-k unit, via the same
     :func:`raft_tpu.neighbors._batching.coarse_select` dispatch the
-    single-chip searches use.
+    single-chip searches use (lean mode applies it to the local stage).
     """
     q, n_local = coarse.shape
     if probe_mode == "global":
-        coarse_all = allgather(coarse, axis)              # (R, q, L)
-        r = coarse_all.shape[0]
-        coarse_flat = jnp.moveaxis(coarse_all, 0, 1).reshape(
-            q, r * n_local)
-        probes = coarse_select(-coarse_flat, n_probes, coarse_algo)
+        rank = jax.lax.axis_index(axis)
+        local_k = min(n_probes, n_local)
+        if 2 * local_k < n_local:
+            # lean candidate exchange: (distance, global id) pairs only
+            loc = coarse_select(-coarse, local_k, coarse_algo)
+            dloc = jnp.take_along_axis(coarse, loc, axis=1)
+            gid = loc.astype(jnp.int32) + rank.astype(jnp.int32) * n_local
+            all_d = allgather(dloc, axis)                 # (R, q, local_k)
+            all_g = allgather(gid, axis)
+            r = all_d.shape[0]
+            cand_d = jnp.moveaxis(all_d, 0, 1).reshape(q, r * local_k)
+            cand_g = jnp.moveaxis(all_g, 0, 1).reshape(q, r * local_k)
+            _, sg = jax.lax.sort((cand_d, cand_g), dimension=1,
+                                 num_keys=2)
+            probes = sg[:, :n_probes]
+        else:
+            coarse_all = allgather(coarse, axis)          # (R, q, L)
+            r = coarse_all.shape[0]
+            coarse_flat = jnp.moveaxis(coarse_all, 0, 1).reshape(
+                q, r * n_local)
+            probes = coarse_select(-coarse_flat, n_probes, coarse_algo)
         owner = probes // n_local
         local = probes - owner * n_local
-        mine = owner == jax.lax.axis_index(axis)
+        mine = owner == rank
         return local, mine
     probes = coarse_select(-coarse, n_probes, coarse_algo)
     return probes, jnp.ones(probes.shape, jnp.bool_)
+
+
+def merge_results_sharded(best_d, best_i, axis: str, select_min: bool,
+                          wire_dtype: str = "f32",
+                          smallest_id_ties: bool = True):
+    """All-gather each shard's locally-reduced (q, k) top-k and merge —
+    the O(q · k) result collective of every list-sharded search (the
+    ``knn_merge_parts`` pattern inside the program).
+
+    ``wire_dtype="bf16"`` compresses the gathered *distances* on the
+    wire (ids ride exact int32); ties — including the extra ties the
+    compression creates — re-rank deterministically by smallest id, so
+    the returned ids stay exact w.r.t. the wire-rounded ranking and
+    shard-count invariant.
+
+    ``smallest_id_ties=True`` merges by lexicographic (distance, id) —
+    the list-major engines' order, bit-identical to the single-chip
+    engines even on exact-duplicate ties. ``False`` keeps the legacy
+    positional ``knn_merge_parts`` tie-break of the rank-major and BQ
+    paths."""
+    all_d = allgather_wire(best_d, axis, wire_dtype)      # (R, q, k)
+    all_i = allgather(best_i, axis)
+    r, q, k = all_d.shape
+    cat_d = jnp.moveaxis(all_d, 0, 1).reshape(q, r * k)
+    cat_i = jnp.moveaxis(all_i, 0, 1).reshape(q, r * k)
+    if not smallest_id_ties:
+        return merge_topk(cat_d[:, :k], cat_i[:, :k], cat_d[:, k:],
+                          cat_i[:, k:], k, select_min)
+    sd, si = jax.lax.sort((cat_d if select_min else -cat_d, cat_i),
+                          dimension=1, num_keys=2)
+    sd, si = sd[:, :k], si[:, :k]
+    si = jnp.where(jnp.isfinite(sd), si, -1)
+    return (sd if select_min else -sd), si
+
+
+def collective_payload_model(q: int, k: int, n_probes: int, n_lists: int,
+                             r: int, wire_dtype: str = "f32",
+                             probe_mode: str = "global") -> dict:
+    """Modeled per-shard query-path collective payloads (bytes) — the
+    accounting the bench rider emits next to measured throughput, and
+    the contract the lean-collective tests assert on.
+
+    ``coarse_bytes``/``merge_bytes`` are what the current implementation
+    moves per shard; ``dense_coarse_bytes`` is the pre-lean coarse-block
+    gather for comparison."""
+    n_local = max(n_lists // max(r, 1), 1)
+    local_k = min(n_probes, n_local)
+    wire_itemsize = 2 if wire_dtype == "bf16" else 4
+    dense = q * n_local * 4
+    lean = q * local_k * (4 + 4)            # f32 distance + int32 id
+    coarse = 0
+    if probe_mode == "global":
+        coarse = lean if 2 * local_k < n_local else dense
+    return {
+        "coarse_bytes": coarse,
+        "dense_coarse_bytes": dense if probe_mode == "global" else 0,
+        "merge_bytes": q * k * (wire_itemsize + 4),
+        "wire_dtype": wire_dtype,
+    }
 
 
 def resolve_query_sharding(comms: Comms, queries, query_axis):
@@ -166,7 +321,9 @@ def build(
     dataset,
 ) -> DistributedIvfFlat:
     """Build a list-sharded index: global balanced-kmeans quantizer, then
-    lists dealt round-robin by population and placed shard-local.
+    lists dealt round-robin by population and placed shard-local (the
+    deal streams per shard block — :func:`place_dealt` — so the build
+    device never holds a second fully-permuted copy of the index).
 
     ``params.n_lists`` is rounded up to a multiple of the mesh-axis size.
     """
@@ -179,14 +336,13 @@ def build(
         # single-chip build (global quantizer + packed lists), then deal
         index = ivf_flat_mod.build(res, params, dataset)
 
-        # blocked layout wants shard-contiguous rows: permute to
-        # [shard0 lists..., shard1 lists...] per the shared deal policy
+        # blocked layout wants shard-contiguous rows: stream the deal
+        # per shard block per the shared layout policy
         sizes = np.asarray(jax.device_get(index.list_sizes))
-        perm = jnp.asarray(deal_order(sizes, r), jnp.int32)
+        perm = deal_order(sizes, r)
 
-        shard = comms.sharding(comms.axis)              # P(axis) on dim 0
         def place(a):
-            return jax.device_put(jnp.take(a, perm, axis=0), shard)
+            return place_dealt(a, perm, comms)
 
         return DistributedIvfFlat(
             comms=comms,
@@ -199,17 +355,33 @@ def build(
         )
 
 
-@partial(jax.jit, static_argnames=("axis", "mesh", "n_probes", "k", "metric",
-                                   "probe_mode", "query_axis", "coarse_algo"))
-def _dist_search(centers, data, data_norms, indices, queries,
-                 axis: str, mesh, n_probes: int, k: int,
-                 metric: DistanceType, probe_mode: str,
-                 query_axis: Optional[str] = None,
-                 coarse_algo: str = "exact"):
+def _dist_search_fn(queries, centers, data, data_norms, indices,
+                    init_d=None, init_i=None, *, axis: str, mesh,
+                    n_probes: int, k: int, metric: DistanceType,
+                    probe_mode: str, query_axis: Optional[str] = None,
+                    coarse_algo: str = "exact", scan_engine: str = "rank",
+                    wire_dtype: str = "f32"):
+    """One shard_map program: local coarse → (global|local) probe
+    select → shard-local probe scan → lean O(q · k) result merge.
+
+    ``scan_engine`` must arrive resolved (``rank``/``pallas``/``xla``,
+    via :func:`raft_tpu.ops.ivf_scan.resolve_scan_engine`) — it is a
+    jit static, and the mesh-aware serving path keys AOT executables on
+    it. The list-major engines mask not-owned probes to the sentinel id
+    ``n_local`` so each shard streams only the union of lists it owns.
+    ``init_d``/``init_i`` optionally provide the (q, k) running top-k
+    storage (values are reset here; the serving path donates them —
+    the Pallas engine keeps its state in VMEM scratch instead)."""
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
+    interpret = jax.default_backend() != "tpu"
 
-    def body(centers_l, data_l, norms_l, ids_l, qs):
+    if init_d is None:
+        init_d = jnp.full((queries.shape[0], k), pad_val, jnp.float32)
+    if init_i is None:
+        init_i = jnp.full((queries.shape[0], k), -1, jnp.int32)
+
+    def body(centers_l, data_l, norms_l, ids_l, qs, ind, ini):
         q = qs.shape[0]
         n_local = centers_l.shape[0]
         qf = qs.astype(jnp.float32)
@@ -229,47 +401,59 @@ def _dist_search(centers, data, data_norms, indices, queries,
         local, mine = select_probes_sharded(coarse, n_probes, axis,
                                             probe_mode, coarse_algo)
 
-        def step(carry, rank_i):
-            best_d, best_i = carry
-            lists = local[:, rank_i]
-            valid = mine[:, rank_i]
-            rows = jnp.take(data_l, lists, axis=0).astype(jnp.float32)
-            row_norms = jnp.take(norms_l, lists, axis=0)
-            row_ids = jnp.take(ids_l, lists, axis=0)
-            ipr = jax.lax.dot_general(
-                rows, qf, (((2,), (1,)), ((0,), (0,))),
-                precision=jax.lax.Precision.HIGHEST,
-                preferred_element_type=jnp.float32,
-            )
-            if metric == DistanceType.InnerProduct:
-                dist = ipr
-            else:
-                dist = row_norms - 2.0 * ipr
-            dist = jnp.where((row_ids >= 0) & valid[:, None], dist, pad_val)
-            return merge_topk(best_d, best_i, dist, row_ids, k,
-                              select_min), None
+        if scan_engine != "rank":
+            # list-major: not-owned probes mask to the sentinel id
+            # n_local (ops/ivf_scan mask plumbing); each owned unique
+            # list streams from HBM once and scores the whole query
+            # tile in one MXU GEMM — the PR 2 single-chip engines,
+            # unchanged, running inside the shard_map body
+            masked = jnp.where(mine, local, n_local).astype(jnp.int32)
+            best_d, best_i = list_major_scan(
+                qf, data_l, norms_l, ids_l, masked, None, ind, ini,
+                k=k, metric=metric, engine=scan_engine,
+                interpret=interpret)
+        else:
+            def step(carry, rank_i):
+                best_d, best_i = carry
+                lists = local[:, rank_i]
+                valid = mine[:, rank_i]
+                rows = jnp.take(data_l, lists, axis=0).astype(jnp.float32)
+                row_norms = jnp.take(norms_l, lists, axis=0)
+                row_ids = jnp.take(ids_l, lists, axis=0)
+                ipr = jax.lax.dot_general(
+                    rows, qf, (((2,), (1,)), ((0,), (0,))),
+                    precision=jax.lax.Precision.HIGHEST,
+                    preferred_element_type=jnp.float32,
+                )
+                if metric == DistanceType.InnerProduct:
+                    dist = ipr
+                else:
+                    dist = row_norms - 2.0 * ipr
+                dist = jnp.where((row_ids >= 0) & valid[:, None], dist,
+                                 pad_val)
+                return merge_topk(best_d, best_i, dist, row_ids, k,
+                                  select_min), None
 
-        init = (jnp.full((q, k), pad_val, jnp.float32),
-                jnp.full((q, k), -1, jnp.int32))
-        (best_d, best_i), _ = jax.lax.scan(
-            step, init, jnp.arange(local.shape[1]))
+            init = (jnp.full_like(ind, pad_val), jnp.full_like(ini, -1))
+            (best_d, best_i), _ = jax.lax.scan(
+                step, init, jnp.arange(local.shape[1]))
 
-        all_d = allgather(best_d, axis)                  # (R, q, k)
-        all_i = allgather(best_i, axis)
-        return knn_merge_parts(all_d, all_i, select_min)
+        return merge_results_sharded(
+            best_d, best_i, axis, select_min, wire_dtype,
+            smallest_id_ties=scan_engine != "rank")
 
     # 2-D grid: queries shard over a second mesh axis while lists shard
     # over the first — the reference's row/col process grid
     # (``sub_comms.hpp``). Each device handles its (list-block,
     # query-block) cell; merges stay within the list axis.
     qspec = P() if query_axis is None else P(query_axis, None)
-    out_d, out_i = jax.shard_map(
+    out_d, out_i = shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None), P(axis, None, None), P(axis, None),
-                  P(axis, None), qspec),
+                  P(axis, None), qspec, qspec, qspec),
         out_specs=(qspec, qspec),
         check_vma=False,
-    )(centers, data, data_norms, indices, queries)
+    )(centers, data, data_norms, indices, queries, init_d, init_i)
 
     if metric != DistanceType.InnerProduct:
         q_sq = jnp.sum(jnp.square(queries.astype(jnp.float32)), axis=1,
@@ -281,6 +465,11 @@ def _dist_search(centers, data, data_norms, indices, queries,
     return out_d, out_i
 
 
+_dist_search = partial(jax.jit, static_argnames=(
+    "axis", "mesh", "n_probes", "k", "metric", "probe_mode", "query_axis",
+    "coarse_algo", "scan_engine", "wire_dtype"))(_dist_search_fn)
+
+
 def search(
     res: Optional[Resources],
     params: IvfFlatSearchParams,
@@ -289,11 +478,17 @@ def search(
     k: int,
     probe_mode: str = "global",
     query_axis: Optional[str] = None,
+    wire_dtype: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """One-program distributed search; returns replicated (q, k) results
     with global row ids. See the module docstring for ``probe_mode``.
     ``query_axis`` names a second mesh axis to shard queries over (2-D
-    list × query grid); results come back sharded over that axis."""
+    list × query grid); results come back sharded over that axis.
+    ``wire_dtype="bf16"`` halves the result-merge collective payload
+    (distances compressed on the wire; ids exact, smallest-id ties).
+    The probe scan engine follows ``params.scan_engine`` exactly like
+    the single-chip entry (resolved per backend/shape by
+    :func:`raft_tpu.ops.ivf_scan.resolve_scan_engine`)."""
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -305,12 +500,20 @@ def search(
     expect(params.coarse_algo in ("exact", "approx"),
            f"coarse_algo must be 'exact' or 'approx', got "
            f"{params.coarse_algo!r}")
+    resolve_wire_dtype(wire_dtype)
+    from raft_tpu.ops.ivf_scan import resolve_scan_engine
+
+    scan_engine = resolve_scan_engine(params.scan_engine, data=index.data,
+                                      k=k)
     queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_flat.search"):
         return _dist_search(
-            index.centers, index.data, index.data_norms, index.indices,
-            queries, comms.axis, comms.mesh, n_probes, k, index.metric,
-            probe_mode, query_axis, params.coarse_algo,
+            queries, index.centers, index.data, index.data_norms,
+            index.indices, axis=comms.axis, mesh=comms.mesh,
+            n_probes=n_probes, k=k, metric=index.metric,
+            probe_mode=probe_mode, query_axis=query_axis,
+            coarse_algo=params.coarse_algo, scan_engine=scan_engine,
+            wire_dtype=wire_dtype,
         )
 
 
@@ -359,8 +562,7 @@ def build_streaming(
         max_size = padded_extent(sizes_np)
 
         # deal lists round-robin by population; dealt[i] = original list
-        order = np.argsort(-sizes_np, kind="stable")
-        deal = np.concatenate([order[s::r] for s in range(r)])
+        deal = deal_order(sizes_np, r)
         dealt_pos = np.empty((n_lists,), np.int32)
         dealt_pos[deal] = np.arange(n_lists, dtype=np.int32)
 
@@ -400,11 +602,9 @@ def build_streaming(
             norms = jnp.sum(jnp.square(data), axis=2)
             return jnp.where(indices >= 0, norms, jnp.inf)
 
-        perm = jnp.asarray(deal, jnp.int32)
         return DistributedIvfFlat(
             comms=comms,
-            centers=jax.device_put(jnp.take(quant.centers, perm, axis=0),
-                                   shard),
+            centers=place_dealt(quant.centers, deal, comms),
             data=data,
             data_norms=make_norms(data, indices),
             indices=indices,
@@ -447,6 +647,10 @@ class DistributedIvfPq:
         return self.centers.shape[1]
 
     @property
+    def max_list_size(self) -> int:
+        return self.codes.shape[1]
+
+    @property
     def pq_dim(self) -> int:
         return self.codes.shape[2]
 
@@ -484,11 +688,10 @@ def build_pq(
             index = dataclasses.replace(index, codes=codes, packed=False)
 
         sizes = np.asarray(jax.device_get(index.list_sizes))
-        perm = jnp.asarray(deal_order(sizes, r), jnp.int32)
+        perm = deal_order(sizes, r)
 
-        shard = comms.sharding(comms.axis)
         def place(a):
-            return jax.device_put(jnp.take(a, perm, axis=0), shard)
+            return place_dealt(a, perm, comms)
 
         rep = comms.replicated()
         per_cluster = params.codebook_kind == CodebookKind.PER_CLUSTER
@@ -507,18 +710,20 @@ def build_pq(
         )
 
 
-@partial(jax.jit, static_argnames=("axis", "mesh", "n_probes", "k", "metric",
-                                   "probe_mode", "query_axis",
-                                   "codebook_kind", "score_mode", "lut_dtype",
-                                   "coarse_algo"))
-def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
-                    axis: str, mesh, n_probes: int, k: int,
-                    metric: DistanceType, probe_mode: str,
-                    query_axis: Optional[str] = None,
-                    codebook_kind: CodebookKind = CodebookKind.PER_SUBSPACE,
-                    score_mode: str = "gather",
-                    lut_dtype=jnp.float32,
-                    coarse_algo: str = "exact"):
+def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
+                       indices, init_d=None, init_i=None, *, axis: str,
+                       mesh, n_probes: int, k: int, metric: DistanceType,
+                       probe_mode: str, query_axis: Optional[str] = None,
+                       codebook_kind: CodebookKind = (
+                           CodebookKind.PER_SUBSPACE),
+                       score_mode: str = "gather", lut_dtype=jnp.float32,
+                       coarse_algo: str = "exact",
+                       scan_engine: str = "rank",
+                       wire_dtype: str = "f32"):
+    """Distributed ADC probe scan — same engine plumbing as
+    :func:`_dist_search_fn` (``scan_engine: xla`` is the list-major
+    union scan of :mod:`raft_tpu.neighbors.ivf_pq`, run per shard with
+    not-owned probes masked to the sentinel id)."""
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
     pq_dim = codes.shape[2]
@@ -527,7 +732,12 @@ def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
     per_cluster = codebook_kind == CodebookKind.PER_CLUSTER
     score = ivf_pq_mod.score_fn(score_mode, codebooks.shape[1])
 
-    def body(centers_l, books_l, codes_l, ids_l, qs):
+    if init_d is None:
+        init_d = jnp.full((queries.shape[0], k), pad_val, jnp.float32)
+    if init_i is None:
+        init_i = jnp.full((queries.shape[0], k), -1, jnp.int32)
+
+    def body(centers_l, books_l, codes_l, ids_l, qs, ind, ini):
         q = qs.shape[0]
         n_local = centers_l.shape[0]
         qf = qs.astype(jnp.float32)
@@ -550,48 +760,92 @@ def _dist_search_pq(centers, rotation, codebooks, codes, indices, queries,
         lut_fixed = (jnp.einsum("qsl,sjl->qsj", qsub_fixed, books_l)
                      if ip_metric and not per_cluster else None)
 
-        def step(carry, rank_i):
-            best_d, best_i = carry
-            lists = local[:, rank_i]
-            valid = mine[:, rank_i]
+        def probe_dist(lists, rows, row_ids):
             c = jnp.take(centers_l, lists, axis=0)        # (q, dim)
             lut, base = ivf_pq_mod._probe_lut(
                 qf, c, qsub_fixed, lut_fixed, rotation, books_l, lists,
                 ip_metric, per_cluster)
             lut, lut_scale = ivf_pq_mod.quantize_lut(lut, lut_dtype)
-            rows = jnp.take(codes_l, lists, axis=0)       # (q, m, s) u8
-            row_ids = jnp.take(ids_l, lists, axis=0)
             dist = score(lut, rows)
             if lut_scale is not None:
                 dist = dist * lut_scale
             dist = dist + base[:, None]
-            dist = jnp.where((row_ids >= 0) & valid[:, None], dist, pad_val)
-            return merge_topk(best_d, best_i, dist, row_ids, k,
-                              select_min), None
+            return jnp.where(row_ids >= 0, dist, pad_val)
 
-        init = (jnp.full((q, k), pad_val, jnp.float32),
-                jnp.full((q, k), -1, jnp.int32))
-        (best_d, best_i), _ = jax.lax.scan(
-            step, init, jnp.arange(local.shape[1]))
+        if scan_engine != "rank":
+            # list-major union scan (the single-chip ivf_pq "xla"
+            # engine inside the shard body): min-space with the
+            # smallest-id tie-break, not-owned probes masked out
+            from raft_tpu.ops.ivf_scan import (
+                _merge_smallest_id,
+                unique_lists,
+            )
 
-        all_d = allgather(best_d, axis)
-        all_i = allgather(best_i, axis)
-        return knn_merge_parts(all_d, all_i, select_min)
+            masked = jnp.where(mine, local, n_local).astype(jnp.int32)
+
+            def step(carry, lid):
+                best_d, best_i = carry
+                lidc = jnp.minimum(lid, n_local - 1)       # sentinel-safe
+                lists = jnp.full((q,), lidc, jnp.int32)
+                rows1 = jax.lax.dynamic_index_in_dim(codes_l, lidc, 0,
+                                                     False)
+                ids1 = jax.lax.dynamic_index_in_dim(ids_l, lidc, 0, False)
+                rows = jnp.broadcast_to(rows1[None], (q,) + rows1.shape)
+                row_ids = jnp.broadcast_to(ids1[None], (q, ids1.shape[0]))
+                dist = probe_dist(lists, rows, row_ids)
+                if not select_min:
+                    dist = -dist                           # to min-space
+                probed = (jnp.any(masked == lid, axis=1)
+                          & (lid < n_local))               # membership
+                dist = jnp.where(probed[:, None], dist, jnp.inf)
+                return _merge_smallest_id(best_d, best_i, dist, row_ids,
+                                          k), None
+
+            init = (jnp.full_like(ind, jnp.inf), jnp.full_like(ini, -1))
+            (best_d, best_i), _ = jax.lax.scan(
+                step, init, unique_lists(masked, n_local))
+            if not select_min:
+                best_d = -best_d
+        else:
+            def step(carry, rank_i):
+                best_d, best_i = carry
+                lists = local[:, rank_i]
+                valid = mine[:, rank_i]
+                rows = jnp.take(codes_l, lists, axis=0)    # (q, m, s) u8
+                row_ids = jnp.take(ids_l, lists, axis=0)
+                dist = probe_dist(lists, rows, row_ids)
+                dist = jnp.where(valid[:, None], dist, pad_val)
+                return merge_topk(best_d, best_i, dist, row_ids, k,
+                                  select_min), None
+
+            init = (jnp.full_like(ind, pad_val), jnp.full_like(ini, -1))
+            (best_d, best_i), _ = jax.lax.scan(
+                step, init, jnp.arange(local.shape[1]))
+
+        return merge_results_sharded(
+            best_d, best_i, axis, select_min, wire_dtype,
+            smallest_id_ties=scan_engine != "rank")
 
     qspec = P() if query_axis is None else P(query_axis, None)
     bspec = P(axis, None, None) if per_cluster else P(None, None, None)
-    out_d, out_i = jax.shard_map(
+    out_d, out_i = shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None), bspec, P(axis, None, None), P(axis, None),
-                  qspec),
+                  qspec, qspec, qspec),
         out_specs=(qspec, qspec),
         check_vma=False,
-    )(centers, codebooks, codes, indices, queries)
+    )(centers, codebooks, codes, indices, queries, init_d, init_i)
 
     if metric == DistanceType.L2SqrtExpanded:
         out_d = jnp.where(jnp.isfinite(out_d),
                           jnp.sqrt(jnp.maximum(out_d, 0.0)), out_d)
     return out_d, out_i
+
+
+_dist_search_pq = partial(jax.jit, static_argnames=(
+    "axis", "mesh", "n_probes", "k", "metric", "probe_mode", "query_axis",
+    "codebook_kind", "score_mode", "lut_dtype", "coarse_algo",
+    "scan_engine", "wire_dtype"))(_dist_search_pq_fn)
 
 
 def search_pq(
@@ -602,9 +856,13 @@ def search_pq(
     k: int,
     probe_mode: str = "global",
     query_axis: Optional[str] = None,
+    wire_dtype: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
-    """One-program distributed PQ search (LUT scoring per shard, global
-    merge); semantics of :func:`search` incl. the 2-D ``query_axis``."""
+    """One-program distributed PQ search (LUT scoring per shard, lean
+    global merge); semantics of :func:`search` incl. the 2-D
+    ``query_axis`` and the ``wire_dtype`` result compression. The probe
+    scan follows ``params.scan_engine`` (``auto|xla|rank``, resolved by
+    :func:`raft_tpu.neighbors.ivf_pq.resolve_scan_engine`)."""
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -616,12 +874,17 @@ def search_pq(
     expect(params.coarse_algo in ("exact", "approx"),
            f"coarse_algo must be 'exact' or 'approx', got "
            f"{params.coarse_algo!r}")
+    resolve_wire_dtype(wire_dtype)
+    scan_engine = ivf_pq_mod.resolve_scan_engine(params.scan_engine)
     queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_pq.search"):
         return _dist_search_pq(
-            index.centers, index.rotation, index.codebooks, index.codes,
-            index.indices, queries, comms.axis, comms.mesh, n_probes, k,
-            index.metric, probe_mode, query_axis,
-            index.codebook_kind, params.score_mode, params.lut_dtype,
-            params.coarse_algo,
+            queries, index.centers, index.rotation, index.codebooks,
+            index.codes, index.indices, axis=comms.axis, mesh=comms.mesh,
+            n_probes=n_probes, k=k, metric=index.metric,
+            probe_mode=probe_mode, query_axis=query_axis,
+            codebook_kind=index.codebook_kind,
+            score_mode=params.score_mode, lut_dtype=params.lut_dtype,
+            coarse_algo=params.coarse_algo, scan_engine=scan_engine,
+            wire_dtype=wire_dtype,
         )

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from raft_tpu.cluster.kmeans import _kmeanspp_init
-from raft_tpu.comms.comms import Comms, Op, allreduce
+from raft_tpu.comms.comms import Comms, Op, allreduce, shard_map
 from raft_tpu.core import tracing
 from raft_tpu.core.validation import expect
 
@@ -84,7 +84,7 @@ def fit(
             inertia = allreduce(jnp.sum(jnp.min(d2, axis=1)), Op.SUM, axis)
             return centers, inertia
 
-        return jax.shard_map(
+        return shard_map(
             body, mesh=comms.mesh, in_specs=(P(axis, None), P()),
             out_specs=(P(), P()),
         )(x_sh, c0)

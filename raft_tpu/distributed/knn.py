@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu.comms.comms import Comms, allgather, rank
+from raft_tpu.comms.comms import Comms, allgather, rank, shard_map
 from raft_tpu.core import tracing
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.pairwise import _pairwise_distance_impl
@@ -82,7 +82,7 @@ def brute_force_knn(
         # the merged result is replicated (identical on every shard) but
         # post-all_gather values can't be statically proven so; skip the
         # vma check
-        return jax.shard_map(
+        return shard_map(
             body, mesh=comms.mesh, in_specs=(P(axis, None), P()),
             out_specs=(P(), P()), check_vma=False,
         )(ds, qs)
@@ -153,7 +153,7 @@ def brute_force_knn_ring(
             _, best_d, best_i = state
             return best_d, best_i
 
-        return jax.shard_map(
+        return shard_map(
             body, mesh=comms.mesh,
             in_specs=(P(axis, None), P(axis, None)),
             out_specs=(P(axis, None), P(axis, None)),
@@ -195,10 +195,15 @@ def _local_scan(queries, dataset, k: int, metric, metric_arg, tile: int,
     init = (jnp.full((q, k), pad_val, jnp.float32),
             jnp.full((q, k), -1, jnp.int32))
     if axis is not None:
-        # mark the carry device-varying (pvary was deprecated for pcast)
+        # mark the carry device-varying (pvary was deprecated for pcast;
+        # jax 0.4.x/0.5.x have neither and need no marking — their
+        # shard_map runs these programs with check_rep=False)
         pcast = getattr(jax.lax, "pcast", None)
-        init = (pcast(init, axis, to="varying") if pcast is not None
-                else jax.lax.pvary(init, axis))
+        pvary = getattr(jax.lax, "pvary", None)
+        if pcast is not None:
+            init = pcast(init, axis, to="varying")
+        elif pvary is not None:
+            init = pvary(init, axis)
     (best_d, best_i), _ = jax.lax.scan(
         step, init, (jnp.arange(tiles.shape[0]), tiles))
     return best_d, best_i

@@ -23,6 +23,18 @@ from raft_tpu.core.validation import expect
 from raft_tpu.neighbors.brute_force import knn_merge_parts
 
 
+def _index_device(index) -> Optional[jax.Device]:
+    """The device a sub-index's arrays live on (first array leaf), or
+    None when the index is opaque to pytree flattening."""
+    for leaf in jax.tree_util.tree_leaves(index):
+        if isinstance(leaf, jax.Array):
+            try:
+                return list(leaf.devices())[0]
+            except Exception:  # noqa: BLE001 — deleted/donated buffer
+                return None
+    return None
+
+
 @dataclasses.dataclass
 class ShardedIndex:
     """Per-shard sub-indexes + their global row offsets."""
@@ -42,25 +54,38 @@ class ShardedIndex:
         queries,
         k: int,
     ) -> Tuple[jax.Array, jax.Array]:
-        """Fan out to every shard, then ``knn_merge_parts``."""
+        """Fan out to every shard, merge with the shared top-k merge.
+
+        Async-dispatch discipline (the Dask client's scatter/gather
+        role, minus the round trips): queries are pre-placed once per
+        shard device (one batched transfer), EVERY shard search is
+        dispatched before anything blocks, the per-shard (q, k) parts
+        come back to the merge device in ONE batched transfer, and the
+        merge is one ``knn_merge_parts`` over the stacked parts —
+        offsets applied on the merge device so shard devices run only
+        their search."""
         res = ensure_resources(res)
         queries = jnp.asarray(queries)
         with tracing.range("raft_tpu.distributed.sharded_search"):
-            parts_d, parts_i = [], []
-            for index, off in zip(self.shards, self.offsets):
-                d, i = self.search_fn(res, index, queries, k)
-                parts_d.append(d)
-                parts_i.append(jnp.where(i >= 0, i + off, i))
-            # per-shard parts live on their shard's device; the merge
-            # needs them together (the raft-dask client-side
-            # knn_merge_parts role) — gather to the resources' device
-            # (default device when unset) before stacking
+            # one batched host->device scatter of the query block
+            devs = [_index_device(ix) for ix in self.shards]
+            unique_devs = [d for d in dict.fromkeys(devs) if d is not None]
+            placed = dict(zip(unique_devs, jax.device_put(
+                [queries] * len(unique_devs), unique_devs))
+            ) if unique_devs else {}
+            # fan out: all shard searches dispatch before any fetch
+            parts = [
+                self.search_fn(res, index, placed.get(dev, queries), k)
+                for index, dev in zip(self.shards, devs)
+            ]
+            # ONE batched gather of the (q, k) parts to the merge device
             merge_dev = res.device or jax.devices()[0]
-            parts_d = [jax.device_put(p, merge_dev) for p in parts_d]
-            parts_i = [jax.device_put(p, merge_dev) for p in parts_i]
-            return knn_merge_parts(
-                jnp.stack(parts_d), jnp.stack(parts_i), self.select_min
-            )
+            flat = [a for d, i in parts for a in (d, i)]
+            flat = jax.device_put(flat, merge_dev)
+            parts_i = [jnp.where(i >= 0, i + off, i)
+                       for i, off in zip(flat[1::2], self.offsets)]
+            return knn_merge_parts(jnp.stack(flat[0::2]),
+                                   jnp.stack(parts_i), self.select_min)
 
 
 def build_sharded(

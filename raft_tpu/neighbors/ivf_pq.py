@@ -837,7 +837,9 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
             dist = probe_dist(lists, rows, row_ids)
             if not select_min:
                 dist = -dist                           # to min-space
-            probed = jnp.any(probes == lid, axis=1)    # (q,) membership
+            # membership (sentinel steps — and sentinel-valued masked
+            # probe slots — match nothing, as in ops/ivf_scan)
+            probed = jnp.any(probes == lid, axis=1) & (lid < n_lists)
             dist = jnp.where(probed[:, None], dist, jnp.inf)
             return _merge_smallest_id(best_d, best_i, dist, row_ids,
                                       k), None

@@ -123,10 +123,13 @@ def unique_lists(probes: jax.Array, n_lists: int) -> jax.Array:
     """Sorted union of probed list ids, padded to the static cap
     ``min(n_lists, q * n_probes)`` with the sentinel id ``n_lists``.
 
-    The sentinel never matches any row of ``probes``, so the per-query
-    membership predicate masks sentinel steps out wholesale — the
-    ragged union rides a fixed shape, the same tail-masking discipline
-    as ``fused_topk``'s partial final block."""
+    The engines' membership predicates reject sentinel steps outright
+    (``lid < n_lists``), so the ragged union rides a fixed shape — the
+    same tail-masking discipline as ``fused_topk``'s partial final
+    block. Probe slots may themselves carry the sentinel value
+    ``n_lists`` ("masked probe" — e.g. a probe owned by another shard
+    of a list-sharded index): they collapse into the sentinel steps and
+    contribute nothing to any query's results."""
     q, p = probes.shape
     cap = min(n_lists, q * p)
     flat = jnp.sort(probes.reshape(-1).astype(jnp.int32))
@@ -153,7 +156,11 @@ def list_major_scan(qf, data, data_norms, indices, probes,
     each other even on exact duplicates. ``init_d``/``init_i``
     optionally provide the (q, k) running-state storage for the XLA
     engine (values are reset; the serving path donates them); the
-    Pallas engine keeps its state in VMEM scratch and ignores them."""
+    Pallas engine keeps its state in VMEM scratch and ignores them.
+
+    Probe slots carrying the sentinel value ``n_lists`` are masked
+    probes (the list-sharded indexes mark not-owned probes this way);
+    they are ignored by both engines."""
     expect(engine in ("pallas", "xla"),
            f"list_major_scan engine must be pallas|xla, got {engine!r}")
     if engine == "pallas":
@@ -211,7 +218,10 @@ def _scan_xla(qf, data, data_norms, indices, probes, filter_words,
                 data_norms, lidc, 0, False)
             dist = row_norms[None, :] - 2.0 * ip
         ids_b = jnp.broadcast_to(row_ids[None, :], dist.shape)
-        probed = jnp.any(probes == lid, axis=1)                # (q,)
+        # membership: which queries probed this list. A sentinel step
+        # (lid == n_lists) matches nothing — including masked probe
+        # slots, which carry the sentinel value themselves.
+        probed = jnp.any(probes == lid, axis=1) & (lid < n_lists)  # (q,)
         ok = (ids_b >= 0) & probed[:, None]
         if filter_words is not None:
             ok = ok & test_filter(filter_words, ids_b)
@@ -237,7 +247,7 @@ def _scan_xla(qf, data, data_norms, indices, probes, filter_words,
 
 def _ivf_scan_kernel(u_ref, probes_ref, q_ref, x_ref, xn_ref, ids_ref,
                      outd_ref, outi_ref, bestd, besti, *, k: int,
-                     n_steps: int, ip_metric: bool):
+                     n_steps: int, n_lists: int, ip_metric: bool):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -258,9 +268,12 @@ def _ivf_scan_kernel(u_ref, probes_ref, q_ref, x_ref, xn_ref, ids_ref,
     # min-space distances; IP negates back at the final step
     dist = -ip if ip_metric else xn_ref[:] - 2.0 * ip
     ids = ids_ref[:]                      # (1, m) — -1 marks pad/filtered
-    # membership predicate: which tile rows actually probed this list
-    # (the sentinel id n_lists matches no row, masking ragged tails)
+    # membership predicate: which tile rows actually probed this list.
+    # The lid < n_lists guard kills sentinel steps outright, including
+    # the case where probe slots carry the sentinel value themselves
+    # (shard-masked probes of the list-sharded indexes).
     probed = jnp.any(probes_ref[:] == lid, axis=1, keepdims=True)
+    probed = jnp.logical_and(probed, lid < n_lists)
     dist = jnp.where((ids >= 0) & probed, dist, jnp.inf)
 
     # filtered merge: skip the k-round extraction when no row improves
@@ -337,7 +350,7 @@ def _scan_pallas(qf, data, data_norms, indices, probes, filter_words, *,
                        constant_values=-1)
 
     kernel = functools.partial(_ivf_scan_kernel, k=k, n_steps=n_steps,
-                               ip_metric=ip_metric)
+                               n_lists=n_lists, ip_metric=ip_metric)
     clamp = n_lists - 1
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

@@ -121,6 +121,58 @@ def main():
     except Exception as e:  # noqa: BLE001
         emit("ivf_scan", error=str(e)[:300])
 
+    # ---- sharded list-major scan on the real mesh: the distributed
+    # IVF search (probe_mode=global) must be bit-identical to the
+    # single-device index for every engine — the PR-3 mesh contract,
+    # proven on real silicon (a 1-chip "mesh" still exercises the
+    # shard_map program + collectives end to end)
+    try:
+        from raft_tpu.comms import local_comms
+        from raft_tpu.distributed import ivf as dist_ivf
+        from raft_tpu.neighbors import ivf_flat
+
+        comms = local_comms()
+        xs = jnp.asarray(rng.standard_normal((20_000, 128)).astype(
+            np.float32))
+        qs = jnp.asarray(rng.standard_normal((16, 128)).astype(np.float32))
+        params = ivf_flat.IvfFlatIndexParams(n_lists=64, kmeans_n_iters=5)
+        single = ivf_flat.build(None, params, xs)
+        sharded = dist_ivf.build(None, comms, params, xs)
+        rep = {"n_chips": comms.size}
+        for eng in ("rank", "xla", "pallas"):
+            sp = ivf_flat.IvfFlatSearchParams(n_probes=8, scan_engine=eng)
+            d0, i0 = ivf_flat.search(None, sp, single, qs, 10)
+            d1, i1 = dist_ivf.search(None, sp, sharded, qs, 10)
+            rep[f"{eng}_ids_exact"] = bool(
+                (np.asarray(i0) == np.asarray(i1)).all())
+            rep[f"{eng}_bits_exact"] = bool(
+                (np.asarray(d0) == np.asarray(d1)).all()
+                and (np.asarray(i0) == np.asarray(i1)).all())
+        # wire-compressed merge stays rank-stable on well-separated data
+        sp = ivf_flat.IvfFlatSearchParams(n_probes=8)
+        _, iw = dist_ivf.search(None, sp, sharded, qs, 10,
+                                wire_dtype="bf16")
+        _, i0 = dist_ivf.search(None, sp, sharded, qs, 10)
+        rep["bf16_wire_id_agreement"] = float(
+            (np.asarray(iw) == np.asarray(i0)).mean())
+        # mesh-aware executor: zero recompiles across batch sizes
+        from raft_tpu import SearchExecutor
+        from raft_tpu.core import tracing
+
+        tracing.install_xla_compile_listener()
+        ex = SearchExecutor()
+        for nq in (16, 13, 9):
+            ex.search(sharded, qs[:nq], 10, params=sp)
+        b0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        for nq in (16, 13, 9, 13):
+            ex.search(sharded, qs[:nq], 10, params=sp)
+        rep["executor_zero_recompile"] = bool(
+            tracing.get_counter(tracing.XLA_COMPILE_COUNT) == b0)
+        rep["executor_compile_count"] = ex.stats.compile_count
+        emit("dist_ivf_scan", **rep)
+    except Exception as e:  # noqa: BLE001
+        emit("dist_ivf_scan", error=str(e)[:300])
+
     # ---- beam_search compiled vs the XLA engine (same seeds)
     try:
         from raft_tpu.neighbors.cagra import _search_batch

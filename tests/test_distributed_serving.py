@@ -1,0 +1,317 @@
+"""Mesh-native serving tests — the PR-3 acceptance suite.
+
+- Engine parity: the list-sharded IVF search (probe_mode=global) is
+  bit-identical (ids AND distances) to the single-device index for
+  every ``scan_engine``, on the 8-virtual-CPU-device mesh.
+- Lean collectives: the lean probe-candidate exchange selects the same
+  probe set as the dense coarse-block gather; ``wire_dtype="bf16"``
+  result compression keeps ids exact-ranked (smallest-id ties) and
+  shard-count deterministic.
+- Mesh-aware SearchExecutor: bucketing invariance (bit-identity with
+  the direct distributed search at batch sizes that do and do not fill
+  their bucket) and the zero-recompile steady-state guarantee, asserted
+  against jax's backend-compile monitoring events.
+- Streamed build deal: the per-shard placement produces the same index
+  as the dealt layout contract requires, and the peak build-device
+  staging counter stays at one block.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu import SearchExecutor
+from raft_tpu.comms import local_comms
+from raft_tpu.core import tracing
+from raft_tpu.distributed import bq as dist_bq, ivf as dist_ivf
+from raft_tpu.neighbors import ivf_bq, ivf_flat, ivf_pq
+from raft_tpu.neighbors.ivf_flat import (
+    IvfFlatIndexParams,
+    IvfFlatSearchParams,
+)
+from raft_tpu.neighbors.ivf_pq import IvfPqIndexParams, IvfPqSearchParams
+
+N_DEV = 8
+
+
+@pytest.fixture(scope="module")
+def comms():
+    return local_comms()
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(11)
+    x = rng.standard_normal((4096, 32)).astype(np.float32)
+    q = rng.standard_normal((16, 32)).astype(np.float32)
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def flat_pair(comms, data):
+    """The same dataset built as a single-device index and as the
+    list-sharded distributed index (same params/resources, so the
+    quantizer and packed lists are identical — only the deal differs)."""
+    x, _ = data
+    params = IvfFlatIndexParams(n_lists=32)
+    return (ivf_flat.build(None, params, x),
+            dist_ivf.build(None, comms, params, x))
+
+
+class TestEngineParity:
+    """probe_mode=global must be bit-identical to the single-device
+    index for every scan engine — the tentpole acceptance criterion."""
+
+    @pytest.mark.parametrize("engine", ["rank", "xla", "pallas", "auto"])
+    @pytest.mark.parametrize("n_probes", [4, 12, 32])
+    def test_flat_bit_identical(self, data, flat_pair, engine, n_probes):
+        _, q = data
+        single, dist = flat_pair
+        sp = IvfFlatSearchParams(n_probes=n_probes, scan_engine=engine)
+        d0, i0 = ivf_flat.search(None, sp, single, q, 10)
+        d1, i1 = dist_ivf.search(None, sp, dist, q, 10)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_flat_inner_product(self, comms, data):
+        x, q = data
+        from raft_tpu.distance.types import DistanceType
+
+        params = IvfFlatIndexParams(n_lists=32,
+                                    metric=DistanceType.InnerProduct)
+        single = ivf_flat.build(None, params, x)
+        dist = dist_ivf.build(None, comms, params, x)
+        for engine in ("rank", "xla"):
+            sp = IvfFlatSearchParams(n_probes=8, scan_engine=engine)
+            d0, i0 = ivf_flat.search(None, sp, single, q, 10)
+            d1, i1 = dist_ivf.search(None, sp, dist, q, 10)
+            np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+            np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    @pytest.mark.parametrize("engine", ["xla", "rank"])
+    def test_pq_engines(self, comms, data, engine):
+        """PQ union scan per shard: the xla engine must match the
+        single-chip xla engine bit-for-bit (shared smallest-id ADC
+        tie-break); the rank engine tracks it on id sets (positional
+        ties may legitimately order differently across layouts)."""
+        x, q = data
+        params = IvfPqIndexParams(n_lists=16, pq_dim=16)
+        single = ivf_pq.build(None, params, x)
+        dist = dist_ivf.build_pq(None, comms, params, x)
+        sp = IvfPqSearchParams(n_probes=8, scan_engine=engine)
+        d0, i0 = ivf_pq.search(None, sp, single, q, 10)
+        d1, i1 = dist_ivf.search_pq(None, sp, dist, q, 10)
+        if engine == "xla":
+            np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+            np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        else:
+            for a, b in zip(np.asarray(i0), np.asarray(i1)):
+                assert set(a.tolist()) == set(b.tolist())
+
+    def test_full_probes_equal_brute_force(self, comms, data):
+        x, q = data
+        dist = dist_ivf.build(None, comms, IvfFlatIndexParams(n_lists=16),
+                              x)
+        d2 = ((q[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        gt = np.argsort(d2, axis=1, kind="stable")[:, :5]
+        for engine in ("rank", "xla", "pallas"):
+            sp = IvfFlatSearchParams(n_probes=16, scan_engine=engine)
+            _, i = dist_ivf.search(None, sp, dist, q, 5)
+            np.testing.assert_array_equal(np.asarray(i), gt)
+
+
+class TestLeanCollectives:
+    def test_lean_probe_select_matches_dense(self, data, flat_pair):
+        """n_probes small enough to take the lean candidate exchange
+        (2·local_k < n_local) must return the same results as the
+        single-device probe set — the lean path is exact."""
+        _, q = data
+        single, dist = flat_pair
+        # n_local = 32/8 = 4 -> lean needs local_k < 2: n_probes=1
+        sp = IvfFlatSearchParams(n_probes=1, scan_engine="xla")
+        d0, i0 = ivf_flat.search(None, sp, single, q, 5)
+        d1, i1 = dist_ivf.search(None, sp, dist, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_lean_vs_dense_larger_mesh_budget(self, comms, data):
+        """With more lists per shard, a mid-size probe budget rides the
+        lean branch; it must match the dense branch bit-for-bit
+        (synthesized by a probe budget that forces the dense path on
+        the same index)."""
+        x, q = data
+        dist = dist_ivf.build(None, comms, IvfFlatIndexParams(n_lists=128),
+                              x)
+        # n_local = 16: n_probes=4 -> local_k=4, lean; compare against
+        # the single-device search (the exactness oracle)
+        single = ivf_flat.build(None, IvfFlatIndexParams(n_lists=128), x)
+        sp = IvfFlatSearchParams(n_probes=4, scan_engine="xla")
+        d0, i0 = ivf_flat.search(None, sp, single, q, 5)
+        d1, i1 = dist_ivf.search(None, sp, dist, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_wire_dtype_bf16(self, data, flat_pair):
+        """bf16 wire compression: ids stay int32-exact and the result
+        ranking follows the compressed distances with smallest-id
+        ties; against the f32 wire the id sets stay near-identical on
+        well-separated data."""
+        _, q = data
+        _, dist = flat_pair
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        d32, i32 = dist_ivf.search(None, sp, dist, q, 10)
+        d16, i16 = dist_ivf.search(None, sp, dist, q, 10,
+                                   wire_dtype="bf16")
+        assert np.asarray(i16).dtype == np.int32
+        agree = (np.asarray(i32) == np.asarray(i16)).mean()
+        assert agree >= 0.9, agree
+        # compressed distances within bf16 relative tolerance
+        np.testing.assert_allclose(np.asarray(d16), np.asarray(d32),
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_wire_dtype_validates(self, data, flat_pair):
+        _, q = data
+        _, dist = flat_pair
+        with pytest.raises(ValueError, match="wire_dtype"):
+            dist_ivf.search(None, IvfFlatSearchParams(n_probes=4), dist,
+                            q, 5, wire_dtype="f16")
+
+    def test_payload_model_is_lean(self):
+        """Acceptance: global-mode probe selection and result merge move
+        O(q · n_probes) and O(q · k) payloads, not the O(q · n_lists/R)
+        coarse block."""
+        m = dist_ivf.collective_payload_model(
+            q=64, k=10, n_probes=32, n_lists=4096, r=8,
+            wire_dtype="bf16")
+        assert m["coarse_bytes"] == 64 * 32 * 8      # (d, id) candidates
+        assert m["coarse_bytes"] < m["dense_coarse_bytes"]
+        assert m["merge_bytes"] == 64 * 10 * (2 + 4)  # bf16 wire + ids
+
+
+class TestMeshExecutor:
+    """Mesh-aware SearchExecutor: bucketing invariance + the
+    zero-recompile steady state, per engine."""
+
+    @pytest.mark.parametrize("engine", ["rank", "xla", "pallas"])
+    @pytest.mark.parametrize("q_rows", [3, 11, 16])
+    def test_bucketing_invariance(self, data, flat_pair, engine, q_rows):
+        _, q = data
+        _, dist = flat_pair
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine=engine)
+        ex = SearchExecutor()
+        d0, i0 = dist_ivf.search(None, sp, dist, q[:q_rows], 5)
+        d1, i1 = ex.search(dist, q[:q_rows], 5, params=sp)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_zero_recompiles_within_bucket(self, data, flat_pair):
+        _, q = data
+        _, dist = flat_pair
+        tracing.install_xla_compile_listener()
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        ex = SearchExecutor()
+        # prime each batch size (search compiles once per bucket; the
+        # tiny pad/place programs compile per distinct size)
+        for n in (16, 13, 9):
+            ex.search(dist, q[:n], 5, params=sp)
+        compiles0 = ex.stats.compile_count
+        assert compiles0 == 1
+        backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        for n in (16, 13, 9, 13, 16, 9):
+            ex.search(dist, q[:n], 5, params=sp)
+        assert ex.stats.compile_count == compiles0
+        assert tracing.get_counter(tracing.XLA_COMPILE_COUNT) == backend0
+        assert ex.stats.cache_hits >= 8
+
+    def test_engine_switch_is_distinct_executable(self, data, flat_pair):
+        _, q = data
+        _, dist = flat_pair
+        ex = SearchExecutor()
+        ex.search(dist, q, 5,
+                  params=IvfFlatSearchParams(n_probes=8, scan_engine="xla"))
+        c0 = ex.stats.compile_count
+        ex.search(dist, q, 5,
+                  params=IvfFlatSearchParams(n_probes=8,
+                                             scan_engine="rank"))
+        assert ex.stats.compile_count == c0 + 1
+
+    def test_warmup_then_serve(self, data, flat_pair):
+        _, q = data
+        _, dist = flat_pair
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        ex = SearchExecutor()
+        secs = ex.warmup(dist, buckets=(16,), k=5, params=sp)
+        assert secs > 0 and ex.stats.compile_count == 1
+        d, i = ex.search(dist, q, 5, params=sp)
+        assert ex.stats.compile_count == 1
+        assert ex.stats.cache_hits == 1
+        d0, i0 = dist_ivf.search(None, sp, dist, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i))
+
+    def test_donated_state_keeps_results_valid(self, data, flat_pair):
+        import warnings
+
+        _, q = data
+        _, dist = flat_pair
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # cpu ignores donation
+            ex = SearchExecutor(donate=True)
+            d1, i1 = ex.search(dist, q[:16], 5, params=sp)
+            d1c = np.asarray(d1).copy()
+            ex.search(dist, q[:9], 5, params=sp)
+            np.testing.assert_array_equal(np.asarray(d1), d1c)
+
+    def test_pq_and_bq_through_executor(self, comms, data):
+        x, q = data
+        pqi = dist_ivf.build_pq(
+            None, comms, IvfPqIndexParams(n_lists=16, pq_dim=16), x)
+        sp = IvfPqSearchParams(n_probes=8)
+        ex = SearchExecutor()
+        d0, i0 = dist_ivf.search_pq(None, sp, pqi, q[:9], 5)
+        d1, i1 = ex.search(pqi, q[:9], 5, params=sp)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+        bqi = dist_bq.build_bq(
+            None, comms, ivf_bq.IvfBqIndexParams(n_lists=16), x)
+        spb = ivf_bq.IvfBqSearchParams(n_probes=8)
+        d0, i0 = dist_bq.search_bq(None, spb, bqi, q[:9], 10)
+        d1, i1 = ex.search(bqi, q[:9], 10, params=spb)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_rejects_filter_and_query_axis(self, data, flat_pair):
+        from raft_tpu.core.bitset import Bitset
+        from raft_tpu.core.validation import RaftError
+        from raft_tpu.neighbors.filters import BitsetFilter
+
+        x, q = data
+        _, dist = flat_pair
+        ex = SearchExecutor()
+        bs = Bitset.from_mask(np.ones(x.shape[0], bool))
+        with pytest.raises(RaftError, match="sample_filter"):
+            ex.search(dist, q, 5, params=IvfFlatSearchParams(n_probes=4),
+                      sample_filter=BitsetFilter(bs))
+        with pytest.raises(RaftError, match="query_axis"):
+            ex.search(dist, q, 5, params=IvfFlatSearchParams(n_probes=4),
+                      query_axis="queries")
+
+
+class TestStreamedBuildDeal:
+    def test_peak_staging_is_one_block(self, comms, data):
+        x, _ = data
+        tracing.reset_counters("distributed.build.")
+        index = dist_ivf.build(None, comms, IvfFlatIndexParams(n_lists=32),
+                               x)
+        peak = tracing.get_counter(
+            "distributed.build.peak_deal_block_bytes")
+        total = tracing.get_counter("distributed.build.deal_bytes_total")
+        data_bytes = index.data.size * index.data.dtype.itemsize
+        assert 0 < peak <= data_bytes // N_DEV + 1
+        assert total >= data_bytes
+        # and the dealt index still searches exactly
+        q = x[:4]
+        sp = IvfFlatSearchParams(n_probes=32)
+        _, i = dist_ivf.search(None, sp, index, q, 1)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0],
+                                      np.arange(4))

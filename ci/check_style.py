@@ -1,141 +1,35 @@
 #!/usr/bin/env python
-"""Static style/sanity checks — role of the reference's
-``ci/checks/check_style.sh`` (flake8/black/clang-format there). The
-image ships no third-party linters, so this is a self-contained AST
-pass enforcing the repo's own hygiene rules:
+"""Style gate — thin wrapper over graftlint rule R0.
 
-  * every source file byte-compiles (syntax)
-  * no unused imports (except explicit ``# noqa`` / re-export manifests)
-  * no tabs, no trailing whitespace, newline at EOF
-  * no ``print(`` in library code (loggers only; bench/examples/scripts
-    and the CLI are exempt — printing is their job)
-  * no ``NotImplementedError`` stubs in ``raft_tpu/``
+The AST style pass that used to live in this file (syntax, unused
+imports, whitespace, no print-in-lib, no NotImplementedError stubs) is
+now rule R0 of ``raft_tpu.analysis`` (graftlint), behind the shared
+rule registry, so style and the serving-path invariant rules R1–R6 run
+one traversal and one suppression mechanism.
 
 Run: ``python ci/check_style.py`` (exit 1 on any finding).
+The full analyzer is ``python -m raft_tpu.analysis`` — ci/test.sh runs
+that as the real gate; this entry point stays for the quick
+style-only loop.
 """
 from __future__ import annotations
 
-import ast
 import pathlib
-import py_compile
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-LIB = ROOT / "raft_tpu"
-CHECK_DIRS = [LIB, ROOT / "tests", ROOT / "examples", ROOT / "scripts"]
-PRINT_EXEMPT = ("bench", "examples", "scripts", "__main__")
-
-errors: list[str] = []
-
-
-def err(path: pathlib.Path, line: int, msg: str) -> None:
-    errors.append(f"{path.relative_to(ROOT)}:{line}: {msg}")
-
-
-class ImportTracker(ast.NodeVisitor):
-    """Collect imported names and every name read anywhere."""
-
-    def __init__(self) -> None:
-        self.imported: dict[str, int] = {}
-        self.used: set[str] = set()
-
-    def visit_Import(self, node: ast.Import) -> None:
-        for a in node.names:
-            name = (a.asname or a.name).split(".")[0]
-            self.imported[name] = node.lineno
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        if node.module == "__future__":
-            return
-        for a in node.names:
-            if a.name == "*":
-                continue
-            self.imported[a.asname or a.name] = node.lineno
-
-    def visit_Name(self, node: ast.Name) -> None:
-        if isinstance(node.ctx, ast.Load):
-            self.used.add(node.id)
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        self.generic_visit(node)
-
-
-def check_file(path: pathlib.Path) -> None:
-    rel = str(path.relative_to(ROOT))
-    try:
-        py_compile.compile(str(path), doraise=True, cfile=None)
-    except py_compile.PyCompileError as e:
-        err(path, 0, f"does not compile: {e.msg}")
-        return
-
-    text = path.read_text()
-    lines = text.splitlines()
-    noqa = {i + 1 for i, ln in enumerate(lines) if "# noqa" in ln}
-    for i, ln in enumerate(lines, 1):
-        if "\t" in ln:
-            err(path, i, "tab character")
-        if ln != ln.rstrip():
-            err(path, i, "trailing whitespace")
-    if text and not text.endswith("\n"):
-        err(path, len(lines), "no newline at end of file")
-
-    tree = ast.parse(text)
-
-    # unused imports — skip __init__.py (re-export manifests) and conftest
-    if path.name not in ("__init__.py", "conftest.py"):
-        tracker = ImportTracker()
-        tracker.visit(tree)
-        # names referenced in __all__ strings or docstring references count
-        all_strings = {
-            s.value
-            for s in ast.walk(tree)
-            if isinstance(s, ast.Constant) and isinstance(s.value, str)
-        }
-        for name, line in tracker.imported.items():
-            if line in noqa or name.startswith("_"):
-                continue
-            if name not in tracker.used and name not in all_strings:
-                err(path, line, f"unused import '{name}'")
-
-    in_lib = path.is_relative_to(LIB)
-    exempt = any(p in path.parts for p in PRINT_EXEMPT)
-    if in_lib and not exempt:
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Name)
-                    and node.func.id == "print"
-                    and node.lineno not in noqa):
-                err(path, node.lineno, "print() in library code — use the logger")
-            # a function whose whole body is `raise NotImplementedError`
-            # is a stub; a terminal raise after exhaustive dispatch is fine
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                body = [s for s in node.body
-                        if not (isinstance(s, ast.Expr)
-                                and isinstance(s.value, ast.Constant))]
-                if len(body) == 1 and isinstance(body[0], ast.Raise):
-                    exc = body[0].exc
-                    name = (exc.func.id if isinstance(exc, ast.Call)
-                            and isinstance(exc.func, ast.Name) else
-                            exc.id if isinstance(exc, ast.Name) else None)
-                    if name == "NotImplementedError":
-                        err(path, node.lineno, "NotImplementedError stub")
+sys.path.insert(0, str(ROOT))
 
 
 def main() -> int:
-    n = 0
-    for d in CHECK_DIRS:
-        if not d.exists():
-            continue
-        for path in sorted(d.rglob("*.py")):
-            n += 1
-            check_file(path)
-    if errors:
-        print(f"check_style: {len(errors)} finding(s) in {n} files")
-        for e in errors:
-            print("  " + e)
-        return 1
-    print(f"check_style: OK ({n} files)")
-    return 0
+    from raft_tpu.analysis import Project, run
+    from raft_tpu.analysis.report import render_text
+
+    report = run(Project.from_root(ROOT), rules=["R0"])
+    out = render_text(report)
+    print(out.replace("graftlint:", "check_style [graftlint R0]:"),
+          end="")
+    return 0 if report.ok else 1
 
 
 if __name__ == "__main__":

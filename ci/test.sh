@@ -1,14 +1,19 @@
 #!/usr/bin/env bash
 # CI orchestration — role of the reference's ci/ tree:
-#   ci/checks/check_style.sh  -> ci/check_style.py (AST lint, no deps)
+#   ci/checks/check_style.sh  -> graftlint (python -m raft_tpu.analysis;
+#                                AST+dataflow lint, no deps — style is
+#                                rule R0, serving invariants R1-R6)
 #   ci/test_python.sh / ctest -> pytest (tests cover the whole framework;
 #                                native IO is built on demand via tests/test_io.py)
 #   wheel smoke tests         -> editable install + bare import + CLI --help
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== style =="
-python ci/check_style.py
+echo "== graftlint =="
+# exits non-zero on any unsuppressed finding; the JSON report lands
+# next to the bench JSONs as a build artifact
+JAX_PLATFORMS=cpu python -m raft_tpu.analysis --format=ci \
+    --output ci/graftlint_report.json
 
 echo "== packaging smoke =="
 python -m pip install -e . --no-deps --no-build-isolation --quiet

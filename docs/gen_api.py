@@ -18,6 +18,8 @@ MODULES = [
     "raft_tpu.core.tracing", "raft_tpu.core.interruptible",
     "raft_tpu.core.serialize", "raft_tpu.core.operators",
     "raft_tpu.core.validation",
+    "raft_tpu.analysis", "raft_tpu.analysis.core",
+    "raft_tpu.analysis.astutil", "raft_tpu.analysis.report",
     "raft_tpu.distance", "raft_tpu.distance.types",
     "raft_tpu.distance.fused_l2_nn", "raft_tpu.distance.masked_nn",
     "raft_tpu.distance.kernels",

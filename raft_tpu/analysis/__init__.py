@@ -1,0 +1,62 @@
+"""graftlint — a JAX/Pallas-aware static analyzer enforcing the
+serving-path invariants PR 1–3 established, as CI-gated lint rules.
+
+The performance guarantees of this repo are *invariants of how the
+code is written*: AOT cache keys stay hashable statics (R1), donated
+buffers are never read after donation (R2), every collective goes
+through the versioned comms veneer and names a real mesh axis (R3),
+every Pallas kernel states and fits its VMEM budget (R4), the serving
+hot path never round-trips to the host (R5), and every kernel keeps an
+interpret-mode CPU reference (R6). Runtime tests catch violations one
+configuration at a time; graftlint machine-checks them on every diff.
+
+Run::
+
+    python -m raft_tpu.analysis               # text report, exit 1 on findings
+    python -m raft_tpu.analysis --format=ci   # findings + suppression inventory
+    python -m raft_tpu.analysis --format=json --output=report.json
+
+Suppress a finding only with a written reason::
+
+    risky_line()  # graftlint: disable=R5(one-off build-path fetch)
+
+The analyzer is stdlib-``ast`` only (no third-party deps, the same
+constraint the old ``ci/check_style.py`` worked under — its checks now
+live here as rule R0).
+"""
+
+from raft_tpu.analysis.core import (
+    DEFAULT_DIRS,
+    Finding,
+    Project,
+    Report,
+    RULES,
+    Rule,
+    Suppression,
+    rule,
+    run,
+)
+
+# importing the rule modules registers them
+from raft_tpu.analysis import rules_style  # noqa: F401
+from raft_tpu.analysis import rules_trace  # noqa: F401
+from raft_tpu.analysis import rules_mesh  # noqa: F401
+from raft_tpu.analysis import rules_pallas  # noqa: F401
+from raft_tpu.analysis import rules_hostsync  # noqa: F401
+
+
+def lint_texts(texts, rules=None) -> Report:
+    """Lint an in-memory {relative path: source} mapping — the fixture
+    corpus entry point used by ``tests/test_analysis.py``."""
+    return run(Project.from_texts(texts), rules=rules)
+
+
+def lint_root(root, rules=None) -> Report:
+    """Lint a repo checkout rooted at ``root``."""
+    return run(Project.from_root(root), rules=rules)
+
+
+__all__ = [
+    "DEFAULT_DIRS", "Finding", "Project", "Report", "RULES", "Rule",
+    "Suppression", "rule", "run", "lint_texts", "lint_root",
+]

@@ -1,0 +1,71 @@
+"""CLI: ``python -m raft_tpu.analysis`` — lint the repo, exit non-zero
+on any unsuppressed finding."""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from raft_tpu.analysis import Project, run
+from raft_tpu.analysis.report import (
+    render_ci,
+    render_rules,
+    render_suppressions,
+    render_text,
+)
+
+
+def _default_root() -> pathlib.Path:
+    """The repo root: cwd when it holds the package, else the source
+    checkout this installed package lives in."""
+    cwd = pathlib.Path.cwd()
+    if (cwd / "raft_tpu").is_dir():
+        return cwd
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m raft_tpu.analysis",
+        description="graftlint — serving-path invariants as lint rules")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detect)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--format", dest="fmt", default="text",
+                    choices=("text", "json", "ci"))
+    ap.add_argument("--output", default=None,
+                    help="also write the JSON report to this path")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--list-suppressions", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        sys.stdout.write(render_rules())
+        return 0
+
+    root = pathlib.Path(args.root) if args.root else _default_root()
+    rules = args.rules.split(",") if args.rules else None
+    try:
+        report = run(Project.from_root(root), rules=rules)
+    except ValueError as e:
+        sys.stderr.write(f"graftlint: {e}\n")
+        return 2
+
+    if args.output:
+        pathlib.Path(args.output).write_text(report.to_json())
+    if args.list_suppressions:
+        sys.stdout.write(render_suppressions(report))
+        return 0
+    if args.fmt == "json":
+        sys.stdout.write(report.to_json())
+    elif args.fmt == "ci":
+        sys.stdout.write(render_ci(report))
+    else:
+        sys.stdout.write(render_text(report))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,356 @@
+"""Shared AST machinery for the graftlint rules.
+
+The load-bearing abstraction is the *traced-name dataflow*: given a
+function that jax traces (a ``*_fn`` serving impl, a ``shard_map``
+body, a Pallas kernel), which local names hold tracers?  The repo's
+signature convention makes the seed set syntactic — array operands are
+**unannotated positional** parameters, compile-time statics are
+keyword-only (or annotated) — and a simple forward pass propagates
+tracer-ness through assignments, treating shape/dtype metadata access
+as laundering (``x.shape[0]`` is a Python int, not a tracer).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# attribute accesses that yield static metadata, not a traced value
+METADATA_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize",
+                  "sharding", "device", "weak_type", "aval"}
+# calls whose result is static metadata regardless of the arguments
+METADATA_FNS = {"len", "isinstance", "type", "getattr", "hasattr",
+                "str", "repr", "id", "hash", "callable",
+                "np.shape", "jnp.shape", "np.ndim", "jnp.ndim",
+                "np.result_type", "jnp.result_type", "np.dtype"}
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def walk_in_order(body: Sequence[ast.stmt]) -> Iterable[ast.stmt]:
+    """Statements in source order, recursing into compound statements
+    but NOT into nested function/class definitions (separate scopes)."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            yield from walk_in_order(getattr(stmt, field, []) or [])
+        for h in getattr(stmt, "handlers", []) or []:
+            yield from walk_in_order(h.body)
+
+
+def value_names(expr: ast.AST) -> Set[str]:
+    """Bare names whose *runtime value* the expression consumes.
+
+    Names consumed only through metadata (``x.shape``, ``len(x)``),
+    identity checks (``x is None``), or other laundering constructs do
+    not count — conditioning on those is shape-static and jit-safe.
+    """
+    out: Set[str] = set()
+
+    def visit(n: ast.AST, value: bool) -> None:
+        if isinstance(n, ast.Name):
+            if value and isinstance(n.ctx, ast.Load):
+                out.add(n.id)
+            return
+        if isinstance(n, ast.Attribute):
+            visit(n.value, value and n.attr not in METADATA_ATTRS)
+            return
+        if isinstance(n, ast.Compare):
+            identity_only = all(isinstance(op, (ast.Is, ast.IsNot))
+                                for op in n.ops)
+            visit(n.left, value and not identity_only)
+            for c in n.comparators:
+                visit(c, value and not identity_only)
+            return
+        if isinstance(n, ast.Call):
+            fname = call_name(n)
+            launders = fname in METADATA_FNS
+            # the callee itself: `x.astype(...)` consumes x's value
+            visit(n.func, value)
+            for a in n.args:
+                visit(a, value and not launders)
+            for kw in n.keywords:
+                visit(kw.value, value and not launders)
+            return
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            return  # separate scope
+        for child in ast.iter_child_nodes(n):
+            visit(child, value)
+
+    visit(expr, True)
+    return out
+
+
+def assigned_names(target: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+def jit_static_names(fn: ast.FunctionDef) -> Optional[Set[str]]:
+    """For a jit-decorated def, the static parameter names (resolving
+    static_argnums to names). None when the def is not jit-decorated."""
+    for dec in fn.decorator_list:
+        call = dec if isinstance(dec, ast.Call) else None
+        name = dotted(call.func) if call else dotted(dec)
+        if name is None:
+            continue
+        target = call
+        if name in ("functools.partial", "partial") and call is not None:
+            if not call.args:
+                continue
+            inner = dotted(call.args[0])
+            if inner not in ("jax.jit", "jit"):
+                continue
+        elif name not in ("jax.jit", "jit"):
+            continue
+        statics: Set[str] = set()
+        pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        if target is not None:
+            for kw in target.keywords:
+                if kw.arg == "static_argnames":
+                    for c in ast.walk(kw.value):
+                        if (isinstance(c, ast.Constant)
+                                and isinstance(c.value, str)):
+                            statics.add(c.value)
+                if kw.arg == "static_argnums":
+                    for c in ast.walk(kw.value):
+                        if (isinstance(c, ast.Constant)
+                                and isinstance(c.value, int)
+                                and c.value < len(pos)):
+                            statics.add(pos[c.value])
+        return statics
+    return None
+
+
+def seed_traced_params(fn, statics: Optional[Set[str]] = None) -> Set[str]:
+    """The repo convention: unannotated positional params are traced
+    arrays; keyword-only and annotated params are compile-time statics."""
+    statics = statics or set()
+    traced: Set[str] = set()
+    args = fn.args
+    pos = list(getattr(args, "posonlyargs", [])) + list(args.args)
+    for p in pos:
+        ann = getattr(p, "annotation", None)
+        if ann is None and p.arg not in ("self", "cls", "res"):
+            if p.arg not in statics:
+                traced.add(p.arg)
+    if args.vararg is not None and args.vararg.annotation is None:
+        traced.add(args.vararg.arg)
+    return traced
+
+
+def traced_names(fn, statics: Optional[Set[str]] = None) -> Set[str]:
+    """Seed + two forward propagation passes over the body (two passes
+    give loop-carried names a chance to converge)."""
+    traced = seed_traced_params(fn, statics)
+    body = fn.body if isinstance(fn.body, list) else [ast.Return(fn.body)]
+    for _ in range(2):
+        for stmt in walk_in_order(body):
+            if isinstance(stmt, ast.Assign):
+                hot = bool(value_names(stmt.value) & traced)
+                for t in stmt.targets:
+                    names = assigned_names(t)
+                    if hot:
+                        traced |= names
+                    elif isinstance(t, ast.Name):
+                        traced.discard(t.id)
+            elif isinstance(stmt, ast.AugAssign):
+                if value_names(stmt.value) & traced:
+                    traced |= assigned_names(stmt.target)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                if value_names(stmt.value) & traced:
+                    traced |= assigned_names(stmt.target)
+            elif isinstance(stmt, ast.For):
+                if value_names(stmt.iter) & traced:
+                    traced |= assigned_names(stmt.target)
+    return traced
+
+
+def collect_functions(tree: ast.AST) -> List[ast.FunctionDef]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def traced_bodies(tree: ast.AST) -> List[Tuple[ast.AST, Set[str], str]]:
+    """Functions jax traces, with their traced-name sets:
+
+    - ``*_fn`` serving impls (repo naming convention)
+    - jit-decorated defs (statics read off the decorator)
+    - bodies passed to ``shard_map`` / ``comms.run`` / ``pallas_call``
+      (by local name, nested def, lambda, or ``functools.partial``)
+
+    Returns (node, traced names, origin tag).
+    """
+    fns = collect_functions(tree)
+    by_name: Dict[str, ast.FunctionDef] = {}
+    for f in fns:
+        by_name.setdefault(f.name, f)
+
+    out: List[Tuple[ast.AST, Set[str], str]] = []
+    seen: Set[int] = set()
+
+    def add(fn, statics, origin):
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        if isinstance(fn, ast.Lambda):
+            traced = {a.arg for a in fn.args.args + fn.args.posonlyargs}
+            out.append((fn, traced, origin))
+        else:
+            out.append((fn, traced_names(fn, statics), origin))
+
+    for f in fns:
+        statics = jit_static_names(f)
+        if statics is not None:
+            add(f, statics, "jit")
+        elif f.name.endswith("_fn"):
+            add(f, None, "fn-convention")
+
+    def resolve_body_arg(arg):
+        if isinstance(arg, (ast.Lambda,)):
+            return arg
+        if isinstance(arg, ast.Name):
+            return by_name.get(arg.id)
+        if isinstance(arg, ast.Call):
+            nm = call_name(arg)
+            if nm in ("functools.partial", "partial") and arg.args:
+                return resolve_body_arg(arg.args[0])
+        return None
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        nm = call_name(node) or ""
+        leaf = nm.split(".")[-1]
+        if leaf in ("shard_map", "pallas_call") and node.args:
+            body = resolve_body_arg(node.args[0])
+            if body is not None:
+                add(body, None, leaf)
+        if leaf == "run" and nm.endswith(".run") and node.args:
+            # Comms.run(fn, *args, in_specs=..., out_specs=...)
+            if any(kw.arg == "in_specs" for kw in node.keywords):
+                body = resolve_body_arg(node.args[0])
+                if body is not None:
+                    add(body, None, "comms.run")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# constant folding (R4's static VMEM estimate)
+# ---------------------------------------------------------------------------
+
+
+class Env:
+    """Lazy single-assignment constant environment for one function."""
+
+    def __init__(self, fn: ast.AST):
+        self.bindings: Dict[str, ast.AST] = {}
+        self.multi: Set[str] = set()
+        body = fn.body if isinstance(fn.body, list) else []
+        for stmt in walk_in_order(body):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                if name in self.bindings:
+                    self.multi.add(name)
+                self.bindings[name] = stmt.value
+            elif isinstance(stmt, (ast.AugAssign, ast.For)):
+                for n in assigned_names(getattr(stmt, "target", stmt)):
+                    self.multi.add(n)
+        self._memo: Dict[str, Optional[float]] = {}
+        self._stack: Set[str] = set()
+
+    def lookup(self, name: str) -> Optional[float]:
+        if name in self.multi or name not in self.bindings:
+            return None
+        if name in self._memo:
+            return self._memo[name]
+        if name in self._stack:
+            return None
+        self._stack.add(name)
+        try:
+            val = const_fold(self.bindings[name], self)
+        finally:
+            self._stack.discard(name)
+        self._memo[name] = val
+        return val
+
+
+def const_fold(expr: ast.AST, env: Optional[Env] = None):
+    """Best-effort numeric fold; None when any input is dynamic."""
+    if isinstance(expr, ast.Constant) and isinstance(
+            expr.value, (int, float)) and not isinstance(expr.value, bool):
+        return expr.value
+    if isinstance(expr, ast.Name):
+        return env.lookup(expr.id) if env else None
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.USub):
+        v = const_fold(expr.operand, env)
+        return None if v is None else -v
+    if isinstance(expr, ast.BinOp):
+        left = const_fold(expr.left, env)
+        right = const_fold(expr.right, env)
+        if left is None or right is None:
+            return None
+        try:
+            if isinstance(expr.op, ast.Add):
+                return left + right
+            if isinstance(expr.op, ast.Sub):
+                return left - right
+            if isinstance(expr.op, ast.Mult):
+                return left * right
+            if isinstance(expr.op, ast.FloorDiv):
+                return left // right
+            if isinstance(expr.op, ast.Mod):
+                return left % right
+            if isinstance(expr.op, ast.LShift):
+                return int(left) << int(right)
+            if isinstance(expr.op, ast.RShift):
+                return int(left) >> int(right)
+            if isinstance(expr.op, ast.Pow):
+                return left ** right
+        except (ZeroDivisionError, TypeError, ValueError, OverflowError):
+            return None
+        return None
+    if isinstance(expr, ast.Call):
+        nm = call_name(expr)
+        if nm in ("min", "max") and expr.args and not expr.keywords:
+            vals = [const_fold(a, env) for a in expr.args]
+            if any(v is None for v in vals):
+                return None
+            return min(vals) if nm == "min" else max(vals)
+        if nm == "int" and len(expr.args) == 1:
+            v = const_fold(expr.args[0], env)
+            return None if v is None else int(v)
+    return None
+
+
+def fold_shape(shape_expr: ast.AST, env: Optional[Env]) -> Optional[List[int]]:
+    """Fold a literal shape tuple to ints; None if any dim is dynamic."""
+    if not isinstance(shape_expr, (ast.Tuple, ast.List)):
+        return None
+    dims: List[int] = []
+    for el in shape_expr.elts:
+        v = const_fold(el, env)
+        if v is None:
+            return None
+        dims.append(int(v))
+    return dims

@@ -1,0 +1,356 @@
+"""graftlint core model — source files, suppression pragmas, the rule
+registry, and the runner.
+
+The analyzer itself is self-contained (stdlib ``ast`` only — the same
+no-third-party-deps constraint as the old ``ci/check_style.py``); it
+never *executes* the code it checks, it only parses it. Note the CLI
+(``python -m raft_tpu.analysis``) still pays the ``raft_tpu`` package
+import (which pulls in jax) — the analysis modules merely add nothing
+on top.
+
+Suppressions are written next to the finding they silence::
+
+    x = risky()  # graftlint: disable=R5(build-path host fetch, one-off)
+
+or on their own line, covering the next statement::
+
+    # graftlint: disable=R3(pvary compat shim lives here by design)
+    out = jax.lax.ppermute(x, axis, perm)
+
+The rule id must match and the parenthesized reason is mandatory —
+a pragma without a reason, and a pragma that silences nothing, are
+themselves findings (rule R0), so the suppression inventory can only
+grow deliberately and is snapshot-tested.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import pathlib
+import re
+import tokenize
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+PRAGMA_RE = re.compile(r"#\s*graftlint:\s*disable=(.*?)\s*$")
+PRAGMA_ID_RE = re.compile(r"\s*([A-Z][A-Z0-9]*)\s*")
+
+
+def parse_pragma_items(payload: str):
+    """Parse ``R1(reason), R5(reason with (parens))`` — returns
+    ([(rule, reason-or-None)], trailing-garbage-flag). Reasons may
+    contain balanced parentheses."""
+    items = []
+    pos, bad = 0, False
+    while pos < len(payload):
+        m = PRAGMA_ID_RE.match(payload, pos)
+        if not m:
+            bad = bad or bool(payload[pos:].strip(", \t"))
+            break
+        rule_id = m.group(1)
+        pos = m.end()
+        reason = None
+        if pos < len(payload) and payload[pos] == "(":
+            depth, start = 1, pos + 1
+            pos += 1
+            while pos < len(payload) and depth:
+                if payload[pos] == "(":
+                    depth += 1
+                elif payload[pos] == ")":
+                    depth -= 1
+                pos += 1
+            if depth:
+                bad = True
+                break
+            reason = payload[start:pos - 1]
+        items.append((rule_id, reason))
+        rest = payload[pos:].lstrip()
+        if rest.startswith(","):
+            pos = len(payload) - len(rest) + 1
+        elif rest:
+            bad = True
+            break
+        else:
+            break
+    return items, bad
+
+#: directories scanned by default, relative to the repo root — the same
+#: set the old ci/check_style.py walked.
+DEFAULT_DIRS = ("raft_tpu", "tests", "examples", "scripts")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclasses.dataclass
+class Suppression:
+    """One ``# graftlint: disable=RULE(reason)`` pragma."""
+
+    rule: str
+    path: str
+    line: int          # code line the pragma covers
+    pragma_line: int   # line the comment physically sits on
+    reason: str
+    used: bool = False
+
+
+class SourceFile:
+    """A parsed source file plus its suppression pragmas."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel.replace("\\", "/")
+        parts = self.rel.split("/")
+        self.kind = parts[0] if parts[0] in DEFAULT_DIRS else "other"
+        self.text = text
+        self.lines = text.splitlines()
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(text)
+        except SyntaxError as e:
+            self.tree = None
+            self.syntax_error = e
+        self.suppressions: List[Suppression] = []
+        self.bad_pragmas: List[tuple] = []  # (line, why)
+        self._parse_pragmas()
+
+    # -- pragmas ------------------------------------------------------------
+
+    def _stmt_start(self, line: int) -> int:
+        """First line of the innermost statement spanning ``line`` —
+        findings anchor to a node's first line, so a pragma trailing a
+        *continuation* line of a multi-line statement must map back to
+        the statement start to suppress anything."""
+        if self.tree is None:
+            return line
+        best = line
+        best_span = None
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.stmt):
+                continue
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end:
+                span = end - node.lineno
+                if best_span is None or span <= best_span:
+                    best, best_span = node.lineno, span
+        return best
+
+    def _covered_line(self, pragma_line: int, own_line: bool) -> int:
+        """A trailing pragma covers its statement; a comment-only
+        pragma covers the statement starting at (or spanning) the next
+        non-blank, non-comment line."""
+        if own_line:
+            return self._stmt_start(pragma_line)
+        for j in range(pragma_line, len(self.lines)):
+            nxt = self.lines[j].strip()
+            if nxt and not nxt.startswith("#"):
+                return self._stmt_start(j + 1)
+        return pragma_line
+
+    def _comment_tokens(self):
+        """Real COMMENT tokens only — a pragma quoted inside a
+        docstring (e.g. this module's own examples) is not a pragma."""
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    yield tok.start[0], tok.start[1], tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return
+
+    def _parse_pragmas(self) -> None:
+        for i, col, comment in self._comment_tokens():
+            m = PRAGMA_RE.search(comment)
+            if not m:
+                continue
+            own_line = bool(self.lines[i - 1][:col].strip())
+            covered = self._covered_line(i, own_line)
+            items, bad = parse_pragma_items(m.group(1))
+            for rule_id, reason in items:
+                if reason is None or not reason.strip():
+                    self.bad_pragmas.append(
+                        (i, f"suppression of {rule_id} carries no reason "
+                            "— write disable="
+                            f"{rule_id}(why this is safe)"))
+                    continue
+                self.suppressions.append(Suppression(
+                    rule=rule_id, path=self.rel, line=covered,
+                    pragma_line=i, reason=reason.strip()))
+            if bad or not items:
+                self.bad_pragmas.append(
+                    (i, "malformed graftlint pragma — expected "
+                        "disable=RULE(reason)"))
+
+    def suppression_for(self, rule: str, line: int) -> Optional[Suppression]:
+        # findings anchor to a node's own line, which for a multi-line
+        # statement may be a continuation line — normalize both sides
+        # to the statement start so a trailing pragma anywhere in the
+        # statement suppresses any finding inside it
+        stmt = self._stmt_start(line)
+        for s in self.suppressions:
+            if s.rule == rule and s.line in (line, stmt):
+                return s
+        return None
+
+
+class Project:
+    """The set of files one analysis run sees."""
+
+    def __init__(self, files: Sequence[SourceFile],
+                 root: Optional[pathlib.Path] = None):
+        self.files = list(files)
+        self.root = root
+        self.by_rel = {f.rel: f for f in self.files}
+
+    @classmethod
+    def from_root(cls, root, dirs: Sequence[str] = DEFAULT_DIRS
+                  ) -> "Project":
+        root = pathlib.Path(root).resolve()
+        files = []
+        for d in dirs:
+            base = root / d
+            if not base.exists():
+                continue
+            for path in sorted(base.rglob("*.py")):
+                if "__pycache__" in path.parts:
+                    continue
+                rel = path.relative_to(root).as_posix()
+                files.append(SourceFile(rel, path.read_text()))
+        return cls(files, root)
+
+    @classmethod
+    def from_texts(cls, texts: Dict[str, str]) -> "Project":
+        """Synthetic project for the fixture corpus: path -> source."""
+        return cls([SourceFile(rel, text)
+                    for rel, text in sorted(texts.items())])
+
+    def lib(self) -> List[SourceFile]:
+        return [f for f in self.files if f.kind == "raft_tpu"]
+
+    def tests(self) -> List[SourceFile]:
+        return [f for f in self.files if f.kind == "tests"]
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    doc: str
+    check: Callable[[Project], Iterable[Finding]]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, name: str):
+    """Register a checker under a rule id. The checker's docstring is
+    the rule's documentation (surfaced by ``--list-rules``)."""
+
+    def deco(fn):
+        doc = " ".join((fn.__doc__ or "").split())
+        RULES[rule_id] = Rule(rule_id, name, doc, fn)
+        return fn
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Report:
+    findings: List[Finding]                 # unsuppressed — gate on these
+    suppressed: List[tuple]                 # (Finding, reason)
+    suppressions: List[Suppression]         # full inventory
+    rules_run: List[str]
+    n_files: int
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "rules_run": self.rules_run,
+            "n_files": self.n_files,
+            "findings": [dataclasses.asdict(f) for f in self.findings],
+            "suppressed": [
+                dict(dataclasses.asdict(f), reason=reason)
+                for f, reason in self.suppressed
+            ],
+            "suppressions": [dataclasses.asdict(s)
+                             for s in self.suppressions],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2) + "\n"
+
+
+def run(project: Project, rules: Optional[Sequence[str]] = None) -> Report:
+    """Run ``rules`` (default: all registered) over ``project`` and
+    fold in suppression pragmas + pragma hygiene."""
+    selected = list(rules) if rules is not None else sorted(RULES)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s) {unknown}; have {sorted(RULES)}")
+
+    raw: List[Finding] = []
+    for rid in selected:
+        raw.extend(RULES[rid].check(project))
+
+    findings: List[Finding] = []
+    suppressed: List[tuple] = []
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
+        sf = project.by_rel.get(f.path)
+        sup = sf.suppression_for(f.rule, f.line) if sf else None
+        if sup is not None:
+            sup.used = True
+            suppressed.append((f, sup.reason))
+        else:
+            findings.append(f)
+
+    # pragma hygiene rides rule R0 (it is style discipline); an unused
+    # pragma only counts against rules that actually ran this pass
+    inventory: List[Suppression] = []
+    for sf in project.files:
+        if "R0" in selected:
+            for line, why in sf.bad_pragmas:
+                findings.append(Finding("R0", sf.rel, line, why))
+        for s in sf.suppressions:
+            inventory.append(s)
+            if "R0" not in selected:
+                continue
+            if s.rule not in RULES:
+                findings.append(Finding(
+                    "R0", sf.rel, s.pragma_line,
+                    f"suppression names unknown rule {s.rule!r} "
+                    f"(registered: {', '.join(sorted(RULES))}) — a "
+                    "typo'd id silences nothing"))
+            elif not s.used and s.rule in selected:
+                findings.append(Finding(
+                    "R0", sf.rel, s.pragma_line,
+                    f"unused suppression of {s.rule} — the rule no "
+                    "longer fires here; delete the pragma"))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return Report(findings=findings, suppressed=suppressed,
+                  suppressions=inventory, rules_run=selected,
+                  n_files=len(project.files))

@@ -1,0 +1,126 @@
+"""R5 — host-sync lint for the serving hot path.
+
+TPU-KNN's peak-throughput recipe (and PR 1's executor design) dies by
+a thousand silent host round-trips: one ``.item()`` in a scan loop
+serializes every dispatch; an ``np.asarray`` on a device array fetches
+the whole buffer; a ``device_put`` inside a Python loop issues one
+transfer per iteration where one batched call would do.
+
+Scope — the hot modules named by the serving stack:
+``core/executor.py``, ``raft_tpu/ops/*``, ``raft_tpu/distributed/*``
+(except ``checkpoint.py``, which is the host-IO module by design) and
+``raft_tpu/neighbors/*``. Within them:
+
+- ``.item()`` anywhere (it is never right on the hot path);
+- ``np.asarray`` / ``np.array`` / ``jax.device_get``, and
+  ``float()``/``int()`` of traced values, inside jit-traced serving
+  bodies (``*_fn`` impls, ``shard_map``/Pallas bodies) and
+  ``search*`` entry points — host fetches the steady state must not
+  pay (build/save/load paths are host-side by contract and exempt);
+- ``jax.device_put`` inside a ``for``/``while`` loop — transfers
+  belong in one batched call per step, not one per iteration.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from raft_tpu.analysis import astutil
+from raft_tpu.analysis.core import Finding, Project, rule
+
+HOT_PREFIXES = ("raft_tpu/ops/", "raft_tpu/distributed/",
+                "raft_tpu/neighbors/")
+HOT_FILES = ("raft_tpu/core/executor.py",)
+EXEMPT = ("raft_tpu/distributed/checkpoint.py",)
+
+_FETCH_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                "jax.device_get", "device_get"}
+
+
+def _is_hot(rel: str) -> bool:
+    if rel in EXEMPT:
+        return False
+    return rel in HOT_FILES or rel.startswith(HOT_PREFIXES)
+
+
+def _serving_scopes(tree: ast.AST):
+    """jit-traced bodies plus host-side ``search*`` orchestration."""
+    scopes = list(astutil.traced_bodies(tree))
+    seen = {id(fn) for fn, _, _ in scopes}
+    for fn in astutil.collect_functions(tree):
+        if id(fn) not in seen and (fn.name == "search"
+                                   or fn.name.startswith("search_")
+                                   or fn.name.startswith("_search")):
+            scopes.append((fn, astutil.traced_names(fn), "search-entry"))
+    return scopes
+
+
+@rule("R5", "host-sync")
+def check_host_sync(project: Project) -> Iterable[Finding]:
+    """Host round-trips (.item, np.asarray/device_get, float/int of
+    traced values, per-iteration device_put) in the serving hot
+    modules."""
+    out: List[Finding] = []
+    for f in project.lib():
+        if f.tree is None or not _is_hot(f.rel):
+            continue
+
+        # .item() anywhere in a hot module
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                out.append(Finding(
+                    "R5", f.rel, node.lineno,
+                    ".item() in a hot module — a blocking host sync "
+                    "per call; keep the value on device or fetch it "
+                    "once, batched"))
+
+        # device_put inside python loops
+        for loop in ast.walk(f.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if node is loop:
+                    continue
+                if isinstance(node, ast.Call) and (
+                        astutil.call_name(node) or "").endswith(
+                        "device_put"):
+                    out.append(Finding(
+                        "R5", f.rel, node.lineno,
+                        "device_put inside a python loop — one "
+                        "transfer per iteration; batch the placements "
+                        "into a single device_put call"))
+
+        # host fetches inside serving scopes
+        for fn, traced, origin in _serving_scopes(f.tree):
+            body = fn.body if isinstance(fn.body, list) else []
+            reported: Set[int] = set()
+            for stmt in astutil.walk_in_order(body):
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Call) \
+                            or node.lineno in reported:
+                        continue
+                    nm = astutil.call_name(node) or ""
+                    if nm in _FETCH_CALLS:
+                        reported.add(node.lineno)
+                        out.append(Finding(
+                            "R5", f.rel, node.lineno,
+                            f"{nm}() inside {origin} "
+                            f"'{getattr(fn, 'name', '<lambda>')}' — "
+                            "fetches device data to host on the "
+                            "serving path"))
+                    leaf = nm.split(".")[-1]
+                    if leaf in ("float", "int") and node.args:
+                        hot = astutil.value_names(node.args[0]) & traced
+                        if hot:
+                            reported.add(node.lineno)
+                            out.append(Finding(
+                                "R5", f.rel, node.lineno,
+                                f"{leaf}() of traced value(s) "
+                                f"{sorted(hot)} inside {origin} "
+                                f"'{getattr(fn, 'name', '<lambda>')}'"
+                                " — forces a device sync (and fails "
+                                "under jit); keep it as an array"))
+    return out

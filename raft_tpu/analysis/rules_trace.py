@@ -1,0 +1,304 @@
+"""R1 recompile-hazard and R2 donation-safety — the trace-discipline
+rules defending PR 1's zero-recompile serving contract.
+
+R1 has two teeth:
+
+- **tracer control flow**: a Python ``if``/``while``/``for`` on a
+  traced value inside a jit-traced body (``*_fn`` serving impls,
+  ``shard_map``/``comms.run`` bodies, Pallas kernels, jit-decorated
+  defs) either crashes at trace time (ConcretizationTypeError) or —
+  worse — silently retraces per value when the operand is weakly
+  concrete. Shape/metadata conditions (``x.ndim == 2``,
+  ``fw is None``) are static and exempt.
+- **cache-key discipline**: the executor's AOT cache keys (``_Plan``'s
+  ``key=`` tuples and any ``key = (...)`` feeding them) must stay
+  hashable statics — a bare list/set/dict display (not folded through
+  ``tuple()``/``frozenset()``), or a ``float()``/``int()``/``.item()``
+  of runtime data, makes the key unhashable or data-dependent and
+  turns every search into a cache miss + recompile.
+
+R2 follows donated buffers: an argument donated to a jitted call
+(``donate_argnums``/``donate_argnames`` at the ``jax.jit`` site, or
+the repo's ``donate=True`` convention on ``extend``-style entry
+points) is dead storage after the call — reading it again raises
+jax's deleted-array error on backends that honor donation and
+silently "works" on CPU, which is exactly the kind of
+configuration-dependent regression this rule exists to catch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from raft_tpu.analysis import astutil
+from raft_tpu.analysis.core import Finding, Project, rule
+
+_KEY_WRAPPERS = ("tuple", "frozenset")
+_BANNED_DISPLAYS = (ast.List, ast.Set, ast.Dict, ast.ListComp,
+                    ast.SetComp, ast.DictComp)
+
+
+def _check_key_expr(f, expr: ast.AST, out: List[Finding]) -> None:
+    """Flag unhashable displays and data-dependent scalars in a cache
+    key expression."""
+
+    def visit(n: ast.AST, wrapped: bool) -> None:
+        if isinstance(n, _BANNED_DISPLAYS) and not wrapped:
+            out.append(Finding(
+                "R1", f.rel, n.lineno,
+                f"unhashable {type(n).__name__.lower()} in an executor "
+                "cache key — wrap it in tuple()/frozenset() so the AOT "
+                "cache can hash it"))
+            return
+        if isinstance(n, ast.Call):
+            nm = astutil.call_name(n) or ""
+            leaf = nm.split(".")[-1]
+            if leaf in ("float", "int") and n.args and not isinstance(
+                    n.args[0], ast.Constant):
+                out.append(Finding(
+                    "R1", f.rel, n.lineno,
+                    f"{leaf}() of runtime data in an executor cache key "
+                    "— keys must be built from hashable statics, not "
+                    "values pulled off arrays"))
+            if leaf == "item":
+                out.append(Finding(
+                    "R1", f.rel, n.lineno,
+                    ".item() in an executor cache key — a host sync per "
+                    "lookup and a data-dependent key"))
+            wrapped = wrapped or leaf in _KEY_WRAPPERS
+        for child in ast.iter_child_nodes(n):
+            visit(child, wrapped)
+
+    visit(expr, False)
+
+
+@rule("R1", "recompile-hazard")
+def check_recompile(project: Project) -> Iterable[Finding]:
+    """Python control flow on traced values inside jit-traced bodies,
+    and unhashable / data-dependent executor cache keys."""
+    out: List[Finding] = []
+    for f in project.lib():
+        if f.tree is None:
+            continue
+        for fn, traced, origin in astutil.traced_bodies(f.tree):
+            body = fn.body if isinstance(fn.body, list) else []
+            for stmt in astutil.walk_in_order(body):
+                if isinstance(stmt, (ast.If, ast.While)):
+                    hot = astutil.value_names(stmt.test) & traced
+                    if hot:
+                        kind = ("if" if isinstance(stmt, ast.If)
+                                else "while")
+                        out.append(Finding(
+                            "R1", f.rel, stmt.lineno,
+                            f"python `{kind}` on traced value(s) "
+                            f"{sorted(hot)} inside {origin} body "
+                            f"'{getattr(fn, 'name', '<lambda>')}' — "
+                            "use lax.cond/jnp.where, or hoist the "
+                            "decision to a static"))
+                elif isinstance(stmt, ast.For):
+                    hot = astutil.value_names(stmt.iter) & traced
+                    if hot:
+                        out.append(Finding(
+                            "R1", f.rel, stmt.lineno,
+                            f"python `for` over traced value(s) "
+                            f"{sorted(hot)} inside {origin} body "
+                            f"'{getattr(fn, 'name', '<lambda>')}' — "
+                            "use lax.scan/fori_loop"))
+
+        # cache-key discipline: `_Plan(key=...)` and `key = (...)`
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call):
+                nm = astutil.call_name(node) or ""
+                if nm.split(".")[-1] == "_Plan":
+                    for kw in node.keywords:
+                        if kw.arg == "key":
+                            _check_key_expr(f, kw.value, out)
+            elif isinstance(node, ast.Assign):
+                if (len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id in ("key", "cache_key")
+                        and isinstance(node.value, ast.Tuple)):
+                    _check_key_expr(f, node.value, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R2 — donation safety
+# ---------------------------------------------------------------------------
+
+
+def _positional_names(fn) -> list:
+    return [a.arg for a in fn.args.posonlyargs + fn.args.args]
+
+
+def _donated_argnums(call: ast.Call, resolve_fn=None) -> Optional[Set[int]]:
+    """For a ``jax.jit(f, donate_argnums=...)`` /
+    ``jax.jit(f, donate_argnames=...)`` call, the donated positional
+    indices (None when the call is not a donating jit).
+    ``donate_argnames`` needs the wrapped function's signature —
+    ``resolve_fn`` maps its first argument to a local def when one is
+    in scope."""
+    nm = astutil.call_name(call) or ""
+    if nm.split(".")[-1] != "jit":
+        return None
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums |= {c.value for c in ast.walk(kw.value)
+                     if isinstance(c, ast.Constant)
+                     and isinstance(c.value, int)}
+        if kw.arg == "donate_argnames" and resolve_fn is not None:
+            names = {c.value for c in ast.walk(kw.value)
+                     if isinstance(c, ast.Constant)
+                     and isinstance(c.value, str)}
+            fn = resolve_fn(call.args[0]) if call.args else None
+            if fn is not None:
+                pos = _positional_names(fn)
+                nums |= {i for i, p in enumerate(pos) if p in names}
+    return nums or None
+
+
+def _decorator_donated_argnums(fn) -> Optional[Set[int]]:
+    """Donated positional indices for the ``@partial(jax.jit,
+    donate_argnums=...)`` / ``@jax.jit(donate_argnames=...)`` decorator
+    forms — the shape 5 of the repo's 7 donation sites use."""
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        nm = astutil.dotted(dec.func) or ""
+        target = dec
+        if nm.split(".")[-1] == "partial" and dec.args:
+            inner = astutil.dotted(dec.args[0]) or ""
+            if inner.split(".")[-1] != "jit":
+                continue
+        elif nm.split(".")[-1] != "jit":
+            continue
+        nums: Set[int] = set()
+        pos = _positional_names(fn)
+        for kw in target.keywords:
+            if kw.arg == "donate_argnums":
+                nums |= {c.value for c in ast.walk(kw.value)
+                         if isinstance(c, ast.Constant)
+                         and isinstance(c.value, int)}
+            if kw.arg == "donate_argnames":
+                names = {c.value for c in ast.walk(kw.value)
+                         if isinstance(c, ast.Constant)
+                         and isinstance(c.value, str)}
+                nums |= {i for i, p in enumerate(pos) if p in names}
+        if nums:
+            return nums
+    return None
+
+
+def _scan_reads_after(f, scope, call_stmt_line: int,
+                      donated: Set[str], out: List[Finding],
+                      how: str) -> None:
+    """Flag loads of donated names after the donating call, up to the
+    first rebind (a rebind on the call line itself is the blessed
+    ``state = step(state)`` threading idiom)."""
+    loads = []
+    stores = {}
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Name) and n.id in donated:
+            if isinstance(n.ctx, ast.Load):
+                loads.append((n.lineno, n.id))
+            else:
+                stores.setdefault(n.id, []).append(n.lineno)
+    for name in donated:
+        rebinds = [ln for ln in stores.get(name, ())
+                   if ln >= call_stmt_line]
+        horizon = min(rebinds) if rebinds else float("inf")
+        for ln, nm in loads:
+            if nm == name and call_stmt_line < ln < horizon:
+                out.append(Finding(
+                    "R2", f.rel, ln,
+                    f"'{name}' is read after being donated "
+                    f"({how} at line {call_stmt_line}) — donated "
+                    "buffers are deleted on donating backends; thread "
+                    "the result instead"))
+                break  # one finding per donated name is enough
+
+
+@rule("R2", "donation-safety")
+def check_donation(project: Project) -> Iterable[Finding]:
+    """Arguments donated to a jitted call (donate_argnums at the
+    jax.jit site, or the ``donate=True`` entry-point convention) must
+    not be read after the call site."""
+    out: List[Finding] = []
+    for f in project.lib():
+        if f.tree is None:
+            continue
+        all_fns = astutil.collect_functions(f.tree)
+        by_name = {}
+        for fn in all_fns:
+            by_name.setdefault(fn.name, fn)
+
+        def resolve_fn(arg):
+            return by_name.get(arg.id) if isinstance(arg, ast.Name) \
+                else None
+
+        # donating callables visible from any scope: module-level
+        # `g = jax.jit(f, donate_*)` bindings and decorator-form
+        # `@partial(jax.jit, donate_*)` defs
+        module_donating: dict = {}
+        for stmt in astutil.walk_in_order(f.tree.body):
+            if (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)):
+                nums = _donated_argnums(stmt.value, resolve_fn)
+                if nums:
+                    module_donating[stmt.targets[0].id] = nums
+        for fn in all_fns:
+            nums = _decorator_donated_argnums(fn)
+            if nums:
+                module_donating[fn.name] = nums
+        scopes = [f.tree] + all_fns
+        for scope in scopes:
+            body = getattr(scope, "body", [])
+            if not isinstance(body, list):
+                continue
+            donating: dict = dict(module_donating)
+            # pass 1: names bound to donating jax.jit(...) in this scope
+            for stmt in astutil.walk_in_order(body):
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and isinstance(stmt.value, ast.Call)):
+                    nums = _donated_argnums(stmt.value, resolve_fn)
+                    if nums:
+                        donating[stmt.targets[0].id] = nums
+            # pass 2: call sites
+            for stmt in astutil.walk_in_order(body):
+                for call in ast.walk(stmt):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    nm = astutil.call_name(call) or ""
+                    donated: Set[str] = set()
+                    how = ""
+                    if isinstance(call.func, ast.Name) \
+                            and call.func.id in donating:
+                        for i in donating[call.func.id]:
+                            if i < len(call.args) and isinstance(
+                                    call.args[i], ast.Name):
+                                donated.add(call.args[i].id)
+                        how = f"donate_argnums of '{call.func.id}'"
+                    elif any(kw.arg == "donate"
+                             and isinstance(kw.value, ast.Constant)
+                             and kw.value.value is True
+                             for kw in call.keywords):
+                        # entry-point convention: fn(res, index, ...,
+                        # donate=True) donates the INDEX-owned buffers
+                        # (second positional or index= keyword) — later
+                        # args (new rows, ids) stay caller-owned
+                        donated = {a.id for a in call.args[1:2]
+                                   if isinstance(a, ast.Name)}
+                        donated |= {kw.value.id for kw in call.keywords
+                                    if kw.arg == "index"
+                                    and isinstance(kw.value, ast.Name)}
+                        how = f"donate=True call to '{nm}'"
+                    if donated:
+                        _scan_reads_after(f, scope, call.lineno,
+                                          donated, out, how)
+    return out

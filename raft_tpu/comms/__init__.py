@@ -25,6 +25,7 @@ from raft_tpu.comms.comms import (
     device_send,
     device_sendrecv,
     gather,
+    mark_varying,
     reduce,
     reducescatter,
 )
@@ -48,6 +49,7 @@ __all__ = [
     "device_send",
     "device_recv",
     "device_sendrecv",
+    "mark_varying",
     "initialize",
     "local_comms",
     "make_mesh",

@@ -191,6 +191,23 @@ def device_sendrecv(x, perm: Sequence[tuple], axis: str = "data"):
     return jax.lax.ppermute(x, axis, list(perm))
 
 
+def mark_varying(x, axis: str = "data"):
+    """Mark a value device-varying for shard_map's validity check —
+    the version shim for the pvary → pcast migration: jax 0.7+ spells
+    it ``pcast(..., to="varying")``, 0.6 has ``pvary``, and 0.4.x/0.5.x
+    have neither and need no marking (their shard_map runs these
+    programs with ``check_rep=False``). Like :func:`shard_map` and
+    :func:`axis_size`, this is THE spelling mesh programs use — a raw
+    feature probe at a call site would re-fork on every jax bump."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axis, to="varying")
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, axis)
+    return x
+
+
 def barrier(axis: str = "data"):
     """``comms_t::barrier`` / ``sync_stream``: a psum fence all ranks
     must reach; returns the rank count."""

@@ -56,6 +56,7 @@ from raft_tpu.comms.comms import (
     Comms,
     allgather,
     allgather_wire,
+    rank as comm_rank,
     resolve_wire_dtype,
     shard_map,
 )
@@ -156,6 +157,7 @@ def place_dealt(a, perm: np.ndarray, comms: Comms):
                             blk_bytes)
         tracing.inc_counter("distributed.build.deal_bytes_total",
                             blk_bytes * len(devs))
+        # graftlint: disable=R5(streaming deal: per-block puts bound build staging to O(block))
         puts = [jax.device_put(blk, d) for d in devs]
         # block before gathering the next block so at most one block's
         # worth of staging lives on the build device at a time
@@ -197,7 +199,7 @@ def select_probes_sharded(coarse, n_probes: int, axis: str,
     """
     q, n_local = coarse.shape
     if probe_mode == "global":
-        rank = jax.lax.axis_index(axis)
+        rank = comm_rank(axis)
         local_k = min(n_probes, n_local)
         if 2 * local_k < n_local:
             # lean candidate exchange: (distance, global id) pairs only

@@ -26,7 +26,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu.comms.comms import Comms, allgather, rank, shard_map
+from raft_tpu.comms.comms import (
+    Comms,
+    allgather,
+    device_sendrecv,
+    mark_varying,
+    rank,
+    shard_map,
+)
 from raft_tpu.core import tracing
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.pairwise import _pairwise_distance_impl
@@ -148,8 +155,8 @@ def brute_force_knn_ring(
                 best_d, best_i = merge_topk(
                     best_d, best_i, d_loc,
                     (i_loc + my_base).astype(jnp.int32), k, select_min)
-                state = jax.lax.ppermute((blk, best_d, best_i), axis,
-                                         perm)
+                state = device_sendrecv((blk, best_d, best_i), perm,
+                                        axis)
             _, best_d, best_i = state
             return best_d, best_i
 
@@ -195,15 +202,9 @@ def _local_scan(queries, dataset, k: int, metric, metric_arg, tile: int,
     init = (jnp.full((q, k), pad_val, jnp.float32),
             jnp.full((q, k), -1, jnp.int32))
     if axis is not None:
-        # mark the carry device-varying (pvary was deprecated for pcast;
-        # jax 0.4.x/0.5.x have neither and need no marking — their
-        # shard_map runs these programs with check_rep=False)
-        pcast = getattr(jax.lax, "pcast", None)
-        pvary = getattr(jax.lax, "pvary", None)
-        if pcast is not None:
-            init = pcast(init, axis, to="varying")
-        elif pvary is not None:
-            init = pvary(init, axis)
+        # mark the carry device-varying for shard_map's vma check (the
+        # pvary/pcast version shim lives in the comms veneer)
+        init = mark_varying(init, axis)
     (best_d, best_i), _ = jax.lax.scan(
         step, init, (jnp.arange(tiles.shape[0]), tiles))
     return best_d, best_i

@@ -60,9 +60,33 @@ from raft_tpu.neighbors._packing import (
 )
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
-from raft_tpu.neighbors.ivf_pq import make_rotation_matrix
 
 _SERIALIZATION_VERSION = 2  # v2: multi-level (bits > 1) residual codes
+
+# entangled into the pinned rotation stream; bumping it redraws every
+# rotation (and re-derives the estimator-quality expectations)
+_ROTATION_STREAM = 0
+
+
+def _pinned_rotation(seed: int, dim_ext: int, dim: int) -> jax.Array:
+    """Random orthogonal rotation dim → dim_ext from a **pinned**
+    generator: numpy's PCG64 stream is stable across numpy versions,
+    where ``jax.random`` draws shift across jax releases (threefry
+    partitionable default, key layout). The estimator-quality contracts
+    in ``tests/test_ivf_bq.py`` are calibrated against this exact
+    stream — a jax upgrade must not silently redraw the rotation every
+    saved BQ index and recall bound was derived under (the ROADMAP's
+    "BQ estimator quality on jax 0.4.x" item)."""
+    rng = np.random.default_rng(
+        np.random.SeedSequence([seed, _ROTATION_STREAM]))
+    g = rng.standard_normal((max(dim_ext, dim), dim_ext))
+    q, r = np.linalg.qr(g)          # orthonormal columns
+    # LAPACK backends disagree on QR column signs — normalize so the
+    # rotation (not just the stream) is backend-invariant
+    d = np.sign(np.diag(r))
+    d[d == 0] = 1.0
+    q = q * d
+    return jnp.asarray(q[:dim, :].T, jnp.float32)  # (dim_ext, dim)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -221,10 +245,9 @@ def build(
         )
         centers = kmeans_balanced.fit(res, km, trainset, params.n_lists)
         # the random rotation is what makes sign codes informative —
-        # always random, never identity
-        rotation = make_rotation_matrix(
-            jax.random.fold_in(jax.random.key(res.seed), 13),
-            dim_ext, dim, True)
+        # always random, never identity; pinned so recall contracts
+        # survive jax upgrades
+        rotation = _pinned_rotation(res.seed, dim_ext, dim)
 
         empty = IvfBqIndex(
             centers=centers, rotation=rotation,

@@ -319,8 +319,6 @@ def _select_kernel(v_ref, outd_ref, outi_ref, bestd, besti,
         outi_ref[:] = besti[:]
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("k", "select_min", "tile", "interpret"))
 def select_k_tiles(
     values,
     k: int,
@@ -331,7 +329,28 @@ def select_k_tiles(
 ) -> Tuple[jax.Array, jax.Array]:
     """Batched k-selection over a wide (batch, n) matrix as a streamed
     Pallas merge — the radix/warpsort-select analog. Exact, first-
-    occurrence tie-break like the reference's stable warpsort."""
+    occurrence tie-break like the reference's stable warpsort.
+
+    The VMEM budget is resolved OUTSIDE the jitted impl (like
+    ``fused_knn``) so ``RAFT_TPU_VMEM_MB`` is honored per call instead
+    of being frozen into the first trace."""
+    return _select_k_tiles_impl(values, k, select_min, tile=tile,
+                                interpret=interpret,
+                                vmem_mb=_default_vmem_mb())
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "select_min", "tile",
+                                    "interpret", "vmem_mb"))
+def _select_k_tiles_impl(
+    values,
+    k: int,
+    select_min: bool = True,
+    *,
+    tile: int = 4096,
+    interpret: bool = False,
+    vmem_mb: int = 64,
+) -> Tuple[jax.Array, jax.Array]:
     b, n = values.shape
     expect(0 < k <= n, "select_k_tiles: bad k")
     tile = min(tile, max(128, ((n + 127) // 128) * 128))
@@ -364,6 +383,8 @@ def select_k_tiles(
             pltpu.VMEM((bp, k), jnp.float32),
             pltpu.VMEM((bp, k), jnp.int32),
         ],
+        compiler_params=_COMPILER_PARAMS(
+            vmem_limit_bytes=vmem_mb << 20),
         interpret=interpret,
     )(vs)
     return outd[:b], outi[:b]

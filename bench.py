@@ -40,7 +40,10 @@ RAFT_TPU_DISABLE_FUSED=1 (force the XLA tile-scan path). Opt-in
 riders: BENCH_IVF_SWEEP=1 (probe-scan engine A/B with roofline
 annotations), BENCH_MULTICHIP=1 (mesh-native serving: per-chip QPS,
 compile counts and modeled lean collective bytes for the list-sharded
-index across every visible chip).
+index across every visible chip), BENCH_SERVING=1 (request frontend:
+bursty open-loop load through the DynamicBatcher — p50/p95/p99
+latency, shed rate and batch occupancy next to the one-request-per-
+call baseline QPS).
 """
 
 import json
@@ -603,6 +606,17 @@ def child_main():
         except Exception as e:  # noqa: BLE001 — keep headline record
             log(f"multichip rider failed ({e}); keeping headline record")
 
+    # opt-in rider: the request frontend — bursty open-loop load
+    # through the DynamicBatcher vs one-request-per-call dispatch
+    if os.environ.get("BENCH_SERVING") == "1" and last_rec:
+        try:
+            sv = _serving_rider()
+            rec = dict(last_rec)
+            rec["serving"] = sv
+            print(json.dumps(rec), flush=True)
+        except Exception as e:  # noqa: BLE001 — keep headline record
+            log(f"serving rider failed ({e}); keeping headline record")
+
 
 def _ivf_engine_sweep():
     """BENCH_IVF_SWEEP=1 rider: A/B the IVF-Flat probe-scan engines
@@ -765,6 +779,109 @@ def _multichip_rider():
             "batch": BATCH, "n_chips": n_dev,
             "build_peak_deal_block_bytes": int(build_peak),
             "cases": cases}
+
+
+def _serving_rider():
+    """BENCH_SERVING=1 rider: the request frontend under bursty
+    open-loop load. A DynamicBatcher in front of a warmed
+    ``SearchExecutor`` takes bursts of small (1-4 row) requests on a
+    fixed schedule (open loop — submission does not wait for
+    completions) and the rider emits p50/p95/p99 end-to-end latency,
+    the shed/reject rates, and the measured batch occupancy
+    (requests per executor call — the coalescing win) next to the
+    one-request-per-call baseline's QPS over the same request stream.
+    Env knobs: BENCH_SV_N / BENCH_SV_LISTS / BENCH_SV_BURSTS /
+    BENCH_SV_BURST (requests per burst) / BENCH_SV_PERIOD_MS /
+    BENCH_SV_WAIT_MS (batcher max-wait) / BENCH_SV_TIMEOUT_MS
+    (per-request deadline)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu import SearchExecutor
+    from raft_tpu.core import tracing
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.serving import BatcherConfig, DynamicBatcher
+    from raft_tpu.serving import metrics as sv_metrics
+    from raft_tpu.serving.harness import burst_schedule, drive_open_loop
+
+    n = int(os.environ.get("BENCH_SV_N", 200_000))
+    n_lists = int(os.environ.get("BENCH_SV_LISTS", 256))
+    n_bursts = int(os.environ.get("BENCH_SV_BURSTS", 50))
+    burst = int(os.environ.get("BENCH_SV_BURST", 16))
+    period_s = float(os.environ.get("BENCH_SV_PERIOD_MS", 10)) / 1e3
+    max_wait_s = float(os.environ.get("BENCH_SV_WAIT_MS", 2)) / 1e3
+    timeout_s = float(os.environ.get("BENCH_SV_TIMEOUT_MS", 250)) / 1e3
+
+    kd, kq = jax.random.split(jax.random.key(5))
+    x = np.asarray(jax.random.normal(kd, (n, D), jnp.float32))
+    rng = np.random.default_rng(9)
+    log(f"serving rider: building index ({n}x{D}, {n_lists} lists)")
+    index = ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(
+        n_lists=n_lists, kmeans_n_iters=10), x)
+    p = ivf_flat.IvfFlatSearchParams(n_probes=20)
+    ex = SearchExecutor()
+    ex.warmup(index, k=K, params=p)
+    tracing.install_xla_compile_listener()
+
+    # pre-draw the request stream: bursts of 1-4 row blocks
+    blocks = [rng.standard_normal(
+        (int(rng.integers(1, 5)), D)).astype(np.float32)
+        for _ in range(n_bursts * burst)]
+
+    # baseline: the same stream, one executor call per request
+    t0 = time.perf_counter()
+    for b in blocks:
+        jax.block_until_ready(ex.search(index, b, K, params=p))
+    base_dt = time.perf_counter() - t0
+    base_qps = len(blocks) / base_dt
+    log(f"serving rider baseline: {base_qps:.1f} req/s "
+        f"(one call per request)")
+
+    sv_metrics.reset()
+    b = DynamicBatcher(ex, BatcherConfig(max_wait_s=max_wait_s,
+                                         full_batch_rows=256))
+    clock = b._clock
+    backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+
+    def submit(ordinal, _t):
+        return b.submit(index, blocks[ordinal], K, params=p,
+                        timeout_s=timeout_s)
+
+    t0 = time.perf_counter()
+    handles = drive_open_loop(
+        submit, burst_schedule(n_bursts, burst, period_s,
+                               start_s=clock.now()), clock)
+    done = sum(1 for h in handles if h.exception(timeout=30.0) is None)
+    dt = time.perf_counter() - t0
+    b.close()
+
+    snap = sv_metrics.snapshot()
+    occ = snap["occupancy"]
+    e2e = snap["histograms"].get(sv_metrics.E2E, {})
+    shed = snap["counters"].get("serving.batcher.shed_deadline", 0)
+    rej = snap["counters"].get("serving.admission.rejected", 0)
+    out = {
+        "n": n, "dim": D, "n_lists": n_lists, "k": K,
+        "bursts": n_bursts, "burst_size": burst,
+        "period_ms": period_s * 1e3, "max_wait_ms": max_wait_s * 1e3,
+        "requests": len(handles), "completed": done,
+        "qps": round(done / dt, 2),
+        "baseline_one_per_call_qps": round(base_qps, 2),
+        "p50_ms": round(e2e.get("p50", 0) * 1e3, 3),
+        "p95_ms": round(e2e.get("p95", 0) * 1e3, 3),
+        "p99_ms": round(e2e.get("p99", 0) * 1e3, 3),
+        "shed_rate": round(shed / max(len(handles), 1), 4),
+        "reject_rate": round(rej / max(len(handles), 1), 4),
+        "requests_per_batch": round(occ["requests_per_batch"], 2),
+        "rows_per_batch": round(occ["rows_per_batch"], 2),
+        "backend_compiles_during_load": (
+            tracing.get_counter(tracing.XLA_COMPILE_COUNT) - backend0),
+    }
+    log(f"serving rider: {out['qps']} req/s through the batcher "
+        f"(occupancy {out['requests_per_batch']} req/call, "
+        f"p99 {out['p99_ms']} ms, shed {out['shed_rate']})")
+    return out
 
 
 def _list_cpu_hogs():

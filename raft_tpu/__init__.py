@@ -19,6 +19,8 @@ Layering (mirrors the reference's layer map, SURVEY.md §1):
 - ``raft_tpu.cluster``    — kmeans, balanced kmeans, linkage, spectral (L3)
 - ``raft_tpu.neighbors``  — brute force / IVF-Flat / IVF-PQ / CAGRA (L4)
 - ``raft_tpu.comms``      — collectives over ICI/DCN device meshes (L5)
+- ``raft_tpu.serving``    — request frontend: dynamic batching, admission
+  control, deadline scheduling, load-shedding (L7)
 - ``raft_tpu.ops``        — Pallas TPU kernels backing the hot paths
 - ``raft_tpu.bench``      — ANN benchmark harness (L8)
 

@@ -8,8 +8,11 @@ transfer per iteration where one batched call would do.
 
 Scope — the hot modules named by the serving stack:
 ``core/executor.py``, ``raft_tpu/ops/*``, ``raft_tpu/distributed/*``
-(except ``checkpoint.py``, which is the host-IO module by design) and
-``raft_tpu/neighbors/*``. Within them:
+(except ``checkpoint.py``, which is the host-IO module by design),
+``raft_tpu/neighbors/*``, and the request frontend
+``raft_tpu/serving/*`` (PR 5 — the batcher sits on the per-request
+hot path: one stray ``.item()`` or per-iteration ``device_put`` in a
+dispatch loop taxes every request in the process). Within them:
 
 - ``.item()`` anywhere (it is never right on the hot path);
 - ``np.asarray`` / ``np.array`` / ``jax.device_get``, and
@@ -30,7 +33,7 @@ from raft_tpu.analysis import astutil
 from raft_tpu.analysis.core import Finding, Project, rule
 
 HOT_PREFIXES = ("raft_tpu/ops/", "raft_tpu/distributed/",
-                "raft_tpu/neighbors/")
+                "raft_tpu/neighbors/", "raft_tpu/serving/")
 HOT_FILES = ("raft_tpu/core/executor.py",)
 EXEMPT = ("raft_tpu/distributed/checkpoint.py",)
 

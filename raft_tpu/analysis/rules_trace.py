@@ -15,7 +15,12 @@ R1 has two teeth:
   hashable statics — a bare list/set/dict display (not folded through
   ``tuple()``/``frozenset()``), or a ``float()``/``int()``/``.item()``
   of runtime data, makes the key unhashable or data-dependent and
-  turns every search into a cache miss + recompile.
+  turns every search into a cache miss + recompile. The serving
+  frontend's coalescing keys (``coalesce_key = (...)`` /
+  ``compat_key = (...)`` and the ``compat_key=`` field of
+  ``SearchRequest``) carry the same contract — an unhashable key there
+  breaks request grouping, a data-dependent one silently splits every
+  micro-batch.
 
 R2 follows donated buffers: an argument donated to a jitted call
 (``donate_argnums``/``donate_argnames`` at the ``jax.jit`` site, or
@@ -106,18 +111,22 @@ def check_recompile(project: Project) -> Iterable[Finding]:
                             f"'{getattr(fn, 'name', '<lambda>')}' — "
                             "use lax.scan/fori_loop"))
 
-        # cache-key discipline: `_Plan(key=...)` and `key = (...)`
+        # cache-key discipline: `_Plan(key=...)` + the serving layer's
+        # `SearchRequest(compat_key=...)`, and the named key tuples
+        # that feed either
         for node in ast.walk(f.tree):
             if isinstance(node, ast.Call):
                 nm = astutil.call_name(node) or ""
-                if nm.split(".")[-1] == "_Plan":
+                if nm.split(".")[-1] in ("_Plan", "SearchRequest"):
                     for kw in node.keywords:
-                        if kw.arg == "key":
+                        if kw.arg in ("key", "compat_key"):
                             _check_key_expr(f, kw.value, out)
             elif isinstance(node, ast.Assign):
                 if (len(node.targets) == 1
                         and isinstance(node.targets[0], ast.Name)
-                        and node.targets[0].id in ("key", "cache_key")
+                        and node.targets[0].id in (
+                            "key", "cache_key", "coalesce_key",
+                            "compat_key")
                         and isinstance(node.value, ast.Tuple)):
                     _check_key_expr(f, node.value, out)
     return out

@@ -17,6 +17,8 @@ from raft_tpu.comms.comms import (
     Comms,
     Op,
     allgather,
+    allgather_quantized,
+    allgather_wire,
     allreduce,
     alltoall,
     barrier,
@@ -28,6 +30,8 @@ from raft_tpu.comms.comms import (
     mark_varying,
     reduce,
     reducescatter,
+    resolve_probe_wire_dtype,
+    resolve_wire_dtype,
 )
 from raft_tpu.comms.bootstrap import (
     initialize,
@@ -40,6 +44,10 @@ __all__ = [
     "Op",
     "allreduce",
     "allgather",
+    "allgather_quantized",
+    "allgather_wire",
+    "resolve_probe_wire_dtype",
+    "resolve_wire_dtype",
     "alltoall",
     "barrier",
     "bcast",

@@ -135,6 +135,46 @@ def allgather_wire(x, axis: str = "data", wire_dtype: str = "f32"):
     return jax.lax.all_gather(x.astype(wd), axis).astype(x.dtype)
 
 
+# wire formats for the coarse/probe-candidate exchange: the payload is
+# *candidate scores* (compared, never accumulated), so it tolerates a
+# harder squeeze than the result merge — int8 with a per-row scale
+# (the EQuARX block-scaling recipe) quarters the bytes of f32
+PROBE_WIRE_DTYPES = ("f32", "bf16", "int8")
+
+
+def resolve_probe_wire_dtype(wire_dtype: str) -> str:
+    """Validate a probe-exchange ``wire_dtype`` (identity mapping —
+    ``int8`` has no jnp carrier; :func:`allgather_quantized` packs it
+    with an explicit per-row scale plane)."""
+    if wire_dtype not in PROBE_WIRE_DTYPES:
+        raise ValueError(
+            f"probe wire_dtype must be one of {PROBE_WIRE_DTYPES}, "
+            f"got {wire_dtype!r}")
+    return wire_dtype
+
+
+def allgather_quantized(x, axis: str = "data", wire_dtype: str = "f32"):
+    """:func:`allgather` of a (rows, n) score block with an opt-in
+    quantized wire format, dequantized after the collective:
+
+    - ``"f32"`` / ``"bf16"``: :func:`allgather_wire` (cast-only).
+    - ``"int8"``: symmetric per-row quantization — each row travels as
+      int8 codes plus one f32 scale (``max|row| / 127``), so the
+      payload is ~1/4 of f32 for n >> 1. Rounding is
+      round-half-to-even (jnp.round), deterministic across shards.
+
+    Quantization error creates ties the caller must break
+    deterministically (the probe selects sort by (distance, id))."""
+    if wire_dtype != "int8":
+        return allgather_wire(x, axis, wire_dtype)
+    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)
+    q8 = jnp.clip(jnp.round(x * (127.0 / scale)), -127, 127)
+    all_q = jax.lax.all_gather(q8.astype(jnp.int8), axis)
+    all_s = jax.lax.all_gather(scale, axis)
+    return all_q.astype(jnp.float32) * (all_s * (1.0 / 127.0))
+
+
 def gather(x, root: int = 0, axis: str = "data", tiled: bool = False):
     """``comms_t::gather`` (valid on every rank, superset of reference;
     per-link cost on ICI matches a rooted gather — see

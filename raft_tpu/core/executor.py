@@ -257,6 +257,63 @@ class SearchExecutor:
             outs_i.append(i)
         return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
 
+    def coalesce_key(self, index, k: int, params=None, sample_filter=None,
+                     **kw) -> tuple:
+        """Hashable compatibility key for request coalescing: two
+        submissions may share one bucketed call iff their keys are
+        equal. This is the executor's plan key with the bucket stripped
+        (any bucket serves any compatible batch) and the index's
+        identity mixed in (two indexes with equal shapes must never
+        coalesce). The serving batcher groups its queues by this."""
+        fw = self._resolve_filter(sample_filter)
+        plan = self._plan(index, params, k, self.buckets[0], fw, kw)
+        # plan.key is (family, bucket, *specialization) in every family
+        return (id(index), plan.key[0]) + tuple(plan.key[2:])
+
+    def search_blocks(self, index, blocks, k: int, params=None,
+                      sample_filter=None, **kw):
+        """Batch-handle entry point for the serving frontend: run the
+        per-request query blocks of ONE coalesced micro-batch as a
+        single bucketed call and split the results back per block.
+
+        ``blocks`` is a sequence of (m_j, dim) query arrays that agreed
+        on :meth:`coalesce_key`; a 2-D ``sample_filter`` must be the
+        row-wise concatenation matching the blocks. Returns a list of
+        per-block ``(distances, indices)`` pairs, each bit-identical to
+        a direct :meth:`search` of that block alone (bucketing pads
+        with inert rows, so coalescing cannot perturb results).
+
+        CAGRA plans are the one family whose results depend on a row's
+        absolute position in the batch (seeds draw per absolute row, so
+        *tiles of one batch* are invariant but *concatenated requests*
+        would shift each other's rows) — those dispatch one call per
+        block, preserving the per-block bit-identity contract at the
+        cost of coalescing."""
+        expect(len(blocks) > 0, "search_blocks needs at least one block")
+        sizes = [int(np.shape(b)[0]) for b in blocks]
+        fw = self._resolve_filter(sample_filter)
+        plan = self._plan(index, params, k, self.buckets[0], fw, kw)
+        if plan.pass_row0:
+            out, start = [], 0
+            for b, m in zip(blocks, sizes):
+                fwb = fw[start:start + m] if (
+                    fw is not None and fw.ndim == 2) else fw
+                out.append(self.search(index, b, k, params, fwb, **kw))
+                start += m
+            return out
+        if len(blocks) == 1:
+            cat = blocks[0]
+        elif all(isinstance(b, np.ndarray) for b in blocks):
+            cat = np.concatenate(blocks)
+        else:
+            cat = jnp.concatenate([jnp.asarray(b) for b in blocks])
+        d, i = self.search(index, cat, k, params, fw, **kw)
+        out, start = [], 0
+        for m in sizes:
+            out.append((d[start:start + m], i[start:start + m]))
+            start += m
+        return out
+
     # -- internals ----------------------------------------------------------
 
     def _resolve_filter(self, sample_filter):
@@ -408,22 +465,28 @@ class SearchExecutor:
         raise TypeError(f"SearchExecutor does not support {type(index)!r}")
 
     def _dist_statics(self, index, kw) -> tuple:
-        """Shared mesh-plan pieces: (comms, probe_mode, wire_dtype) —
-        validated; the mesh-aware executor serves the 1-D list-sharded
-        layout with replicated queries (``query_axis`` grids go through
-        the direct search entry points)."""
-        from raft_tpu.comms.comms import resolve_wire_dtype
+        """Shared mesh-plan pieces: (comms, probe_mode, wire_dtype,
+        probe_wire_dtype) — validated; the mesh-aware executor serves
+        the 1-D list-sharded layout with replicated queries
+        (``query_axis`` grids go through the direct search entry
+        points)."""
+        from raft_tpu.comms.comms import (
+            resolve_probe_wire_dtype,
+            resolve_wire_dtype,
+        )
 
         comms = index.comms
         probe_mode = kw.get("probe_mode", "global")
         wire_dtype = kw.get("wire_dtype", "f32")
+        probe_wire_dtype = kw.get("probe_wire_dtype", "f32")
         expect(probe_mode in ("global", "local"),
                f"probe_mode must be 'global' or 'local', got {probe_mode!r}")
         resolve_wire_dtype(wire_dtype)
+        resolve_probe_wire_dtype(probe_wire_dtype)
         expect(kw.get("query_axis") is None,
                "SearchExecutor serves replicated queries; use the direct "
                "distributed search entry points for query_axis grids")
-        return comms, probe_mode, wire_dtype
+        return comms, probe_mode, wire_dtype, probe_wire_dtype
 
     def _plan_dist_ivf_flat(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.distributed import ivf as dist_ivf
@@ -433,7 +496,8 @@ class SearchExecutor:
         expect(fw is None,
                "distributed searches have no sample_filter support")
         params = params or m.IvfFlatSearchParams()
-        comms, probe_mode, wire_dtype = self._dist_statics(index, kw)
+        (comms, probe_mode, wire_dtype,
+         probe_wire_dtype) = self._dist_statics(index, kw)
         n_probes = dist_ivf.resolve_probe_budget(
             params.n_probes, index.n_lists, comms.size, probe_mode)
         engine = resolve_scan_engine(params.scan_engine, data=index.data,
@@ -442,7 +506,8 @@ class SearchExecutor:
                   "n_probes": n_probes, "k": k, "metric": index.metric,
                   "probe_mode": probe_mode,
                   "coarse_algo": params.coarse_algo,
-                  "scan_engine": engine, "wire_dtype": wire_dtype}
+                  "scan_engine": engine, "wire_dtype": wire_dtype,
+                  "probe_wire_dtype": probe_wire_dtype}
         arrays = (index.centers, index.data, index.data_norms,
                   index.indices)
         key = ("dist_ivf_flat", bucket, _mesh_key(comms), _sig(*arrays),
@@ -464,7 +529,8 @@ class SearchExecutor:
         expect(fw is None,
                "distributed searches have no sample_filter support")
         params = params or m.IvfPqSearchParams()
-        comms, probe_mode, wire_dtype = self._dist_statics(index, kw)
+        (comms, probe_mode, wire_dtype,
+         probe_wire_dtype) = self._dist_statics(index, kw)
         n_probes = dist_ivf.resolve_probe_budget(
             params.n_probes, index.n_lists, comms.size, probe_mode)
         engine = m.resolve_scan_engine(params.scan_engine)
@@ -476,7 +542,8 @@ class SearchExecutor:
                   "codebook_kind": index.codebook_kind,
                   "score_mode": score_mode, "lut_dtype": params.lut_dtype,
                   "coarse_algo": params.coarse_algo,
-                  "scan_engine": engine, "wire_dtype": wire_dtype}
+                  "scan_engine": engine, "wire_dtype": wire_dtype,
+                  "probe_wire_dtype": probe_wire_dtype}
         arrays = (index.centers, index.rotation, index.codebooks,
                   index.codes, index.indices)
         key = ("dist_ivf_pq", bucket, _mesh_key(comms), _sig(*arrays),
@@ -495,14 +562,16 @@ class SearchExecutor:
         expect(fw is None,
                "distributed searches have no sample_filter support")
         params = params or m.IvfBqSearchParams()
-        comms, probe_mode, wire_dtype = self._dist_statics(index, kw)
+        (comms, probe_mode, wire_dtype,
+         probe_wire_dtype) = self._dist_statics(index, kw)
         n_probes = dist_ivf.resolve_probe_budget(
             params.n_probes, index.n_lists, comms.size, probe_mode)
         static = {"axis": comms.axis, "mesh": comms.mesh,
                   "n_probes": n_probes, "k": k, "metric": index.metric,
                   "probe_mode": probe_mode,
                   "coarse_algo": params.coarse_algo,
-                  "wire_dtype": wire_dtype}
+                  "wire_dtype": wire_dtype,
+                  "probe_wire_dtype": probe_wire_dtype}
         arrays = (index.centers, index.rotation, index.codes, index.scales,
                   index.rnorm2, index.indices)
         key = ("dist_ivf_bq", bucket, _mesh_key(comms), _sig(*arrays),

@@ -10,7 +10,9 @@ both so one decorator/context manager covers traced and untraced code.
 The counter registry is the export surface for the serving path
 (``core/executor.py``): compile counts, cache hits/evictions and warmup
 time land here so a frontend (or the bench harness) can scrape one
-place. ``install_xla_compile_listener`` additionally taps jax's
+place, and the serving frontend (``raft_tpu/serving/``) adds per-stage
+latency histograms (:func:`observe` / :func:`histograms`) next to
+them. ``install_xla_compile_listener`` additionally taps jax's
 monitoring events so *every* backend compile in the process — not just
 the executor's — is visible; that is what the tier-1 recompile
 regression test asserts on.
@@ -18,6 +20,7 @@ regression test asserts on.
 
 from __future__ import annotations
 
+import builtins
 import contextlib
 import functools
 import threading
@@ -107,6 +110,105 @@ def reset_counters(prefix: str = "") -> None:
     with _counters_lock:
         for k in [k for k in _counters if k.startswith(prefix)]:
             del _counters[k]
+
+
+# ---------------------------------------------------------------------------
+# histograms — per-stage latency distributions for the serving frontend
+# ---------------------------------------------------------------------------
+
+# log2-spaced bucket upper bounds from 1 µs to ~67 s: wide enough for
+# queue waits and device executes alike, cheap enough (27 ints) that
+# observing on the per-request hot path is a dict lookup + increment
+# (builtins.range — this module's own `range` is the profiling scope)
+_HIST_BOUNDS = tuple(1e-6 * (2.0 ** i) for i in builtins.range(27))
+
+_histograms: dict = {}
+
+
+class Histogram:
+    """Fixed-bound latency histogram (bounds in seconds, log2-spaced).
+
+    ``observe`` is O(log n_buckets); ``quantile`` interpolates linearly
+    inside the selected bucket, which is the usual Prometheus-style
+    estimate — exact enough for p50/p95/p99 serving dashboards."""
+
+    __slots__ = ("bounds", "counts", "count", "sum")
+
+    def __init__(self, bounds=_HIST_BOUNDS):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)  # +overflow bucket
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        lo, hi = 0, len(self.bounds)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if value <= self.bounds[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        self.counts[lo] += 1
+        self.count += 1
+        self.sum += value
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (0 <= q <= 1); 0.0 when empty."""
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if seen + c >= target and c > 0:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = (self.bounds[i] if i < len(self.bounds)
+                      else self.bounds[-1] * 2.0)
+                frac = (target - seen) / c
+                return lo + (hi - lo) * frac
+            seen += c
+        return self.bounds[-1] * 2.0
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+        }
+
+
+def observe(name: str, value: float) -> None:
+    """Record ``value`` (seconds) into the named process-wide histogram
+    (created on first use)."""
+    with _counters_lock:
+        h = _histograms.get(name)
+        if h is None:
+            h = _histograms[name] = Histogram()
+        h.observe(value)
+
+
+def get_histogram(name: str) -> Histogram:
+    """The named histogram (an empty one if never observed)."""
+    with _counters_lock:
+        h = _histograms.get(name)
+        if h is None:
+            h = _histograms[name] = Histogram()
+        return h
+
+
+def histograms(prefix: str = "") -> dict:
+    """``{name: snapshot-dict}`` for histograms matching ``prefix``."""
+    with _counters_lock:
+        return {k: h.snapshot() for k, h in _histograms.items()
+                if k.startswith(prefix)}
+
+
+def reset_histograms(prefix: str = "") -> None:
+    """Drop histograms matching ``prefix`` — test isolation."""
+    with _counters_lock:
+        for k in [k for k in _histograms if k.startswith(prefix)]:
+            del _histograms[k]
 
 
 _compile_listener_installed = False

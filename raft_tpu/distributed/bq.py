@@ -23,7 +23,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from raft_tpu.comms.comms import Comms, resolve_wire_dtype, shard_map
+from raft_tpu.comms.comms import (
+    Comms,
+    resolve_probe_wire_dtype,
+    resolve_wire_dtype,
+    shard_map,
+)
 from raft_tpu.core import tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.validation import expect
@@ -117,7 +122,8 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, scales, rn2,
                        mesh, n_probes: int, k: int, metric: DistanceType,
                        probe_mode: str, query_axis=None,
                        coarse_algo: str = "exact",
-                       wire_dtype: str = "f32"):
+                       wire_dtype: str = "f32",
+                       probe_wire_dtype: str = "f32"):
     """Distributed sign-code probe scan: lean probe selection + local
     MXU scan + O(q · k) result merge (``wire_dtype`` compresses the
     gathered estimate distances; the positional ``knn_merge_parts``
@@ -151,7 +157,8 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, scales, rn2,
             qnorm = jnp.sum(jnp.square(qf), axis=1)
 
         local, mine = select_probes_sharded(coarse, n_probes, axis,
-                                            probe_mode, coarse_algo)
+                                            probe_mode, coarse_algo,
+                                            probe_wire_dtype)
 
         qrot = qf @ rotation.T
         centers_rot = None if ip_metric else centers_l @ rotation.T
@@ -190,7 +197,7 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, scales, rn2,
 
 _dist_search_bq = partial(jax.jit, static_argnames=(
     "axis", "mesh", "n_probes", "k", "metric", "probe_mode", "query_axis",
-    "coarse_algo", "wire_dtype"))(_dist_search_bq_fn)
+    "coarse_algo", "wire_dtype", "probe_wire_dtype"))(_dist_search_bq_fn)
 
 
 def search_bq(
@@ -203,6 +210,7 @@ def search_bq(
     query_axis: Optional[str] = None,
     query_tile: int = 4096,
     wire_dtype: str = "f32",
+    probe_wire_dtype: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """One-program distributed BQ search (estimated distances — refine
     host-side as with the single-chip index). Large query sets run in
@@ -211,7 +219,10 @@ def search_bq(
     second mesh axis to shard queries over (the 2-D list×query grid,
     matching :func:`raft_tpu.distributed.ivf.search_pq`);
     ``wire_dtype="bf16"`` compresses the merge collective's distances
-    (sign-code estimates are already coarse — the cheap payload win)."""
+    (sign-code estimates are already coarse — the cheap payload win);
+    ``probe_wire_dtype`` (``f32|bf16|int8``) compresses the
+    probe-candidate exchange (see
+    :func:`raft_tpu.distributed.ivf.select_probes_sharded`)."""
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -224,6 +235,7 @@ def search_bq(
            f"coarse_algo must be 'exact' or 'approx', got "
            f"{params.coarse_algo!r}")
     resolve_wire_dtype(wire_dtype)
+    resolve_probe_wire_dtype(probe_wire_dtype)
     queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_bq.search"):
         def run(qt, _fw):
@@ -234,6 +246,7 @@ def search_bq(
                 k=k, metric=index.metric, probe_mode=probe_mode,
                 query_axis=query_axis, coarse_algo=params.coarse_algo,
                 wire_dtype=wire_dtype,
+                probe_wire_dtype=probe_wire_dtype,
             )
 
         if query_axis is not None:

@@ -55,8 +55,10 @@ from jax.sharding import PartitionSpec as P
 from raft_tpu.comms.comms import (
     Comms,
     allgather,
+    allgather_quantized,
     allgather_wire,
     rank as comm_rank,
+    resolve_probe_wire_dtype,
     resolve_wire_dtype,
     shard_map,
 )
@@ -171,7 +173,8 @@ def place_dealt(a, perm: np.ndarray, comms: Comms):
 
 
 def select_probes_sharded(coarse, n_probes: int, axis: str,
-                          probe_mode: str, coarse_algo: str = "exact"):
+                          probe_mode: str, coarse_algo: str = "exact",
+                          probe_wire_dtype: str = "f32"):
     """Shared probe selection inside a shard_map body — THE
     probe-ownership arithmetic for every list-sharded index family.
 
@@ -196,6 +199,15 @@ def select_probes_sharded(coarse, n_probes: int, axis: str,
     native approximate top-k unit, via the same
     :func:`raft_tpu.neighbors._batching.coarse_select` dispatch the
     single-chip searches use (lean mode applies it to the local stage).
+
+    ``probe_wire_dtype`` compresses the exchanged coarse *distances*
+    on the wire (``f32|bf16|int8`` — int8 rides a per-query scale,
+    :func:`raft_tpu.comms.comms.allgather_quantized`); candidate ids
+    stay exact int32, and the final probe select sorts (distance, id)
+    so compression-induced ties resolve deterministically. This trades
+    probe-selection fidelity (hence a little recall) for 2-4x fewer
+    coarse-exchange bytes — recall-checked in
+    ``tests/test_distributed_serving.py``.
     """
     q, n_local = coarse.shape
     if probe_mode == "global":
@@ -206,7 +218,9 @@ def select_probes_sharded(coarse, n_probes: int, axis: str,
             loc = coarse_select(-coarse, local_k, coarse_algo)
             dloc = jnp.take_along_axis(coarse, loc, axis=1)
             gid = loc.astype(jnp.int32) + rank.astype(jnp.int32) * n_local
-            all_d = allgather(dloc, axis)                 # (R, q, local_k)
+            # (R, q, local_k); distances optionally ride a quantized
+            # wire format, ids always exact
+            all_d = allgather_quantized(dloc, axis, probe_wire_dtype)
             all_g = allgather(gid, axis)
             r = all_d.shape[0]
             cand_d = jnp.moveaxis(all_d, 0, 1).reshape(q, r * local_k)
@@ -215,7 +229,8 @@ def select_probes_sharded(coarse, n_probes: int, axis: str,
                                  num_keys=2)
             probes = sg[:, :n_probes]
         else:
-            coarse_all = allgather(coarse, axis)          # (R, q, L)
+            coarse_all = allgather_quantized(
+                coarse, axis, probe_wire_dtype)           # (R, q, L)
             r = coarse_all.shape[0]
             coarse_flat = jnp.moveaxis(coarse_all, 0, 1).reshape(
                 q, r * n_local)
@@ -263,27 +278,33 @@ def merge_results_sharded(best_d, best_i, axis: str, select_min: bool,
 
 def collective_payload_model(q: int, k: int, n_probes: int, n_lists: int,
                              r: int, wire_dtype: str = "f32",
-                             probe_mode: str = "global") -> dict:
+                             probe_mode: str = "global",
+                             probe_wire_dtype: str = "f32") -> dict:
     """Modeled per-shard query-path collective payloads (bytes) — the
     accounting the bench rider emits next to measured throughput, and
     the contract the lean-collective tests assert on.
 
     ``coarse_bytes``/``merge_bytes`` are what the current implementation
     moves per shard; ``dense_coarse_bytes`` is the pre-lean coarse-block
-    gather for comparison."""
+    gather for comparison. ``probe_wire_dtype`` prices the quantized
+    candidate exchange (int8 adds one f32 scale per (query, shard))."""
     n_local = max(n_lists // max(r, 1), 1)
     local_k = min(n_probes, n_local)
     wire_itemsize = 2 if wire_dtype == "bf16" else 4
-    dense = q * n_local * 4
-    lean = q * local_k * (4 + 4)            # f32 distance + int32 id
+    probe_itemsize = {"f32": 4, "bf16": 2, "int8": 1}[probe_wire_dtype]
+    scale = 4 if probe_wire_dtype == "int8" else 0  # per-row f32 scale
+    dense = q * (n_local * probe_itemsize + scale)
+    lean = q * (local_k * (probe_itemsize + 4) + scale)  # + int32 ids
     coarse = 0
     if probe_mode == "global":
         coarse = lean if 2 * local_k < n_local else dense
     return {
         "coarse_bytes": coarse,
-        "dense_coarse_bytes": dense if probe_mode == "global" else 0,
+        "dense_coarse_bytes": q * n_local * 4
+            if probe_mode == "global" else 0,
         "merge_bytes": q * k * (wire_itemsize + 4),
         "wire_dtype": wire_dtype,
+        "probe_wire_dtype": probe_wire_dtype,
     }
 
 
@@ -362,7 +383,8 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
                     n_probes: int, k: int, metric: DistanceType,
                     probe_mode: str, query_axis: Optional[str] = None,
                     coarse_algo: str = "exact", scan_engine: str = "rank",
-                    wire_dtype: str = "f32"):
+                    wire_dtype: str = "f32",
+                    probe_wire_dtype: str = "f32"):
     """One shard_map program: local coarse → (global|local) probe
     select → shard-local probe scan → lean O(q · k) result merge.
 
@@ -401,7 +423,8 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
             coarse = cn[None, :] - 2.0 * ip
 
         local, mine = select_probes_sharded(coarse, n_probes, axis,
-                                            probe_mode, coarse_algo)
+                                            probe_mode, coarse_algo,
+                                            probe_wire_dtype)
 
         if scan_engine != "rank":
             # list-major: not-owned probes mask to the sentinel id
@@ -469,7 +492,8 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
 
 _dist_search = partial(jax.jit, static_argnames=(
     "axis", "mesh", "n_probes", "k", "metric", "probe_mode", "query_axis",
-    "coarse_algo", "scan_engine", "wire_dtype"))(_dist_search_fn)
+    "coarse_algo", "scan_engine", "wire_dtype",
+    "probe_wire_dtype"))(_dist_search_fn)
 
 
 def search(
@@ -481,13 +505,18 @@ def search(
     probe_mode: str = "global",
     query_axis: Optional[str] = None,
     wire_dtype: str = "f32",
+    probe_wire_dtype: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """One-program distributed search; returns replicated (q, k) results
     with global row ids. See the module docstring for ``probe_mode``.
     ``query_axis`` names a second mesh axis to shard queries over (2-D
     list × query grid); results come back sharded over that axis.
     ``wire_dtype="bf16"`` halves the result-merge collective payload
-    (distances compressed on the wire; ids exact, smallest-id ties).
+    (distances compressed on the wire; ids exact, smallest-id ties);
+    ``probe_wire_dtype`` (``f32|bf16|int8``) additionally compresses
+    the probe-candidate exchange — int8 rides a per-query scale and
+    trades a little probe-selection fidelity for ~4x fewer coarse
+    bytes (see :func:`select_probes_sharded`).
     The probe scan engine follows ``params.scan_engine`` exactly like
     the single-chip entry (resolved per backend/shape by
     :func:`raft_tpu.ops.ivf_scan.resolve_scan_engine`)."""
@@ -503,6 +532,7 @@ def search(
            f"coarse_algo must be 'exact' or 'approx', got "
            f"{params.coarse_algo!r}")
     resolve_wire_dtype(wire_dtype)
+    resolve_probe_wire_dtype(probe_wire_dtype)
     from raft_tpu.ops.ivf_scan import resolve_scan_engine
 
     scan_engine = resolve_scan_engine(params.scan_engine, data=index.data,
@@ -515,7 +545,7 @@ def search(
             n_probes=n_probes, k=k, metric=index.metric,
             probe_mode=probe_mode, query_axis=query_axis,
             coarse_algo=params.coarse_algo, scan_engine=scan_engine,
-            wire_dtype=wire_dtype,
+            wire_dtype=wire_dtype, probe_wire_dtype=probe_wire_dtype,
         )
 
 
@@ -721,7 +751,8 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
                        score_mode: str = "gather", lut_dtype=jnp.float32,
                        coarse_algo: str = "exact",
                        scan_engine: str = "rank",
-                       wire_dtype: str = "f32"):
+                       wire_dtype: str = "f32",
+                       probe_wire_dtype: str = "f32"):
     """Distributed ADC probe scan — same engine plumbing as
     :func:`_dist_search_fn` (``scan_engine: xla`` is the list-major
     union scan of :mod:`raft_tpu.neighbors.ivf_pq`, run per shard with
@@ -756,7 +787,8 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
             coarse = cn[None, :] - 2.0 * ip
 
         local, mine = select_probes_sharded(coarse, n_probes, axis,
-                                            probe_mode, coarse_algo)
+                                            probe_mode, coarse_algo,
+                                            probe_wire_dtype)
 
         qsub_fixed = (qf @ rotation.T).reshape(q, pq_dim, pq_len)
         lut_fixed = (jnp.einsum("qsl,sjl->qsj", qsub_fixed, books_l)
@@ -847,7 +879,7 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
 _dist_search_pq = partial(jax.jit, static_argnames=(
     "axis", "mesh", "n_probes", "k", "metric", "probe_mode", "query_axis",
     "codebook_kind", "score_mode", "lut_dtype", "coarse_algo",
-    "scan_engine", "wire_dtype"))(_dist_search_pq_fn)
+    "scan_engine", "wire_dtype", "probe_wire_dtype"))(_dist_search_pq_fn)
 
 
 def search_pq(
@@ -859,10 +891,12 @@ def search_pq(
     probe_mode: str = "global",
     query_axis: Optional[str] = None,
     wire_dtype: str = "f32",
+    probe_wire_dtype: str = "f32",
 ) -> Tuple[jax.Array, jax.Array]:
     """One-program distributed PQ search (LUT scoring per shard, lean
     global merge); semantics of :func:`search` incl. the 2-D
-    ``query_axis`` and the ``wire_dtype`` result compression. The probe
+    ``query_axis``, the ``wire_dtype`` result compression, and the
+    ``probe_wire_dtype`` quantized probe-candidate exchange. The probe
     scan follows ``params.scan_engine`` (``auto|xla|rank``, resolved by
     :func:`raft_tpu.neighbors.ivf_pq.resolve_scan_engine`)."""
     ensure_resources(res)
@@ -877,6 +911,7 @@ def search_pq(
            f"coarse_algo must be 'exact' or 'approx', got "
            f"{params.coarse_algo!r}")
     resolve_wire_dtype(wire_dtype)
+    resolve_probe_wire_dtype(probe_wire_dtype)
     scan_engine = ivf_pq_mod.resolve_scan_engine(params.scan_engine)
     queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_pq.search"):
@@ -888,5 +923,5 @@ def search_pq(
             codebook_kind=index.codebook_kind,
             score_mode=params.score_mode, lut_dtype=params.lut_dtype,
             coarse_algo=params.coarse_algo, scan_engine=scan_engine,
-            wire_dtype=wire_dtype,
+            wire_dtype=wire_dtype, probe_wire_dtype=probe_wire_dtype,
         )

@@ -62,7 +62,9 @@ class BitmapFilter:
 
 def resolve_filter_words(sample_filter):
     """Normalize any accepted filter form to a words array (1-D shared,
-    2-D per-query) or None."""
+    2-D per-query) or None. Idempotent: an already-resolved words array
+    passes through unchanged (the serving batcher resolves once at
+    admission and re-submits the words)."""
     if sample_filter is None or isinstance(sample_filter, NoneSampleFilter):
         return None
     if isinstance(sample_filter, Bitset):
@@ -71,6 +73,12 @@ def resolve_filter_words(sample_filter):
         return sample_filter.bitset.words
     if isinstance(sample_filter, BitmapFilter):
         return sample_filter.words
+    if hasattr(sample_filter, "ndim") and hasattr(sample_filter, "dtype"):
+        if sample_filter.ndim not in (1, 2):
+            raise TypeError(
+                f"filter words must be 1-D or 2-D, got "
+                f"{sample_filter.ndim}-D")
+        return sample_filter
     raise TypeError(
         f"unsupported sample_filter type {type(sample_filter).__name__}; "
         "pass a Bitset, BitsetFilter, BitmapFilter, or NoneSampleFilter"

@@ -1,0 +1,54 @@
+"""Serving frontend: async dynamic batching in front of
+:class:`~raft_tpu.core.executor.SearchExecutor`.
+
+PRs 1–3 made the query hot path shape-stable and zero-recompile; this
+package is the request layer on top — the piece that turns many small
+caller queries into the executor's power-of-two buckets without
+letting tail latency or overload take the service down:
+
+- :mod:`~raft_tpu.serving.request` — :class:`SearchRequest` +
+  future-style :class:`ResultHandle` with cancellation and typed
+  failures (:class:`Overloaded`, :class:`DeadlineExceeded`,
+  :class:`Cancelled`, :class:`ShutDown`).
+- :mod:`~raft_tpu.serving.batcher` — :class:`DynamicBatcher`: a
+  background micro-batcher with a dual dispatch trigger (max-wait
+  timer OR bucket-full) that coalesces compatible requests and splits
+  results back per request, zero-recompile in steady state.
+- :mod:`~raft_tpu.serving.admission` — bounded queue with
+  backpressure, EDF-within-priority scheduling, deadline shedding, and
+  the documented load-shed ladder (:class:`LoadShed`).
+- :mod:`~raft_tpu.serving.metrics` — per-stage latency histograms and
+  throughput/shed/occupancy counters via :mod:`raft_tpu.core.tracing`.
+- :mod:`~raft_tpu.serving.harness` — fault-injection pieces (manual
+  clock, executor shims, bursty open-loop load) the deterministic
+  test suite and the bench rider share.
+
+Works unchanged for single-chip and mesh-sharded (``Distributed*``)
+indexes — the batcher only talks to the executor API.
+"""
+
+from raft_tpu.serving.admission import AdmissionQueue, LoadShed
+from raft_tpu.serving.batcher import BatcherConfig, DynamicBatcher
+from raft_tpu.serving.request import (
+    Cancelled,
+    DeadlineExceeded,
+    Overloaded,
+    ResultHandle,
+    SearchRequest,
+    ServingError,
+    ShutDown,
+)
+
+__all__ = [
+    "AdmissionQueue",
+    "BatcherConfig",
+    "Cancelled",
+    "DeadlineExceeded",
+    "DynamicBatcher",
+    "LoadShed",
+    "Overloaded",
+    "ResultHandle",
+    "SearchRequest",
+    "ServingError",
+    "ShutDown",
+]

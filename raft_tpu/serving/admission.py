@@ -1,0 +1,184 @@
+"""Admission control: bounded queue, deadline-aware scheduling, and the
+graceful-degradation ladder.
+
+The queue is the only stateful thing between callers and the batcher
+thread, so its discipline carries the serving SLO:
+
+- **Bounded with backpressure**: ``push`` raises
+  :class:`~raft_tpu.serving.request.Overloaded` once ``capacity``
+  requests are queued — callers see a typed error immediately instead
+  of a silently growing queue and an unbounded tail.
+- **Deadline-aware**: requests order by (priority class, earliest
+  deadline, arrival); expired requests are shed at pop time — before
+  any device work is spent on them — and complete with
+  :class:`~raft_tpu.serving.request.DeadlineExceeded`.
+- **Coalescing-aware**: requests group by their executor
+  ``coalesce_key``; the batcher always pops one *group* (the one
+  holding the globally most-urgent request) so a micro-batch only ever
+  contains requests that may legally share one compiled call.
+
+The degradation ladder (:class:`LoadShed`) maps queue occupancy to a
+documented policy, mildest first:
+
+====  ==========================  =========================================
+rung  trigger (occupancy >=)      action
+====  ==========================  =========================================
+0     —                           normal: dual-trigger batching
+1     ``shrink_wait_at``          max-wait shrinks to 0 — dispatch eagerly,
+                                  trading batch occupancy for queue drain
+2     ``degrade_params_at``       the configured load-shed params override
+                                  applies to NEW submissions (e.g. capped
+                                  ``n_probes``) — cheaper device work per
+                                  request; the override is part of the
+                                  coalesce key, so warm it up ahead of time
+3     queue full                  reject with typed ``Overloaded``
+====  ==========================  =========================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional
+
+from raft_tpu.core import tracing
+from raft_tpu.serving.request import Overloaded, SearchRequest
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadShed:
+    """Degradation-ladder configuration (see module docstring).
+
+    ``params_override`` is a callable ``params -> params`` applied to
+    new submissions at rung 2+ (e.g. ``lambda p: dataclasses.replace(p,
+    n_probes=min(p.n_probes, 8))``). It must be deterministic: the
+    overridden params join the coalesce key, and a warmup of the
+    degraded specialization keeps rung 2 zero-recompile too."""
+
+    shrink_wait_at: float = 0.5
+    degrade_params_at: float = 0.75
+    params_override: Optional[Any] = None
+
+
+class AdmissionQueue:
+    """Bounded, priority + EDF, coalescing-aware request queue."""
+
+    def __init__(self, capacity: int = 1024,
+                 shed: Optional[LoadShed] = None):
+        self.capacity = capacity
+        self.shed = shed or LoadShed()
+        self._lock = threading.Lock()
+        self._groups: Dict[Any, List[SearchRequest]] = {}
+        self._n = 0
+
+    # -- state --------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._n
+
+    def occupancy(self) -> float:
+        """Queued fraction of capacity (the ladder's trigger signal)."""
+        with self._lock:
+            return self._n / self.capacity if self.capacity else 1.0
+
+    def shed_level(self) -> int:
+        """Current degradation rung (0–3) from queue occupancy."""
+        occ = self.occupancy()
+        if occ >= 1.0:
+            return 3
+        if occ >= self.shed.degrade_params_at:
+            return 2
+        if occ >= self.shed.shrink_wait_at:
+            return 1
+        return 0
+
+    # -- producer side ------------------------------------------------------
+
+    def push(self, req: SearchRequest) -> None:
+        """Admit or raise typed :class:`Overloaded` (backpressure)."""
+        with self._lock:
+            if self._n >= self.capacity:
+                tracing.inc_counter("serving.admission.rejected")
+                raise Overloaded(
+                    f"admission queue full ({self.capacity} requests); "
+                    "retry with backoff or raise capacity")
+            self._groups.setdefault(req.compat_key, []).append(req)
+            self._n += 1
+        tracing.inc_counter("serving.admission.accepted")
+
+    # -- consumer (batcher) side --------------------------------------------
+
+    def next_deadline_group(self, now: float):
+        """(compat_key, oldest-arrival, rows, most-urgent order_key) of
+        the group the batcher should serve next, or None when empty.
+        Cancelled/expired requests are pruned lazily here, completing
+        expired ones with ``DeadlineExceeded`` *before* dispatch."""
+        from raft_tpu.serving.request import DeadlineExceeded
+
+        shed: List[SearchRequest] = []
+        with self._lock:
+            best = None
+            for key, group in list(self._groups.items()):
+                keep = []
+                for r in group:
+                    if r.handle.done():          # cancelled while queued
+                        tracing.inc_counter("serving.batcher.cancelled")
+                        continue
+                    if r.expired(now):
+                        shed.append(r)
+                        continue
+                    keep.append(r)
+                self._n -= len(group) - len(keep)
+                if keep:
+                    self._groups[key] = keep
+                    urgent = min(r.order_key() for r in keep)
+                    arrival = min(r.arrival for r in keep)
+                    rows = sum(r.rows for r in keep)
+                    if best is None or urgent < best[3]:
+                        best = (key, arrival, rows, urgent)
+                else:
+                    del self._groups[key]
+        for r in shed:
+            if r.handle._set_exception(DeadlineExceeded(
+                    f"deadline passed {now - r.deadline:.6f}s before "
+                    "dispatch; shed from queue")):
+                tracing.inc_counter("serving.batcher.shed_deadline")
+        return best
+
+    def pop_group(self, key, max_rows: int) -> List[SearchRequest]:
+        """Claim up to ``max_rows`` query rows from the group, most
+        urgent first (EDF within priority). Requests whose handle is no
+        longer pending (cancel won the race) are skipped; claimed
+        handles transition to *running* atomically, so a later cancel
+        returns False."""
+        out: List[SearchRequest] = []
+        with self._lock:
+            group = self._groups.get(key, [])
+            group.sort(key=SearchRequest.order_key)
+            rest: List[SearchRequest] = []
+            rows = 0
+            for r in group:
+                if out and rows + r.rows > max_rows:
+                    rest.append(r)
+                    continue
+                if not r.handle._try_start():
+                    self._n -= 1
+                    tracing.inc_counter("serving.batcher.cancelled")
+                    continue
+                out.append(r)
+                rows += r.rows
+                self._n -= 1
+            if rest:
+                self._groups[key] = rest
+            else:
+                self._groups.pop(key, None)
+        return out
+
+    def drain(self) -> List[SearchRequest]:
+        """Remove and return every queued request (shutdown path)."""
+        with self._lock:
+            all_reqs = [r for g in self._groups.values() for r in g]
+            self._groups.clear()
+            self._n = 0
+        return all_reqs

@@ -1,0 +1,314 @@
+"""Background dynamic micro-batcher — the continuous-batching discipline
+of TPU LLM serving (Ragged Paged Attention, PAPERS.md) applied to ANN
+queries.
+
+Small requests must coalesce into the executor's power-of-two buckets
+to reach the peak-FLOP/s regime (TPU-KNN), but naive accumulation blows
+up tail latency. The batcher runs a **dual trigger**: a micro-batch
+dispatches when its group's query rows reach ``full_batch_rows``
+(bucket-full) OR when its oldest request has waited ``max_wait_s``
+(timer) — whichever comes first. p99 latency is therefore bounded by
+``max_wait_s`` + one device execute, while bursts fill whole buckets.
+
+Requests coalesce only within a compatibility group — the executor's
+:meth:`~raft_tpu.core.executor.SearchExecutor.coalesce_key` (same
+index identity, same resolved statics/engine, same filter spec) — and
+the assembled batch goes through
+:meth:`~raft_tpu.core.executor.SearchExecutor.search_blocks`, i.e. the
+*existing* bucket set: steady state stays zero-recompile (asserted in
+the tests against ``xla.backend_compile_count``) and results are
+bit-identical to direct ``SearchExecutor`` calls, because bucketing
+pads with inert rows and every row's result is independent.
+
+Scheduling is delegated to :class:`~raft_tpu.serving.admission
+.AdmissionQueue` (bounded + backpressure, EDF within priority class,
+expired requests shed before dispatch) and the load-shed ladder is
+documented there. The batcher is pure-stdlib threading: one daemon
+worker, one condition variable, an injectable clock — the fault
+harness (:mod:`raft_tpu.serving.harness`) drives it deterministically
+with ``start=False`` + :meth:`pump` and a manual clock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import tracing
+from raft_tpu.core.validation import expect
+from raft_tpu.serving import metrics
+from raft_tpu.serving.admission import AdmissionQueue, LoadShed
+from raft_tpu.serving.request import (
+    ResultHandle,
+    SearchRequest,
+    ShutDown,
+)
+
+
+class MonotonicClock:
+    """Production clock: ``time.monotonic`` + plain condition waits."""
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def wait(self, cond: threading.Condition, timeout: Optional[float]):
+        """Block on ``cond`` (caller holds it) until notified or
+        ``timeout`` elapses. Manual clocks override this to make the
+        wait a deterministic rendezvous instead of a real sleep."""
+        cond.wait(timeout)
+
+
+@dataclasses.dataclass(frozen=True)
+class BatcherConfig:
+    """Tuning knobs for :class:`DynamicBatcher`.
+
+    ``max_wait_s`` bounds the batching delay any request can be charged
+    (the timer half of the dual trigger); ``full_batch_rows`` is the
+    bucket-full half and the cap on rows per micro-batch (oversized
+    single requests still dispatch alone — the executor tiles them).
+    ``capacity`` bounds the admission queue; ``default_timeout_s``
+    applies a deadline to requests that do not carry one (None = no
+    deadline). ``shed`` is the degradation ladder."""
+
+    max_wait_s: float = 0.002
+    full_batch_rows: int = 256
+    capacity: int = 1024
+    default_timeout_s: Optional[float] = None
+    shed: LoadShed = dataclasses.field(default_factory=LoadShed)
+
+
+class DynamicBatcher:
+    """Async dynamic micro-batcher in front of a ``SearchExecutor``.
+
+    Example::
+
+        ex = SearchExecutor(res)
+        ex.warmup(index, k=10)
+        b = DynamicBatcher(ex)
+        h = b.submit(index, queries, 10, timeout_s=0.050)
+        d, i = h.result()          # typed ServingError on failure
+        b.close()
+
+    ``submit`` never blocks on device work: it admits (or rejects with
+    typed ``Overloaded``), wakes the worker, and returns a
+    :class:`~raft_tpu.serving.request.ResultHandle`. With
+    ``start=False`` no thread runs and :meth:`pump` processes ready
+    work synchronously — the deterministic mode the fault-injection
+    suite drives with a manual clock."""
+
+    def __init__(self, executor, config: Optional[BatcherConfig] = None,
+                 *, clock=None, start: bool = True):
+        self.executor = executor
+        self.config = config or BatcherConfig()
+        expect(self.config.max_wait_s >= 0.0, "max_wait_s must be >= 0")
+        expect(self.config.full_batch_rows > 0,
+               "full_batch_rows must be > 0")
+        self._clock = clock or MonotonicClock()
+        self._queue = AdmissionQueue(self.config.capacity,
+                                     self.config.shed)
+        self._cond = threading.Condition()
+        self._closing = False
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, name="raft-tpu-batcher", daemon=True)
+            self._thread.start()
+
+    # -- caller side --------------------------------------------------------
+
+    def submit(self, index, queries, k: int, params=None, *,
+               timeout_s: Optional[float] = None,
+               deadline: Optional[float] = None, priority: int = 0,
+               sample_filter=None, **kw) -> ResultHandle:
+        """Enqueue one search. ``timeout_s`` (relative) or ``deadline``
+        (absolute, clock domain) bound its queue life; expired requests
+        are shed before device dispatch. A 2-D (per-row) filter rides
+        the request and is re-concatenated at dispatch; a 1-D (shared)
+        filter coalesces by words-array identity — pass the same
+        filter object for requests that should share a call. Raises
+        typed ``Overloaded`` on a full queue and ``ShutDown`` after
+        :meth:`close`; unsupported index/params/filter combinations
+        fail here, synchronously."""
+        if self._closing:
+            raise ShutDown("batcher is closed")
+        now = self._clock.now()
+        if deadline is None:
+            t = (timeout_s if timeout_s is not None
+                 else self.config.default_timeout_s)
+            deadline = now + t if t is not None else None
+        shed = self.config.shed
+        if (shed.params_override is not None
+                and self._queue.shed_level() >= 2):
+            params = shed.params_override(params)
+            tracing.inc_counter("serving.batcher.shed_degraded_params")
+        # resolve the filter to its words ONCE (wrapper types carry no
+        # row info themselves); the executor's coalesce key validates
+        # the plan up front but carries only the filter's spec, so 1-D
+        # (shared) words additionally key by array identity — two
+        # different bitsets of equal shape must never share a call
+        from raft_tpu.neighbors.filters import resolve_filter_words
+
+        fw = resolve_filter_words(sample_filter)
+        compat_key = self.executor.coalesce_key(
+            index, k, params=params, sample_filter=fw, **kw)
+        if fw is not None:
+            if fw.ndim == 1:
+                compat_key = compat_key + (id(fw),)
+            else:
+                expect(fw.shape[0] == int(np.shape(queries)[0]),
+                       "2-D filter rows must match query rows")
+        req = SearchRequest(index=index, queries=queries, k=k,
+                            params=params, deadline=deadline,
+                            priority=priority,
+                            sample_filter=fw, kw=dict(kw),
+                            compat_key=compat_key, arrival=now)
+        # admission happens under the scheduler lock: a submit racing
+        # close() either lands before the final drain (and is drained)
+        # or sees _closing and fails typed — never a stranded handle
+        with self._cond:
+            if self._closing:
+                raise ShutDown("batcher is closed")
+            self._queue.push(req)      # typed Overloaded on overflow
+            self._cond.notify_all()
+        return req.handle
+
+    def pump(self) -> int:
+        """Synchronously dispatch every micro-batch that is ready at
+        the current clock time (deterministic mode; also usable as a
+        flush with a running worker). Returns batches dispatched."""
+        n = 0
+        while True:
+            batch = self._poll()
+            if not batch:
+                return n
+            self._dispatch(*batch)
+            n += 1
+
+    def close(self, drain: bool = True) -> None:
+        """Shut down. ``drain=True`` dispatches everything still queued
+        (in-flight batches complete normally); ``drain=False`` fails
+        queued requests with typed ``ShutDown``. Idempotent; joins the
+        worker thread, so no threads or pending futures leak."""
+        with self._cond:
+            if self._closing:
+                self._cond.notify_all()
+            self._closing = True
+            if not drain:
+                for r in self._queue.drain():
+                    if r.handle._set_exception(
+                            ShutDown("batcher closed before dispatch")):
+                        tracing.inc_counter(
+                            "serving.batcher.shutdown_shed")
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        elif drain:
+            self.pump()            # threadless mode drains inline
+        # anything left (e.g. raced submits) fails typed rather than
+        # hanging its caller forever
+        for r in self._queue.drain():
+            if r.handle._set_exception(
+                    ShutDown("batcher closed before dispatch")):
+                tracing.inc_counter("serving.batcher.shutdown_shed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- worker -------------------------------------------------------------
+
+    def _effective_max_wait(self) -> float:
+        """Ladder rung 1: above ``shrink_wait_at`` occupancy the timer
+        trigger collapses to 0 — drain beats batching delay."""
+        if self._queue.shed_level() >= 1:
+            return 0.0
+        return self.config.max_wait_s
+
+    def _poll(self):
+        """One non-blocking scheduling decision: the next ready
+        micro-batch as ``(key, requests)``, or ``()`` when nothing is
+        ready yet."""
+        with self._cond:
+            return self._select(block=False)
+
+    def _select(self, block: bool):
+        """Core of the dual trigger (caller holds ``self._cond``)."""
+        while True:
+            now = self._clock.now()
+            head = self._queue.next_deadline_group(now)
+            if head is None:
+                if self._closing or not block:
+                    return None if self._closing else ()
+                self._clock.wait(self._cond, None)
+                continue
+            key, arrival, rows, _ = head
+            wait = self._effective_max_wait()
+            full = rows >= self.config.full_batch_rows
+            timed_out = now >= arrival + wait
+            if full or timed_out or self._closing:
+                reqs = self._queue.pop_group(
+                    key, self.config.full_batch_rows)
+                if not reqs:       # cancels won every race — rescan
+                    continue
+                return (key, reqs)
+            if not block:
+                return ()
+            self._clock.wait(self._cond, arrival + wait - now)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                batch = self._select(block=True)
+            if batch is None:
+                return             # closed and drained
+            if batch:
+                self._dispatch(*batch)
+
+    def _dispatch(self, key, reqs) -> None:
+        """Assemble one micro-batch, execute, split results back."""
+        t0 = self._clock.now()
+        for r in reqs:
+            metrics.observe_stage(metrics.QUEUE_WAIT, t0 - r.arrival)
+        rep = reqs[0]
+        blocks = [r.queries for r in reqs]
+        n_rows = sum(r.rows for r in reqs)
+        # requests carry RESOLVED filter words (see submit): 1-D words
+        # are shared by coalesce-key construction, 2-D (per-row) words
+        # concatenate to match the concatenated query rows
+        fw = rep.sample_filter
+        if fw is not None and fw.ndim == 2 and len(reqs) > 1:
+            parts = [r.sample_filter for r in reqs]
+            if all(isinstance(p, np.ndarray) for p in parts):
+                fw = np.concatenate(parts)
+            else:
+                fw = jnp.concatenate([jnp.asarray(p) for p in parts])
+        t1 = self._clock.now()
+        metrics.observe_stage(metrics.ASSEMBLY, t1 - t0)
+        try:
+            results = self.executor.search_blocks(
+                rep.index, blocks, rep.k, params=rep.params,
+                sample_filter=fw, **rep.kw)
+            results = jax.block_until_ready(results)
+        except Exception as e:  # noqa: BLE001 — fail the handles, not the worker
+            for r in reqs:
+                r.handle._set_exception(e)
+            tracing.inc_counter("serving.batcher.failed_batches")
+            return
+        t2 = self._clock.now()
+        metrics.observe_stage(metrics.EXECUTE, t2 - t1)
+        for r, (d, i) in zip(reqs, results):
+            r.handle._set_result(d, i)
+        t3 = self._clock.now()
+        metrics.observe_stage(metrics.SPLIT, t3 - t2)
+        for r in reqs:
+            metrics.observe_stage(metrics.E2E, t3 - r.arrival)
+        metrics.batch_dispatched(len(reqs), n_rows)

@@ -1,0 +1,80 @@
+"""Serving-frontend metrics, exported through the existing
+:mod:`raft_tpu.core.tracing` registry.
+
+Per-stage latency **histograms** (log2 buckets, p50/p95/p99 estimates):
+
+- ``serving.batcher.queue_wait_seconds``   — admission → batch assembly
+- ``serving.batcher.assembly_seconds``     — group pop + block concat
+- ``serving.batcher.execute_seconds``      — device execute (blocked)
+- ``serving.batcher.split_seconds``        — result re-split + handle set
+- ``serving.batcher.e2e_seconds``          — admission → handle complete
+
+**Counters** (throughput / shed / occupancy):
+
+- ``serving.admission.accepted`` / ``.rejected``  — admission outcomes
+- ``serving.batcher.requests`` / ``.rows``        — dispatched work
+- ``serving.batcher.batches``                     — executor calls made
+- ``serving.batcher.shed_deadline``               — expired → shed
+- ``serving.batcher.cancelled``                   — cancelled in queue
+- ``serving.batcher.shutdown_shed``               — shed at close()
+
+Batch **occupancy** — the coalescing win the ISSUE's acceptance
+criterion gates on — is derived, not stored: ``requests / batches``
+(and ``rows / batches``) from one counters snapshot.
+"""
+
+from __future__ import annotations
+
+from raft_tpu.core import tracing
+
+PREFIX = "serving.batcher."
+
+QUEUE_WAIT = PREFIX + "queue_wait_seconds"
+ASSEMBLY = PREFIX + "assembly_seconds"
+EXECUTE = PREFIX + "execute_seconds"
+SPLIT = PREFIX + "split_seconds"
+E2E = PREFIX + "e2e_seconds"
+
+
+def observe_stage(name: str, seconds: float) -> None:
+    """Record one stage latency into its histogram."""
+    tracing.observe(name, seconds)
+
+
+def batch_dispatched(n_requests: int, n_rows: int) -> None:
+    """Count one dispatched micro-batch."""
+    tracing.inc_counter(PREFIX + "batches")
+    tracing.inc_counter(PREFIX + "requests", n_requests)
+    tracing.inc_counter(PREFIX + "rows", n_rows)
+
+
+def occupancy() -> dict:
+    """Derived batch-occupancy stats: mean requests and rows per
+    dispatched micro-batch (1.0 requests/batch == no coalescing)."""
+    batches = tracing.get_counter(PREFIX + "batches")
+    if batches == 0:
+        return {"batches": 0, "requests_per_batch": 0.0,
+                "rows_per_batch": 0.0}
+    return {
+        "batches": int(batches),
+        "requests_per_batch":
+            tracing.get_counter(PREFIX + "requests") / batches,
+        "rows_per_batch": tracing.get_counter(PREFIX + "rows") / batches,
+    }
+
+
+def snapshot() -> dict:
+    """One scrape of the whole serving surface: counters + per-stage
+    histogram summaries + derived occupancy (the bench rider's and any
+    monitoring agent's single entry point)."""
+    return {
+        "counters": tracing.counters("serving."),
+        "histograms": tracing.histograms(PREFIX),
+        "occupancy": occupancy(),
+    }
+
+
+def reset() -> None:
+    """Zero every serving counter and histogram — test/bench isolation."""
+    tracing.reset_counters("serving.")
+    tracing.reset_histograms(PREFIX)

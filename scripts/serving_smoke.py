@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Serving-frontend smoke on the real backend: a DynamicBatcher in
+front of a warmed SearchExecutor takes bursty open-loop traffic and
+the script asserts the PR-5 acceptance criteria end-to-end on chip —
+results bit-identical to direct executor calls under coalescing and
+re-splitting, batch occupancy >= 2x one-request-per-call — and
+reports steady-state backend compiles (the warmed search executable
+never recompiles; pad/slice micro-programs per NEW coalesced batch
+size are the executor's documented small print). One JSON line per
+piece (commit the output as hardware evidence, like
+tpu_smoke_kernels.py).
+
+Run: PYTHONPATH=/root/repo:/root/.axon_site python scripts/serving_smoke.py
+"""
+
+import json
+import os
+
+import numpy as np
+
+os.environ.setdefault("RAFT_TPU_VMEM_MB", "64")
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                 "results", "jaxcache"))
+
+import jax  # noqa: E402
+
+
+def emit(piece, **kw):
+    print(json.dumps({"piece": piece, **kw}), flush=True)
+
+
+def main():
+    emit("config", backend=jax.default_backend(),
+         device=jax.devices()[0].device_kind)
+    from raft_tpu import SearchExecutor
+    from raft_tpu.core import tracing
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.serving import BatcherConfig, DynamicBatcher
+    from raft_tpu.serving import metrics as sv_metrics
+    from raft_tpu.serving.harness import burst_schedule, drive_open_loop
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((50_000, 128)).astype(np.float32)
+    index = ivf_flat.build(
+        None, ivf_flat.IvfFlatIndexParams(n_lists=64), x)
+    p = ivf_flat.IvfFlatSearchParams(n_probes=8)
+    ex = SearchExecutor()
+    warm_s = ex.warmup(index, k=10, params=p)
+    tracing.install_xla_compile_listener()
+
+    # bit-identity under coalescing + re-split
+    q = rng.standard_normal((48, 128)).astype(np.float32)
+    want_d, want_i = (np.asarray(a)
+                      for a in ex.search(index, q, 10, params=p))
+    with DynamicBatcher(ex, BatcherConfig(max_wait_s=0.005)) as b:
+        hs = [b.submit(index, q[at:at + m], 10, params=p)
+              for at, m in ((0, 17), (17, 3), (20, 28))]
+        got_d = np.concatenate(
+            [np.asarray(h.result(timeout=60)[0]) for h in hs])
+        got_i = np.concatenate(
+            [np.asarray(h.result(timeout=60)[1]) for h in hs])
+    bit_identical = (np.array_equal(got_i, want_i)
+                     and np.array_equal(got_d, want_d))
+    emit("bit_identity", ok=bool(bit_identical),
+         warmup_seconds=round(warm_s, 3))
+    assert bit_identical
+
+    # bursty open-loop load: occupancy + zero-recompile steady state
+    sv_metrics.reset()
+    blocks = [rng.standard_normal(
+        (int(rng.integers(1, 5)), 128)).astype(np.float32)
+        for _ in range(200)]
+    b = DynamicBatcher(ex, BatcherConfig(max_wait_s=0.002))
+    # primer burst so per-batch-size pad programs land pre-measurement
+    for h in [b.submit(index, blk, 10, params=p)
+              for blk in blocks[:40]]:
+        h.result(timeout=60)
+    backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+    handles = drive_open_loop(
+        lambda o, _t: b.submit(index, blocks[40 + o], 10, params=p),
+        burst_schedule(n_bursts=10, burst_size=16, period_s=0.01,
+                       start_s=b._clock.now()),
+        b._clock)
+    failures = sum(1 for h in handles
+                   if h.exception(timeout=60) is not None)
+    b.close()
+    compiles = tracing.get_counter(tracing.XLA_COMPILE_COUNT) - backend0
+    occ = sv_metrics.occupancy()
+    emit("open_loop", requests=len(handles), failures=failures,
+         requests_per_batch=round(occ["requests_per_batch"], 2),
+         rows_per_batch=round(occ["rows_per_batch"], 2),
+         backend_compiles_steady_state=int(compiles),
+         e2e=sv_metrics.snapshot()["histograms"].get(
+             sv_metrics.E2E, {}))
+    assert failures == 0
+    assert occ["requests_per_batch"] >= 2.0
+    emit("done", ok=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -265,6 +265,25 @@ def refresh(parts, dev):
     return jax.device_put(list(parts), dev)
 '''
 
+# PR 5 scope extensions: R5 covers raft_tpu/serving/* and R1's
+# cache-key discipline covers the batcher's coalescing keys
+R5_SERVING_VIOLATING = '''\
+def dispatch(batch):
+    depth = batch.depth.item()
+    return depth
+'''
+R1_SERVING_KEY_VIOLATING = '''\
+def admit(executor, index, k, kw, handle):
+    compat_key = (id(index), [k], float(kw))
+    return SearchRequest(compat_key={"k": k}, handle=handle)
+'''
+R1_SERVING_KEY_CONFORMING = '''\
+def admit(executor, index, k, kw, handle):
+    compat_key = (id(index), k,
+                  tuple(sorted((n, str(v)) for n, v in kw.items())))
+    return SearchRequest(compat_key=compat_key, handle=handle)
+'''
+
 R6_OPS_VIOLATING = '''\
 from jax.experimental import pallas as pl
 
@@ -366,6 +385,23 @@ class TestFixtureCorpus:
         assert "np.asarray" in msgs
         assert "device_put inside a python loop" in msgs
         assert lint_lib(R5_CONFORMING, ["R5"]).ok
+
+    def test_r5_covers_serving_modules(self):
+        bad = lint_lib(R5_SERVING_VIOLATING, ["R5"],
+                       rel="raft_tpu/serving/sample.py")
+        assert rules_fired(bad) == {"R5"}
+        assert ".item()" in bad.findings[0].message
+        # the same source outside the hot set stays quiet
+        assert lint_lib(R5_SERVING_VIOLATING, ["R5"],
+                        rel="raft_tpu/io/sample.py").ok
+
+    def test_r1_serving_compat_key(self):
+        bad = lint_lib(R1_SERVING_KEY_VIOLATING, ["R1"],
+                       rel="raft_tpu/serving/sample.py")
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "unhashable" in msgs and "float()" in msgs, msgs
+        assert lint_lib(R1_SERVING_KEY_CONFORMING, ["R1"],
+                        rel="raft_tpu/serving/sample.py").ok
 
     def test_r6(self):
         bad = lint_texts({"raft_tpu/ops/sample.py": R6_OPS_VIOLATING},
@@ -526,6 +562,8 @@ class TestRepoWide:
         ("raft_tpu/distributed/ivf.py", "R5",
          "streaming deal: per-block puts bound build staging to "
          "O(block)"),
+        ("raft_tpu/serving/harness.py", "R5",
+         "device-free test shim: inputs are host arrays by contract"),
     ]
 
     @pytest.fixture(scope="class")

@@ -78,12 +78,21 @@ class TestMatrixVector:
 
 class TestReduce:
     def test_reduce_rows_cols(self, rng_np, res):
+        # XLA's f32 reduce order differs from numpy's pairwise
+        # summation by O(n * eps * sum|x|) ABSOLUTE error (~1 ulp of
+        # the largest addend). A row of +-O(1) values can cancel to a
+        # sum near 0, where that 6e-8 shows up as 6e-5 *relative* —
+        # so rtol alone is the wrong contract for a sum. atol is
+        # pinned to n * eps * max_row(sum|x|) with margin: 5 addends
+        # * 1.2e-7 * ~4 ≈ 2.4e-6 → 1e-5.
         m = rng_np.standard_normal((8, 5)).astype(np.float32)
         np.testing.assert_allclose(
-            np.asarray(linalg.coalesced_reduction(res, m)), m.sum(axis=1), rtol=1e-5
+            np.asarray(linalg.coalesced_reduction(res, m)), m.sum(axis=1),
+            rtol=1e-5, atol=1e-5,
         )
         np.testing.assert_allclose(
-            np.asarray(linalg.strided_reduction(res, m)), m.sum(axis=0), rtol=1e-5
+            np.asarray(linalg.strided_reduction(res, m)), m.sum(axis=0),
+            rtol=1e-5, atol=1e-5,
         )
 
     def test_norms(self, rng_np, res):

@@ -21,4 +21,8 @@ python -m pip install -e . --no-deps --no-build-isolation --quiet
 JAX_PLATFORMS=cpu python -m raft_tpu.bench --help > /dev/null && echo "bench CLI OK"
 
 echo "== tests =="
-python -m pytest tests/ -q "$@"
+# the session drops ci/metrics_snapshot.json — the full tracing
+# registries (counters / gauges / cumulative-bucket histograms / span
+# ring stats) as a build artifact next to the graftlint report
+RAFT_TPU_METRICS_SNAPSHOT="$PWD/ci/metrics_snapshot.json" \
+    python -m pytest tests/ -q "$@"

@@ -6,9 +6,11 @@ code is written*: AOT cache keys stay hashable statics (R1), donated
 buffers are never read after donation (R2), every collective goes
 through the versioned comms veneer and names a real mesh axis (R3),
 every Pallas kernel states and fits its VMEM budget (R4), the serving
-hot path never round-trips to the host (R5), and every kernel keeps an
-interpret-mode CPU reference (R6). Runtime tests catch violations one
-configuration at a time; graftlint machine-checks them on every diff.
+hot path never round-trips to the host (R5), every kernel keeps an
+interpret-mode CPU reference (R6), and the serving frontend reads time
+only through the injectable clock (R7). Runtime tests catch violations
+one configuration at a time; graftlint machine-checks them on every
+diff.
 
 Run::
 
@@ -43,6 +45,7 @@ from raft_tpu.analysis import rules_trace  # noqa: F401
 from raft_tpu.analysis import rules_mesh  # noqa: F401
 from raft_tpu.analysis import rules_pallas  # noqa: F401
 from raft_tpu.analysis import rules_hostsync  # noqa: F401
+from raft_tpu.analysis import rules_clock  # noqa: F401
 
 
 def lint_texts(texts, rules=None) -> Report:

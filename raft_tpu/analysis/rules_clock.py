@@ -1,0 +1,115 @@
+"""R7 — clock discipline in the serving frontend.
+
+The serving fault suite is deterministic because virtual time is the
+ONLY time: ``DynamicBatcher`` takes an injectable clock, the manual
+clock advances when the test says so, and every deadline / max-wait /
+arrival-rate / span timestamp is computed from ``clock.now()``. One
+direct ``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()``
+in a serving module silently re-couples that logic to the wall clock:
+the manual-clock harness keeps passing (nothing *races*), but the
+quantity it thinks it controls — an expiry decision, a span duration,
+a rate estimate — is now measured in a different time domain and
+drifts under load. This is the failure mode that only shows up as
+flaky prod telemetry, which is why it is a lint rule and not a test.
+
+Scope: ``raft_tpu/serving/*``. The one blessed location is the
+injectable-clock plumbing itself — a class whose name ends in
+``Clock`` (``MonotonicClock`` is the production implementation;
+harness clocks override ``now``/``wait``). Everything else must take
+timestamps from the clock object or from values stamped by it
+(``req.arrival``, ``deadline``). Every import spelling is covered —
+``time.monotonic()``, ``import time as t; t.monotonic()``, and
+``from time import time`` alike. ``time.sleep`` is not flagged: the
+harness's real-clock fallbacks sleep by design, and sleeping reads no
+clock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from raft_tpu.analysis import astutil
+from raft_tpu.analysis.core import Finding, Project, rule
+
+SERVING_PREFIX = "raft_tpu/serving/"
+
+# the clock-reading members of the time module
+CLOCK_FNS = {"time", "monotonic", "perf_counter",
+             "time_ns", "monotonic_ns", "perf_counter_ns"}
+
+
+def _clock_class_spans(tree: ast.AST) -> List[tuple]:
+    """(first, last) line ranges of ``class *Clock`` definitions — the
+    injectable-clock plumbing where direct clock reads belong."""
+    spans = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name.endswith("Clock"):
+            spans.append((node.lineno,
+                          getattr(node, "end_lineno", node.lineno)))
+    return spans
+
+
+def _time_module_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to the ``time`` module (``import time``,
+    ``import time as t``) — aliasing must not evade the rule."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    aliases.add(a.asname or "time")
+    return aliases
+
+
+def _clock_fn_imports(tree: ast.AST) -> Set[str]:
+    """Local names bound to clock functions via ``from time import
+    ...`` (``from time import time``, ``from time import monotonic as
+    now``) — the bare-call evasion route."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in CLOCK_FNS:
+                    names.add(a.asname or a.name)
+    return names
+
+
+@rule("R7", "clock-discipline")
+def check_clock_discipline(project: Project) -> Iterable[Finding]:
+    """Direct ``time.time()``/``time.monotonic()``/``time.perf_counter()``
+    calls (any import spelling) in ``raft_tpu/serving/`` outside a
+    ``*Clock`` class — they bypass the injectable clock, so the
+    manual-clock fault harness no longer controls the quantity being
+    measured."""
+    out: List[Finding] = []
+    for f in project.lib():
+        if f.tree is None or not f.rel.startswith(SERVING_PREFIX):
+            continue
+        clock_spans = _clock_class_spans(f.tree)
+        mod_aliases = _time_module_aliases(f.tree)
+        bare_names = _clock_fn_imports(f.tree)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            nm = astutil.call_name(node)
+            if nm is None:
+                continue
+            if "." in nm:
+                mod, fn = nm.split(".", 1)
+                if mod not in mod_aliases or fn not in CLOCK_FNS:
+                    continue
+            elif nm not in bare_names:
+                # a bare name is a clock read only when this module
+                # imported it from `time` — locals stay exempt
+                continue
+            if any(lo <= node.lineno <= hi for lo, hi in clock_spans):
+                continue
+            out.append(Finding(
+                "R7", f.rel, node.lineno,
+                f"{nm}() in a serving module bypasses the injectable "
+                "clock — take timestamps from the batcher clock "
+                "(clock.now() / req.arrival) or put this inside the "
+                "*Clock plumbing, or the manual-clock fault harness "
+                "stops being deterministic"))
+    return out

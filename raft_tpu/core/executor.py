@@ -33,6 +33,21 @@ are exported through :mod:`raft_tpu.core.tracing` under the
 provides the backend-compile ground truth that the tier-1 recompile
 regression test asserts on.
 
+**Executable cost introspection (PR 6, graftscope).** AOT compilation
+is the one moment the whole program is in hand, so that is where the
+TPU-KNN roofline accounting moves from bench artifact to live metric:
+each compiled entry captures XLA's ``cost_analysis()`` (flops, bytes
+accessed) and ``memory_analysis()`` (argument/output/temp bytes → peak
+HBM) once, publishes them as ``serving.executable.<digest>.*`` gauges,
+and every dispatch bumps ``serving.execute.modeled_flops`` /
+``.modeled_bytes`` by the entry's numbers — pure host-side dict work,
+captured at compile time, so the steady state stays sync-free and
+zero-recompile. Combined with the measured execute-latency histogram
+(the batcher blocks on results anyway) a scrape derives live achieved
+GB/s and FLOP/s. Mesh plans also publish their
+``collective_payload_model`` bytes per wire dtype. :meth:`
+SearchExecutor.executable_costs` is the JSON-snapshot view.
+
 Supported index types: ``BruteForceIndex``, ``IvfFlatIndex``,
 ``IvfPqIndex``, ``IvfBqIndex``, ``CagraIndex``, and the mesh-sharded
 ``DistributedIvfFlat`` / ``DistributedIvfPq`` / ``DistributedIvfBq``
@@ -51,6 +66,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import hashlib
 import threading
 import time
 from typing import Any, Callable, Optional, Tuple
@@ -109,14 +125,65 @@ class _Plan:
     sharded: bool = False
     qsharding: Any = None
     state_sharding: Any = None
+    # distributed plans carry their modeled per-shard collective
+    # payload as (family, thunk returning the collective_payload_model
+    # dict) — evaluated and published as gauges only on a compile miss,
+    # so the cache-hit hot path never builds the dict
+    payload: Any = None
 
 
 class _Entry:
-    __slots__ = ("compiled", "state")
+    __slots__ = ("compiled", "state", "cost", "digest")
 
-    def __init__(self, compiled, state):
+    def __init__(self, compiled, state, cost=None, digest=""):
         self.compiled = compiled
         self.state = state
+        self.cost = cost or {}
+        self.digest = digest
+
+
+def _executable_cost(compiled) -> dict:
+    """XLA's static accounting for one compiled executable: flops and
+    bytes accessed from ``cost_analysis()``, the HBM footprint split
+    from ``memory_analysis()``. Best-effort — backends that implement
+    neither simply yield an empty dict (the gauges then read 0)."""
+    cost: dict = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            cost["flops"] = float(ca.get("flops", 0.0))
+            cost["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
+    except Exception:  # noqa: BLE001 — introspection must never fail a compile
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            arg = float(getattr(ma, "argument_size_in_bytes", 0))
+            out = float(getattr(ma, "output_size_in_bytes", 0))
+            tmp = float(getattr(ma, "temp_size_in_bytes", 0))
+            alias = float(getattr(ma, "alias_size_in_bytes", 0))
+            cost["argument_bytes"] = arg
+            cost["output_bytes"] = out
+            cost["temp_bytes"] = tmp
+            # aliased (donated) outputs reuse argument storage
+            cost["peak_hbm_bytes"] = arg + out + tmp - alias
+    except Exception:  # noqa: BLE001 — introspection must never fail a compile
+        pass
+    return cost
+
+
+def _cost_gauge_values(digest: str, cost: dict) -> dict:
+    """The ``serving.executable.<digest>.*`` gauge values for one
+    executable's cost dict (compile-time publication and scrape-time
+    re-publication read from the same mapping)."""
+    base = f"serving.executable.{digest}."
+    return {
+        base + "flops": cost.get("flops", 0.0),
+        base + "bytes_accessed": cost.get("bytes_accessed", 0.0),
+        base + "peak_hbm_bytes": cost.get("peak_hbm_bytes", 0.0),
+    }
 
 
 def _sds(x) -> jax.ShapeDtypeStruct:
@@ -193,6 +260,9 @@ class SearchExecutor:
         self.stats = ExecutorStats()
         self._cache: "collections.OrderedDict[tuple, _Entry]" = (
             collections.OrderedDict())
+        # digest -> {family, bucket, flops, bytes_accessed, ...}: the
+        # JSON-snapshot view of the per-executable cost gauges
+        self._cost_table: dict = {}
         # multi-threaded frontends share one executor: the cache and
         # the donated per-entry state buffers must hand off atomically
         # (two threads donating the same state would hit jax's
@@ -346,6 +416,21 @@ class SearchExecutor:
             if plan.has_state:
                 args.extend(entry.state)
             out_d, out_i = entry.compiled(*args)
+            # modeled per-dispatch work, from the compile-time capture:
+            # a counter bump (one host lock), never a device sync. The
+            # scrape divides these by the measured execute-latency sum
+            # to publish live achieved GB/s / FLOP/s. Counted AFTER the
+            # dispatch so a call that raises does not inflate the
+            # achieved-bandwidth numerator its failed execution never
+            # contributes latency for.
+            tracing.inc_counters({
+                "serving.execute.calls": 1.0,
+                "serving.execute.rows": float(q),
+                "serving.execute.modeled_flops":
+                    entry.cost.get("flops", 0.0),
+                "serving.execute.modeled_bytes":
+                    entry.cost.get("bytes_accessed", 0.0),
+            })
             if plan.has_state:
                 # outputs alias the donated state storage; keep them as
                 # the next call's state and hand the caller copies
@@ -395,13 +480,66 @@ class SearchExecutor:
             if plan.state_sharding is not None:
                 state = tuple(jax.device_put(s, plan.state_sharding)
                               for s in state)
-        ent = _Entry(compiled, state)
+        # cost introspection happens HERE — compile time, once per
+        # executable — so the per-dispatch accounting below is a plain
+        # dict read with zero device interaction
+        cost = _executable_cost(compiled)
+        digest = hashlib.sha1(repr(plan.key).encode()).hexdigest()[:12]
+        info = {"family": plan.key[0], "bucket": bucket, "k": k,
+                "compile_seconds": dt, **cost}
+        if plan.payload is not None:
+            family, model_fn = plan.payload
+            model = dict(model_fn())
+            info["collective_family"] = family
+            info["collective_payload"] = model
+            from raft_tpu.distributed.ivf import publish_payload_gauges
+
+            publish_payload_gauges(family, model)
+        self._cost_table[digest] = info
+        tracing.set_gauges(_cost_gauge_values(digest, cost))
+        ent = _Entry(compiled, state, cost=cost, digest=digest)
         self._cache[plan.key] = ent
         while len(self._cache) > self.max_entries:
-            self._cache.popitem(last=False)
+            _, old = self._cache.popitem(last=False)
             self.stats.evictions += 1
             tracing.inc_counter("serving.evictions")
+            if old.digest:
+                self._cost_table.pop(old.digest, None)
+                tracing.reset_gauges(f"serving.executable.{old.digest}.")
+        tracing.set_gauge("serving.executor.cached_executables",
+                          float(len(self._cache)))
         return ent
+
+    def executable_costs(self) -> dict:
+        """``{digest: {family, bucket, k, flops, bytes_accessed,
+        peak_hbm_bytes, ...}}`` for every cached executable — the JSON
+        view of the ``serving.executable.*`` gauges (one scrape shows
+        which programs are resident and what each costs per call)."""
+        with self._lock:
+            return {d: dict(info) for d, info in self._cost_table.items()}
+
+    def publish_cost_gauges(self) -> None:
+        """Re-publish every resident executable's cost gauges plus the
+        cache-size gauge from the live cache. ``metrics.reset()``
+        clears the whole ``serving.`` gauge namespace while the cache
+        keeps its entries; an attached exporter calls this at scrape
+        time so ``/metrics`` and :meth:`executable_costs` never
+        disagree about which programs are resident. Mesh entries'
+        ``serving.collective.*`` payload gauges re-publish too (they
+        are keyed by family + wire dtypes rather than digest, so one
+        gauge can represent several resident executables)."""
+        with self._lock:
+            table = {d: dict(info) for d, info in self._cost_table.items()}
+            n = len(self._cache)
+        vals = {"serving.executor.cached_executables": float(n)}
+        for digest, info in table.items():
+            vals.update(_cost_gauge_values(digest, info))
+            if "collective_payload" in info:
+                from raft_tpu.distributed.ivf import publish_payload_gauges
+
+                publish_payload_gauges(info["collective_family"],
+                                       info["collective_payload"])
+        tracing.set_gauges(vals)
 
     def _compile(self, plan: _Plan, bucket: int, k: int):
         donate = ()
@@ -520,7 +658,12 @@ class SearchExecutor:
                      post=arrays, qdim=index.dim,
                      has_state=engine != "pallas", sharded=True,
                      qsharding=comms.replicated(),
-                     state_sharding=comms.replicated())
+                     state_sharding=comms.replicated(),
+                     payload=("dist_ivf_flat",
+                              lambda: dist_ivf.collective_payload_model(
+                                  bucket, k, n_probes, index.n_lists,
+                                  comms.size, wire_dtype, probe_mode,
+                                  probe_wire_dtype)))
 
     def _plan_dist_ivf_pq(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.distributed import ivf as dist_ivf
@@ -552,7 +695,12 @@ class SearchExecutor:
         return _Plan(key=key, fn=dist_ivf._dist_search_pq_fn,
                      static=static, post=arrays, qdim=index.dim,
                      sharded=True, qsharding=comms.replicated(),
-                     state_sharding=comms.replicated())
+                     state_sharding=comms.replicated(),
+                     payload=("dist_ivf_pq",
+                              lambda: dist_ivf.collective_payload_model(
+                                  bucket, k, n_probes, index.n_lists,
+                                  comms.size, wire_dtype, probe_mode,
+                                  probe_wire_dtype)))
 
     def _plan_dist_ivf_bq(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.distributed import bq as dist_bq
@@ -580,7 +728,12 @@ class SearchExecutor:
         return _Plan(key=key, fn=dist_bq._dist_search_bq_fn, static=static,
                      post=arrays, qdim=index.dim, sharded=True,
                      qsharding=comms.replicated(),
-                     state_sharding=comms.replicated())
+                     state_sharding=comms.replicated(),
+                     payload=("dist_ivf_bq",
+                              lambda: dist_ivf.collective_payload_model(
+                                  bucket, k, n_probes, index.n_lists,
+                                  comms.size, wire_dtype, probe_mode,
+                                  probe_wire_dtype)))
 
     def _plan_brute_force(self, index, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import brute_force as bf

@@ -16,14 +16,39 @@ them. ``install_xla_compile_listener`` additionally taps jax's
 monitoring events so *every* backend compile in the process — not just
 the executor's — is visible; that is what the tier-1 recompile
 regression test asserts on.
+
+PR 6 (graftscope) grows this module into the full observability core:
+
+- **Gauges** (:func:`set_gauge`) — last-value metrics next to the
+  monotone counters: per-executable cost-analysis numbers, queue
+  depth, arrival rate, collective payload models.
+- **Request spans** (:class:`Span` / :class:`SpanRecorder`) — a
+  bounded, lock-protected ring buffer of host-side stage spans keyed
+  by ``trace_id``, doubling as a flight recorder for post-mortems.
+  :meth:`SpanRecorder.to_chrome_trace` exports Chrome trace-event JSON
+  so the serving stage spans overlay the ``jax.profiler`` device
+  timeline in Perfetto.
+- :class:`Histogram` grew cumulative bucket counts (the Prometheus
+  exposition format needs them) and its own lock — ``get_histogram``
+  hands out live instances, so unlocked ``observe`` raced concurrent
+  observers before PR 6.
+
+None of it touches the device: recording a span or bumping a counter
+is a dict/deque operation under a host lock, so instrumentation adds
+no host syncs and cannot perturb the zero-recompile steady state.
 """
 
 from __future__ import annotations
 
 import builtins
+import collections
 import contextlib
+import dataclasses
 import functools
+import itertools
 import threading
+import time
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 
@@ -86,6 +111,15 @@ def inc_counter(name: str, amount: float = 1.0) -> None:
         _counters[name] = _counters.get(name, 0.0) + amount
 
 
+def inc_counters(amounts: Dict[str, float]) -> None:
+    """Add several counters under ONE lock acquisition — the per-call
+    hot-path form (the executor bumps calls + modeled flops + modeled
+    bytes per dispatch; three separate locks would triple the cost)."""
+    with _counters_lock:
+        for name, amount in amounts.items():
+            _counters[name] = _counters.get(name, 0.0) + amount
+
+
 def max_counter(name: str, value: float) -> None:
     """Raise a named counter to ``value`` if it is below it (creates it
     at ``value``) — high-water-mark counters like peak bytes."""
@@ -113,6 +147,48 @@ def reset_counters(prefix: str = "") -> None:
 
 
 # ---------------------------------------------------------------------------
+# gauges — last-value metrics (cost-analysis numbers, queue depth, rates)
+# ---------------------------------------------------------------------------
+
+_gauges: dict = {}
+
+
+def set_gauge(name: str, value: float) -> None:
+    """Set a named process-wide gauge to ``value`` (last write wins) —
+    the non-monotone sibling of :func:`inc_counter`, for quantities
+    that go up AND down (queue depth, arrival rate) or describe a
+    current object (an executable's cost-analysis flops)."""
+    with _counters_lock:
+        _gauges[name] = value
+
+
+def set_gauges(values: Dict[str, float]) -> None:
+    """Set several gauges under one lock acquisition."""
+    with _counters_lock:
+        _gauges.update(values)
+
+
+def get_gauge(name: str, default: float = 0.0) -> float:
+    """Current value of a gauge (``default`` if never set)."""
+    with _counters_lock:
+        return _gauges.get(name, default)
+
+
+def gauges(prefix: str = "") -> dict:
+    """Snapshot of all gauges whose name starts with ``prefix``."""
+    with _counters_lock:
+        return {k: v for k, v in _gauges.items() if k.startswith(prefix)}
+
+
+def reset_gauges(prefix: str = "") -> None:
+    """Drop gauges matching ``prefix`` — test isolation, and how the
+    executor retires the per-executable gauges of an evicted entry."""
+    with _counters_lock:
+        for k in [k for k in _gauges if k.startswith(prefix)]:
+            del _gauges[k]
+
+
+# ---------------------------------------------------------------------------
 # histograms — per-stage latency distributions for the serving frontend
 # ---------------------------------------------------------------------------
 
@@ -130,15 +206,22 @@ class Histogram:
 
     ``observe`` is O(log n_buckets); ``quantile`` interpolates linearly
     inside the selected bucket, which is the usual Prometheus-style
-    estimate — exact enough for p50/p95/p99 serving dashboards."""
+    estimate — exact enough for p50/p95/p99 serving dashboards.
+    Values past the last bound land in an overflow bucket whose
+    quantile estimate is pinned at ``2 * bounds[-1]``.
 
-    __slots__ = ("bounds", "counts", "count", "sum")
+    Every instance carries its own lock: :func:`get_histogram` hands
+    out live objects, so ``observe``/``snapshot`` must be safe against
+    concurrent callers without routing through the registry lock."""
+
+    __slots__ = ("bounds", "counts", "count", "sum", "_lock")
 
     def __init__(self, bounds=_HIST_BOUNDS):
         self.bounds = tuple(bounds)
         self.counts = [0] * (len(self.bounds) + 1)  # +overflow bucket
         self.count = 0
         self.sum = 0.0
+        self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
         lo, hi = 0, len(self.bounds)
@@ -148,17 +231,17 @@ class Histogram:
                 hi = mid
             else:
                 lo = mid + 1
-        self.counts[lo] += 1
-        self.count += 1
-        self.sum += value
+        with self._lock:
+            self.counts[lo] += 1
+            self.count += 1
+            self.sum += value
 
-    def quantile(self, q: float) -> float:
-        """Estimated q-quantile (0 <= q <= 1); 0.0 when empty."""
-        if self.count == 0:
+    def _quantile_locked(self, q: float, counts, count) -> float:
+        if count == 0:
             return 0.0
-        target = q * self.count
+        target = q * count
         seen = 0
-        for i, c in enumerate(self.counts):
+        for i, c in enumerate(counts):
             if seen + c >= target and c > 0:
                 lo = self.bounds[i - 1] if i > 0 else 0.0
                 hi = (self.bounds[i] if i < len(self.bounds)
@@ -168,13 +251,28 @@ class Histogram:
             seen += c
         return self.bounds[-1] * 2.0
 
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (0 <= q <= 1); 0.0 when empty."""
+        with self._lock:
+            counts, count = list(self.counts), self.count
+        return self._quantile_locked(q, counts, count)
+
     def snapshot(self) -> dict:
+        """One consistent read: count/sum/quantile estimates plus the
+        bucket bounds and CUMULATIVE per-bucket counts (the last entry
+        is the +Inf/overflow bucket and equals ``count``) — the shape
+        the Prometheus exposition format wants."""
+        with self._lock:
+            counts, count, total = list(self.counts), self.count, self.sum
+        cumulative = list(itertools.accumulate(counts))
         return {
-            "count": self.count,
-            "sum": self.sum,
-            "p50": self.quantile(0.50),
-            "p95": self.quantile(0.95),
-            "p99": self.quantile(0.99),
+            "count": count,
+            "sum": total,
+            "p50": self._quantile_locked(0.50, counts, count),
+            "p95": self._quantile_locked(0.95, counts, count),
+            "p99": self._quantile_locked(0.99, counts, count),
+            "bucket_bounds": list(self.bounds),
+            "bucket_counts": cumulative,
         }
 
 
@@ -209,6 +307,223 @@ def reset_histograms(prefix: str = "") -> None:
     with _counters_lock:
         for k in [k for k in _histograms if k.startswith(prefix)]:
             del _histograms[k]
+
+
+# ---------------------------------------------------------------------------
+# request spans — structured host-side stage timing with trace ids
+# ---------------------------------------------------------------------------
+
+_trace_ids = itertools.count(1)
+
+
+def new_trace_id() -> int:
+    """Mint a process-unique trace id (monotonically increasing int).
+    One is stamped on every ``SearchRequest`` at construction and
+    propagated through admission → assembly → execute → split, so a
+    request's whole journey is one grep in the span ring."""
+    return next(_trace_ids)
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    """One completed host-side span.
+
+    ``start``/``end`` are seconds in the *recording clock's* domain —
+    the serving stack records with its injectable clock, so spans from
+    a manual-clock test are exact virtual timestamps, and spans from
+    production overlay the profiler timeline. Zero-duration spans are
+    instant markers (shed/cancel/reject reasons). ``events`` is a
+    tuple of ``(ts, name, attrs)`` marks inside the span."""
+
+    name: str
+    start: float
+    end: float
+    trace_ids: Tuple[int, ...] = ()
+    attrs: Any = dataclasses.field(default_factory=dict)
+    events: tuple = ()
+    tid: int = 0
+
+    @property
+    def duration(self) -> float:
+        return self.end - self.start
+
+
+class SpanRecorder:
+    """Bounded, lock-protected span ring buffer — the flight recorder.
+
+    The ring holds the most recent ``capacity`` spans; overwrites are
+    counted in :attr:`dropped` rather than silently vanishing, so a
+    post-mortem knows whether it is looking at the full story. All
+    mutation is a deque append under one lock: O(1), no allocation
+    beyond the span itself, safe from any thread."""
+
+    def __init__(self, capacity: int = 8192):
+        self._lock = threading.Lock()
+        self._buf: "collections.deque[Span]" = collections.deque(
+            maxlen=max(int(capacity), 1))
+        self._dropped = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._buf.maxlen
+
+    @property
+    def dropped(self) -> int:
+        """Spans overwritten by the ring since the last :meth:`clear`."""
+        with self._lock:
+            return self._dropped
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def record(self, name: str, start: float, end: float, *,
+               trace_ids: Tuple[int, ...] = (), attrs: Optional[dict] = None,
+               events: tuple = ()) -> Span:
+        """Record one completed span (the serving stack's entry point —
+        stages time themselves with their own clock and report here)."""
+        span = Span(name=name, start=start, end=end,
+                    trace_ids=tuple(trace_ids), attrs=dict(attrs or {}),
+                    events=tuple(events),
+                    tid=threading.get_ident())
+        with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self._dropped += 1
+            self._buf.append(span)
+        return span
+
+    def event(self, name: str, ts: float, *,
+              trace_ids: Tuple[int, ...] = (),
+              attrs: Optional[dict] = None) -> Span:
+        """Record an instant marker (zero-duration span) — shed,
+        cancel, and reject reasons land here."""
+        return self.record(name, ts, ts, trace_ids=trace_ids, attrs=attrs)
+
+    def spans(self, trace_id: Optional[int] = None,
+              name: Optional[str] = None) -> list:
+        """Snapshot of recorded spans, oldest first, optionally
+        filtered by ``trace_id`` membership and/or exact ``name``."""
+        with self._lock:
+            out = list(self._buf)
+        if trace_id is not None:
+            out = [s for s in out if trace_id in s.trace_ids]
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._dropped = 0
+
+    # -- Chrome trace-event JSON (Perfetto / chrome://tracing) --------------
+
+    def to_chrome_trace(self, pid: int = 0) -> dict:
+        """Export the ring as a Chrome trace-event JSON object.
+
+        Complete spans become ``"ph": "X"`` duration events (µs
+        timestamps); span events and zero-duration spans additionally
+        emit ``"ph": "i"`` instant marks so reasons are visible on the
+        Perfetto timeline. The precise float seconds ride along in
+        ``args`` (``t0_s``/``t1_s``) because µs conversion is lossy —
+        :meth:`from_chrome_trace` reads those back, making the export
+        a faithful round trip. The reserved arg keys (``trace_ids`` /
+        ``t0_s`` / ``t1_s`` / ``events``) win over same-named span
+        attrs: a colliding attr is shadowed in the export rather than
+        corrupting the rebuilt span's timing."""
+        events = []
+        for s in self.spans():
+            args = dict(s.attrs)
+            args.update({
+                "trace_ids": list(s.trace_ids), "t0_s": s.start,
+                "t1_s": s.end,
+                "events": [[ts, name, dict(attrs)]
+                           for ts, name, attrs in s.events]})
+            events.append({
+                "name": s.name, "ph": "X", "pid": pid, "tid": s.tid,
+                "ts": s.start * 1e6,
+                "dur": max(s.end - s.start, 0.0) * 1e6,
+                "args": args,
+            })
+            for ts, name, attrs in s.events:
+                events.append({
+                    "name": f"{s.name}.{name}", "ph": "i", "s": "t",
+                    "pid": pid, "tid": s.tid, "ts": ts * 1e6,
+                    "args": dict(attrs),
+                })
+            if s.end == s.start:
+                # shed/cancel/reject markers: a dur=0 "X" slice is
+                # invisible in Perfetto, the "i" mark is clickable
+                events.append({
+                    "name": s.name, "ph": "i", "s": "t",
+                    "pid": pid, "tid": s.tid, "ts": s.start * 1e6,
+                    "args": dict(s.attrs),
+                })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    @staticmethod
+    def from_chrome_trace(data: dict) -> list:
+        """Rebuild the span list from :meth:`to_chrome_trace` output —
+        the post-mortem path: load a dumped flight-recorder JSON back
+        into :class:`Span` objects."""
+        out = []
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            args = dict(ev.get("args", {}))
+            trace_ids = tuple(args.pop("trace_ids", ()))
+            start = args.pop("t0_s", ev.get("ts", 0.0) / 1e6)
+            end = args.pop("t1_s",
+                           (ev.get("ts", 0.0) + ev.get("dur", 0.0)) / 1e6)
+            events = tuple((ts, name, dict(attrs))
+                           for ts, name, attrs in args.pop("events", []))
+            out.append(Span(name=ev.get("name", ""), start=start, end=end,
+                            trace_ids=trace_ids, attrs=args, events=events,
+                            tid=ev.get("tid", 0)))
+        return out
+
+
+_span_recorder = SpanRecorder()
+
+
+def span_recorder() -> SpanRecorder:
+    """The process-wide span ring (serving spans land here)."""
+    return _span_recorder
+
+
+def record_span(name: str, start: float, end: float, *,
+                trace_ids: Tuple[int, ...] = (),
+                attrs: Optional[dict] = None,
+                events: tuple = ()) -> Span:
+    """Record into the process-wide ring (see :class:`SpanRecorder`)."""
+    return _span_recorder.record(name, start, end, trace_ids=trace_ids,
+                                 attrs=attrs, events=events)
+
+
+def span_event(name: str, ts: float, *, trace_ids: Tuple[int, ...] = (),
+               attrs: Optional[dict] = None) -> Span:
+    """Instant marker in the process-wide ring."""
+    return _span_recorder.event(name, ts, trace_ids=trace_ids, attrs=attrs)
+
+
+def reset_spans() -> None:
+    """Drop every recorded span — test isolation."""
+    _span_recorder.clear()
+
+
+@contextlib.contextmanager
+def host_span(name: str, *, trace_ids: Tuple[int, ...] = (),
+              attrs: Optional[dict] = None):
+    """Context manager recording a wall-clock host span (build paths,
+    scripts — places with no injectable clock). The serving stack does
+    NOT use this: it records explicit clock-domain timestamps so the
+    manual-clock harness stays deterministic."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_span(name, t0, time.perf_counter(),
+                    trace_ids=trace_ids, attrs=attrs)
 
 
 _compile_listener_installed = False

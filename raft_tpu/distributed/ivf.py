@@ -308,6 +308,23 @@ def collective_payload_model(q: int, k: int, n_probes: int, n_lists: int,
     }
 
 
+def publish_payload_gauges(family: str, model: dict) -> None:
+    """Register one :func:`collective_payload_model` result as live
+    ``serving.collective.*`` gauges — called once per compiled mesh
+    executable by the executor (PR 6 graftscope), so a monitoring
+    scrape sees the modeled per-shard wire bytes next to the achieved
+    bandwidth counters instead of only in offline BENCH JSONs."""
+    from raft_tpu.core import tracing
+
+    base = (f"serving.collective.{family}."
+            f"{model['wire_dtype']}.{model['probe_wire_dtype']}.")
+    tracing.set_gauges({
+        base + "coarse_bytes": float(model["coarse_bytes"]),
+        base + "dense_coarse_bytes": float(model["dense_coarse_bytes"]),
+        base + "merge_bytes": float(model["merge_bytes"]),
+    })
+
+
 def resolve_query_sharding(comms: Comms, queries, query_axis):
     """Shared ``query_axis`` validation + placement for the 2-D
     list×query grids: returns the sharding the replicated-or-sharded

@@ -22,6 +22,10 @@ letting tail latency or overload take the service down:
 - :mod:`~raft_tpu.serving.harness` — fault-injection pieces (manual
   clock, executor shims, bursty open-loop load) the deterministic
   test suite and the bench rider share.
+- :mod:`~raft_tpu.serving.exporter` — :class:`MetricsExporter`: the
+  pull-based observability endpoint (PR 6 graftscope) — Prometheus
+  text exposition, a JSON snapshot, and the span flight recorder as
+  Chrome trace-event JSON for Perfetto overlays.
 
 Works unchanged for single-chip and mesh-sharded (``Distributed*``)
 indexes — the batcher only talks to the executor API.
@@ -29,6 +33,7 @@ indexes — the batcher only talks to the executor API.
 
 from raft_tpu.serving.admission import AdmissionQueue, LoadShed
 from raft_tpu.serving.batcher import BatcherConfig, DynamicBatcher
+from raft_tpu.serving.exporter import MetricsExporter
 from raft_tpu.serving.request import (
     Cancelled,
     DeadlineExceeded,
@@ -46,6 +51,7 @@ __all__ = [
     "DeadlineExceeded",
     "DynamicBatcher",
     "LoadShed",
+    "MetricsExporter",
     "Overloaded",
     "ResultHandle",
     "SearchRequest",

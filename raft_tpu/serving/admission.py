@@ -60,8 +60,25 @@ class LoadShed:
     params_override: Optional[Any] = None
 
 
+# EWMA smoothing for the arrival-rate gauge: each inter-arrival gap
+# contributes 20% — a few bursts move the estimate, one outlier doesn't
+_EWMA_ALPHA = 0.2
+
+# instantaneous-rate floor: two arrivals at the SAME clock tick (bursts
+# under a manual clock) read as one inter-arrival of this, not 1/0
+_MIN_GAP_S = 1e-6
+
+
 class AdmissionQueue:
-    """Bounded, priority + EDF, coalescing-aware request queue."""
+    """Bounded, priority + EDF, coalescing-aware request queue.
+
+    Exports live gauges (PR 6 graftscope): ``serving.admission
+    .queue_depth``, ``.shed_level``, and ``.arrival_rate_hz`` — an
+    EWMA over inter-arrival gaps in the *batcher clock's* domain (the
+    timestamps come in on ``req.arrival``, so the queue itself never
+    reads a clock and the manual-clock harness stays deterministic).
+    The rate gauge is the measurement half of the planned adaptive
+    ``max_wait_s`` control loop."""
 
     def __init__(self, capacity: int = 1024,
                  shed: Optional[LoadShed] = None):
@@ -70,6 +87,8 @@ class AdmissionQueue:
         self._lock = threading.Lock()
         self._groups: Dict[Any, List[SearchRequest]] = {}
         self._n = 0
+        self._rate = 0.0
+        self._last_arrival: Optional[float] = None
 
     # -- state --------------------------------------------------------------
 
@@ -82,9 +101,7 @@ class AdmissionQueue:
         with self._lock:
             return self._n / self.capacity if self.capacity else 1.0
 
-    def shed_level(self) -> int:
-        """Current degradation rung (0–3) from queue occupancy."""
-        occ = self.occupancy()
+    def _level_for(self, occ: float) -> int:
         if occ >= 1.0:
             return 3
         if occ >= self.shed.degrade_params_at:
@@ -93,19 +110,64 @@ class AdmissionQueue:
             return 1
         return 0
 
+    def shed_level(self) -> int:
+        """Current degradation rung (0–3) from queue occupancy."""
+        return self._level_for(self.occupancy())
+
+    def arrival_rate(self) -> float:
+        """EWMA arrival rate (requests/s, clock domain); 0.0 before the
+        second arrival."""
+        with self._lock:
+            return self._rate
+
+    def publish_gauges(self) -> None:
+        """Re-publish the admission gauges from current state — the
+        exporter's scrape-time refresh, so a quiet service (no
+        admission events since the last scrape) still reads current
+        depth, rung, and rate from the one place that defines them."""
+        with self._lock:
+            n, rate = self._n, self._rate
+        self._publish_gauges(n, rate)
+
+    def _publish_gauges(self, n: int, rate: float) -> None:
+        occ = n / self.capacity if self.capacity else 1.0
+        tracing.set_gauges({
+            "serving.admission.queue_depth": float(n),
+            "serving.admission.shed_level": float(self._level_for(occ)),
+            "serving.admission.arrival_rate_hz": rate,
+        })
+
     # -- producer side ------------------------------------------------------
 
     def push(self, req: SearchRequest) -> None:
         """Admit or raise typed :class:`Overloaded` (backpressure)."""
         with self._lock:
+            # arrival-rate EWMA ticks on every offered request —
+            # rejected ones are load too
+            if self._last_arrival is not None:
+                gap = max(req.arrival - self._last_arrival, _MIN_GAP_S)
+                sample = 1.0 / gap
+                self._rate = (_EWMA_ALPHA * sample
+                              + (1.0 - _EWMA_ALPHA) * self._rate
+                              if self._rate else sample)
+            self._last_arrival = req.arrival
+            rate = self._rate
             if self._n >= self.capacity:
                 tracing.inc_counter("serving.admission.rejected")
+                self._publish_gauges(self._n, rate)
+                tracing.span_event(
+                    "serving.rejected", req.arrival,
+                    trace_ids=(req.trace_id,),
+                    attrs={"reason": "queue_full",
+                           "capacity": self.capacity})
                 raise Overloaded(
                     f"admission queue full ({self.capacity} requests); "
                     "retry with backoff or raise capacity")
             self._groups.setdefault(req.compat_key, []).append(req)
             self._n += 1
+            n = self._n
         tracing.inc_counter("serving.admission.accepted")
+        self._publish_gauges(n, rate)
 
     # -- consumer (batcher) side --------------------------------------------
 
@@ -117,6 +179,7 @@ class AdmissionQueue:
         from raft_tpu.serving.request import DeadlineExceeded
 
         shed: List[SearchRequest] = []
+        cancelled: List[SearchRequest] = []
         with self._lock:
             best = None
             for key, group in list(self._groups.items()):
@@ -124,6 +187,7 @@ class AdmissionQueue:
                 for r in group:
                     if r.handle.done():          # cancelled while queued
                         tracing.inc_counter("serving.batcher.cancelled")
+                        cancelled.append(r)
                         continue
                     if r.expired(now):
                         shed.append(r)
@@ -139,20 +203,34 @@ class AdmissionQueue:
                         best = (key, arrival, rows, urgent)
                 else:
                     del self._groups[key]
+            n, rate = self._n, self._rate
+        for r in cancelled:
+            tracing.span_event("serving.cancelled", now,
+                               trace_ids=(r.trace_id,),
+                               attrs={"reason": "cancelled_in_queue"})
         for r in shed:
             if r.handle._set_exception(DeadlineExceeded(
                     f"deadline passed {now - r.deadline:.6f}s before "
                     "dispatch; shed from queue")):
                 tracing.inc_counter("serving.batcher.shed_deadline")
+                tracing.span_event(
+                    "serving.shed", now, trace_ids=(r.trace_id,),
+                    attrs={"reason": "deadline",
+                           "late_s": now - r.deadline})
+        if shed or cancelled:
+            self._publish_gauges(n, rate)
         return best
 
-    def pop_group(self, key, max_rows: int) -> List[SearchRequest]:
+    def pop_group(self, key, max_rows: int,
+                  now: float = 0.0) -> List[SearchRequest]:
         """Claim up to ``max_rows`` query rows from the group, most
         urgent first (EDF within priority). Requests whose handle is no
         longer pending (cancel won the race) are skipped; claimed
         handles transition to *running* atomically, so a later cancel
-        returns False."""
+        returns False. ``now`` only timestamps the cancellation
+        markers in the span recorder."""
         out: List[SearchRequest] = []
+        cancelled: List[SearchRequest] = []
         with self._lock:
             group = self._groups.get(key, [])
             group.sort(key=SearchRequest.order_key)
@@ -165,6 +243,7 @@ class AdmissionQueue:
                 if not r.handle._try_start():
                     self._n -= 1
                     tracing.inc_counter("serving.batcher.cancelled")
+                    cancelled.append(r)
                     continue
                 out.append(r)
                 rows += r.rows
@@ -173,6 +252,12 @@ class AdmissionQueue:
                 self._groups[key] = rest
             else:
                 self._groups.pop(key, None)
+            n, rate = self._n, self._rate
+        for r in cancelled:
+            tracing.span_event("serving.cancelled", now,
+                               trace_ids=(r.trace_id,),
+                               attrs={"reason": "cancelled_at_assembly"})
+        self._publish_gauges(n, rate)
         return out
 
     def drain(self) -> List[SearchRequest]:
@@ -181,4 +266,6 @@ class AdmissionQueue:
             all_reqs = [r for g in self._groups.values() for r in g]
             self._groups.clear()
             self._n = 0
+            rate = self._rate
+        self._publish_gauges(0, rate)
         return all_reqs

@@ -143,10 +143,13 @@ class DynamicBatcher:
                  else self.config.default_timeout_s)
             deadline = now + t if t is not None else None
         shed = self.config.shed
+        degrade_events = ()
         if (shed.params_override is not None
                 and self._queue.shed_level() >= 2):
             params = shed.params_override(params)
             tracing.inc_counter("serving.batcher.shed_degraded_params")
+            degrade_events = ((now, "degraded_params",
+                               {"reason": "shed_rung_2"}),)
         # resolve the filter to its words ONCE (wrapper types carry no
         # row info themselves); the executor's coalesce key validates
         # the plan up front but carries only the filter's spec, so 1-D
@@ -176,6 +179,12 @@ class DynamicBatcher:
                 raise ShutDown("batcher is closed")
             self._queue.push(req)      # typed Overloaded on overflow
             self._cond.notify_all()
+        tracing.record_span(
+            "serving.admission", now, self._clock.now(),
+            trace_ids=(req.trace_id,),
+            attrs={"rows": req.rows, "priority": priority,
+                   "deadline": deadline},
+            events=degrade_events)
         return req.handle
 
     def pump(self) -> int:
@@ -195,16 +204,22 @@ class DynamicBatcher:
         (in-flight batches complete normally); ``drain=False`` fails
         queued requests with typed ``ShutDown``. Idempotent; joins the
         worker thread, so no threads or pending futures leak."""
+        def _shutdown_shed(reqs):
+            now = self._clock.now()
+            for r in reqs:
+                if r.handle._set_exception(
+                        ShutDown("batcher closed before dispatch")):
+                    tracing.inc_counter("serving.batcher.shutdown_shed")
+                    tracing.span_event(
+                        "serving.shed", now, trace_ids=(r.trace_id,),
+                        attrs={"reason": "shutdown"})
+
         with self._cond:
             if self._closing:
                 self._cond.notify_all()
             self._closing = True
             if not drain:
-                for r in self._queue.drain():
-                    if r.handle._set_exception(
-                            ShutDown("batcher closed before dispatch")):
-                        tracing.inc_counter(
-                            "serving.batcher.shutdown_shed")
+                _shutdown_shed(self._queue.drain())
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join()
@@ -213,10 +228,7 @@ class DynamicBatcher:
             self.pump()            # threadless mode drains inline
         # anything left (e.g. raced submits) fails typed rather than
         # hanging its caller forever
-        for r in self._queue.drain():
-            if r.handle._set_exception(
-                    ShutDown("batcher closed before dispatch")):
-                tracing.inc_counter("serving.batcher.shutdown_shed")
+        _shutdown_shed(self._queue.drain())
 
     def __enter__(self):
         return self
@@ -256,7 +268,7 @@ class DynamicBatcher:
             timed_out = now >= arrival + wait
             if full or timed_out or self._closing:
                 reqs = self._queue.pop_group(
-                    key, self.config.full_batch_rows)
+                    key, self.config.full_batch_rows, now)
                 if not reqs:       # cancels won every race — rescan
                     continue
                 return (key, reqs)
@@ -274,8 +286,14 @@ class DynamicBatcher:
                 self._dispatch(*batch)
 
     def _dispatch(self, key, reqs) -> None:
-        """Assemble one micro-batch, execute, split results back."""
+        """Assemble one micro-batch, execute, split results back.
+
+        Each stage records a span into the flight recorder carrying
+        every member request's ``trace_id`` — pure host-side deque
+        appends in the batcher clock's domain, so the device dispatch
+        sequence (and its zero-recompile guarantee) is untouched."""
         t0 = self._clock.now()
+        ids = tuple(r.trace_id for r in reqs)
         for r in reqs:
             metrics.observe_stage(metrics.QUEUE_WAIT, t0 - r.arrival)
         rep = reqs[0]
@@ -293,6 +311,8 @@ class DynamicBatcher:
                 fw = jnp.concatenate([jnp.asarray(p) for p in parts])
         t1 = self._clock.now()
         metrics.observe_stage(metrics.ASSEMBLY, t1 - t0)
+        tracing.record_span("serving.assembly", t0, t1, trace_ids=ids,
+                            attrs={"requests": len(reqs), "rows": n_rows})
         try:
             results = self.executor.search_blocks(
                 rep.index, blocks, rep.k, params=rep.params,
@@ -302,13 +322,25 @@ class DynamicBatcher:
             for r in reqs:
                 r.handle._set_exception(e)
             tracing.inc_counter("serving.batcher.failed_batches")
+            tracing.record_span(
+                "serving.execute", t1, self._clock.now(), trace_ids=ids,
+                attrs={"requests": len(reqs), "rows": n_rows},
+                events=((self._clock.now(), "failed",
+                         {"error": type(e).__name__}),))
             return
         t2 = self._clock.now()
         metrics.observe_stage(metrics.EXECUTE, t2 - t1)
+        tracing.record_span("serving.execute", t1, t2, trace_ids=ids,
+                            attrs={"requests": len(reqs), "rows": n_rows})
         for r, (d, i) in zip(reqs, results):
             r.handle._set_result(d, i)
         t3 = self._clock.now()
         metrics.observe_stage(metrics.SPLIT, t3 - t2)
+        tracing.record_span("serving.split", t2, t3, trace_ids=ids,
+                            attrs={"requests": len(reqs)})
         for r in reqs:
             metrics.observe_stage(metrics.E2E, t3 - r.arrival)
+            tracing.record_span("serving.request", r.arrival, t3,
+                                trace_ids=(r.trace_id,),
+                                attrs={"rows": r.rows})
         metrics.batch_dispatched(len(reqs), n_rows)

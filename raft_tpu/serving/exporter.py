@@ -1,0 +1,228 @@
+"""Pull-based export surface (PR 6 graftscope): one stdlib
+``http.server`` port serving every observability signal in the process.
+
+Endpoints:
+
+- ``/metrics`` — Prometheus text exposition (version 0.0.4): every
+  :mod:`raft_tpu.core.tracing` counter and gauge, plus the latency
+  histograms with CUMULATIVE bucket counts (``*_bucket{le="..."}`` /
+  ``*_sum`` / ``*_count``) — scrapeable by any Prometheus-compatible
+  agent. Metric names are the registry names with non-identifier
+  characters folded to ``_`` (``serving.batcher.e2e_seconds`` →
+  ``serving_batcher_e2e_seconds``).
+- ``/snapshot.json`` — the JSON view: ``serving.metrics.snapshot()``
+  (counters, gauges, histograms, occupancy, derived achieved GB/s),
+  the attached executor's per-executable cost table, the attached
+  batcher's degradation-ladder rung, and flight-recorder stats.
+- ``/trace.json`` — the span ring as Chrome trace-event JSON; load it
+  into Perfetto next to a ``jax.profiler`` capture to overlay host
+  stage spans on the device timeline.
+- ``/healthz`` — liveness probe.
+
+The exporter holds NO state of its own: every request re-reads the
+live registries, so a scrape is always current and costs the serving
+path nothing (the registries are the same dicts the hot path already
+writes; the scrape takes the same short locks any reader takes). The
+server runs on a daemon thread; ``port=0`` binds an ephemeral port
+(tests), a fixed port is the production deployment.
+
+Example::
+
+    exp = MetricsExporter(executor=ex, batcher=b)
+    port = exp.start()
+    # curl http://127.0.0.1:<port>/metrics
+    exp.close()
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import re
+import threading
+from typing import Optional
+
+from raft_tpu.core import tracing
+from raft_tpu.serving import metrics as serving_metrics
+
+_NAME_SUB = re.compile(r"[^a-zA-Z0-9_:]").sub
+
+
+def prom_name(name: str) -> str:
+    """Registry name → valid Prometheus metric name."""
+    out = _NAME_SUB("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    """Shortest float text that round-trips (Prometheus accepts
+    scientific notation); integral values render as integers."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_prometheus(counters: dict, gauges: dict,
+                      histograms: dict) -> str:
+    """Render registry snapshots as Prometheus text exposition.
+
+    ``histograms`` maps name → :meth:`Histogram.snapshot` dicts (the
+    PR 6 shape with ``bucket_bounds`` + cumulative ``bucket_counts``;
+    the final overflow bucket becomes ``le="+Inf"``)."""
+    lines = []
+    for name in sorted(counters):
+        pn = prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_fmt(counters[name])}")
+    for name in sorted(gauges):
+        pn = prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_fmt(gauges[name])}")
+    for name in sorted(histograms):
+        snap = histograms[name]
+        pn = prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        bounds = snap.get("bucket_bounds", [])
+        cumulative = snap.get("bucket_counts", [])
+        for le, c in zip(bounds, cumulative):
+            lines.append(f'{pn}_bucket{{le="{_fmt(le)}"}} {c}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {snap["count"]}')
+        lines.append(f"{pn}_sum {_fmt(snap['sum'])}")
+        lines.append(f"{pn}_count {snap['count']}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricsExporter:
+    """HTTP pull endpoint for the whole observability surface.
+
+    ``executor`` (optional) contributes its per-executable cost table
+    to ``/snapshot.json``; ``batcher`` (optional) contributes the live
+    degradation rung and queue depth (polled at scrape time, so the
+    rung is current even while the event-driven gauges are quiet)."""
+
+    def __init__(self, executor=None, batcher=None, *,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.executor = executor
+        self.batcher = batcher
+        self.host = host
+        self.port = port
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- payloads (usable without the HTTP server, e.g. in tests) -----------
+
+    def prometheus_text(self) -> str:
+        """The ``/metrics`` body: full registries, freshly read."""
+        self._refresh()
+        return render_prometheus(tracing.counters(), tracing.gauges(),
+                                 tracing.histograms())
+
+    def snapshot(self) -> dict:
+        """The ``/snapshot.json`` body."""
+        self._refresh()
+        out = dict(serving_metrics.snapshot())
+        out["xla"] = tracing.counters("xla.")
+        if self.executor is not None and hasattr(self.executor,
+                                                 "executable_costs"):
+            out["executables"] = self.executor.executable_costs()
+        if self.batcher is not None:
+            q = self.batcher._queue
+            out["admission"] = {
+                "queue_depth": len(q),
+                "shed_level": q.shed_level(),
+                "arrival_rate_hz": q.arrival_rate(),
+            }
+        rec = tracing.span_recorder()
+        out["spans"] = {"recorded": len(rec), "dropped": rec.dropped,
+                        "capacity": rec.capacity}
+        return out
+
+    def chrome_trace(self) -> dict:
+        """The ``/trace.json`` body (Perfetto overlay input)."""
+        return tracing.span_recorder().to_chrome_trace()
+
+    def _refresh(self) -> None:
+        """Re-publish the poll-style gauges from the attached executor
+        and batcher so a scrape of a quiet service (or one taken after
+        ``metrics.reset()``) still reads current state. Both delegate
+        to the owning object — the gauge names and derivations live in
+        one place each."""
+        if self.executor is not None and hasattr(self.executor,
+                                                 "publish_cost_gauges"):
+            self.executor.publish_cost_gauges()
+        if self.batcher is not None:
+            self.batcher._queue.publish_gauges()
+
+    # -- server lifecycle ---------------------------------------------------
+
+    def start(self) -> int:
+        """Bind and serve on a daemon thread; returns the bound port."""
+        if self._server is not None:
+            return self.port
+        exporter = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            # the serving process logs through its own logger; default
+            # BaseHTTPRequestHandler stderr chatter is noise
+            def log_message(self, fmt, *args):  # noqa: ARG002
+                pass
+
+            def _send(self, body: bytes, ctype: str, code: int = 200):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._send(exporter.prometheus_text().encode(),
+                               "text/plain; version=0.0.4; "
+                               "charset=utf-8")
+                elif path == "/snapshot.json":
+                    self._send(
+                        json.dumps(exporter.snapshot(),
+                                   default=str).encode(),
+                        "application/json")
+                elif path == "/trace.json":
+                    self._send(json.dumps(exporter.chrome_trace()).encode(),
+                               "application/json")
+                elif path == "/healthz":
+                    self._send(b"ok\n", "text/plain")
+                else:
+                    self._send(b"not found\n", "text/plain", 404)
+
+        self._server = http.server.ThreadingHTTPServer(
+            (self.host, self.port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="raft-tpu-metrics-exporter", daemon=True)
+        self._thread.start()
+        return self.port
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self) -> None:
+        """Stop serving and join the server thread (idempotent)."""
+        if self._server is None:
+            return
+        self._server.shutdown()
+        self._server.server_close()
+        self._server = None
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

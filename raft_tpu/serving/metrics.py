@@ -17,10 +17,27 @@ Per-stage latency **histograms** (log2 buckets, p50/p95/p99 estimates):
 - ``serving.batcher.shed_deadline``               — expired → shed
 - ``serving.batcher.cancelled``                   — cancelled in queue
 - ``serving.batcher.shutdown_shed``               — shed at close()
+- ``serving.execute.calls`` / ``.rows`` /
+  ``.modeled_flops`` / ``.modeled_bytes``         — executor dispatches
+  priced by each executable's compile-time ``cost_analysis()``
+
+**Gauges** (PR 6 graftscope):
+
+- ``serving.admission.queue_depth`` / ``.shed_level`` /
+  ``.arrival_rate_hz``                            — admission state
+- ``serving.executable.<digest>.flops`` /
+  ``.bytes_accessed`` / ``.peak_hbm_bytes``       — per-executable cost
+- ``serving.executor.cached_executables``         — AOT cache size
+- ``serving.collective.<family>.<wire>.<probe_wire>.*_bytes``
+                                                  — modeled mesh wire
 
 Batch **occupancy** — the coalescing win the ISSUE's acceptance
 criterion gates on — is derived, not stored: ``requests / batches``
-(and ``rows / batches``) from one counters snapshot.
+(and ``rows / batches``) from one counters snapshot. Likewise the
+**achieved-bandwidth** numbers (:func:`derived`): modeled bytes/flops
+over the measured execute-latency sum — the TPU-KNN roofline
+accounting as a running metric, from the same inputs the BENCH rider
+reports — plus the executor cache hit-rate.
 """
 
 from __future__ import annotations
@@ -63,18 +80,47 @@ def occupancy() -> dict:
     }
 
 
+def derived() -> dict:
+    """Metrics computed from one counters read: executor cache
+    hit-rate and live achieved GB/s / GFLOP/s (modeled bytes & flops
+    from compile-time cost analysis, divided by the measured execute
+    histogram's latency sum)."""
+    hits = tracing.get_counter("serving.cache_hits")
+    misses = tracing.get_counter("serving.cache_misses")
+    exec_s = tracing.get_histogram(EXECUTE).snapshot()["sum"]
+    out = {
+        "cache_hit_rate": hits / (hits + misses) if hits + misses else 0.0,
+        "execute_seconds_total": exec_s,
+        "modeled_bytes_total":
+            tracing.get_counter("serving.execute.modeled_bytes"),
+        "modeled_flops_total":
+            tracing.get_counter("serving.execute.modeled_flops"),
+    }
+    out["achieved_gbps"] = (
+        out["modeled_bytes_total"] / exec_s / 1e9 if exec_s > 0 else 0.0)
+    out["achieved_gflops"] = (
+        out["modeled_flops_total"] / exec_s / 1e9 if exec_s > 0 else 0.0)
+    return out
+
+
 def snapshot() -> dict:
-    """One scrape of the whole serving surface: counters + per-stage
-    histogram summaries + derived occupancy (the bench rider's and any
-    monitoring agent's single entry point)."""
+    """One scrape of the whole serving surface: counters + gauges +
+    per-stage histogram summaries + derived occupancy and achieved
+    bandwidth (the bench rider's, the exporter's, and any monitoring
+    agent's single entry point)."""
     return {
         "counters": tracing.counters("serving."),
+        "gauges": tracing.gauges("serving."),
         "histograms": tracing.histograms(PREFIX),
         "occupancy": occupancy(),
+        "derived": derived(),
     }
 
 
 def reset() -> None:
-    """Zero every serving counter and histogram — test/bench isolation."""
+    """Zero every serving counter, gauge, histogram, and the span
+    flight recorder — test/bench isolation."""
     tracing.reset_counters("serving.")
+    tracing.reset_gauges("serving.")
     tracing.reset_histograms(PREFIX)
+    tracing.reset_spans()

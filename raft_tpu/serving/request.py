@@ -20,6 +20,8 @@ import itertools
 import threading
 from typing import Any, Optional, Tuple
 
+from raft_tpu.core import tracing
+
 
 class ServingError(RuntimeError):
     """Base class of every typed serving-frontend failure."""
@@ -147,7 +149,12 @@ class SearchRequest:
     ``deadline`` is absolute, in the batcher clock's domain
     (``clock.now()``-relative); ``None`` means no deadline. Lower
     ``priority`` values are served first; within a priority class the
-    queue is earliest-deadline-first, then FIFO by ``seq``."""
+    queue is earliest-deadline-first, then FIFO by ``seq``.
+
+    ``trace_id`` is minted at construction (PR 6 graftscope) and rides
+    every stage span the request touches — admission, assembly,
+    execute, split, and any shed/cancel marker — so one id pulls the
+    request's whole journey out of the span flight recorder."""
 
     index: Any
     queries: Any                      # (m, dim) host array
@@ -162,6 +169,7 @@ class SearchRequest:
     compat_key: Any = None
     arrival: float = 0.0
     seq: int = dataclasses.field(default_factory=lambda: next(_seq))
+    trace_id: int = dataclasses.field(default_factory=tracing.new_trace_id)
 
     @property
     def rows(self) -> int:

@@ -42,3 +42,31 @@ def res():
     from raft_tpu import Resources
 
     return Resources(seed=42)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Drop a metrics-snapshot artifact after the run when CI asks
+    (``RAFT_TPU_METRICS_SNAPSHOT=<path>``, set by ``ci/test.sh``): the
+    full tracing registries — counters, gauges, histogram summaries
+    with cumulative buckets, span-ring stats — accumulated over the
+    test session. A CI browser then sees the same accounting a live
+    ``/metrics`` scrape would show, next to the bench JSONs."""
+    path = os.environ.get("RAFT_TPU_METRICS_SNAPSHOT")
+    if not path:
+        return
+    import json
+
+    from raft_tpu.core import tracing
+
+    rec = tracing.span_recorder()
+    snap = {
+        "exit_status": int(exitstatus),
+        "counters": tracing.counters(),
+        "gauges": tracing.gauges(),
+        "histograms": tracing.histograms(),
+        "spans": {"recorded": len(rec), "dropped": rec.dropped,
+                  "capacity": rec.capacity},
+    }
+    with open(path, "w") as f:
+        json.dump(snap, f, indent=2, sort_keys=True, default=str)
+        f.write("\n")

@@ -284,6 +284,59 @@ def admit(executor, index, k, kw, handle):
     return SearchRequest(compat_key=compat_key, handle=handle)
 '''
 
+R7_SERVING_VIOLATING = '''\
+import time
+
+
+def pick_deadline(timeout_s):
+    return time.monotonic() + timeout_s
+
+
+def stamp():
+    return time.time()
+'''
+R7_SERVING_CONFORMING = '''\
+import time
+
+
+class MonotonicClock:
+    def now(self):
+        return time.monotonic()
+
+
+class WallClock:
+    def now(self):
+        return time.time()
+
+
+def pick_deadline(clock, timeout_s):
+    return clock.now() + timeout_s
+
+
+def nap(delay_s):
+    time.sleep(delay_s)    # sleeping reads no clock
+'''
+R7_BARE_IMPORT_VIOLATING = '''\
+from time import monotonic
+
+
+def stamp():
+    return monotonic()
+'''
+R7_EVASION_VIOLATING = '''\
+import time as t
+from time import time
+from time import perf_counter as pc
+
+
+def three_ways():
+    return t.monotonic() + time() + pc()
+'''
+R7_LOCAL_NAME_CONFORMING = '''\
+def use_local(time, monotonic):
+    return time() + monotonic()    # locals, not the time module
+'''
+
 R6_OPS_VIOLATING = '''\
 from jax.experimental import pallas as pl
 
@@ -402,6 +455,31 @@ class TestFixtureCorpus:
         assert "unhashable" in msgs and "float()" in msgs, msgs
         assert lint_lib(R1_SERVING_KEY_CONFORMING, ["R1"],
                         rel="raft_tpu/serving/sample.py").ok
+
+    def test_r7_clock_discipline(self):
+        bad = lint_lib(R7_SERVING_VIOLATING, ["R7"],
+                       rel="raft_tpu/serving/sample.py")
+        assert rules_fired(bad) == {"R7"}
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "time.monotonic" in msgs and "time.time" in msgs, msgs
+        assert "injectable clock" in msgs
+        assert lint_lib(R7_SERVING_CONFORMING, ["R7"],
+                        rel="raft_tpu/serving/sample.py").ok
+        # from-imports of clock functions are still clock reads
+        bad = lint_lib(R7_BARE_IMPORT_VIOLATING, ["R7"],
+                       rel="raft_tpu/serving/sample.py")
+        assert rules_fired(bad) == {"R7"}
+        # evasion routes: aliased module, `from time import time`,
+        # aliased from-import — all three fire
+        bad = lint_lib(R7_EVASION_VIOLATING, ["R7"],
+                       rel="raft_tpu/serving/sample.py")
+        assert len(bad.findings) == 3, [f.render() for f in bad.findings]
+        # a local variable that happens to be named `time` stays exempt
+        assert lint_lib(R7_LOCAL_NAME_CONFORMING, ["R7"],
+                        rel="raft_tpu/serving/sample.py").ok
+        # the same sources outside raft_tpu/serving/ stay quiet
+        assert lint_lib(R7_SERVING_VIOLATING, ["R7"],
+                        rel="raft_tpu/ops/sample.py").ok
 
     def test_r6(self):
         bad = lint_texts({"raft_tpu/ops/sample.py": R6_OPS_VIOLATING},
@@ -572,7 +650,7 @@ class TestRepoWide:
 
     def test_registry_is_complete(self):
         assert sorted(RULES) == ["R0", "R1", "R2", "R3", "R4", "R5",
-                                 "R6"]
+                                 "R6", "R7"]
 
     def test_repo_lints_clean(self, report):
         assert report.ok, "\n" + "\n".join(

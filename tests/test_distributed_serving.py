@@ -405,3 +405,37 @@ class TestStreamedBuildDeal:
         _, i = dist_ivf.search(None, sp, index, q, 1)
         np.testing.assert_array_equal(np.asarray(i)[:, 0],
                                       np.arange(4))
+
+
+class TestPayloadGauges:
+    """graftscope (PR 6): compiling a mesh executable publishes its
+    modeled collective payload as live gauges — the same accounting
+    the bench rider emits, scrapeable while serving."""
+
+    def test_mesh_compile_publishes_collective_gauges(self, data,
+                                                      flat_pair):
+        _, q = data
+        _, dist = flat_pair
+        tracing.reset_gauges("serving.collective.")
+        sp = IvfFlatSearchParams(n_probes=8)
+        ex = SearchExecutor()
+        ex.search(dist, q, 5, params=sp, wire_dtype="bf16")
+        got = tracing.gauges("serving.collective.dist_ivf_flat.bf16.f32.")
+        assert set(n.rsplit(".", 1)[1] for n in got) == {
+            "coarse_bytes", "dense_coarse_bytes", "merge_bytes"}
+        model = dist_ivf.collective_payload_model(
+            16, 5, 8, dist.n_lists, N_DEV, "bf16")
+        base = "serving.collective.dist_ivf_flat.bf16.f32."
+        assert got[base + "merge_bytes"] == model["merge_bytes"]
+        assert got[base + "coarse_bytes"] == model["coarse_bytes"]
+        # the executor's cost table carries the same model per entry
+        (info,) = ex.executable_costs().values()
+        assert info["collective_payload"]["merge_bytes"] == (
+            model["merge_bytes"])
+        # a gauge wipe (metrics.reset) heals at scrape time: the
+        # resident mesh entry re-publishes its collective gauges too
+        tracing.reset_gauges("serving.")
+        assert tracing.gauges(base) == {}
+        ex.publish_cost_gauges()
+        assert tracing.gauges(base)[base + "merge_bytes"] == (
+            model["merge_bytes"])

@@ -223,3 +223,81 @@ class TestCacheAndState:
         ex = SearchExecutor()
         with pytest.raises(TypeError):
             ex.search(object(), np.zeros((2, 4), np.float32), 1)
+
+
+class TestCostIntrospection:
+    """graftscope (PR 6): compile-time cost_analysis/memory_analysis
+    capture, per-executable gauges, and the modeled-work counters the
+    live achieved-GB/s derivation stands on."""
+
+    def test_cost_table_and_gauges_after_compile(self, data, indexes):
+        _, q = data
+        ex = SearchExecutor()
+        ex.search(indexes["ivf_flat"], q, 5,
+                  params=ivf_flat.IvfFlatSearchParams(n_probes=4))
+        costs = ex.executable_costs()
+        assert len(costs) == 1
+        digest, info = next(iter(costs.items()))
+        assert info["family"] == "ivf_flat"
+        assert info["bucket"] == 16 and info["k"] == 5
+        assert info["bytes_accessed"] > 0
+        assert info["peak_hbm_bytes"] > 0
+        assert info["compile_seconds"] > 0
+        base = f"serving.executable.{digest}."
+        assert tracing.get_gauge(base + "bytes_accessed") == (
+            info["bytes_accessed"])
+        assert tracing.get_gauge(base + "peak_hbm_bytes") == (
+            info["peak_hbm_bytes"])
+        assert tracing.get_gauge(
+            "serving.executor.cached_executables") == 1.0
+
+    def test_modeled_counters_advance_per_call_not_per_compile(
+            self, data, indexes):
+        _, q = data
+        ex = SearchExecutor()
+        tracing.reset_counters("serving.execute.")
+        ex.warmup(indexes["brute_force"], buckets=(16,), k=5)
+        # warmup compiles but dispatches nothing
+        assert tracing.get_counter("serving.execute.calls") == 0
+        ex.search(indexes["brute_force"], q, 5)
+        ex.search(indexes["brute_force"], q, 5)
+        assert tracing.get_counter("serving.execute.calls") == 2
+        per_call = ex.executable_costs()
+        (info,) = per_call.values()
+        assert tracing.get_counter(
+            "serving.execute.modeled_bytes") == pytest.approx(
+                2 * info["bytes_accessed"])
+        assert tracing.get_counter(
+            "serving.execute.rows") == 2 * q.shape[0]
+
+    def test_publish_cost_gauges_survives_gauge_reset(self, data, indexes):
+        """``metrics.reset()`` clears the serving gauge namespace while
+        the AOT cache keeps its executables; ``publish_cost_gauges()``
+        (the exporter's scrape-time refresh) restores the per-executable
+        gauges so /metrics and executable_costs() agree again."""
+        _, q = data
+        ex = SearchExecutor()
+        ex.search(indexes["brute_force"], q, 5)
+        (digest,) = ex.executable_costs()
+        base = f"serving.executable.{digest}."
+        tracing.reset_gauges("serving.")
+        assert tracing.gauges(base) == {}
+        ex.publish_cost_gauges()
+        info = ex.executable_costs()[digest]
+        assert tracing.get_gauge(base + "bytes_accessed") == (
+            info["bytes_accessed"])
+        assert tracing.get_gauge(
+            "serving.executor.cached_executables") == 1.0
+
+    def test_eviction_retires_cost_gauges(self, data, indexes):
+        _, q = data
+        ex = SearchExecutor(max_entries=1)
+        ex.search(indexes["brute_force"], q, 5)
+        first = set(ex.executable_costs())
+        ex.search(indexes["brute_force"], q, 7)   # evicts k=5 entry
+        second = set(ex.executable_costs())
+        assert len(second) == 1 and first != second
+        gone = first.pop()
+        assert tracing.gauges(f"serving.executable.{gone}.") == {}
+        assert tracing.get_gauge(
+            f"serving.executable.{second.pop()}.bytes_accessed") > 0

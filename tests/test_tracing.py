@@ -1,0 +1,223 @@
+"""The graftscope observability core (``core/tracing.py``): histogram
+thread safety + cumulative buckets, gauges, and the span flight
+recorder with its Chrome trace-event round trip."""
+
+import json
+import threading
+
+import pytest
+
+from raft_tpu.core import tracing
+
+
+class TestHistogramConcurrency:
+    def test_concurrent_observe_loses_nothing(self):
+        """PR 5's ``get_histogram`` handed out live objects whose
+        ``observe`` ran unlocked — racing increments could drop
+        counts. Hammer one instance from many threads and assert
+        exact totals."""
+        h = tracing.Histogram()
+        n_threads, per_thread = 8, 5000
+        start = threading.Barrier(n_threads)
+
+        def worker(seed):
+            start.wait()
+            for i in range(per_thread):
+                h.observe(1e-6 * ((seed + i) % 50 + 1))
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = h.snapshot()
+        assert snap["count"] == n_threads * per_thread
+        assert sum(h.counts) == n_threads * per_thread
+        assert snap["bucket_counts"][-1] == n_threads * per_thread
+
+    def test_concurrent_snapshot_is_consistent(self):
+        """A snapshot taken mid-storm must be internally consistent:
+        its cumulative bucket total equals its count."""
+        h = tracing.Histogram()
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                h.observe(1e-6 * (i % 30 + 1))
+                i += 1
+
+        w = threading.Thread(target=writer)
+        w.start()
+        try:
+            for _ in range(200):
+                snap = h.snapshot()
+                assert snap["bucket_counts"][-1] == snap["count"]
+        finally:
+            stop.set()
+            w.join()
+
+
+class TestHistogramBuckets:
+    def test_cumulative_buckets_shape_and_monotonicity(self):
+        h = tracing.Histogram()
+        for v in (0.5e-6, 3e-6, 3e-6, 1.0):
+            h.observe(v)
+        snap = h.snapshot()
+        bounds, cum = snap["bucket_bounds"], snap["bucket_counts"]
+        assert len(cum) == len(bounds) + 1      # +Inf overflow bucket
+        assert cum == sorted(cum)               # cumulative => monotone
+        assert cum[-1] == snap["count"] == 4
+        # first bucket (le 1e-6) holds exactly the 0.5 µs observation
+        assert cum[0] == 1
+
+    def test_empty_histogram(self):
+        h = tracing.Histogram()
+        snap = h.snapshot()
+        assert snap["count"] == 0 and snap["sum"] == 0.0
+        assert h.quantile(0.5) == 0.0
+        assert snap["p50"] == snap["p95"] == snap["p99"] == 0.0
+        assert snap["bucket_counts"][-1] == 0
+
+    def test_single_observation_quantile(self):
+        """Every quantile of a single observation lands inside that
+        observation's bucket (linear interpolation within it)."""
+        h = tracing.Histogram()
+        h.observe(5e-6)                          # bucket (4e-6, 8e-6]
+        for q in (0.01, 0.5, 0.99):
+            assert 4e-6 < h.quantile(q) <= 8e-6, q
+
+    def test_overflow_bucket_estimate(self):
+        """Observations past the last bound interpolate inside the
+        synthetic overflow bucket (last bound, 2 × last bound] — a
+        bounded estimate, not garbage — and q→1 hits the 2× cap."""
+        h = tracing.Histogram()
+        h.observe(1e9)
+        top = h.bounds[-1]
+        assert top < h.quantile(0.5) <= 2.0 * top
+        assert h.quantile(1.0) == pytest.approx(2.0 * top)
+        snap = h.snapshot()
+        assert snap["bucket_counts"][-2] == 0    # nothing below +Inf
+        assert snap["bucket_counts"][-1] == 1
+
+
+class TestGauges:
+    def test_set_get_prefix_reset(self):
+        tracing.reset_gauges("t_gauge.")
+        tracing.set_gauge("t_gauge.a", 3.0)
+        tracing.set_gauge("t_gauge.a", 1.5)      # last write wins
+        tracing.set_gauges({"t_gauge.b": 2.0, "other.c": 7.0})
+        try:
+            assert tracing.get_gauge("t_gauge.a") == 1.5
+            assert tracing.get_gauge("t_gauge.missing", -1.0) == -1.0
+            assert tracing.gauges("t_gauge.") == {"t_gauge.a": 1.5,
+                                                  "t_gauge.b": 2.0}
+            tracing.reset_gauges("t_gauge.")
+            assert tracing.gauges("t_gauge.") == {}
+            assert tracing.get_gauge("other.c") == 7.0
+        finally:
+            tracing.reset_gauges("t_gauge.")
+            tracing.reset_gauges("other.c")
+
+    def test_inc_counters_batch(self):
+        tracing.reset_counters("t_batch.")
+        try:
+            tracing.inc_counters({"t_batch.x": 2.0, "t_batch.y": 1.0})
+            tracing.inc_counters({"t_batch.x": 3.0})
+            assert tracing.get_counter("t_batch.x") == 5.0
+            assert tracing.get_counter("t_batch.y") == 1.0
+        finally:
+            tracing.reset_counters("t_batch.")
+
+
+class TestSpanRecorder:
+    def test_record_filter_and_trace_ids(self):
+        r = tracing.SpanRecorder(capacity=16)
+        a, b = tracing.new_trace_id(), tracing.new_trace_id()
+        assert a != b
+        r.record("stage.one", 0.0, 1.0, trace_ids=(a,))
+        r.record("stage.two", 1.0, 2.0, trace_ids=(a, b))
+        r.event("mark", 1.5, trace_ids=(b,), attrs={"reason": "x"})
+        assert len(r) == 3
+        assert [s.name for s in r.spans(trace_id=a)] == ["stage.one",
+                                                         "stage.two"]
+        only_b = r.spans(trace_id=b)
+        assert [s.name for s in only_b] == ["stage.two", "mark"]
+        assert r.spans(name="mark")[0].duration == 0.0
+        assert r.spans(name="mark")[0].attrs["reason"] == "x"
+
+    def test_ring_bounds_and_drop_accounting(self):
+        """The flight recorder is bounded: old spans fall off, and the
+        overwrite count is visible (a post-mortem must know whether it
+        sees the whole story)."""
+        r = tracing.SpanRecorder(capacity=4)
+        for i in range(10):
+            r.record(f"s{i}", float(i), float(i) + 0.5)
+        assert len(r) == 4
+        assert r.dropped == 6
+        assert [s.name for s in r.spans()] == ["s6", "s7", "s8", "s9"]
+        r.clear()
+        assert len(r) == 0 and r.dropped == 0
+
+    def test_chrome_trace_round_trip(self):
+        """Export → json.dumps → json.loads → import reproduces the
+        exact span list (timestamps ride in args as float seconds, so
+        µs conversion lossiness cannot corrupt a post-mortem)."""
+        r = tracing.SpanRecorder(capacity=8)
+        tid = tracing.new_trace_id()
+        r.record("serving.execute", 0.1, 0.25, trace_ids=(tid,),
+                 attrs={"rows": 17},
+                 events=((0.2, "failed", {"error": "ValueError"}),))
+        r.event("serving.shed", 0.3, trace_ids=(tid,),
+                attrs={"reason": "deadline"})
+        data = json.loads(json.dumps(r.to_chrome_trace()))
+        assert data["traceEvents"], data
+        xs = [e for e in data["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"serving.execute",
+                                           "serving.shed"}
+        # event marks surface as instant events for Perfetto
+        instants = [e for e in data["traceEvents"] if e["ph"] == "i"]
+        assert any(e["name"] == "serving.execute.failed"
+                   for e in instants)
+        # zero-duration spans (shed/cancel/reject reasons) surface as
+        # clickable instant marks too, not just invisible dur=0 slices
+        shed_marks = [e for e in instants if e["name"] == "serving.shed"]
+        assert shed_marks and shed_marks[0]["args"]["reason"] == "deadline"
+        back = tracing.SpanRecorder.from_chrome_trace(data)
+        assert back == r.spans()
+
+    def test_chrome_trace_reserved_keys_win_over_attrs(self):
+        """A span attr named like a reserved arg key (``t0_s`` etc.)
+        must not corrupt the export: the reserved keys win, so the
+        rebuilt span keeps exact timing/ids and only the colliding
+        attr itself is shadowed."""
+        r = tracing.SpanRecorder(capacity=4)
+        r.record("x", 1.0, 2.0, trace_ids=(7,),
+                 attrs={"t0_s": "label", "trace_ids": "oops", "rows": 3})
+        (back,) = tracing.SpanRecorder.from_chrome_trace(
+            json.loads(json.dumps(r.to_chrome_trace())))
+        assert (back.start, back.end) == (1.0, 2.0)
+        assert back.trace_ids == (7,)
+        assert back.attrs == {"rows": 3}
+
+    def test_process_ring_helpers(self):
+        tracing.reset_spans()
+        try:
+            tid = tracing.new_trace_id()
+            tracing.record_span("stage", 1.0, 2.0, trace_ids=(tid,))
+            tracing.span_event("mark", 1.5, trace_ids=(tid,))
+            assert len(tracing.span_recorder().spans(trace_id=tid)) == 2
+        finally:
+            tracing.reset_spans()
+
+    def test_host_span_context_manager(self):
+        tracing.reset_spans()
+        try:
+            with tracing.host_span("build.extend", attrs={"n": 3}):
+                pass
+            (s,) = tracing.span_recorder().spans(name="build.extend")
+            assert s.end >= s.start
+            assert s.attrs == {"n": 3}
+        finally:
+            tracing.reset_spans()

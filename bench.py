@@ -871,6 +871,8 @@ def _serving_rider():
     e2e = snap["histograms"].get(sv_metrics.E2E, {})
     shed = snap["counters"].get("serving.batcher.shed_deadline", 0)
     rej = snap["counters"].get("serving.admission.rejected", 0)
+    slo_ok = snap["counters"].get(sv_metrics.SLO_ATTAINED, 0)
+    slo_miss = snap["counters"].get(sv_metrics.SLO_MISSED, 0)
     der = snap["derived"]
 
     # roofline: a pure streamed read of the packed list tensor — the
@@ -901,6 +903,11 @@ def _serving_rider():
         "p99_ms": round(e2e.get("p99", 0) * 1e3, 3),
         "shed_rate": round(shed / max(len(handles), 1), 4),
         "reject_rate": round(rej / max(len(handles), 1), 4),
+        # graftscope v2: deadline-SLO attainment over the same stream
+        "slo_attained": int(slo_ok),
+        "slo_missed": int(slo_miss),
+        "slo_burn_rate": round(
+            tracing.get_gauge(sv_metrics.SLO_BURN_RATE), 4),
         "requests_per_batch": round(occ["requests_per_batch"], 2),
         "rows_per_batch": round(occ["rows_per_batch"], 2),
         "backend_compiles_during_load": (

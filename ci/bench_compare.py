@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""CI perf-regression gate (graftscope v2) — diff a fresh
+``BENCH_SERVING`` run against the committed baseline with tolerance
+bands, and sanity-check the test session's ``ci/metrics_snapshot.json``
+modeled-throughput columns.
+
+Why: PRs 1–6 built the serving hot path and the instrumentation that
+prices it, but nothing *gated* on the numbers — a PR could halve
+steady-state QPS or silently stop pricing dispatches and CI would stay
+green. This script closes that loop:
+
+1. **Bench diff** — replay the baseline's pinned small-config bench
+   (``BENCH_CHILD=1``, CPU, seconds-scale) and compare the recorded
+   columns against ``ci/bench_baseline.json``. Bands are wide where CI
+   machines are noisy (wall-clock QPS/p99) and tight where the quantity
+   is structural (batch occupancy, backend compiles during load —
+   a recompiling steady state is a bug regardless of wall clock).
+2. **Snapshot floors** — the metrics snapshot the test session drops
+   must still carry live modeled-throughput accounting
+   (``serving.execute.modeled_{bytes,flops}`` > 0): if a refactor
+   disconnects cost introspection from the dispatch path, every
+   achieved-GB/s surface goes dark while looking "green"; this catches
+   it structurally.
+
+Exit codes: 0 pass, 1 regression (messages on stderr), 2 usage/missing
+inputs. Re-baseline deliberately with ``--update`` (writes the fresh
+record + current default tolerances back to the baseline file) — the
+diff then shows reviewers exactly what moved.
+
+Usage (what ``ci/test.sh`` runs)::
+
+    python ci/bench_compare.py --run --snapshot ci/metrics_snapshot.json
+    python ci/bench_compare.py --run --update        # re-baseline
+    python ci/bench_compare.py --fresh some_run.json  # offline diff
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE_PATH = os.path.join(REPO, "ci", "bench_baseline.json")
+
+# The pinned replay config: small enough for seconds-scale CI on CPU,
+# big enough that the serving rider coalesces real micro-batches. It is
+# recorded into the baseline and replayed from there on compare runs,
+# so baseline and fresh always measure the same problem.
+PINNED_ENV = {
+    "BENCH_CHILD": "1",
+    "JAX_PLATFORMS": "cpu",
+    "BENCH_N": "20000",
+    "BENCH_DIM": "64",
+    "BENCH_BATCH": "10",
+    "BENCH_K": "10",
+    "BENCH_SECONDS": "3",
+    "BENCH_DTYPE": "float32",
+    "BENCH_SERVING": "1",
+    "BENCH_SV_N": "20000",
+    "BENCH_SV_LISTS": "32",
+    "BENCH_SV_BURSTS": "12",
+    "BENCH_SV_BURST": "8",
+    "BENCH_SV_PERIOD_MS": "10",
+    "BENCH_SV_WAIT_MS": "2",
+    # generous deadline: on a loaded CI host the CPU executes batches
+    # near the second scale, and a deadline-shed would make the
+    # completion column timing-flaky — attainment is still measured
+    # (slo_* columns), it just isn't gated
+    "BENCH_SV_TIMEOUT_MS": "10000",
+}
+
+# Tolerance bands, keyed by dotted path into the bench record.
+#   min_ratio:    fresh >= baseline * r   (higher is better)
+#   max_ratio:    fresh <= baseline * r   (lower is better; a zero
+#                 baseline only requires fresh to stay finite-small
+#                 via max_increase when given)
+#   max_increase: fresh <= baseline + n   (absolute slack)
+# Wall-clock columns get wide bands (shared CI hosts are noisy);
+# structural columns get tight ones.
+DEFAULT_TOLERANCES = {
+    "value": {"min_ratio": 0.30},                  # headline QPS
+    "serving.qps": {"min_ratio": 0.30},
+    "serving.baseline_one_per_call_qps": {"min_ratio": 0.30},
+    "serving.p99_ms": {"max_ratio": 4.0, "max_increase": 50.0},
+    "serving.requests_per_batch": {"min_ratio": 0.6},
+    "serving.completed": {"min_ratio": 0.9},
+    # steady state must not start recompiling: small absolute slack
+    # covers the per-batch-size pad/concat micro-programs whose count
+    # varies with thread-timing-dependent batch composition
+    "serving.backend_compiles_during_load": {"max_increase": 25},
+    "serving.modeled_exec_bytes": {"min_ratio": 0.5},
+    "serving.modeled_exec_flops": {"min_ratio": 0.5},
+}
+
+# counters the test session's metrics snapshot must carry ABOVE these
+# values — the modeled-throughput accounting staying alive
+SNAPSHOT_FLOORS = {
+    "serving.execute.calls": 0.0,
+    "serving.execute.modeled_bytes": 0.0,
+    "serving.execute.modeled_flops": 0.0,
+}
+
+
+def get_path(record: dict, dotted: str):
+    """Resolve ``"serving.qps"``-style paths; None when absent."""
+    cur = record
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def compare(baseline: dict, fresh: dict, tolerances=None) -> list:
+    """Regression messages from diffing two bench records (empty list
+    = within bands). Columns missing from the BASELINE are skipped (an
+    old baseline predating a new column must not fail the gate);
+    columns missing from the FRESH record are regressions — the
+    measurement itself disappeared."""
+    msgs = []
+    for path, tol in (tolerances or DEFAULT_TOLERANCES).items():
+        base = get_path(baseline, path)
+        if base is None:
+            continue
+        got = get_path(fresh, path)
+        if got is None:
+            msgs.append(f"{path}: present in baseline ({base}) but "
+                        "missing from the fresh record")
+            continue
+        base, got = float(base), float(got)
+        if "min_ratio" in tol and got < base * tol["min_ratio"]:
+            msgs.append(
+                f"{path}: {got:g} < {tol['min_ratio']:g}x baseline "
+                f"({base:g}) — throughput regression")
+        ceiling = None
+        if "max_ratio" in tol and base > 0:
+            ceiling = base * tol["max_ratio"]
+        if "max_increase" in tol:
+            inc = base + tol["max_increase"]
+            ceiling = inc if ceiling is None else max(ceiling, inc)
+        if ceiling is not None and got > ceiling:
+            msgs.append(
+                f"{path}: {got:g} > allowed {ceiling:g} "
+                f"(baseline {base:g}) — latency/compile regression")
+    return msgs
+
+
+def check_snapshot(snapshot: dict, floors=None) -> list:
+    """Floor checks on the test session's metrics snapshot: the
+    modeled-throughput counters must exist and exceed their floors.
+    Reads the session-lifetime ledger (``counters_lifetime`` — totals
+    that survive per-test ``reset_counters()`` isolation) when the
+    snapshot carries one; the live ``counters`` view only holds what
+    ran after the LAST reset, which depends on test ordering."""
+    msgs = []
+    counters = snapshot.get("counters_lifetime") or \
+        snapshot.get("counters", {})
+    for name, floor in (floors or SNAPSHOT_FLOORS).items():
+        v = counters.get(name)
+        if v is None:
+            msgs.append(f"metrics snapshot: counter {name!r} missing — "
+                        "modeled-throughput accounting went dark")
+        elif float(v) <= floor:
+            msgs.append(f"metrics snapshot: {name} = {v} (must be > "
+                        f"{floor}) — modeled-throughput accounting "
+                        "went dark")
+    return msgs
+
+
+def run_bench(env_overrides: dict) -> dict:
+    """Run the bench CHILD directly (no backend probes — the pinned
+    config is CPU) and return its last JSON stdout line."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # CPU child must not touch
+    env.pop("BENCH_TAG", None)              # the relay plugin / naming
+    env.pop("BENCH_SUFFIX", None)
+    env.update(env_overrides)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, timeout=1800)
+    rec = None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    if rec is None:
+        sys.stderr.write(proc.stderr[-4000:] + "\n")
+        raise RuntimeError(
+            f"bench child produced no JSON (exit {proc.returncode})")
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--fresh", help="existing bench-record JSON to "
+                    "diff instead of running the bench")
+    ap.add_argument("--run", action="store_true",
+                    help="run the pinned bench config to get the "
+                    "fresh record")
+    ap.add_argument("--snapshot", help="metrics_snapshot.json to "
+                    "floor-check (skipped silently if the file is "
+                    "absent — local runs without the pytest artifact)")
+    ap.add_argument("--update", action="store_true",
+                    help="write the fresh record back as the baseline "
+                    "(deliberate re-baseline) instead of comparing")
+    args = ap.parse_args(argv)
+
+    baseline = None
+    if os.path.exists(args.baseline):
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    if baseline is None and not args.update:
+        sys.stderr.write(
+            f"bench_compare: no baseline at {args.baseline} — run with "
+            "--update to create one\n")
+        return 2
+
+    if args.fresh:
+        with open(args.fresh) as f:
+            fresh = json.load(f)
+    elif args.run or args.update:
+        env = dict((baseline or {}).get("env") or PINNED_ENV)
+        print(f"bench_compare: running pinned bench config "
+              f"({env.get('BENCH_N')}x{env.get('BENCH_DIM')}, "
+              f"serving rider on)", flush=True)
+        fresh = run_bench(env)
+    else:
+        sys.stderr.write("bench_compare: need --run or --fresh\n")
+        return 2
+
+    if args.update:
+        out = {
+            "env": dict((baseline or {}).get("env") or PINNED_ENV),
+            "tolerances": DEFAULT_TOLERANCES,
+            "snapshot_floors": SNAPSHOT_FLOORS,
+            "record": fresh,
+        }
+        with open(args.baseline, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"bench_compare: baseline updated at {args.baseline}")
+        return 0
+
+    msgs = compare(baseline.get("record", {}), fresh,
+                   baseline.get("tolerances") or DEFAULT_TOLERANCES)
+    if args.snapshot and os.path.exists(args.snapshot):
+        with open(args.snapshot) as f:
+            msgs += check_snapshot(
+                json.load(f),
+                baseline.get("snapshot_floors") or SNAPSHOT_FLOORS)
+    if msgs:
+        for m in msgs:
+            sys.stderr.write(f"bench_compare: REGRESSION: {m}\n")
+        sys.stderr.write(
+            "bench_compare: if the change is intentional, re-baseline "
+            "with: python ci/bench_compare.py --run --update\n")
+        return 1
+    print("bench_compare: OK — fresh run within tolerance of baseline")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

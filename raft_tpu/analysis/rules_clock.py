@@ -22,6 +22,16 @@ timestamps from the clock object or from values stamped by it
 ``from time import time`` alike. ``time.sleep`` is not flagged: the
 harness's real-clock fallbacks sleep by design, and sleeping reads no
 clock.
+
+PR 7 (graftscope v2) widened the rule with the new span/SLO call
+sites: serving code now records spans, SLO-window samples and
+burn-rate timestamps wherever it runs, and the one evasion route the
+``time``-module machinery missed was the ``datetime`` module —
+``datetime.datetime.now()`` / ``.utcnow()`` / ``date.today()`` read
+the wall clock just as surely and additionally smuggle in a *civil*
+time that doesn't even share the monotonic clock's epoch. Any such
+read feeding a span or SLO sample splits the recording across two
+time domains, so they are findings under the same rule.
 """
 
 from __future__ import annotations
@@ -37,6 +47,10 @@ SERVING_PREFIX = "raft_tpu/serving/"
 # the clock-reading members of the time module
 CLOCK_FNS = {"time", "monotonic", "perf_counter",
              "time_ns", "monotonic_ns", "perf_counter_ns"}
+
+# the clock-reading constructors of the datetime module's classes
+DATETIME_CLOCK_FNS = {"now", "utcnow", "today"}
+DATETIME_CLASSES = {"datetime", "date"}
 
 
 def _clock_class_spans(tree: ast.AST) -> List[tuple]:
@@ -75,6 +89,48 @@ def _clock_fn_imports(tree: ast.AST) -> Set[str]:
     return names
 
 
+def _datetime_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to the ``datetime`` MODULE (``import
+    datetime``, ``import datetime as dt``)."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "datetime":
+                    aliases.add(a.asname or "datetime")
+    return aliases
+
+
+def _datetime_class_names(tree: ast.AST) -> Set[str]:
+    """Local names bound to the ``datetime``/``date`` CLASSES via
+    ``from datetime import ...`` — ``datetime.now()`` spelled bare."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "datetime":
+            for a in node.names:
+                if a.name in DATETIME_CLASSES:
+                    names.add(a.asname or a.name)
+    return names
+
+
+def _is_datetime_clock_read(nm: str, mod_aliases: Set[str],
+                            class_names: Set[str]) -> bool:
+    """True when dotted call name ``nm`` reads the wall clock through
+    the datetime module: ``<mod>.datetime.now()``, ``<mod>.date
+    .today()``, or ``<class>.now()``/``.utcnow()``/``.today()``.
+    Constructors that transform an existing timestamp VALUE
+    (``fromtimestamp``, ``combine``…) read no clock and stay exempt."""
+    if "." not in nm:
+        return False
+    parts = nm.split(".")
+    if parts[-1] not in DATETIME_CLOCK_FNS:
+        return False
+    if parts[0] in mod_aliases and len(parts) == 3 \
+            and parts[1] in DATETIME_CLASSES:
+        return True
+    return parts[0] in class_names and len(parts) == 2
+
+
 @rule("R7", "clock-discipline")
 def check_clock_discipline(project: Project) -> Iterable[Finding]:
     """Direct ``time.time()``/``time.monotonic()``/``time.perf_counter()``
@@ -89,13 +145,18 @@ def check_clock_discipline(project: Project) -> Iterable[Finding]:
         clock_spans = _clock_class_spans(f.tree)
         mod_aliases = _time_module_aliases(f.tree)
         bare_names = _clock_fn_imports(f.tree)
+        dt_mod_aliases = _datetime_aliases(f.tree)
+        dt_class_names = _datetime_class_names(f.tree)
         for node in ast.walk(f.tree):
             if not isinstance(node, ast.Call):
                 continue
             nm = astutil.call_name(node)
             if nm is None:
                 continue
-            if "." in nm:
+            if _is_datetime_clock_read(nm, dt_mod_aliases,
+                                       dt_class_names):
+                pass
+            elif "." in nm:
                 mod, fn = nm.split(".", 1)
                 if mod not in mod_aliases or fn not in CLOCK_FNS:
                     continue

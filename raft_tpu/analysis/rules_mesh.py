@@ -38,12 +38,15 @@ LAX_COLLECTIVES = {
 }
 
 # veneer function name -> positional index of its axis argument
+# (timed_dispatch is the PR 7 host-side timing shim: its axis names
+# the mesh axis being timed, so a typo'd literal is the same latent
+# bug an axis typo in a collective is)
 VENEER_AXIS_POS = {
     "allreduce": 2, "bcast": 2, "reduce": 3, "allgather": 1,
     "allgather_wire": 1, "allgatherv": 2, "reducescatter": 2,
     "alltoall": 1, "device_send": 2, "device_recv": 2,
     "device_sendrecv": 2, "barrier": 0, "rank": 0, "size": 0,
-    "mark_varying": 1,
+    "mark_varying": 1, "timed_dispatch": 2,
 }
 
 

@@ -21,11 +21,75 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Callable, Sequence
+import time
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.core import tracing
+
+
+def _count_collective(family: str, tree) -> None:
+    """Trace-time calls/bytes accounting for one collective veneer call
+    (PR 7 graftscope v2): bumps ``comms.<family>.calls`` and
+    ``comms.<family>.modeled_bytes`` (summed over the payload pytree's
+    static shapes — available on tracers) under one lock. This runs as
+    plain Python while the program is being *traced*, so the traced
+    body gains no ops and no host syncs; AOT executables trace once,
+    so the steady-state dispatch cost is exactly zero. The counters
+    therefore inventory the collective families (and modeled per-shard
+    payload bytes) compiled into the process's programs — the wire-cost
+    ledger a scrape reads next to the ``serving.collective.*`` payload
+    gauges."""
+    nbytes = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        n = 1
+        for d in shape:
+            n *= int(d)
+        nbytes += n * jnp.dtype(dtype).itemsize
+    tracing.inc_counters({
+        f"comms.{family}.calls": 1.0,
+        f"comms.{family}.modeled_bytes": float(nbytes),
+    })
+
+
+def timed_dispatch(family: str, thunk: Callable, axis: str = "data", *,
+                   modeled_bytes: float = 0.0,
+                   trace_ids: Tuple[int, ...] = (),
+                   attrs: Optional[dict] = None):
+    """Host-side timed dispatch of one collective-bearing program —
+    the PR 6 discipline applied to the mesh: timing wraps the *call
+    site* of the compiled program (``thunk``), never the traced body,
+    so no host syncs ride into ``shard_map``. Records a
+    ``comms.dispatch.<family>`` span into the flight recorder and
+    bumps ``comms.dispatch.<family>.{calls,seconds,modeled_bytes}``
+    under one lock. ``modeled_bytes`` is the caller's per-dispatch
+    wire model (``collective_payload_model``); ``axis`` names the mesh
+    axis whose collectives the dispatch carries (span attr only).
+
+    Returns ``thunk()``'s result unchanged. Note the timing covers
+    dispatch (and whatever the thunk itself blocks on) — callers that
+    want readiness-inclusive timing block inside the thunk, as the
+    traced direct-search entries do."""
+    t0 = time.perf_counter()
+    out = thunk()
+    t1 = time.perf_counter()
+    a = {"axis": axis, "modeled_bytes": float(modeled_bytes)}
+    a.update(attrs or {})
+    tracing.record_span(f"comms.dispatch.{family}", t0, t1,
+                        trace_ids=trace_ids, attrs=a)
+    tracing.inc_counters({
+        f"comms.dispatch.{family}.calls": 1.0,
+        f"comms.dispatch.{family}.seconds": t1 - t0,
+        f"comms.dispatch.{family}.modeled_bytes": float(modeled_bytes),
+    })
+    return out
 
 
 class Op(enum.Enum):
@@ -67,8 +131,10 @@ def axis_size(axis: str) -> int:
 # ---------------------------------------------------------------------------
 
 
-def allreduce(x, op: Op = Op.SUM, axis: str = "data"):
-    """``comms_t::allreduce`` → psum/pmax/pmin (XLA all-reduce on ICI)."""
+def _allreduce_impl(x, op: Op, axis: str):
+    """Uncounted all-reduce body — delegating veneers (:func:`reduce`,
+    :func:`reducescatter`'s non-SUM branch) call this so one logical
+    collective bumps the ledger exactly once, under its own family."""
     if op == Op.SUM:
         return jax.lax.psum(x, axis)
     if op == Op.MAX:
@@ -79,8 +145,15 @@ def allreduce(x, op: Op = Op.SUM, axis: str = "data"):
     return jnp.prod(jax.lax.all_gather(x, axis), axis=0)
 
 
+def allreduce(x, op: Op = Op.SUM, axis: str = "data"):
+    """``comms_t::allreduce`` → psum/pmax/pmin (XLA all-reduce on ICI)."""
+    _count_collective("allreduce", x)
+    return _allreduce_impl(x, op, axis)
+
+
 def bcast(x, root: int = 0, axis: str = "data"):
     """``comms_t::bcast``: every rank ends with root's value."""
+    _count_collective("bcast", x)
     rank = jax.lax.axis_index(axis)
     contrib = jnp.where(rank == root, x, jnp.zeros_like(x))
     return jax.lax.psum(contrib, axis)
@@ -99,12 +172,14 @@ def reduce(x, root: int = 0, op: Op = Op.SUM, axis: str = "data"):
     hop-by-hop forwarding a rooted gather needs). DCN-spanning meshes
     are where a rooted variant would pay; revisit if a DCN profile
     shows these hot."""
-    return allreduce(x, op, axis)
+    _count_collective("reduce", x)
+    return _allreduce_impl(x, op, axis)
 
 
 def allgather(x, axis: str = "data", tiled: bool = False):
     """``comms_t::allgather``: stack (or concat when ``tiled``) every
     rank's block along a new leading axis."""
+    _count_collective("allgather", x)
     return jax.lax.all_gather(x, axis, tiled=tiled)
 
 
@@ -131,8 +206,11 @@ def allgather_wire(x, axis: str = "data", wire_dtype: str = "f32"):
     tie-break by exact id)."""
     wd = resolve_wire_dtype(wire_dtype)
     if x.dtype == wd:
+        _count_collective("allgather_wire", x)
         return jax.lax.all_gather(x, axis)
-    return jax.lax.all_gather(x.astype(wd), axis).astype(x.dtype)
+    xw = x.astype(wd)
+    _count_collective("allgather_wire", xw)
+    return jax.lax.all_gather(xw, axis).astype(x.dtype)
 
 
 # wire formats for the coarse/probe-candidate exchange: the payload is
@@ -170,6 +248,7 @@ def allgather_quantized(x, axis: str = "data", wire_dtype: str = "f32"):
     scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
     scale = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)
     q8 = jnp.clip(jnp.round(x * (127.0 / scale)), -127, 127)
+    _count_collective("allgather_quantized", (q8.astype(jnp.int8), scale))
     all_q = jax.lax.all_gather(q8.astype(jnp.int8), axis)
     all_s = jax.lax.all_gather(scale, axis)
     return all_q.astype(jnp.float32) * (all_s * (1.0 / 127.0))
@@ -179,6 +258,7 @@ def gather(x, root: int = 0, axis: str = "data", tiled: bool = False):
     """``comms_t::gather`` (valid on every rank, superset of reference;
     per-link cost on ICI matches a rooted gather — see
     :func:`reduce`)."""
+    _count_collective("gather", x)
     return jax.lax.all_gather(x, axis, tiled=tiled)
 
 
@@ -187,16 +267,19 @@ def allgatherv(x, valid_size, axis: str = "data"):
     block + per-rank sizes (TPU collectives need static shapes).
 
     Returns (stacked (n_ranks, max_block, ...), sizes (n_ranks,))."""
+    sizes = jnp.asarray(valid_size, jnp.int32)
+    _count_collective("allgatherv", (x, sizes))   # both wire payloads
     return (
         jax.lax.all_gather(x, axis),
-        jax.lax.all_gather(jnp.asarray(valid_size, jnp.int32), axis),
+        jax.lax.all_gather(sizes, axis),
     )
 
 
 def reducescatter(x, op: Op = Op.SUM, axis: str = "data"):
     """``comms_t::reducescatter`` → psum_scatter over the leading dim."""
+    _count_collective("reducescatter", x)
     if op != Op.SUM:
-        gathered = allreduce(x, op, axis)
+        gathered = _allreduce_impl(x, op, axis)
         n = axis_size(axis)
         rank = jax.lax.axis_index(axis)
         block = x.shape[0] // n
@@ -207,27 +290,37 @@ def reducescatter(x, op: Op = Op.SUM, axis: str = "data"):
 def alltoall(x, axis: str = "data"):
     """``comms_t`` device_multicast/alltoall: exchange row blocks so rank
     r receives block r from every rank (``lax.all_to_all``)."""
+    _count_collective("alltoall", x)
     n = axis_size(axis)
     blocks = x.reshape((n, x.shape[0] // n) + x.shape[1:])
     return jax.lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0)
+
+
+def _ring_permute(x, offset: int, axis: str):
+    """Uncounted ring-shift body shared by send/recv (each veneer
+    bumps its own ledger family exactly once)."""
+    n = axis_size(axis)
+    perm = [(i, (i + offset) % n) for i in range(n)]
+    return jax.lax.ppermute(x, axis, perm)
 
 
 def device_send(x, dest_offset: int = 1, axis: str = "data"):
     """Ring send: rank r's value moves to rank (r + dest_offset) % n —
     the p2p pattern expressible on the ICI torus (``comms_t::device_send``;
     arbitrary pairs route through :func:`device_sendrecv` perms)."""
-    n = axis_size(axis)
-    perm = [(i, (i + dest_offset) % n) for i in range(n)]
-    return jax.lax.ppermute(x, axis, perm)
+    _count_collective("device_send", x)
+    return _ring_permute(x, dest_offset, axis)
 
 
 def device_recv(x, src_offset: int = 1, axis: str = "data"):
     """Ring recv: receive the value from rank (r - src_offset) % n."""
-    return device_send(x, src_offset, axis)
+    _count_collective("device_recv", x)
+    return _ring_permute(x, src_offset, axis)
 
 
 def device_sendrecv(x, perm: Sequence[tuple], axis: str = "data"):
     """``comms_t::device_sendrecv``: explicit (src, dst) pair list."""
+    _count_collective("device_sendrecv", x)
     return jax.lax.ppermute(x, axis, list(perm))
 
 

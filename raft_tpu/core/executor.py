@@ -133,13 +133,26 @@ class _Plan:
 
 
 class _Entry:
-    __slots__ = ("compiled", "state", "cost", "digest")
+    __slots__ = ("compiled", "state", "cost", "digest", "family",
+                 "payload_model")
 
-    def __init__(self, compiled, state, cost=None, digest=""):
+    def __init__(self, compiled, state, cost=None, digest="",
+                 family="", payload_model=None):
         self.compiled = compiled
         self.state = state
         self.cost = cost or {}
         self.digest = digest
+        self.family = family
+        # mesh entries keep their collective_payload_model dict so the
+        # per-dispatch mesh spans can attach modeled per-phase bytes
+        # without rebuilding the model on the hot path
+        self.payload_model = payload_model
+
+
+# readiness-poll quantum for per-shard arrival timing (mesh_trace):
+# also the straggler timings' resolution — 50 µs resolves sub-ms skew
+# while keeping the poll loop's host cost negligible per dispatch
+_MESH_POLL_S = 50e-6
 
 
 def _executable_cost(compiled) -> dict:
@@ -237,11 +250,25 @@ class SearchExecutor:
       max_entries: LRU capacity of the executable cache.
       donate: donate the running top-k state buffers to each call.
         Default: enabled on backends that implement donation (not CPU).
+      mesh_trace: record graftscope-v2 mesh spans around every
+        mesh-sharded dispatch — the three modeled phase spans
+        (coarse select / scan / merge, bytes from the entry's
+        ``collective_payload_model``) plus per-shard readiness timings
+        through the straggler detector
+        (``serving.mesh.{shard_skew,slowest_shard}``). Costs a
+        host-side readiness wait per dispatch AFTER it is enqueued
+        (the batcher blocks on results anyway — but an oversized
+        batch's tiles serialize, since each tile's poll completes
+        before the next dispatches), compiles nothing, and adds
+        nothing inside the traced program; default off so
+        latency-pipelined callers (the bench riders) keep fully async
+        dispatch.
     """
 
     def __init__(self, res: Optional[Resources] = None, *,
                  min_bucket: int = 8, max_bucket: int = 4096,
-                 max_entries: int = 64, donate: Optional[bool] = None):
+                 max_entries: int = 64, donate: Optional[bool] = None,
+                 mesh_trace: bool = False):
         self.res = ensure_resources(res)
         expect(0 < min_bucket <= max_bucket,
                f"need 0 < min_bucket <= max_bucket, got "
@@ -257,6 +284,7 @@ class SearchExecutor:
         if donate is None:
             donate = jax.default_backend() not in ("cpu",)
         self.donate = donate
+        self.mesh_trace = mesh_trace
         self.stats = ExecutorStats()
         self._cache: "collections.OrderedDict[tuple, _Entry]" = (
             collections.OrderedDict())
@@ -301,11 +329,15 @@ class SearchExecutor:
         return dt
 
     def search(self, index, queries, k: int, params=None,
-               sample_filter=None, **kw) -> Tuple[jax.Array, jax.Array]:
+               sample_filter=None, trace_ids: Tuple[int, ...] = (),
+               **kw) -> Tuple[jax.Array, jax.Array]:
         """Bucketed, compile-free search. Returns (distances (q, k),
         indices (q, k) int32), bit-identical to the direct per-family
         ``search`` entry point. Extra ``kw`` are family-specific knobs
-        (brute force: ``db_tile``, ``approx``)."""
+        (brute force: ``db_tile``, ``approx``). ``trace_ids`` tags the
+        dispatch's flight-recorder spans (mesh plans with
+        ``mesh_trace`` on) — the serving batcher passes its members'
+        ids so mesh stragglers attribute back to requests."""
         expect(len(np.shape(queries)) == 2, "queries must be (q, dim)")
         q = int(np.shape(queries)[0])
         if q == 0:
@@ -314,7 +346,8 @@ class SearchExecutor:
         fw = self._resolve_filter(sample_filter)
         max_b = self.buckets[-1]
         if q <= max_b:
-            return self._run(index, queries, k, params, fw, kw)
+            return self._run(index, queries, k, params, fw, kw,
+                             trace_ids=trace_ids)
         # tile oversized batches at the top bucket; every tile runs the
         # same executable and all tiles dispatch before any fetch
         outs_d, outs_i = [], []
@@ -322,7 +355,8 @@ class SearchExecutor:
             qt = queries[start:start + max_b]
             fwt = fw[start:start + max_b] if (
                 fw is not None and fw.ndim == 2) else fw
-            d, i = self._run(index, qt, k, params, fwt, kw, row0=start)
+            d, i = self._run(index, qt, k, params, fwt, kw, row0=start,
+                             trace_ids=trace_ids)
             outs_d.append(d)
             outs_i.append(i)
         return jnp.concatenate(outs_d), jnp.concatenate(outs_i)
@@ -341,7 +375,8 @@ class SearchExecutor:
         return (id(index), plan.key[0]) + tuple(plan.key[2:])
 
     def search_blocks(self, index, blocks, k: int, params=None,
-                      sample_filter=None, **kw):
+                      sample_filter=None, trace_ids: Tuple[int, ...] = (),
+                      **kw):
         """Batch-handle entry point for the serving frontend: run the
         per-request query blocks of ONE coalesced micro-batch as a
         single bucketed call and split the results back per block.
@@ -368,7 +403,8 @@ class SearchExecutor:
             for b, m in zip(blocks, sizes):
                 fwb = fw[start:start + m] if (
                     fw is not None and fw.ndim == 2) else fw
-                out.append(self.search(index, b, k, params, fwb, **kw))
+                out.append(self.search(index, b, k, params, fwb,
+                                       trace_ids=trace_ids, **kw))
                 start += m
             return out
         if len(blocks) == 1:
@@ -377,7 +413,8 @@ class SearchExecutor:
             cat = np.concatenate(blocks)
         else:
             cat = jnp.concatenate([jnp.asarray(b) for b in blocks])
-        d, i = self.search(index, cat, k, params, fw, **kw)
+        d, i = self.search(index, cat, k, params, fw,
+                           trace_ids=trace_ids, **kw)
         out, start = [], 0
         for m in sizes:
             out.append((d[start:start + m], i[start:start + m]))
@@ -393,7 +430,8 @@ class SearchExecutor:
 
         return resolve_filter_words(sample_filter)
 
-    def _run(self, index, queries, k, params, fw, kw, row0: int = 0):
+    def _run(self, index, queries, k, params, fw, kw, row0: int = 0,
+             trace_ids: Tuple[int, ...] = ()):
         q = int(np.shape(queries)[0])
         bucket = self.bucket_for(q)
         plan = self._plan(index, params, k, bucket, fw, kw)
@@ -411,10 +449,12 @@ class SearchExecutor:
             if fw is not None and fw.ndim == 2:
                 fwp = self._pad(fw, bucket, fw.dtype)
             args.append(fwp)
+        ret = None
         with self._lock:
             entry = self._get_entry(plan, bucket, k)
             if plan.has_state:
                 args.extend(entry.state)
+            t0 = time.perf_counter()
             out_d, out_i = entry.compiled(*args)
             # modeled per-dispatch work, from the compile-time capture:
             # a counter bump (one host lock), never a device sync. The
@@ -436,8 +476,60 @@ class SearchExecutor:
                 # the next call's state and hand the caller copies
                 entry.state = (out_d, out_i)
                 if q == bucket and self.donate:
-                    return jnp.copy(out_d), jnp.copy(out_i)
+                    ret = (jnp.copy(out_d), jnp.copy(out_i))
+        # mesh recording AFTER the lock releases: the readiness poll
+        # lasts as long as the slowest shard, and holding the executor
+        # lock through it would stall OTHER threads — concurrent
+        # searches and exporter scrapes (publish_cost_gauges takes the
+        # same lock) — for a full device execution. The calling thread
+        # itself still waits out the poll, so an oversized batch's
+        # tiles DO serialize under mesh_trace (per-tile attribution is
+        # the trade; see the mesh_trace docstring)
+        if plan.sharded and self.mesh_trace:
+            self._record_mesh_dispatch(entry, out_d, out_i, t0,
+                                       trace_ids)
+        if ret is not None:
+            return ret
         return out_d[:q], out_i[:q]
+
+    def _record_mesh_dispatch(self, entry, out_d, out_i, t0: float,
+                              trace_ids: Tuple[int, ...]) -> None:
+        """Graftscope v2 mesh span recording around one sharded
+        dispatch (``mesh_trace=True``): the three modeled phase spans
+        (bytes from the entry's compile-time
+        ``collective_payload_model``) plus per-shard readiness timings
+        — each output shard's host-visible arrival offset — reduced by
+        the straggler detector into ``serving.mesh.*`` gauges. All of
+        it is host-side timing + dict work AFTER the dispatch; nothing
+        enters the traced program, so zero-recompile is untouched (the
+        regression test runs with this enabled).
+
+        Arrival times come from the shared non-blocking poll
+        (:func:`raft_tpu.core.tracing.poll_shard_timings` — see there
+        for why sequential blocking would hide early-ordinal
+        stragglers, and for the donated-buffer tolerance the
+        outside-the-lock poll needs)."""
+        try:
+            shards = [(sd.data, si.data)
+                      for sd, si in zip(out_d.addressable_shards,
+                                        out_i.addressable_shards)]
+        except RuntimeError:
+            # donated-state plans: a concurrent re-dispatch consumed
+            # the output buffers before we could even enumerate the
+            # shards — nothing left to time, skip this dispatch's
+            # recording rather than failing the caller's search
+            return
+        timings = tracing.poll_shard_timings(shards, t0,
+                                             poll_s=_MESH_POLL_S)
+        phases = None
+        if entry.payload_model is not None:
+            from raft_tpu.distributed.ivf import mesh_phases
+
+            phases = mesh_phases(entry.payload_model)
+        tracing.record_mesh_spans(
+            entry.family or "mesh", t0,
+            t0 + (max(timings) if timings else 0.0),
+            trace_ids=trace_ids, phases=phases, shard_timings=timings)
 
     def _pad(self, arr, rows: int, dtype):
         """Pad to ``rows`` along axis 0. numpy inputs (the serving
@@ -487,17 +579,19 @@ class SearchExecutor:
         digest = hashlib.sha1(repr(plan.key).encode()).hexdigest()[:12]
         info = {"family": plan.key[0], "bucket": bucket, "k": k,
                 "compile_seconds": dt, **cost}
+        payload_model = None
         if plan.payload is not None:
             family, model_fn = plan.payload
-            model = dict(model_fn())
+            payload_model = dict(model_fn())
             info["collective_family"] = family
-            info["collective_payload"] = model
+            info["collective_payload"] = payload_model
             from raft_tpu.distributed.ivf import publish_payload_gauges
 
-            publish_payload_gauges(family, model)
+            publish_payload_gauges(family, payload_model)
         self._cost_table[digest] = info
         tracing.set_gauges(_cost_gauge_values(digest, cost))
-        ent = _Entry(compiled, state, cost=cost, digest=digest)
+        ent = _Entry(compiled, state, cost=cost, digest=digest,
+                     family=plan.key[0], payload_model=payload_model)
         self._cache[plan.key] = ent
         while len(self._cache) > self.max_entries:
             _, old = self._cache.popitem(last=False)

@@ -42,9 +42,11 @@ from raft_tpu.neighbors.ivf_bq import (
     score_probe,
 )
 from raft_tpu.distributed.ivf import (
+    collective_payload_model,
     deal_order,
     merge_results_sharded,
     place_dealt,
+    record_dispatch,
     resolve_probe_budget,
     resolve_query_sharding,
     select_probes_sharded,
@@ -211,6 +213,7 @@ def search_bq(
     query_tile: int = 4096,
     wire_dtype: str = "f32",
     probe_wire_dtype: str = "f32",
+    trace_id: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """One-program distributed BQ search (estimated distances — refine
     host-side as with the single-chip index). Large query sets run in
@@ -222,7 +225,10 @@ def search_bq(
     (sign-code estimates are already coarse — the cheap payload win);
     ``probe_wire_dtype`` (``f32|bf16|int8``) compresses the
     probe-candidate exchange (see
-    :func:`raft_tpu.distributed.ivf.select_probes_sharded`)."""
+    :func:`raft_tpu.distributed.ivf.select_probes_sharded`);
+    ``trace_id`` opts into graftscope-v2 mesh span recording (the
+    dispatch then blocks and times —
+    :func:`raft_tpu.distributed.ivf.record_dispatch`)."""
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -249,9 +255,18 @@ def search_bq(
                 probe_wire_dtype=probe_wire_dtype,
             )
 
+        # lazy: only a traced dispatch (trace_id=) builds the model
+        model = lambda: collective_payload_model(  # noqa: E731
+            queries.shape[0], k, n_probes, index.n_lists, comms.size,
+            wire_dtype, probe_mode, probe_wire_dtype)
         if query_axis is not None:
             # already query-sharded: tiling would slice across the
             # shard layout and force a reshard per tile — run whole
             # (the 2-D grid is itself the large-batch mechanism)
-            return run(queries, None)
-        return tile_queries(run, queries, None, query_tile)
+            return record_dispatch("dist_ivf_bq", model, trace_id,
+                                   lambda: run(queries, None),
+                                   axis=comms.axis)
+        return record_dispatch(
+            "dist_ivf_bq", model, trace_id,
+            lambda: tile_queries(run, queries, None, query_tile),
+            axis=comms.axis)

@@ -44,6 +44,7 @@ Probe semantics (``probe_mode``):
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Optional, Tuple
 
@@ -308,6 +309,72 @@ def collective_payload_model(q: int, k: int, n_probes: int, n_lists: int,
     }
 
 
+def mesh_phases(model: dict) -> dict:
+    """Map one :func:`collective_payload_model` result onto the three
+    mesh query phases — the span attrs of the ``serving.mesh.*`` spans
+    (PR 7 graftscope v2): ``coarse_select`` carries the probe-candidate
+    exchange bytes, ``scan`` the shard-local probe scan (no wire
+    bytes — it is the HBM-bound stage), ``merge`` the O(q · k) result
+    collective. ``modeled: True`` marks the attribution as byte-model
+    accounting over the shared dispatch window, not a device profile —
+    the TPU-KNN methodology, machine-readable."""
+    return {
+        "coarse_select": {"modeled": True,
+                          "wire_bytes": model["coarse_bytes"],
+                          "dense_wire_bytes": model["dense_coarse_bytes"],
+                          "probe_wire_dtype": model["probe_wire_dtype"]},
+        "scan": {"modeled": True, "wire_bytes": 0},
+        "merge": {"modeled": True, "wire_bytes": model["merge_bytes"],
+                  "wire_dtype": model["wire_dtype"]},
+    }
+
+
+def record_dispatch(family: str, model, trace_id, thunk, *,
+                    axis: str = "data",
+                    phases: Optional[dict] = None,
+                    modeled_bytes: Optional[float] = None,
+                    attrs: Optional[dict] = None):
+    """Shared traced-dispatch path of the direct distributed search
+    entries: with ``trace_id=None`` (the default) the thunk dispatches
+    untouched — fully async, zero instrumentation cost. With a
+    ``trace_id`` the dispatch is timed through
+    :func:`raft_tpu.comms.comms.timed_dispatch`, **blocks until the
+    result is ready** (so the span duration covers the mesh execution,
+    not just the enqueue — the one place tracing trades away async
+    dispatch, opt-in per call), and the mesh phase spans are recorded
+    with the modeled per-phase wire bytes attached.
+
+    ``axis`` names the mesh axis the program's collectives ride (the
+    caller's ``comms.axis`` — a span attr; hardcoding ``"data"`` would
+    mislabel 2-D grids and renamed-axis meshes).
+    ``phases``/``modeled_bytes`` default from ``model`` — a
+    :func:`collective_payload_model` dict, or a zero-arg callable
+    producing one so the untraced hot path (``trace_id=None``, every
+    production call) never pays for building a model it immediately
+    discards; callers with a different phase structure (the exact-kNN
+    programs, which have no coarse phase) pass them explicitly and may
+    leave ``model`` as None. ``attrs`` ride on the timed-dispatch
+    span."""
+    from raft_tpu.comms.comms import timed_dispatch
+
+    if trace_id is None:
+        return thunk()
+    if callable(model):
+        model = model()
+    if phases is None:
+        phases = mesh_phases(model)
+    if modeled_bytes is None:
+        modeled_bytes = float(model["coarse_bytes"] + model["merge_bytes"])
+    ids = (trace_id,)
+    t0 = time.perf_counter()
+    out = timed_dispatch(
+        family, lambda: jax.block_until_ready(thunk()), axis,
+        modeled_bytes=modeled_bytes, trace_ids=ids, attrs=attrs)
+    tracing.record_mesh_spans(family, t0, time.perf_counter(),
+                              trace_ids=ids, phases=phases)
+    return out
+
+
 def publish_payload_gauges(family: str, model: dict) -> None:
     """Register one :func:`collective_payload_model` result as live
     ``serving.collective.*`` gauges — called once per compiled mesh
@@ -523,6 +590,7 @@ def search(
     query_axis: Optional[str] = None,
     wire_dtype: str = "f32",
     probe_wire_dtype: str = "f32",
+    trace_id: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """One-program distributed search; returns replicated (q, k) results
     with global row ids. See the module docstring for ``probe_mode``.
@@ -536,7 +604,10 @@ def search(
     bytes (see :func:`select_probes_sharded`).
     The probe scan engine follows ``params.scan_engine`` exactly like
     the single-chip entry (resolved per backend/shape by
-    :func:`raft_tpu.ops.ivf_scan.resolve_scan_engine`)."""
+    :func:`raft_tpu.ops.ivf_scan.resolve_scan_engine`).
+    ``trace_id`` (graftscope v2) opts this call into mesh span
+    recording — the dispatch blocks, times, and lands the three phase
+    spans with modeled wire bytes (:func:`record_dispatch`)."""
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -556,14 +627,20 @@ def search(
                                       k=k)
     queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_flat.search"):
-        return _dist_search(
-            queries, index.centers, index.data, index.data_norms,
-            index.indices, axis=comms.axis, mesh=comms.mesh,
-            n_probes=n_probes, k=k, metric=index.metric,
-            probe_mode=probe_mode, query_axis=query_axis,
-            coarse_algo=params.coarse_algo, scan_engine=scan_engine,
-            wire_dtype=wire_dtype, probe_wire_dtype=probe_wire_dtype,
-        )
+        # lazy: only a traced dispatch (trace_id=) builds the model
+        model = lambda: collective_payload_model(  # noqa: E731
+            queries.shape[0], k, n_probes, index.n_lists, comms.size,
+            wire_dtype, probe_mode, probe_wire_dtype)
+        return record_dispatch(
+            "dist_ivf_flat", model, trace_id, axis=comms.axis,
+            thunk=lambda: _dist_search(
+                queries, index.centers, index.data, index.data_norms,
+                index.indices, axis=comms.axis, mesh=comms.mesh,
+                n_probes=n_probes, k=k, metric=index.metric,
+                probe_mode=probe_mode, query_axis=query_axis,
+                coarse_algo=params.coarse_algo, scan_engine=scan_engine,
+                wire_dtype=wire_dtype, probe_wire_dtype=probe_wire_dtype,
+            ))
 
 
 def build_streaming(
@@ -909,11 +986,13 @@ def search_pq(
     query_axis: Optional[str] = None,
     wire_dtype: str = "f32",
     probe_wire_dtype: str = "f32",
+    trace_id: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """One-program distributed PQ search (LUT scoring per shard, lean
     global merge); semantics of :func:`search` incl. the 2-D
-    ``query_axis``, the ``wire_dtype`` result compression, and the
-    ``probe_wire_dtype`` quantized probe-candidate exchange. The probe
+    ``query_axis``, the ``wire_dtype`` result compression, the
+    ``probe_wire_dtype`` quantized probe-candidate exchange, and the
+    opt-in ``trace_id`` mesh span recording. The probe
     scan follows ``params.scan_engine`` (``auto|xla|rank``, resolved by
     :func:`raft_tpu.neighbors.ivf_pq.resolve_scan_engine`)."""
     ensure_resources(res)
@@ -932,13 +1011,19 @@ def search_pq(
     scan_engine = ivf_pq_mod.resolve_scan_engine(params.scan_engine)
     queries = jax.device_put(queries, qsharding)
     with tracing.range("raft_tpu.distributed.ivf_pq.search"):
-        return _dist_search_pq(
-            queries, index.centers, index.rotation, index.codebooks,
-            index.codes, index.indices, axis=comms.axis, mesh=comms.mesh,
-            n_probes=n_probes, k=k, metric=index.metric,
-            probe_mode=probe_mode, query_axis=query_axis,
-            codebook_kind=index.codebook_kind,
-            score_mode=params.score_mode, lut_dtype=params.lut_dtype,
-            coarse_algo=params.coarse_algo, scan_engine=scan_engine,
-            wire_dtype=wire_dtype, probe_wire_dtype=probe_wire_dtype,
-        )
+        # lazy: only a traced dispatch (trace_id=) builds the model
+        model = lambda: collective_payload_model(  # noqa: E731
+            queries.shape[0], k, n_probes, index.n_lists, comms.size,
+            wire_dtype, probe_mode, probe_wire_dtype)
+        return record_dispatch(
+            "dist_ivf_pq", model, trace_id, axis=comms.axis,
+            thunk=lambda: _dist_search_pq(
+                queries, index.centers, index.rotation, index.codebooks,
+                index.codes, index.indices, axis=comms.axis,
+                mesh=comms.mesh, n_probes=n_probes, k=k,
+                metric=index.metric, probe_mode=probe_mode,
+                query_axis=query_axis, codebook_kind=index.codebook_kind,
+                score_mode=params.score_mode, lut_dtype=params.lut_dtype,
+                coarse_algo=params.coarse_algo, scan_engine=scan_engine,
+                wire_dtype=wire_dtype, probe_wire_dtype=probe_wire_dtype,
+            ))

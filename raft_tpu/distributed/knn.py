@@ -42,6 +42,26 @@ from raft_tpu.matrix.select_k import merge_topk
 from raft_tpu.neighbors.brute_force import knn_merge_parts
 
 
+def _traced_knn_dispatch(family: str, trace_id, q: int, k: int,
+                         r: int, axis: str, thunk):
+    """Opt-in graftscope-v2 span recording for the exact-kNN mesh
+    programs — a thin phase adapter over the shared
+    :func:`raft_tpu.distributed.ivf.record_dispatch` protocol: kNN has
+    no coarse phase (the scan + one merge collective IS the program),
+    so the merge span carries the modeled per-shard gather payload
+    (the (q, k) distance+id pairs each of the ``r`` shards
+    contributes) and the coarse phase is simply absent. ``axis`` is
+    the caller's mesh axis (span attr)."""
+    from raft_tpu.distributed.ivf import record_dispatch
+
+    merge_bytes = q * k * 8          # f32 distance + int32 id per slot
+    return record_dispatch(
+        family, None, trace_id, thunk, axis=axis,
+        phases={"scan": {"modeled": True, "wire_bytes": 0},
+                "merge": {"modeled": True, "wire_bytes": merge_bytes}},
+        modeled_bytes=float(merge_bytes), attrs={"shards": r})
+
+
 def brute_force_knn(
     comms: Comms,
     dataset,
@@ -50,6 +70,7 @@ def brute_force_knn(
     metric: DistanceType = DistanceType.L2Expanded,
     metric_arg: float = 2.0,
     db_tile: int = 32768,
+    trace_id: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN over a row-sharded dataset.
 
@@ -58,6 +79,8 @@ def brute_force_knn(
       dataset: (n, d) — placed row-sharded if not already.
       queries: (q, d) — replicated to every shard.
       k: neighbors per query.
+      trace_id: opt-in mesh span recording (blocks + times the
+        dispatch — :func:`_traced_knn_dispatch`).
 
     Returns (distances (q, k), global indices (q, k) int32), identical to
     single-device ``brute_force.knn`` up to tie ordering.
@@ -95,7 +118,9 @@ def brute_force_knn(
         )(ds, qs)
 
     with tracing.range("raft_tpu.distributed.brute_force_knn"):
-        return _run(dataset, queries)
+        return _traced_knn_dispatch(
+            "dist_knn", trace_id, queries.shape[0], k, comms.size,
+            comms.axis, lambda: _run(dataset, queries))
 
 
 def brute_force_knn_ring(
@@ -106,6 +131,7 @@ def brute_force_knn_ring(
     metric: DistanceType = DistanceType.L2Expanded,
     metric_arg: float = 2.0,
     db_tile: int = 32768,
+    trace_id: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN with BOTH dataset and queries row-sharded; query blocks
     circulate the ring (``ppermute``) so nothing is ever replicated.
@@ -168,7 +194,9 @@ def brute_force_knn_ring(
         )(ds, qs)
 
     with tracing.range("raft_tpu.distributed.brute_force_knn_ring"):
-        return _run(dataset, queries)
+        return _traced_knn_dispatch(
+            "dist_knn_ring", trace_id, queries.shape[0], k, R,
+            comms.axis, lambda: _run(dataset, queries))
 
 
 def _local_scan(queries, dataset, k: int, metric, metric_arg, tile: int,

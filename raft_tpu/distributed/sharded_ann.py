@@ -12,6 +12,7 @@ Dask worker role); per-shard compute stays jitted on its device.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
@@ -53,6 +54,7 @@ class ShardedIndex:
         res: Optional[Resources],
         queries,
         k: int,
+        trace_id: Optional[int] = None,
     ) -> Tuple[jax.Array, jax.Array]:
         """Fan out to every shard, merge with the shared top-k merge.
 
@@ -63,10 +65,21 @@ class ShardedIndex:
         come back to the merge device in ONE batched transfer, and the
         merge is one ``knn_merge_parts`` over the stacked parts —
         offsets applied on the merge device so shard devices run only
-        their search."""
+        their search.
+
+        Straggler attribution (graftscope v2), opt-in via
+        ``trace_id``: because this path dispatches per shard, each
+        shard's host-side readiness is individually observable — after
+        the fan-out a non-blocking poll measures every part's arrival
+        offset and feeds the straggler detector
+        (``serving.mesh.{shard_skew,slowest_shard}`` gauges +
+        per-shard spans tagged with the id). The wait adds nothing to
+        the critical path: the batched gather right after blocks on
+        the same results."""
         res = ensure_resources(res)
         queries = jnp.asarray(queries)
         with tracing.range("raft_tpu.distributed.sharded_search"):
+            t0 = time.perf_counter()
             # one batched host->device scatter of the query block
             devs = [_index_device(ix) for ix in self.shards]
             unique_devs = [d for d in dict.fromkeys(devs) if d is not None]
@@ -78,6 +91,19 @@ class ShardedIndex:
                 self.search_fn(res, index, placed.get(dev, queries), k)
                 for index, dev in zip(self.shards, devs)
             ]
+            # per-shard arrival times — the straggler detector's
+            # input, opt-in via trace_id (same discipline as the
+            # executor's default-off mesh_trace: unconditional
+            # recording would fill the bounded span ring with shard
+            # spans under steady traffic and evict the per-request
+            # spans /trace.json?trace_id= exists to serve); the shared
+            # non-blocking poll — see tracing.poll_shard_timings for
+            # why sequential blocking would hide early stragglers
+            if trace_id is not None:
+                timings = tracing.poll_shard_timings(parts, t0)
+                tracing.record_mesh_spans(
+                    "sharded_ann", t0, t0 + max(timings),
+                    trace_ids=(trace_id,), shard_timings=timings)
             # ONE batched gather of the (q, k) parts to the merge device
             merge_dev = res.device or jax.devices()[0]
             flat = [a for d, i in parts for a in (d, i)]

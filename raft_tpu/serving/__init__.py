@@ -24,16 +24,31 @@ letting tail latency or overload take the service down:
   test suite and the bench rider share.
 - :mod:`~raft_tpu.serving.exporter` — :class:`MetricsExporter`: the
   pull-based observability endpoint (PR 6 graftscope) — Prometheus
-  text exposition, a JSON snapshot, and the span flight recorder as
-  Chrome trace-event JSON for Perfetto overlays.
+  text exposition (labeled per-executable families since PR 7), a JSON
+  snapshot, the span flight recorder as Chrome trace-event JSON for
+  Perfetto overlays (``?trace_id=`` per-request filter), and a gated
+  on-demand ``/profile`` capture.
+
+graftscope v2 (PR 7) additions: deadline-SLO attainment counters and
+a sliding-window burn-rate gauge (:class:`~raft_tpu.serving.metrics
+.SloConfig` / ``SloWindow``, batcher clock domain), the opt-in
+:class:`~raft_tpu.serving.batcher.AdaptiveWait` arrival-rate →
+max-wait control law, and mesh-deep trace propagation (the batcher
+hands its members' ``trace_id``s to the executor, whose mesh
+dispatches record per-shard straggler spans).
 
 Works unchanged for single-chip and mesh-sharded (``Distributed*``)
 indexes — the batcher only talks to the executor API.
 """
 
 from raft_tpu.serving.admission import AdmissionQueue, LoadShed
-from raft_tpu.serving.batcher import BatcherConfig, DynamicBatcher
+from raft_tpu.serving.batcher import (
+    AdaptiveWait,
+    BatcherConfig,
+    DynamicBatcher,
+)
 from raft_tpu.serving.exporter import MetricsExporter
+from raft_tpu.serving.metrics import SloConfig, SloWindow
 from raft_tpu.serving.request import (
     Cancelled,
     DeadlineExceeded,
@@ -45,6 +60,7 @@ from raft_tpu.serving.request import (
 )
 
 __all__ = [
+    "AdaptiveWait",
     "AdmissionQueue",
     "BatcherConfig",
     "Cancelled",
@@ -57,4 +73,6 @@ __all__ = [
     "SearchRequest",
     "ServingError",
     "ShutDown",
+    "SloConfig",
+    "SloWindow",
 ]

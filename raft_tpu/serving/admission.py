@@ -81,9 +81,13 @@ class AdmissionQueue:
     ``max_wait_s`` control loop."""
 
     def __init__(self, capacity: int = 1024,
-                 shed: Optional[LoadShed] = None):
+                 shed: Optional[LoadShed] = None, slo=None):
         self.capacity = capacity
         self.shed = shed or LoadShed()
+        # optional SloWindow: deadline sheds are SLO misses, and they
+        # happen here (lazy pruning) — the batcher injects its window
+        # so both completion paths feed one burn-rate ledger
+        self._slo = slo
         self._lock = threading.Lock()
         self._groups: Dict[Any, List[SearchRequest]] = {}
         self._n = 0
@@ -217,6 +221,8 @@ class AdmissionQueue:
                     "serving.shed", now, trace_ids=(r.trace_id,),
                     attrs={"reason": "deadline",
                            "late_s": now - r.deadline})
+                if self._slo is not None:
+                    self._slo.record(now, False)
         if shed or cancelled:
             self._publish_gauges(n, rate)
         return best

@@ -45,6 +45,7 @@ from raft_tpu.core.validation import expect
 from raft_tpu.serving import metrics
 from raft_tpu.serving.admission import AdmissionQueue, LoadShed
 from raft_tpu.serving.request import (
+    Overloaded,
     ResultHandle,
     SearchRequest,
     ShutDown,
@@ -65,6 +66,37 @@ class MonotonicClock:
 
 
 @dataclasses.dataclass(frozen=True)
+class AdaptiveWait:
+    """Control law for the adaptive ``max_wait_s`` (PR 7, closing the
+    serving follow-on whose measurement half —
+    ``serving.admission.arrival_rate_hz`` — shipped in PR 6): map the
+    admission queue's EWMA arrival rate to a bounded effective
+    max-wait. High rate → shrink toward ``min_wait_s`` (bursts fill
+    buckets fast; extra waiting only adds latency); idle → grow toward
+    the configured ``max_wait_s`` cap (a lone request may as well wait
+    the full budget for company). Linear interpolation between the two
+    rate knees, so the manual-clock tests pin the output exactly; the
+    rate itself is clock-domain (EWMA over ``req.arrival`` gaps), so
+    the whole loop stays deterministic under the fault harness. Off by
+    default — see :attr:`BatcherConfig.adaptive_wait`."""
+
+    low_rate_hz: float = 50.0
+    high_rate_hz: float = 2000.0
+    min_wait_s: float = 0.0
+
+    def wait_for(self, rate_hz: float, max_wait_s: float) -> float:
+        """Effective max-wait for the observed arrival rate (0.0 rate
+        — nothing measured yet — gets the full configured cap)."""
+        if rate_hz <= self.low_rate_hz:
+            return max_wait_s
+        if rate_hz >= self.high_rate_hz:
+            return self.min_wait_s
+        frac = ((rate_hz - self.low_rate_hz)
+                / (self.high_rate_hz - self.low_rate_hz))
+        return max_wait_s + (self.min_wait_s - max_wait_s) * frac
+
+
+@dataclasses.dataclass(frozen=True)
 class BatcherConfig:
     """Tuning knobs for :class:`DynamicBatcher`.
 
@@ -74,13 +106,20 @@ class BatcherConfig:
     single requests still dispatch alone — the executor tiles them).
     ``capacity`` bounds the admission queue; ``default_timeout_s``
     applies a deadline to requests that do not carry one (None = no
-    deadline). ``shed`` is the degradation ladder."""
+    deadline). ``shed`` is the degradation ladder. ``slo`` configures
+    the deadline-attainment burn-rate window (None disables the SLO
+    surface). ``adaptive_wait`` (off by default) enables the
+    arrival-rate → max-wait control law; the shed ladder's rung 1
+    (wait → 0) still takes precedence over it."""
 
     max_wait_s: float = 0.002
     full_batch_rows: int = 256
     capacity: int = 1024
     default_timeout_s: Optional[float] = None
     shed: LoadShed = dataclasses.field(default_factory=LoadShed)
+    slo: Optional[metrics.SloConfig] = dataclasses.field(
+        default_factory=metrics.SloConfig)
+    adaptive_wait: Optional[AdaptiveWait] = None
 
 
 class DynamicBatcher:
@@ -110,8 +149,12 @@ class DynamicBatcher:
         expect(self.config.full_batch_rows > 0,
                "full_batch_rows must be > 0")
         self._clock = clock or MonotonicClock()
+        self._slo = (metrics.SloWindow(self.config.slo)
+                     if self.config.slo is not None else None)
+        # the queue records deadline-shed requests as SLO misses (they
+        # are pruned inside its lock, where the batcher never sees them)
         self._queue = AdmissionQueue(self.config.capacity,
-                                     self.config.shed)
+                                     self.config.shed, slo=self._slo)
         self._cond = threading.Condition()
         self._closing = False
         self._thread: Optional[threading.Thread] = None
@@ -177,7 +220,15 @@ class DynamicBatcher:
         with self._cond:
             if self._closing:
                 raise ShutDown("batcher is closed")
-            self._queue.push(req)      # typed Overloaded on overflow
+            try:
+                self._queue.push(req)  # typed Overloaded on overflow
+            except Overloaded:
+                # a rejected deadline-carrying request IS an SLO miss:
+                # under total overload the window must fill with misses,
+                # not sit empty reading burn_rate = 0 during the outage
+                if self._slo is not None and req.deadline is not None:
+                    self._slo.record(now, False)
+                raise
             self._cond.notify_all()
         tracing.record_span(
             "serving.admission", now, self._clock.now(),
@@ -238,12 +289,28 @@ class DynamicBatcher:
 
     # -- worker -------------------------------------------------------------
 
+    def publish_slo_gauges(self) -> None:
+        """Re-publish the SLO burn-rate gauges as of the batcher
+        clock's now — the exporter's scrape-time refresh, so misses age
+        out of the window even while no new requests complete."""
+        if self._slo is not None:
+            self._slo.publish(self._clock.now())
+
     def _effective_max_wait(self) -> float:
         """Ladder rung 1: above ``shrink_wait_at`` occupancy the timer
-        trigger collapses to 0 — drain beats batching delay."""
+        trigger collapses to 0 — drain beats batching delay. Below it,
+        the optional :class:`AdaptiveWait` control law maps the
+        observed arrival rate into [min_wait, max_wait] (published as
+        the ``serving.batcher.effective_max_wait_s`` gauge)."""
         if self._queue.shed_level() >= 1:
             return 0.0
-        return self.config.max_wait_s
+        aw = self.config.adaptive_wait
+        if aw is None:
+            return self.config.max_wait_s
+        wait = aw.wait_for(self._queue.arrival_rate(),
+                           self.config.max_wait_s)
+        tracing.set_gauge("serving.batcher.effective_max_wait_s", wait)
+        return wait
 
     def _poll(self):
         """One non-blocking scheduling decision: the next ready
@@ -314,33 +381,55 @@ class DynamicBatcher:
         tracing.record_span("serving.assembly", t0, t1, trace_ids=ids,
                             attrs={"requests": len(reqs), "rows": n_rows})
         try:
+            # trace_ids ride into the executor so mesh dispatches (and
+            # their per-shard straggler spans) attribute back to the
+            # member requests — graftscope v2's mesh-deep propagation
             results = self.executor.search_blocks(
                 rep.index, blocks, rep.k, params=rep.params,
-                sample_filter=fw, **rep.kw)
+                sample_filter=fw, trace_ids=ids, **rep.kw)
             results = jax.block_until_ready(results)
         except Exception as e:  # noqa: BLE001 — fail the handles, not the worker
+            t_fail = self._clock.now()
             for r in reqs:
-                r.handle._set_exception(e)
+                performed = r.handle._set_exception(e)
+                # a failed deadline-carrying request is an SLO miss: a
+                # wedged executor must drive the burn rate up, not
+                # starve the window into a healthy-looking 0.0. Keyed
+                # on the handle transition so a shutdown-drained
+                # request (already completed, exempt by contract) is
+                # not recorded a second time.
+                if performed and self._slo is not None \
+                        and r.deadline is not None:
+                    self._slo.record(t_fail, False)
             tracing.inc_counter("serving.batcher.failed_batches")
             tracing.record_span(
-                "serving.execute", t1, self._clock.now(), trace_ids=ids,
+                "serving.execute", t1, t_fail, trace_ids=ids,
                 attrs={"requests": len(reqs), "rows": n_rows},
-                events=((self._clock.now(), "failed",
+                events=((t_fail, "failed",
                          {"error": type(e).__name__}),))
             return
         t2 = self._clock.now()
         metrics.observe_stage(metrics.EXECUTE, t2 - t1)
         tracing.record_span("serving.execute", t1, t2, trace_ids=ids,
                             attrs={"requests": len(reqs), "rows": n_rows})
-        for r, (d, i) in zip(reqs, results):
-            r.handle._set_result(d, i)
+        delivered = [r.handle._set_result(d, i)
+                     for r, (d, i) in zip(reqs, results)]
         t3 = self._clock.now()
         metrics.observe_stage(metrics.SPLIT, t3 - t2)
         tracing.record_span("serving.split", t2, t3, trace_ids=ids,
                             attrs={"requests": len(reqs)})
-        for r in reqs:
+        for r, ok in zip(reqs, delivered):
             metrics.observe_stage(metrics.E2E, t3 - r.arrival)
             tracing.record_span("serving.request", r.arrival, t3,
                                 trace_ids=(r.trace_id,),
                                 attrs={"rows": r.rows})
+            # SLO attainment: a deadline-carrying request that completed
+            # is attained iff its result landed before the deadline (a
+            # late completion is a miss even though the caller gets a
+            # result — the deadline-shed path records its misses inside
+            # the admission queue). Keyed on the handle transition
+            # (``ok``) so a request something else already completed —
+            # the shutdown drain — lands exactly one outcome.
+            if ok and self._slo is not None and r.deadline is not None:
+                self._slo.record(t3, t3 <= r.deadline)
         metrics.batch_dispatched(len(reqs), n_rows)

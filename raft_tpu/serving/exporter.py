@@ -16,8 +16,29 @@ Endpoints:
   batcher's degradation-ladder rung, and flight-recorder stats.
 - ``/trace.json`` — the span ring as Chrome trace-event JSON; load it
   into Perfetto next to a ``jax.profiler`` capture to overlay host
-  stage spans on the device timeline.
+  stage spans on the device timeline. ``?trace_id=N`` (PR 7) restricts
+  the dump to one request's journey — per-request fetches stop paying
+  for the whole ring; an unknown id returns an empty (valid) trace.
+- ``/profile?seconds=N`` — on-demand ``jax.profiler`` capture (PR 7):
+  gated on a ``profile_dir`` configured at construction (403 when
+  absent — a scraper must not be able to write the service's disk), one
+  capture at a time (409 while busy), N outside [0, 60] rejected with
+  400 (no silent clamping — an operator asking for 120 s should learn
+  the cap, not get a shorter capture than requested). Fetch
+  ``/trace.json`` for the same window and open both in Perfetto — the
+  automated version of the overlay recipe.
 - ``/healthz`` — liveness probe.
+
+Prometheus label support (PR 7): the per-executable cost gauges render
+as ONE metric family per field with a ``digest`` label
+(``serving_executable_peak_hbm_bytes{digest="..."}``) instead of a
+metric name per executable, and the modeled collective payloads label
+by ``family``/``wire``/``probe_wire`` — so dashboards aggregate across
+executables with plain PromQL. The old flat names — the sha1-embedded
+``serving_executable_<digest>_*`` AND the dotted
+``serving_collective_<family>_<wire>_<probe_wire>_*`` spellings — are
+kept for one release behind ``legacy_executable_metrics=True``
+(deprecated; emitted *in addition* to the labeled families).
 
 The exporter holds NO state of its own: every request re-reads the
 live registries, so a scrape is always current and costs the serving
@@ -40,12 +61,21 @@ import http.server
 import json
 import re
 import threading
+import time
+import urllib.parse
 from typing import Optional
 
 from raft_tpu.core import tracing
 from raft_tpu.serving import metrics as serving_metrics
 
 _NAME_SUB = re.compile(r"[^a-zA-Z0-9_:]").sub
+
+# registry names that render as LABELED Prometheus families (PR 7):
+# one family per field, one sample per digest / wire combination
+_EXEC_GAUGE = re.compile(
+    r"^serving\.executable\.([0-9a-f]+)\.([a-z_]+)$")
+_COLLECTIVE_GAUGE = re.compile(
+    r"^serving\.collective\.([^.]+)\.([^.]+)\.([^.]+)\.([a-z_]+)$")
 
 
 def prom_name(name: str) -> str:
@@ -65,22 +95,56 @@ def _fmt(v: float) -> str:
     return repr(f)
 
 
-def render_prometheus(counters: dict, gauges: dict,
-                      histograms: dict) -> str:
+def render_prometheus(counters: dict, gauges: dict, histograms: dict,
+                      legacy_executable_metrics: bool = False) -> str:
     """Render registry snapshots as Prometheus text exposition.
 
     ``histograms`` maps name → :meth:`Histogram.snapshot` dicts (the
     PR 6 shape with ``bucket_bounds`` + cumulative ``bucket_counts``;
-    the final overflow bucket becomes ``le="+Inf"``)."""
+    the final overflow bucket becomes ``le="+Inf"``).
+
+    Per-executable cost gauges and modeled collective payloads render
+    as labeled families (``serving_executable_<field>{digest=...}``,
+    ``serving_collective_<field>{family=...,wire=...,probe_wire=...}``);
+    ``legacy_executable_metrics=True`` ADDITIONALLY emits the
+    deprecated flat names (both the sha1-embedded executable spellings
+    and the dotted collective ones) for one release of overlap."""
     lines = []
     for name in sorted(counters):
         pn = prom_name(name)
         lines.append(f"# TYPE {pn} counter")
         lines.append(f"{pn} {_fmt(counters[name])}")
+    exec_fields: dict = {}
+    coll_fields: dict = {}
     for name in sorted(gauges):
+        m = _EXEC_GAUGE.match(name)
+        if m:
+            exec_fields.setdefault(m.group(2), []).append(
+                (m.group(1), gauges[name]))
+            if not legacy_executable_metrics:
+                continue
+        else:
+            m = _COLLECTIVE_GAUGE.match(name)
+            if m:
+                coll_fields.setdefault(m.group(4), []).append(
+                    (m.group(1), m.group(2), m.group(3), gauges[name]))
+                if not legacy_executable_metrics:
+                    continue
         pn = prom_name(name)
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{pn} {_fmt(gauges[name])}")
+    for field in sorted(exec_fields):
+        pn = f"serving_executable_{prom_name(field)}"
+        lines.append(f"# TYPE {pn} gauge")
+        for digest, v in sorted(exec_fields[field]):
+            lines.append(f'{pn}{{digest="{digest}"}} {_fmt(v)}')
+    for field in sorted(coll_fields):
+        pn = f"serving_collective_{prom_name(field)}"
+        lines.append(f"# TYPE {pn} gauge")
+        for family, wire, probe_wire, v in sorted(coll_fields[field]):
+            lines.append(
+                f'{pn}{{family="{family}",wire="{wire}",'
+                f'probe_wire="{probe_wire}"}} {_fmt(v)}')
     for name in sorted(histograms):
         snap = histograms[name]
         pn = prom_name(name)
@@ -101,14 +165,23 @@ class MetricsExporter:
     ``executor`` (optional) contributes its per-executable cost table
     to ``/snapshot.json``; ``batcher`` (optional) contributes the live
     degradation rung and queue depth (polled at scrape time, so the
-    rung is current even while the event-driven gauges are quiet)."""
+    rung is current even while the event-driven gauges are quiet).
+    ``profile_dir`` arms ``/profile`` (None keeps it 403-disabled);
+    ``legacy_executable_metrics`` additionally emits the deprecated
+    flat per-executable AND per-collective gauge names next to the
+    labeled families."""
 
     def __init__(self, executor=None, batcher=None, *,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 profile_dir: Optional[str] = None,
+                 legacy_executable_metrics: bool = False):
         self.executor = executor
         self.batcher = batcher
         self.host = host
         self.port = port
+        self.profile_dir = profile_dir
+        self.legacy_executable_metrics = legacy_executable_metrics
+        self._profile_lock = threading.Lock()
         self._server: Optional[http.server.ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -117,8 +190,9 @@ class MetricsExporter:
     def prometheus_text(self) -> str:
         """The ``/metrics`` body: full registries, freshly read."""
         self._refresh()
-        return render_prometheus(tracing.counters(), tracing.gauges(),
-                                 tracing.histograms())
+        return render_prometheus(
+            tracing.counters(), tracing.gauges(), tracing.histograms(),
+            legacy_executable_metrics=self.legacy_executable_metrics)
 
     def snapshot(self) -> dict:
         """The ``/snapshot.json`` body."""
@@ -140,9 +214,34 @@ class MetricsExporter:
                         "capacity": rec.capacity}
         return out
 
-    def chrome_trace(self) -> dict:
-        """The ``/trace.json`` body (Perfetto overlay input)."""
-        return tracing.span_recorder().to_chrome_trace()
+    def chrome_trace(self, trace_id: Optional[int] = None) -> dict:
+        """The ``/trace.json`` body (Perfetto overlay input);
+        ``trace_id`` restricts it to one request's spans — an unknown
+        id yields an empty trace, not an error (the id may simply have
+        aged out of the ring)."""
+        return tracing.span_recorder().to_chrome_trace(
+            trace_id=trace_id)
+
+    def profile(self, seconds: float) -> dict:
+        """Run one gated ``jax.profiler`` capture of ``seconds`` into
+        ``profile_dir`` and return ``{"log_dir", "seconds"}``.
+        Raises ``PermissionError`` when no ``profile_dir`` was
+        configured and ``RuntimeError`` when a capture is already in
+        flight — the HTTP layer maps these to 403/409. The capture
+        sleeps wall-clock (no clock *read* — R7-clean): profiling
+        windows are a wall-time concern, not a batcher-clock one."""
+        if self.profile_dir is None:
+            raise PermissionError(
+                "profiling is disabled: construct MetricsExporter with "
+                "profile_dir=... to arm /profile")
+        if not self._profile_lock.acquire(blocking=False):
+            raise RuntimeError("a profiler capture is already running")
+        try:
+            with tracing.capture(self.profile_dir):
+                time.sleep(seconds)
+        finally:
+            self._profile_lock.release()
+        return {"log_dir": self.profile_dir, "seconds": seconds}
 
     def _refresh(self) -> None:
         """Re-publish the poll-style gauges from the attached executor
@@ -155,6 +254,10 @@ class MetricsExporter:
             self.executor.publish_cost_gauges()
         if self.batcher is not None:
             self.batcher._queue.publish_gauges()
+            if hasattr(self.batcher, "publish_slo_gauges"):
+                # burn rate decays as misses age out of the window —
+                # re-evaluated at the batcher clock's now per scrape
+                self.batcher.publish_slo_gauges()
 
     # -- server lifecycle ---------------------------------------------------
 
@@ -178,7 +281,12 @@ class MetricsExporter:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802 — http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
+                # keep_blank_values: '?trace_id=' must surface as a
+                # present-but-empty param and 400 below, not silently
+                # vanish and dump the whole ring / default-capture
+                qs = urllib.parse.parse_qs(query,
+                                           keep_blank_values=True)
                 if path == "/metrics":
                     self._send(exporter.prometheus_text().encode(),
                                "text/plain; version=0.0.4; "
@@ -189,7 +297,40 @@ class MetricsExporter:
                                    default=str).encode(),
                         "application/json")
                 elif path == "/trace.json":
-                    self._send(json.dumps(exporter.chrome_trace()).encode(),
+                    trace_id = None
+                    if "trace_id" in qs:
+                        try:
+                            trace_id = int(qs["trace_id"][0])
+                        except ValueError:
+                            self._send(b"trace_id must be an integer\n",
+                                       "text/plain", 400)
+                            return
+                    self._send(
+                        json.dumps(exporter.chrome_trace(
+                            trace_id=trace_id)).encode(),
+                        "application/json")
+                elif path == "/profile":
+                    try:
+                        seconds = float(qs.get("seconds", ["1.0"])[0])
+                    except ValueError:
+                        seconds = -1.0
+                    if not 0.0 <= seconds <= 60.0:
+                        self._send(b"seconds must be in [0, 60]\n",
+                                   "text/plain", 400)
+                        return
+                    try:
+                        out = exporter.profile(seconds)
+                    except PermissionError as e:
+                        self._send(f"{e}\n".encode(), "text/plain", 403)
+                        return
+                    except RuntimeError as e:
+                        self._send(f"{e}\n".encode(), "text/plain", 409)
+                        return
+                    except Exception as e:  # noqa: BLE001 — report, don't die
+                        self._send(f"capture failed: {e}\n".encode(),
+                                   "text/plain", 500)
+                        return
+                    self._send(json.dumps(out).encode(),
                                "application/json")
                 elif path == "/healthz":
                     self._send(b"ok\n", "text/plain")

@@ -104,14 +104,23 @@ class ShimExecutor:
     (virtual clocks advance; real clocks sleep) — the *slow executor*
     that makes queues pile up on demand. ``fail_on`` maps 0-based call
     ordinals to exceptions to raise instead of executing. The wrapped
-    executor's results pass through untouched."""
+    executor's results pass through untouched.
+
+    ``shard_times`` scripts per-shard mesh timings (graftscope v2): a
+    sequence of per-shard seconds applied to every call, or a dict of
+    0-based call ordinal → sequence. Each scripted call records the
+    timings through :func:`raft_tpu.core.tracing.record_mesh_spans` at
+    the injected clock's current time, exactly as a mesh dispatch
+    would — so the straggler detector's ``serving.mesh.*`` gauges are
+    pinned to the script, device-free."""
 
     def __init__(self, inner, *, delay_s: float = 0.0, clock=None,
-                 fail_on: Optional[dict] = None):
+                 fail_on: Optional[dict] = None, shard_times=None):
         self.inner = inner
         self.delay_s = delay_s
         self.clock = clock
         self.fail_on = dict(fail_on or {})
+        self.shard_times = shard_times
         self.calls: List[Tuple[int, int]] = []
 
     @property
@@ -134,6 +143,17 @@ class ShimExecutor:
                 time.sleep(self.delay_s)
         if ordinal in self.fail_on:
             raise self.fail_on[ordinal]
+        times = self.shard_times
+        if isinstance(times, dict):
+            times = times.get(ordinal)
+        if times:
+            from raft_tpu.core import tracing
+
+            t0 = self.clock.now() if self.clock is not None else 0.0
+            tracing.record_mesh_spans(
+                "shim", t0, t0 + max(times),
+                trace_ids=tuple(kw.get("trace_ids", ())),
+                shard_timings=list(times))
         return self.inner.search_blocks(index, blocks, k, **kw)
 
 

@@ -31,6 +31,28 @@ Per-stage latency **histograms** (log2 buckets, p50/p95/p99 estimates):
 - ``serving.collective.<family>.<wire>.<probe_wire>.*_bytes``
                                                   — modeled mesh wire
 
+**SLO surface** (PR 7 graftscope v2, batcher clock domain):
+
+- ``serving.slo.attained`` / ``.missed``          — deadline-attainment
+  counters: every deadline-carrying request that reaches ``submit()``
+  lands as exactly one of the two (on-time result → attained; completed
+  past its deadline, shed for expiry before dispatch, rejected at
+  admission, or failed with its batch → missed — overload and executor
+  failure must drive the burn rate UP, not starve the window into a
+  healthy-looking 0.0; exempt are the deliberate shutdown drain and
+  caller cancellation that wins before dispatch — a request the client
+  abandoned is not a service outcome)
+- ``serving.slo.burn_rate``                       — sliding-window gauge:
+  the window's miss fraction over the SLO's error budget
+  (``1 − target``); 1.0 = burning budget exactly as provisioned, >1 =
+  on track to exhaust it. All timestamps come from the batcher clock,
+  so the manual-clock tests pin the window arithmetic exactly.
+- ``serving.slo.window_total`` / ``.window_missed`` — current window
+  contents (the burn rate's numerator/denominator, for debugging)
+- ``serving.mesh.shard_skew`` / ``.slowest_shard`` /
+  ``.shard_time_{max,mean}_s``                    — straggler detector
+  output (see :func:`raft_tpu.core.tracing.record_mesh_spans`)
+
 Batch **occupancy** — the coalescing win the ISSUE's acceptance
 criterion gates on — is derived, not stored: ``requests / batches``
 (and ``rows / batches``) from one counters snapshot. Likewise the
@@ -42,6 +64,11 @@ reports — plus the executor cache hit-rate.
 
 from __future__ import annotations
 
+import collections
+import dataclasses
+import threading
+from typing import Optional
+
 from raft_tpu.core import tracing
 
 PREFIX = "serving.batcher."
@@ -51,6 +78,92 @@ ASSEMBLY = PREFIX + "assembly_seconds"
 EXECUTE = PREFIX + "execute_seconds"
 SPLIT = PREFIX + "split_seconds"
 E2E = PREFIX + "e2e_seconds"
+
+SLO_ATTAINED = "serving.slo.attained"
+SLO_MISSED = "serving.slo.missed"
+SLO_BURN_RATE = "serving.slo.burn_rate"
+
+
+@dataclasses.dataclass(frozen=True)
+class SloConfig:
+    """Deadline-SLO definition for the burn-rate window.
+
+    ``target`` is the attainment objective (0.999 = "99.9% of
+    deadline-carrying requests complete on time"); its complement is
+    the error budget the burn rate is normalized by. ``window_s`` is
+    the sliding window (batcher clock domain) the rate is computed
+    over — short windows catch fast burns, long windows catch slow
+    leaks; run one exporter-side recording per deployment and let the
+    alerting layer combine windows."""
+
+    window_s: float = 60.0
+    target: float = 0.999
+
+
+class SloWindow:
+    """Deadline-attainment accounting in the batcher clock's domain.
+
+    :meth:`record` counts one deadline-carrying request's outcome into
+    the monotone ``serving.slo.{attained,missed}`` counters AND a
+    sliding window of (timestamp, attained) events; the **burn rate**
+    — window miss fraction ÷ error budget, the standard SRE
+    multiwindow-alerting quantity — publishes as the
+    ``serving.slo.burn_rate`` gauge. Everything is keyed to caller
+    timestamps (``clock.now()`` / the batcher's stage times), so the
+    window never reads a wall clock and the manual-clock tests pin it
+    exactly. Thread-safe: one lock, O(events-in-window) memory; the
+    miss count is maintained incrementally on append/prune, so every
+    operation is O(events-pruned), not O(window) — record() sits on
+    the per-request completion path."""
+
+    def __init__(self, config: Optional[SloConfig] = None):
+        self.config = config or SloConfig()
+        self._lock = threading.Lock()
+        self._events: "collections.deque" = collections.deque()
+        self._missed = 0
+
+    def _prune_locked(self, now: float) -> None:
+        horizon = now - self.config.window_s
+        while self._events and self._events[0][0] <= horizon:
+            _, ok = self._events.popleft()
+            if not ok:
+                self._missed -= 1
+
+    def _counts(self, now: float):
+        with self._lock:
+            self._prune_locked(now)
+            return len(self._events), self._missed
+
+    def record(self, now: float, attained: bool) -> None:
+        """Count one outcome at clock time ``now`` and re-publish."""
+        tracing.inc_counter(SLO_ATTAINED if attained else SLO_MISSED)
+        with self._lock:
+            self._events.append((now, attained))
+            if not attained:
+                self._missed += 1
+        self.publish(now)
+
+    def burn_rate(self, now: float) -> float:
+        """Window miss fraction over the error budget at ``now`` (0.0
+        for an empty window — no traffic burns no budget)."""
+        total, missed = self._counts(now)
+        if total == 0:
+            return 0.0
+        budget = max(1.0 - self.config.target, 1e-9)
+        return (missed / total) / budget
+
+    def publish(self, now: float) -> None:
+        """Re-publish the window gauges as of ``now`` — called on every
+        record and by the exporter's scrape-time refresh, so a quiet
+        service's burn rate decays as its misses age out of the
+        window."""
+        total, missed = self._counts(now)
+        budget = max(1.0 - self.config.target, 1e-9)
+        tracing.set_gauges({
+            SLO_BURN_RATE: (missed / total) / budget if total else 0.0,
+            "serving.slo.window_total": float(total),
+            "serving.slo.window_missed": float(missed),
+        })
 
 
 def observe_stage(name: str, seconds: float) -> None:

@@ -119,13 +119,18 @@ class ResultHandle:
             self._state = _RUNNING
             return True
 
-    def _set_result(self, distances, indices) -> None:
+    def _set_result(self, distances, indices) -> bool:
+        """Complete with a result (no-op if already done). Returns
+        True when this call performed the completion — the batcher's
+        SLO accounting keys on it, so a shutdown-drained handle is
+        never double-counted."""
         with self._lock:
             if self._state == _DONE:
-                return
+                return False
             self._state = _DONE
             self._result = (distances, indices)
         self._event.set()
+        return True
 
     def _set_exception(self, exc: BaseException) -> bool:
         """Complete with a typed failure (no-op if already done).

@@ -62,6 +62,10 @@ def pytest_sessionfinish(session, exitstatus):
     snap = {
         "exit_status": int(exitstatus),
         "counters": tracing.counters(),
+        # session totals surviving per-test reset_counters() isolation —
+        # what ci/bench_compare.py floors check (the live view above
+        # only carries whatever ran after the LAST reset)
+        "counters_lifetime": tracing.lifetime_counters(),
         "gauges": tracing.gauges(),
         "histograms": tracing.histograms(),
         "spans": {"recorded": len(rec), "dropped": rec.dropped,

@@ -337,6 +337,59 @@ def use_local(time, monotonic):
     return time() + monotonic()    # locals, not the time module
 '''
 
+# PR 7 scope extensions: datetime is a wall-clock read too (span /
+# SLO call sites must stay in the injectable clock's domain), and the
+# comms timed-dispatch shim joins R3's axis-literal discipline
+R7_DATETIME_VIOLATING = '''\
+import datetime
+from datetime import datetime as dt
+
+
+def stamp_span():
+    return datetime.datetime.now().timestamp()
+
+
+def stamp_bare():
+    return dt.utcnow()
+
+
+def day():
+    return datetime.date.today()
+'''
+R7_DATETIME_CONFORMING = '''\
+import datetime
+
+
+def render(ts):
+    # transforming an existing timestamp VALUE reads no clock
+    return datetime.datetime.fromtimestamp(ts).isoformat()
+
+
+def span_times(clock):
+    t0 = clock.now()
+    return t0, clock.now()
+'''
+R3_TIMED_DISPATCH_VIOLATING = '''\
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import timed_dispatch
+
+
+def dispatch(thunk):
+    spec = P("data")
+    return timed_dispatch("knn", thunk, "dataa"), spec
+'''
+R3_TIMED_DISPATCH_CONFORMING = '''\
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import timed_dispatch
+
+
+def dispatch(thunk):
+    spec = P("data")
+    return timed_dispatch("knn", thunk, "data"), spec
+'''
+
 R6_OPS_VIOLATING = '''\
 from jax.experimental import pallas as pl
 
@@ -480,6 +533,29 @@ class TestFixtureCorpus:
         # the same sources outside raft_tpu/serving/ stay quiet
         assert lint_lib(R7_SERVING_VIOLATING, ["R7"],
                         rel="raft_tpu/ops/sample.py").ok
+
+    def test_r7_datetime_clock_reads(self):
+        """PR 7: datetime.now()/utcnow()/date.today() are wall-clock
+        reads — module-dotted and from-import spellings both fire;
+        fromtimestamp (a value transform) stays exempt."""
+        bad = lint_lib(R7_DATETIME_VIOLATING, ["R7"],
+                       rel="raft_tpu/serving/sample.py")
+        assert rules_fired(bad) == {"R7"}
+        assert len(bad.findings) == 3, [f.render() for f in bad.findings]
+        assert lint_lib(R7_DATETIME_CONFORMING, ["R7"],
+                        rel="raft_tpu/serving/sample.py").ok
+        # outside the serving scope: quiet, like the time-module rule
+        assert lint_lib(R7_DATETIME_VIOLATING, ["R7"],
+                        rel="raft_tpu/ops/sample.py").ok
+
+    def test_r3_timed_dispatch_axis_literal(self):
+        """PR 7: the comms timed-dispatch shim is on R3's veneer
+        allowlist — a typo'd axis literal at its call site is the same
+        latent multi-chip bug as one inside a collective."""
+        bad = lint_lib(R3_TIMED_DISPATCH_VIOLATING, ["R3"])
+        assert rules_fired(bad) == {"R3"}
+        assert "'dataa'" in bad.findings[0].message
+        assert lint_lib(R3_TIMED_DISPATCH_CONFORMING, ["R3"]).ok
 
     def test_r6(self):
         bad = lint_texts({"raft_tpu/ops/sample.py": R6_OPS_VIOLATING},

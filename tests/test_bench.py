@@ -605,3 +605,146 @@ class TestHnswCpuBaseline:
         rows = run_benchmark(dataset_dir, config, tmp_path / "res",
                              k=10, search_iters=1)
         assert [r["algo"] for r in rows] == ["raft_brute_force"]
+
+
+class TestBenchCompare:
+    """The CI perf-regression gate (graftscope v2): ``ci/bench_compare``
+    must pass a record against itself, exit nonzero on an injected
+    throughput/latency regression beyond tolerance, and floor-check the
+    metrics snapshot's modeled-throughput counters."""
+
+    @pytest.fixture(scope="class")
+    def bc(self):
+        import importlib.util
+        import pathlib
+
+        path = (pathlib.Path(__file__).parent.parent / "ci"
+                / "bench_compare.py")
+        spec = importlib.util.spec_from_file_location(
+            "bench_compare", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    @pytest.fixture
+    def record(self):
+        return {
+            "value": 1000.0,
+            "serving": {
+                "qps": 800.0,
+                "baseline_one_per_call_qps": 400.0,
+                "p99_ms": 20.0,
+                "requests_per_batch": 4.0,
+                "completed": 96.0,
+                "backend_compiles_during_load": 22.0,
+                "modeled_exec_bytes": 7e6,
+                "modeled_exec_flops": 3e7,
+            },
+        }
+
+    def test_identical_records_pass(self, bc, record):
+        assert bc.compare(record, record) == []
+
+    def test_injected_throughput_regression_fails(self, bc, record):
+        import copy
+
+        slow = copy.deepcopy(record)
+        slow["serving"]["qps"] = record["serving"]["qps"] * 0.1
+        msgs = bc.compare(record, slow)
+        assert any("serving.qps" in m for m in msgs)
+        # within the band: a 2x slowdown on a 0.30 min_ratio passes
+        ok = copy.deepcopy(record)
+        ok["serving"]["qps"] = record["serving"]["qps"] * 0.5
+        assert bc.compare(record, ok) == []
+
+    def test_injected_latency_and_compile_regressions_fail(self, bc,
+                                                           record):
+        import copy
+
+        bad = copy.deepcopy(record)
+        bad["serving"]["p99_ms"] = 200.0        # > 4x and > base + 50
+        msgs = bc.compare(record, bad)
+        assert any("p99_ms" in m for m in msgs)
+        rec = copy.deepcopy(record)
+        rec["serving"]["backend_compiles_during_load"] = 100.0
+        msgs = bc.compare(record, rec)
+        assert any("backend_compiles_during_load" in m for m in msgs)
+
+    def test_missing_fresh_column_is_a_regression(self, bc, record):
+        import copy
+
+        gone = copy.deepcopy(record)
+        del gone["serving"]["modeled_exec_bytes"]
+        msgs = bc.compare(record, gone)
+        assert any("modeled_exec_bytes" in m and "missing" in m
+                   for m in msgs)
+        # the converse — a column only the FRESH run has — is fine
+        # (old baselines must not fail new code)
+        extra = copy.deepcopy(record)
+        del extra["serving"]["modeled_exec_bytes"]
+        assert bc.compare(extra, record) == []
+
+    def test_snapshot_floors(self, bc):
+        ok = {"counters": {"serving.execute.calls": 5.0,
+                           "serving.execute.modeled_bytes": 1e6,
+                           "serving.execute.modeled_flops": 1e7}}
+        assert bc.check_snapshot(ok) == []
+        dark = {"counters": {"serving.execute.calls": 5.0,
+                             "serving.execute.modeled_bytes": 0.0}}
+        msgs = bc.check_snapshot(dark)
+        assert any("modeled_bytes" in m for m in msgs)
+        assert any("modeled_flops" in m and "missing" in m
+                   for m in msgs)
+
+    def test_snapshot_floors_prefer_lifetime_ledger(self, bc):
+        """The floors read ``counters_lifetime`` when present: the live
+        ``counters`` view only holds what ran after the session's LAST
+        ``reset_counters()`` — ordering-dependent — while the lifetime
+        ledger accumulates across resets (conftest writes both)."""
+        snap = {
+            "counters": {},  # a late test reset the live registry
+            "counters_lifetime": {
+                "serving.execute.calls": 5.0,
+                "serving.execute.modeled_bytes": 1e6,
+                "serving.execute.modeled_flops": 1e7,
+            },
+        }
+        assert bc.check_snapshot(snap) == []
+
+    def test_main_exits_nonzero_on_injected_regression(self, bc, record,
+                                                       tmp_path):
+        """End-to-end through ``main()``: the gate's exit code is the
+        CI contract — 0 within bands, 1 on regression."""
+        import copy
+
+        baseline = {"record": record,
+                    "tolerances": bc.DEFAULT_TOLERANCES,
+                    "snapshot_floors": bc.SNAPSHOT_FLOORS}
+        bpath = tmp_path / "baseline.json"
+        bpath.write_text(json.dumps(baseline))
+        good = tmp_path / "fresh_ok.json"
+        good.write_text(json.dumps(record))
+        assert bc.main(["--baseline", str(bpath),
+                        "--fresh", str(good)]) == 0
+        slow = copy.deepcopy(record)
+        slow["serving"]["qps"] = 1.0
+        bad = tmp_path / "fresh_bad.json"
+        bad.write_text(json.dumps(slow))
+        assert bc.main(["--baseline", str(bpath),
+                        "--fresh", str(bad)]) == 1
+        # missing baseline without --update is a usage error, not a pass
+        assert bc.main(["--baseline", str(tmp_path / "absent.json"),
+                        "--fresh", str(good)]) == 2
+
+    def test_update_writes_baseline(self, bc, record, tmp_path):
+        bpath = tmp_path / "baseline.json"
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(record))
+        assert bc.main(["--baseline", str(bpath), "--fresh", str(fresh),
+                        "--update"]) == 0
+        out = json.loads(bpath.read_text())
+        assert out["record"] == record
+        assert out["tolerances"] == bc.DEFAULT_TOLERANCES
+        # and the freshly written baseline gates against itself
+        assert bc.main(["--baseline", str(bpath),
+                        "--fresh", str(fresh)]) == 0

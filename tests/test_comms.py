@@ -90,6 +90,34 @@ class TestCollectives:
         np.testing.assert_allclose(np.asarray(out),
                                    np.arange(N_DEV, dtype=np.float32) * N_DEV)
 
+    def test_ledger_one_bump_per_call(self, comms):
+        """Delegating veneers (reduce → allreduce body, non-SUM
+        reducescatter, device_recv → ring permute) must bump the
+        trace-time collective ledger exactly once, under their OWN
+        family — a scrape reading comms.* must not see one logical
+        collective double-counted (graftscope v2 wire-cost ledger)."""
+        from raft_tpu.comms.comms import device_recv, reduce
+        from raft_tpu.core import tracing
+
+        x = np.tile(np.arange(N_DEV, dtype=np.float32), N_DEV)
+        before = {k: tracing.get_counter(f"comms.{k}.calls")
+                  for k in ("reducescatter", "allreduce", "reduce",
+                            "device_send", "device_recv")}
+        comms.run(lambda v: reducescatter(v, Op.MAX, comms.axis),
+                  self._shard(comms, x),
+                  in_specs=P(comms.axis), out_specs=P(comms.axis))
+        comms.run(lambda v: reduce(v, 0, Op.SUM, comms.axis),
+                  self._shard(comms, x),
+                  in_specs=P(comms.axis), out_specs=P(comms.axis))
+        comms.run(lambda v: device_recv(v, 1, comms.axis),
+                  self._shard(comms, np.arange(N_DEV, dtype=np.float32)),
+                  in_specs=P(comms.axis), out_specs=P(comms.axis))
+        delta = {k: tracing.get_counter(f"comms.{k}.calls") - before[k]
+                 for k in before}
+        assert delta == {"reducescatter": 1.0, "allreduce": 0.0,
+                         "reduce": 1.0, "device_send": 0.0,
+                         "device_recv": 1.0}
+
     def test_alltoall(self, comms):
         # rank r holds rows [r*8, (r+1)*8); after alltoall rank r holds
         # block r of every rank

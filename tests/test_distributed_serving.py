@@ -439,3 +439,159 @@ class TestPayloadGauges:
         ex.publish_cost_gauges()
         assert tracing.gauges(base)[base + "merge_bytes"] == (
             model["merge_bytes"])
+
+
+class TestMeshSpans:
+    """graftscope v2: trace_id propagation into the distributed search
+    — phase spans with modeled wire bytes, per-shard straggler spans,
+    and the regressions (bit-identity + zero-recompile) re-asserted
+    with mesh tracing fully enabled."""
+
+    def test_executor_mesh_span_tree(self, data, flat_pair):
+        _, q = data
+        single, dist = flat_pair
+        tracing.reset_spans()
+        tracing.reset_gauges("serving.mesh.")
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        ex = SearchExecutor(mesh_trace=True)
+        tid = tracing.new_trace_id()
+        d1, i1 = ex.search(dist, q, 5, params=sp, trace_ids=(tid,))
+        # tracing changes nothing about the results
+        d0, i0 = ivf_flat.search(None, sp, single, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        rec = tracing.span_recorder()
+        # the three mesh phases, each carrying the trace id AND the
+        # modeled wire bytes of the entry's collective_payload_model
+        model = dist_ivf.collective_payload_model(
+            16, 5, 8, dist.n_lists, N_DEV)
+        (cs,) = rec.spans(trace_id=tid,
+                          name="serving.mesh.coarse_select")
+        assert cs.attrs["wire_bytes"] == model["coarse_bytes"]
+        (mg,) = rec.spans(trace_id=tid, name="serving.mesh.merge")
+        assert mg.attrs["wire_bytes"] == model["merge_bytes"]
+        assert rec.spans(trace_id=tid, name="serving.mesh.scan")
+        # one readiness span per shard of the 8-device mesh, and the
+        # straggler gauges reduced from those timings
+        shards = rec.spans(trace_id=tid, name="serving.mesh.shard")
+        assert [s.attrs["shard"] for s in shards] == list(range(N_DEV))
+        assert all(s.attrs["family"] == "dist_ivf_flat" for s in shards)
+        slowest = tracing.get_gauge(tracing.MESH_SLOWEST_SHARD)
+        times = [s.duration for s in shards]
+        assert times[int(slowest)] == max(times)
+        assert tracing.get_gauge(
+            tracing.MESH_SHARD_SKEW) == pytest.approx(
+                max(times) - min(times))
+
+    def test_zero_recompiles_with_mesh_tracing_enabled(self, data,
+                                                       flat_pair):
+        _, q = data
+        _, dist = flat_pair
+        tracing.install_xla_compile_listener()
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        ex = SearchExecutor(mesh_trace=True)
+        tid = tracing.new_trace_id()
+        for n in (16, 13, 9):
+            ex.search(dist, q[:n], 5, params=sp, trace_ids=(tid,))
+        compiles0 = ex.stats.compile_count
+        assert compiles0 == 1
+        backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        for n in (16, 13, 9, 13, 16):
+            ex.search(dist, q[:n], 5, params=sp, trace_ids=(tid,))
+        assert ex.stats.compile_count == compiles0
+        assert tracing.get_counter(tracing.XLA_COMPILE_COUNT) == backend0
+
+    def test_direct_search_trace_id(self, data, flat_pair):
+        _, q = data
+        single, dist = flat_pair
+        tracing.reset_spans()
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        tid = tracing.new_trace_id()
+        d1, i1 = dist_ivf.search(None, sp, dist, q, 5, trace_id=tid)
+        d0, i0 = ivf_flat.search(None, sp, single, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        rec = tracing.span_recorder()
+        # the timed-dispatch wrapper's span + the three phase spans
+        (disp,) = rec.spans(trace_id=tid,
+                            name="comms.dispatch.dist_ivf_flat")
+        assert disp.duration > 0
+        assert disp.attrs["modeled_bytes"] > 0
+        assert rec.spans(trace_id=tid, name="serving.mesh.merge")
+        assert tracing.get_counter(
+            "comms.dispatch.dist_ivf_flat.calls") >= 1.0
+        # untraced calls record nothing new (opt-in contract)
+        n0 = len(rec.spans())
+        dist_ivf.search(None, sp, dist, q, 5)
+        assert len(rec.spans()) == n0
+
+    def test_bq_direct_search_trace_id(self, comms, data):
+        x, q = data
+        from raft_tpu.neighbors.ivf_bq import (
+            IvfBqIndexParams,
+            IvfBqSearchParams,
+        )
+
+        tracing.reset_spans()
+        dist = dist_bq.build_bq(None, comms,
+                                IvfBqIndexParams(n_lists=16), x)
+        tid = tracing.new_trace_id()
+        dist_bq.search_bq(None, IvfBqSearchParams(n_probes=8), dist,
+                          q, 5, trace_id=tid)
+        rec = tracing.span_recorder()
+        assert rec.spans(trace_id=tid,
+                         name="comms.dispatch.dist_ivf_bq")
+        assert rec.spans(trace_id=tid, name="serving.mesh.merge")
+
+    def test_collective_trace_counters_inventory(self, data, flat_pair):
+        """The comms veneer's trace-time accounting: tracing a mesh
+        program bumps per-family calls/bytes counters, and repeat
+        dispatches of the compiled program add nothing."""
+        _, q = data
+        _, dist = flat_pair
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        ex = SearchExecutor()
+        ex.warmup(dist, buckets=(16,), k=5, params=sp)
+        calls0 = tracing.get_counter("comms.allgather.calls")
+        assert calls0 >= 1.0            # the id gather traced at least once
+        assert tracing.get_counter("comms.allgather.modeled_bytes") > 0
+        ex.search(dist, q, 5, params=sp)
+        ex.search(dist, q, 5, params=sp)
+        # steady state: no re-traces, so the inventory is unchanged
+        assert tracing.get_counter("comms.allgather.calls") == calls0
+
+
+class TestShardedAnnStraggler:
+    """The per-shard-dispatch path measures REAL per-shard readiness:
+    a trace_id-carrying search feeds the straggler detector (opt-in —
+    untraced steady traffic must not fill the span ring)."""
+
+    def test_sharded_search_records_shard_timings(self):
+        import jax
+
+        from raft_tpu.distributed import sharded_ann
+        from raft_tpu.neighbors import brute_force
+
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((512, 16)).astype(np.float32)
+        q = rng.standard_normal((8, 16)).astype(np.float32)
+        idx = sharded_ann.build_sharded(
+            None,
+            lambda res, part: brute_force.build(res, part),
+            lambda res, ix, qs, k: brute_force.search(res, ix, qs, k),
+            x, devices=jax.devices()[:4])
+        tracing.reset_spans()
+        tracing.reset_gauges("serving.mesh.")
+        tid = tracing.new_trace_id()
+        d, i = idx.search(None, q, 5, trace_id=tid)
+        assert np.asarray(i).shape == (8, 5)
+        rec = tracing.span_recorder()
+        shards = rec.spans(trace_id=tid, name="serving.mesh.shard")
+        assert len(shards) == 4
+        assert tracing.get_gauge(tracing.MESH_SHARD_TIME_MAX) > 0
+        assert 0 <= tracing.get_gauge(tracing.MESH_SLOWEST_SHARD) < 4
+        # opt-in: an untraced search records NO shard spans — steady
+        # traffic must not churn the bounded span ring
+        n_before = len(rec.spans(name="serving.mesh.shard"))
+        idx.search(None, q, 5)
+        assert len(rec.spans(name="serving.mesh.shard")) == n_before

@@ -683,7 +683,8 @@ class TestObservability:
                 exp.url("/metrics"), timeout=10).read().decode()
             # every exposition line parses: name[{labels}] value
             line_re = re.compile(
-                r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{le="[^"]+"\})? '
+                r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+                r'(\{[a-zA-Z_]+="[^"]*"(,[a-zA-Z_]+="[^"]*")*\})? '
                 r"[-+0-9.e]+$")
             for line in text.strip().splitlines():
                 if not line.startswith("#"):
@@ -769,5 +770,391 @@ class TestObservability:
         text = MetricsExporter(executor=ex, batcher=b).prometheus_text()
         assert tracing.get_gauge(
             f"serving.executable.{digest}.bytes_accessed") > 0
-        assert f"serving_executable_{digest}_bytes_accessed" in text
+        # PR 7: one labeled family per field; the sha1-embedded flat
+        # name only comes back under the deprecation flag
+        assert (f'serving_executable_bytes_accessed{{digest="{digest}"}}'
+                in text)
+        assert f"serving_executable_{digest}_bytes_accessed" not in text
+        legacy = MetricsExporter(
+            executor=ex, batcher=b,
+            legacy_executable_metrics=True).prometheus_text()
+        assert f"serving_executable_{digest}_bytes_accessed" in legacy
+        assert (f'serving_executable_bytes_accessed{{digest="{digest}"}}'
+                in legacy)
         b.close()
+
+
+class TestSloBurnRate:
+    """graftscope v2 SLO surface — attainment counters and the
+    sliding-window burn rate, pinned exactly under the manual clock
+    (targets are binary-exact fractions so the budget arithmetic has
+    no float fuzz)."""
+
+    def _batcher(self, **slo_kw):
+        from raft_tpu.serving import SloConfig
+
+        clock = ManualClock()
+        b = DynamicBatcher(
+            FakeExecutor(),
+            BatcherConfig(max_wait_s=0.01,
+                          slo=SloConfig(**slo_kw)),
+            clock=clock, start=False)
+        return b, clock
+
+    def test_attained_and_late_completion(self):
+        metrics.reset()
+        b, clock = self._batcher(window_s=10.0, target=0.75)
+        idx = _Index()
+        h1 = b.submit(idx, q_block([1]), 3, timeout_s=1.0)
+        clock.advance(0.01)
+        b.pump()                        # completes well before deadline
+        assert h1.result(timeout=0)
+        assert tracing.get_counter(metrics.SLO_ATTAINED) == 1.0
+        assert tracing.get_counter(metrics.SLO_MISSED) == 0.0
+        assert tracing.get_gauge(metrics.SLO_BURN_RATE) == 0.0
+        # a request that COMPLETES after its deadline is a miss even
+        # though the caller gets a result: claimed into a batch before
+        # expiry (so not shed), finished late under a slow executor
+        shim = ShimExecutor(FakeExecutor(), delay_s=0.2, clock=clock)
+        b2 = DynamicBatcher(
+            shim, BatcherConfig(max_wait_s=0.0, slo=b.config.slo),
+            clock=clock, start=False)
+        h2 = b2.submit(idx, q_block([2]), 3, timeout_s=0.1)
+        b2.pump()                       # dispatches now, takes 0.2 s
+        assert h2.result(timeout=0)     # result delivered...
+        assert tracing.get_counter(metrics.SLO_MISSED) == 1.0  # ...late
+        b.close()
+        b2.close()
+
+    def test_shed_is_a_miss_and_burn_rate_exact(self):
+        metrics.reset()
+        b, clock = self._batcher(window_s=10.0, target=0.75)
+        idx = _Index()
+        h_ok = b.submit(idx, q_block([1]), 3, timeout_s=1.0)
+        clock.advance(0.01)
+        b.pump()
+        assert h_ok.done()
+        h_exp = b.submit(idx, q_block([2]), 3, timeout_s=0.05)
+        clock.advance(1.0)              # expires in queue
+        b.pump()
+        with pytest.raises(DeadlineExceeded):
+            h_exp.result(timeout=0)
+        assert tracing.get_counter(metrics.SLO_ATTAINED) == 1.0
+        assert tracing.get_counter(metrics.SLO_MISSED) == 1.0
+        # window: 1 miss of 2 outcomes; budget = 1 - 0.75 = 0.25 exact
+        assert tracing.get_gauge(metrics.SLO_BURN_RATE) == 2.0
+        b.close()
+
+    def test_window_slide_decays_burn_rate(self):
+        metrics.reset()
+        b, clock = self._batcher(window_s=5.0, target=0.75)
+        idx = _Index()
+        h = b.submit(idx, q_block([1]), 3, timeout_s=0.05)
+        clock.advance(1.0)
+        b.pump()                        # miss at t=1.0
+        assert tracing.get_gauge(metrics.SLO_BURN_RATE) == 4.0
+        clock.advance(4.0)              # t=5.0: event at horizon edge
+        b.publish_slo_gauges()
+        assert tracing.get_gauge(metrics.SLO_BURN_RATE) == 4.0
+        clock.advance(1.01)             # t=6.01: miss aged out
+        b.publish_slo_gauges()
+        assert tracing.get_gauge(metrics.SLO_BURN_RATE) == 0.0
+        assert tracing.get_gauge("serving.slo.window_total") == 0.0
+        # monotone counters are untouched by the slide
+        assert tracing.get_counter(metrics.SLO_MISSED) == 1.0
+        assert h.done()
+        b.close()
+
+    def test_no_deadline_means_no_slo_sample(self):
+        metrics.reset()
+        b, clock = self._batcher(window_s=10.0, target=0.75)
+        idx = _Index()
+        h = b.submit(idx, q_block([1]), 3)      # no deadline
+        clock.advance(0.05)
+        b.pump()
+        assert h.result(timeout=0)
+        assert tracing.get_counter(metrics.SLO_ATTAINED) == 0.0
+        assert tracing.get_counter(metrics.SLO_MISSED) == 0.0
+        b.close()
+
+    def test_admission_reject_is_a_miss(self):
+        """Total overload must drive the burn rate UP: a
+        deadline-carrying request rejected at submit is an SLO miss,
+        so a saturated queue can't starve the window into a
+        healthy-looking 0.0 during the outage."""
+        from raft_tpu.serving import Overloaded, SloConfig
+
+        metrics.reset()
+        clock = ManualClock()
+        b = DynamicBatcher(
+            FakeExecutor(),
+            BatcherConfig(max_wait_s=0.01, capacity=1,
+                          slo=SloConfig(window_s=10.0, target=0.75)),
+            clock=clock, start=False)
+        idx = _Index()
+        b.submit(idx, q_block([1]), 3, timeout_s=1.0)
+        with pytest.raises(Overloaded):
+            b.submit(idx, q_block([2]), 3, timeout_s=1.0)
+        assert tracing.get_counter(metrics.SLO_MISSED) == 1.0
+        assert tracing.get_gauge(metrics.SLO_BURN_RATE) == 4.0
+        # a rejected request WITHOUT a deadline is not an SLO sample
+        with pytest.raises(Overloaded):
+            b.submit(idx, q_block([3]), 3)
+        assert tracing.get_counter(metrics.SLO_MISSED) == 1.0
+        b.close()
+
+    def test_failed_batch_is_a_miss(self):
+        """A wedged executor fails the handles AND burns budget: each
+        deadline-carrying member of the failed batch is a miss."""
+        from raft_tpu.serving import SloConfig
+
+        metrics.reset()
+        clock = ManualClock()
+        shim = ShimExecutor(FakeExecutor(), clock=clock,
+                            fail_on={0: RuntimeError("wedged")})
+        b = DynamicBatcher(
+            shim,
+            BatcherConfig(max_wait_s=0.0,
+                          slo=SloConfig(window_s=10.0, target=0.75)),
+            clock=clock, start=False)
+        idx = _Index()
+        h1 = b.submit(idx, q_block([1]), 3, timeout_s=1.0)
+        h2 = b.submit(idx, q_block([2]), 3)     # no deadline: no sample
+        b.pump()
+        with pytest.raises(RuntimeError):
+            h1.result(timeout=0)
+        with pytest.raises(RuntimeError):
+            h2.result(timeout=0)
+        assert tracing.get_counter(metrics.SLO_MISSED) == 1.0
+        assert tracing.get_counter(metrics.SLO_ATTAINED) == 0.0
+        b.close()
+
+
+class TestAdaptiveWait:
+    """The arrival-rate → max-wait control law (serving follow-on (b)):
+    clock-domain EWMA in, deterministic wait out; off by default; the
+    shed ladder's rung 1 still wins."""
+
+    def test_off_by_default(self):
+        b, ex, clock = manual_batcher(max_wait_s=0.123)
+        assert b.config.adaptive_wait is None
+        assert b._effective_max_wait() == 0.123
+        b.close()
+
+    def test_control_law_endpoints_and_interpolation(self):
+        from raft_tpu.serving import AdaptiveWait
+
+        aw = AdaptiveWait(low_rate_hz=10.0, high_rate_hz=110.0,
+                          min_wait_s=0.001)
+        assert aw.wait_for(0.0, 0.101) == 0.101      # idle -> full cap
+        assert aw.wait_for(10.0, 0.101) == 0.101
+        assert aw.wait_for(110.0, 0.101) == 0.001    # hot -> min
+        assert aw.wait_for(10_000.0, 0.101) == 0.001
+        # exact midpoint of the linear ramp
+        assert aw.wait_for(60.0, 0.101) == pytest.approx(0.051)
+
+    def test_live_rate_drives_effective_wait(self):
+        from raft_tpu.serving import AdaptiveWait
+
+        metrics.reset()
+        aw = AdaptiveWait(low_rate_hz=10.0, high_rate_hz=110.0,
+                          min_wait_s=0.001)
+        clock = ManualClock()
+        b = DynamicBatcher(
+            FakeExecutor(),
+            BatcherConfig(max_wait_s=0.101, capacity=64,
+                          adaptive_wait=aw),
+            clock=clock, start=False)
+        idx = _Index()
+        # uniform 60 Hz arrivals: the EWMA converges to exactly 60.0
+        for i in range(6):
+            b.submit(idx, q_block([i]), 3)
+            clock.advance(1 / 60.0)
+        rate = b._queue.arrival_rate()
+        assert rate == pytest.approx(60.0)
+        want = aw.wait_for(rate, 0.101)
+        assert b._effective_max_wait() == pytest.approx(want)
+        assert tracing.get_gauge(
+            "serving.batcher.effective_max_wait_s") == pytest.approx(
+                want)
+        b.pump()
+        b.close()
+
+    def test_rung1_overrides_adaptive(self):
+        from raft_tpu.serving import AdaptiveWait
+
+        clock = ManualClock()
+        b = DynamicBatcher(
+            FakeExecutor(),
+            BatcherConfig(max_wait_s=0.101, capacity=4,
+                          adaptive_wait=AdaptiveWait()),
+            clock=clock, start=False)
+        idx = _Index()
+        b.submit(idx, q_block([1]), 3)
+        b.submit(idx, q_block([2]), 3)  # occupancy 0.5 -> rung 1
+        assert b._effective_max_wait() == 0.0
+        b.pump()
+        b.close()
+
+
+class TestMeshSpansViaShim:
+    """Scripted per-shard latencies drive the straggler detector
+    end-to-end through the batcher: skew gauges exact, shard spans
+    carry the member requests' trace ids."""
+
+    def test_scripted_shard_skew_gauges_exact(self):
+        metrics.reset()
+        clock = ManualClock()
+        shim = ShimExecutor(FakeExecutor(), clock=clock,
+                            shard_times=[0.003, 0.011, 0.005, 0.004])
+        b = DynamicBatcher(shim, BatcherConfig(max_wait_s=0.0),
+                           clock=clock, start=False)
+        idx = _Index()
+        h1 = b.submit(idx, q_block([1]), 3, timeout_s=5.0)
+        h2 = b.submit(idx, q_block([2]), 3, timeout_s=5.0)
+        b.pump()
+        assert h1.done() and h2.done()
+        assert tracing.get_gauge(
+            tracing.MESH_SHARD_SKEW) == pytest.approx(0.008)
+        assert tracing.get_gauge(tracing.MESH_SLOWEST_SHARD) == 1.0
+        shards = tracing.span_recorder().spans(name="serving.mesh.shard")
+        assert len(shards) == 4
+        # the mesh spans carry BOTH coalesced requests' trace ids —
+        # the straggler attributes back to the requests it delayed
+        for s in shards:
+            assert len(s.trace_ids) == 2
+        b.close()
+
+    def test_per_call_scripts_by_ordinal(self):
+        metrics.reset()
+        clock = ManualClock()
+        shim = ShimExecutor(
+            FakeExecutor(), clock=clock,
+            shard_times={1: [0.002, 0.009]})
+        b = DynamicBatcher(shim, BatcherConfig(max_wait_s=0.0),
+                           clock=clock, start=False)
+        idx = _Index()
+        b.submit(idx, q_block([1]), 3)
+        b.pump()                        # call 0: no script, no spans
+        assert not tracing.span_recorder().spans(
+            name="serving.mesh.shard")
+        b.submit(idx, q_block([2]), 3)
+        b.pump()                        # call 1: scripted
+        assert tracing.get_gauge(
+            tracing.MESH_SHARD_SKEW) == pytest.approx(0.007)
+        b.close()
+
+
+class TestExporterV2Endpoints:
+    """/trace.json?trace_id= filter and the gated /profile capture."""
+
+    def test_trace_id_filter_and_unknown_id(self):
+        import json
+        import urllib.request
+
+        from raft_tpu.serving import MetricsExporter
+
+        metrics.reset()
+        b, ex, clock = manual_batcher(max_wait_s=0.0)
+        idx = _Index()
+        h1 = b.submit(idx, q_block([1]), 3, timeout_s=1.0)
+        b.pump()
+        h2 = b.submit(idx, q_block([2]), 3, timeout_s=1.0)
+        b.pump()
+        assert h1.done() and h2.done()
+        rec = tracing.span_recorder()
+        tid = rec.spans(name="serving.request")[0].trace_ids[0]
+        with MetricsExporter(batcher=b) as exp:
+            t = json.loads(urllib.request.urlopen(
+                exp.url(f"/trace.json?trace_id={tid}"),
+                timeout=10).read())
+            assert t["traceEvents"], "filtered trace must not be empty"
+            for e in t["traceEvents"]:
+                ids = e.get("args", {}).get("trace_ids")
+                if ids is not None:
+                    assert tid in ids
+            # unknown id: 200 with an empty, VALID trace
+            t2 = json.loads(urllib.request.urlopen(
+                exp.url("/trace.json?trace_id=999999999"),
+                timeout=10).read())
+            assert t2["traceEvents"] == []
+            # malformed id: 400 — including present-but-EMPTY
+            # (parse_qs must keep blank values: '?trace_id=' silently
+            # vanishing would dump the whole ring instead)
+            for bad in ("trace_id=bogus", "trace_id="):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(
+                        exp.url(f"/trace.json?{bad}"), timeout=10)
+                assert ei.value.code == 400
+        b.close()
+
+    def test_profile_endpoint_gated_and_captures(self, tmp_path):
+        import json
+        import os
+        import urllib.request
+
+        from raft_tpu.serving import MetricsExporter
+
+        b, ex, clock = manual_batcher(max_wait_s=0.0)
+        # ungated: 403, and nothing written anywhere
+        with MetricsExporter(batcher=b) as exp:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(exp.url("/profile?seconds=0"),
+                                       timeout=10)
+            assert ei.value.code == 403
+        prof = tmp_path / "prof"
+        prof.mkdir()
+        with MetricsExporter(batcher=b,
+                             profile_dir=str(prof)) as exp:
+            out = json.loads(urllib.request.urlopen(
+                exp.url("/profile?seconds=0"), timeout=60).read())
+            assert out["log_dir"] == str(prof)
+            assert os.listdir(prof), "capture wrote nothing"
+            # bad seconds: 400 (malformed and out-of-range alike)
+            for q in ("seconds=bogus", "seconds=-1", "seconds=999",
+                      "seconds="):    # blank must 400, not default
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(exp.url(f"/profile?{q}"),
+                                           timeout=10)
+                assert ei.value.code == 400
+        b.close()
+
+
+class TestPrometheusLabels:
+    """One metric family per executable field with a digest label; the
+    collective payload gauges label by family/wire; legacy flat names
+    only behind the deprecation flag."""
+
+    def test_render_groups_digest_labels(self):
+        from raft_tpu.serving.exporter import render_prometheus
+
+        gauges = {
+            "serving.executable.aaa111.flops": 10.0,
+            "serving.executable.bbb222.flops": 20.0,
+            "serving.executable.aaa111.peak_hbm_bytes": 512.0,
+            "serving.collective.dist_ivf_flat.f32.int8.merge_bytes":
+                1280.0,
+            "serving.executor.cached_executables": 2.0,
+        }
+        text = render_prometheus({}, gauges, {})
+        assert '# TYPE serving_executable_flops gauge' in text
+        assert 'serving_executable_flops{digest="aaa111"} 10' in text
+        assert 'serving_executable_flops{digest="bbb222"} 20' in text
+        assert ('serving_executable_peak_hbm_bytes{digest="aaa111"} 512'
+                in text)
+        assert ('serving_collective_merge_bytes{family="dist_ivf_flat"'
+                ',wire="f32",probe_wire="int8"} 1280' in text)
+        # the TYPE header appears once per family, not per executable
+        assert text.count("# TYPE serving_executable_flops gauge") == 1
+        # plain gauges are untouched; no flat digest names by default
+        assert "serving_executor_cached_executables 2" in text
+        assert "serving_executable_aaa111_flops" not in text
+
+    def test_legacy_flag_emits_both(self):
+        from raft_tpu.serving.exporter import render_prometheus
+
+        gauges = {"serving.executable.aaa111.flops": 10.0}
+        text = render_prometheus({}, gauges, {},
+                                 legacy_executable_metrics=True)
+        assert 'serving_executable_flops{digest="aaa111"} 10' in text
+        assert "serving_executable_aaa111_flops 10" in text

@@ -130,6 +130,22 @@ class TestGauges:
         finally:
             tracing.reset_counters("t_batch.")
 
+    def test_reset_folds_into_lifetime_ledger(self):
+        """``reset_counters`` moves counts into the process-lifetime
+        ledger instead of discarding them: the session-end CI snapshot
+        floors read :func:`lifetime_counters`, so a mid-session test
+        reset must not blank the session's accounting."""
+        tracing.reset_counters("t_life.")
+        base = tracing.lifetime_counters("t_life.")
+        tracing.inc_counter("t_life.a", 2.0)
+        tracing.reset_counters("t_life.")        # folds, not discards
+        tracing.inc_counter("t_life.a", 3.0)     # live again
+        life = tracing.lifetime_counters("t_life.")
+        assert life["t_life.a"] - base.get("t_life.a", 0.0) == 5.0
+        # the LIVE view only sees what ran after the reset
+        assert tracing.get_counter("t_life.a") == 3.0
+        tracing.reset_counters("t_life.")
+
 
 class TestSpanRecorder:
     def test_record_filter_and_trace_ids(self):
@@ -219,5 +235,75 @@ class TestSpanRecorder:
             (s,) = tracing.span_recorder().spans(name="build.extend")
             assert s.end >= s.start
             assert s.attrs == {"n": 3}
+        finally:
+            tracing.reset_spans()
+
+
+class TestStragglerDetector:
+    """graftscope v2: per-shard timings reduce into exact straggler
+    attribution — gauges, phase spans, and the trace_id-filtered
+    Chrome export."""
+
+    def test_straggler_stats_exact(self):
+        stats = tracing.straggler_stats([0.010, 0.004, 0.025, 0.007])
+        assert stats["shards"] == 4
+        assert stats["slowest_shard"] == 2
+        assert stats["shard_skew"] == pytest.approx(0.021)
+        assert stats["max_s"] == 0.025
+        assert stats["mean_s"] == pytest.approx(0.0115)
+        empty = tracing.straggler_stats([])
+        assert empty["slowest_shard"] == -1
+        assert empty["shard_skew"] == 0.0
+
+    def test_record_mesh_spans_spans_and_gauges(self):
+        tracing.reset_spans()
+        tracing.reset_gauges("serving.mesh.")
+        tracing.reset_counters("serving.mesh.")
+        try:
+            tid = tracing.new_trace_id()
+            stats = tracing.record_mesh_spans(
+                "dist_ivf_flat", 10.0, 10.5, trace_ids=(tid,),
+                phases={"coarse_select": {"wire_bytes": 256},
+                        "merge": {"wire_bytes": 1280}},
+                shard_timings=[0.1, 0.5, 0.2])
+            rec = tracing.span_recorder()
+            (cs,) = rec.spans(trace_id=tid,
+                              name="serving.mesh.coarse_select")
+            assert cs.attrs["wire_bytes"] == 256
+            assert cs.attrs["family"] == "dist_ivf_flat"
+            assert (cs.start, cs.end) == (10.0, 10.5)
+            shards = rec.spans(trace_id=tid, name="serving.mesh.shard")
+            assert [s.attrs["shard"] for s in shards] == [0, 1, 2]
+            assert shards[1].end == pytest.approx(10.5)
+            # gauges pin to the scripted timings exactly
+            assert tracing.get_gauge(
+                tracing.MESH_SHARD_SKEW) == pytest.approx(0.4)
+            assert tracing.get_gauge(tracing.MESH_SLOWEST_SHARD) == 1.0
+            assert tracing.get_gauge(
+                tracing.MESH_SHARD_TIME_MAX) == pytest.approx(0.5)
+            assert tracing.get_counter("serving.mesh.dispatches") == 1.0
+            assert stats["shard_skew"] == pytest.approx(0.4)
+        finally:
+            tracing.reset_spans()
+            tracing.reset_gauges("serving.mesh.")
+            tracing.reset_counters("serving.mesh.")
+
+    def test_chrome_trace_trace_id_filter(self):
+        tracing.reset_spans()
+        try:
+            t1, t2 = tracing.new_trace_id(), tracing.new_trace_id()
+            tracing.record_span("a", 1.0, 2.0, trace_ids=(t1,))
+            tracing.record_span("b", 1.0, 2.0, trace_ids=(t2,))
+            tracing.record_span("both", 2.0, 3.0, trace_ids=(t1, t2))
+            rec = tracing.span_recorder()
+            names = {e["name"]
+                     for e in rec.to_chrome_trace(
+                         trace_id=t1)["traceEvents"]}
+            assert names == {"a", "both"}
+            # unknown id: empty but VALID trace, not an error
+            empty = rec.to_chrome_trace(trace_id=10**9)
+            assert empty["traceEvents"] == []
+            # the unfiltered export is unchanged
+            assert len(rec.to_chrome_trace()["traceEvents"]) == 3
         finally:
             tracing.reset_spans()

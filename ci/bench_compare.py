@@ -27,16 +27,28 @@ inputs. Re-baseline deliberately with ``--update`` (writes the fresh
 record + current default tolerances back to the baseline file) — the
 diff then shows reviewers exactly what moved.
 
+**Multi-baseline** (PR 8): ``--baseline`` repeats, and with none given
+every committed ``ci/bench_baseline*.json`` gates — so a TPU-recorded
+baseline (``scripts/record_tpu_baseline.py`` →
+``ci/bench_baseline_tpu.json``) rides next to the pinned CPU one. A
+baseline carrying ``"requires_backend"`` is skipped with a note when
+the current jax backend differs (the TPU baseline is inert on CPU CI
+and live on the TPU runner); each baseline replays its OWN pinned env,
+and identical envs share one bench run.
+
 Usage (what ``ci/test.sh`` runs)::
 
     python ci/bench_compare.py --run --snapshot ci/metrics_snapshot.json
     python ci/bench_compare.py --run --update        # re-baseline
     python ci/bench_compare.py --fresh some_run.json  # offline diff
+    python ci/bench_compare.py --run \
+        --baseline ci/bench_baseline_tpu.json         # TPU gate only
 """
 
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
 import os
 import subprocess
@@ -96,11 +108,17 @@ DEFAULT_TOLERANCES = {
 }
 
 # counters the test session's metrics snapshot must carry ABOVE these
-# values — the modeled-throughput accounting staying alive
+# values — the modeled-throughput accounting staying alive, and (PR 8)
+# the graftgauge probe-frequency accounting: ``accounted`` mirrors the
+# lifetime total fetched off the DEVICE counter planes, so a refactor
+# that silently disconnects the scatter-add (or the scrape-side fetch)
+# zeroes it and fails here structurally
 SNAPSHOT_FLOORS = {
     "serving.execute.calls": 0.0,
     "serving.execute.modeled_bytes": 0.0,
     "serving.execute.modeled_flops": 0.0,
+    "index.probe.dispatches": 0.0,
+    "index.probe_freq.accounted": 0.0,
 }
 
 
@@ -196,14 +214,38 @@ def run_bench(env_overrides: dict) -> dict:
     return rec
 
 
+def backend_available(required: str) -> bool:
+    """Whether the current jax backend matches a baseline's
+    ``requires_backend`` declaration. Imported lazily — the common
+    CPU-only gate never pays the jax import."""
+    try:
+        import jax
+
+        return jax.default_backend() == required
+    except Exception:                        # pragma: no cover
+        return False
+
+
+def default_baselines() -> list:
+    """Every committed ``ci/bench_baseline*.json``, sorted — the
+    multi-baseline default, so a TPU-recorded baseline gates
+    automatically once committed. Falls back to the canonical path
+    (for the --update bootstrap) when none exist yet."""
+    found = sorted(_glob.glob(
+        os.path.join(REPO, "ci", "bench_baseline*.json")))
+    return found or [BASELINE_PATH]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--baseline", action="append",
+                    help="baseline JSON to gate against (repeatable; "
+                    "default: every ci/bench_baseline*.json)")
     ap.add_argument("--fresh", help="existing bench-record JSON to "
                     "diff instead of running the bench")
     ap.add_argument("--run", action="store_true",
-                    help="run the pinned bench config to get the "
-                    "fresh record")
+                    help="run each baseline's pinned bench config to "
+                    "get the fresh record")
     ap.add_argument("--snapshot", help="metrics_snapshot.json to "
                     "floor-check (skipped silently if the file is "
                     "absent — local runs without the pytest artifact)")
@@ -212,57 +254,95 @@ def main(argv=None) -> int:
                     "(deliberate re-baseline) instead of comparing")
     args = ap.parse_args(argv)
 
-    baseline = None
-    if os.path.exists(args.baseline):
-        with open(args.baseline) as f:
-            baseline = json.load(f)
-    if baseline is None and not args.update:
+    paths = args.baseline or default_baselines()
+    if args.update and len(paths) != 1:
         sys.stderr.write(
-            f"bench_compare: no baseline at {args.baseline} — run with "
-            "--update to create one\n")
+            "bench_compare: --update needs exactly ONE --baseline "
+            f"target, got {len(paths)}\n")
         return 2
-
-    if args.fresh:
-        with open(args.fresh) as f:
-            fresh = json.load(f)
-    elif args.run or args.update:
-        env = dict((baseline or {}).get("env") or PINNED_ENV)
-        print(f"bench_compare: running pinned bench config "
-              f"({env.get('BENCH_N')}x{env.get('BENCH_DIM')}, "
-              f"serving rider on)", flush=True)
-        fresh = run_bench(env)
-    else:
+    if not (args.fresh or args.run or args.update):
         sys.stderr.write("bench_compare: need --run or --fresh\n")
         return 2
 
-    if args.update:
-        out = {
-            "env": dict((baseline or {}).get("env") or PINNED_ENV),
-            "tolerances": DEFAULT_TOLERANCES,
-            "snapshot_floors": SNAPSHOT_FLOORS,
-            "record": fresh,
-        }
-        with open(args.baseline, "w") as f:
-            json.dump(out, f, indent=2, sort_keys=True)
-            f.write("\n")
-        print(f"bench_compare: baseline updated at {args.baseline}")
-        return 0
+    fresh_fixed = None
+    if args.fresh:
+        with open(args.fresh) as f:
+            fresh_fixed = json.load(f)
 
-    msgs = compare(baseline.get("record", {}), fresh,
-                   baseline.get("tolerances") or DEFAULT_TOLERANCES)
-    if args.snapshot and os.path.exists(args.snapshot):
-        with open(args.snapshot) as f:
-            msgs += check_snapshot(
-                json.load(f),
-                baseline.get("snapshot_floors") or SNAPSHOT_FLOORS)
+    msgs = []
+    gated = 0
+    failing_paths = []
+    run_cache: dict = {}       # env (sorted tuple) -> bench record
+    for path in paths:
+        baseline = None
+        if os.path.exists(path):
+            with open(path) as f:
+                baseline = json.load(f)
+        if baseline is None and not args.update:
+            sys.stderr.write(
+                f"bench_compare: no baseline at {path} — run with "
+                "--update to create one\n")
+            return 2
+        required = (baseline or {}).get("requires_backend")
+        if required and not backend_available(required):
+            print(f"bench_compare: SKIP {os.path.basename(path)} — "
+                  f"requires backend {required!r}, not present")
+            continue
+
+        env = dict((baseline or {}).get("env") or PINNED_ENV)
+        if fresh_fixed is not None:
+            fresh = fresh_fixed
+        else:
+            key = tuple(sorted(env.items()))
+            if key not in run_cache:
+                print(f"bench_compare: running pinned bench config "
+                      f"({env.get('BENCH_N')}x{env.get('BENCH_DIM')}, "
+                      f"serving rider on)", flush=True)
+                run_cache[key] = run_bench(env)
+            fresh = run_cache[key]
+
+        if args.update:
+            out = {
+                "env": env,
+                "tolerances": DEFAULT_TOLERANCES,
+                "snapshot_floors": SNAPSHOT_FLOORS,
+                "record": fresh,
+            }
+            if required:
+                out["requires_backend"] = required
+            with open(path, "w") as f:
+                json.dump(out, f, indent=2, sort_keys=True)
+                f.write("\n")
+            print(f"bench_compare: baseline updated at {path}")
+            return 0
+
+        gated += 1
+        path_msgs = compare(
+            baseline.get("record", {}), fresh,
+            baseline.get("tolerances") or DEFAULT_TOLERANCES)
+        if args.snapshot and os.path.exists(args.snapshot):
+            with open(args.snapshot) as f:
+                path_msgs += check_snapshot(
+                    json.load(f),
+                    baseline.get("snapshot_floors") or SNAPSHOT_FLOORS)
+        if path_msgs:
+            failing_paths.append(path)
+        msgs += [f"[{os.path.basename(path)}] {m}" for m in path_msgs]
+
     if msgs:
         for m in msgs:
             sys.stderr.write(f"bench_compare: REGRESSION: {m}\n")
-        sys.stderr.write(
-            "bench_compare: if the change is intentional, re-baseline "
-            "with: python ci/bench_compare.py --run --update\n")
+        # --update takes exactly one target, so the hint names each
+        # failing baseline explicitly
+        for p in failing_paths:
+            rel = os.path.relpath(p, REPO) if p.startswith(REPO) else p
+            sys.stderr.write(
+                "bench_compare: if the change is intentional, "
+                "re-baseline with: python ci/bench_compare.py --run "
+                f"--update --baseline {rel}\n")
         return 1
-    print("bench_compare: OK — fresh run within tolerance of baseline")
+    print(f"bench_compare: OK — fresh run within tolerance of "
+          f"{gated} baseline(s)")
     return 0
 
 

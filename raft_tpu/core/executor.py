@@ -69,6 +69,7 @@ import dataclasses
 import hashlib
 import threading
 import time
+import weakref
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -130,6 +131,13 @@ class _Plan:
     # dict) — evaluated and published as gauges only on a compile miss,
     # so the cache-hit hot path never builds the dict
     payload: Any = None
+    # graftgauge probe-frequency accounting (IVF families, opt-in via
+    # SearchExecutor(probe_accounting=True)): (pkey, n_lists,
+    # counts_sharding, family, label, index) describing the donated
+    # int32 counter plane this plan's dispatches thread through the
+    # call — None keeps the compiled signature (and the executable
+    # cache key) exactly as before
+    probe: Any = None
 
 
 class _Entry:
@@ -263,12 +271,25 @@ class SearchExecutor:
         nothing inside the traced program; default off so
         latency-pipelined callers (the bench riders) keep fully async
         dispatch.
+      probe_accounting: graftgauge device-side probe-frequency
+        accounting for the IVF families (single-chip and mesh): each
+        dispatch scatter-adds its selected probe ids into a donated
+        per-index int32 counter plane inside the compiled program —
+        the plane threads through calls exactly like the donated top-k
+        state, so steady state stays zero-recompile and search results
+        stay bit-identical (the results never read the plane). The
+        counters are fetched ONLY at scrape time
+        (:meth:`probe_frequencies` / :meth:`publish_probe_gauges` —
+        one device fetch per plane per scrape, never per dispatch).
+        Default off: enabling changes the compiled signature, so it is
+        part of the executable cache key.
     """
 
     def __init__(self, res: Optional[Resources] = None, *,
                  min_bucket: int = 8, max_bucket: int = 4096,
                  max_entries: int = 64, donate: Optional[bool] = None,
-                 mesh_trace: bool = False):
+                 mesh_trace: bool = False,
+                 probe_accounting: bool = False):
         self.res = ensure_resources(res)
         expect(0 < min_bucket <= max_bucket,
                f"need 0 < min_bucket <= max_bucket, got "
@@ -285,6 +306,19 @@ class SearchExecutor:
             donate = jax.default_backend() not in ("cpu",)
         self.donate = donate
         self.mesh_trace = mesh_trace
+        self.probe_accounting = probe_accounting
+        # graftgauge probe-frequency planes: pkey -> device counter
+        # array holding the CURRENT scrape window (threaded donated
+        # through dispatches, so every bucket/engine entry of one
+        # index shares ONE plane; reset to zero as each scrape claims
+        # its window), the scrape-side descriptors, the host-side
+        # int64 lifetime totals, and the pkeys whose index a weakref
+        # finalizer reported garbage-collected (drained under the
+        # lock — GC callbacks only append)
+        self._probe_state: dict = {}
+        self._probe_info: dict = {}
+        self._probe_totals: dict = {}
+        self._probe_dead: list = []
         self.stats = ExecutorStats()
         self._cache: "collections.OrderedDict[tuple, _Entry]" = (
             collections.OrderedDict())
@@ -454,8 +488,45 @@ class SearchExecutor:
             entry = self._get_entry(plan, bucket, k)
             if plan.has_state:
                 args.extend(entry.state)
+            kwargs = {}
+            if plan.probe is not None:
+                # graftgauge: thread the per-index donated counter
+                # plane + the valid-row count (traced scalar — inert
+                # bucket-pad rows must not pollute the histogram).
+                # Created lazily on first dispatch; the lock serializes
+                # the donate-and-replace handoff exactly like the
+                # top-k state's.
+                pkey, n_lists, csharding, family, label = plan.probe[:5]
+                counts = self._probe_state.get(pkey)
+                if counts is None:
+                    self._evict_dead_probe_planes_locked()
+                    counts = jnp.zeros((n_lists,), jnp.int32)
+                    if csharding is not None:
+                        counts = jax.device_put(counts, csharding)
+                    self._probe_info[pkey] = {
+                        "family": family, "label": label,
+                        "n_lists": n_lists, "sharding": csharding}
+                    try:
+                        # report the index's death so the plane (and
+                        # its label) cannot be inherited by a new
+                        # index reusing the address; the callback may
+                        # fire in GC context, so it only appends —
+                        # never takes the executor lock
+                        weakref.finalize(plan.probe[5],
+                                         self._probe_dead.append, pkey)
+                    except TypeError:       # non-weakref-able index
+                        pass
+                nv = jnp.asarray(q, jnp.int32)
+                if plan.state_sharding is not None:
+                    nv = jax.device_put(nv, plan.state_sharding)
+                kwargs = {"probe_counts": counts, "n_valid": nv}
             t0 = time.perf_counter()
-            out_d, out_i = entry.compiled(*args)
+            out = entry.compiled(*args, **kwargs)
+            if plan.probe is not None:
+                out_d, out_i, new_counts = out
+                self._probe_state[plan.probe[0]] = new_counts
+            else:
+                out_d, out_i = out
             # modeled per-dispatch work, from the compile-time capture:
             # a counter bump (one host lock), never a device sync. The
             # scrape divides these by the measured execute-latency sum
@@ -463,14 +534,20 @@ class SearchExecutor:
             # dispatch so a call that raises does not inflate the
             # achieved-bandwidth numerator its failed execution never
             # contributes latency for.
-            tracing.inc_counters({
+            amounts = {
                 "serving.execute.calls": 1.0,
                 "serving.execute.rows": float(q),
                 "serving.execute.modeled_flops":
                     entry.cost.get("flops", 0.0),
                 "serving.execute.modeled_bytes":
                     entry.cost.get("bytes_accessed", 0.0),
-            })
+            }
+            if plan.probe is not None:
+                # the host-side heartbeat of the device accounting —
+                # what the CI snapshot floors check (lifetime ledger)
+                amounts["index.probe.dispatches"] = 1.0
+                amounts["index.probe.rows"] = float(q)
+            tracing.inc_counters(amounts)
             if plan.has_state:
                 # outputs alias the donated state storage; keep them as
                 # the next call's state and hand the caller copies
@@ -637,8 +714,11 @@ class SearchExecutor:
 
     def _compile(self, plan: _Plan, bucket: int, k: int):
         donate = ()
-        if plan.has_state and self.donate:
-            donate = ("init_d", "init_i")
+        if self.donate:
+            if plan.has_state:
+                donate += ("init_d", "init_i")
+            if plan.probe is not None:
+                donate += ("probe_counts",)
         jitted = jax.jit(plan.fn, static_argnames=tuple(plan.static),
                          donate_argnames=donate)
         sds = _sds_sharded if plan.sharded else _sds
@@ -661,7 +741,157 @@ class SearchExecutor:
                                              sharding=plan.state_sharding))
             args.append(jax.ShapeDtypeStruct((bucket, k), jnp.int32,
                                              sharding=plan.state_sharding))
-        return jitted.lower(*args, **plan.static).compile()
+        kwargs = {}
+        if plan.probe is not None:
+            # graftgauge counter plane + valid-row scalar ride as
+            # KEYWORD avals: several plans skip the optional init_d /
+            # init_i positionals, so a positional plane would slide
+            # into the wrong parameter slot
+            _, n_lists, csharding = plan.probe[:3]
+            kwargs["probe_counts"] = jax.ShapeDtypeStruct(
+                (n_lists,), jnp.int32, sharding=csharding)
+            kwargs["n_valid"] = jax.ShapeDtypeStruct(
+                (), jnp.int32, sharding=plan.state_sharding)
+        return jitted.lower(*args, **kwargs, **plan.static).compile()
+
+    # -- graftgauge probe-frequency surface ---------------------------------
+
+    def _probe_plumbing(self, index, family: str, key: tuple,
+                        sharding=None):
+        """(key', probe descriptor) for one IVF-family plan: appends
+        the accounting marker to the executable cache key (enabling
+        accounting changes the compiled signature — it must be a
+        distinct executable) and names the per-index counter plane.
+        No-op (key unchanged, None) when accounting is off."""
+        if not self.probe_accounting:
+            return key, None
+        pkey = (id(index), index.n_lists)
+        digest = hashlib.sha1(
+            repr((family, id(index))).encode()).hexdigest()[:6]
+        # dash, not dot: the label must stay ONE dot-delimited segment
+        # of the gauge name so the exporter's labeled-family regexes
+        # can lift it into an {index="..."} label
+        label = f"{family}-{digest}"
+        # marker slots in BEFORE the trailing _filter_spec tuple —
+        # _compile reads the filter spec off key[-1]
+        key = key[:-1] + ("probe_accounting", key[-1])
+        # the index rides along (plans are per-dispatch descriptors,
+        # not cached) so first-dispatch plane creation can register
+        # the death-watch weakref
+        return key, (pkey, index.n_lists, sharding, family, label,
+                     index)
+
+    def _evict_dead_probe_planes_locked(self) -> None:
+        """Drop planes whose index was garbage-collected. The weakref
+        finalizer only APPENDS the dead pkey (list.append is atomic —
+        a GC-context callback must never try to take the executor
+        lock); the actual eviction happens here, under the lock, on
+        the next dispatch-create or scrape. This also closes the
+        id-reuse hazard: a new index reusing a dead one's address
+        cannot inherit its cumulative plane."""
+        while self._probe_dead:
+            pkey = self._probe_dead.pop()
+            self._probe_state.pop(pkey, None)
+            self._probe_info.pop(pkey, None)
+            self._probe_totals.pop(pkey, None)
+
+    def probe_frequencies(self) -> dict:
+        """``{label: (n_lists,) int64 numpy plane}`` of cumulative
+        per-list probe counts, one entry per index that has dispatched
+        with ``probe_accounting`` on. ONE device fetch per plane —
+        this is the scrape-time read; nothing on the dispatch path
+        ever fetches. The fetch happens under the executor lock, which
+        also serializes dispatch, so it atomically CLAIMS the window
+        since the last scrape: the device plane resets to zero and the
+        fetched counts fold into a host-side int64 lifetime ledger
+        (per-window device counts stay far from int32 overflow on any
+        realistic scrape interval, while the returned totals never
+        wrap) — and the claimed window bumps the monotone
+        ``index.probe_freq.accounted`` counter exactly once, however
+        many scrapers run concurrently."""
+        out = {}
+        accounted = 0
+        with self._lock:
+            self._evict_dead_probe_planes_locked()
+            reset_keys, reset_zeros, reset_shardings = [], [], []
+            for pkey, arr in self._probe_state.items():
+                info = self._probe_info.get(pkey)
+                if info is None:
+                    continue
+                window = np.asarray(jax.device_get(arr), dtype=np.int64)
+                if window.any():
+                    # claim the window: queue the plane for reset
+                    # (placed in ONE batched device_put below)
+                    reset_keys.append(pkey)
+                    reset_zeros.append(
+                        np.zeros(arr.shape, dtype=np.int32))
+                    reset_shardings.append(info["sharding"])
+                    accounted += int(window.sum())
+                total = self._probe_totals.get(pkey)
+                total = window if total is None else total + window
+                self._probe_totals[pkey] = total
+                out[info["label"]] = total.copy()
+            if reset_keys:
+                fresh = jax.device_put(
+                    reset_zeros,
+                    [s if s is not None else jax.devices()[0]
+                     for s in reset_shardings])
+                for pkey, plane in zip(reset_keys, fresh):
+                    self._probe_state[pkey] = plane
+        if accounted:
+            # the mirror the CI snapshot floors check: counts that
+            # really came off the device, exactly once per window
+            tracing.inc_counter("index.probe_freq.accounted",
+                                float(accounted))
+        return out
+
+    def publish_probe_gauges(self, top_n: int = 8,
+                             planes: Optional[dict] = None) -> dict:
+        """Reduce every probe plane through
+        :func:`raft_tpu.core.tracing.probe_freq_stats` and publish the
+        ``index.probe_freq.<label>.*`` gauges: lifetime ``total``,
+        ``probed_fraction`` (share of lists traffic ever touched),
+        the hot/cold coverage fractions ``coverage_p01`` /
+        ``coverage_p10`` (share of probes the hottest 1% / 10% of
+        lists absorb — the signal a future HBM/host-RAM tier split
+        keys on), and the top-``top_n`` lists as
+        ``index.probe_freq.<label>.list.<lid>`` samples (a labeled
+        Prometheus family on the exporter). The monotone
+        ``index.probe_freq.accounted`` mirror — the CI snapshot
+        floor's ledger of counts that really came off the device — is
+        bumped by :meth:`probe_frequencies` as it claims each window.
+        ``planes`` lets a caller that already fetched (the exporter's
+        scrape does, to share one fetch with drift detection) skip a
+        second device read. Returns ``{label: stats}``."""
+        if planes is None:
+            planes = self.probe_frequencies()
+        out = {}
+        for label, counts in planes.items():
+            stats = tracing.probe_freq_stats(counts, top_n=top_n)
+            out[label] = stats
+            base = f"index.probe_freq.{label}."
+            # retire stale top-N samples before republishing — a list
+            # that fell out of the top set must not linger at its old
+            # value
+            tracing.reset_gauges(base + "list.")
+            vals = {
+                base + "total": float(stats["total"]),
+                base + "probed_fraction": stats["probed_fraction"],
+                base + "coverage_p01": stats["coverage_p01"],
+                base + "coverage_p10": stats["coverage_p10"],
+            }
+            for lid, c in stats["top"]:
+                vals[f"{base}list.{lid}"] = float(c)
+            tracing.set_gauges(vals)
+        return out
+
+    def probe_label(self, index) -> Optional[str]:
+        """The gauge label of ``index``'s probe plane (None until its
+        first accounted dispatch) — how graftgauge's drift detector
+        pairs a watched index with its live histogram."""
+        with self._lock:
+            info = self._probe_info.get((id(index), index.n_lists))
+        return info["label"] if info else None
 
     # -- per-family plans ---------------------------------------------------
 
@@ -745,12 +975,16 @@ class SearchExecutor:
         key = ("dist_ivf_flat", bucket, _mesh_key(comms), _sig(*arrays),
                tuple(sorted((n, str(v)) for n, v in static.items())),
                _filter_spec(None))
+        key, probe = self._probe_plumbing(
+            index, "dist_ivf_flat", key,
+            sharding=comms.sharding(comms.axis))
         # same engine/donation split as the single-chip ivf_flat plan:
         # the rank and XLA list-major scans thread the donated per-shard
         # (q, k) state through HBM; the Pallas kernel keeps it in VMEM
         return _Plan(key=key, fn=dist_ivf._dist_search_fn, static=static,
                      post=arrays, qdim=index.dim,
                      has_state=engine != "pallas", sharded=True,
+                     probe=probe,
                      qsharding=comms.replicated(),
                      state_sharding=comms.replicated(),
                      payload=("dist_ivf_flat",
@@ -786,9 +1020,13 @@ class SearchExecutor:
         key = ("dist_ivf_pq", bucket, _mesh_key(comms), _sig(*arrays),
                tuple(sorted((n, str(v)) for n, v in static.items())),
                _filter_spec(None))
+        key, probe = self._probe_plumbing(
+            index, "dist_ivf_pq", key,
+            sharding=comms.sharding(comms.axis))
         return _Plan(key=key, fn=dist_ivf._dist_search_pq_fn,
                      static=static, post=arrays, qdim=index.dim,
-                     sharded=True, qsharding=comms.replicated(),
+                     sharded=True, probe=probe,
+                     qsharding=comms.replicated(),
                      state_sharding=comms.replicated(),
                      payload=("dist_ivf_pq",
                               lambda: dist_ivf.collective_payload_model(
@@ -819,8 +1057,12 @@ class SearchExecutor:
         key = ("dist_ivf_bq", bucket, _mesh_key(comms), _sig(*arrays),
                tuple(sorted((n, str(v)) for n, v in static.items())),
                _filter_spec(None))
+        key, probe = self._probe_plumbing(
+            index, "dist_ivf_bq", key,
+            sharding=comms.sharding(comms.axis))
         return _Plan(key=key, fn=dist_bq._dist_search_bq_fn, static=static,
                      post=arrays, qdim=index.dim, sharded=True,
+                     probe=probe,
                      qsharding=comms.replicated(),
                      state_sharding=comms.replicated(),
                      payload=("dist_ivf_bq",
@@ -881,12 +1123,13 @@ class SearchExecutor:
         key = ("ivf_flat", bucket, _sig(*arrays),
                tuple(sorted((n, str(v)) for n, v in static.items())),
                _filter_spec(fw))
+        key, probe = self._probe_plumbing(index, "ivf_flat", key)
         # the rank-major and XLA list-major scans thread the donated
         # (q, k) running state through HBM; the Pallas kernel keeps
         # its state in VMEM scratch, so donated buffers would go unused
         return _Plan(key=key, fn=m._search_impl_fn, static=static,
                      post=arrays, use_filter=True, qdim=index.dim,
-                     has_state=engine != "pallas")
+                     has_state=engine != "pallas", probe=probe)
 
     def _plan_ivf_pq(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import ivf_pq as m
@@ -907,10 +1150,12 @@ class SearchExecutor:
         key = ("ivf_pq", bucket, _sig(*arrays),
                tuple(sorted((n, str(v)) for n, v in static.items())),
                _filter_spec(fw))
+        key, probe = self._probe_plumbing(index, "ivf_pq", key)
         # both PQ scan engines build their lax.scan carry from the
         # donated init buffers — keep PR 1's donation on either path
         return _Plan(key=key, fn=m._search_impl_fn, static=static,
-                     post=arrays, use_filter=True, qdim=index.dim)
+                     post=arrays, use_filter=True, qdim=index.dim,
+                     probe=probe)
 
     def _plan_ivf_bq(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import ivf_bq as m
@@ -924,8 +1169,10 @@ class SearchExecutor:
         key = ("ivf_bq", bucket, _sig(*arrays),
                tuple(sorted((n, str(v)) for n, v in static.items())),
                _filter_spec(fw))
+        key, probe = self._probe_plumbing(index, "ivf_bq", key)
         return _Plan(key=key, fn=m._search_impl_fn, static=static,
-                     post=arrays, use_filter=True, qdim=index.dim)
+                     post=arrays, use_filter=True, qdim=index.dim,
+                     probe=probe)
 
     def _plan_cagra(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import cagra as m

@@ -58,6 +58,7 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
+import numpy as np
 
 
 @contextlib.contextmanager
@@ -656,6 +657,125 @@ def record_mesh_spans(family: str, t0: float, t1: float, *,
         })
         inc_counter("serving.mesh.dispatches")
     return stats
+
+
+# ---------------------------------------------------------------------------
+# graftgauge — index-health, probe-frequency, and drift reducers (PR 8)
+# ---------------------------------------------------------------------------
+#
+# Pure functions of host arrays: the serving layer fetches its inputs
+# once per scrape (the executor's probe planes, an index's list_sizes)
+# and reduces them here, so every gauge value is pinned exactly by a
+# scripted test and nothing below ever touches the device.
+
+# the flat (unlabeled) drift/recall gauge names graftgauge publishes
+DRIFT_SCORE = "index.drift.score"
+RECALL_ESTIMATE = "index.recall.estimate"
+
+
+def index_health(list_sizes, max_list_size: Optional[int] = None,
+                 shards: int = 0) -> dict:
+    """Reduce one index's per-list populations into its health stats:
+    occupancy skew (``max``/``mean``/``p99`` list size and the Gini
+    coefficient of the size distribution), ``dead_lists`` (empty —
+    wasted probes land there), ``overflow_lists`` (at the padded
+    capacity ``max_list_size`` — the next extend() into them forces a
+    full repack), and ``fill_fraction`` of the padded tensor. With
+    ``shards`` > 0 the block-sharded layout's per-shard row totals
+    reduce into ``shard_imbalance`` (max/mean — 1.0 is a perfectly
+    balanced mesh) — the evidence the lifecycle/compaction direction
+    needs to decide what to rebalance. Pure function of its inputs."""
+    sizes = np.asarray(list_sizes, dtype=np.int64)
+    n = int(sizes.size)
+    total = int(sizes.sum())
+    out = {
+        "n_lists": n,
+        "rows": total,
+        "max_list_size": int(sizes.max()) if n else 0,
+        "mean_list_size": total / n if n else 0.0,
+        "p99_list_size": float(np.percentile(sizes, 99)) if n else 0.0,
+        "dead_lists": int((sizes == 0).sum()),
+        "overflow_lists": 0,
+        "fill_fraction": 0.0,
+        "gini": 0.0,
+        "shard_imbalance": 1.0,
+    }
+    if max_list_size:
+        out["overflow_lists"] = int((sizes >= max_list_size).sum())
+        out["fill_fraction"] = (total / (n * max_list_size)
+                                if n * max_list_size else 0.0)
+    if total > 0 and n > 1:
+        # Gini over list populations: 0 = perfectly even, ->1 = all
+        # rows in one list (the standard inequality reduction)
+        s = np.sort(sizes)
+        cum = np.cumsum(s, dtype=np.float64)
+        out["gini"] = float(
+            (n + 1 - 2.0 * (cum.sum() / cum[-1])) / n)
+    if shards > 1 and n % shards == 0:
+        per_shard = sizes.reshape(shards, n // shards).sum(axis=1)
+        mean = per_shard.mean()
+        out["shard_imbalance"] = (float(per_shard.max() / mean)
+                                  if mean > 0 else 1.0)
+    return out
+
+
+def probe_freq_stats(counts, top_n: int = 8) -> dict:
+    """Reduce one cumulative probe-frequency plane into its traffic
+    stats: lifetime ``total`` probes, ``probed_fraction`` (share of
+    lists traffic ever touched — its complement is the cold set), the
+    hot-set coverage fractions ``coverage_p01``/``coverage_p10``
+    (share of all probes the hottest 1% / 10% of lists absorbed — the
+    exact signal an HBM/host-RAM tier split keys on), and the
+    ``top_n`` hottest lists as ``(list_id, count)`` pairs. Pure
+    function of the fetched plane."""
+    c = np.asarray(counts, dtype=np.int64)
+    n = int(c.size)
+    total = int(c.sum())
+    if n == 0 or total == 0:
+        return {"n_lists": n, "total": total, "probed_fraction": 0.0,
+                "coverage_p01": 0.0, "coverage_p10": 0.0, "top": []}
+    order = np.argsort(-c, kind="stable")
+    sorted_c = c[order]
+    cum = np.cumsum(sorted_c, dtype=np.float64)
+
+    def coverage(frac: float) -> float:
+        k = max(1, int(np.ceil(n * frac)))
+        return float(cum[k - 1] / total)
+
+    top = [(int(order[i]), int(sorted_c[i]))
+           for i in builtins.range(min(top_n, n)) if sorted_c[i] > 0]
+    return {
+        "n_lists": n,
+        "total": total,
+        "probed_fraction": float((c > 0).sum() / n),
+        "coverage_p01": coverage(0.01),
+        "coverage_p10": coverage(0.10),
+        "top": top,
+    }
+
+
+def js_divergence(p, q) -> float:
+    """Jensen-Shannon divergence (base 2 — bounded [0, 1]) between two
+    count histograms; the drift score's distance. Inputs need not be
+    normalized; a zero histogram against a non-zero one scores 1.0
+    (maximal drift), two zero histograms 0.0. Symmetric and finite
+    even where one side has mass the other lacks — why it, and not
+    KL, is the streaming drift metric."""
+    pa = np.asarray(p, dtype=np.float64)
+    qa = np.asarray(q, dtype=np.float64)
+    ps, qs = pa.sum(), qa.sum()
+    if ps == 0 and qs == 0:
+        return 0.0
+    if ps == 0 or qs == 0:
+        return 1.0
+    pa, qa = pa / ps, qa / qs
+    m = 0.5 * (pa + qa)
+
+    def kl(a, b):
+        mask = a > 0
+        return float(np.sum(a[mask] * np.log2(a[mask] / b[mask])))
+
+    return 0.5 * kl(pa, m) + 0.5 * kl(qa, m)
 
 
 @contextlib.contextmanager

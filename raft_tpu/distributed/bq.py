@@ -120,7 +120,8 @@ def build_bq(
 
 
 def _dist_search_bq_fn(queries, centers, rotation, codes, scales, rn2,
-                       indices, init_d=None, init_i=None, *, axis: str,
+                       indices, init_d=None, init_i=None,
+                       probe_counts=None, n_valid=None, *, axis: str,
                        mesh, n_probes: int, k: int, metric: DistanceType,
                        probe_mode: str, query_axis=None,
                        coarse_algo: str = "exact",
@@ -131,7 +132,10 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, scales, rn2,
     gathered estimate distances; the positional ``knn_merge_parts``
     tie-break is kept so results match the single-chip BQ index).
     ``init_d``/``init_i`` optionally provide the (q, k) running top-k
-    storage (values are reset here; the serving path donates them)."""
+    storage (values are reset here; the serving path donates them).
+    ``probe_counts`` optionally provides the donated list-sharded
+    (n_lists,) int32 probe-frequency plane (graftgauge — owned probes
+    only, returned as a third output)."""
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
     ip_metric = metric == DistanceType.InnerProduct
@@ -141,7 +145,8 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, scales, rn2,
     if init_i is None:
         init_i = jnp.full((queries.shape[0], k), -1, jnp.int32)
 
-    def body(centers_l, codes_l, scales_l, rn2_l, ids_l, qs, ind, ini):
+    def body(centers_l, codes_l, scales_l, rn2_l, ids_l, qs, ind, ini,
+             cnt=None, nv=None):
         qf = qs.astype(jnp.float32)
 
         ip = jax.lax.dot_general(
@@ -161,6 +166,10 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, scales, rn2,
         local, mine = select_probes_sharded(coarse, n_probes, axis,
                                             probe_mode, coarse_algo,
                                             probe_wire_dtype)
+        if cnt is not None:
+            from raft_tpu.ops.ivf_scan import probe_histogram
+
+            cnt = probe_histogram(local, cnt, nv, owned=mine)
 
         qrot = qf @ rotation.T
         centers_rot = None if ip_metric else centers_l @ rotation.T
@@ -178,22 +187,35 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, scales, rn2,
         (best_d, best_i), _ = jax.lax.scan(
             step, init, jnp.arange(local.shape[1]))
 
-        return merge_results_sharded(best_d, best_i, axis, select_min,
-                                     wire_dtype, smallest_id_ties=False)
+        merged = merge_results_sharded(best_d, best_i, axis, select_min,
+                                       wire_dtype, smallest_id_ties=False)
+        if cnt is not None:
+            return merged + (cnt,)
+        return merged
 
     qspec = P() if query_axis is None else P(query_axis, None)
-    out_d, out_i = shard_map(
+    args = [centers, codes, scales, rn2, indices, queries, init_d, init_i]
+    in_specs = [P(axis, None), P(axis, None, None),
+                P(axis, None, None), P(axis, None), P(axis, None),
+                qspec, qspec, qspec]
+    out_specs = [qspec, qspec]
+    if probe_counts is not None:
+        args += [probe_counts, n_valid]
+        in_specs += [P(axis), P()]
+        out_specs += [P(axis)]
+    outs = shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None, None),
-                  P(axis, None, None), P(axis, None), P(axis, None),
-                  qspec, qspec, qspec),
-        out_specs=(qspec, qspec),
+        in_specs=tuple(in_specs),
+        out_specs=tuple(out_specs),
         check_vma=False,
-    )(centers, codes, scales, rn2, indices, queries, init_d, init_i)
+    )(*args)
+    out_d, out_i = outs[0], outs[1]
 
     if metric == DistanceType.L2SqrtExpanded:
         out_d = jnp.where(jnp.isfinite(out_d),
                           jnp.sqrt(jnp.maximum(out_d, 0.0)), out_d)
+    if probe_counts is not None:
+        return out_d, out_i, outs[2]
     return out_d, out_i
 
 

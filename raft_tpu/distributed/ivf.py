@@ -463,7 +463,8 @@ def build(
 
 
 def _dist_search_fn(queries, centers, data, data_norms, indices,
-                    init_d=None, init_i=None, *, axis: str, mesh,
+                    init_d=None, init_i=None, probe_counts=None,
+                    n_valid=None, *, axis: str, mesh,
                     n_probes: int, k: int, metric: DistanceType,
                     probe_mode: str, query_axis: Optional[str] = None,
                     coarse_algo: str = "exact", scan_engine: str = "rank",
@@ -479,7 +480,15 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
     ``n_local`` so each shard streams only the union of lists it owns.
     ``init_d``/``init_i`` optionally provide the (q, k) running top-k
     storage (values are reset here; the serving path donates them —
-    the Pallas engine keeps its state in VMEM scratch instead)."""
+    the Pallas engine keeps its state in VMEM scratch instead).
+
+    ``probe_counts`` (graftgauge) optionally provides the donated
+    list-sharded (n_lists,) int32 probe-frequency plane: each shard
+    scatter-adds only the probes it OWNS into its local slice (so a
+    probe counts exactly once mesh-wide) and the updated plane returns
+    as a third output. Replicated-query dispatches only (the mesh
+    executor's mode; a ``query_axis`` grid would write divergent
+    replicas)."""
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
     interpret = jax.default_backend() != "tpu"
@@ -489,7 +498,8 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
     if init_i is None:
         init_i = jnp.full((queries.shape[0], k), -1, jnp.int32)
 
-    def body(centers_l, data_l, norms_l, ids_l, qs, ind, ini):
+    def body(centers_l, data_l, norms_l, ids_l, qs, ind, ini,
+             cnt=None, nv=None):
         q = qs.shape[0]
         n_local = centers_l.shape[0]
         qf = qs.astype(jnp.float32)
@@ -509,6 +519,10 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
         local, mine = select_probes_sharded(coarse, n_probes, axis,
                                             probe_mode, coarse_algo,
                                             probe_wire_dtype)
+        if cnt is not None:
+            from raft_tpu.ops.ivf_scan import probe_histogram
+
+            cnt = probe_histogram(local, cnt, nv, owned=mine)
 
         if scan_engine != "rank":
             # list-major: not-owned probes mask to the sentinel id
@@ -547,22 +561,33 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
             (best_d, best_i), _ = jax.lax.scan(
                 step, init, jnp.arange(local.shape[1]))
 
-        return merge_results_sharded(
+        merged = merge_results_sharded(
             best_d, best_i, axis, select_min, wire_dtype,
             smallest_id_ties=scan_engine != "rank")
+        if cnt is not None:
+            return merged + (cnt,)
+        return merged
 
     # 2-D grid: queries shard over a second mesh axis while lists shard
     # over the first — the reference's row/col process grid
     # (``sub_comms.hpp``). Each device handles its (list-block,
     # query-block) cell; merges stay within the list axis.
     qspec = P() if query_axis is None else P(query_axis, None)
-    out_d, out_i = shard_map(
+    args = [centers, data, data_norms, indices, queries, init_d, init_i]
+    in_specs = [P(axis, None), P(axis, None, None), P(axis, None),
+                P(axis, None), qspec, qspec, qspec]
+    out_specs = [qspec, qspec]
+    if probe_counts is not None:
+        args += [probe_counts, n_valid]
+        in_specs += [P(axis), P()]
+        out_specs += [P(axis)]
+    outs = shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None, None), P(axis, None),
-                  P(axis, None), qspec, qspec, qspec),
-        out_specs=(qspec, qspec),
+        in_specs=tuple(in_specs),
+        out_specs=tuple(out_specs),
         check_vma=False,
-    )(centers, data, data_norms, indices, queries, init_d, init_i)
+    )(*args)
+    out_d, out_i = outs[0], outs[1]
 
     if metric != DistanceType.InnerProduct:
         q_sq = jnp.sum(jnp.square(queries.astype(jnp.float32)), axis=1,
@@ -571,6 +596,8 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
                           jnp.maximum(out_d + q_sq, 0.0), out_d)
         if metric == DistanceType.L2SqrtExpanded:
             out_d = jnp.where(jnp.isfinite(out_d), jnp.sqrt(out_d), out_d)
+    if probe_counts is not None:
+        return out_d, out_i, outs[2]
     return out_d, out_i
 
 
@@ -837,7 +864,8 @@ def build_pq(
 
 
 def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
-                       indices, init_d=None, init_i=None, *, axis: str,
+                       indices, init_d=None, init_i=None,
+                       probe_counts=None, n_valid=None, *, axis: str,
                        mesh, n_probes: int, k: int, metric: DistanceType,
                        probe_mode: str, query_axis: Optional[str] = None,
                        codebook_kind: CodebookKind = (
@@ -850,7 +878,8 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
     """Distributed ADC probe scan — same engine plumbing as
     :func:`_dist_search_fn` (``scan_engine: xla`` is the list-major
     union scan of :mod:`raft_tpu.neighbors.ivf_pq`, run per shard with
-    not-owned probes masked to the sentinel id)."""
+    not-owned probes masked to the sentinel id), including the optional
+    donated list-sharded ``probe_counts`` plane (owned probes only)."""
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
     pq_dim = codes.shape[2]
@@ -864,7 +893,8 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
     if init_i is None:
         init_i = jnp.full((queries.shape[0], k), -1, jnp.int32)
 
-    def body(centers_l, books_l, codes_l, ids_l, qs, ind, ini):
+    def body(centers_l, books_l, codes_l, ids_l, qs, ind, ini,
+             cnt=None, nv=None):
         q = qs.shape[0]
         n_local = centers_l.shape[0]
         qf = qs.astype(jnp.float32)
@@ -883,6 +913,10 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
         local, mine = select_probes_sharded(coarse, n_probes, axis,
                                             probe_mode, coarse_algo,
                                             probe_wire_dtype)
+        if cnt is not None:
+            from raft_tpu.ops.ivf_scan import probe_histogram
+
+            cnt = probe_histogram(local, cnt, nv, owned=mine)
 
         qsub_fixed = (qf @ rotation.T).reshape(q, pq_dim, pq_len)
         lut_fixed = (jnp.einsum("qsl,sjl->qsj", qsub_fixed, books_l)
@@ -950,23 +984,36 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
             (best_d, best_i), _ = jax.lax.scan(
                 step, init, jnp.arange(local.shape[1]))
 
-        return merge_results_sharded(
+        merged = merge_results_sharded(
             best_d, best_i, axis, select_min, wire_dtype,
             smallest_id_ties=scan_engine != "rank")
+        if cnt is not None:
+            return merged + (cnt,)
+        return merged
 
     qspec = P() if query_axis is None else P(query_axis, None)
     bspec = P(axis, None, None) if per_cluster else P(None, None, None)
-    out_d, out_i = shard_map(
+    args = [centers, codebooks, codes, indices, queries, init_d, init_i]
+    in_specs = [P(axis, None), bspec, P(axis, None, None), P(axis, None),
+                qspec, qspec, qspec]
+    out_specs = [qspec, qspec]
+    if probe_counts is not None:
+        args += [probe_counts, n_valid]
+        in_specs += [P(axis), P()]
+        out_specs += [P(axis)]
+    outs = shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis, None), bspec, P(axis, None, None), P(axis, None),
-                  qspec, qspec, qspec),
-        out_specs=(qspec, qspec),
+        in_specs=tuple(in_specs),
+        out_specs=tuple(out_specs),
         check_vma=False,
-    )(centers, codebooks, codes, indices, queries, init_d, init_i)
+    )(*args)
+    out_d, out_i = outs[0], outs[1]
 
     if metric == DistanceType.L2SqrtExpanded:
         out_d = jnp.where(jnp.isfinite(out_d),
                           jnp.sqrt(jnp.maximum(out_d, 0.0)), out_d)
+    if probe_counts is not None:
+        return out_d, out_i, outs[2]
     return out_d, out_i
 
 

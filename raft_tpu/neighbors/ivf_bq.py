@@ -448,12 +448,16 @@ def score_probe(lists, qrot, centers_rot, ip, cn, qnorm, codes, scales,
 
 
 def _search_impl_fn(queries, centers, rotation, codes, scales, rn2, indices,
-                    filter_words, init_d=None, init_i=None, *, n_probes: int,
+                    filter_words, init_d=None, init_i=None,
+                    probe_counts=None, n_valid=None, *, n_probes: int,
                     k: int, metric: DistanceType, coarse_algo: str = "exact"):
     """Sign-code probe scan. ``init_d``/``init_i`` optionally provide
     the (q, k) running-state storage (values are reset here); the
     serving path donates them so the scan state reuses one HBM
-    allocation."""
+    allocation. ``probe_counts`` optionally provides the donated
+    (n_lists,) int32 probe-frequency plane (graftgauge): selected
+    probe ids scatter-add into it (rows past ``n_valid`` masked) and
+    the updated plane returns as a third output."""
     q, dim = queries.shape
     select_min = is_min_close(metric)
     qf = queries.astype(jnp.float32)
@@ -474,6 +478,10 @@ def _search_impl_fn(queries, centers, rotation, codes, scales, rn2, indices,
         score = -(c_norms[None, :] - 2.0 * ip)
         qnorm = jnp.sum(jnp.square(qf), axis=1)
     probes = coarse_select(score, n_probes, coarse_algo)
+    if probe_counts is not None:
+        from raft_tpu.ops.ivf_scan import probe_histogram
+
+        probe_counts = probe_histogram(probes, probe_counts, n_valid)
     pad_val = jnp.inf if select_min else -jnp.inf
 
     # probe-invariant precomputation: the rotated query never changes,
@@ -501,6 +509,8 @@ def _search_impl_fn(queries, centers, rotation, codes, scales, rn2, indices,
     if metric == DistanceType.L2SqrtExpanded:
         best_d = jnp.where(jnp.isfinite(best_d),
                            jnp.sqrt(jnp.maximum(best_d, 0.0)), best_d)
+    if probe_counts is not None:
+        return best_d, best_i, probe_counts
     return best_d, best_i
 
 

@@ -438,7 +438,8 @@ def build_streaming(
 
 
 def _search_impl_fn(queries, centers, center_norms, data, data_norms, indices,
-                    filter_words, init_d=None, init_i=None, *, n_probes: int,
+                    filter_words, init_d=None, init_i=None,
+                    probe_counts=None, n_valid=None, *, n_probes: int,
                     k: int, metric: DistanceType, coarse_algo: str = "exact",
                     scan_engine: str = "rank"):
     """Coarse select + probe scan with running top-k merge.
@@ -447,6 +448,13 @@ def _search_impl_fn(queries, centers, center_norms, data, data_norms, indices,
     storage (values are reset here); the serving path donates them so
     the scan state reuses one HBM allocation across calls (rank-major
     engine only — the list-major engines carry their state in VMEM).
+
+    ``probe_counts`` (graftgauge) optionally provides the donated
+    (n_lists,) int32 cumulative probe-frequency plane: the selected
+    probe ids scatter-add into it (:func:`raft_tpu.ops.ivf_scan
+    .probe_histogram`, pad rows past ``n_valid`` masked out) and the
+    updated plane returns as a third output. The search results never
+    read it, so enabling accounting cannot perturb them.
 
     ``scan_engine`` must arrive resolved (``rank``/``pallas``/``xla``,
     via :func:`raft_tpu.ops.ivf_scan.resolve_scan_engine`): it is a jit
@@ -465,6 +473,10 @@ def _search_impl_fn(queries, centers, center_norms, data, data_norms, indices,
     score = (ip if metric == DistanceType.InnerProduct
              else -(center_norms[None, :] - 2.0 * ip))          # larger=better
     probes = coarse_select(score, n_probes, coarse_algo)
+    if probe_counts is not None:
+        from raft_tpu.ops.ivf_scan import probe_histogram
+
+        probe_counts = probe_histogram(probes, probe_counts, n_valid)
 
     pad_val = jnp.inf if select_min else -jnp.inf
 
@@ -521,6 +533,8 @@ def _search_impl_fn(queries, centers, center_norms, data, data_norms, indices,
                            jnp.maximum(best_d + q_sq, 0.0), best_d)
         if metric == DistanceType.L2SqrtExpanded:
             best_d = jnp.where(jnp.isfinite(best_d), jnp.sqrt(best_d), best_d)
+    if probe_counts is not None:
+        return best_d, best_i, probe_counts
     return best_d, best_i
 
 

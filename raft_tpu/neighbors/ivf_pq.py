@@ -741,7 +741,8 @@ def quantize_lut(lut, lut_dtype):
 
 
 def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
-                    filter_words, init_d=None, init_i=None, *, n_probes: int,
+                    filter_words, init_d=None, init_i=None,
+                    probe_counts=None, n_valid=None, *, n_probes: int,
                     k: int, metric: DistanceType,
                     codebook_kind: CodebookKind, lut_dtype,
                     score_mode: str = "gather", packed: bool = False,
@@ -749,6 +750,10 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
     """ADC probe scan. ``init_d``/``init_i`` optionally provide the
     (q, k) running-state storage (values are reset here); the serving
     path donates them so the scan state reuses one HBM allocation.
+    ``probe_counts`` optionally provides the donated (n_lists,) int32
+    probe-frequency plane (graftgauge): selected probe ids scatter-add
+    into it (rows past ``n_valid`` masked) and the updated plane
+    returns as a third output — the results never read it.
 
     ``scan_engine`` must arrive resolved (``rank``/``xla`` via
     :func:`resolve_scan_engine` — it is a jit static). ``rank`` scans
@@ -776,6 +781,10 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
     score = (ip if metric == DistanceType.InnerProduct
              else -(jnp.sum(jnp.square(centers), axis=1)[None, :] - 2.0 * ip))
     probes = coarse_select(score, n_probes, coarse_algo)
+    if probe_counts is not None:
+        from raft_tpu.ops.ivf_scan import probe_histogram
+
+        probe_counts = probe_histogram(probes, probe_counts, n_valid)
 
     pad_val = jnp.inf if select_min else -jnp.inf
 
@@ -882,6 +891,8 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
     if metric == DistanceType.L2SqrtExpanded:
         best_d = jnp.where(jnp.isfinite(best_d),
                            jnp.sqrt(jnp.maximum(best_d, 0.0)), best_d)
+    if probe_counts is not None:
+        return best_d, best_i, probe_counts
     return best_d, best_i
 
 

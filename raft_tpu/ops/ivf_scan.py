@@ -119,6 +119,35 @@ def resolve_scan_engine(engine: str, *, data=None, filter_words=None,
     return engine
 
 
+def probe_histogram(probes: jax.Array, counts: jax.Array,
+                    n_valid=None, owned=None) -> jax.Array:
+    """Scatter-add a ``bincount`` of the selected probe ids into the
+    running ``counts`` plane — the device half of graftgauge's
+    probe-frequency accounting, shared by every IVF family's search
+    body (single-chip and the shard-local half of the sharded ones).
+
+    ``probes`` is the (q, n_probes) int32 probe selection; ``counts``
+    is the donated (n_lists,) int32 cumulative plane (the serving
+    executor threads it like the top-k state, so steady state stays
+    zero-recompile). ``n_valid`` (traced scalar) masks the executor's
+    inert bucket-pad rows — a pad query's phantom probes must not
+    pollute the traffic histogram; ``owned`` is the sharded families'
+    per-slot ownership mask (count a probe exactly once mesh-wide, on
+    the shard that owns the list). Masked slots redirect to the
+    out-of-range index ``n_lists`` and ``mode="drop"`` discards them —
+    including sentinel-valued masked probes, which already carry
+    ``n_lists``. Pure accumulation: the search results never read the
+    plane, so bit-identity is untouched by construction."""
+    n_lists = counts.shape[0]
+    ids = probes.astype(jnp.int32)
+    if owned is not None:
+        ids = jnp.where(owned, ids, n_lists)
+    if n_valid is not None:
+        valid = jnp.arange(ids.shape[0], dtype=jnp.int32) < n_valid
+        ids = jnp.where(valid[:, None], ids, n_lists)
+    return counts.at[ids.reshape(-1)].add(1, mode="drop")
+
+
 def unique_lists(probes: jax.Array, n_lists: int) -> jax.Array:
     """Sorted union of probed list ids, padded to the static cap
     ``min(n_lists, q * n_probes)`` with the sentinel id ``n_lists``.

@@ -48,7 +48,19 @@ from raft_tpu.serving.batcher import (
     DynamicBatcher,
 )
 from raft_tpu.serving.exporter import MetricsExporter
-from raft_tpu.serving.metrics import SloConfig, SloWindow
+from raft_tpu.serving.gauge import (
+    DriftDetector,
+    IndexGauge,
+    RecallWindow,
+    ShadowConfig,
+    ShadowSampler,
+)
+from raft_tpu.serving.metrics import (
+    MultiBurnAlert,
+    MultiBurnConfig,
+    SloConfig,
+    SloWindow,
+)
 from raft_tpu.serving.request import (
     Cancelled,
     DeadlineExceeded,
@@ -65,13 +77,20 @@ __all__ = [
     "BatcherConfig",
     "Cancelled",
     "DeadlineExceeded",
+    "DriftDetector",
     "DynamicBatcher",
+    "IndexGauge",
     "LoadShed",
     "MetricsExporter",
+    "MultiBurnAlert",
+    "MultiBurnConfig",
     "Overloaded",
+    "RecallWindow",
     "ResultHandle",
     "SearchRequest",
     "ServingError",
+    "ShadowConfig",
+    "ShadowSampler",
     "ShutDown",
     "SloConfig",
     "SloWindow",

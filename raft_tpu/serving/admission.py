@@ -25,7 +25,12 @@ rung  trigger (occupancy >=)      action
 ====  ==========================  =========================================
 0     —                           normal: dual-trigger batching
 1     ``shrink_wait_at``          max-wait shrinks to 0 — dispatch eagerly,
-                                  trading batch occupancy for queue drain
+                                  trading batch occupancy for queue drain;
+                                  background-class submissions (priority >=
+                                  ``background_priority``, e.g. graftgauge
+                                  shadow queries) reject from
+                                  ``background_reject_at`` (default 0.5)
+                                  while live traffic still admits
 2     ``degrade_params_at``       the configured load-shed params override
                                   applies to NEW submissions (e.g. capped
                                   ``n_probes``) — cheaper device work per
@@ -53,11 +58,21 @@ class LoadShed:
     new submissions at rung 2+ (e.g. ``lambda p: dataclasses.replace(p,
     n_probes=min(p.n_probes, 8))``). It must be deterministic: the
     overridden params join the coalesce key, and a warmup of the
-    degraded specialization keeps rung 2 zero-recompile too."""
+    degraded specialization keeps rung 2 zero-recompile too.
+
+    ``background_priority`` (PR 8, graftgauge) declares a background
+    request class — priorities at/above it are the ladder's FIRST
+    casualty: once occupancy reaches ``background_reject_at`` the
+    queue rejects background submissions with typed ``Overloaded``
+    while live traffic still admits normally. Shadow recall queries
+    ride this class, so under load the recall estimator degrades (its
+    widening CI says so) before any live request feels the queue."""
 
     shrink_wait_at: float = 0.5
     degrade_params_at: float = 0.75
     params_override: Optional[Any] = None
+    background_priority: Optional[int] = None
+    background_reject_at: float = 0.5
 
 
 # EWMA smoothing for the arrival-rate gauge: each inter-arrival gap
@@ -156,6 +171,26 @@ class AdmissionQueue:
                               if self._rate else sample)
             self._last_arrival = req.arrival
             rate = self._rate
+            shed = self.shed
+            if (shed.background_priority is not None
+                    and req.priority >= shed.background_priority
+                    and (self._n / self.capacity if self.capacity
+                         else 1.0) >= shed.background_reject_at):
+                # background class (shadow queries, compaction) is the
+                # ladder's first casualty — rejected while live
+                # traffic still admits
+                tracing.inc_counter(
+                    "serving.admission.rejected_background")
+                self._publish_gauges(self._n, rate)
+                tracing.span_event(
+                    "serving.rejected", req.arrival,
+                    trace_ids=(req.trace_id,),
+                    attrs={"reason": "background_shed",
+                           "priority": req.priority})
+                raise Overloaded(
+                    "background-class request rejected at occupancy >= "
+                    f"{shed.background_reject_at} (ladder sheds "
+                    "background work first)")
             if self._n >= self.capacity:
                 tracing.inc_counter("serving.admission.rejected")
                 self._publish_gauges(self._n, rate)

@@ -108,9 +108,12 @@ class BatcherConfig:
     applies a deadline to requests that do not carry one (None = no
     deadline). ``shed`` is the degradation ladder. ``slo`` configures
     the deadline-attainment burn-rate window (None disables the SLO
-    surface). ``adaptive_wait`` (off by default) enables the
-    arrival-rate → max-wait control law; the shed ladder's rung 1
-    (wait → 0) still takes precedence over it."""
+    surface); ``multiburn`` (PR 8) swaps in the paired short+long
+    multiwindow alert policy instead — outcomes then land in both
+    windows and ``serving.slo.alert`` fires only when both burn.
+    ``adaptive_wait`` (off by default) enables the arrival-rate →
+    max-wait control law; the shed ladder's rung 1 (wait → 0) still
+    takes precedence over it."""
 
     max_wait_s: float = 0.002
     full_batch_rows: int = 256
@@ -119,6 +122,7 @@ class BatcherConfig:
     shed: LoadShed = dataclasses.field(default_factory=LoadShed)
     slo: Optional[metrics.SloConfig] = dataclasses.field(
         default_factory=metrics.SloConfig)
+    multiburn: Optional[metrics.MultiBurnConfig] = None
     adaptive_wait: Optional[AdaptiveWait] = None
 
 
@@ -149,8 +153,13 @@ class DynamicBatcher:
         expect(self.config.full_batch_rows > 0,
                "full_batch_rows must be > 0")
         self._clock = clock or MonotonicClock()
-        self._slo = (metrics.SloWindow(self.config.slo)
-                     if self.config.slo is not None else None)
+        # multiburn (paired windows + alert) and the single window are
+        # duck-type equivalent on the completion paths: record/publish
+        if self.config.multiburn is not None:
+            self._slo = metrics.MultiBurnAlert(self.config.multiburn)
+        else:
+            self._slo = (metrics.SloWindow(self.config.slo)
+                         if self.config.slo is not None else None)
         # the queue records deadline-shed requests as SLO misses (they
         # are pruned inside its lock, where the batcher never sees them)
         self._queue = AdmissionQueue(self.config.capacity,

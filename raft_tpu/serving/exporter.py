@@ -76,6 +76,46 @@ _EXEC_GAUGE = re.compile(
     r"^serving\.executable\.([0-9a-f]+)\.([a-z_]+)$")
 _COLLECTIVE_GAUGE = re.compile(
     r"^serving\.collective\.([^.]+)\.([^.]+)\.([^.]+)\.([a-z_]+)$")
+# graftgauge (PR 8) labeled families: per-index probe-frequency
+# top-N samples + summary fields, index-health stats, drift scores —
+# the label value is the dot-free <label>/<name> segment
+_PROBE_LIST_GAUGE = re.compile(
+    r"^index\.probe_freq\.([^.]+)\.list\.([0-9]+)$")
+_PROBE_GAUGE = re.compile(
+    r"^index\.probe_freq\.([^.]+)\.([a-z0-9_]+)$")
+_HEALTH_GAUGE = re.compile(
+    r"^index\.health\.([^.]+)\.([a-z0-9_]+)$")
+_DRIFT_GAUGE = re.compile(
+    r"^index\.drift\.([^.]+)\.(score|alert)$")
+
+# HELP text per family prefix (longest match wins; the generic
+# fallback keeps every family carrying *a* HELP line — the exposition
+# satellite's parse-check requires one per family)
+_HELP_PREFIXES = (
+    ("serving.executable.", "per-executable compile-time cost analysis"),
+    ("serving.collective.", "modeled mesh collective payload bytes"),
+    ("serving.admission.", "admission-control state"),
+    ("serving.batcher.", "dynamic micro-batcher stage metric"),
+    ("serving.execute.", "executor dispatch accounting"),
+    ("serving.mesh.", "mesh straggler attribution"),
+    ("serving.slo.", "deadline-SLO attainment and burn rate"),
+    ("serving.", "serving-path metric"),
+    ("index.probe_freq.", "graftgauge per-list probe-frequency "
+                          "accounting"),
+    ("index.probe.", "graftgauge probe-accounting dispatch heartbeat"),
+    ("index.health.", "graftgauge index-health stat"),
+    ("index.recall.", "graftgauge online recall estimation"),
+    ("index.drift.", "graftgauge query-drift detection"),
+    ("xla.", "XLA backend compile accounting"),
+)
+
+
+def help_text(name: str) -> str:
+    """One-line ``# HELP`` text for a registry (or family) name."""
+    for prefix, text in _HELP_PREFIXES:
+        if name.startswith(prefix):
+            return text
+    return "raft_tpu registry metric"
 
 
 def prom_name(name: str) -> str:
@@ -103,52 +143,99 @@ def render_prometheus(counters: dict, gauges: dict, histograms: dict,
     PR 6 shape with ``bucket_bounds`` + cumulative ``bucket_counts``;
     the final overflow bucket becomes ``le="+Inf"``).
 
-    Per-executable cost gauges and modeled collective payloads render
-    as labeled families (``serving_executable_<field>{digest=...}``,
-    ``serving_collective_<field>{family=...,wire=...,probe_wire=...}``);
+    Every family — flat counters/gauges, LABELED families, histograms
+    — carries ``# HELP`` and ``# TYPE`` lines (PR 8 closed the gap
+    where only flat families were annotated; the scrape test
+    parse-checks the pairing line by line).
+
+    Labeled families: per-executable cost gauges
+    (``serving_executable_<field>{digest=...}``), modeled collective
+    payloads (``serving_collective_<field>{family=,wire=,probe_wire=}``)
+    and the graftgauge index surface —
+    ``index_probe_freq_count{index=,list=}`` top-N samples,
+    ``index_probe_freq_<field>{index=}`` summaries,
+    ``index_health_<field>{index=}`` and ``index_drift_<field>{index=}``.
     ``legacy_executable_metrics=True`` ADDITIONALLY emits the
     deprecated flat names (both the sha1-embedded executable spellings
     and the dotted collective ones) for one release of overlap."""
     lines = []
+
+    def emit_family(pn: str, mtype: str, help_name: str) -> None:
+        lines.append(f"# HELP {pn} {help_text(help_name)}")
+        lines.append(f"# TYPE {pn} {mtype}")
+
     for name in sorted(counters):
         pn = prom_name(name)
-        lines.append(f"# TYPE {pn} counter")
+        emit_family(pn, "counter", name)
         lines.append(f"{pn} {_fmt(counters[name])}")
-    exec_fields: dict = {}
-    coll_fields: dict = {}
+
+    # family prom-name -> {"help": registry prefix, "samples": [...]}
+    labeled: dict = {}
+
+    def add_labeled(pn: str, help_name: str, labels: str, v) -> None:
+        fam = labeled.setdefault(pn, {"help": help_name, "samples": []})
+        fam["samples"].append((labels, v))
+
     for name in sorted(gauges):
+        v = gauges[name]
         m = _EXEC_GAUGE.match(name)
         if m:
-            exec_fields.setdefault(m.group(2), []).append(
-                (m.group(1), gauges[name]))
+            add_labeled(f"serving_executable_{prom_name(m.group(2))}",
+                        "serving.executable.",
+                        f'digest="{m.group(1)}"', v)
             if not legacy_executable_metrics:
                 continue
         else:
             m = _COLLECTIVE_GAUGE.match(name)
             if m:
-                coll_fields.setdefault(m.group(4), []).append(
-                    (m.group(1), m.group(2), m.group(3), gauges[name]))
+                add_labeled(
+                    f"serving_collective_{prom_name(m.group(4))}",
+                    "serving.collective.",
+                    f'family="{m.group(1)}",wire="{m.group(2)}",'
+                    f'probe_wire="{m.group(3)}"', v)
                 if not legacy_executable_metrics:
                     continue
+            else:
+                # graftgauge index families are labeled-only (they
+                # were born in PR 8 — no legacy flat spelling to keep)
+                m = _PROBE_LIST_GAUGE.match(name)
+                if m:
+                    add_labeled("index_probe_freq_count",
+                                "index.probe_freq.",
+                                f'index="{m.group(1)}",'
+                                f'list="{m.group(2)}"', v)
+                    continue
+                m = _PROBE_GAUGE.match(name)
+                if m:
+                    add_labeled(
+                        f"index_probe_freq_{prom_name(m.group(2))}",
+                        "index.probe_freq.",
+                        f'index="{m.group(1)}"', v)
+                    continue
+                m = _HEALTH_GAUGE.match(name)
+                if m:
+                    add_labeled(
+                        f"index_health_{prom_name(m.group(2))}",
+                        "index.health.", f'index="{m.group(1)}"', v)
+                    continue
+                m = _DRIFT_GAUGE.match(name)
+                if m:
+                    add_labeled(
+                        f"index_drift_{prom_name(m.group(2))}",
+                        "index.drift.", f'index="{m.group(1)}"', v)
+                    continue
         pn = prom_name(name)
-        lines.append(f"# TYPE {pn} gauge")
-        lines.append(f"{pn} {_fmt(gauges[name])}")
-    for field in sorted(exec_fields):
-        pn = f"serving_executable_{prom_name(field)}"
-        lines.append(f"# TYPE {pn} gauge")
-        for digest, v in sorted(exec_fields[field]):
-            lines.append(f'{pn}{{digest="{digest}"}} {_fmt(v)}')
-    for field in sorted(coll_fields):
-        pn = f"serving_collective_{prom_name(field)}"
-        lines.append(f"# TYPE {pn} gauge")
-        for family, wire, probe_wire, v in sorted(coll_fields[field]):
-            lines.append(
-                f'{pn}{{family="{family}",wire="{wire}",'
-                f'probe_wire="{probe_wire}"}} {_fmt(v)}')
+        emit_family(pn, "gauge", name)
+        lines.append(f"{pn} {_fmt(v)}")
+    for pn in sorted(labeled):
+        fam = labeled[pn]
+        emit_family(pn, "gauge", fam["help"])
+        for labels, v in sorted(fam["samples"]):
+            lines.append(f"{pn}{{{labels}}} {_fmt(v)}")
     for name in sorted(histograms):
         snap = histograms[name]
         pn = prom_name(name)
-        lines.append(f"# TYPE {pn} histogram")
+        emit_family(pn, "histogram", name)
         bounds = snap.get("bucket_bounds", [])
         cumulative = snap.get("bucket_counts", [])
         for le, c in zip(bounds, cumulative):
@@ -174,13 +261,18 @@ class MetricsExporter:
     def __init__(self, executor=None, batcher=None, *,
                  host: str = "127.0.0.1", port: int = 0,
                  profile_dir: Optional[str] = None,
-                 legacy_executable_metrics: bool = False):
+                 legacy_executable_metrics: bool = False,
+                 index_gauge=None):
         self.executor = executor
         self.batcher = batcher
         self.host = host
         self.port = port
         self.profile_dir = profile_dir
         self.legacy_executable_metrics = legacy_executable_metrics
+        # graftgauge (PR 8): an IndexGauge refreshes the index-health /
+        # probe-frequency / recall / drift surface per scrape and backs
+        # the /index.json endpoint (404 when not attached)
+        self.index_gauge = index_gauge
         self._profile_lock = threading.Lock()
         self._server: Optional[http.server.ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -258,6 +350,23 @@ class MetricsExporter:
                 # burn rate decays as misses age out of the window —
                 # re-evaluated at the batcher clock's now per scrape
                 self.batcher.publish_slo_gauges()
+        if self.index_gauge is not None:
+            # graftgauge: one probe-plane fetch shared across the
+            # probe-frequency gauges and drift scoring, plus health
+            # stats and the shadow-recall window refresh
+            self.index_gauge.publish()
+
+    def index_snapshot(self) -> dict:
+        """The ``/index.json`` body: the attached
+        :class:`~raft_tpu.serving.gauge.IndexGauge`'s full structured
+        view (health, probe-frequency stats, drift, recall), freshly
+        published. Raises ``LookupError`` when no gauge is attached —
+        the HTTP layer maps it to 404."""
+        if self.index_gauge is None:
+            raise LookupError(
+                "no IndexGauge attached: construct MetricsExporter "
+                "with index_gauge=... to arm /index.json")
+        return self.index_gauge.publish()
 
     # -- server lifecycle ---------------------------------------------------
 
@@ -296,6 +405,14 @@ class MetricsExporter:
                         json.dumps(exporter.snapshot(),
                                    default=str).encode(),
                         "application/json")
+                elif path == "/index.json":
+                    try:
+                        out = exporter.index_snapshot()
+                    except LookupError as e:
+                        self._send(f"{e}\n".encode(), "text/plain", 404)
+                        return
+                    self._send(json.dumps(out, default=str).encode(),
+                               "application/json")
                 elif path == "/trace.json":
                     trace_id = None
                     if "trace_id" in qs:

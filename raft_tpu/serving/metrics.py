@@ -52,6 +52,26 @@ Per-stage latency **histograms** (log2 buckets, p50/p95/p99 estimates):
 - ``serving.mesh.shard_skew`` / ``.slowest_shard`` /
   ``.shard_time_{max,mean}_s``                    — straggler detector
   output (see :func:`raft_tpu.core.tracing.record_mesh_spans`)
+- ``serving.slo.burn_rate.<label>`` / ``serving.slo.alert`` — the
+  multiwindow burn-rate policy (PR 8): labeled per-window gauges plus
+  the combined alert that fires only when every window burns
+  (:class:`MultiBurnConfig` / :class:`MultiBurnAlert`)
+
+**graftgauge surface** (PR 8, published at scrape time by
+:class:`~raft_tpu.serving.gauge.IndexGauge` and the executor):
+
+- ``index.probe_freq.<label>.{total,probed_fraction,coverage_p01,
+  coverage_p10}`` + ``.list.<lid>`` top-N samples — device-side
+  probe-frequency accounting; ``index.probe_freq.accounted`` is the
+  monotone counter mirror the CI snapshot floors check, and
+  ``index.probe.{dispatches,rows}`` the per-dispatch host heartbeat
+- ``index.health.<name>.*`` — list-occupancy skew, dead/overflow
+  lists, fill fraction, Gini, per-shard imbalance
+- ``index.recall.{estimate,ci_low,ci_high,window_pairs,window_trials}``
+  + the ``index.recall.shadow_*`` lifecycle counters — windowed online
+  recall estimation from shadow queries
+- ``index.drift.score`` / ``index.drift.<name>.{score,alert}`` —
+  streaming divergence of live traffic from the build-time baseline
 
 Batch **occupancy** — the coalescing win the ISSUE's acceptance
 criterion gates on — is derived, not stored: ``requests / batches``
@@ -82,6 +102,7 @@ E2E = PREFIX + "e2e_seconds"
 SLO_ATTAINED = "serving.slo.attained"
 SLO_MISSED = "serving.slo.missed"
 SLO_BURN_RATE = "serving.slo.burn_rate"
+SLO_ALERT = "serving.slo.alert"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,10 +135,18 @@ class SloWindow:
     exactly. Thread-safe: one lock, O(events-in-window) memory; the
     miss count is maintained incrementally on append/prune, so every
     operation is O(events-pruned), not O(window) — record() sits on
-    the per-request completion path."""
+    the per-request completion path.
 
-    def __init__(self, config: Optional[SloConfig] = None):
+    ``label`` suffixes the published gauge names
+    (``serving.slo.burn_rate.<label>``) so several windows over the
+    same outcome stream — the multiburn alert's 5 m + 1 h pair —
+    publish side by side; unlabeled keeps the original flat names."""
+
+    def __init__(self, config: Optional[SloConfig] = None, *,
+                 label: Optional[str] = None):
         self.config = config or SloConfig()
+        self.label = label
+        self._suffix = f".{label}" if label else ""
         self._lock = threading.Lock()
         self._events: "collections.deque" = collections.deque()
         self._missed = 0
@@ -134,13 +163,19 @@ class SloWindow:
             self._prune_locked(now)
             return len(self._events), self._missed
 
-    def record(self, now: float, attained: bool) -> None:
-        """Count one outcome at clock time ``now`` and re-publish."""
-        tracing.inc_counter(SLO_ATTAINED if attained else SLO_MISSED)
+    def _append(self, now: float, attained: bool) -> None:
+        """Window bookkeeping only — no counter bump, no publish. The
+        multiburn alert fans one outcome into several windows and must
+        bump the process-wide attained/missed counters exactly once."""
         with self._lock:
             self._events.append((now, attained))
             if not attained:
                 self._missed += 1
+
+    def record(self, now: float, attained: bool) -> None:
+        """Count one outcome at clock time ``now`` and re-publish."""
+        tracing.inc_counter(SLO_ATTAINED if attained else SLO_MISSED)
+        self._append(now, attained)
         self.publish(now)
 
     def burn_rate(self, now: float) -> float:
@@ -160,10 +195,69 @@ class SloWindow:
         total, missed = self._counts(now)
         budget = max(1.0 - self.config.target, 1e-9)
         tracing.set_gauges({
-            SLO_BURN_RATE: (missed / total) / budget if total else 0.0,
-            "serving.slo.window_total": float(total),
-            "serving.slo.window_missed": float(missed),
+            SLO_BURN_RATE + self._suffix:
+                (missed / total) / budget if total else 0.0,
+            "serving.slo.window_total" + self._suffix: float(total),
+            "serving.slo.window_missed" + self._suffix: float(missed),
         })
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiBurnConfig:
+    """Multiwindow burn-rate alert policy (the SRE multiburn pattern):
+    a short window catches fast burns, a long window confirms they are
+    sustained, and the alert fires only when BOTH burn past
+    ``alert_burn`` — a short spike that the long window absorbs, or a
+    slow leak the short window has already recovered from, pages
+    nobody. Defaults pair 5 m + 1 h at burn 1.0 (consuming error
+    budget exactly as provisioned)."""
+
+    short: SloConfig = SloConfig(window_s=300.0)
+    long: SloConfig = SloConfig(window_s=3600.0)
+    short_label: str = "5m"
+    long_label: str = "1h"
+    alert_burn: float = 1.0
+
+
+class MultiBurnAlert:
+    """Paired :class:`SloWindow` recorder + the ``serving.slo.alert``
+    gauge. Batcher-facing duck type of a single ``SloWindow``
+    (``record(now, attained)`` / ``publish(now)``), so
+    ``BatcherConfig.multiburn`` swaps it in without touching any
+    completion path; each outcome bumps the process-wide
+    attained/missed counters exactly once and lands in both windows.
+    All timestamps are caller-clock-domain — the ManualClock tests pin
+    window arithmetic and the alert transition exactly."""
+
+    def __init__(self, config: Optional[MultiBurnConfig] = None):
+        self.config = config or MultiBurnConfig()
+        self.windows = (
+            SloWindow(self.config.short, label=self.config.short_label),
+            SloWindow(self.config.long, label=self.config.long_label),
+        )
+
+    def record(self, now: float, attained: bool) -> None:
+        """One outcome → both windows; counters bumped once."""
+        tracing.inc_counter(SLO_ATTAINED if attained else SLO_MISSED)
+        for w in self.windows:
+            w._append(now, attained)
+        self.publish(now)
+
+    def burn_rates(self, now: float) -> tuple:
+        return tuple(w.burn_rate(now) for w in self.windows)
+
+    def alert(self, now: float) -> bool:
+        """True iff EVERY window burns at/above the policy threshold."""
+        return all(r >= self.config.alert_burn
+                   for r in self.burn_rates(now))
+
+    def publish(self, now: float) -> None:
+        """Re-publish each window's labeled gauges plus the combined
+        ``serving.slo.alert`` (1.0 firing / 0.0 quiet) — scrape-time
+        refresh decays both windows and may clear the alert."""
+        for w in self.windows:
+            w.publish(now)
+        tracing.set_gauge(SLO_ALERT, 1.0 if self.alert(now) else 0.0)
 
 
 def observe_stage(name: str, seconds: float) -> None:
@@ -231,9 +325,12 @@ def snapshot() -> dict:
 
 
 def reset() -> None:
-    """Zero every serving counter, gauge, histogram, and the span
-    flight recorder — test/bench isolation."""
+    """Zero every serving + graftgauge counter, gauge, histogram, and
+    the span flight recorder — test/bench isolation (counters fold
+    into the lifetime ledger, so session artifacts survive)."""
     tracing.reset_counters("serving.")
     tracing.reset_gauges("serving.")
+    tracing.reset_counters("index.")
+    tracing.reset_gauges("index.")
     tracing.reset_histograms(PREFIX)
     tracing.reset_spans()

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Record a TPU bench baseline for the CI perf-regression gate.
+
+The committed ``ci/bench_baseline.json`` pins a small CPU config so
+every CI run gates somewhere; the numbers that actually matter are
+TPU numbers. Run this ON a TPU host to record
+``ci/bench_baseline_tpu.json`` — the same record/tolerances shape,
+plus ``"requires_backend": "tpu"`` so ``ci/bench_compare.py`` (which
+gates every ``ci/bench_baseline*.json`` by default) skips it with a
+note on CPU-only runners and gates it wherever a TPU is present.
+
+Commit the output file to put TPU throughput under the same
+regression bands as the CPU smoke::
+
+    python scripts/record_tpu_baseline.py            # defaults
+    BENCH_N=1000000 python scripts/record_tpu_baseline.py  # bigger pin
+
+Any ``BENCH_*`` already in the environment overrides the default pin
+(recorded into the baseline, so compare runs replay exactly what was
+measured).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# TPU pin: the CPU config's shape scaled to something a TPU core
+# notices, serving rider on. Deliberately modest — the gate needs a
+# stable signal, not a record run.
+TPU_PINNED_ENV = {
+    "BENCH_CHILD": "1",
+    "BENCH_N": "200000",
+    "BENCH_DIM": "128",
+    "BENCH_BATCH": "64",
+    "BENCH_K": "10",
+    "BENCH_SECONDS": "5",
+    "BENCH_DTYPE": "float32",
+    "BENCH_SERVING": "1",
+    "BENCH_SV_N": "200000",
+    "BENCH_SV_LISTS": "256",
+    "BENCH_SV_BURSTS": "40",
+    "BENCH_SV_BURST": "16",
+    "BENCH_SV_PERIOD_MS": "5",
+    "BENCH_SV_WAIT_MS": "2",
+    "BENCH_SV_TIMEOUT_MS": "2000",
+}
+
+
+def load_bench_compare():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare", REPO / "ci" / "bench_compare.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    import jax
+
+    if jax.default_backend() != "tpu":
+        sys.stderr.write(
+            "record_tpu_baseline: no TPU backend present "
+            f"(default_backend={jax.default_backend()!r}) — run this "
+            "on a TPU host\n")
+        return 2
+    bc = load_bench_compare()
+    env = dict(TPU_PINNED_ENV)
+    # operator overrides (larger corpus, different burst shape) are
+    # recorded into the baseline so replays measure the same problem
+    env.update({k: v for k, v in os.environ.items()
+                if k.startswith("BENCH_")})
+    env["BENCH_CHILD"] = "1"
+    print(f"record_tpu_baseline: running pinned TPU config "
+          f"({env['BENCH_N']}x{env['BENCH_DIM']})", flush=True)
+    record = bc.run_bench(env)
+    out_path = REPO / "ci" / "bench_baseline_tpu.json"
+    out = {
+        "env": env,
+        "requires_backend": "tpu",
+        "tolerances": bc.DEFAULT_TOLERANCES,
+        "snapshot_floors": bc.SNAPSHOT_FLOORS,
+        "record": record,
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"record_tpu_baseline: wrote {out_path} — commit it to gate "
+          "TPU throughput in CI (skipped automatically off-TPU)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -534,6 +534,38 @@ class TestFixtureCorpus:
         assert lint_lib(R7_SERVING_VIOLATING, ["R7"],
                         rel="raft_tpu/ops/sample.py").ok
 
+    def test_r5_r7_cover_graftgauge_sampler_module(self):
+        """PR 8 satellite: the hot scope reaches the new graftgauge
+        sampler module by its real path — a host sync or a bare clock
+        read landing in ``raft_tpu/serving/gauge.py`` is a finding,
+        not a blind spot (the shipped module itself lints clean: its
+        fetches are scrape-time by contract and its timestamps come
+        from the batcher's injectable clock)."""
+        sampler_sync = (
+            "def pump(handles):\n"
+            "    return [h.depth.item() for h in handles]\n"
+        )
+        bad = lint_lib(sampler_sync, ["R5"],
+                       rel="raft_tpu/serving/gauge.py")
+        assert rules_fired(bad) == {"R5"}
+        sampler_clock = (
+            "import time\n"
+            "\n"
+            "\n"
+            "def shadow_stamp():\n"
+            "    return time.monotonic()\n"
+        )
+        bad = lint_lib(sampler_clock, ["R7"],
+                       rel="raft_tpu/serving/gauge.py")
+        assert rules_fired(bad) == {"R7"}
+        # and the conforming discipline the module actually uses
+        ok = (
+            "def shadow_stamp(clock):\n"
+            "    return clock.now()\n"
+        )
+        assert lint_lib(ok, ["R5", "R7"],
+                        rel="raft_tpu/serving/gauge.py").ok
+
     def test_r7_datetime_clock_reads(self):
         """PR 7: datetime.now()/utcnow()/date.today() are wall-clock
         reads — module-dotted and from-import spellings both fire;

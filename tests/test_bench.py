@@ -687,7 +687,9 @@ class TestBenchCompare:
     def test_snapshot_floors(self, bc):
         ok = {"counters": {"serving.execute.calls": 5.0,
                            "serving.execute.modeled_bytes": 1e6,
-                           "serving.execute.modeled_flops": 1e7}}
+                           "serving.execute.modeled_flops": 1e7,
+                           "index.probe.dispatches": 2.0,
+                           "index.probe_freq.accounted": 64.0}}
         assert bc.check_snapshot(ok) == []
         dark = {"counters": {"serving.execute.calls": 5.0,
                              "serving.execute.modeled_bytes": 0.0}}
@@ -707,6 +709,8 @@ class TestBenchCompare:
                 "serving.execute.calls": 5.0,
                 "serving.execute.modeled_bytes": 1e6,
                 "serving.execute.modeled_flops": 1e7,
+                "index.probe.dispatches": 2.0,
+                "index.probe_freq.accounted": 64.0,
             },
         }
         assert bc.check_snapshot(snap) == []
@@ -748,3 +752,82 @@ class TestBenchCompare:
         # and the freshly written baseline gates against itself
         assert bc.main(["--baseline", str(bpath),
                         "--fresh", str(fresh)]) == 0
+
+    # -- PR 8: multi-baseline support + probe-accounting floors -------------
+
+    def test_snapshot_floors_include_probe_accounting(self, bc):
+        """graftgauge satellite: the gate floor-checks the device-side
+        probe-frequency ledger — a refactor that disconnects the
+        scatter-add (or the scrape fetch) zeroes these and fails."""
+        assert "index.probe_freq.accounted" in bc.SNAPSHOT_FLOORS
+        assert "index.probe.dispatches" in bc.SNAPSHOT_FLOORS
+        dark = {"counters_lifetime": {
+            "serving.execute.calls": 5.0,
+            "serving.execute.modeled_bytes": 1e6,
+            "serving.execute.modeled_flops": 1e7,
+            "index.probe.dispatches": 3.0,
+            "index.probe_freq.accounted": 0.0,     # went dark
+        }}
+        msgs = bc.check_snapshot(dark)
+        assert any("index.probe_freq.accounted" in m for m in msgs)
+        dark["counters_lifetime"]["index.probe_freq.accounted"] = 96.0
+        assert bc.check_snapshot(dark) == []
+
+    def test_multi_baseline_gates_each(self, bc, record, tmp_path):
+        import copy
+
+        b1 = tmp_path / "bench_baseline.json"
+        b2 = tmp_path / "bench_baseline_other.json"
+        b1.write_text(json.dumps({"record": record}))
+        tight = copy.deepcopy(record)
+        tight["serving"]["qps"] = record["serving"]["qps"] * 4
+        b2.write_text(json.dumps({"record": tight}))
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(record))
+        # passes against itself, fails against the tighter second
+        assert bc.main(["--baseline", str(b1),
+                        "--fresh", str(fresh)]) == 0
+        assert bc.main(["--baseline", str(b1), "--baseline", str(b2),
+                        "--fresh", str(fresh)]) == 1
+
+    def test_requires_backend_skips_when_absent(self, bc, record,
+                                                tmp_path, capsys):
+        import copy
+
+        impossible = copy.deepcopy(record)
+        impossible["serving"]["qps"] = record["serving"]["qps"] * 100
+        tpu = tmp_path / "bench_baseline_tpu.json"
+        tpu.write_text(json.dumps({"record": impossible,
+                                   "requires_backend": "tpu"}))
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(record))
+        # the (on CPU CI, unmeetable) TPU baseline is skipped with a
+        # note instead of failing the gate
+        assert bc.main(["--baseline", str(tpu),
+                        "--fresh", str(fresh)]) == 0
+        assert "SKIP" in capsys.readouterr().out
+        cpu_spelled = tmp_path / "bench_baseline_cpu.json"
+        cpu_spelled.write_text(json.dumps({"record": record,
+                                           "requires_backend": "cpu"}))
+        # a baseline whose backend IS present gates normally
+        assert bc.main(["--baseline", str(cpu_spelled),
+                        "--fresh", str(fresh)]) == 0
+
+    def test_update_rejects_multiple_baselines(self, bc, record,
+                                               tmp_path):
+        fresh = tmp_path / "fresh.json"
+        fresh.write_text(json.dumps(record))
+        assert bc.main(["--baseline", str(tmp_path / "a.json"),
+                        "--baseline", str(tmp_path / "b.json"),
+                        "--fresh", str(fresh), "--update"]) == 2
+
+    def test_default_baselines_glob(self, bc):
+        """With no --baseline the gate picks up every committed
+        ci/bench_baseline*.json — how a recorded TPU baseline joins
+        CI without touching test.sh."""
+        import os
+
+        paths = bc.default_baselines()
+        assert any(p.endswith("bench_baseline.json") for p in paths)
+        assert all(os.path.basename(p).startswith("bench_baseline")
+                   for p in paths)

@@ -595,3 +595,90 @@ class TestShardedAnnStraggler:
         n_before = len(rec.spans(name="serving.mesh.shard"))
         idx.search(None, q, 5)
         assert len(rec.spans(name="serving.mesh.shard")) == n_before
+
+
+class TestMeshProbeAccounting:
+    """graftgauge (PR 8): the sharded IVF families scatter-add their
+    selected probes into a LIST-SHARDED donated counter plane — each
+    shard counts only the probes it owns, so a probe lands exactly
+    once mesh-wide and the gathered plane is the same global histogram
+    the single-chip index would have recorded."""
+
+    def test_mesh_bit_identity_and_exact_counts(self, data, flat_pair):
+        _, q = data
+        single, dist = flat_pair
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        ex = SearchExecutor(probe_accounting=True)
+        d1, i1 = ex.search(dist, q, 5, params=sp)
+        d0, i0 = dist_ivf.search(None, sp, dist, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        (plane,) = ex.probe_frequencies().values()
+        assert plane.shape == (dist.n_lists,)
+        assert plane.sum() == q.shape[0] * 8
+
+    def test_mesh_histogram_in_own_list_id_space(self, data, flat_pair):
+        """The gathered mesh plane must be the exact probe histogram
+        in the DIST index's own list-id space (the deal permutes list
+        ids, so that space — the one ``dist.list_sizes`` and the drift
+        baseline live in — is the meaningful one): bin for bin equal
+        to a host-side bincount of the coarse selection over the dist
+        quantizer, and a permutation of nothing lost."""
+        _, q = data
+        _, dist = flat_pair
+        import jax.numpy as jnp
+        from raft_tpu.neighbors._batching import coarse_select
+
+        ex = SearchExecutor(probe_accounting=True)
+        ex.search(dist, q, 5,
+                  params=IvfFlatSearchParams(n_probes=8,
+                                             scan_engine="xla"))
+        (p_mesh,) = ex.probe_frequencies().values()
+        c = jnp.asarray(np.asarray(dist.centers))
+        ip = jnp.asarray(q) @ c.T
+        score = -(jnp.sum(jnp.square(c), axis=1)[None, :] - 2.0 * ip)
+        probes = np.asarray(coarse_select(score, 8, "exact"))
+        expected = np.bincount(probes.reshape(-1),
+                               minlength=dist.n_lists)
+        np.testing.assert_array_equal(expected, p_mesh)
+
+    def test_mesh_zero_recompile_with_accounting(self, data, flat_pair):
+        _, q = data
+        _, dist = flat_pair
+        tracing.install_xla_compile_listener()
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        ex = SearchExecutor(probe_accounting=True)
+        for n in (16, 13, 9):
+            ex.search(dist, q[:n], 5, params=sp)
+        compiles0 = ex.stats.compile_count
+        backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        for n in (16, 13, 9, 13, 16, 9):
+            ex.search(dist, q[:n], 5, params=sp)
+        assert ex.stats.compile_count == compiles0
+        assert tracing.get_counter(tracing.XLA_COMPILE_COUNT) == backend0
+        # pad rows masked on the mesh too: 6 + 3 dispatches of
+        # (16+13+9)=38 rows x 8 probes each plane-wide
+        (plane,) = ex.probe_frequencies().values()
+        assert plane.sum() == 3 * (16 + 13 + 9) * 8
+
+    def test_mesh_pq_and_bq_accounting(self, comms, data):
+        x, q = data
+        ex = SearchExecutor(probe_accounting=True)
+        pq = dist_ivf.build_pq(
+            None, comms, IvfPqIndexParams(n_lists=32, pq_dim=8), x)
+        sp = IvfPqSearchParams(n_probes=8)
+        d1, i1 = ex.search(pq, q, 5, params=sp)
+        d0, i0 = dist_ivf.search_pq(None, sp, pq, q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        bq = dist_bq.build_bq(
+            None, comms, ivf_bq.IvfBqIndexParams(n_lists=32), x)
+        bp = ivf_bq.IvfBqSearchParams(n_probes=8)
+        d3, i3 = ex.search(bq, q, 5, params=bp)
+        d2, i2 = dist_bq.search_bq(None, bp, bq, q, 5)
+        np.testing.assert_array_equal(np.asarray(i2), np.asarray(i3))
+        np.testing.assert_array_equal(np.asarray(d2), np.asarray(d3))
+        planes = ex.probe_frequencies()
+        assert len(planes) == 2
+        for plane in planes.values():
+            assert plane.sum() == q.shape[0] * 8

@@ -301,3 +301,123 @@ class TestCostIntrospection:
         assert tracing.gauges(f"serving.executable.{gone}.") == {}
         assert tracing.get_gauge(
             f"serving.executable.{second.pop()}.bytes_accessed") > 0
+
+
+class TestProbeAccounting:
+    """graftgauge (PR 8): device-side probe-frequency accounting —
+    a donated int32 counter plane scatter-added inside the jitted IVF
+    search bodies. Acceptance: bit-identity and zero-recompile stay
+    green with accounting ON, counts are exact (inert bucket-pad rows
+    masked), and the counters surface only at scrape time."""
+
+    IVF = ("ivf_flat", "ivf_pq", "ivf_bq")
+
+    @pytest.mark.parametrize("name", IVF)
+    def test_bit_identity_with_accounting_on(self, data, indexes, name):
+        _, q = data
+        ex = SearchExecutor(probe_accounting=True)
+        d1, i1 = ex.search(indexes[name], q, 5, params=_params(name))
+        d0, i0 = _direct(name, indexes[name], q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    @pytest.mark.parametrize("name", IVF)
+    def test_exact_counts_and_pad_masking(self, data, indexes, name):
+        """Every dispatch adds exactly rows * n_probes to the plane —
+        13 rows pad to the 16-bucket, and the 3 phantom rows' probe
+        selections must NOT pollute the histogram."""
+        _, q = data
+        ex = SearchExecutor(probe_accounting=True)
+        for _ in range(3):
+            ex.search(indexes[name], q[:13], 5, params=_params(name))
+        (plane,) = ex.probe_frequencies().values()
+        assert plane.shape == (8,)          # n_lists
+        assert plane.sum() == 3 * 13 * 8    # calls * rows * n_probes
+        assert (plane >= 0).all()
+
+    def test_zero_recompile_steady_state(self, data, indexes):
+        _, q = data
+        tracing.install_xla_compile_listener()
+        ex = SearchExecutor(probe_accounting=True)
+        sp = ivf_flat.IvfFlatSearchParams(n_probes=4)
+        for n in (16, 13, 9):
+            ex.search(indexes["ivf_flat"], q[:n], 5, params=sp)
+        compiles0 = ex.stats.compile_count
+        backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        for n in (16, 13, 9, 13, 16, 9):
+            ex.search(indexes["ivf_flat"], q[:n], 5, params=sp)
+        assert ex.stats.compile_count == compiles0
+        assert tracing.get_counter(tracing.XLA_COMPILE_COUNT) == backend0
+
+    def test_accounting_is_a_distinct_executable(self, data, indexes):
+        """The counter plane changes the compiled signature, so the
+        flag joins the cache key — an accounting executor and a plain
+        one must not collide in the persistent compile cache."""
+        _, q = data
+        ex_on = SearchExecutor(probe_accounting=True)
+        ex_off = SearchExecutor(probe_accounting=False)
+        sp = ivf_flat.IvfFlatSearchParams(n_probes=4)
+        kon = ex_on._plan(indexes["ivf_flat"], sp, 5, 16, None, {}).key
+        koff = ex_off._plan(indexes["ivf_flat"], sp, 5, 16, None, {}).key
+        assert kon != koff
+        assert "probe_accounting" in kon
+
+    def test_off_by_default_no_planes(self, data, indexes):
+        _, q = data
+        ex = SearchExecutor()
+        ex.search(indexes["ivf_flat"], q, 5,
+                  params=ivf_flat.IvfFlatSearchParams(n_probes=4))
+        assert ex.probe_frequencies() == {}
+        assert ex.probe_label(indexes["ivf_flat"]) is None
+
+    def test_non_ivf_families_unaffected(self, data, indexes):
+        _, q = data
+        ex = SearchExecutor(probe_accounting=True)
+        d1, i1 = ex.search(indexes["brute_force"], q, 5)
+        d0, i0 = _direct("brute_force", indexes["brute_force"], q, 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        assert ex.probe_frequencies() == {}
+
+    def test_publish_probe_gauges_and_lifetime_counter(
+            self, data, indexes):
+        _, q = data
+        tracing.reset_counters("index.")
+        tracing.reset_gauges("index.")
+        ex = SearchExecutor(probe_accounting=True)
+        sp = ivf_flat.IvfFlatSearchParams(n_probes=4)
+        ex.search(indexes["ivf_flat"], q, 5, params=sp)
+        label = ex.probe_label(indexes["ivf_flat"])
+        assert label is not None and "." not in label
+        stats = ex.publish_probe_gauges(top_n=3)[label]
+        base = f"index.probe_freq.{label}."
+        assert tracing.get_gauge(base + "total") == 16 * 4
+        assert 0.0 < tracing.get_gauge(base + "probed_fraction") <= 1.0
+        assert (tracing.get_gauge(base + "coverage_p01")
+                <= tracing.get_gauge(base + "coverage_p10"))
+        assert len(stats["top"]) <= 3
+        for lid, c in stats["top"]:
+            assert tracing.get_gauge(f"{base}list.{lid}") == float(c)
+        # the monotone counter mirror — what the CI snapshot floors
+        # check — reflects exactly what came off the device
+        assert tracing.get_counter(
+            "index.probe_freq.accounted") == 16 * 4
+        ex.search(indexes["ivf_flat"], q, 5, params=sp)
+        ex.publish_probe_gauges(top_n=3)
+        assert tracing.get_counter(
+            "index.probe_freq.accounted") == 2 * 16 * 4
+        # the per-dispatch host heartbeat
+        assert tracing.get_counter("index.probe.dispatches") == 2
+        assert tracing.get_counter("index.probe.rows") == 32
+
+    def test_stale_topn_samples_retire(self, data, indexes):
+        _, q = data
+        ex = SearchExecutor(probe_accounting=True)
+        ex.search(indexes["ivf_flat"], q, 5,
+                  params=ivf_flat.IvfFlatSearchParams(n_probes=4))
+        label = ex.probe_label(indexes["ivf_flat"])
+        base = f"index.probe_freq.{label}.list."
+        ex.publish_probe_gauges(top_n=8)
+        # fake a stale sample, then republishing must retire it
+        tracing.set_gauge(base + "9999", 123.0)
+        ex.publish_probe_gauges(top_n=8)
+        assert base + "9999" not in tracing.gauges(base)

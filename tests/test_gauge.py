@@ -1,0 +1,454 @@
+"""graftgauge tests (PR 8) — the index-health half of observability.
+
+- Online recall estimation: the shadow-query sampler's windowed
+  estimate is CALIBRATED against exact recall on a known corpus (the
+  acceptance criterion: within ±0.02 while all shadows complete), and
+  shadow work is the admission ladder's FIRST casualty under injected
+  overload — live traffic never waits on a shadow.
+- Query-drift detection: deterministic under a fixed shadow-sample
+  seed (two identical runs → bit-equal score sequences), crafted
+  traffic shifts drive the JS score up, quiet scrapes hold it.
+- IndexGauge + exporter: one scrape refreshes health / probe-freq /
+  recall / drift, ``/index.json`` serves the structured view, 404
+  when unattached.
+
+Everything deterministic: manual clock, seeded sampler, threadless
+batcher (``start=False`` + ``pump()``).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu import SearchExecutor
+from raft_tpu.core import tracing
+from raft_tpu.neighbors import brute_force, ivf_flat
+from raft_tpu.serving import (
+    BatcherConfig,
+    DriftDetector,
+    DynamicBatcher,
+    IndexGauge,
+    LoadShed,
+    MetricsExporter,
+    RecallWindow,
+    ShadowConfig,
+    ShadowSampler,
+)
+from raft_tpu.serving import metrics
+from raft_tpu.serving.gauge import wilson_interval
+from raft_tpu.serving.harness import FakeExecutor, ManualClock
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """Calibration corpus: big enough that n_probes=2/8 visibly
+    misses, with a brute-force twin as ground truth."""
+    rng = np.random.default_rng(42)
+    x = rng.standard_normal((2000, 24)).astype(np.float32)
+    q = rng.standard_normal((48, 24)).astype(np.float32)
+    return {
+        "x": x, "q": q,
+        "ivf": ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=16), x),
+        "bf": brute_force.build(None, x),
+    }
+
+
+def exact_recall(ivf_index, bf_index, q, k, params):
+    """Host-side ground truth: recall@k of the ANN result against the
+    brute-force ids over the SAME query block."""
+    _, ann = ivf_flat.search(None, params, ivf_index, q, k)
+    _, truth = brute_force.search(None, bf_index, q, k)
+    ann, truth = np.asarray(ann), np.asarray(truth)
+    hits = sum(int(np.isin(ann[r], truth[r][truth[r] >= 0]).sum())
+               for r in range(ann.shape[0]))
+    return hits / (ann.shape[0] * k)
+
+
+class TestWilsonInterval:
+    def test_edges(self):
+        assert wilson_interval(0, 0) == (0.0, 1.0)
+        lo, hi = wilson_interval(10, 10)
+        assert lo < 1.0 and hi == 1.0
+        lo, hi = wilson_interval(0, 10)
+        assert lo == 0.0 and hi > 0.0
+
+    def test_narrows_with_trials(self):
+        lo1, hi1 = wilson_interval(8, 10)
+        lo2, hi2 = wilson_interval(800, 1000)
+        assert (hi2 - lo2) < (hi1 - lo1)
+        assert lo2 < 0.8 < hi2
+
+
+class TestRecallWindow:
+    def test_estimate_and_slide(self):
+        metrics.reset()
+        w = RecallWindow(window_s=10.0)
+        w.record(0.0, hits=9, trials=10)
+        w.record(5.0, hits=5, trials=10)
+        e = w.estimate(5.0)
+        assert e["estimate"] == pytest.approx(14 / 20)
+        assert e["pairs"] == 2
+        assert tracing.get_gauge(tracing.RECALL_ESTIMATE) == (
+            pytest.approx(14 / 20))
+        # the first pair ages out of the window
+        e = w.estimate(10.5)
+        assert e["estimate"] == pytest.approx(5 / 10)
+        assert e["pairs"] == 1
+        # empty window is maximally uncertain, not confidently zero
+        e = w.estimate(100.0)
+        assert e["estimate"] == 0.0
+        assert (e["ci_low"], e["ci_high"]) == (0.0, 1.0)
+
+
+class TestShadowSampler:
+    def _serve(self, corpus, fraction, seed, n_probes=2, k=10,
+               rounds=6, rows=8):
+        """Drive the live+shadow loop threadless; returns the sampler
+        after all pairs resolve."""
+        ex = SearchExecutor()
+        clock = ManualClock()
+        b = DynamicBatcher(
+            ex, BatcherConfig(max_wait_s=0.0,
+                              shed=LoadShed(background_priority=100)),
+            clock=clock, start=False)
+        sampler = ShadowSampler(
+            b, corpus["bf"],
+            ShadowConfig(fraction=fraction, seed=seed, priority=100,
+                         timeout_s=None, window_s=1e9))
+        p = ivf_flat.IvfFlatSearchParams(n_probes=n_probes)
+        q = corpus["q"]
+        for r in range(rounds):
+            block = q[(r * rows) % 40:(r * rows) % 40 + rows]
+            sampler.submit(corpus["ivf"], block, k, params=p)
+            while b.pump():
+                pass
+        sampler.pump()
+        b.close()
+        return sampler
+
+    def test_estimate_calibrated_within_002(self, corpus):
+        """Acceptance: with every shadow completing, the windowed
+        estimate lands within ±0.02 of exact recall on the SAME
+        queries (here it is exactly equal — same pairs, same
+        arithmetic — so the band is pure safety margin)."""
+        metrics.reset()
+        p = ivf_flat.IvfFlatSearchParams(n_probes=2)
+        sampler = self._serve(corpus, fraction=1.0, seed=3)
+        e = sampler.window.estimate(sampler._clock.now())
+        assert e["pairs"] == 6
+        truth = exact_recall(corpus["ivf"], corpus["bf"],
+                             corpus["q"][:40], 10, p)
+        assert 0.2 < truth < 0.999      # the corpus really misses
+        assert abs(e["estimate"] - truth) <= 0.02
+        assert e["ci_low"] <= e["estimate"] <= e["ci_high"]
+        assert tracing.get_counter(
+            "index.recall.shadow_completed") == 6
+
+    def test_sampled_subset_is_seed_deterministic(self, corpus):
+        metrics.reset()
+        s1 = self._serve(corpus, fraction=0.5, seed=11)
+        n1 = tracing.get_counter("index.recall.shadow_submitted")
+        e1 = s1.window.estimate(s1._clock.now())
+        metrics.reset()
+        s2 = self._serve(corpus, fraction=0.5, seed=11)
+        n2 = tracing.get_counter("index.recall.shadow_submitted")
+        e2 = s2.window.estimate(s2._clock.now())
+        assert n1 == n2 > 0
+        assert e1 == e2
+
+    def test_shadow_sheds_first_under_overload(self):
+        """Injected overload: occupancy >= background_reject_at makes
+        the queue reject SHADOW submissions with typed Overloaded
+        while the live path still admits — the recall estimator
+        degrades (fewer samples) before any live request queues behind
+        shadow work."""
+        metrics.reset()
+        ex = FakeExecutor()
+        clock = ManualClock()
+        b = DynamicBatcher(
+            ex,
+            BatcherConfig(max_wait_s=1.0, capacity=8,
+                          shed=LoadShed(background_priority=100,
+                                        background_reject_at=0.5)),
+            clock=clock, start=False)
+
+        class _Idx:
+            pass
+
+        live_idx, exact_idx = _Idx(), _Idx()
+        sampler = ShadowSampler(b, exact_idx,
+                                ShadowConfig(fraction=1.0, seed=0,
+                                             priority=100))
+        blk = np.zeros((1, 4), np.float32)
+        # fill to occupancy 0.5 without pumping
+        for _ in range(4):
+            b.submit(live_idx, blk, 3)
+        h = sampler.submit(live_idx, blk, 3)
+        # live admitted (queue depth grew), shadow rejected + counted
+        assert tracing.get_counter("index.recall.shadow_shed") == 1
+        assert tracing.get_counter(
+            "serving.admission.rejected_background") == 1
+        assert tracing.get_counter(
+            "index.recall.shadow_submitted") == 0
+        clock.advance(1.0)
+        while b.pump():
+            pass
+        assert h.result(timeout=0) is not None   # live unharmed
+        b.close()
+
+    def test_shadow_below_threshold_admits(self):
+        metrics.reset()
+        b = DynamicBatcher(
+            FakeExecutor(),
+            BatcherConfig(max_wait_s=1.0, capacity=8,
+                          shed=LoadShed(background_priority=100,
+                                        background_reject_at=0.5)),
+            clock=ManualClock(), start=False)
+
+        class _Idx:
+            pass
+
+        sampler = ShadowSampler(b, _Idx(),
+                                ShadowConfig(fraction=1.0, seed=0,
+                                             priority=100))
+        sampler.submit(_Idx(), np.zeros((1, 4), np.float32), 3)
+        assert tracing.get_counter(
+            "index.recall.shadow_submitted") == 1
+        assert tracing.get_counter("index.recall.shadow_shed") == 0
+        b.close()
+
+
+class TestDriftDetector:
+    def test_score_rises_with_shifted_traffic_and_holds_quiet(self):
+        baseline = np.full(16, 100.0)       # even build-time histogram
+        det = DriftDetector(baseline, alpha=1.0, alert_threshold=0.3)
+        assert det.score == 0.0 and not det.alert
+        # live traffic matching the baseline: no drift
+        cum = np.full(16, 5.0)
+        assert det.update(cum) == pytest.approx(0.0)
+        # traffic collapses onto 2 of 16 lists: strong drift
+        cum2 = cum.copy()
+        cum2[:2] += 500.0
+        s = det.update(cum2)
+        assert s > 0.3 and det.alert
+        # a quiet scrape (no new probes) holds the score
+        assert det.update(cum2) == s
+        assert det.updates == 2
+
+    def test_ewma_smooths_single_scrape_spike(self):
+        baseline = np.full(8, 10.0)
+        det = DriftDetector(baseline, alpha=0.2)
+        even = np.full(8, 10.0)
+        det.update(even)
+        spike = even + np.eye(8)[0] * 1000.0
+        s_smooth = det.update(spike)
+        det2 = DriftDetector(baseline, alpha=1.0)
+        det2.update(even)
+        s_raw = det2.update(spike)
+        assert 0.0 < s_smooth < s_raw
+
+    def test_deterministic_sequence(self):
+        rng = np.random.default_rng(5)
+        baseline = rng.integers(1, 50, size=32)
+        cums = np.cumsum(rng.integers(0, 9, size=(6, 32)), axis=0)
+        runs = []
+        for _ in range(2):
+            det = DriftDetector(baseline)
+            runs.append([det.update(c) for c in cums])
+        assert runs[0] == runs[1]          # bit-equal, not approx
+
+
+class TestIndexGauge:
+    def test_publish_and_index_json(self, corpus):
+        metrics.reset()
+        ex = SearchExecutor(probe_accounting=True)
+        clock = ManualClock()
+        b = DynamicBatcher(ex, BatcherConfig(max_wait_s=0.0),
+                           clock=clock, start=False)
+        sampler = ShadowSampler(
+            b, corpus["bf"], ShadowConfig(fraction=1.0, seed=1,
+                                          timeout_s=None))
+        p = ivf_flat.IvfFlatSearchParams(n_probes=4)
+        sampler.submit(corpus["ivf"], corpus["q"][:8], 5, params=p)
+        while b.pump():
+            pass
+        det = DriftDetector.from_index(corpus["ivf"])
+        gauge = IndexGauge(executor=ex, indexes={"main": corpus["ivf"]},
+                           sampler=sampler, drift={"main": det})
+        with MetricsExporter(executor=ex, batcher=b,
+                             index_gauge=gauge) as exp:
+            body = json.loads(urllib.request.urlopen(
+                exp.url("/index.json"), timeout=10).read())
+            assert body["health"]["main"]["n_lists"] == 16
+            assert body["health"]["main"]["rows"] == 2000
+            assert body["recall"]["pairs"] == 1
+            assert body["drift"]["main"]["updates"] == 1
+            label = ex.probe_label(corpus["ivf"])
+            assert body["probe_freq"][label]["total"] == 8 * 4
+            # gauges landed for every surface
+            assert tracing.get_gauge(
+                "index.health.main.dead_lists") >= 0.0
+            assert tracing.get_gauge(
+                f"index.probe_freq.{label}.total") == 8 * 4
+            assert tracing.gauges("index.drift.main.")
+            # and the scrape exposes them as LABELED prom families
+            text = urllib.request.urlopen(
+                exp.url("/metrics"), timeout=10).read().decode()
+            assert f'index_probe_freq_total{{index="{label}"}}' in text
+            assert 'index_health_rows{index="main"} 2000' in text
+            assert 'index_drift_score{index="main"}' in text
+        b.close()
+
+    def test_index_json_404_when_unattached(self):
+        with MetricsExporter() as exp:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(exp.url("/index.json"),
+                                       timeout=10)
+            assert ei.value.code == 404
+
+    def test_drift_pairs_with_live_plane_via_probe_label(self, corpus):
+        """The detector watches the executor's REAL probe plane: live
+        traffic matching the build distribution scores near zero;
+        after the baseline is skewed away, the same traffic alerts."""
+        metrics.reset()
+        ex = SearchExecutor(probe_accounting=True)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=4)
+        ex.search(corpus["ivf"], corpus["q"], 5, params=p)
+        det = DriftDetector.from_index(corpus["ivf"],
+                                       alert_threshold=0.5)
+        gauge = IndexGauge(executor=ex,
+                           indexes={"main": corpus["ivf"]},
+                           drift={"main": det})
+        gauge.publish()
+        assert det.updates == 1
+        low = det.score
+        assert 0.0 <= low < 0.5
+        skewed = np.zeros(16)
+        skewed[0] = 1.0                      # everything in one list
+        det2 = DriftDetector(skewed)
+        gauge2 = IndexGauge(executor=ex,
+                            indexes={"main": corpus["ivf"]},
+                            drift={"main": det2})
+        ex.search(corpus["ivf"], corpus["q"], 5, params=p)
+        gauge2.publish()
+        assert det2.score > low
+        assert tracing.get_gauge(tracing.DRIFT_SCORE) == det2.score
+
+
+class TestReviewHardening:
+    """Regression tests for the PR 8 review findings."""
+
+    def test_filtered_requests_are_never_shadowed(self, corpus):
+        """Recall must compare ANN against exact truth over the SAME
+        candidate set; the brute-force shadow leg has no filter
+        support, so a filtered pair would score healthy traffic
+        against the unfiltered truth and read permanently stale.
+        Filtered submissions skip shadowing (counted) instead."""
+        from raft_tpu.core.bitset import Bitset
+        from raft_tpu.neighbors.filters import BitsetFilter
+
+        metrics.reset()
+        n = corpus["x"].shape[0]
+        keep = np.zeros(n, bool)
+        keep[: n // 2] = True              # exclude half the corpus
+        filt = BitsetFilter(Bitset.from_mask(keep))
+        ex = SearchExecutor()
+        clock = ManualClock()
+        b = DynamicBatcher(ex, BatcherConfig(max_wait_s=0.0),
+                           clock=clock, start=False)
+        sampler = ShadowSampler(
+            b, corpus["bf"], ShadowConfig(fraction=1.0, seed=2,
+                                          timeout_s=None))
+        p = ivf_flat.IvfFlatSearchParams(n_probes=4)
+        q = corpus["q"][:16]
+        h = sampler.submit(corpus["ivf"], q, 10, params=p,
+                           sample_filter=filt)
+        while b.pump():
+            pass
+        # the live (filtered) leg served normally...
+        _, ids = h.result(timeout=0)
+        assert (np.asarray(ids) < n // 2).all()   # filter honored
+        # ...but no pair formed: skipped, not mis-scored
+        assert sampler.pump() == 0
+        assert tracing.get_counter(
+            "index.recall.shadow_skipped") == 1
+        assert tracing.get_counter(
+            "index.recall.shadow_submitted") == 0
+        assert sampler.window.estimate(clock.now())["pairs"] == 0
+        b.close()
+
+    def test_live_failure_balances_shadow_ledger(self):
+        """A pair whose LIVE leg was shed still resolves into the
+        lifecycle ledger (dropped), so submitted == completed +
+        shed-after-admission + dropped."""
+        metrics.reset()
+        clock = ManualClock()
+        b = DynamicBatcher(FakeExecutor(),
+                           BatcherConfig(max_wait_s=0.05),
+                           clock=clock, start=False)
+
+        class _Idx:
+            pass
+
+        sampler = ShadowSampler(b, _Idx(),
+                                ShadowConfig(fraction=1.0, seed=0,
+                                             timeout_s=None))
+        # live expires in-queue; the (no-deadline) shadow completes
+        sampler.submit(_Idx(), np.zeros((1, 4), np.float32), 3,
+                       timeout_s=0.01)
+        clock.advance(0.1)
+        while b.pump():
+            pass
+        assert sampler.pump() == 0
+        assert tracing.get_counter("index.recall.shadow_dropped") == 1
+        submitted = tracing.get_counter("index.recall.shadow_submitted")
+        resolved = (tracing.get_counter("index.recall.shadow_completed")
+                    + tracing.get_counter("index.recall.shadow_shed")
+                    + tracing.get_counter("index.recall.shadow_dropped"))
+        assert submitted == resolved == 1
+        b.close()
+
+    def test_probe_window_reset_keeps_totals_monotone(self, corpus):
+        """Each scrape claims its window (device plane resets to
+        zero; totals accumulate host-side in int64) — repeated quiet
+        scrapes change nothing and never double-count."""
+        metrics.reset()
+        ex = SearchExecutor(probe_accounting=True)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=4)
+        ex.search(corpus["ivf"], corpus["q"][:16], 5, params=p)
+        (t1,) = ex.probe_frequencies().values()
+        assert t1.dtype == np.int64 and t1.sum() == 16 * 4
+        assert tracing.get_counter(
+            "index.probe_freq.accounted") == 16 * 4
+        # quiet scrapes: totals identical, accounted unmoved
+        (t2,) = ex.probe_frequencies().values()
+        np.testing.assert_array_equal(t1, t2)
+        assert tracing.get_counter(
+            "index.probe_freq.accounted") == 16 * 4
+        # more traffic accumulates on top
+        ex.search(corpus["ivf"], corpus["q"][:16], 5, params=p)
+        (t3,) = ex.probe_frequencies().values()
+        assert t3.sum() == 2 * 16 * 4
+        np.testing.assert_array_equal(t3, 2 * t1)
+
+    def test_dead_index_plane_evicted(self):
+        """A garbage-collected index's plane (and label) must not be
+        inherited by a new index reusing its address."""
+        import gc
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((400, 8)).astype(np.float32)
+        q = rng.standard_normal((8, 8)).astype(np.float32)
+        ex = SearchExecutor(probe_accounting=True)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=2)
+        idx = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+        ex.search(idx, q, 5, params=p)
+        assert len(ex.probe_frequencies()) == 1
+        del idx
+        gc.collect()
+        assert ex.probe_frequencies() == {}

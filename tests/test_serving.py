@@ -1158,3 +1158,163 @@ class TestPrometheusLabels:
                                  legacy_executable_metrics=True)
         assert 'serving_executable_flops{digest="aaa111"} 10' in text
         assert "serving_executable_aaa111_flops 10" in text
+
+
+class TestMultiBurnAlert:
+    """PR 8 satellite: the paired 5 m + 1 h multiwindow burn-rate
+    policy — ``serving.slo.alert`` fires only when BOTH windows burn,
+    pinned exactly under the manual clock."""
+
+    def _multiburn(self, short_s=10.0, long_s=100.0, target=0.5):
+        from raft_tpu.serving import MultiBurnConfig, SloConfig
+
+        cfg = MultiBurnConfig(
+            short=metrics.SloConfig(window_s=short_s, target=target),
+            long=metrics.SloConfig(window_s=long_s, target=target),
+            short_label="short", long_label="long")
+        return metrics.MultiBurnAlert(cfg)
+
+    def test_alert_requires_both_windows(self):
+        metrics.reset()
+        mb = self._multiburn()
+        # burn only the short window: misses at t=0..2, then a long
+        # stretch of attained keeps the LONG window healthy
+        for t in (0.0, 1.0, 2.0):
+            mb.record(t, attained=False)
+        for t in range(3, 30):
+            mb.record(float(t), attained=True)
+        now = 29.0
+        short_rate, long_rate = mb.burn_rates(now)
+        # short window (last 10 s) holds only attained events
+        assert short_rate == 0.0
+        assert long_rate > 0.0
+        assert not mb.alert(now)
+        assert tracing.get_gauge(metrics.SLO_ALERT) == 0.0
+
+    def test_alert_fires_when_both_burn_then_clears(self):
+        metrics.reset()
+        mb = self._multiburn(target=0.5)    # budget = 0.5
+        # 100% misses: both windows burn at 1/0.5 = 2.0 >= 1.0
+        for t in (0.0, 1.0, 2.0, 3.0):
+            mb.record(float(t), attained=False)
+        assert mb.burn_rates(3.0) == (pytest.approx(2.0),
+                                      pytest.approx(2.0))
+        assert mb.alert(3.0)
+        assert tracing.get_gauge(metrics.SLO_ALERT) == 1.0
+        assert tracing.get_gauge(
+            "serving.slo.burn_rate.short") == pytest.approx(2.0)
+        assert tracing.get_gauge(
+            "serving.slo.burn_rate.long") == pytest.approx(2.0)
+        # the misses age out of the SHORT window -> alert clears at
+        # scrape-time publish even though the long window still burns
+        mb.publish(50.0)
+        assert tracing.get_gauge(
+            "serving.slo.burn_rate.short") == 0.0
+        assert tracing.get_gauge(
+            "serving.slo.burn_rate.long") == pytest.approx(2.0)
+        assert tracing.get_gauge(metrics.SLO_ALERT) == 0.0
+
+    def test_counters_bump_exactly_once_per_outcome(self):
+        metrics.reset()
+        mb = self._multiburn()
+        mb.record(0.0, attained=True)
+        mb.record(1.0, attained=False)
+        assert tracing.get_counter(metrics.SLO_ATTAINED) == 1.0
+        assert tracing.get_counter(metrics.SLO_MISSED) == 1.0
+
+    def test_batcher_swaps_in_multiburn(self):
+        """``BatcherConfig(multiburn=...)`` routes every completion
+        outcome through the paired windows — shed-at-expiry lands in
+        both, and the alert gauge goes live."""
+        from raft_tpu.serving import MultiBurnConfig
+
+        metrics.reset()
+        clock = ManualClock()
+        cfg = MultiBurnConfig(
+            short=metrics.SloConfig(window_s=10.0, target=0.5),
+            long=metrics.SloConfig(window_s=100.0, target=0.5),
+            short_label="short", long_label="long")
+        b = DynamicBatcher(
+            FakeExecutor(),
+            BatcherConfig(max_wait_s=0.01, multiburn=cfg),
+            clock=clock, start=False)
+        idx = _Index()
+        h = b.submit(idx, q_block([1]), 3, timeout_s=0.05)
+        clock.advance(0.2)              # expires in queue -> shed
+        b.pump()
+        with pytest.raises(DeadlineExceeded):
+            h.result(timeout=0)
+        assert tracing.get_counter(metrics.SLO_MISSED) == 1.0
+        assert tracing.get_gauge(
+            "serving.slo.burn_rate.short") == pytest.approx(2.0)
+        assert tracing.get_gauge(metrics.SLO_ALERT) == 1.0
+        h2 = b.submit(idx, q_block([2]), 3, timeout_s=5.0)
+        clock.advance(0.01)
+        b.pump()
+        assert h2.result(timeout=0)
+        assert tracing.get_counter(metrics.SLO_ATTAINED) == 1.0
+        b.close()
+
+
+class TestExpositionHelpTypePairing:
+    """PR 8 satellite: EVERY family on /metrics — flat, labeled, and
+    histogram — carries # HELP and # TYPE lines, checked line by line
+    against the exposition grammar."""
+
+    def test_every_family_has_help_and_type(self, real_setup):
+        import re
+        import urllib.request
+
+        from raft_tpu.serving import MetricsExporter
+
+        metrics.reset()
+        ex = SearchExecutor(probe_accounting=True)
+        clock = ManualClock()
+        b = DynamicBatcher(ex, BatcherConfig(max_wait_s=0.0),
+                           clock=clock, start=False)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=4)
+        b.submit(real_setup["ivf"], real_setup["q"], 5, params=p)
+        b.pump()
+        gauge = __import__("raft_tpu.serving.gauge",
+                           fromlist=["IndexGauge"]).IndexGauge(
+            executor=ex, indexes={"main": real_setup["ivf"]})
+        with MetricsExporter(executor=ex, batcher=b,
+                             index_gauge=gauge) as exp:
+            text = urllib.request.urlopen(
+                exp.url("/metrics"), timeout=10).read().decode()
+        b.close()
+        helped, typed, histograms = set(), set(), set()
+        sample_re = re.compile(
+            r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'(\{[a-zA-Z_]+="[^"]*"(,[a-zA-Z_]+="[^"]*")*\})? '
+            r"[-+0-9.e]+$")
+        samples = []
+        for line in text.strip().splitlines():
+            if line.startswith("# HELP "):
+                helped.add(line.split()[2])
+                assert len(line.split(None, 3)) == 4, line  # has text
+            elif line.startswith("# TYPE "):
+                name, mtype = line.split()[2:4]
+                typed.add(name)
+                assert mtype in ("counter", "gauge", "histogram"), line
+                if mtype == "histogram":
+                    histograms.add(name)
+            else:
+                m = sample_re.match(line)
+                assert m, line
+                samples.append(m.group(1))
+        families = set()
+        for fam in samples:
+            # histogram _bucket/_count/_sum series fold onto their
+            # declared family; _count is ALSO a legitimate standalone
+            # family suffix (index_probe_freq_count), so only fold
+            # onto names # TYPE declared as histograms
+            base = re.sub(r"_(bucket|count|sum)$", "", fam)
+            families.add(base if base in histograms else fam)
+        missing_help = families - helped
+        missing_type = families - typed
+        assert not missing_help, f"families without HELP: {missing_help}"
+        assert not missing_type, f"families without TYPE: {missing_type}"
+        # the graftgauge labeled families are present and annotated
+        assert "index_health_rows" in families
+        assert any(f.startswith("index_probe_freq") for f in families)

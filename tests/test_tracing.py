@@ -307,3 +307,119 @@ class TestStragglerDetector:
             assert len(rec.to_chrome_trace()["traceEvents"]) == 3
         finally:
             tracing.reset_spans()
+
+
+class TestSpanRecorderConcurrentOverflow:
+    """PR 8 satellite: the ring's overwrite accounting stays exact
+    with MULTIPLE recorders overflowing under concurrent writers —
+    recorders share nothing (each has its own lock, deque, and drop
+    counter), so parallel flight recorders (per-test rings next to the
+    process ring) cannot cross-pollute each other's story."""
+
+    def test_concurrent_recorders_exact_drop_accounting(self):
+        recorders = [tracing.SpanRecorder(capacity=32)
+                     for _ in range(3)]
+        threads_per = 4
+        spans_per = 500
+        errs = []
+
+        def writer(r, tid):
+            try:
+                for i in range(spans_per):
+                    r.record(f"t{tid}.s{i}", float(i), float(i) + 0.1)
+            except Exception as e:          # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=writer,
+                                    args=(r, t), daemon=True)
+                   for r in recorders for t in range(threads_per)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        for r in recorders:
+            # every record either resides in the ring or was counted
+            # dropped — nothing vanishes silently
+            assert len(r) == 32
+            assert r.dropped == threads_per * spans_per - 32
+            # the ring holds whole spans (no torn writes)
+            for s in r.spans():
+                assert s.end == pytest.approx(s.start + 0.1)
+
+    def test_process_ring_isolated_from_local_recorders(self):
+        tracing.reset_spans()
+        local = tracing.SpanRecorder(capacity=2)
+        for i in range(5):
+            local.record(f"local{i}", float(i), float(i) + 1)
+        assert tracing.span_recorder().dropped == 0
+        tracing.record_span("process.one", 0.0, 1.0)
+        assert local.dropped == 3
+        assert len(tracing.span_recorder()) == 1
+
+
+class TestGraftgaugeReducers:
+    """graftgauge (PR 8): the pure index-health / probe-frequency /
+    drift reducers — host-array functions whose every output a
+    scripted test pins exactly."""
+
+    def test_index_health_exact(self):
+        sizes = [0, 10, 10, 10, 10, 40, 0, 10]
+        h = tracing.index_health(sizes, max_list_size=40, shards=2)
+        assert h["n_lists"] == 8
+        assert h["rows"] == 90
+        assert h["max_list_size"] == 40
+        assert h["mean_list_size"] == pytest.approx(90 / 8)
+        assert h["dead_lists"] == 2
+        assert h["overflow_lists"] == 1
+        assert h["fill_fraction"] == pytest.approx(90 / (8 * 40))
+        # shards: [0,10,10,10]=30 vs [10,40,0,10]=60 -> max/mean
+        assert h["shard_imbalance"] == pytest.approx(60 / 45)
+        assert 0.0 < h["gini"] < 1.0
+
+    def test_index_health_gini_edges(self):
+        even = tracing.index_health([5, 5, 5, 5])
+        assert even["gini"] == pytest.approx(0.0)
+        skewed = tracing.index_health([0, 0, 0, 20])
+        # all rows in one of n lists -> (n-1)/n
+        assert skewed["gini"] == pytest.approx(3 / 4)
+        assert tracing.index_health([])["gini"] == 0.0
+        assert tracing.index_health([0, 0])["rows"] == 0
+
+    def test_probe_freq_stats_exact(self):
+        # 100 lists: list 0 takes 90 probes, list 1 takes 6, 4 lists
+        # take 1 each -> total 100
+        counts = [0] * 100
+        counts[0] = 90
+        counts[1] = 6
+        for lid in (10, 20, 30, 40):
+            counts[lid] = 1
+        s = tracing.probe_freq_stats(counts, top_n=3)
+        assert s["total"] == 100
+        assert s["probed_fraction"] == pytest.approx(6 / 100)
+        # hottest 1% (1 list) absorbs 90%; hottest 10% everything
+        assert s["coverage_p01"] == pytest.approx(0.90)
+        assert s["coverage_p10"] == pytest.approx(1.0)
+        assert s["top"] == [(0, 90), (1, 6), (10, 1)]
+
+    def test_probe_freq_stats_empty(self):
+        s = tracing.probe_freq_stats([0, 0, 0])
+        assert s["total"] == 0 and s["top"] == []
+        assert s["coverage_p01"] == 0.0
+        assert tracing.probe_freq_stats([])["n_lists"] == 0
+
+    def test_js_divergence_properties(self):
+        assert tracing.js_divergence([1, 2, 3], [1, 2, 3]) == (
+            pytest.approx(0.0))
+        assert tracing.js_divergence([2, 4, 6], [1, 2, 3]) == (
+            pytest.approx(0.0))      # scale-invariant
+        # disjoint support is maximal drift (base-2 JSD bound)
+        assert tracing.js_divergence([1, 0], [0, 1]) == (
+            pytest.approx(1.0))
+        a, b = [5, 1, 1], [1, 1, 5]
+        assert tracing.js_divergence(a, b) == pytest.approx(
+            tracing.js_divergence(b, a))   # symmetric
+        assert 0.0 < tracing.js_divergence(a, b) < 1.0
+        # zero-mass edges
+        assert tracing.js_divergence([0, 0], [0, 0]) == 0.0
+        assert tracing.js_divergence([0, 0], [1, 1]) == 1.0

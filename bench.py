@@ -800,10 +800,22 @@ def _serving_rider():
     ``derived`` block read, so BENCH JSONs and a running scrape agree
     by construction.
 
+    PR 9 (ragged continuous batching): the record carries a
+    ``ragged`` A/B block — the SAME request stream driven through the
+    packed-batch plan family (``BatcherConfig(ragged=True)``, one
+    executable at ``BENCH_SV_RAGGED_TILE`` rows) next to the bucketed
+    leg, with the columns the acceptance criteria gate on: pad-waste
+    fraction (bucketed pow2 rounding wastes up to ~50%; the packed
+    tile only pads timer-fired partials), executables compiled (one
+    vs the ladder), backend compiles during load, and p99 at the same
+    offered load.
+
     Env knobs: BENCH_SV_N / BENCH_SV_LISTS / BENCH_SV_BURSTS /
-    BENCH_SV_BURST (requests per burst) / BENCH_SV_PERIOD_MS /
-    BENCH_SV_WAIT_MS (batcher max-wait) / BENCH_SV_TIMEOUT_MS
-    (per-request deadline)."""
+    BENCH_SV_BURST (requests per burst) / BENCH_SV_MAX_ROWS (request
+    sizes draw 1..max — the size variance the pad-waste A/B regime is
+    defined over) / BENCH_SV_PERIOD_MS / BENCH_SV_WAIT_MS (batcher
+    max-wait) / BENCH_SV_TIMEOUT_MS (per-request deadline) /
+    BENCH_SV_RAGGED_TILE (packed tile rows)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -819,6 +831,7 @@ def _serving_rider():
     n_lists = int(os.environ.get("BENCH_SV_LISTS", 256))
     n_bursts = int(os.environ.get("BENCH_SV_BURSTS", 50))
     burst = int(os.environ.get("BENCH_SV_BURST", 16))
+    max_rows = int(os.environ.get("BENCH_SV_MAX_ROWS", 4))
     period_s = float(os.environ.get("BENCH_SV_PERIOD_MS", 10)) / 1e3
     max_wait_s = float(os.environ.get("BENCH_SV_WAIT_MS", 2)) / 1e3
     timeout_s = float(os.environ.get("BENCH_SV_TIMEOUT_MS", 250)) / 1e3
@@ -834,19 +847,28 @@ def _serving_rider():
     ex.warmup(index, k=K, params=p)
     tracing.install_xla_compile_listener()
 
-    # pre-draw the request stream: bursts of 1-4 row blocks
+    # pre-draw the request stream: bursts of mixed-size blocks
+    # (1..BENCH_SV_MAX_ROWS rows). Size variance is what makes the
+    # pad-waste A/B honest: whole-request assembly stops mid-bucket
+    # when the next request does not fit, while the ragged path splits
+    # at tile boundaries and keeps every tile full.
     blocks = [rng.standard_normal(
-        (int(rng.integers(1, 5)), D)).astype(np.float32)
+        (int(rng.integers(1, max_rows + 1)), D)).astype(np.float32)
         for _ in range(n_bursts * burst)]
 
-    # baseline: the same stream, one executor call per request
+    # baseline: the same stream, one executor call per request — also
+    # the honest measurement of the raw bucket ladder's pad waste
+    # (every request pow2-rounds alone; coalescing hides most of it,
+    # splitting kills it)
+    sv_metrics.reset()
     t0 = time.perf_counter()
     for b in blocks:
         jax.block_until_ready(ex.search(index, b, K, params=p))
     base_dt = time.perf_counter() - t0
     base_qps = len(blocks) / base_dt
+    base_pad_waste = sv_metrics.derived()["pad_waste_fraction"]
     log(f"serving rider baseline: {base_qps:.1f} req/s "
-        f"(one call per request)")
+        f"(one call per request, pad waste {base_pad_waste:.3f})")
 
     sv_metrics.reset()
     b = DynamicBatcher(ex, BatcherConfig(max_wait_s=max_wait_s,
@@ -891,6 +913,51 @@ def _serving_rider():
                      / st["best_s"] / 1e9)
     except Exception as e:  # noqa: BLE001 — roofline probe is best-effort
         log(f"serving rider roofline probe failed ({e})")
+    # ---- ragged A/B leg: the SAME stream through the packed-batch
+    # plan family — one executable (BENCH_SV_RAGGED_TILE rows),
+    # continuous admission with tile-boundary splits
+    ragged_tile = int(os.environ.get("BENCH_SV_RAGGED_TILE", 64))
+    ex_r = SearchExecutor(ragged_tile=ragged_tile)
+    ex_r.warmup_ragged(index, k=K, params=p)
+    sv_metrics.reset()
+    br = DynamicBatcher(ex_r, BatcherConfig(max_wait_s=max_wait_s,
+                                            full_batch_rows=256,
+                                            ragged=True))
+    clock_r = br._clock
+    backend0_r = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+
+    def submit_r(ordinal, _t):
+        return br.submit(index, blocks[ordinal], K, params=p,
+                         timeout_s=timeout_s)
+
+    t0 = time.perf_counter()
+    handles_r = drive_open_loop(
+        submit_r, burst_schedule(n_bursts, burst, period_s,
+                                 start_s=clock_r.now()), clock_r)
+    done_r = sum(1 for h in handles_r
+                 if h.exception(timeout=30.0) is None)
+    dt_r = time.perf_counter() - t0
+    br.close()
+    snap_r = sv_metrics.snapshot()
+    der_r = snap_r["derived"]
+    e2e_r = snap_r["histograms"].get(sv_metrics.E2E, {})
+    occ_r = snap_r["occupancy"]
+    ragged_out = {
+        "tile_rows": ragged_tile,
+        "requests": len(handles_r), "completed": done_r,
+        "qps": round(done_r / dt_r, 2),
+        "p50_ms": round(e2e_r.get("p50", 0) * 1e3, 3),
+        "p95_ms": round(e2e_r.get("p95", 0) * 1e3, 3),
+        "p99_ms": round(e2e_r.get("p99", 0) * 1e3, 3),
+        "requests_per_batch": round(occ_r["requests_per_batch"], 2),
+        "rows_per_batch": round(occ_r["rows_per_batch"], 2),
+        "pad_waste_fraction": round(der_r["pad_waste_fraction"], 4),
+        "backend_compiles_during_load": (
+            tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+            - backend0_r),
+        "executables": ex_r.ragged_executables(),
+    }
+
     out = {
         "n": n, "dim": D, "n_lists": n_lists, "k": K,
         "bursts": n_bursts, "burst_size": burst,
@@ -898,6 +965,7 @@ def _serving_rider():
         "requests": len(handles), "completed": done,
         "qps": round(done / dt, 2),
         "baseline_one_per_call_qps": round(base_qps, 2),
+        "baseline_pad_waste_fraction": round(base_pad_waste, 4),
         "p50_ms": round(e2e.get("p50", 0) * 1e3, 3),
         "p95_ms": round(e2e.get("p95", 0) * 1e3, 3),
         "p99_ms": round(e2e.get("p99", 0) * 1e3, 3),
@@ -923,12 +991,21 @@ def _serving_rider():
                         if roof_gbps else 0.0),
         "cache_hit_rate": round(der["cache_hit_rate"], 4),
         "executables": len(ex.executable_costs()),
+        "pad_waste_fraction": round(der["pad_waste_fraction"], 4),
+        "ragged": ragged_out,
     }
     log(f"serving rider: {out['qps']} req/s through the batcher "
         f"(occupancy {out['requests_per_batch']} req/call, "
         f"p99 {out['p99_ms']} ms, shed {out['shed_rate']}, "
         f"scan {out['achieved_gbps']} GB/s = {out['vs_roofline']} of "
         f"roofline)")
+    log(f"serving rider ragged A/B: {ragged_out['qps']} req/s, p99 "
+        f"{ragged_out['p99_ms']} ms, pad waste "
+        f"{ragged_out['pad_waste_fraction']} (bucketed "
+        f"{out['pad_waste_fraction']}), "
+        f"{ragged_out['executables']} executable(s) vs "
+        f"{out['executables']}, compiles during load "
+        f"{ragged_out['backend_compiles_during_load']}")
     return out
 
 

@@ -17,10 +17,12 @@ R1 has two teeth:
   of runtime data, makes the key unhashable or data-dependent and
   turns every search into a cache miss + recompile. The serving
   frontend's coalescing keys (``coalesce_key = (...)`` /
-  ``compat_key = (...)`` and the ``compat_key=`` field of
+  ``compat_key = (...)`` / the ragged path's ``ragged_key`` /
+  ``packing_key`` tuples and the ``compat_key=`` field of
   ``SearchRequest``) carry the same contract — an unhashable key there
   breaks request grouping, a data-dependent one silently splits every
-  micro-batch.
+  micro-batch (and on the ragged path would fork the ONE packed
+  executable per load shape, resurrecting the bucket ladder).
 
 R2 follows donated buffers: an argument donated to a jitted call
 (``donate_argnums``/``donate_argnames`` at the ``jax.jit`` site, or
@@ -126,7 +128,7 @@ def check_recompile(project: Project) -> Iterable[Finding]:
                         and isinstance(node.targets[0], ast.Name)
                         and node.targets[0].id in (
                             "key", "cache_key", "coalesce_key",
-                            "compat_key")
+                            "compat_key", "ragged_key", "packing_key")
                         and isinstance(node.value, ast.Tuple)):
                     _check_key_expr(f, node.value, out)
     return out

@@ -56,10 +56,30 @@ bucket): queries bucket exactly like the single-chip families, are
 placed replicated on the mesh, and the per-shard running top-k state
 is donated — steady-state multi-chip serving is zero-recompile).
 
+**Ragged packed-batch plans (PR 9).** The bucket ladder trades pad
+compute for shape stability: every batch pow2-rounds (up to ~2x pad
+on the query axis) and a micro-batch must assemble whole requests.
+The ragged plan family (Ragged Paged Attention, PAPERS.md) collapses
+the ladder to ONE executable per (index shapes, params class): a
+fixed ``(ragged_tile, dim)`` packed query tensor carries several
+requests adjacently, each row's probe budget rides a per-row plane
+into the engines' membership mask, and per-request ``k`` is a column
+slice of the class-cap top-k (both total orders, so results stay
+bit-identical per request to the bucketed path). ``n_probes``/``k``
+round up to power-of-two CLASSES instead of forking executables — the
+pow2 ladder moved from the batch axis (paid per dispatch, in pad
+rows) to the params axis (paid once, in compiles). See
+:meth:`SearchExecutor.search_ragged` / :meth:`~SearchExecutor
+.ragged_key`; the serving batcher's ``BatcherConfig(ragged=True)``
+admits continuously into the open packed tile and splits requests at
+tile boundaries.
+
 Small print: padding/slicing a batch to/from its bucket executes tiny
 device ops whose programs XLA caches per distinct batch size — the
 *search* program itself never recompiles, and once a batch size has
-been seen, repeats are entirely compile-free.
+been seen, repeats are entirely compile-free. (The ragged path has no
+such per-shape micro-programs at all: packing is host-side numpy in,
+one batched fetch out.)
 """
 
 from __future__ import annotations
@@ -138,6 +158,10 @@ class _Plan:
     # call — None keeps the compiled signature (and the executable
     # cache key) exactly as before
     probe: Any = None
+    # ragged packed-batch plans: the compiled signature carries the
+    # per-row probe-budget plane ((tile,) int32) right after the
+    # packed queries — the ragged query-tile front of ops/ivf_scan
+    ragged: bool = False
 
 
 class _Entry:
@@ -233,6 +257,17 @@ def _sig(*arrays) -> tuple:
     return tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
 
 
+def _pow2_at_least(n: int, floor: int) -> int:
+    """Smallest power-of-two multiple of ``floor`` at/above ``n`` —
+    the ragged params-class rounding (a pow2 ladder on the *params*
+    axis replaces the old one on the *batch* axis, so the executable
+    count stays logarithmic while the query tile carries no pad)."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
 def _filter_spec(fw) -> tuple:
     if fw is None:
         return ("nofilter",)
@@ -283,13 +318,19 @@ class SearchExecutor:
         one device fetch per plane per scrape, never per dispatch).
         Default off: enabling changes the compiled signature, so it is
         part of the executable cache key.
+      ragged_tile: row count of the ragged plan family's ONE packed
+        batch shape (:meth:`search_ragged`). Every ragged dispatch
+        runs ``(ragged_tile, dim)`` queries — under load the serving
+        batcher keeps the tile full via tile-boundary splits, so pad
+        waste collapses to timer-fired partial tiles.
     """
 
     def __init__(self, res: Optional[Resources] = None, *,
                  min_bucket: int = 8, max_bucket: int = 4096,
                  max_entries: int = 64, donate: Optional[bool] = None,
                  mesh_trace: bool = False,
-                 probe_accounting: bool = False):
+                 probe_accounting: bool = False,
+                 ragged_tile: int = 256):
         self.res = ensure_resources(res)
         expect(0 < min_bucket <= max_bucket,
                f"need 0 < min_bucket <= max_bucket, got "
@@ -305,6 +346,12 @@ class SearchExecutor:
         if donate is None:
             donate = jax.default_backend() not in ("cpu",)
         self.donate = donate
+        expect(ragged_tile > 0, "ragged_tile must be > 0")
+        # the ragged plan family's ONE packed-batch shape: every
+        # ragged dispatch runs (ragged_tile, dim) queries, so one AOT
+        # entry per (index shapes, params class) serves every load
+        # shape — the bucket ladder collapsed to a single executable
+        self.ragged_tile = ragged_tile
         self.mesh_trace = mesh_trace
         self.probe_accounting = probe_accounting
         # graftgauge probe-frequency planes: pkey -> device counter
@@ -455,6 +502,260 @@ class SearchExecutor:
             start += m
         return out
 
+    # -- ragged packed-batch plan family ------------------------------------
+
+    def ragged_key(self, index, k: int, params=None, sample_filter=None,
+                   **kw) -> Optional[tuple]:
+        """Hashable packing key for the ragged continuous-batching
+        path, or ``None`` when this (index, params, k) combination is
+        not servable ragged (non-IVF-flat families, approx coarse
+        select, the legacy rank-major engine, family-specific kwargs)
+        — the caller then falls back to :meth:`coalesce_key` and the
+        bucketed path.
+
+        Two submissions may share one packed ragged batch iff their
+        keys are equal. Unlike :meth:`coalesce_key`, ``n_probes`` and
+        ``k`` do NOT fork the key directly — they round up to a
+        power-of-two *params class* (``n_probes`` resolves per row
+        through the engines' membership mask, ``k`` through a
+        caller-side column slice), so mixed-``n_probes``/``k`` traffic
+        under one class cap shares ONE executable. The degradation
+        ladder's params override feeds this key like any other params
+        (the batcher applies it before keying), so a degraded
+        specialization that changes only ``n_probes`` keeps packing
+        with live traffic."""
+        fw = self._resolve_filter(sample_filter)
+        spec = self._ragged_spec(index, k, params, fw, kw)
+        if spec is None:
+            return None
+        return (id(index), "ivf_flat_ragged", str(index.metric),
+                spec["engine"], spec["np_class"], spec["k_class"],
+                _filter_spec(fw))
+
+    def warmup_ragged(self, index, *, k: int, params=None,
+                      sample_filter=None, **kw) -> float:
+        """AOT-compile the ONE ragged executable of this (index,
+        params-class) — the whole warmup the ragged path needs, where
+        the bucketed ladder compiled one executable per bucket.
+        Raises on combinations :meth:`ragged_key` would refuse."""
+        fw = self._resolve_filter(sample_filter)
+        spec = self._ragged_spec(index, k, params, fw, kw)
+        expect(spec is not None,
+               "index/params combination is not servable by the ragged "
+               "plan family (see SearchExecutor.ragged_key)")
+        t0 = time.perf_counter()
+        plan = self._plan_ivf_flat_ragged(index, fw, spec)
+        self._get_entry(plan, self.ragged_tile, spec["k_class"])
+        dt = time.perf_counter() - t0
+        self.stats.warmup_seconds += dt
+        tracing.inc_counter("serving.warmup_seconds", dt)
+        return dt
+
+    def search_ragged(self, index, blocks, ks, params_list=None,
+                      sample_filter=None,
+                      trace_ids: Tuple[int, ...] = (), **kw):
+        """Packed ragged-batch entry point: run several requests'
+        query blocks — possibly with DIFFERENT per-request ``k`` and
+        ``params.n_probes`` — as packed ``(ragged_tile, dim)`` calls
+        of ONE compiled executable, and split the results back per
+        block.
+
+        ``blocks`` is a sequence of (m_j, dim) query arrays; ``ks``
+        and ``params_list`` give each block's ``k`` / search params (a
+        scalar/single value is shared by all). Every block must agree
+        on :meth:`ragged_key` — the serving batcher groups by it. A
+        2-D ``sample_filter`` is the row-wise concatenation matching
+        the blocks (1-D shared words pass through, exactly like
+        :meth:`search_blocks`).
+
+        Blocks pack adjacently into the tile (the tail padded with
+        inert zero rows whose probe budget is 0); totals past one tile
+        stream through the SAME executable in tile-sized chunks.
+        Returns per-block ``(distances, indices)`` as HOST (numpy)
+        arrays, each bit-identical to a direct bucketed :meth:`search`
+        of that block alone (total-order coarse select +
+        membership-masked probes + total-order merges make the packed
+        results independent of what else shares the tile).
+
+        The per-request split is deliberately host-side: one batched
+        device fetch per packed tile replaces per-(offset, rows, k)
+        device slices — whose tiny programs would otherwise compile
+        per load shape, resurrecting through the back door the shape
+        churn the ONE packed executable exists to kill. The serving
+        batcher blocks on results immediately, so the fetch costs what
+        the caller was about to pay anyway; like the bucketed path,
+        every chunk is dispatched before anything else can re-donate
+        its outputs."""
+        expect(len(blocks) > 0, "search_ragged needs at least one block")
+        n = len(blocks)
+        if not isinstance(ks, (list, tuple)):
+            ks = [ks] * n
+        if not isinstance(params_list, (list, tuple)):
+            params_list = [params_list] * n
+        expect(len(ks) == n and len(params_list) == n,
+               "ks/params_list must match blocks")
+        fw = self._resolve_filter(sample_filter)
+        specs = [self._ragged_spec(index, kj, pj, fw, kw)
+                 for kj, pj in zip(ks, params_list)]
+        expect(all(s is not None for s in specs),
+               "a block is not servable by the ragged plan family "
+               "(see SearchExecutor.ragged_key)")
+        classes = {(s["engine"], s["np_class"], s["k_class"])
+                   for s in specs}
+        expect(len(classes) == 1,
+               "blocks must agree on the ragged params class — group "
+               "submissions by SearchExecutor.ragged_key")
+        spec = specs[0]
+        k_class = spec["k_class"]
+        sizes = [int(np.shape(b)[0]) for b in blocks]
+        for b in blocks:
+            expect(int(np.shape(b)[1]) == index.dim,
+                   "query dim mismatch")
+        total = sum(sizes)
+        if total == 0:
+            return [(np.zeros((0, kj), np.float32),
+                     np.zeros((0, kj), np.int32)) for kj in ks]
+        if fw is not None and fw.ndim == 2:
+            expect(int(fw.shape[0]) == total,
+                   "2-D filter rows must match the packed query rows")
+        tile = self.ragged_tile
+        plan = self._plan_ivf_flat_ragged(index, fw, spec)
+
+        # host-side packing: adjacent blocks, zero pad rows, per-row
+        # probe budgets (0 on pads). numpy blocks (the serving path)
+        # pack with zero device ops; device arrays fall back to one
+        # concat + pad program per distinct total
+        from raft_tpu.ops.ivf_scan import ragged_row_probes
+
+        padded_total = -(-total // tile) * tile
+        row_probes = ragged_row_probes(
+            sizes, [s["n_probes"] for s in specs], padded_total)
+        if all(isinstance(b, np.ndarray) for b in blocks):
+            packed = np.zeros((padded_total, index.dim), np.float32)
+            r = 0
+            for b, m in zip(blocks, sizes):
+                packed[r:r + m] = b
+                r += m
+        else:
+            from raft_tpu.neighbors._batching import pad_rows
+
+            packed = pad_rows(
+                jnp.concatenate([jnp.asarray(b, jnp.float32)
+                                 for b in blocks]), padded_total)
+        fwp = fw
+        if fw is not None and fw.ndim == 2 and padded_total > total:
+            fwp = self._pad(fw, padded_total, fw.dtype)
+
+        parts_d, parts_i, raw = [], [], []
+        with self._lock:
+            for start in range(0, padded_total, tile):
+                q_real = min(total - start, tile)
+                args = [packed[start:start + tile],
+                        jnp.asarray(row_probes[start:start + tile])]
+                args.extend(plan.post)
+                fwt = fwp
+                if fwp is not None and fwp.ndim == 2:
+                    fwt = fwp[start:start + tile]
+                args.append(fwt)
+                _, out_d, out_i, _ = self._execute_entry_locked(
+                    plan, tile, k_class, args, q_real)
+                if plan.has_state:
+                    # donated-state (xla) engine: the outputs ARE the
+                    # state the next chunk (or the next caller)
+                    # immediately re-donates, so they must be read
+                    # before the lock releases — one batched fetch
+                    # per tile. See the docstring for why the split
+                    # is host-side by design.
+                    # graftlint: disable=R5(ragged split is host-side by design: one batched fetch per packed tile replaces per-shape device-slice micro-programs; the serving caller blocks on results immediately)
+                    host = jax.device_get((out_d, out_i))
+                    parts_d.append(host[0][:q_real])
+                    parts_i.append(host[1][:q_real])
+                else:
+                    # stateless (pallas) engine: nothing aliases the
+                    # outputs, so only ENQUEUE under the lock — every
+                    # tile dispatches before anything is fetched, and
+                    # concurrent searches/scrapes are not blocked for
+                    # a device execution
+                    raw.append((out_d, out_i, q_real))
+        for out_d, out_i, q_real in raw:
+            # graftlint: disable=R5(ragged split is host-side by design: one batched fetch per packed tile replaces per-shape device-slice micro-programs; the serving caller blocks on results immediately)
+            host = jax.device_get((out_d, out_i))
+            parts_d.append(host[0][:q_real])
+            parts_i.append(host[1][:q_real])
+        if len(parts_d) == 1:
+            d_all, i_all = parts_d[0], parts_i[0]
+        else:
+            d_all = np.concatenate(parts_d)
+            i_all = np.concatenate(parts_i)
+        out, row = [], 0
+        for m, kj in zip(sizes, ks):
+            # per-request k: a column slice of the class-cap top-k —
+            # the merge is a total order, so the first k_j columns ARE
+            # the solo top-k_j
+            out.append((d_all[row:row + m, :kj],
+                        i_all[row:row + m, :kj]))
+            row += m
+        return out
+
+    def _ragged_spec(self, index, k: int, params, fw, kw):
+        """Resolve one request onto the ragged plan family: the
+        engine + power-of-two class caps, or None when the request
+        must stay on the bucketed path. Raggable today: the IVF-flat
+        family through the list-major engines with exact coarse
+        select (only the exact coarse top-k has the prefix property
+        per-row budgets rely on; the rank-major engine has no
+        membership mask to resolve them through)."""
+        from raft_tpu.neighbors.ivf_flat import (
+            IvfFlatIndex,
+            IvfFlatSearchParams,
+        )
+        from raft_tpu.ops.ivf_scan import resolve_scan_engine
+
+        if not isinstance(index, IvfFlatIndex) or kw:
+            return None
+        params = params or IvfFlatSearchParams()
+        if params.coarse_algo != "exact" or params.scan_engine == "rank":
+            return None
+        if index.max_list_size <= 0 or k <= 0:
+            return None
+        n_probes = min(params.n_probes, index.n_lists)
+        np_class = min(_pow2_at_least(n_probes, 8), index.n_lists)
+        k_class = _pow2_at_least(k, 8)
+        engine = resolve_scan_engine(params.scan_engine, data=index.data,
+                                     filter_words=fw, k=k_class)
+        if engine not in ("pallas", "xla"):
+            return None
+        return {"n_probes": n_probes, "np_class": np_class,
+                "k_class": k_class, "engine": engine}
+
+    def _plan_ivf_flat_ragged(self, index, fw, spec) -> _Plan:
+        from raft_tpu.neighbors import ivf_flat as m
+
+        static = {"n_probes": spec["np_class"], "k": spec["k_class"],
+                  "metric": index.metric,
+                  "scan_engine": spec["engine"]}
+        arrays = (index.centers, index.center_norms, index.data,
+                  index.data_norms, index.indices)
+        key = ("ivf_flat_ragged", self.ragged_tile, _sig(*arrays),
+               tuple(sorted((n, str(v)) for n, v in static.items())),
+               _filter_spec(fw))
+        # probe planes are shared with the bucketed plans (same pkey),
+        # so one cumulative histogram covers an index however its
+        # traffic splits across the two path families
+        key, probe = self._probe_plumbing(index, "ivf_flat", key)
+        return _Plan(key=key, fn=m._search_ragged_fn, static=static,
+                     post=arrays, use_filter=True, qdim=index.dim,
+                     has_state=spec["engine"] != "pallas", probe=probe,
+                     ragged=True)
+
+    def ragged_executables(self) -> int:
+        """Resident ragged-plan executables — the acceptance surface
+        of the one-executable contract (steady state: exactly one per
+        (index shapes, params class) served)."""
+        with self._lock:
+            return sum(1 for key in self._cache
+                       if key and key[0] == "ivf_flat_ragged")
+
     # -- internals ----------------------------------------------------------
 
     def _resolve_filter(self, sample_filter):
@@ -485,75 +786,17 @@ class SearchExecutor:
             args.append(fwp)
         ret = None
         with self._lock:
-            entry = self._get_entry(plan, bucket, k)
-            if plan.has_state:
-                args.extend(entry.state)
-            kwargs = {}
-            if plan.probe is not None:
-                # graftgauge: thread the per-index donated counter
-                # plane + the valid-row count (traced scalar — inert
-                # bucket-pad rows must not pollute the histogram).
-                # Created lazily on first dispatch; the lock serializes
-                # the donate-and-replace handoff exactly like the
-                # top-k state's.
-                pkey, n_lists, csharding, family, label = plan.probe[:5]
-                counts = self._probe_state.get(pkey)
-                if counts is None:
-                    self._evict_dead_probe_planes_locked()
-                    counts = jnp.zeros((n_lists,), jnp.int32)
-                    if csharding is not None:
-                        counts = jax.device_put(counts, csharding)
-                    self._probe_info[pkey] = {
-                        "family": family, "label": label,
-                        "n_lists": n_lists, "sharding": csharding}
-                    try:
-                        # report the index's death so the plane (and
-                        # its label) cannot be inherited by a new
-                        # index reusing the address; the callback may
-                        # fire in GC context, so it only appends —
-                        # never takes the executor lock
-                        weakref.finalize(plan.probe[5],
-                                         self._probe_dead.append, pkey)
-                    except TypeError:       # non-weakref-able index
-                        pass
-                nv = jnp.asarray(q, jnp.int32)
-                if plan.state_sharding is not None:
-                    nv = jax.device_put(nv, plan.state_sharding)
-                kwargs = {"probe_counts": counts, "n_valid": nv}
-            t0 = time.perf_counter()
-            out = entry.compiled(*args, **kwargs)
-            if plan.probe is not None:
-                out_d, out_i, new_counts = out
-                self._probe_state[plan.probe[0]] = new_counts
-            else:
-                out_d, out_i = out
-            # modeled per-dispatch work, from the compile-time capture:
-            # a counter bump (one host lock), never a device sync. The
-            # scrape divides these by the measured execute-latency sum
-            # to publish live achieved GB/s / FLOP/s. Counted AFTER the
-            # dispatch so a call that raises does not inflate the
-            # achieved-bandwidth numerator its failed execution never
-            # contributes latency for.
-            amounts = {
-                "serving.execute.calls": 1.0,
-                "serving.execute.rows": float(q),
-                "serving.execute.modeled_flops":
-                    entry.cost.get("flops", 0.0),
-                "serving.execute.modeled_bytes":
-                    entry.cost.get("bytes_accessed", 0.0),
-            }
-            if plan.probe is not None:
-                # the host-side heartbeat of the device accounting —
-                # what the CI snapshot floors check (lifetime ledger)
-                amounts["index.probe.dispatches"] = 1.0
-                amounts["index.probe.rows"] = float(q)
-            tracing.inc_counters(amounts)
-            if plan.has_state:
-                # outputs alias the donated state storage; keep them as
-                # the next call's state and hand the caller copies
-                entry.state = (out_d, out_i)
-                if q == bucket and self.donate:
-                    ret = (jnp.copy(out_d), jnp.copy(out_i))
+            entry, out_d, out_i, t0 = self._execute_entry_locked(
+                plan, bucket, k, args, q)
+            if plan.has_state and self.donate:
+                # outputs alias the donated state storage: the result
+                # slice (or, at full bucket, a copy — the un-padded
+                # slice would BE the state arrays) must dispatch
+                # before the lock releases, or a concurrent dispatch
+                # of the same plan could re-donate the buffers first
+                ret = ((jnp.copy(out_d), jnp.copy(out_i))
+                       if q == bucket
+                       else (out_d[:q], out_i[:q]))
         # mesh recording AFTER the lock releases: the readiness poll
         # lasts as long as the slowest shard, and holding the executor
         # lock through it would stall OTHER threads — concurrent
@@ -568,6 +811,88 @@ class SearchExecutor:
         if ret is not None:
             return ret
         return out_d[:q], out_i[:q]
+
+    def _execute_entry_locked(self, plan: _Plan, rows: int, k: int,
+                              args, q_real: int):
+        """Shared locked dispatch core of the bucketed and ragged
+        paths: entry fetch/compile, donated top-k state + graftgauge
+        probe-plane threading, and the modeled-work counters. The
+        caller holds ``self._lock`` (RLock) and has assembled ``args``
+        up to (but not including) the donated state. Returns
+        ``(entry, out_d, out_i, t0)``; with ``plan.has_state`` the
+        outputs ARE the next call's donated state — the caller must
+        slice or copy them before anything re-donates."""
+        entry = self._get_entry_locked(plan, rows, k)
+        if plan.has_state:
+            args = list(args) + list(entry.state)
+        kwargs = {}
+        if plan.probe is not None:
+            # graftgauge: thread the per-index donated counter
+            # plane + the valid-row count (traced scalar — inert
+            # bucket-pad rows must not pollute the histogram).
+            # Created lazily on first dispatch; the lock serializes
+            # the donate-and-replace handoff exactly like the
+            # top-k state's.
+            pkey, n_lists, csharding, family, label = plan.probe[:5]
+            counts = self._probe_state.get(pkey)
+            if counts is None:
+                self._evict_dead_probe_planes_locked()
+                counts = jnp.zeros((n_lists,), jnp.int32)
+                if csharding is not None:
+                    counts = jax.device_put(counts, csharding)
+                self._probe_info[pkey] = {
+                    "family": family, "label": label,
+                    "n_lists": n_lists, "sharding": csharding}
+                try:
+                    # report the index's death so the plane (and
+                    # its label) cannot be inherited by a new
+                    # index reusing the address; the callback may
+                    # fire in GC context, so it only appends —
+                    # never takes the executor lock
+                    weakref.finalize(plan.probe[5],
+                                     self._probe_dead.append, pkey)
+                except TypeError:       # non-weakref-able index
+                    pass
+            nv = jnp.asarray(q_real, jnp.int32)
+            if plan.state_sharding is not None:
+                nv = jax.device_put(nv, plan.state_sharding)
+            kwargs = {"probe_counts": counts, "n_valid": nv}
+        t0 = time.perf_counter()
+        out = entry.compiled(*args, **kwargs)
+        if plan.probe is not None:
+            out_d, out_i, new_counts = out
+            self._probe_state[plan.probe[0]] = new_counts
+        else:
+            out_d, out_i = out
+        # modeled per-dispatch work, from the compile-time capture:
+        # a counter bump (one host lock), never a device sync. The
+        # scrape divides these by the measured execute-latency sum
+        # to publish live achieved GB/s / FLOP/s. Counted AFTER the
+        # dispatch so a call that raises does not inflate the
+        # achieved-bandwidth numerator its failed execution never
+        # contributes latency for.
+        amounts = {
+            "serving.execute.calls": 1.0,
+            "serving.execute.rows": float(q_real),
+            # dispatched row capacity incl. bucket/tile pad — the
+            # pad-waste denominator the ragged-vs-bucketed A/B reads
+            "serving.execute.padded_rows": float(rows),
+            "serving.execute.modeled_flops":
+                entry.cost.get("flops", 0.0),
+            "serving.execute.modeled_bytes":
+                entry.cost.get("bytes_accessed", 0.0),
+        }
+        if plan.probe is not None:
+            # the host-side heartbeat of the device accounting —
+            # what the CI snapshot floors check (lifetime ledger)
+            amounts["index.probe.dispatches"] = 1.0
+            amounts["index.probe.rows"] = float(q_real)
+        tracing.inc_counters(amounts)
+        if plan.has_state:
+            # outputs alias the donated state storage; keep them as
+            # the next call's state
+            entry.state = (out_d, out_i)
+        return entry, out_d, out_i, t0
 
     def _record_mesh_dispatch(self, entry, out_d, out_i, t0: float,
                               trace_ids: Tuple[int, ...]) -> None:
@@ -725,6 +1050,9 @@ class SearchExecutor:
         args = [sds(a) for a in plan.pre]
         args.append(jax.ShapeDtypeStruct((bucket, plan.qdim), plan.qdtype,
                                          sharding=plan.qsharding))
+        if plan.ragged:
+            # per-row probe-budget plane of the packed ragged batch
+            args.append(jax.ShapeDtypeStruct((bucket,), jnp.int32))
         if plan.pass_row0:
             args.append(jax.ShapeDtypeStruct((), jnp.int32))
         args.extend(sds(a) for a in plan.post)

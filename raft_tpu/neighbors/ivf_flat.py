@@ -542,6 +542,74 @@ _search_impl = partial(jax.jit, static_argnames=(
     "n_probes", "k", "metric", "coarse_algo", "scan_engine"))(_search_impl_fn)
 
 
+def _search_ragged_fn(queries, row_probes, centers, center_norms, data,
+                      data_norms, indices, filter_words, init_d=None,
+                      init_i=None, probe_counts=None, n_valid=None, *,
+                      n_probes: int, k: int, metric: DistanceType,
+                      scan_engine: str = "xla"):
+    """Packed ragged-batch search body — the serving executor's
+    one-executable-per-params-class entry (Ragged Paged Attention
+    style; see :mod:`raft_tpu.ops.ivf_scan`'s ragged front).
+
+    ``queries`` is a fixed ``(tile, d)`` packed tensor holding several
+    requests' rows adjacently (pad rows zero); ``row_probes`` is the
+    per-row probe budget (:func:`raft_tpu.ops.ivf_scan
+    .ragged_row_probes` — 0 on pad rows). ``n_probes`` and ``k`` are
+    the packed batch's CLASS CAPS: the coarse stage selects the top
+    ``n_probes`` lists exactly (``lax.top_k`` is a total order, so a
+    row's first ``b`` slots equal a solo ``n_probes=b`` selection) and
+    each row masks its slots past ``row_probes`` to the sentinel —
+    per-request ``n_probes`` resolves through the engines' existing
+    membership mask, and per-request ``k`` is a caller-side column
+    slice of the total-order top-``k``. Bit-identical per request to
+    :func:`_search_impl_fn` on that request alone.
+
+    ``coarse_algo`` is deliberately NOT a knob: only the exact coarse
+    top-k has the prefix property the class cap relies on
+    (``approx_max_k`` at the cap is not a solo ``approx_max_k`` at the
+    request's budget), so approx-coarse requests stay on the bucketed
+    path. ``probe_counts`` threads graftgauge's donated plane exactly
+    like the bucketed body; ``n_valid`` is accepted for signature
+    parity but unused — ``row_probes`` already zeroes pad rows out of
+    the histogram (their every slot carries the sentinel)."""
+    del n_valid
+    from raft_tpu.ops.ivf_scan import list_major_scan, ragged_probes
+
+    n_lists = data.shape[0]
+    qf = queries.astype(jnp.float32)
+
+    # coarse select at the class cap — exact top-k only (prefix property)
+    ip = jax.lax.dot_general(
+        qf, centers, (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    score = (ip if metric == DistanceType.InnerProduct
+             else -(center_norms[None, :] - 2.0 * ip))
+    probes = coarse_select(score, n_probes, "exact")
+    probes = ragged_probes(probes, row_probes, n_lists)
+    if probe_counts is not None:
+        from raft_tpu.ops.ivf_scan import probe_histogram
+
+        probe_counts = probe_histogram(probes, probe_counts)
+
+    best_d, best_i = list_major_scan(
+        qf, data, data_norms, indices, probes, filter_words,
+        init_d, init_i, k=k, metric=metric, engine=scan_engine,
+        interpret=jax.default_backend() != "tpu")
+
+    if metric != DistanceType.InnerProduct:
+        q_sq = jnp.sum(jnp.square(qf), axis=1, keepdims=True)
+        best_d = jnp.where(jnp.isfinite(best_d),
+                           jnp.maximum(best_d + q_sq, 0.0), best_d)
+        if metric == DistanceType.L2SqrtExpanded:
+            best_d = jnp.where(jnp.isfinite(best_d), jnp.sqrt(best_d),
+                               best_d)
+    if probe_counts is not None:
+        return best_d, best_i, probe_counts
+    return best_d, best_i
+
+
 def search(
     res: Optional[Resources],
     params: IvfFlatSearchParams,

@@ -40,6 +40,18 @@ Two engines share the formulation:
   smallest-id tie-break as the kernel, any k without unrolling) — the
   portable fallback (CPU/GPU, 2-D per-query filters, int8 storage,
   large k, misaligned layouts on TPU).
+
+**Ragged query-tile front** (the continuous-batching serving path):
+several requests with *different* per-request ``n_probes`` pack
+adjacently into one fixed query tile, and each row's probe slots past
+its own budget mask to the sentinel id ``n_lists``
+(:func:`ragged_row_probes` / :func:`ragged_probes`). Sentinel-valued
+probe slots are exactly how the list-sharded indexes already mark
+not-owned probes, so BOTH engines serve the packed tile unchanged —
+the membership predicate is the raggedness mechanism, and the
+scalar-prefetched index map streams only the union the packed batch
+actually probed. One executable therefore serves every load shape;
+the per-request results are bit-identical to solo calls.
 """
 
 from __future__ import annotations
@@ -48,6 +60,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -146,6 +159,50 @@ def probe_histogram(probes: jax.Array, counts: jax.Array,
         valid = jnp.arange(ids.shape[0], dtype=jnp.int32) < n_valid
         ids = jnp.where(valid[:, None], ids, n_lists)
     return counts.at[ids.reshape(-1)].add(1, mode="drop")
+
+
+def ragged_row_probes(sizes, n_probes_list, tile: int):
+    """Host-side half of the ragged query-tile front (Ragged Paged
+    Attention's packing descriptor, arxiv 2604.15464): expand one
+    packed tile's per-request row ranges into the per-ROW probe-budget
+    plane the device front consumes.
+
+    ``sizes[j]`` rows of request ``j`` occupy the next ``sizes[j]``
+    packed rows (requests pack adjacently, in order), and every row of
+    request ``j`` carries that request's probe budget
+    ``n_probes_list[j]``. Rows past ``sum(sizes)`` are tile padding and
+    get budget 0 — a pad row probes nothing, so it contributes nothing
+    to any result, the probed-list union, or the probe-frequency
+    histogram. Returns a ``(tile,)`` int32 numpy array (the serving
+    path packs host-side; the executor ships it with the queries)."""
+    out = np.zeros((tile,), np.int32)
+    row = 0
+    for m, p in zip(sizes, n_probes_list):
+        out[row:row + m] = p
+        row += m
+    expect(row <= tile, f"packed rows {row} overflow the tile {tile}")
+    return out
+
+
+def ragged_probes(probes: jax.Array, row_probes: jax.Array,
+                  n_lists: int) -> jax.Array:
+    """Device half of the ragged front: mask each row's probe slots
+    past its own budget to the sentinel id ``n_lists``.
+
+    ``probes`` is the coarse selection at the packed tile's CLASS cap
+    (``(tile, n_probes_class)``, exact top-k — so slots ``[0, b)`` of a
+    row with budget ``b <= n_probes_class`` are exactly what a solo
+    search with ``n_probes=b`` would have selected); ``row_probes`` is
+    :func:`ragged_row_probes`'s per-row budget plane. Sentinel-masked
+    slots ride the exact machinery the list-sharded indexes already
+    use for not-owned probes: :func:`unique_lists` collapses them into
+    sentinel steps, both engines' membership predicates reject them
+    (``lid < n_lists``), and :func:`probe_histogram` drops them — so
+    one packed executable serves every per-request ``n_probes`` in the
+    class, bit-identical per request to the solo call."""
+    slot = jnp.arange(probes.shape[1], dtype=jnp.int32)
+    return jnp.where(slot[None, :] < row_probes[:, None], probes,
+                     n_lists)
 
 
 def unique_lists(probes: jax.Array, n_lists: int) -> jax.Array:

@@ -44,10 +44,25 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 from raft_tpu.core import tracing
 from raft_tpu.serving.request import Overloaded, SearchRequest
+
+
+class GroupHead(NamedTuple):
+    """One compatibility group's scheduling summary (see
+    :meth:`AdmissionQueue.group_heads`): its key, the oldest member's
+    arrival (the dual trigger's timer anchor), the queued row count
+    (remaining rows — a ragged split's claimed rows are gone), the
+    most-urgent member's order key, and whether the group rides the
+    ragged packed-batch path."""
+
+    key: Any
+    arrival: float
+    rows: int
+    urgent: tuple
+    ragged: bool
 
 
 @dataclasses.dataclass(frozen=True)
@@ -212,34 +227,62 @@ class AdmissionQueue:
 
     def next_deadline_group(self, now: float):
         """(compat_key, oldest-arrival, rows, most-urgent order_key) of
-        the group the batcher should serve next, or None when empty.
-        Cancelled/expired requests are pruned lazily here, completing
-        expired ones with ``DeadlineExceeded`` *before* dispatch."""
+        the most urgent group, or None when empty — the single-group
+        view of :meth:`group_heads`, kept for callers that only need
+        the head of the line."""
+        heads = self.group_heads(now)
+        if not heads:
+            return None
+        h = heads[0]
+        return (h.key, h.arrival, h.rows, h.urgent)
+
+    def group_heads(self, now: float) -> List[GroupHead]:
+        """Every queued group's :class:`GroupHead`, most urgent first —
+        the batcher's full scheduling view, so a dispatch-ready group
+        is never invisible behind a more-urgent one still waiting out
+        its timer, and the fairness budget can pick the most urgent
+        *other* group. Cancelled/expired requests are pruned lazily
+        here, completing expired ones with ``DeadlineExceeded``
+        *before* dispatch."""
         from raft_tpu.serving.request import DeadlineExceeded
 
         shed: List[SearchRequest] = []
         cancelled: List[SearchRequest] = []
+        heads: List[GroupHead] = []
         with self._lock:
-            best = None
             for key, group in list(self._groups.items()):
                 keep = []
                 for r in group:
-                    if r.handle.done():          # cancelled while queued
-                        tracing.inc_counter("serving.batcher.cancelled")
-                        cancelled.append(r)
+                    if r.handle.done():
+                        # taken == 0: a pre-dispatch completion —
+                        # caller cancellation (or shutdown) won while
+                        # the request was still whole. taken > 0: a
+                        # split remainder whose dispatched slice
+                        # FAILED the handle — that outcome was already
+                        # counted (failed_batches / SLO miss), so the
+                        # remainder just leaves the queue uncounted
+                        if r.taken == 0:
+                            tracing.inc_counter(
+                                "serving.batcher.cancelled")
+                            cancelled.append(r)
                         continue
-                    if r.expired(now):
+                    if r.taken == 0 and r.expired(now):
+                        # an expired remainder whose first rows already
+                        # dispatched is NOT shed: its handle is
+                        # running, and the started work completes (the
+                        # late result records its SLO miss normally)
                         shed.append(r)
                         continue
                     keep.append(r)
                 self._n -= len(group) - len(keep)
                 if keep:
                     self._groups[key] = keep
-                    urgent = min(r.order_key() for r in keep)
-                    arrival = min(r.arrival for r in keep)
-                    rows = sum(r.rows for r in keep)
-                    if best is None or urgent < best[3]:
-                        best = (key, arrival, rows, urgent)
+                    heads.append(GroupHead(
+                        key=key,
+                        arrival=min(r.arrival for r in keep),
+                        rows=sum(r.rows_left for r in keep),
+                        urgent=min(r.order_key() for r in keep),
+                        ragged=any(r.ragged for r in keep)))
                 else:
                     del self._groups[key]
             n, rate = self._n, self._rate
@@ -260,7 +303,8 @@ class AdmissionQueue:
                     self._slo.record(now, False)
         if shed or cancelled:
             self._publish_gauges(n, rate)
-        return best
+        heads.sort(key=lambda h: h.urgent)
+        return heads
 
     def pop_group(self, key, max_rows: int,
                   now: float = 0.0) -> List[SearchRequest]:
@@ -289,6 +333,63 @@ class AdmissionQueue:
                 out.append(r)
                 rows += r.rows
                 self._n -= 1
+            if rest:
+                self._groups[key] = rest
+            else:
+                self._groups.pop(key, None)
+            n, rate = self._n, self._rate
+        for r in cancelled:
+            tracing.span_event("serving.cancelled", now,
+                               trace_ids=(r.trace_id,),
+                               attrs={"reason": "cancelled_at_assembly"})
+        self._publish_gauges(n, rate)
+        return out
+
+    def pop_rows(self, key, max_rows: int,
+                 now: float = 0.0) -> List[Tuple[SearchRequest, int, int]]:
+        """Ragged claim: up to ``max_rows`` query ROWS from the group,
+        most urgent first, **splitting the boundary request** instead
+        of leaving the tile short — the continuous-admission half of
+        ragged batching. Returns ``(request, start, stop)`` row slices;
+        a request whose rows spill past the tile keeps its remainder
+        queued (same order key, so EDF still holds and the remainder
+        packs first-eligible into the next tile).
+
+        A request's handle transitions to *running* when its FIRST
+        slice is claimed (cancel races resolve exactly as on the
+        whole-request path); continuation slices belong to an
+        already-running request and are claimed unconditionally."""
+        out: List[Tuple[SearchRequest, int, int]] = []
+        cancelled: List[SearchRequest] = []
+        with self._lock:
+            group = self._groups.get(key, [])
+            group.sort(key=SearchRequest.order_key)
+            rest: List[SearchRequest] = []
+            rows = 0
+            for r in group:
+                avail = max_rows - rows
+                if avail <= 0:
+                    rest.append(r)
+                    continue
+                if r.taken == 0 and not r.handle._try_start():
+                    self._n -= 1
+                    tracing.inc_counter("serving.batcher.cancelled")
+                    cancelled.append(r)
+                    continue
+                if r.taken > 0 and r.handle.done():
+                    # remainder of a split whose dispatched slice
+                    # already failed the handle — the outcome was
+                    # counted there; don't pack dead rows
+                    self._n -= 1
+                    continue
+                take = min(r.rows_left, avail)
+                start, stop = r.take(take)
+                out.append((r, start, stop))
+                rows += take
+                if r.rows_left > 0:
+                    rest.append(r)       # split: remainder stays queued
+                else:
+                    self._n -= 1
             if rest:
                 self._groups[key] = rest
             else:

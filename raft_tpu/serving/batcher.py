@@ -20,6 +20,19 @@ the tests against ``xla.backend_compile_count``) and results are
 bit-identical to direct ``SearchExecutor`` calls, because bucketing
 pads with inert rows and every row's result is independent.
 
+**Ragged continuous batching** (``BatcherConfig(ragged=True)``, PR 9)
+replaces cycle-and-wait assembly for raggable submissions: requests
+group by the executor's :meth:`~raft_tpu.core.executor.SearchExecutor
+.ragged_key` (mixed per-request ``n_probes``/``k`` under one params
+class share ONE packed executable), admit continuously into the open
+packed tile, and SPLIT at tile boundaries instead of waiting for a
+tile they fully fit — the dual trigger becomes tile-full OR max-wait,
+EDF order is preserved (a split remainder keeps its order key), and
+the degradation ladder's params override feeds the packing key
+exactly as it fed the coalesce key. Non-raggable submissions (CAGRA's
+per-block exemption, approx coarse select, the rank engine, other
+families) fall back to the bucketed path transparently.
+
 Scheduling is delegated to :class:`~raft_tpu.serving.admission
 .AdmissionQueue` (bounded + backpressure, EDF within priority class,
 expired requests shed before dispatch) and the load-shed ladder is
@@ -113,7 +126,21 @@ class BatcherConfig:
     windows and ``serving.slo.alert`` fires only when both burn.
     ``adaptive_wait`` (off by default) enables the arrival-rate →
     max-wait control law; the shed ladder's rung 1 (wait → 0) still
-    takes precedence over it."""
+    takes precedence over it.
+
+    ``ragged`` (off by default) routes raggable submissions onto the
+    executor's packed-batch plan family: requests group by
+    ``executor.ragged_key`` (mixed ``n_probes``/``k`` under one params
+    class share ONE executable), admit continuously into the open
+    packed tile (``executor.ragged_tile`` rows — the tile-full half of
+    the dual trigger), and SPLIT at tile boundaries instead of waiting
+    for a tile they fully fit. Non-raggable submissions (CAGRA, brute
+    force, approx coarse select, the rank engine) fall back to the
+    bucketed path transparently. ``group_budget`` caps consecutive
+    dispatches from one compatibility group while another group is
+    dispatch-ready (0 disables): one slow index family's group cannot
+    monopolize the worker loop, and the wait of the groups passed over
+    is published as the ``serving.batcher.group_starvation_s`` gauge."""
 
     max_wait_s: float = 0.002
     full_batch_rows: int = 256
@@ -124,6 +151,8 @@ class BatcherConfig:
         default_factory=metrics.SloConfig)
     multiburn: Optional[metrics.MultiBurnConfig] = None
     adaptive_wait: Optional[AdaptiveWait] = None
+    ragged: bool = False
+    group_budget: int = 8
 
 
 class DynamicBatcher:
@@ -166,6 +195,9 @@ class DynamicBatcher:
                                      self.config.shed, slo=self._slo)
         self._cond = threading.Condition()
         self._closing = False
+        # fairness bookkeeping: the group served last and its streak
+        self._last_key = None
+        self._consecutive = 0
         self._thread: Optional[threading.Thread] = None
         if start:
             self._thread = threading.Thread(
@@ -210,8 +242,21 @@ class DynamicBatcher:
         from raft_tpu.neighbors.filters import resolve_filter_words
 
         fw = resolve_filter_words(sample_filter)
-        compat_key = self.executor.coalesce_key(
-            index, k, params=params, sample_filter=fw, **kw)
+        # ragged continuous batching: raggable submissions group by the
+        # executor's packing key (mixed n_probes/k in one params class
+        # pack into ONE executable; the ladder's params override was
+        # already applied above, so a degraded submission keys — and
+        # packs — exactly like any other bearer of those params).
+        # Everything else falls back to the bucketed coalesce key.
+        ragged = False
+        compat_key = None
+        if self.config.ragged and hasattr(self.executor, "ragged_key"):
+            compat_key = self.executor.ragged_key(
+                index, k, params=params, sample_filter=fw, **kw)
+            ragged = compat_key is not None
+        if compat_key is None:
+            compat_key = self.executor.coalesce_key(
+                index, k, params=params, sample_filter=fw, **kw)
         if fw is not None:
             if fw.ndim == 1:
                 compat_key = compat_key + (id(fw),)
@@ -222,7 +267,8 @@ class DynamicBatcher:
                             params=params, deadline=deadline,
                             priority=priority,
                             sample_filter=fw, kw=dict(kw),
-                            compat_key=compat_key, arrival=now)
+                            compat_key=compat_key, arrival=now,
+                            ragged=ragged)
         # admission happens under the scheduler lock: a submit racing
         # close() either lands before the final drain (and is drained)
         # or sees _closing and fails typed — never a stranded handle
@@ -256,7 +302,11 @@ class DynamicBatcher:
             batch = self._poll()
             if not batch:
                 return n
-            self._dispatch(*batch)
+            key, items, ragged = batch
+            if ragged:
+                self._dispatch_ragged(key, items)
+            else:
+                self._dispatch(key, items)
             n += 1
 
     def close(self, drain: bool = True) -> None:
@@ -328,29 +378,77 @@ class DynamicBatcher:
         with self._cond:
             return self._select(block=False)
 
+    def _tile_rows(self, head) -> int:
+        """The row cap of one micro-batch for this group: the ragged
+        plan family's fixed packed tile, or the bucketed
+        ``full_batch_rows``."""
+        if head.ragged:
+            return int(getattr(self.executor, "ragged_tile",
+                               self.config.full_batch_rows))
+        return self.config.full_batch_rows
+
+    def _pick_fair(self, ready):
+        """Most urgent dispatch-ready group, except when one group has
+        held the worker ``group_budget`` consecutive dispatches while
+        another group is also ready — then the most urgent OTHER ready
+        group is served (cross-index fairness: a slow family's group
+        cannot monopolize the loop). Pure selection: the streak only
+        advances in :meth:`_record_pick`, once the pop actually yields
+        a dispatch — a cancel-race empty pop must not burn budget the
+        picked group never used."""
+        pick = ready[0]
+        budget = self.config.group_budget
+        if (budget and len(ready) > 1 and pick.key == self._last_key
+                and self._consecutive >= budget):
+            pick = ready[1]
+        return pick
+
+    def _record_pick(self, pick, ready, now: float) -> None:
+        """Account one real dispatch to the fairness streak and
+        publish the ``serving.batcher.group_starvation_s`` gauge: the
+        longest any passed-over ready group has waited."""
+        if pick.key == self._last_key:
+            self._consecutive += 1
+        else:
+            self._last_key = pick.key
+            self._consecutive = 1
+        starve = max((now - h.arrival for h in ready
+                      if h.key != pick.key), default=0.0)
+        tracing.set_gauge("serving.batcher.group_starvation_s", starve)
+
     def _select(self, block: bool):
         """Core of the dual trigger (caller holds ``self._cond``)."""
         while True:
             now = self._clock.now()
-            head = self._queue.next_deadline_group(now)
-            if head is None:
+            heads = self._queue.group_heads(now)
+            if not heads:
                 if self._closing or not block:
                     return None if self._closing else ()
                 self._clock.wait(self._cond, None)
                 continue
-            key, arrival, rows, _ = head
             wait = self._effective_max_wait()
-            full = rows >= self.config.full_batch_rows
-            timed_out = now >= arrival + wait
-            if full or timed_out or self._closing:
-                reqs = self._queue.pop_group(
-                    key, self.config.full_batch_rows, now)
-                if not reqs:       # cancels won every race — rescan
+            # every group's trigger is evaluated (not only the most
+            # urgent group's): a tile-full group is never stuck behind
+            # a more-urgent group still waiting out its timer
+            ready = [h for h in heads
+                     if h.rows >= self._tile_rows(h)
+                     or now >= h.arrival + wait or self._closing]
+            if ready:
+                pick = self._pick_fair(ready)
+                if pick.ragged:
+                    items = self._queue.pop_rows(
+                        pick.key, self._tile_rows(pick), now)
+                else:
+                    items = self._queue.pop_group(
+                        pick.key, self._tile_rows(pick), now)
+                if not items:      # cancels won every race — rescan
                     continue
-                return (key, reqs)
+                self._record_pick(pick, ready, now)
+                return (pick.key, items, pick.ragged)
             if not block:
                 return ()
-            self._clock.wait(self._cond, arrival + wait - now)
+            soonest = min(h.arrival + wait for h in heads)
+            self._clock.wait(self._cond, soonest - now)
 
     def _loop(self) -> None:
         while True:
@@ -359,7 +457,11 @@ class DynamicBatcher:
             if batch is None:
                 return             # closed and drained
             if batch:
-                self._dispatch(*batch)
+                key, items, ragged = batch
+                if ragged:
+                    self._dispatch_ragged(key, items)
+                else:
+                    self._dispatch(key, items)
 
     def _dispatch(self, key, reqs) -> None:
         """Assemble one micro-batch, execute, split results back.
@@ -442,3 +544,88 @@ class DynamicBatcher:
             if ok and self._slo is not None and r.deadline is not None:
                 self._slo.record(t3, t3 <= r.deadline)
         metrics.batch_dispatched(len(reqs), n_rows)
+
+    def _dispatch_ragged(self, key, slices) -> None:
+        """Assemble one packed ragged tile from (request, start, stop)
+        row slices, execute through ``executor.search_ragged``, and
+        complete every request whose final slice landed. A split
+        request's earlier slices accumulate on the request; completion
+        (result, SLO outcome, ``serving.request`` span) happens exactly
+        once, when the last slice arrives. Stage spans mirror the
+        bucketed dispatch, with the packing described in attrs."""
+        t0 = self._clock.now()
+        ids = tuple(dict.fromkeys(r.trace_id for r, _, _ in slices))
+        n_rows = sum(stop - start for _, start, stop in slices)
+        blocks, ks, params_list = [], [], []
+        fw2 = []
+        rep = slices[0][0]
+        for r, start, stop in slices:
+            if start == 0:
+                metrics.observe_stage(metrics.QUEUE_WAIT,
+                                      t0 - r.arrival)
+            blocks.append(r.queries[start:stop])
+            ks.append(r.k)
+            params_list.append(r.params)
+            if r.sample_filter is not None and r.sample_filter.ndim == 2:
+                fw2.append(r.sample_filter[start:stop])
+        # 1-D filter words are shared by packing-key construction (the
+        # words' identity joins the key); 2-D per-row words concatenate
+        # to the packed rows
+        fw = rep.sample_filter
+        if fw2:
+            if all(isinstance(p, np.ndarray) for p in fw2):
+                fw = np.concatenate(fw2)
+            else:
+                fw = jnp.concatenate([jnp.asarray(p) for p in fw2])
+        t1 = self._clock.now()
+        metrics.observe_stage(metrics.ASSEMBLY, t1 - t0)
+        tracing.record_span(
+            "serving.assembly", t0, t1, trace_ids=ids,
+            attrs={"requests": len(ids), "slices": len(slices),
+                   "rows": n_rows, "ragged": True})
+        try:
+            results = self.executor.search_ragged(
+                rep.index, blocks, ks, params_list=params_list,
+                sample_filter=fw, trace_ids=ids, **rep.kw)
+            results = jax.block_until_ready(results)
+        except Exception as e:  # noqa: BLE001 — fail the handles, not the worker
+            t_fail = self._clock.now()
+            for r in {id(r): r for r, _, _ in slices}.values():
+                performed = r.handle._set_exception(e)
+                if performed and self._slo is not None \
+                        and r.deadline is not None:
+                    self._slo.record(t_fail, False)
+            tracing.inc_counter("serving.batcher.failed_batches")
+            tracing.record_span(
+                "serving.execute", t1, t_fail, trace_ids=ids,
+                attrs={"requests": len(ids), "rows": n_rows,
+                       "ragged": True},
+                events=((t_fail, "failed",
+                         {"error": type(e).__name__}),))
+            return
+        t2 = self._clock.now()
+        metrics.observe_stage(metrics.EXECUTE, t2 - t1)
+        tracing.record_span("serving.execute", t1, t2, trace_ids=ids,
+                            attrs={"requests": len(ids), "rows": n_rows,
+                                   "ragged": True})
+        finished = []
+        for (r, start, stop), (d, i) in zip(slices, results):
+            if start == 0 and stop == r.rows:
+                finished.append((r, d, i))       # unsplit fast path
+            elif r.add_part(start, d, i):
+                fd, fi = r.assemble()
+                finished.append((r, fd, fi))
+        delivered = [(r, r.handle._set_result(d, i))
+                     for r, d, i in finished]
+        t3 = self._clock.now()
+        metrics.observe_stage(metrics.SPLIT, t3 - t2)
+        tracing.record_span("serving.split", t2, t3, trace_ids=ids,
+                            attrs={"requests": len(finished)})
+        for r, ok in delivered:
+            metrics.observe_stage(metrics.E2E, t3 - r.arrival)
+            tracing.record_span("serving.request", r.arrival, t3,
+                                trace_ids=(r.trace_id,),
+                                attrs={"rows": r.rows, "ragged": True})
+            if ok and self._slo is not None and r.deadline is not None:
+                self._slo.record(t3, t3 <= r.deadline)
+        metrics.batch_dispatched(len(finished), n_rows)

@@ -86,7 +86,7 @@ _PROBE_GAUGE = re.compile(
 _HEALTH_GAUGE = re.compile(
     r"^index\.health\.([^.]+)\.([a-z0-9_]+)$")
 _DRIFT_GAUGE = re.compile(
-    r"^index\.drift\.([^.]+)\.(score|alert)$")
+    r"^index\.drift\.([^.]+)\.(score|alert|rebaselines)$")
 
 # HELP text per family prefix (longest match wins; the generic
 # fallback keeps every family carrying *a* HELP line — the exposition

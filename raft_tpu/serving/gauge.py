@@ -80,20 +80,50 @@ class RecallWindow:
     :class:`~raft_tpu.serving.metrics.SloWindow`: caller timestamps
     only, one lock, O(pairs-pruned) per operation."""
 
-    def __init__(self, window_s: float = 300.0, z: float = 1.96):
+    def __init__(self, window_s: float = 300.0, z: float = 1.96,
+                 decay_half_life_s: Optional[float] = None):
         self.window_s = window_s
         self.z = z
+        # exponential-decay weighting (PR 8 follow-on): a uniform
+        # window reacts to sudden index staleness only as old pairs
+        # age out; with a half-life each pair's weight is
+        # 0.5**(age/half_life), so fresh evidence dominates within a
+        # couple of half-lives while the window still bounds memory.
+        # None (default) keeps the original uniform weighting.
+        self.decay_half_life_s = decay_half_life_s
         self._lock = threading.Lock()
         self._events: "collections.deque" = collections.deque()
         self._hits = 0
         self._trials = 0
+        # decay path: running sums of event weights, anchored at
+        # ``_anchor`` — scaling both sums by the elapsed decay factor
+        # on access keeps record/estimate O(events-pruned), never
+        # O(window); record() sits on the shadow-completion path
+        self._wh = 0.0
+        self._wt = 0.0
+        self._anchor: Optional[float] = None
+
+    def _decay_to_locked(self, now: float) -> None:
+        if self._anchor is None:
+            self._anchor = now
+        elif now > self._anchor:
+            f = 0.5 ** ((now - self._anchor) / self.decay_half_life_s)
+            self._wh *= f
+            self._wt *= f
+            self._anchor = now
 
     def _prune_locked(self, now: float) -> None:
         horizon = now - self.window_s
         while self._events and self._events[0][0] <= horizon:
-            _, h, t = self._events.popleft()
+            t, h, n = self._events.popleft()
             self._hits -= h
-            self._trials -= t
+            self._trials -= n
+            if self.decay_half_life_s is not None:
+                # the event's CURRENT weight (sums sit at _anchor)
+                w = 0.5 ** ((self._anchor - t)
+                            / self.decay_half_life_s)
+                self._wh -= w * h
+                self._wt -= w * n
 
     def record(self, now: float, hits: int, trials: int) -> None:
         """Count one shadow pair's outcome and re-publish."""
@@ -101,14 +131,32 @@ class RecallWindow:
             self._events.append((now, int(hits), int(trials)))
             self._hits += int(hits)
             self._trials += int(trials)
+            if self.decay_half_life_s is not None:
+                self._decay_to_locked(now)
+                self._wh += int(hits)
+                self._wt += int(trials)
         self.publish(now)
 
     def estimate(self, now: float) -> dict:
-        """Windowed recall estimate + Wilson CI as of ``now``."""
+        """Windowed recall estimate + Wilson CI as of ``now``. With
+        ``decay_half_life_s`` set, hits and trials are
+        exponential-decay weighted by age; the CI then uses the
+        weighted trial mass as its sample size — less than the raw
+        count, so decay honestly WIDENS the interval as evidence
+        ages."""
         with self._lock:
+            if self.decay_half_life_s is not None:
+                self._decay_to_locked(now)
             self._prune_locked(now)
-            hits, trials, pairs = self._hits, self._trials, \
-                len(self._events)
+            if self.decay_half_life_s is None:
+                hits, trials = float(self._hits), float(self._trials)
+            else:
+                # float-subtraction residue from pruning stays tiny;
+                # clamp so an emptied window reads exactly no evidence
+                hits = self._wh if self._events else 0.0
+                trials = self._wt if self._events else 0.0
+                hits, trials = max(hits, 0.0), max(trials, 0.0)
+            pairs = len(self._events)
         est = hits / trials if trials else 0.0
         lo, hi = wilson_interval(hits, trials, self.z)
         return {"estimate": est, "ci_low": lo, "ci_high": hi,
@@ -314,16 +362,69 @@ class DriftDetector:
         self._lock = threading.Lock()
         self._last: Optional[np.ndarray] = None
         self._ewma: Optional[np.ndarray] = None
+        # identity watch (PR 8 follow-on): which index object this
+        # baseline was snapshotted from. extend()/rebuild returns a NEW
+        # index whose list_sizes shifted — scoring live traffic against
+        # the stale build-time histogram would read as permanent drift,
+        # so the scrape-time publisher rebaselines when the watched
+        # identity (or the plane shape) changes.
+        self._watched = None
         self.score = 0.0
         self.updates = 0
+        self.rebaselines = 0
 
     @classmethod
     def from_index(cls, index, **kw) -> "DriftDetector":
         """Snapshot ``index.list_sizes`` as the baseline (one fetch,
-        at attach time — never on the dispatch path)."""
+        at attach time — never on the dispatch path) and watch the
+        index's identity for automatic rebaselining."""
         import jax
 
-        return cls(np.asarray(jax.device_get(index.list_sizes)), **kw)
+        det = cls(np.asarray(jax.device_get(index.list_sizes)), **kw)
+        det.watch(index)
+        return det
+
+    def watch(self, index) -> None:
+        """Pair this detector's baseline with ``index``'s identity (a
+        weakref — the detector must not keep a replaced index alive)."""
+        import weakref
+
+        try:
+            self._watched = weakref.ref(index)
+        except TypeError:            # non-weakref-able index objects
+            self._watched = None
+
+    def matches(self, index) -> bool:
+        """Whether the current baseline still describes ``index``: the
+        plane shapes agree AND (when an identity is watched) the index
+        is the very object the baseline came from. A detector built
+        from a raw baseline array matches any shape-compatible index
+        until it is first watched."""
+        n = int(getattr(index, "n_lists", self.baseline.shape[0]))
+        if self.baseline.shape[0] != n:
+            return False
+        return self._watched is None or self._watched() is index
+
+    def rebaseline(self, index) -> None:
+        """Re-snapshot the baseline from (a rebuilt/extended)
+        ``index`` and reset the streaming state — the smoothed live
+        histogram and the last-scrape plane describe traffic scored
+        against the OLD baseline (and may even be the wrong length),
+        so both restart; the score holds at 0 until fresh traffic
+        accumulates. Counted in ``rebaselines`` (published per watched
+        index by :class:`IndexGauge`)."""
+        import jax
+
+        sizes = np.asarray(jax.device_get(index.list_sizes),
+                           dtype=np.float64)
+        with self._lock:
+            self.baseline = sizes
+            self._last = None
+            self._ewma = None
+            self.score = 0.0
+            self.updates = 0         # folds against the NEW baseline
+            self.rebaselines += 1
+        self.watch(index)
 
     @property
     def alert(self) -> bool:
@@ -403,6 +504,14 @@ class IndexGauge:
         worst = 0.0
         for name, det in self.drift.items():
             index = self.indexes.get(name)
+            if index is not None and not det.matches(index):
+                # the watched index was rebuilt/extended (new identity
+                # or a new list count): refresh the baseline instead of
+                # scoring live traffic against the stale build-time
+                # histogram
+                det.rebaseline(index)
+            elif index is not None:
+                det.watch(index)     # adopt raw-baseline detectors
             label = (self.executor.probe_label(index)
                      if self.executor is not None and index is not None
                      else None)
@@ -411,11 +520,14 @@ class IndexGauge:
             tracing.set_gauges({
                 f"index.drift.{name}.score": det.score,
                 f"index.drift.{name}.alert": float(det.alert),
+                f"index.drift.{name}.rebaselines":
+                    float(det.rebaselines),
             })
             worst = max(worst, det.score)
             out["drift"][name] = {"score": det.score,
                                   "alert": det.alert,
-                                  "updates": det.updates}
+                                  "updates": det.updates,
+                                  "rebaselines": det.rebaselines}
         if self.drift:
             tracing.set_gauge(tracing.DRIFT_SCORE, worst)
         if self.sampler is not None:

@@ -72,13 +72,27 @@ class FakeExecutor:
     caught by value. ``calls`` records every dispatched micro-batch as
     ``(n_blocks, total_rows)``."""
 
-    def __init__(self):
+    def __init__(self, ragged_tile: int = 8):
         self.buckets = (8, 16, 32, 64, 128, 256)
+        self.ragged_tile = ragged_tile
         self.calls: List[Tuple[int, int]] = []
+        # ragged dispatches land here as (n_slices, claimed_rows)
+        self.ragged_calls: List[Tuple[int, int]] = []
 
     def coalesce_key(self, index, k: int, params=None,
                      sample_filter=None, **kw) -> tuple:
         return (id(index), "fake", k, repr(params),
+                tuple(sorted((n, str(v)) for n, v in kw.items())))
+
+    def ragged_key(self, index, k: int, params=None, sample_filter=None,
+                   **kw):
+        """Fake packing key: everything is raggable (mixed k packs —
+        the fake's params class is just the index identity) unless the
+        index object opts out with ``bucketed_only = True`` — the
+        tests' stand-in for CAGRA/approx-coarse fallback."""
+        if getattr(index, "bucketed_only", False):
+            return None
+        return (id(index), "fake_ragged", repr(params),
                 tuple(sorted((n, str(v)) for n, v in kw.items())))
 
     def search_blocks(self, index, blocks, k: int, params=None,
@@ -87,6 +101,27 @@ class FakeExecutor:
                            sum(int(np.shape(b)[0]) for b in blocks)))
         out = []
         for b in blocks:
+            # graftlint: disable=R5(device-free test shim: inputs are host arrays by contract)
+            b = np.asarray(b, np.float32)
+            base = b.sum(axis=1, keepdims=True)
+            d = base + np.arange(k, dtype=np.float32)[None, :]
+            i = (b[:, :1].astype(np.int64) * k
+                 + np.arange(k, dtype=np.int64)[None, :]).astype(np.int32)
+            out.append((d, i))
+        return out
+
+    def search_ragged(self, index, blocks, ks, params_list=None,
+                      sample_filter=None, **kw):
+        """Packed-path stand-in: same row-identifying formula as
+        ``search_blocks`` with per-block ``k`` — a mis-split slice or a
+        cross-tile mixup is caught by value."""
+        n = len(blocks)
+        if not isinstance(ks, (list, tuple)):
+            ks = [ks] * n
+        self.ragged_calls.append(
+            (n, sum(int(np.shape(b)[0]) for b in blocks)))
+        out = []
+        for b, k in zip(blocks, ks):
             # graftlint: disable=R5(device-free test shim: inputs are host arrays by contract)
             b = np.asarray(b, np.float32)
             base = b.sum(axis=1, keepdims=True)
@@ -127,13 +162,22 @@ class ShimExecutor:
     def buckets(self):
         return self.inner.buckets
 
+    @property
+    def ragged_tile(self):
+        return getattr(self.inner, "ragged_tile", 256)
+
     def coalesce_key(self, *a, **kw):
         return self.inner.coalesce_key(*a, **kw)
 
-    def search_blocks(self, index, blocks, k: int, **kw):
+    def ragged_key(self, *a, **kw):
+        inner = getattr(self.inner, "ragged_key", None)
+        return inner(*a, **kw) if inner is not None else None
+
+    def _charge_call(self, n_blocks: int, rows: int) -> int:
+        """Shared scripted-latency/failure bookkeeping of both
+        dispatch entries; returns the 0-based call ordinal."""
         ordinal = len(self.calls)
-        self.calls.append((len(blocks),
-                           sum(int(np.shape(b)[0]) for b in blocks)))
+        self.calls.append((n_blocks, rows))
         if self.delay_s:
             if self.clock is not None and hasattr(self.clock, "advance"):
                 self.clock.advance(self.delay_s)
@@ -143,6 +187,16 @@ class ShimExecutor:
                 time.sleep(self.delay_s)
         if ordinal in self.fail_on:
             raise self.fail_on[ordinal]
+        return ordinal
+
+    def search_ragged(self, index, blocks, ks, **kw):
+        self._charge_call(len(blocks),
+                          sum(int(np.shape(b)[0]) for b in blocks))
+        return self.inner.search_ragged(index, blocks, ks, **kw)
+
+    def search_blocks(self, index, blocks, k: int, **kw):
+        ordinal = self._charge_call(
+            len(blocks), sum(int(np.shape(b)[0]) for b in blocks))
         times = self.shard_times
         if isinstance(times, dict):
             times = times.get(ordinal)

@@ -20,6 +20,12 @@ Per-stage latency **histograms** (log2 buckets, p50/p95/p99 estimates):
 - ``serving.execute.calls`` / ``.rows`` /
   ``.modeled_flops`` / ``.modeled_bytes``         — executor dispatches
   priced by each executable's compile-time ``cost_analysis()``
+- ``serving.execute.padded_rows``                 — dispatched row
+  capacity incl. bucket/tile pad; with ``.rows`` it derives the
+  pad-waste fraction the ragged-vs-bucketed A/B gates on
+- ``serving.batcher.group_starvation_s``          — (gauge) longest any
+  dispatch-ready group waited while another was served — the
+  cross-index fairness budget's observable
 
 **Gauges** (PR 6 graftscope):
 
@@ -295,9 +301,16 @@ def derived() -> dict:
     hits = tracing.get_counter("serving.cache_hits")
     misses = tracing.get_counter("serving.cache_misses")
     exec_s = tracing.get_histogram(EXECUTE).snapshot()["sum"]
+    rows = tracing.get_counter("serving.execute.rows")
+    padded = tracing.get_counter("serving.execute.padded_rows")
     out = {
         "cache_hit_rate": hits / (hits + misses) if hits + misses else 0.0,
         "execute_seconds_total": exec_s,
+        # the pad-waste fraction the ragged-vs-bucketed A/B gates on:
+        # share of dispatched row capacity that was bucket/tile pad
+        # (bucketed pow2 rounding wastes up to ~50%; the packed ragged
+        # tile only pads the final partial tile)
+        "pad_waste_fraction": 1.0 - rows / padded if padded else 0.0,
         "modeled_bytes_total":
             tracing.get_counter("serving.execute.modeled_bytes"),
         "modeled_flops_total":

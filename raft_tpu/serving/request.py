@@ -175,12 +175,75 @@ class SearchRequest:
     arrival: float = 0.0
     seq: int = dataclasses.field(default_factory=lambda: next(_seq))
     trace_id: int = dataclasses.field(default_factory=tracing.new_trace_id)
+    # ragged continuous batching: requests on the ragged path may SPLIT
+    # across packed tiles — ``taken`` rows were already claimed by
+    # earlier tiles (the queue holds only the remainder), and completed
+    # row-range results accumulate in ``parts`` until the final slice
+    # lands. ``taken`` is only touched under the admission queue's
+    # lock; ``parts`` has its own lock because two dispatchers may
+    # deliver slices of one request concurrently (``pump()`` is
+    # documented as a flush alongside a running worker) — ``add_part``
+    # elects exactly one assembler.
+    ragged: bool = False
+    taken: int = 0
+    parts: list = dataclasses.field(default_factory=list)
+    _parts_lock: Any = dataclasses.field(
+        default_factory=threading.Lock)
+    _assembled: bool = False
 
     @property
     def rows(self) -> int:
         import numpy as np
 
         return int(np.shape(self.queries)[0])
+
+    @property
+    def rows_left(self) -> int:
+        """Rows not yet claimed by a packed tile (== ``rows`` for
+        whole-request scheduling — ``taken`` only advances on the
+        ragged path's tile-overflow splits)."""
+        return self.rows - self.taken
+
+    def take(self, n: int):
+        """Claim the next ``n`` rows for a packed tile; returns the
+        claimed ``(start, stop)`` row range."""
+        start = self.taken
+        self.taken = start + n
+        return start, self.taken
+
+    def add_part(self, start: int, distances, indices) -> bool:
+        """Record one claimed slice's results; True once every row has
+        landed (the request is then assembled and completable).
+        Thread-safe and once-only: when slices of one request land
+        from two dispatchers (worker + a concurrent ``pump()``),
+        exactly one caller sees True and assembles."""
+        with self._parts_lock:
+            self.parts.append((start, distances, indices))
+            if (self._assembled
+                    or sum(p[1].shape[0] for p in self.parts)
+                    < self.rows):
+                return False
+            self._assembled = True
+            return True
+
+    def assemble(self):
+        """Concatenate the accumulated slices (by row range) into the
+        request's full ``(distances, indices)`` — per-row independence
+        makes the concatenation bit-identical to an unsplit call.
+        Called only by the ``add_part`` winner, after every row has
+        landed, so the parts list is complete and stable."""
+        import numpy as np
+
+        self.parts.sort(key=lambda p: p[0])
+        if len(self.parts) == 1:
+            return self.parts[0][1], self.parts[0][2]
+        if all(isinstance(p[1], np.ndarray) for p in self.parts):
+            return (np.concatenate([p[1] for p in self.parts]),
+                    np.concatenate([p[2] for p in self.parts]))
+        import jax.numpy as jnp
+
+        return (jnp.concatenate([p[1] for p in self.parts]),
+                jnp.concatenate([p[2] for p in self.parts]))
 
     def order_key(self) -> tuple:
         """EDF-within-priority ordering (deadline-less requests sort

@@ -96,6 +96,47 @@ def main():
              sv_metrics.E2E, {}))
     assert failures == 0
     assert occ["requests_per_batch"] >= 2.0
+
+    # PR 9 ragged acceptance on chip: ONE packed executable serves
+    # mixed shapes zero-recompile, bit-identical to the bucketed path,
+    # pad waste = final partial tiles only
+    ex_r = SearchExecutor(ragged_tile=128)
+    warm_r = ex_r.warmup_ragged(index, k=8, params=p)
+    sv_metrics.reset()
+    # mixed n_probes AND k inside ONE pow2 params class (n_probes
+    # {5,8} -> class 8, k {7,8} -> class 8): one executable packs both
+    p2 = ivf_flat.IvfFlatSearchParams(n_probes=5)
+    with DynamicBatcher(ex_r, BatcherConfig(max_wait_s=0.002,
+                                            ragged=True)) as br:
+        # primer pass (transfer programs for the packed shapes)
+        for h in [br.submit(index, blk, 8, params=p)
+                  for blk in blocks[:20]]:
+            h.result(timeout=60)
+        backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        hs = [br.submit(index, blk, 8 if j % 2 else 7,
+                        params=p if j % 2 else p2)
+              for j, blk in enumerate(blocks[20:120])]
+        ragged_failures = sum(1 for h in hs
+                              if h.exception(timeout=60) is not None)
+        ragged_compiles = (
+            tracing.get_counter(tracing.XLA_COMPILE_COUNT) - backend0)
+        j, ragged_bits = 0, True
+        for h, blk in zip(hs, blocks[20:120]):
+            k_j, p_j = (8, p) if j % 2 else (7, p2)
+            want = ex_r.search(index, blk, k_j, params=p_j)
+            got = h.result(timeout=60)
+            ragged_bits = ragged_bits and np.array_equal(
+                np.asarray(got[1]), np.asarray(want[1]))
+            j += 1
+    emit("ragged",
+         ok=bool(ragged_bits and ragged_failures == 0),
+         warmup_seconds=round(warm_r, 3),
+         executables=ex_r.ragged_executables(),
+         backend_compiles_steady_state=int(ragged_compiles),
+         pad_waste_fraction=round(
+             sv_metrics.derived()["pad_waste_fraction"], 4))
+    assert ragged_bits and ragged_failures == 0
+    assert ex_r.ragged_executables() == 1
     emit("done", ok=True)
 
 

@@ -421,3 +421,184 @@ class TestProbeAccounting:
         tracing.set_gauge(base + "9999", 123.0)
         ex.publish_probe_gauges(top_n=8)
         assert base + "9999" not in tracing.gauges(base)
+
+
+class TestRaggedPlans:
+    """The ragged packed-batch plan family: one AOT entry per (index
+    shapes, params class, tile) serves every load shape — bit-identical
+    per request to the bucketed path, zero-recompile steady state."""
+
+    @pytest.fixture(scope="class")
+    def ragged_setup(self):
+        rng = np.random.default_rng(11)
+        x = rng.standard_normal((2000, 24)).astype(np.float32)
+        index = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=16), x)
+        return x, index, rng
+
+    @pytest.mark.parametrize("engine", ["pallas", "xla"])
+    def test_bit_identical_to_bucketed_per_engine(self, ragged_setup,
+                                                  engine):
+        """pallas ≡ xla ≡ bucketed per packed request, with mixed
+        per-request n_probes AND k in one params class."""
+        _, index, rng = ragged_setup
+        ex = SearchExecutor(ragged_tile=16)
+        blocks = [rng.standard_normal((m, 24)).astype(np.float32)
+                  for m in (3, 2, 4, 1)]
+        nps, ks = [5, 8, 2, 7], [3, 7, 5, 8]
+        ps = [ivf_flat.IvfFlatSearchParams(n_probes=n,
+                                           scan_engine=engine)
+              for n in nps]
+        keys = {ex.ragged_key(index, k, params=p)
+                for k, p in zip(ks, ps)}
+        assert len(keys) == 1 and None not in keys
+        res = ex.search_ragged(index, blocks, ks, params_list=ps)
+        for b, k, p, (d, i) in zip(blocks, ks, ps, res):
+            dd, ii = ex.search(index, b, k, params=p)
+            np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+            np.testing.assert_array_equal(np.asarray(d), np.asarray(dd))
+
+    def test_single_request_batch(self, ragged_setup):
+        _, index, rng = ragged_setup
+        ex = SearchExecutor(ragged_tile=16)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=5, scan_engine="xla")
+        b = rng.standard_normal((5, 24)).astype(np.float32)
+        (d, i), = ex.search_ragged(index, [b], 4, params_list=p)
+        dd, ii = ex.search(index, b, 4, params=p)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(dd))
+        assert d.shape == (5, 4)
+
+    def test_one_executable_zero_recompile(self, ragged_setup):
+        """warmup_ragged compiles the ONE executable; mixed load
+        shapes then never compile again (asserted against the XLA
+        backend counter) and the cache holds exactly one ragged
+        entry."""
+        _, index, rng = ragged_setup
+        ex = SearchExecutor(ragged_tile=16)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=6, scan_engine="xla")
+        ex.warmup_ragged(index, k=8, params=p)
+        assert ex.ragged_executables() == 1
+        tracing.install_xla_compile_listener()
+        # first call set: each distinct total-row count pays its tiny
+        # pad/concat program once (the bucketed small print), so churn
+        # the shapes once before measuring
+        shapes = [(1,), (3, 2), (5, 7, 4), (16,), (2, 2, 2)]
+        for sizes in shapes:
+            blocks = [rng.standard_normal((m, 24)).astype(np.float32)
+                      for m in sizes]
+            ex.search_ragged(index, blocks, 8, params_list=p)
+        before = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        for sizes in shapes:
+            blocks = [rng.standard_normal((m, 24)).astype(np.float32)
+                      for m in sizes]
+            ex.search_ragged(index, blocks, 8, params_list=p)
+        assert tracing.get_counter(tracing.XLA_COMPILE_COUNT) == before
+        assert ex.ragged_executables() == 1
+
+    def test_distinct_params_class_distinct_key(self, ragged_setup):
+        _, index, _ = ragged_setup
+        ex = SearchExecutor(ragged_tile=16)
+        p_small = ivf_flat.IvfFlatSearchParams(n_probes=5,
+                                               scan_engine="xla")
+        p_big = ivf_flat.IvfFlatSearchParams(n_probes=16,
+                                             scan_engine="xla")
+        k1 = ex.ragged_key(index, 4, params=p_small)
+        k2 = ex.ragged_key(index, 4, params=p_big)     # np class 8 vs 16
+        k3 = ex.ragged_key(index, 40, params=p_small)  # k class 8 vs 64
+        assert k1 != k2 and k1 != k3
+
+    def test_not_raggable_falls_back(self, ragged_setup, indexes):
+        _, index, _ = ragged_setup
+        ex = SearchExecutor()
+        # rank engine / approx coarse / other families: bucketed only
+        assert ex.ragged_key(index, 4, params=ivf_flat.IvfFlatSearchParams(
+            n_probes=5, scan_engine="rank")) is None
+        assert ex.ragged_key(index, 4, params=ivf_flat.IvfFlatSearchParams(
+            n_probes=5, coarse_algo="approx")) is None
+        assert ex.ragged_key(indexes["cagra"], 4,
+                             params=cagra.CagraSearchParams()) is None
+        assert ex.ragged_key(indexes["brute_force"], 4) is None
+
+    def test_tile_overflow_streams_chunks(self, ragged_setup):
+        """Totals past one tile stream through the SAME executable —
+        results identical, no second specialization."""
+        _, index, rng = ragged_setup
+        ex = SearchExecutor(ragged_tile=8)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=5, scan_engine="xla")
+        blocks = [rng.standard_normal((m, 24)).astype(np.float32)
+                  for m in (6, 7, 9)]           # 22 rows -> 3 chunks
+        res = ex.search_ragged(index, blocks, 6, params_list=p)
+        assert ex.ragged_executables() == 1
+        for b, (d, i) in zip(blocks, res):
+            dd, ii = ex.search(index, b, 6, params=p)
+            np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+            np.testing.assert_array_equal(np.asarray(d), np.asarray(dd))
+
+    def test_empty_total_returns_empties(self, ragged_setup):
+        _, index, _ = ragged_setup
+        ex = SearchExecutor(ragged_tile=8)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=5, scan_engine="xla")
+        res = ex.search_ragged(
+            index, [np.zeros((0, 24), np.float32)], 4, params_list=p)
+        assert res[0][0].shape == (0, 4)
+
+    def test_2d_filter_rows_pack_adjacently(self, ragged_setup):
+        """Per-request 2-D filter rows concatenate to the packed rows
+        and mask exactly as the bucketed path does per request."""
+        x, index, rng = ragged_setup
+        ex = SearchExecutor(ragged_tile=16)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        sizes = (3, 5)
+        blocks = [rng.standard_normal((m, 24)).astype(np.float32)
+                  for m in sizes]
+        mask = rng.random((sum(sizes), len(x))) < 0.5
+        bm = BitmapFilter.from_mask(mask)
+        res = ex.search_ragged(index, blocks, 6, params_list=p,
+                               sample_filter=bm)
+        row = 0
+        for b, m, (d, i) in zip(blocks, sizes, res):
+            bm_j = BitmapFilter.from_mask(mask[row:row + m])
+            dd, ii = ex.search(index, b, 6, params=p, sample_filter=bm_j)
+            np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+            np.testing.assert_array_equal(np.asarray(d), np.asarray(dd))
+            ids = np.asarray(i)
+            valid = ids >= 0
+            rows_of = np.repeat(np.arange(m), 6).reshape(m, 6)
+            assert mask[row:row + m][rows_of[valid], ids[valid]].all()
+            row += m
+
+    def test_shared_1d_filter(self, ragged_setup):
+        x, index, rng = ragged_setup
+        ex = SearchExecutor(ragged_tile=16)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        filt = BitsetFilter(Bitset.from_mask(np.arange(len(x)) % 2 == 0))
+        blocks = [rng.standard_normal((m, 24)).astype(np.float32)
+                  for m in (4, 3)]
+        res = ex.search_ragged(index, blocks, 5, params_list=p,
+                               sample_filter=filt)
+        for b, (d, i) in zip(blocks, res):
+            dd, ii = ex.search(index, b, 5, params=p, sample_filter=filt)
+            np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+            ids = np.asarray(i)
+            assert (ids[ids >= 0] % 2 == 0).all()
+
+    def test_probe_accounting_counts_exactly(self, ragged_setup):
+        """The donated probe plane counts each packed request's OWN
+        n_probes per row — pad rows and masked slots contribute
+        nothing, and the plane is shared with the bucketed plans."""
+        _, index, rng = ragged_setup
+        ex = SearchExecutor(ragged_tile=16, probe_accounting=True)
+        p1 = ivf_flat.IvfFlatSearchParams(n_probes=5, scan_engine="xla")
+        p2 = ivf_flat.IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        blocks = [rng.standard_normal((m, 24)).astype(np.float32)
+                  for m in (3, 2)]
+        ex.search_ragged(index, blocks, 4, params_list=[p1, p2])
+        planes = ex.probe_frequencies()
+        total = sum(int(v.sum()) for v in planes.values())
+        assert total == 3 * 5 + 2 * 8
+        # bucketed dispatch folds into the SAME plane
+        ex.search(index, blocks[0], 4, params=p1)
+        planes = ex.probe_frequencies()
+        assert sum(int(v.sum()) for v in planes.values()) == \
+            total + 3 * 5

@@ -452,3 +452,120 @@ class TestReviewHardening:
         del idx
         gc.collect()
         assert ex.probe_frequencies() == {}
+
+
+class TestRecallWindowDecay:
+    """Exponential-decay weighting (PR 9): recent pairs dominate, so
+    the estimate reacts to sudden staleness within a couple of
+    half-lives; default (uniform) behavior unchanged."""
+
+    def test_decay_weights_pinned(self):
+        metrics.reset()
+        w = RecallWindow(window_s=100.0, decay_half_life_s=10.0)
+        w.record(0.0, hits=10, trials=10)   # perfect recall, old
+        w.record(10.0, hits=0, trials=10)   # total miss, fresh
+        # at t=10 the old pair weighs 0.5: est = 5 / 15
+        e = w.estimate(10.0)
+        assert e["estimate"] == pytest.approx(5.0 / 15.0)
+        # uniform window would read 0.5 — decay reacts faster
+        u = RecallWindow(window_s=100.0)
+        u.record(0.0, hits=10, trials=10)
+        u.record(10.0, hits=0, trials=10)
+        assert u.estimate(10.0)["estimate"] == pytest.approx(0.5)
+        # aging both pairs equally preserves their weight RATIO — the
+        # estimate holds until newer evidence (or the window) moves it
+        e = w.estimate(30.0)
+        assert e["estimate"] == pytest.approx(5.0 / 15.0)
+
+    def test_decay_widens_ci_as_evidence_ages(self):
+        w = RecallWindow(window_s=1000.0, decay_half_life_s=10.0)
+        w.record(0.0, hits=90, trials=100)
+        fresh = w.estimate(0.0)
+        old = w.estimate(50.0)
+        assert old["estimate"] == pytest.approx(fresh["estimate"])
+        assert (old["ci_high"] - old["ci_low"]) > (
+            fresh["ci_high"] - fresh["ci_low"])
+
+    def test_window_prune_still_applies(self):
+        w = RecallWindow(window_s=10.0, decay_half_life_s=5.0)
+        w.record(0.0, hits=10, trials=10)
+        assert w.estimate(11.0)["pairs"] == 0
+
+
+class TestDriftRebaseline:
+    """extend()/rebuild shifts ``list_sizes`` — the detector must
+    refresh its baseline when the watched index changes identity or
+    shape, not score live traffic against the stale histogram."""
+
+    def _corpus(self, n_lists=8, n=400, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.standard_normal((n, 8)).astype(np.float32)
+        q = rng.standard_normal((8, 8)).astype(np.float32)
+        idx = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=n_lists), x)
+        return x, q, idx
+
+    def test_matches_and_watch(self):
+        _, _, idx = self._corpus()
+        det = DriftDetector.from_index(idx)
+        assert det.matches(idx)
+        _, _, other = self._corpus(seed=1)
+        assert not det.matches(other)      # different identity
+        raw = DriftDetector(np.ones(8))
+        assert raw.matches(idx)            # raw baseline adopts shape
+        assert not raw.matches(
+            ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(
+                n_lists=4), np.random.default_rng(2).standard_normal(
+                    (64, 8)).astype(np.float32)))
+
+    def test_extend_triggers_rebaseline_via_gauge(self):
+        x, q, idx = self._corpus()
+        ex = SearchExecutor(probe_accounting=True)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=2)
+        det = DriftDetector.from_index(idx)
+        gauge = IndexGauge(executor=ex, indexes={"main": idx},
+                           drift={"main": det})
+        ex.search(idx, q, 5, params=p)
+        out = gauge.publish()
+        assert out["drift"]["main"]["rebaselines"] == 0
+        base0 = det.baseline.copy()
+        # extend returns a NEW index object with shifted list_sizes
+        rng = np.random.default_rng(3)
+        new_rows = rng.standard_normal((150, 8)).astype(np.float32)
+        idx2 = ivf_flat.extend(None, idx, new_rows)
+        gauge.indexes["main"] = idx2
+        ex.search(idx2, q, 5, params=p)
+        out = gauge.publish()
+        assert out["drift"]["main"]["rebaselines"] == 1
+        assert det.matches(idx2)
+        assert not np.array_equal(det.baseline, base0)
+        np.testing.assert_array_equal(
+            det.baseline, np.asarray(idx2.list_sizes, dtype=np.float64))
+        # the same scrape then scores the NEW index's (fresh) plane
+        # against the fresh baseline — only post-rebaseline traffic,
+        # never the old index's history
+        assert det.updates == 1
+        assert tracing.get_gauge("index.drift.main.rebaselines") == 1.0
+        # further scrapes with the SAME index do not rebaseline again
+        ex.search(idx2, q, 5, params=p)
+        out = gauge.publish()
+        assert out["drift"]["main"]["rebaselines"] == 1
+
+    def test_shape_change_rebaselines_and_scores_clean(self):
+        """A rebuilt index with a different n_lists must swap baseline
+        AND streaming state (stale planes would be the wrong
+        length)."""
+        x, q, idx = self._corpus(n_lists=8)
+        det = DriftDetector.from_index(idx)
+        det.update(np.arange(8, dtype=np.float64))   # some history
+        x2 = np.random.default_rng(4).standard_normal(
+            (400, 8)).astype(np.float32)
+        idx2 = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=16), x2)
+        assert not det.matches(idx2)
+        det.rebaseline(idx2)
+        assert det.baseline.shape == (16,)
+        assert det.score == 0.0 and det.rebaselines == 1
+        # the next update scores against the fresh baseline cleanly
+        det.update(np.asarray(idx2.list_sizes, dtype=np.float64))
+        assert det.score == pytest.approx(0.0, abs=1e-9)

@@ -366,3 +366,99 @@ class TestIvfPqListMajor:
             np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
             np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
         assert ex.stats.compile_count == 2  # one executable per engine
+
+
+class TestRaggedFront:
+    """The ragged query-tile front (ops/ivf_scan.ragged_row_probes /
+    ragged_probes + ivf_flat._search_ragged_fn): per-request probe
+    budgets resolve through the engines' membership mask, so one
+    packed tile is bit-identical per request to solo searches."""
+
+    def test_row_probes_descriptor(self):
+        from raft_tpu.ops.ivf_scan import ragged_row_probes
+
+        rp = ragged_row_probes([3, 2, 4], [5, 9, 2], tile=12)
+        np.testing.assert_array_equal(
+            rp, [5, 5, 5, 9, 9, 2, 2, 2, 2, 0, 0, 0])
+        with pytest.raises(Exception):
+            ragged_row_probes([8, 8], [1, 1], tile=12)  # overflow
+
+    def test_ragged_probes_masks_to_sentinel(self):
+        import jax.numpy as jnp
+
+        from raft_tpu.ops.ivf_scan import ragged_probes
+
+        probes = jnp.arange(12, dtype=jnp.int32).reshape(3, 4)
+        rp = jnp.asarray([2, 0, 4], jnp.int32)
+        out = np.asarray(ragged_probes(probes, rp, n_lists=99))
+        np.testing.assert_array_equal(out[0], [0, 1, 99, 99])
+        np.testing.assert_array_equal(out[1], [99] * 4)  # pad row
+        np.testing.assert_array_equal(out[2], [8, 9, 10, 11])
+
+    @pytest.mark.parametrize("engine", ["pallas", "xla"])
+    @pytest.mark.parametrize("metric", METRICS)
+    def test_packed_tile_bit_identical_to_solo(self, data, indexes,
+                                               metric, engine):
+        """pallas ≡ xla ≡ solo per packed request, mixed n_probes/k."""
+        import jax.numpy as jnp
+
+        from raft_tpu.ops.ivf_scan import ragged_row_probes
+
+        _, q = data
+        index = indexes[metric]
+        sizes, nps, ks = [3, 2, 4, 1], [5, 9, 2, 16], [3, 7, 5, 10]
+        tile, np_cap, k_cap = 16, 16, 16
+        packed = np.zeros((tile, q.shape[1]), np.float32)
+        row = 0
+        for m in sizes:
+            packed[row:row + m] = q[row:row + m]
+            row += m
+        rp = ragged_row_probes(sizes, nps, tile)
+        # jitted like the serving path compiles it: eager-vs-jit is
+        # NOT bit-stable (XLA fuses/reassociates), the contract is
+        # jitted-ragged ≡ jitted-solo
+        import functools
+
+        import jax
+
+        ragged_jit = jax.jit(functools.partial(
+            ivf_flat._search_ragged_fn, n_probes=np_cap, k=k_cap,
+            metric=index.metric, scan_engine=engine))
+        d, i = ragged_jit(
+            jnp.asarray(packed), jnp.asarray(rp), index.centers,
+            index.center_norms, index.data, index.data_norms,
+            index.indices, None)
+        d, i = np.asarray(d), np.asarray(i)
+        row = 0
+        for m, npb, k in zip(sizes, nps, ks):
+            sd, si = _run(index, q[row:row + m], k, engine,
+                          n_probes=npb)
+            np.testing.assert_array_equal(i[row:row + m, :k], si)
+            np.testing.assert_array_equal(d[row:row + m, :k], sd)
+            row += m
+        # tile pad rows (budget 0) probe nothing: empty results
+        assert (i[row:] == -1).all()
+
+    def test_pad_rows_never_pollute_probe_histogram(self, data, indexes):
+        import jax.numpy as jnp
+
+        from raft_tpu.ops.ivf_scan import (
+            probe_histogram,
+            ragged_probes,
+            ragged_row_probes,
+        )
+
+        index = indexes[DistanceType.L2Expanded]
+        _, q = data
+        qf = jnp.asarray(q[:8])
+        import jax
+
+        ip = qf @ index.centers.T
+        _, probes = jax.lax.top_k(-(index.center_norms[None, :] - 2 * ip),
+                                  8)
+        rp = jnp.asarray(ragged_row_probes([3, 2], [4, 8], tile=8))
+        masked = ragged_probes(probes.astype(jnp.int32), rp,
+                               index.n_lists)
+        counts = probe_histogram(masked,
+                                 jnp.zeros((index.n_lists,), jnp.int32))
+        assert int(np.asarray(counts).sum()) == 3 * 4 + 2 * 8

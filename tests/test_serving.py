@@ -1318,3 +1318,402 @@ class TestExpositionHelpTypePairing:
         # the graftgauge labeled families are present and annotated
         assert "index_health_rows" in families
         assert any(f.startswith("index_probe_freq") for f in families)
+
+
+class TestRaggedBatcher:
+    """Ragged continuous batching (BatcherConfig(ragged=True)): one
+    packed tile admits continuously, requests split at tile boundaries,
+    and everything not raggable falls back to the bucketed path."""
+
+    def ragged_batcher(self, executor=None, tile=4, **cfg):
+        clock = ManualClock()
+        ex = executor or FakeExecutor(ragged_tile=tile)
+        cfg.setdefault("max_wait_s", 0.01)
+        b = DynamicBatcher(ex, BatcherConfig(ragged=True, **cfg),
+                           clock=clock, start=False)
+        return b, ex, clock
+
+    def test_continuous_packing_dual_trigger(self):
+        b, ex, clock = self.ragged_batcher(tile=4)
+        idx = _Index()
+        h1 = b.submit(idx, q_block([1, 2, 3]), 3)
+        h2 = b.submit(idx, q_block([7, 8]), 2)
+        h3 = b.submit(idx, q_block([4, 5, 6, 9]), 3)
+        # two FULL tiles dispatch with no time advance (tile-full
+        # trigger): [h1 rows + h2 row 0], [h2 row 1 + h3 rows 0-2]
+        assert b.pump() == 2
+        assert ex.ragged_calls == [(2, 4), (2, 4)]
+        assert not h3.done()            # one row still queued
+        clock.advance(0.01)             # timer flushes the remainder
+        assert b.pump() == 1
+        _, i1 = h1.result(timeout=0)
+        np.testing.assert_array_equal(i1[:, 0], [3, 6, 9])
+        _, i2 = h2.result(timeout=0)
+        np.testing.assert_array_equal(i2[:, 0], [14, 16])
+        d3, i3 = h3.result(timeout=0)
+        assert i3.shape == (4, 3)
+        np.testing.assert_array_equal(i3[:, 0], [12, 15, 18, 27])
+        b.close()
+
+    def test_tile_overflow_split_reassembles(self):
+        """A request bigger than the tile streams across tiles and
+        reassembles bit-exactly (per-row values prove the order)."""
+        b, ex, clock = self.ragged_batcher(tile=4)
+        idx = _Index()
+        ids = list(range(10))
+        h = b.submit(idx, q_block(ids), 2)
+        assert b.pump() == 2            # two full tiles immediately
+        assert not h.done()
+        clock.advance(0.01)
+        assert b.pump() == 1            # final 2-row remainder
+        d, i = h.result(timeout=0)
+        assert i.shape == (10, 2)
+        np.testing.assert_array_equal(i[:, 0], [v * 2 for v in ids])
+        b.close()
+
+    def test_mixed_k_packs_into_one_call(self):
+        """Different per-request k share one packed dispatch (the
+        fake's params class ignores k, like the executor's pow2
+        class)."""
+        b, ex, clock = self.ragged_batcher(tile=4)
+        idx = _Index()
+        h1 = b.submit(idx, q_block([1, 2]), 3)
+        h2 = b.submit(idx, q_block([5, 6]), 7)
+        assert b.pump() == 1
+        assert ex.ragged_calls == [(2, 4)]
+        assert h1.result(timeout=0)[1].shape == (2, 3)
+        assert h2.result(timeout=0)[1].shape == (2, 7)
+        b.close()
+
+    def test_empty_after_shed_batch(self):
+        """Every queued request expires before the trigger: the worker
+        sheds them (typed DeadlineExceeded) and dispatches NOTHING."""
+        b, ex, clock = self.ragged_batcher(tile=8)
+        idx = _Index()
+        h1 = b.submit(idx, q_block([1]), 2, timeout_s=0.005)
+        h2 = b.submit(idx, q_block([2, 3]), 2, timeout_s=0.005)
+        clock.advance(0.02)             # past deadline AND max-wait
+        assert b.pump() == 0
+        assert not ex.ragged_calls and not ex.calls
+        for h in (h1, h2):
+            with pytest.raises(DeadlineExceeded):
+                h.result(timeout=0)
+        b.close()
+
+    def test_edf_order_preserved(self):
+        """The earlier-deadline group still dispatches first, and a
+        split remainder keeps its order key."""
+        b, ex, clock = self.ragged_batcher(tile=2, max_wait_s=0.0)
+        late, soon = _Index(), _Index()
+        b.submit(late, q_block([1]), 3, timeout_s=100.0)
+        b.submit(soon, q_block([2]), 3, timeout_s=1.0)
+        b.pump()
+        assert ex.ragged_calls and ex.ragged_calls[0] == (1, 1)
+        b.close()
+
+    def test_cancel_before_first_slice(self):
+        b, ex, clock = self.ragged_batcher(tile=4)
+        idx = _Index()
+        h = b.submit(idx, q_block([1, 2]), 2)
+        assert h.cancel()
+        clock.advance(0.01)
+        assert b.pump() == 0
+        assert not ex.ragged_calls
+        b.close()
+
+    def test_shutdown_drains_split_requests(self):
+        b, ex, clock = self.ragged_batcher(tile=4)
+        idx = _Index()
+        h = b.submit(idx, q_block(list(range(6))), 2)
+        assert b.pump() == 1            # first tile only (4 of 6 rows)
+        b.close(drain=True)             # close flushes the remainder
+        d, i = h.result(timeout=0)
+        assert i.shape == (6, 2)
+        np.testing.assert_array_equal(i[:, 0], [0, 2, 4, 6, 8, 10])
+
+    def test_failed_tile_fails_split_request_once(self):
+        inner = FakeExecutor(ragged_tile=4)
+        clock = ManualClock()
+        shim = ShimExecutor(inner, fail_on={0: RuntimeError("boom")},
+                            clock=clock)
+        b = DynamicBatcher(shim, BatcherConfig(ragged=True,
+                                               max_wait_s=0.0),
+                           clock=clock, start=False)
+        idx = _Index()
+        h = b.submit(idx, q_block(list(range(6))), 2)
+        b.pump()
+        assert isinstance(h.exception(timeout=0), RuntimeError)
+        b.close()
+
+    def test_bucketed_only_index_falls_back(self):
+        b, ex, clock = self.ragged_batcher(tile=4)
+        idx = _Index()
+        idx.bucketed_only = True
+        h = b.submit(idx, q_block([5]), 2)
+        clock.advance(0.01)
+        assert b.pump() == 1
+        assert ex.calls == [(1, 1)] and not ex.ragged_calls
+        np.testing.assert_array_equal(h.result(timeout=0)[1][:, 0], [10])
+        b.close()
+
+
+class TestRaggedRealExecutor:
+    """Acceptance criteria of the ragged path against the real
+    executor: per-request bit-identity with direct bucketed calls,
+    zero recompiles after the ONE warmup, CAGRA exemption intact."""
+
+    def test_bit_identity_and_zero_recompile(self, real_setup):
+        ex = SearchExecutor(ragged_tile=16)
+        clock = ManualClock()
+        b = DynamicBatcher(ex, BatcherConfig(max_wait_s=0.01,
+                                             ragged=True),
+                           clock=clock, start=False)
+        q = real_setup["q"]
+        index = real_setup["ivf"]
+        p1 = ivf_flat.IvfFlatSearchParams(n_probes=4, scan_engine="xla")
+        p2 = ivf_flat.IvfFlatSearchParams(n_probes=7, scan_engine="xla")
+        ex.warmup_ragged(index, k=5, params=p1)
+        assert ex.ragged_executables() == 1
+        # mixed n_probes AND k in one params class, over several
+        # load shapes; then measure compiles over a repeat pass
+        def drive():
+            hs = [b.submit(index, q[:7], 5, params=p1),
+                  b.submit(index, q[7:10], 3, params=p2),
+                  b.submit(index, q[10:], 8, params=p1)]
+            clock.advance(0.01)
+            b.pump()
+            return hs
+        drive()
+        tracing.install_xla_compile_listener()
+        before = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        hs = drive()
+        assert tracing.get_counter(tracing.XLA_COMPILE_COUNT) == before
+        assert ex.ragged_executables() == 1
+        for h, (blk, k, p) in zip(hs, [(q[:7], 5, p1), (q[7:10], 3, p2),
+                                       (q[10:], 8, p1)]):
+            d, i = h.result(timeout=0)
+            dd, ii = ex.search(index, blk, k, params=p)
+            np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+            np.testing.assert_array_equal(np.asarray(d), np.asarray(dd))
+        b.close()
+
+    def test_pad_waste_collapses_vs_bucketed(self, real_setup):
+        """The acceptance headline in miniature: a packed full tile
+        carries near-zero pad while the bucketed path pads every
+        request to its bucket."""
+        q = real_setup["q"]
+        index = real_setup["ivf"]
+        p = ivf_flat.IvfFlatSearchParams(n_probes=4, scan_engine="xla")
+        blocks = [q[:3], q[3:6], q[6:11], q[11:16]]     # 16 rows
+
+        metrics.reset()
+        ex = SearchExecutor(ragged_tile=16)
+        ex.search_ragged(index, blocks, 5, params_list=p)
+        assert metrics.derived()["pad_waste_fraction"] == 0.0
+
+        metrics.reset()
+        for blk in blocks:              # bucketed: 3->8, 3->8, 5->8, 5->8
+            ex.search(index, blk, 5, params=p)
+        assert metrics.derived()["pad_waste_fraction"] == 0.5
+
+    def test_cagra_exempt_under_ragged_config(self, real_setup):
+        """CAGRA requests under a ragged batcher ride the bucketed
+        per-block path (seeds draw per absolute row) — solo
+        bit-identity preserved."""
+        from raft_tpu.neighbors import cagra
+
+        rng = np.random.default_rng(5)
+        x = real_setup["x"]
+        gindex = cagra.build(None, cagra.CagraIndexParams(
+            graph_degree=8, intermediate_graph_degree=16,
+            build_algo=cagra.BuildAlgo.NN_DESCENT), x)
+        ex = SearchExecutor()
+        clock = ManualClock()
+        b = DynamicBatcher(ex, BatcherConfig(max_wait_s=0.01,
+                                             ragged=True),
+                           clock=clock, start=False)
+        p = cagra.CagraSearchParams(itopk_size=16)
+        q = real_setup["q"]
+        h1 = b.submit(gindex, q[:5], 4, params=p)
+        h2 = b.submit(gindex, q[5:9], 4, params=p)
+        clock.advance(0.01)
+        b.pump()
+        for h, blk in ((h1, q[:5]), (h2, q[5:9])):
+            d, i = h.result(timeout=0)
+            dd, ii = ex.search(gindex, blk, 4, params=p)
+            np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+        b.close()
+
+    def test_2d_filter_slices_ride_the_split(self, real_setup):
+        """Per-row bitmap filters slice with their rows across a tile
+        split and still mask exactly."""
+        x = real_setup["x"]
+        index = real_setup["ivf"]
+        q = real_setup["q"]
+        from raft_tpu.neighbors.filters import BitmapFilter
+
+        rng = np.random.default_rng(9)
+        ex = SearchExecutor(ragged_tile=8)
+        clock = ManualClock()
+        b = DynamicBatcher(ex, BatcherConfig(max_wait_s=0.01,
+                                             ragged=True),
+                           clock=clock, start=False)
+        p = ivf_flat.IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        mask = rng.random((12, len(x))) < 0.5
+        bm = BitmapFilter.from_mask(mask)
+        h = b.submit(index, q[:12], 5, params=p, sample_filter=bm)
+        clock.advance(0.01)
+        b.pump()                        # 12 rows through an 8-row tile
+        d, i = h.result(timeout=0)
+        dd, ii = ex.search(index, q[:12], 5, params=p, sample_filter=bm)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(dd))
+        b.close()
+
+
+class TestGroupFairness:
+    """Cross-index fairness: the per-group dispatch budget keeps one
+    group from monopolizing the worker, pinned by manual clock."""
+
+    def test_budget_forces_other_ready_group(self):
+        clock = ManualClock()
+        ex = FakeExecutor(ragged_tile=2)
+        b = DynamicBatcher(ex, BatcherConfig(ragged=True,
+                                             max_wait_s=0.0,
+                                             group_budget=2),
+                           clock=clock, start=False)
+        A, B = _Index(), _Index()
+        for i in range(8):
+            b.submit(A, q_block([i]), 2)
+        hb = b.submit(B, q_block([99]), 2)
+        clock.advance(0.01)
+        order = []
+        while True:
+            got = b._poll()
+            if not got:
+                break
+            key, items, ragged = got
+            order.append("B" if items[0][0].queries[0, 0] == 99
+                         else "A")
+            b._dispatch_ragged(key, items)
+        # A is always most urgent (earlier seq), but after 2
+        # consecutive A dispatches the budget serves B
+        assert order == ["A", "A", "B", "A", "A"]
+        assert hb.done()
+        b.close()
+
+    def test_starvation_gauge_pinned(self):
+        metrics.reset()
+        clock = ManualClock()
+        ex = FakeExecutor(ragged_tile=2)
+        b = DynamicBatcher(ex, BatcherConfig(ragged=True,
+                                             max_wait_s=0.0,
+                                             group_budget=0),
+                           clock=clock, start=False)
+        A, B = _Index(), _Index()
+        b.submit(A, q_block([1, 2]), 2)
+        b.submit(B, q_block([3, 4]), 2)
+        clock.advance(0.25)
+        got = b._poll()                 # serves A; B has waited 0.25 s
+        assert got and got[1][0][0].queries[0, 0] == 1
+        assert tracing.get_gauge(
+            "serving.batcher.group_starvation_s") == 0.25
+        b._dispatch_ragged(got[0], got[1])
+        got = b._poll()                 # serves B; nobody else waits
+        assert tracing.get_gauge(
+            "serving.batcher.group_starvation_s") == 0.0
+        b._dispatch_ragged(got[0], got[1])
+        b.close()
+
+    def test_budget_zero_disables(self):
+        clock = ManualClock()
+        ex = FakeExecutor(ragged_tile=2)
+        b = DynamicBatcher(ex, BatcherConfig(ragged=True,
+                                             max_wait_s=0.0,
+                                             group_budget=0),
+                           clock=clock, start=False)
+        A, B = _Index(), _Index()
+        for i in range(6):
+            b.submit(A, q_block([i]), 2)
+        hb = b.submit(B, q_block([99]), 2)
+        clock.advance(0.01)
+        order = []
+        while True:
+            got = b._poll()
+            if not got:
+                break
+            order.append("B" if got[1][0][0].queries[0, 0] == 99
+                         else "A")
+            b._dispatch_ragged(got[0], got[1])
+        assert order == ["A", "A", "A", "B"]   # pure EDF, no override
+        b.close()
+
+    def test_full_group_not_stuck_behind_urgent_timer(self):
+        """A tile-full group dispatches even while a more-urgent group
+        is still waiting out its timer (the old head-of-line scan
+        would sleep on the urgent group's timer)."""
+        clock = ManualClock()
+        ex = FakeExecutor(ragged_tile=4)
+        b = DynamicBatcher(ex, BatcherConfig(ragged=True,
+                                             max_wait_s=10.0),
+                           clock=clock, start=False)
+        urgent, full = _Index(), _Index()
+        b.submit(urgent, q_block([1]), 2, timeout_s=50.0)  # EDF winner
+        b.submit(full, q_block([2, 3, 4, 5]), 2)           # tile-full
+        assert b.pump() == 1            # the FULL group went, now
+        assert ex.ragged_calls == [(1, 4)]
+        b.close()
+
+    def test_empty_pop_does_not_burn_fairness_budget(self):
+        """The streak advances only on REAL dispatches (_record_pick):
+        cancel-race empty pops must not count against the picked
+        group, or a group starved by cancellations gets passed over
+        the moment it has real work."""
+        clock = ManualClock()
+        ex = FakeExecutor(ragged_tile=2)
+        b = DynamicBatcher(ex, BatcherConfig(ragged=True,
+                                             max_wait_s=0.0,
+                                             group_budget=2),
+                           clock=clock, start=False)
+        A = _Index()
+
+        class _Head:
+            def __init__(self, key):
+                self.key = key
+                self.arrival = 0.0
+
+        a_head, b_head = _Head("A"), _Head("B")
+        # phantom picks (no _record_pick): budget must stay unburned
+        for _ in range(5):
+            assert b._pick_fair([a_head, b_head]).key == "A"
+        assert b._consecutive == 0
+        # real dispatches burn it; the 3rd pick yields to B
+        b._record_pick(a_head, [a_head, b_head], 0.0)
+        b._record_pick(a_head, [a_head, b_head], 0.0)
+        assert b._pick_fair([a_head, b_head]).key == "B"
+        b.close()
+
+    def test_failed_split_remainder_not_counted_cancelled(self):
+        """A split request whose dispatched slice failed leaves its
+        remainder in the queue with a done handle; pruning it must
+        not inflate serving.batcher.cancelled (the failure was
+        already counted in failed_batches)."""
+        metrics.reset()
+        inner = FakeExecutor(ragged_tile=4)
+        clock = ManualClock()
+        shim = ShimExecutor(inner, fail_on={0: RuntimeError("boom")},
+                            clock=clock)
+        b = DynamicBatcher(shim, BatcherConfig(ragged=True,
+                                               max_wait_s=0.0),
+                           clock=clock, start=False)
+        idx = _Index()
+        h = b.submit(idx, q_block(list(range(6))), 2)  # splits at 4
+        b.pump()                       # tile 1 fails the handle
+        assert isinstance(h.exception(timeout=0), RuntimeError)
+        assert tracing.get_counter(
+            "serving.batcher.failed_batches") == 1
+        b.pump()                       # remainder pruned, not dispatched
+        assert tracing.get_counter("serving.batcher.cancelled") == 0
+        assert len(b._queue) == 0
+        assert inner.ragged_calls == []    # shim failed before inner
+        b.close()

@@ -43,7 +43,10 @@ compile counts and modeled lean collective bytes for the list-sharded
 index across every visible chip), BENCH_SERVING=1 (request frontend:
 bursty open-loop load through the DynamicBatcher — p50/p95/p99
 latency, shed rate and batch occupancy next to the one-request-per-
-call baseline QPS).
+call baseline QPS), BENCH_BQ=1 (RaBitQ IVF-BQ: fused
+estimate-then-rerank vs estimate+refine recall at equal over-fetch,
+modeled bytes/vector and one-stream bytes vs the two-pass model,
+achieved GB/s vs the stream_read_sum roofline).
 """
 
 import json
@@ -617,6 +620,17 @@ def child_main():
         except Exception as e:  # noqa: BLE001 — keep headline record
             log(f"serving rider failed ({e}); keeping headline record")
 
+    # opt-in rider: RaBitQ IVF-BQ — fused estimate-then-rerank vs the
+    # legacy estimate+refine path, with one-stream byte accounting
+    if os.environ.get("BENCH_BQ") == "1" and last_rec:
+        try:
+            bq = _bq_rider()
+            rec = dict(last_rec)
+            rec["bq"] = bq
+            print(json.dumps(rec), flush=True)
+        except Exception as e:  # noqa: BLE001 — keep headline record
+            log(f"bq rider failed ({e}); keeping headline record")
+
 
 def _ivf_engine_sweep():
     """BENCH_IVF_SWEEP=1 rider: A/B the IVF-Flat probe-scan engines
@@ -779,6 +793,219 @@ def _multichip_rider():
             "batch": BATCH, "n_chips": n_dev,
             "build_peak_deal_block_bytes": int(build_peak),
             "cases": cases}
+
+
+def _bq_rider():
+    """BENCH_BQ=1 rider: the RaBitQ IVF-BQ A/B — the fused
+    estimate-then-rerank scan (exact distances, one list-major
+    stream) against the legacy estimate+refine two-pass path at equal
+    over-fetch, with the byte accounting the acceptance criterion is
+    about:
+
+    - ``bytes_per_vector_codes`` vs ``bytes_per_vector_raw``: the scan
+      stream's compression (packed sign words + correction scalars vs
+      f32 rows);
+    - ``fused_model_bytes``: ONE stream of codes + corrections + the
+      raw vectors of *survivor blocks only* (the prune decisions are
+      replayed host-side with the engines' own margin rule), next to
+      ``two_pass_model_bytes`` (estimate stream + an unconditional
+      exact pass over every probed block). ``survivor_row_fraction``
+      is the prune rule's deterministic CI signal — block-level
+      skips (`one_stream_fraction` < 1) only bite at scale, where a
+      block's every probing query has a tight running k-th;
+    - achieved GB/s of the fused search against a ``stream_read_sum``
+      roofline of the raw-vector tensor.
+
+    Env knobs: BENCH_BQ_N / BENCH_BQ_LISTS / BENCH_BQ_PROBES /
+    BENCH_BQ_BITS / BENCH_BQ_SECONDS."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu import SearchExecutor
+    from raft_tpu.bench.prims import timeit_stats
+    from raft_tpu.neighbors import brute_force, ivf_bq
+    from raft_tpu.neighbors.ivf_bq import (
+        _unpack_pm1,
+        estimator_margin,
+        overfetch_budget,
+    )
+    from raft_tpu.neighbors.refine import refine
+    from raft_tpu.ops.bq_scan import resolve_bq_engine
+    from raft_tpu.ops.fused_topk import stream_read_sum
+    from raft_tpu.ops.ivf_scan import unique_lists
+
+    n = int(os.environ.get("BENCH_BQ_N", 100_000))
+    n_lists = int(os.environ.get("BENCH_BQ_LISTS", 128))
+    n_probes = int(os.environ.get("BENCH_BQ_PROBES", 16))
+    bits = int(os.environ.get("BENCH_BQ_BITS", 1))
+    budget = float(os.environ.get("BENCH_BQ_SECONDS", 8))
+    kd, kq = jax.random.split(jax.random.key(7))
+    x = jax.random.normal(kd, (n, D), jnp.float32)
+    queries = jax.random.normal(kq, (BATCH, D), jnp.float32)
+    log(f"bq rider: building RaBitQ index ({n}x{D}, {n_lists} lists, "
+        f"{bits} bit/dim + rerank plane)")
+    index = ivf_bq.build(None, ivf_bq.IvfBqIndexParams(
+        n_lists=n_lists, bits=bits, kmeans_n_iters=10), x)
+    m = index.max_list_size
+    de = index.dim_ext
+    words = index.codes.shape[2]
+    jax.block_until_ready(index.data)
+    _, gt = brute_force.knn(None, x, queries, K)
+    gt = np.asarray(gt)
+
+    def recall(ids):
+        ids = np.asarray(ids)
+        return float(np.mean([len(set(ids[r]) & set(gt[r])) / K
+                              for r in range(ids.shape[0])]))
+
+    # roofline: a pure streamed read of the raw-vector plane — the
+    # ceiling the rerank stream is judged against
+    flat = index.data.reshape(n_lists * m, D)
+    interp = jax.default_backend() != "tpu"
+    st = timeit_stats(lambda: stream_read_sum(flat, interpret=interp),
+                      min(budget, 6.0))
+    roof_gbps = flat.size * 4 / st["best_s"] / 1e9
+    log(f"bq roofline (stream_read_sum raw vectors): "
+        f"{roof_gbps:.1f} GB/s")
+
+    # per-vector scan-stream bytes: packed sign words + the three
+    # correction scalars (+ per-level scales) + the id slot
+    code_slot = words * 4 + (bits + 2) * 4 + 4
+    raw_slot = D * 4 + 8                    # f32 row + norm + id
+
+    # probed-union + host-side replay of the fused prune (the
+    # engines' margin rule) -> survivor blocks for the byte model
+    qf = np.asarray(queries, np.float32)
+    centers = np.asarray(index.centers)
+    qc2_all = (np.sum(qf * qf, 1)[:, None]
+               + np.sum(centers * centers, 1)[None, :]
+               - 2.0 * qf @ centers.T)
+    probes = jnp.asarray(np.argsort(qc2_all, axis=1)[:, :n_probes],
+                         jnp.int32)
+    uniq = np.asarray(unique_lists(probes, n_lists))
+    uniq = uniq[uniq < n_lists]
+    rot = np.asarray(index.rotation)
+    qrot = qf @ rot.T
+    crot = centers @ rot.T
+    rnorm = np.asarray(index.rnorm)
+    cfac = np.asarray(index.cfac)
+    errw = np.asarray(index.errw)
+    ids_plane = np.asarray(index.indices)
+    pm1 = np.asarray(_unpack_pm1(index.codes, jnp.float32)).reshape(
+        n_lists, m, bits, de)
+    recon = ((rnorm[..., None] * cfac)[..., None] * pm1).sum(axis=2)
+    xnorms = np.asarray(index.data_norms)
+    xplane = np.asarray(index.data)
+    probed = np.zeros((BATCH, n_lists), bool)
+    np.put_along_axis(probed, np.asarray(probes), True, axis=1)
+    kth = np.full((BATCH,), np.inf, np.float32)
+    topk = [[] for _ in range(BATCH)]
+    survivor_blocks = 0
+    survivor_rows = 0
+    probed_rows = 0
+    for lid in uniq:
+        qt = qrot - crot[lid]
+        qc2 = np.sum(qt * qt, 1, keepdims=True)
+        delta = ((qt.max(1, keepdims=True) - qt.min(1, keepdims=True))
+                 / 15.0)
+        est = qc2 + np.square(rnorm[lid])[None, :] \
+            - 2.0 * qt @ recon[lid].T
+        margin = np.asarray(estimator_margin(
+            jnp.asarray(np.sqrt(qc2)), jnp.asarray(rnorm[lid])[None],
+            jnp.asarray(errw[lid])[None], jnp.asarray(delta), de, 3.0))
+        ok = (ids_plane[lid][None, :] >= 0) & probed[:, lid : lid + 1]
+        cand = ((est - margin) < kth[:, None]) & ok
+        survivor_rows += int(cand.sum())
+        probed_rows += int(ok.sum())
+        if not cand.any():
+            continue
+        survivor_blocks += 1
+        exact = (np.sum(qf * qf, 1, keepdims=True) + xnorms[lid][None]
+                 - 2.0 * qf @ xplane[lid].T)
+        for r in range(BATCH):
+            if cand[r].any():
+                topk[r].extend(exact[r][cand[r]].tolist())
+                topk[r] = sorted(topk[r])[:K]
+                if len(topk[r]) == K:
+                    kth[r] = topk[r][-1]
+    # the byte models: fused = ONE list-major stream (codes +
+    # corrections for every probed block, raw vectors only for blocks
+    # the prune left survivors in — per-block DMA granularity, the
+    # kernel's actual unit); two-pass = reading each probed block
+    # TWICE (an estimate pass then a full exact pass — the roofline
+    # antipattern the fusion removes). Both are replays of the
+    # engines' own margin rule, deterministic under the pinned seeds:
+    # the gate pins survivor_row_fraction (margin/prune-math
+    # regressions move it), while block-level pruning only bites at
+    # scale — many blocks, tight kth — and on the real chip.
+    est_stream = len(uniq) * m * code_slot
+    fused_model_bytes = est_stream + survivor_blocks * m * raw_slot
+    two_pass_model_bytes = est_stream + len(uniq) * m * raw_slot
+    row_frac = survivor_rows / max(probed_rows, 1)
+    log(f"bq prune replay: {survivor_blocks}/{len(uniq)} blocks, "
+        f"{row_frac:.3f} of probed rows kept for exact re-rank")
+
+    engine = resolve_bq_engine("auto", data=index.data, k=K,
+                               dim_ext=de, bits=bits)
+    p = ivf_bq.IvfBqSearchParams(n_probes=n_probes)
+    ex = SearchExecutor()
+    ex.warmup(index, buckets=(ex.bucket_for(BATCH),), k=K, params=p)
+    stats = timeit_stats(
+        lambda: ex.search(index, queries, K, params=p), budget)
+    dt = stats["best_s"]
+    d_f, i_f = ex.search(index, queries, K, params=p)
+    fused_recall = recall(i_f)
+    gbps = fused_model_bytes / dt / 1e9
+    log(f"bq fused ({engine}): {dt * 1e3:.2f} ms/iter, recall@{K} "
+        f"{fused_recall:.4f}, {gbps:.1f} GB/s modeled "
+        f"({gbps / roof_gbps:.3f} of roofline)")
+
+    # legacy estimate+refine at the bound-derived over-fetch
+    est_index = _dc.replace(index, data=None, data_norms=None)
+    fetch = overfetch_budget(est_index, K)
+    pe = ivf_bq.IvfBqSearchParams(n_probes=n_probes,
+                                  scan_engine="rank")
+
+    def est_refine():
+        _, cand = ivf_bq.search(None, pe, est_index, queries, fetch)
+        return refine(None, x, queries, cand, K)
+
+    est_stats = timeit_stats(lambda: jax.block_until_ready(
+        est_refine()[0]), budget)
+    _, i_e = est_refine()
+    est_recall = recall(i_e)
+    _, i_ek = ivf_bq.search(None, pe, est_index, queries, K)
+    log(f"bq estimate+refine (fetch {fetch}): "
+        f"{est_stats['best_s'] * 1e3:.2f} ms/iter, recall@{K} "
+        f"{est_recall:.4f}; raw estimate@{K} {recall(i_ek):.4f}")
+
+    return {
+        "n": n, "dim": D, "dim_ext": de, "n_lists": n_lists,
+        "n_probes": n_probes, "bits": bits, "batch": BATCH, "k": K,
+        "engine": engine, "max_list_size": m,
+        "union_lists": int(len(uniq)),
+        "survivor_blocks": int(survivor_blocks),
+        "survivor_row_fraction": round(row_frac, 4),
+        "bytes_per_vector_codes": code_slot,
+        "bytes_per_vector_raw": raw_slot,
+        "fused_model_bytes": int(fused_model_bytes),
+        "two_pass_model_bytes": int(two_pass_model_bytes),
+        "one_stream_fraction": round(
+            fused_model_bytes / max(two_pass_model_bytes, 1), 4),
+        "roofline_gbps": round(roof_gbps, 2),
+        "fused_best_s": round(dt, 6),
+        "fused_qps": round(BATCH / dt, 2),
+        "fused_recall": round(fused_recall, 4),
+        "achieved_gbps": round(gbps, 2),
+        "vs_roofline": round(gbps / roof_gbps, 4),
+        "estimate_fetch": int(fetch),
+        "estimate_refine_best_s": round(est_stats["best_s"], 6),
+        "estimate_refine_recall": round(est_recall, 4),
+        "estimate_at_k_recall": round(recall(i_ek), 4),
+    }
 
 
 def _serving_rider():

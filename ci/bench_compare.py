@@ -91,6 +91,13 @@ PINNED_ENV = {
     # completion column timing-flaky — attainment is still measured
     # (slo_* columns), it just isn't gated
     "BENCH_SV_TIMEOUT_MS": "10000",
+    # RaBitQ IVF-BQ rider (this PR): small enough for seconds-scale
+    # CPU CI, clustered enough that the recall floor band is stable
+    "BENCH_BQ": "1",
+    "BENCH_BQ_N": "20000",
+    "BENCH_BQ_LISTS": "32",
+    "BENCH_BQ_PROBES": "8",
+    "BENCH_BQ_SECONDS": "2",
 }
 
 # Tolerance bands, keyed by dotted path into the bench record.
@@ -128,6 +135,20 @@ DEFAULT_TOLERANCES = {
     "serving.ragged.backend_compiles_during_load": {"max_increase": 5},
     "serving.ragged.executables": {"max_increase": 0},
     "serving.pad_waste_fraction": {"max_increase": 0.15},
+    # RaBitQ IVF-BQ rider: the recall floor band (the fused exact
+    # rerank must keep hitting the probe-set ceiling; the
+    # deterministic pinned config makes these tight), the structural
+    # codes-slot width, and the prune rule's deterministic signal —
+    # survivor_row_fraction is a host-side replay of the engines' own
+    # margin rule on the pinned seeds, so a margin/prune-math change
+    # that starts re-ranking materially more rows moves it exactly
+    # (block-level one_stream_fraction only separates at production
+    # scale and is reported, not gated)
+    "bq.fused_recall": {"min_ratio": 0.95},
+    "bq.estimate_refine_recall": {"min_ratio": 0.90},
+    "bq.bytes_per_vector_codes": {"max_increase": 0},
+    "bq.survivor_row_fraction": {"max_increase": 0.05},
+    "bq.fused_qps": {"min_ratio": 0.30},
 }
 
 # counters the test session's metrics snapshot must carry ABOVE these

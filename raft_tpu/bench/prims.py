@@ -334,28 +334,31 @@ def _pq_score_case(size: str, mode: str, J: int = 256):
 
 @_register("bq_score")
 def _bq_score(size: str):
-    """IVF-BQ sign-code scoring core (unpack + fused level GEMMs) —
-    the lookup-free alternative to the pq_score family."""
+    """IVF-BQ sign-code scoring core (int32 word unpack + fused level
+    GEMMs) — the lookup-free alternative to the pq_score family (the
+    rank-major estimate path; the fused engines score the packed
+    words directly by XOR+popcount)."""
     from raft_tpu.neighbors.ivf_bq import _unpack_pm1
 
     q, m, d, bits = _dims(size, (4, 1 << 10, 64, 2), (10, 1 << 15, 128, 2),
                           (10, 1 << 17, 128, 2))
     kq_, kb = jax.random.split(jax.random.key(12))
     qrot = jax.random.normal(kq_, (q, d), jnp.float32)
-    byts = jax.random.randint(kb, (q, m, bits * d // 8), 0, 256,
-                              jnp.int32).astype(jnp.uint8)
+    words = jax.random.randint(kb, (q, m, bits * d // 32),
+                               jnp.iinfo(jnp.int32).min,
+                               jnp.iinfo(jnp.int32).max, jnp.int32)
     a = jnp.abs(jax.random.normal(kb, (q, m, bits), jnp.float32))
-    jax.block_until_ready((qrot, byts, a))
+    jax.block_until_ready((qrot, words, a))
 
     @jax.jit
-    def score(qr, by, aa):
-        pm1 = _unpack_pm1(by).reshape(q, m, bits, d)
+    def score(qr, wo, aa):
+        pm1 = _unpack_pm1(wo).reshape(q, m, bits, d)
         crosses = jnp.einsum("qd,qmld->qml", qr.astype(jnp.bfloat16), pm1,
                              preferred_element_type=jnp.float32)
         return jnp.sum(aa * crosses, axis=-1)
 
     nbytes = q * m * bits * d // 8 + q * d * 4 + q * m * 4
-    return (lambda: score(qrot, byts, a), nbytes, 2 * q * m * bits * d,
+    return (lambda: score(qrot, words, a), nbytes, 2 * q * m * bits * d,
             f"q={q} m={m} d={d} bits={bits}")
 
 

@@ -231,14 +231,20 @@ def _cost_gauge_values(digest: str, cost: dict) -> dict:
     }
 
 
-def _sds(x) -> jax.ShapeDtypeStruct:
+def _sds(x) -> Optional[jax.ShapeDtypeStruct]:
+    # None passes through: optional plan operands (e.g. the BQ
+    # rerank plane of a codes-only index) are empty pytree args
+    if x is None:
+        return None
     return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype)
 
 
-def _sds_sharded(x) -> jax.ShapeDtypeStruct:
+def _sds_sharded(x) -> Optional[jax.ShapeDtypeStruct]:
     """Abstract aval carrying the array's sharding — mesh-sharded plans
     must lower with the real NamedShardings so the compiled executable
     accepts (and keeps) the mesh placement."""
+    if x is None:
+        return None
     return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
                                 sharding=getattr(x, "sharding", None))
 
@@ -1367,6 +1373,8 @@ class SearchExecutor:
         from raft_tpu.distributed import ivf as dist_ivf
         from raft_tpu.neighbors import ivf_bq as m
 
+        from raft_tpu.ops.bq_scan import resolve_bq_engine
+
         expect(fw is None,
                "distributed searches have no sample_filter support")
         params = params or m.IvfBqSearchParams()
@@ -1374,30 +1382,40 @@ class SearchExecutor:
          probe_wire_dtype) = self._dist_statics(index, kw)
         n_probes = dist_ivf.resolve_probe_budget(
             params.n_probes, index.n_lists, comms.size, probe_mode)
+        engine = resolve_bq_engine(
+            params.scan_engine, data=index.data, filter_words=None,
+            k=k, dim_ext=index.dim_ext, bits=index.bits,
+            n_probes=n_probes)
         static = {"axis": comms.axis, "mesh": comms.mesh,
-                  "n_probes": n_probes, "k": k, "metric": index.metric,
-                  "probe_mode": probe_mode,
+                  "n_probes": n_probes, "k": k,
+                  "metric": index.metric, "probe_mode": probe_mode,
                   "coarse_algo": params.coarse_algo,
+                  "scan_engine": engine, "epsilon": params.epsilon,
                   "wire_dtype": wire_dtype,
                   "probe_wire_dtype": probe_wire_dtype}
-        arrays = (index.centers, index.rotation, index.codes, index.scales,
-                  index.rnorm2, index.indices)
-        key = ("dist_ivf_bq", bucket, _mesh_key(comms), _sig(*arrays),
+        arrays = (index.centers, index.rotation, index.codes,
+                  index.rnorm, index.cfac, index.errw, index.indices,
+                  index.data, index.data_norms)
+        key = ("dist_ivf_bq", bucket, _mesh_key(comms),
+               _sig(*(a for a in arrays if a is not None)),
+               ("data", index.data is not None),
                tuple(sorted((n, str(v)) for n, v in static.items())),
                _filter_spec(None))
         key, probe = self._probe_plumbing(
             index, "dist_ivf_bq", key,
             sharding=comms.sharding(comms.axis))
+        # rank and xla engines thread the donated per-shard running
+        # state; the Pallas kernel keeps it in VMEM scratch
         return _Plan(key=key, fn=dist_bq._dist_search_bq_fn, static=static,
                      post=arrays, qdim=index.dim, sharded=True,
-                     probe=probe,
+                     probe=probe, has_state=engine != "pallas",
                      qsharding=comms.replicated(),
                      state_sharding=comms.replicated(),
                      payload=("dist_ivf_bq",
                               lambda: dist_ivf.collective_payload_model(
-                                  bucket, k, n_probes, index.n_lists,
-                                  comms.size, wire_dtype, probe_mode,
-                                  probe_wire_dtype)))
+                                  bucket, k, n_probes,
+                                  index.n_lists, comms.size, wire_dtype,
+                                  probe_mode, probe_wire_dtype)))
 
     def _plan_brute_force(self, index, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import brute_force as bf
@@ -1487,20 +1505,33 @@ class SearchExecutor:
 
     def _plan_ivf_bq(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import ivf_bq as m
+        from raft_tpu.ops.bq_scan import resolve_bq_engine
 
         params = params or m.IvfBqSearchParams()
         expect(index.max_list_size > 0, "index is empty — extend() it first")
-        static = {"n_probes": min(params.n_probes, index.n_lists), "k": k,
-                  "metric": index.metric, "coarse_algo": params.coarse_algo}
-        arrays = (index.centers, index.rotation, index.codes, index.scales,
-                  index.rnorm2, index.indices)
-        key = ("ivf_bq", bucket, _sig(*arrays),
+        # the resolved engine joins the static set and therefore the
+        # AOT cache key (same contract as ivf_flat): engine switch =
+        # distinct executable, never a silent reuse
+        n_probes = min(params.n_probes, index.n_lists)
+        engine = resolve_bq_engine(
+            params.scan_engine, data=index.data, filter_words=fw, k=k,
+            dim_ext=index.dim_ext, bits=index.bits, n_probes=n_probes)
+        static = {"n_probes": n_probes, "k": k,
+                  "metric": index.metric, "coarse_algo": params.coarse_algo,
+                  "scan_engine": engine, "epsilon": params.epsilon}
+        arrays = (index.centers, index.rotation, index.codes, index.rnorm,
+                  index.cfac, index.errw, index.indices, index.data,
+                  index.data_norms)
+        key = ("ivf_bq", bucket, _sig(*(a for a in arrays if a is not None)),
+               ("data", index.data is not None),
                tuple(sorted((n, str(v)) for n, v in static.items())),
                _filter_spec(fw))
         key, probe = self._probe_plumbing(index, "ivf_bq", key)
+        # the rank and xla engines thread the donated (q, k) running
+        # state through HBM; the Pallas kernel keeps it in VMEM scratch
         return _Plan(key=key, fn=m._search_impl_fn, static=static,
                      post=arrays, use_filter=True, qdim=index.dim,
-                     probe=probe)
+                     has_state=engine != "pallas", probe=probe)
 
     def _plan_cagra(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import cagra as m

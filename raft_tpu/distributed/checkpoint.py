@@ -61,7 +61,9 @@ from raft_tpu.neighbors.ivf_pq import CodebookKind
 # with a clear version mismatch instead of a shape error mid-parse
 _FLAT_VERSION = 0x4601  # 'F' << 8 | 1
 _PQ_VERSION = 0x5001    # 'P' << 8 | 1
-_BQ_VERSION = 0x4202    # 'B' << 8 | 2 (v2: multi-level scales)
+# v3: RaBitQ corrections (rnorm/cfac/errw), int32 sign words, optional
+# raw-vector rerank plane
+_BQ_VERSION = 0x4203    # 'B' << 8 | 3
 
 
 def _fetch(a) -> np.ndarray:
@@ -186,28 +188,47 @@ def load_pq(res, comms: Comms, fh_or_path) -> DistributedIvfPq:
 
 
 def save_bq(index, fh_or_path) -> None:
-    """Write a sharded IVF-BQ index (sign codes + per-vector scalars)."""
+    """Write a sharded IVF-BQ index (sign codes + RaBitQ correction
+    scalars + the optional raw-vector rerank plane)."""
     fh, own = open_maybe_path(fh_or_path, "wb")
     try:
         with tracing.range("raft_tpu.distributed.checkpoint.save_bq"):
             serialize_scalar(fh, _BQ_VERSION, np.int32)
             serialize_scalar(fh, int(index.metric), np.int32)
             serialize_scalar(fh, index.bits, np.int32)
+            serialize_scalar(fh, int(index.data is not None), np.int32)
             serialize_array(fh, _fetch(index.centers))
             serialize_array(fh, _fetch(index.rotation))
             serialize_array(fh, _fetch(index.codes))
-            serialize_array(fh, _fetch(index.scales))
-            serialize_array(fh, _fetch(index.rnorm2))
+            serialize_array(fh, _fetch(index.rnorm))
+            serialize_array(fh, _fetch(index.cfac))
+            serialize_array(fh, _fetch(index.errw))
             serialize_array(fh, _fetch(index.indices))
             serialize_array(fh, _fetch(index.list_sizes))
+            if index.data is not None:
+                serialize_array(fh, _fetch(index.data))
     finally:
         if own:
             fh.close()
 
 
+def _bq_shard_rel_err(errw, rnorm, indices, dim_ext: int, deal,
+                      r: int) -> tuple:
+    """Re-derive the measured per-shard relative estimator error for
+    the restored deal — the variance-corrected merge's input, via the
+    ONE shared reduction (:func:`raft_tpu.distributed.bq
+    .shard_rel_err_from_arrays` — the statistic the over-fetch
+    calibration constant was measured against)."""
+    from raft_tpu.distributed.bq import shard_rel_err_from_arrays
+
+    return shard_rel_err_from_arrays(errw, rnorm, indices, dim_ext,
+                                     deal, r)
+
+
 def load_bq(res, comms: Comms, fh_or_path):
     """Restore onto ``comms``'s mesh with the shared re-deal (shard
-    count may differ from save time)."""
+    count may differ from save time); the per-shard estimator-error
+    stats re-derive for the new deal."""
     from raft_tpu.distributed.bq import DistributedIvfBq
 
     fh, own = open_maybe_path(fh_or_path, "rb")
@@ -216,11 +237,14 @@ def load_bq(res, comms: Comms, fh_or_path):
                       "distributed ivf_bq")
         metric = DistanceType(int(deserialize_scalar(fh)))
         int(deserialize_scalar(fh))  # bits — recorded; shape-derivable
-        arrays = [deserialize_array(fh) for _ in range(7)]
+        has_data = bool(deserialize_scalar(fh))
+        arrays = [deserialize_array(fh) for _ in range(8)]
+        data = deserialize_array(fh) if has_data else None
     finally:
         if own:
             fh.close()
-    centers, rotation, codes, scales, rn2, indices, sizes = arrays
+    (centers, rotation, codes, rnorm, cfac, errw, indices,
+     sizes) = arrays
     expect(centers.shape[0] % comms.size == 0,
            f"the mesh axis ({comms.size}) must divide n_lists "
            f"{centers.shape[0]}")
@@ -230,17 +254,27 @@ def load_bq(res, comms: Comms, fh_or_path):
     def place(a):
         return jax.device_put(np.ascontiguousarray(a[deal]), shard)
 
+    data_norms = None
+    if has_data:
+        norms = np.sum(np.square(np.asarray(data, np.float32)), axis=2)
+        data_norms = np.where(np.asarray(indices) >= 0, norms, np.inf)
     return DistributedIvfBq(
         comms=comms,
         centers=place(centers),
         rotation=jax.device_put(np.asarray(rotation),
                                 comms.replicated()),
         codes=place(codes),
-        scales=place(scales),
-        rnorm2=place(rn2),
+        rnorm=place(rnorm),
+        cfac=place(cfac),
+        errw=place(errw),
         indices=place(indices),
         list_sizes=place(sizes),
         metric=metric,
+        shard_rel_err=_bq_shard_rel_err(
+            errw, rnorm, indices, rotation.shape[0], deal, comms.size),
+        data=place(data) if has_data else None,
+        data_norms=(place(data_norms.astype(np.float32))
+                    if has_data else None),
     )
 
 
@@ -425,26 +459,53 @@ def load_pq_multihost(res, comms: Comms, dirpath) -> DistributedIvfPq:
 
 
 def save_bq_multihost(index, dirpath) -> None:
-    """Per-process IVF-BQ checkpoint."""
+    """Per-process IVF-BQ checkpoint (v3 fields; the optional rerank
+    plane rides as an extra sharded field flagged in the meta)."""
     with tracing.range("raft_tpu.distributed.checkpoint.save_bq_mh"):
-        _save_parts(dirpath, _BQ_VERSION, index.comms,
-                    [index.centers, index.codes, index.scales,
-                     index.rnorm2, index.indices, index.list_sizes],
-                    meta_scalars=[int(index.metric), index.bits],
+        fields = [index.centers, index.codes, index.rnorm, index.cfac,
+                  index.errw, index.indices, index.list_sizes]
+        if index.data is not None:
+            fields.append(index.data)
+        _save_parts(dirpath, _BQ_VERSION, index.comms, fields,
+                    meta_scalars=[int(index.metric), index.bits,
+                                  int(index.data is not None)],
                     meta_arrays=[index.rotation])
 
 
 def load_bq_multihost(res, comms: Comms, dirpath):
     from raft_tpu.distributed.bq import DistributedIvfBq
 
+    # peek the meta for the rerank-plane flag — it decides the
+    # per-part field count before the parts are read
+    with open(os.path.join(dirpath, "meta.bin"), "rb") as fh:
+        check_version(deserialize_scalar(fh), _BQ_VERSION,
+                      "distributed ivf_bq")
+        int(deserialize_scalar(fh))                 # n_parts
+        int(deserialize_scalar(fh))                 # metric
+        int(deserialize_scalar(fh))                 # bits
+        has_data = bool(deserialize_scalar(fh))
     scalars, metas, fields = _load_parts(
-        dirpath, _BQ_VERSION, "distributed ivf_bq", 6, 2, 1)
-    centers, codes, scales, rn2, indices, sizes = fields
+        dirpath, _BQ_VERSION, "distributed ivf_bq",
+        8 if has_data else 7, 3, 1)
+    (centers, codes, rnorm, cfac, errw, indices,
+     sizes) = fields[:7]
+    data = fields[7] if has_data else None
     place = _deal_place(comms, sizes)
+    rotation = np.asarray(metas[0])
+    deal = deal_order(np.asarray(sizes), comms.size)
+    data_norms = None
+    if has_data:
+        norms = np.sum(np.square(np.asarray(data, np.float32)), axis=2)
+        data_norms = np.where(np.asarray(indices) >= 0, norms,
+                              np.inf).astype(np.float32)
     return DistributedIvfBq(
         comms=comms, centers=place(centers),
-        rotation=jax.device_put(np.asarray(metas[0]),
-                                comms.replicated()),
-        codes=place(codes), scales=place(scales), rnorm2=place(rn2),
-        indices=place(indices), list_sizes=place(sizes),
-        metric=DistanceType(scalars[0]))
+        rotation=jax.device_put(rotation, comms.replicated()),
+        codes=place(codes), rnorm=place(rnorm), cfac=place(cfac),
+        errw=place(errw), indices=place(indices),
+        list_sizes=place(sizes), metric=DistanceType(scalars[0]),
+        shard_rel_err=_bq_shard_rel_err(errw, rnorm, indices,
+                                        rotation.shape[0], deal,
+                                        comms.size),
+        data=place(data) if has_data else None,
+        data_norms=place(data_norms) if has_data else None)

@@ -1,27 +1,50 @@
-"""IVF-BQ — inverted file with 1-bit (binary) quantization, a TPU-first
-index with no reference analog (closest: ``ivf_pq`` with its smallest
-codebooks; the quantizer follows the RaBitQ line of work — sign codes
-under a random rotation with per-vector scalar correction, arXiv
-2405.12497 / the IVF-RaBitQ build in PAPERS.md).
+"""IVF-BQ — inverted file with RaBitQ-grade binary quantization, a
+TPU-first compression family (quantizer follows RaBitQ, arXiv
+2405.12497, and the IVF-RaBitQ build in PAPERS.md: sign codes of the
+per-vector residual under a pinned random rotation, with per-vector
+scalar correction factors that make the distance estimator *unbiased*
+and give it a *known per-candidate error bound*).
 
 Why this exists on TPU: PQ scoring needs per-code LUT lookups — gathers
 (scalar-core serialized) or one-hot/masked-sum workarounds (J-fold FLOP
-inflation). A sign code has no lookup at all:
+inflation). A sign code has no lookup at all. The geometry-aware
+construction (all in the rotated space, ``R`` orthonormal):
 
-    x ≈ c + Rᵀ(a · s),   s = sign(R(x − c)) ∈ {−1, +1}^D
+    r = x − c            (residual against the list centroid)
+    s_l = sign(resid_l)  (level l encodes what levels < l left over)
+    a_l = per-level scale, globally rescaled so ⟨r, Σ a_l s_l⟩ = ‖r‖²
 
-    ||q − x||² ≈ ||q − c||² − 2·a·(q̃ · s) + ||r||²,   q̃ = R(q − c)
+stored per vector as the packed sign words plus three scalars:
 
-so scoring a whole probed list is ONE MXU GEMM of the rotated query
-against the ±1 code matrix (exact in bf16), plus precomputed per-vector
-scalars (per-level scales and the true residual norm ``||r||²``).
-``bits`` stacks residual sign-quantization levels — each level encodes
-what the previous left over and adds D bits + one scale + one GEMM
-term. Measured on 128-dim clustered data with 4x over-fetch + exact
-refine: recall@10 0.81 at 1 bit (16 B codes), 0.96 at 2 bits (32 B),
-0.99 at 3 bits. Codes unpack to ±1 in VMEM right after the HBM gather;
-pair with :func:`raft_tpu.neighbors.refine` the way the reference
-pairs IVF-PQ with refinement.
+    rnorm = ‖r‖          (residual norm)
+    cfac_l = a_l / ‖r‖   (dimensionless code/residual alignment — for
+                          one level this is 1/(√D·⟨r̂, û⟩), the
+                          reciprocal code/residual inner product of
+                          the RaBitQ estimator)
+    errw = ‖r − Σ a_l s_l‖   (unexplained residual norm — the whole
+                              error budget of the estimator)
+
+The estimator  ‖q − x‖² ≈ ‖q − c‖² − 2·Σ_l a_l·⟨q̃, s_l⟩ + ‖r‖²
+(``q̃ = R(q−c)``) is unbiased with per-candidate error
+``2·⟨q̃, r − recon⟩``; under the random rotation the error's standard
+deviation is ``≈ 2·‖q̃‖·errw/√D`` — a *measurable* quantity
+(:func:`estimator_stats`), which is what retires the hand-calibrated
+over-fetch constants (:func:`overfetch_budget`) and powers the fused
+estimate-then-rerank scan (:mod:`raft_tpu.ops.bq_scan`): candidates
+whose estimate minus the bound cannot beat the running k-th exact
+distance are pruned *before* their raw vector is ever read.
+
+Two search modes:
+
+- **fused** (``scan_engine: auto|pallas|xla``, index built with
+  ``store_vectors=True`` — the default): list-major scan that scores
+  packed codes by XOR+popcount and re-ranks surviving rows against the
+  raw vectors of the *same resident block* — returns **exact**
+  distances, no separate ``refine`` pass needed.
+- **estimate-only** (``scan_engine: "rank"``, or any index without the
+  vector plane — e.g. a codes-only streaming build): today's
+  rank-major estimate scan; over-fetch by :func:`overfetch_budget` and
+  re-rank with :func:`raft_tpu.neighbors.refine`.
 
 Supported metrics: L2Expanded / L2SqrtExpanded / InnerProduct.
 """
@@ -29,6 +52,7 @@ Supported metrics: L2Expanded / L2SqrtExpanded / InnerProduct.
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 from typing import Optional, Tuple
 
@@ -61,11 +85,21 @@ from raft_tpu.neighbors._packing import (
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 
-_SERIALIZATION_VERSION = 2  # v2: multi-level (bits > 1) residual codes
+# v3: RaBitQ corrections (rnorm/cfac/errw), int32 sign words, optional
+# raw-vector rerank plane
+_SERIALIZATION_VERSION = 3
 
 # entangled into the pinned rotation stream; bumping it redraws every
 # rotation (and re-derives the estimator-quality expectations)
 _ROTATION_STREAM = 0
+
+# ONE calibration constant for the bound-derived over-fetch budgets —
+# candidates displaced per unit of relative estimator error (measured
+# once against the pinned rotation stream; replaces the three
+# hand-calibrated constants 40/240/60 retired in this PR: derived
+# budgets land at ~38 on the self-hit config, ~41 on the streamed
+# 2-bit config, and k on every index carrying the rerank plane)
+_OVERFETCH_KAPPA = 25.0
 
 
 def _pinned_rotation(seed: int, dim_ext: int, dim: int) -> jax.Array:
@@ -75,8 +109,7 @@ def _pinned_rotation(seed: int, dim_ext: int, dim: int) -> jax.Array:
     partitionable default, key layout). The estimator-quality contracts
     in ``tests/test_ivf_bq.py`` are calibrated against this exact
     stream — a jax upgrade must not silently redraw the rotation every
-    saved BQ index and recall bound was derived under (the ROADMAP's
-    "BQ estimator quality on jax 0.4.x" item)."""
+    saved BQ index and recall bound was derived under."""
     rng = np.random.default_rng(
         np.random.SeedSequence([seed, _ROTATION_STREAM]))
     g = rng.standard_normal((max(dim_ext, dim), dim_ext))
@@ -96,9 +129,14 @@ class IvfBqIndexParams(IndexParams):
     kmeans_trainset_fraction: float = 0.5
     # residual sign-quantization levels (bits/dim, 1..4): level l
     # encodes the residual left by levels < l. Each level adds D bits
-    # and one f32 scale per vector and one more GEMM term to the score;
-    # 2 bits roughly halves the estimator noise of 1 bit.
+    # and one f32 scale per vector and one more popcount term to the
+    # score; 2 bits roughly halves the estimator noise of 1 bit.
     bits: int = 1
+    # keep the raw vectors in list layout next to the codes — the
+    # rerank plane of the fused estimate-then-rerank scan. False =
+    # codes-only (the many-times-HBM streaming regime): searches are
+    # estimate-only and re-rank host-side via neighbors.refine.
+    store_vectors: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -107,29 +145,45 @@ class IvfBqSearchParams(SearchParams):
     # "approx" routes cluster selection through the TPU's native
     # approximate top-k unit (same knob as the flat/PQ params)
     coarse_algo: str = "exact"
+    # probe-scan engine (ops/bq_scan): auto = fused Pallas kernel on
+    # TPU / fused XLA scan elsewhere when the index carries the
+    # rerank plane; "rank" = the legacy rank-major estimate-only scan
+    scan_engine: str = "auto"    # "auto" | "pallas" | "xla" | "rank"
+    # error-bound confidence multiplier for the fused prune (est −
+    # epsilon·sigma must beat the running k-th exact distance to
+    # trigger a re-rank): 3.0 covers ≥ 99% of estimator errors —
+    # measured in tests/test_ivf_bq.py::TestEstimatorContract
+    epsilon: float = 3.0
 
 
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass(frozen=True)
 class IvfBqIndex:
-    """Binary-quantized IVF index."""
+    """Binary-quantized IVF index (RaBitQ construction)."""
 
     centers: jax.Array        # (n_lists, dim) f32
     rotation: jax.Array       # (dim_ext, dim) f32 random orthogonal
-    codes: jax.Array          # (n_lists, max_list_size, bits·dim_ext//8) u8
-    scales: jax.Array         # (n_lists, max_list_size, bits) f32
-    rnorm2: jax.Array         # (n_lists, max_list_size) f32 — ||r||²
+    codes: jax.Array          # (n_lists, max_list_size, bits·D/32) i32
+    rnorm: jax.Array          # (n_lists, max_list_size) f32 — ‖r‖
+    cfac: jax.Array           # (n_lists, max_list_size, bits) f32
+    errw: jax.Array           # (n_lists, max_list_size) f32 — ‖r−recon‖
     indices: jax.Array        # (n_lists, max_list_size) int32, -1 pad
     list_sizes: jax.Array     # (n_lists,) int32
     metric: DistanceType
+    # optional rerank plane (store_vectors=True): raw vectors in list
+    # layout + per-slot squared norms (+inf at padding, like ivf_flat)
+    data: Optional[jax.Array] = None         # (n_lists, max, dim) f32
+    data_norms: Optional[jax.Array] = None   # (n_lists, max) f32
 
     def tree_flatten(self):
-        return (self.centers, self.rotation, self.codes, self.scales,
-                self.rnorm2, self.indices, self.list_sizes), (self.metric,)
+        return (self.centers, self.rotation, self.codes, self.rnorm,
+                self.cfac, self.errw, self.indices, self.list_sizes,
+                self.data, self.data_norms), (self.metric,)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, metric=aux[0])
+        return cls(*children[:8], metric=aux[0], data=children[8],
+                   data_norms=children[9])
 
     @property
     def n_lists(self) -> int:
@@ -145,7 +199,7 @@ class IvfBqIndex:
 
     @property
     def bits(self) -> int:
-        return self.scales.shape[2]
+        return self.cfac.shape[2]
 
     @property
     def max_list_size(self) -> int:
@@ -156,35 +210,46 @@ class IvfBqIndex:
         return int(self.list_sizes.sum())
 
 
-def _pack_bits(signs):
-    """(..., dim_ext) bool (sign >= 0) → (..., dim_ext // 8) uint8,
-    bit b of byte k = component 8k + b."""
-    b = signs.reshape(*signs.shape[:-1], -1, 8).astype(jnp.uint8)
-    weights = (1 << jnp.arange(8, dtype=jnp.uint8))
-    return jnp.sum(b * weights, axis=-1, dtype=jnp.uint8)
+def _pack_words(signs):
+    """(..., dim_ext) bool (sign >= 0) → (..., dim_ext // 32) int32
+    sign words, bit b of word w = component 32w + b. int32 words (not
+    the old uint8 bytes) so the fused kernel's XOR+popcount scoring
+    runs on native VPU lanes."""
+    d = signs.shape[-1]
+    b = signs.reshape(*signs.shape[:-1], d // 32, 32).astype(jnp.int32)
+    weights = jnp.left_shift(
+        jnp.int32(1), jnp.arange(32, dtype=jnp.int32))
+    return jnp.sum(b * weights, axis=-1, dtype=jnp.int32)
 
 
-def _unpack_pm1(bytes_, dtype=jnp.bfloat16):
-    """(..., n_bytes) uint8 → (..., 8·n_bytes) ±1 in ``dtype``."""
-    bits = (bytes_[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+def _unpack_pm1(words, dtype=jnp.bfloat16):
+    """(..., n_words) int32 → (..., 32·n_words) ±1 in ``dtype``."""
+    bits = (words[..., None] >> jnp.arange(32, dtype=jnp.int32)) & 1
     pm1 = bits.astype(dtype) * 2 - 1
-    return pm1.reshape(*bytes_.shape[:-1], bytes_.shape[-1] * 8)
+    return pm1.reshape(*words.shape[:-1], words.shape[-1] * 32)
 
 
 def _encode(rot_residuals, bits: int = 1):
-    """residual r → (packed sign bits per level, scales, ||r||²).
+    """residual r → (packed sign words per level, ‖r‖, per-level
+    dimensionless scales, unexplained-residual norm).
 
     Level 0 sign-encodes r with the least-squares scale ⟨r,s⟩/D; each
     further level encodes what the previous levels left over (residual
-    sign quantization). A final global rescale γ = ||r||² / ⟨r, r̂⟩ is
-    folded into every level's scale so that ⟨r, Σ a_l s_l⟩ = ||r||²
+    sign quantization). A final global rescale γ = ‖r‖² / ⟨r, recon⟩
+    is folded into every level's scale so that ⟨r, Σ a_l s_l⟩ = ‖r‖²
     EXACTLY — the collinearity correction of the RaBitQ estimator,
     which makes the distance estimate of a vector to itself 0 (with a
-    single level this reduces to a = ||r||²/⟨r, s⟩).
+    single level a = ‖r‖²/⟨r, s⟩ = ‖r‖/(√D·⟨r̂, û⟩) — the
+    reciprocal code/residual inner product). The stored scale is
+    ``cfac_l = a_l/‖r‖``; ``errw = ‖r − γ·recon‖`` is the residual
+    the code fails to explain — the estimator's entire error budget
+    (per-candidate error std ≈ 2·‖q̃‖·errw/√D under the rotation).
 
-    Returns codes (..., bits·D/8) u8, scales (..., bits) f32, rn2."""
+    Returns codes (..., bits·D/32) i32, rnorm, cfac (..., bits),
+    errw."""
     d = rot_residuals.shape[-1]
     rn2 = jnp.sum(jnp.square(rot_residuals), axis=-1)
+    rnorm = jnp.sqrt(rn2)
     level_codes, level_scales = [], []
     resid = rot_residuals
     recon = jnp.zeros_like(rot_residuals)
@@ -192,7 +257,7 @@ def _encode(rot_residuals, bits: int = 1):
         signs = resid >= 0
         s = jnp.where(signs, 1.0, -1.0)
         a = jnp.sum(resid * s, axis=-1) / d           # LS scale per level
-        level_codes.append(_pack_bits(signs))
+        level_codes.append(_pack_words(signs))
         level_scales.append(a)
         recon = recon + a[..., None] * s
         resid = resid - a[..., None] * s
@@ -200,17 +265,32 @@ def _encode(rot_residuals, bits: int = 1):
         jnp.sum(rot_residuals * recon, axis=-1), 1e-20)
     codes = jnp.concatenate(level_codes, axis=-1)
     scales = jnp.stack(level_scales, axis=-1) * gamma[..., None]
-    return codes, scales.astype(jnp.float32), rn2.astype(jnp.float32)
+    errw = jnp.linalg.norm(
+        rot_residuals - recon * gamma[..., None], axis=-1)
+    cfac = scales / jnp.maximum(rnorm, 1e-20)[..., None]
+    return (codes, rnorm.astype(jnp.float32), cfac.astype(jnp.float32),
+            errw.astype(jnp.float32))
 
 
-def _pack_lists(codes, scales, rn2, ids, labels, n_lists, max_size,
-                sizes=None):
+def _pack_lists(codes, rnorm, cfac, errw, ids, labels, n_lists,
+                max_size, vectors=None, sizes=None):
     """Scatter rows into the padded [n_lists, max_list_size] layout
-    (the shared sort-and-rank packing)."""
-    (fc, fa, fr, fi), sizes = pack_padded_lists(
-        labels, n_lists, max_size,
-        [(codes, 0), (scales, 0.0), (rn2, 0.0), (ids, -1)], sizes=sizes)
-    return fc, fa, fr, fi, sizes
+    (the shared sort-and-rank packing). ``vectors`` optionally rides
+    along as the rerank plane."""
+    payloads = [(codes, 0), (rnorm, 0.0), (cfac, 0.0), (errw, 0.0),
+                (ids, -1)]
+    if vectors is not None:
+        payloads.append((vectors, 0.0))
+    packed, sizes = pack_padded_lists(labels, n_lists, max_size,
+                                      payloads, sizes=sizes)
+    return packed, sizes
+
+
+def _vector_norms(data, indices):
+    """Per-slot squared norms, +inf at padding so padded slots never
+    win the exact re-rank (the ivf_flat convention)."""
+    norms = jnp.sum(jnp.square(data.astype(jnp.float32)), axis=2)
+    return jnp.where(indices >= 0, norms, jnp.inf)
 
 
 def build(
@@ -218,7 +298,9 @@ def build(
     params: IvfBqIndexParams,
     dataset,
 ) -> IvfBqIndex:
-    """Train coarse centers + random rotation, sign-encode the dataset."""
+    """Train coarse centers + random rotation, RaBitQ-encode the
+    dataset (and, by default, keep the raw vectors as the fused
+    re-rank plane)."""
     res = ensure_resources(res)
     dataset = jnp.asarray(dataset)
     expect(dataset.ndim == 2, "dataset must be (n, d)")
@@ -229,7 +311,7 @@ def build(
                              DistanceType.InnerProduct),
            f"ivf_bq supports L2/L2Sqrt/InnerProduct, got {params.metric!r}")
     expect(1 <= params.bits <= 4, "bits must be in [1, 4]")
-    dim_ext = -(-dim // 8) * 8
+    dim_ext = -(-dim // 32) * 32
 
     with tracing.range("raft_tpu.ivf_bq.build"):
         frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
@@ -252,13 +334,18 @@ def build(
         empty = IvfBqIndex(
             centers=centers, rotation=rotation,
             codes=jnp.zeros((params.n_lists, 0,
-                             params.bits * dim_ext // 8), jnp.uint8),
-            scales=jnp.zeros((params.n_lists, 0, params.bits),
-                             jnp.float32),
-            rnorm2=jnp.zeros((params.n_lists, 0), jnp.float32),
+                             params.bits * dim_ext // 32), jnp.int32),
+            rnorm=jnp.zeros((params.n_lists, 0), jnp.float32),
+            cfac=jnp.zeros((params.n_lists, 0, params.bits),
+                           jnp.float32),
+            errw=jnp.zeros((params.n_lists, 0), jnp.float32),
             indices=jnp.full((params.n_lists, 0), -1, jnp.int32),
             list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
             metric=DistanceType(params.metric),
+            data=(jnp.zeros((params.n_lists, 0, dim), jnp.float32)
+                  if params.store_vectors else None),
+            data_norms=(jnp.zeros((params.n_lists, 0), jnp.float32)
+                        if params.store_vectors else None),
         )
         if not params.add_data_on_build:
             return empty
@@ -275,8 +362,12 @@ def build_streaming(
     """Streamed BQ build over a :class:`raft_tpu.io.BinDataset` — the
     dataset never fully materializes host-side (same three passes as
     the flat/PQ streaming builds: trainset sample → label count →
-    encode + scatter into donated buffers). Only the sign codes and
-    per-vector scalars live in HBM, so datasets many times HBM fit."""
+    encode + scatter into donated buffers). With
+    ``store_vectors=False`` only the sign codes and per-vector scalars
+    live in HBM, so datasets many times HBM fit (searches are then
+    estimate-only — over-fetch by :func:`overfetch_budget` and refine
+    host-side); the default keeps the rerank plane and streams the raw
+    rows into it chunk-by-chunk."""
     res = ensure_resources(res)
     n, dim = source.n_rows, source.dim
     expect(params.n_lists <= n, "n_lists > n_rows")
@@ -299,49 +390,67 @@ def build_streaming(
                                          chunk_rows, params.n_lists)
         max_size = padded_extent(sizes_np)
 
-        # -- pass 3: encode + scatter with donated buffers
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-        def encode_scatter(codes_buf, scales_buf, rn2_buf, idx_buf,
+        # -- pass 3: encode + scatter with donated buffers (the code
+        # planes and, when kept, the rerank plane each thread through
+        # their own donated scatter — state = step(state) discipline)
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+        def encode_scatter(codes_buf, rn_buf, cf_buf, ew_buf, idx_buf,
                            rows, labels, ids, ranks):
             resid = rows - empty.centers[labels]
             rot = resid @ empty.rotation.T
-            codes, scales, rn2 = _encode(rot, params.bits)
+            codes, rnorm, cfac, errw = _encode(rot, params.bits)
             return (codes_buf.at[labels, ranks].set(codes),
-                    scales_buf.at[labels, ranks].set(scales),
-                    rn2_buf.at[labels, ranks].set(rn2),
+                    rn_buf.at[labels, ranks].set(rnorm),
+                    cf_buf.at[labels, ranks].set(cfac),
+                    ew_buf.at[labels, ranks].set(errw),
                     idx_buf.at[labels, ranks].set(ids))
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def scatter_rows(data_buf, rows, labels, ranks):
+            return data_buf.at[labels, ranks].set(rows)
 
         dim_ext = empty.dim_ext
         codes_buf = jnp.zeros(
-            (params.n_lists, max_size, params.bits * dim_ext // 8),
-            jnp.uint8)
-        scales_buf = jnp.zeros((params.n_lists, max_size, params.bits),
-                               jnp.float32)
-        rn2_buf = jnp.zeros((params.n_lists, max_size), jnp.float32)
+            (params.n_lists, max_size, params.bits * dim_ext // 32),
+            jnp.int32)
+        rn_buf = jnp.zeros((params.n_lists, max_size), jnp.float32)
+        cf_buf = jnp.zeros((params.n_lists, max_size, params.bits),
+                           jnp.float32)
+        ew_buf = jnp.zeros((params.n_lists, max_size), jnp.float32)
         idx_buf = jnp.full((params.n_lists, max_size), -1, jnp.int32)
+        data_buf = (jnp.zeros((params.n_lists, max_size, dim),
+                              jnp.float32)
+                    if params.store_vectors else None)
         fill = np.zeros((params.n_lists,), np.int64)
         for first, chunk in source.iter_chunks(chunk_rows):
             interruptible.yield_()  # cancellation point per chunk
             m = chunk.shape[0]
             lab = labels_np[first : first + m]
             ranks = streaming_ranks(lab, fill, params.n_lists)
-            codes_buf, scales_buf, rn2_buf, idx_buf = encode_scatter(
-                codes_buf, scales_buf, rn2_buf, idx_buf,
-                jnp.asarray(chunk, jnp.float32),
-                jnp.asarray(lab),
+            rows = jnp.asarray(chunk, jnp.float32)
+            lab_d = jnp.asarray(lab)
+            ranks_d = jnp.asarray(ranks)
+            codes_buf, rn_buf, cf_buf, ew_buf, idx_buf = encode_scatter(
+                codes_buf, rn_buf, cf_buf, ew_buf, idx_buf, rows, lab_d,
                 jnp.asarray(first + np.arange(m, dtype=np.int32)),
-                jnp.asarray(ranks),
+                ranks_d,
             )
+            if params.store_vectors:
+                data_buf = scatter_rows(data_buf, rows, lab_d, ranks_d)
 
         return IvfBqIndex(
             centers=empty.centers,
             rotation=empty.rotation,
             codes=codes_buf,
-            scales=scales_buf,
-            rnorm2=rn2_buf,
+            rnorm=rn_buf,
+            cfac=cf_buf,
+            errw=ew_buf,
             indices=idx_buf,
             list_sizes=jnp.asarray(sizes_np, jnp.int32),
             metric=DistanceType(params.metric),
+            data=data_buf,
+            data_norms=(_vector_norms(data_buf, idx_buf)
+                        if params.store_vectors else None),
         )
 
 
@@ -370,72 +479,168 @@ def extend(
                     else DistanceType.L2Expanded))
         labels = kmeans_balanced.predict(res, km, index.centers,
                                          new_vectors.astype(jnp.float32))
-        resid = new_vectors.astype(jnp.float32) - index.centers[labels]
+        newf = new_vectors.astype(jnp.float32)
+        resid = newf - index.centers[labels]
         rot = resid @ index.rotation.T                   # (n, dim_ext)
-        codes, scales, rn2 = _encode(rot, index.bits)
+        codes, rnorm, cfac, errw = _encode(rot, index.bits)
+        with_vectors = index.data is not None
 
         if index.max_list_size > 0:
             keep = index.indices.reshape(-1) >= 0
             old_labels = jnp.repeat(
                 jnp.arange(index.n_lists, dtype=jnp.int32),
                 index.max_list_size)
-            nb = index.codes.shape[2]
+            nw = index.codes.shape[2]
             all_codes = jnp.concatenate(
-                [index.codes.reshape(-1, nb)[keep], codes])
-            all_scales = jnp.concatenate(
-                [index.scales.reshape(-1, index.bits)[keep], scales])
-            all_rn2 = jnp.concatenate(
-                [index.rnorm2.reshape(-1)[keep], rn2])
+                [index.codes.reshape(-1, nw)[keep], codes])
+            all_rn = jnp.concatenate(
+                [index.rnorm.reshape(-1)[keep], rnorm])
+            all_cf = jnp.concatenate(
+                [index.cfac.reshape(-1, index.bits)[keep], cfac])
+            all_ew = jnp.concatenate(
+                [index.errw.reshape(-1)[keep], errw])
             all_ids = jnp.concatenate(
                 [index.indices.reshape(-1)[keep], new_indices])
             all_labels = jnp.concatenate([old_labels[keep], labels])
+            all_vecs = None
+            if with_vectors:
+                all_vecs = jnp.concatenate(
+                    [index.data.reshape(-1, index.dim)[keep], newf])
         else:
-            all_codes, all_scales, all_rn2 = codes, scales, rn2
+            all_codes, all_rn, all_cf, all_ew = codes, rnorm, cfac, errw
             all_ids, all_labels = new_indices, labels
+            all_vecs = newf if with_vectors else None
 
         sizes = jax.ops.segment_sum(
             jnp.ones((all_codes.shape[0],), jnp.int32), all_labels,
             num_segments=index.n_lists)
         max_size = padded_extent(sizes)
-        c, a, r, i, s = _pack_lists(all_codes, all_scales, all_rn2,
+        packed, sizes = _pack_lists(all_codes, all_rn, all_cf, all_ew,
                                     all_ids, all_labels, index.n_lists,
-                                    max_size, sizes=sizes)
-        return dataclasses.replace(index, codes=c, scales=a, rnorm2=r,
-                                   indices=i, list_sizes=s)
+                                    max_size, vectors=all_vecs,
+                                    sizes=sizes)
+        c, rn, cf, ew, ids = packed[:5]
+        data = packed[5] if with_vectors else None
+        return dataclasses.replace(
+            index, codes=c, rnorm=rn, cfac=cf, errw=ew, indices=ids,
+            list_sizes=sizes, data=data,
+            data_norms=(_vector_norms(data, ids) if with_vectors
+                        else None))
 
 
-def score_probe(lists, qrot, centers_rot, ip, cn, qnorm, codes, scales,
-                rn2, indices, ip_metric: bool, pad_val, valid=None):
-    """THE per-probe scoring step, shared by the single-chip and
-    distributed searches: gather one probed list per query, unpack the
-    sign codes, one MXU GEMM cross term, estimator assembly. Rows that
-    are padding (or, distributed, probes this shard does not own via
-    ``valid``) score ``pad_val``. Returns ``(dist (q, m), row_ids)``.
+# ---------------------------------------------------------------------------
+# estimator statistics and bound-derived over-fetch budgets
+# ---------------------------------------------------------------------------
+
+
+def estimator_stats(index) -> dict:
+    """Measured estimator-error statistics of one (shard of an) index
+    — the quantities the bound-derived budgets consume. ONE small
+    device fetch; build/plan-time only, never on the dispatch path.
+
+    - ``mean_errw``: mean unexplained-residual norm ‖r − recon‖
+    - ``mean_rnorm2``: mean squared residual norm (the distance scale)
+    - ``rel_err``: 2·mean_errw / (√D · √mean_rnorm2) — the
+      per-candidate distance-error std over the distance scale, the
+      dimensionless knob every budget below is monotone in
+    """
+    ids = index.indices
+    valid = (ids >= 0).astype(jnp.float32)
+    cnt = jnp.maximum(jnp.sum(valid), 1.0)
+    mean_e = jnp.sum(index.errw * valid) / cnt
+    mean_rn2 = jnp.sum(jnp.square(index.rnorm) * valid) / cnt
+    mean_e, mean_rn2 = jax.device_get((mean_e, mean_rn2))
+    mean_e = float(mean_e)
+    mean_rn2 = float(mean_rn2)
+    rel = (2.0 * mean_e / (math.sqrt(index.dim_ext)
+                           * math.sqrt(max(mean_rn2, 1e-20)))
+           if mean_rn2 > 0 else 0.0)
+    return {"mean_errw": mean_e, "mean_rnorm2": mean_rn2,
+            "rel_err": rel, "dim_ext": index.dim_ext}
+
+
+def overfetch_budget(index, k: int, *, confidence: float = 1.0) -> int:
+    """Bound-derived candidate budget for the estimate-only path: how
+    many estimate-ranked candidates to fetch so the true top-k survive
+    the exact re-rank (:func:`raft_tpu.neighbors.refine`).
+
+    ``budget = ceil(k · (1 + confidence·κ·ρ))`` where ``ρ`` is the
+    index's measured relative estimator error
+    (:func:`estimator_stats`) and ``κ`` is the one calibration
+    constant ``_OVERFETCH_KAPPA`` (displacement per unit relative
+    error, measured against the pinned rotation stream) — replacing
+    the three hand-calibrated constants (self-hit 40, sharded merge
+    240, streamed-bits2 60; ``tests/test_ivf_bq.py`` pins derived ≤
+    old at equal recall targets). An index carrying the rerank plane
+    needs no over-fetch at all: the fused scan already returns exact
+    distances, so the budget is ``k``."""
+    expect(k >= 1, "k must be >= 1")
+    if index.data is not None:
+        return k
+    stats = estimator_stats(index)
+    budget = math.ceil(
+        k * (1.0 + confidence * _OVERFETCH_KAPPA * stats["rel_err"]))
+    return max(k, min(budget, index.size))
+
+
+def estimator_margin(qc_norm, rnorm, errw, delta, dim_ext: int,
+                     epsilon: float):
+    """Per-candidate distance-error bound at confidence ``epsilon``
+    (the fused prune's margin; shared with the engines in
+    :mod:`raft_tpu.ops.bq_scan` and the estimator-contract tests).
+
+    Two independent noise sources add in quadrature: the rotation
+    part (the unexplained residual ``errw`` projected on the query
+    direction — std ``‖q̃‖·errw/√D`` under the random rotation) and
+    the query-quantization part (uniform rounding noise of width
+    ``delta`` against the reconstruction, whose squared norm is
+    ``rnorm² + errw²`` by the collinearity rescale). The factor 2 is
+    the cross term's weight in the squared-distance estimator."""
+    recon2 = jnp.square(rnorm) + jnp.square(errw)
+    return 2.0 * epsilon * jnp.sqrt(
+        jnp.square(qc_norm * errw) / dim_ext
+        + jnp.square(delta) * recon2 / 12.0)
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+def score_probe(lists, qrot, centers_rot, ip, cn, qnorm, codes, rnorm,
+                cfac, indices, ip_metric: bool, pad_val, valid=None):
+    """THE per-probe scoring step of the rank-major estimate-only
+    engine, shared by the single-chip and distributed searches: gather
+    one probed list per query, unpack the sign words, one MXU GEMM
+    cross term, estimator assembly. Rows that are padding (or,
+    distributed, probes this shard does not own via ``valid``) score
+    ``pad_val``. Returns ``(dist (q, m), row_ids)``.
 
     Inputs are the probe-invariant precomputations: ``qrot = R q``,
     ``centers_rot = R c`` (L2 only), the coarse-stage ``ip = q·c``
-    matrix and norms (L2 only).
-    """
+    matrix and norms (L2 only)."""
     q = qrot.shape[0]
     qidx = jnp.arange(q)
-    byts = jnp.take(codes, lists, axis=0)          # (q, m, bits·D/8) u8
-    a = jnp.take(scales, lists, axis=0)            # (q, m, bits)
-    bits = a.shape[-1]
-    pm1 = _unpack_pm1(byts)                        # (q, m, bits·D) bf16
+    words = jnp.take(codes, lists, axis=0)       # (q, m, bits·D/32)
+    cf = jnp.take(cfac, lists, axis=0)           # (q, m, bits)
+    rn = jnp.take(rnorm, lists, axis=0)          # (q, m)
+    bits = cf.shape[-1]
+    a = rn[..., None] * cf                       # per-level scales
+    pm1 = _unpack_pm1(words)                     # (q, m, bits·D) bf16
     m = pm1.shape[1]
-    pm1 = pm1.reshape(q, m, bits, -1)              # (q, m, L, D)
-    row_ids = jnp.take(indices, lists, axis=0)     # (q, m)
+    pm1 = pm1.reshape(q, m, bits, -1)            # (q, m, L, D)
+    row_ids = jnp.take(indices, lists, axis=0)   # (q, m)
     if ip_metric:
         # similarity (select_min is False for IP — no negation)
         crosses = jnp.einsum("qd,qmld->qml", qrot.astype(jnp.bfloat16),
                              pm1, preferred_element_type=jnp.float32)
-        base = ip[qidx, lists]                     # q·c from coarse
+        base = ip[qidx, lists]                   # q·c from coarse
         dist = base[:, None] + jnp.sum(a * crosses, axis=-1)
     else:
-        qsub = qrot - centers_rot[lists]           # (q, dim_ext)
+        qsub = qrot - centers_rot[lists]         # (q, dim_ext)
         crosses = jnp.einsum("qd,qmld->qml", qsub.astype(jnp.bfloat16),
                              pm1, preferred_element_type=jnp.float32)
-        r2 = jnp.take(rn2, lists, axis=0)
+        r2 = jnp.square(rn)
         # ||q−c||² from the coarse-stage terms (R is an isometry, so
         # this equals Σ qsub² without re-reducing per probe)
         qc2 = qnorm + cn[lists] - 2.0 * ip[qidx, lists]
@@ -447,17 +652,25 @@ def score_probe(lists, qrot, centers_rot, ip, cn, qnorm, codes, scales,
     return jnp.where(ok, dist, pad_val), row_ids
 
 
-def _search_impl_fn(queries, centers, rotation, codes, scales, rn2, indices,
-                    filter_words, init_d=None, init_i=None,
-                    probe_counts=None, n_valid=None, *, n_probes: int,
-                    k: int, metric: DistanceType, coarse_algo: str = "exact"):
-    """Sign-code probe scan. ``init_d``/``init_i`` optionally provide
-    the (q, k) running-state storage (values are reset here); the
-    serving path donates them so the scan state reuses one HBM
-    allocation. ``probe_counts`` optionally provides the donated
-    (n_lists,) int32 probe-frequency plane (graftgauge): selected
-    probe ids scatter-add into it (rows past ``n_valid`` masked) and
-    the updated plane returns as a third output."""
+def _search_impl_fn(queries, centers, rotation, codes, rnorm, cfac,
+                    errw, indices, data, data_norms, filter_words,
+                    init_d=None, init_i=None, probe_counts=None,
+                    n_valid=None, *, n_probes: int, k: int,
+                    metric: DistanceType, coarse_algo: str = "exact",
+                    scan_engine: str = "rank", epsilon: float = 3.0):
+    """BQ probe scan: coarse select, then either the fused
+    estimate-then-rerank list-major engines (``pallas``/``xla`` —
+    :mod:`raft_tpu.ops.bq_scan`, exact output distances) or the legacy
+    rank-major estimate-only scan (``rank``). ``init_d``/``init_i``
+    optionally provide the (q, k) running-state storage (values are
+    reset here); the serving path donates them (rank and xla engines —
+    the Pallas kernel's state lives in VMEM scratch). ``probe_counts``
+    optionally provides the donated (n_lists,) int32 probe-frequency
+    plane (graftgauge): selected probe ids scatter-add into it (rows
+    past ``n_valid`` masked) and the updated plane returns as a third
+    output. ``scan_engine`` must arrive resolved (via
+    :func:`raft_tpu.ops.bq_scan.resolve_bq_engine`): it is a jit
+    static, so an unresolved ``"auto"`` would fork the compile cache."""
     q, dim = queries.shape
     select_min = is_min_close(metric)
     qf = queries.astype(jnp.float32)
@@ -487,24 +700,39 @@ def _search_impl_fn(queries, centers, rotation, codes, scales, rn2, indices,
     # probe-invariant precomputation: the rotated query never changes,
     # and q̃ = R(q−c) = Rq − (Rc) needs only a rotated-centers table
     qrot = qf @ rotation.T                             # (q, dim_ext)
-    centers_rot = None if ip_metric else centers @ rotation.T
+    centers_rot = centers @ rotation.T
 
-    def step(carry, rank):
-        best_d, best_i = carry
-        lists = probes[:, rank]                        # (q,)
-        dist, row_ids = score_probe(
-            lists, qrot, centers_rot, ip, c_norms, qnorm, codes, scales,
-            rn2, indices, ip_metric, pad_val)
-        if filter_words is not None:
-            bits = test_filter(filter_words, row_ids)
-            dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
-        return merge_topk(best_d, best_i, dist, row_ids, k, select_min), None
+    if scan_engine != "rank":
+        # fused estimate-then-rerank (ops/bq_scan): stream each unique
+        # probed list's codes once, XOR+popcount estimates, exact f32
+        # re-rank of surviving rows from the same resident block
+        from raft_tpu.ops.bq_scan import bq_list_major_scan
 
-    init = (jnp.full((q, k), pad_val, jnp.float32) if init_d is None
-            else jnp.full_like(init_d, pad_val),
-            jnp.full((q, k), -1, jnp.int32) if init_i is None
-            else jnp.full_like(init_i, -1))
-    (best_d, best_i), _ = jax.lax.scan(step, init, jnp.arange(n_probes))
+        best_d, best_i = bq_list_major_scan(
+            qf, qrot, centers_rot, codes, rnorm, cfac, errw, indices,
+            data, data_norms, probes, filter_words, init_d, init_i,
+            k=k, metric=metric, epsilon=epsilon, engine=scan_engine,
+            interpret=jax.default_backend() != "tpu")
+    else:
+        def step(carry, rank):
+            best_d, best_i = carry
+            lists = probes[:, rank]                    # (q,)
+            dist, row_ids = score_probe(
+                lists, qrot, None if ip_metric else centers_rot, ip,
+                c_norms, qnorm, codes, rnorm, cfac, indices, ip_metric,
+                pad_val)
+            if filter_words is not None:
+                bits = test_filter(filter_words, row_ids)
+                dist = jnp.where(bits & (row_ids >= 0), dist, pad_val)
+            return merge_topk(best_d, best_i, dist, row_ids, k,
+                              select_min), None
+
+        init = (jnp.full((q, k), pad_val, jnp.float32) if init_d is None
+                else jnp.full_like(init_d, pad_val),
+                jnp.full((q, k), -1, jnp.int32) if init_i is None
+                else jnp.full_like(init_i, -1))
+        (best_d, best_i), _ = jax.lax.scan(step, init,
+                                           jnp.arange(n_probes))
 
     if metric == DistanceType.L2SqrtExpanded:
         best_d = jnp.where(jnp.isfinite(best_d),
@@ -515,7 +743,8 @@ def _search_impl_fn(queries, centers, rotation, codes, scales, rn2, indices,
 
 
 _search_impl = partial(jax.jit, static_argnames=(
-    "n_probes", "k", "metric", "coarse_algo"))(_search_impl_fn)
+    "n_probes", "k", "metric", "coarse_algo", "scan_engine",
+    "epsilon"))(_search_impl_fn)
 
 
 def search(
@@ -527,9 +756,13 @@ def search(
     sample_filter=None,
     query_tile: int = 4096,
 ) -> Tuple[jax.Array, jax.Array]:
-    """ANN search over sign codes — estimated distances; re-rank with
-    :func:`raft_tpu.neighbors.refine` (fetch 3-5x k here) for high
-    recall, as with IVF-PQ."""
+    """ANN search over RaBitQ codes. With the fused engines (the
+    default on an index carrying the rerank plane) the returned
+    distances are **exact** — estimate-then-rerank happens inside one
+    list-major pass, no separate :func:`raft_tpu.neighbors.refine`
+    needed. On a codes-only index (or ``scan_engine="rank"``) the
+    distances are unbiased estimates: over-fetch by
+    :func:`overfetch_budget` and refine host-side."""
     ensure_resources(res)
     queries = jnp.asarray(queries)
     expect(queries.ndim == 2 and queries.shape[1] == index.dim,
@@ -540,13 +773,21 @@ def search(
            f"coarse_algo must be 'exact' or 'approx', got "
            f"{params.coarse_algo!r}")
     filter_words = resolve_filter_words(sample_filter)
+    from raft_tpu.ops.bq_scan import resolve_bq_engine
+
+    scan_engine = resolve_bq_engine(
+        params.scan_engine, data=index.data, filter_words=filter_words,
+        k=k, dim_ext=index.dim_ext, bits=index.bits,
+        n_probes=n_probes)
     with tracing.range("raft_tpu.ivf_bq.search"):
         def run(qt, fw):
             return _search_impl(
                 qt, index.centers, index.rotation, index.codes,
-                index.scales, index.rnorm2, index.indices, fw,
+                index.rnorm, index.cfac, index.errw, index.indices,
+                index.data, index.data_norms, fw,
                 n_probes=n_probes, k=k, metric=index.metric,
-                coarse_algo=params.coarse_algo)
+                coarse_algo=params.coarse_algo, scan_engine=scan_engine,
+                epsilon=params.epsilon)
 
         return tile_queries(run, queries, filter_words, query_tile)
 
@@ -557,13 +798,17 @@ def save(index: IvfBqIndex, fh_or_path) -> None:
         serialize_scalar(fh, _SERIALIZATION_VERSION, np.int32)
         serialize_scalar(fh, int(index.metric), np.int32)
         serialize_scalar(fh, index.bits, np.int32)
+        serialize_scalar(fh, int(index.data is not None), np.int32)
         serialize_array(fh, index.centers)
         serialize_array(fh, index.rotation)
         serialize_array(fh, index.codes)
-        serialize_array(fh, index.scales)
-        serialize_array(fh, index.rnorm2)
+        serialize_array(fh, index.rnorm)
+        serialize_array(fh, index.cfac)
+        serialize_array(fh, index.errw)
         serialize_array(fh, index.indices)
         serialize_array(fh, index.list_sizes)
+        if index.data is not None:
+            serialize_array(fh, index.data)
     finally:
         if own:
             fh.close()
@@ -577,13 +822,18 @@ def load(res: Optional[Resources], fh_or_path) -> IvfBqIndex:
                       "ivf_bq")
         metric = DistanceType(int(deserialize_scalar(fh)))
         int(deserialize_scalar(fh))  # bits — recorded; shape-derivable
-        arrays = [res.put(deserialize_array(fh)) for _ in range(7)]
+        has_data = bool(deserialize_scalar(fh))
+        arrays = [res.put(deserialize_array(fh)) for _ in range(8)]
+        data = res.put(deserialize_array(fh)) if has_data else None
     finally:
         if own:
             fh.close()
-    centers, rotation, codes, scales, rn2, indices, sizes = map(
-        jnp.asarray, arrays)
+    (centers, rotation, codes, rnorm, cfac, errw, indices,
+     sizes) = map(jnp.asarray, arrays)
+    data = jnp.asarray(data) if has_data else None
     return IvfBqIndex(
-        centers=centers, rotation=rotation, codes=codes, scales=scales,
-        rnorm2=rn2, indices=indices, list_sizes=sizes, metric=metric,
+        centers=centers, rotation=rotation, codes=codes, rnorm=rnorm,
+        cfac=cfac, errw=errw, indices=indices, list_sizes=sizes,
+        metric=metric, data=data,
+        data_norms=_vector_norms(data, indices) if has_data else None,
     )

@@ -4,6 +4,7 @@ Each kernel has an interpret-mode path so the CPU test mesh can validate
 numerics; on TPU hardware they compile to Mosaic.
 """
 
+from raft_tpu.ops.bq_scan import bq_list_major_scan, resolve_bq_engine
 from raft_tpu.ops.fused_topk import fused_knn, select_k_tiles
 from raft_tpu.ops.ivf_scan import (
     list_major_scan,
@@ -12,7 +13,9 @@ from raft_tpu.ops.ivf_scan import (
 )
 
 __all__ = [
+    "bq_list_major_scan",
     "fused_knn",
+    "resolve_bq_engine",
     "select_k_tiles",
     "list_major_scan",
     "resolve_scan_engine",

@@ -81,9 +81,14 @@ class RecallWindow:
     only, one lock, O(pairs-pruned) per operation."""
 
     def __init__(self, window_s: float = 300.0, z: float = 1.96,
-                 decay_half_life_s: Optional[float] = None):
+                 decay_half_life_s: Optional[float] = None,
+                 gauge_prefix: str = "index.recall"):
         self.window_s = window_s
         self.z = z
+        # the published gauge family; a params-sweep leg publishes
+        # under "index.recall.sweep.p<NP>" so the operating point and
+        # the frontier samples stay separate scrape families
+        self.gauge_prefix = gauge_prefix
         # exponential-decay weighting (PR 8 follow-on): a uniform
         # window reacts to sudden index staleness only as old pairs
         # age out; with a half-life each pair's weight is
@@ -167,12 +172,13 @@ class RecallWindow:
         called on every record and by the scrape-time refresh, so the
         estimate's window slides even while no shadows complete."""
         e = self.estimate(now)
+        p = self.gauge_prefix
         tracing.set_gauges({
-            tracing.RECALL_ESTIMATE: e["estimate"],
-            "index.recall.ci_low": e["ci_low"],
-            "index.recall.ci_high": e["ci_high"],
-            "index.recall.window_pairs": float(e["pairs"]),
-            "index.recall.window_trials": float(e["trials"]),
+            f"{p}.estimate": e["estimate"],
+            f"{p}.ci_low": e["ci_low"],
+            f"{p}.ci_high": e["ci_high"],
+            f"{p}.window_pairs": float(e["pairs"]),
+            f"{p}.window_trials": float(e["trials"]),
         })
         return e
 
@@ -203,6 +209,14 @@ class ShadowConfig:
     timeout_s: Optional[float] = 1.0
     window_s: float = 300.0
     max_pending: int = 256
+    # params-sweep shadow sampling (PR 8 follow-on): alternative
+    # n_probes values to re-run sampled submissions at, as EXTRA
+    # background-class legs paired against the same exact truth —
+    # ``index.recall.sweep.p<NP>.*`` then maps the live
+    # recall/latency frontier instead of just the operating point.
+    # Values rotate round-robin across sampled submissions (seeded
+    # sampling keeps the rotation deterministic); () disables.
+    sweep_probes: tuple = ()
 
 
 class ShadowSampler:
@@ -239,6 +253,15 @@ class ShadowSampler:
         self._lock = threading.Lock()
         self._pending: "collections.deque" = collections.deque()
         self.window = RecallWindow(window_s=self.config.window_s)
+        # params-sweep legs: one window per swept n_probes, published
+        # as its own gauge family — together they sample the live
+        # recall side of the recall/latency frontier
+        self.sweep_windows = {
+            int(p): RecallWindow(
+                window_s=self.config.window_s,
+                gauge_prefix=f"index.recall.sweep.p{int(p)}")
+            for p in self.config.sweep_probes}
+        self._sweep_cursor = 0
 
     def submit(self, index, queries, k: int, params=None, **kw):
         """Submit one live request (exactly ``batcher.submit``) and
@@ -254,7 +277,16 @@ class ShadowSampler:
         filtered pair would score healthy traffic against the wrong
         (unfiltered) truth and read as permanent staleness. Such
         submissions count ``index.recall.shadow_skipped`` and the
-        estimate honestly covers unfiltered traffic only."""
+        estimate honestly covers unfiltered traffic only.
+
+        With ``sweep_probes`` configured, a sampled submission also
+        re-runs at ONE alternative ``n_probes`` (round-robin over the
+        sweep values) as an extra background-class leg scored against
+        the same exact truth — the per-value
+        ``index.recall.sweep.p<NP>.*`` windows then map the live
+        recall frontier, not just the operating point. The sweep leg
+        shares the shadow's shed-first discipline; a submission whose
+        ``params`` has no ``n_probes`` knob simply sweeps nothing."""
         handle = self.batcher.submit(index, queries, k, params=params,
                                      **kw)
         with self._lock:
@@ -274,10 +306,30 @@ class ShadowSampler:
             return handle
         tracing.inc_counter(SHADOW_SUBMITTED)
         with self._lock:
-            self._pending.append((handle, shadow, k))
+            self._pending.append((handle, shadow, k, None))
             while len(self._pending) > self.config.max_pending:
                 self._pending.popleft()
                 tracing.inc_counter(SHADOW_DROPPED)
+        if self.sweep_windows and hasattr(params, "n_probes"):
+            with self._lock:
+                order = sorted(self.sweep_windows)
+                probes = order[self._sweep_cursor % len(order)]
+                self._sweep_cursor += 1
+            sweep_params = dataclasses.replace(params, n_probes=probes)
+            try:
+                leg = self.batcher.submit(
+                    index, queries, k, params=sweep_params,
+                    priority=self.config.priority,
+                    timeout_s=self.config.timeout_s)
+            except (Overloaded, ShutDown):
+                tracing.inc_counter(SHADOW_SHED)
+                return handle
+            tracing.inc_counter(SHADOW_SUBMITTED)
+            with self._lock:
+                self._pending.append((leg, shadow, k, probes))
+                while len(self._pending) > self.config.max_pending:
+                    self._pending.popleft()
+                    tracing.inc_counter(SHADOW_DROPPED)
         return handle
 
     @staticmethod
@@ -309,30 +361,37 @@ class ShadowSampler:
                     keep.append(pair)
             self._pending = keep
         resolved = 0
-        for live, shadow, k in done:
+        for live, shadow, k, probes in done:
             if shadow.exception(timeout=0) is not None:
                 # expiry-shed / ladder-rejected / shutdown shadow —
                 # the designed overload behavior, not an error
                 tracing.inc_counter(SHADOW_SHED)
                 continue
             if live.exception(timeout=0) is not None:
-                # the LIVE leg failed (shed/cancelled) — the pair is
-                # unscorable; count it dropped so the lifecycle ledger
-                # keeps summing: submitted == completed + shed + dropped
+                # the LIVE (or sweep) leg failed (shed/cancelled) —
+                # the pair is unscorable; count it dropped so the
+                # lifecycle ledger keeps summing:
+                # submitted == completed + shed + dropped
                 tracing.inc_counter(SHADOW_DROPPED)
                 continue
             hits, trials = self._pair_hits(
                 live.result()[1], shadow.result()[1], k)
-            self.window.record(now, hits, trials)
+            window = (self.window if probes is None
+                      else self.sweep_windows[probes])
+            window.record(now, hits, trials)
             tracing.inc_counter(SHADOW_COMPLETED)
             resolved += 1
         return resolved
 
     def publish(self) -> dict:
         """Scrape-time refresh: resolve finished pairs and re-publish
-        the recall gauges at the clock's now."""
+        the recall gauges (operating point + every sweep window) at
+        the clock's now."""
         self.pump()
-        return self.window.publish(self._clock.now())
+        now = self._clock.now()
+        for w in self.sweep_windows.values():
+            w.publish(now)
+        return self.window.publish(now)
 
 
 class DriftDetector:

@@ -173,6 +173,69 @@ def main():
     except Exception as e:  # noqa: BLE001
         emit("dist_ivf_scan", error=str(e)[:300])
 
+    # ---- fused BQ estimate-then-rerank compiled: on-chip pallas ≡
+    # xla parity on ids + the one-stream byte check (the compiled
+    # fused program's cost_analysis bytes must sit well under the
+    # two-pass estimate + refine programs')
+    try:
+        from raft_tpu.neighbors import ivf_bq
+        from raft_tpu.neighbors.ivf_bq import (
+            IvfBqIndexParams,
+            IvfBqSearchParams,
+        )
+
+        bq_index = ivf_bq.build(None, IvfBqIndexParams(n_lists=64), x)
+        rep = {}
+
+        def compiled_bytes(fn, *args, **kw):
+            comp = jax.jit(fn, static_argnames=tuple(kw)).lower(
+                *args, **kw).compile()
+            ca = comp.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            return float(ca.get("bytes accessed", 0.0))
+
+        sp_p = IvfBqSearchParams(n_probes=16, scan_engine="pallas")
+        sp_x = IvfBqSearchParams(n_probes=16, scan_engine="xla")
+        dp, ip_ = ivf_bq.search(None, sp_p, bq_index, qd, 10)
+        dx, ix = ivf_bq.search(None, sp_x, bq_index, qd, 10)
+        rep["pallas_ids_eq_xla"] = bool(
+            (np.asarray(ip_) == np.asarray(ix)).all())
+        rep["max_d_err_vs_xla"] = float(
+            np.nanmax(np.abs(np.asarray(dp) - np.asarray(dx))))
+        rep["recall_vs_exact"] = float(
+            (np.asarray(ip_) == ref_i).mean())
+        # stream-bytes: compiled fused (pallas) vs the two-pass
+        # estimate-scan + exact-refine alternative
+        from raft_tpu.neighbors.refine import refine as _refine
+
+        fw = None
+        fused_b = compiled_bytes(
+            lambda qq: ivf_bq._search_impl_fn(
+                qq, bq_index.centers, bq_index.rotation,
+                bq_index.codes, bq_index.rnorm, bq_index.cfac,
+                bq_index.errw, bq_index.indices, bq_index.data,
+                bq_index.data_norms, fw, n_probes=16, k=10,
+                metric=bq_index.metric, scan_engine="pallas"), qd)
+        est_b = compiled_bytes(
+            lambda qq: ivf_bq._search_impl_fn(
+                qq, bq_index.centers, bq_index.rotation,
+                bq_index.codes, bq_index.rnorm, bq_index.cfac,
+                bq_index.errw, bq_index.indices, None, None, fw,
+                n_probes=16, k=40, metric=bq_index.metric,
+                scan_engine="rank"), qd)
+        _, cand = ivf_bq.search(
+            None, IvfBqSearchParams(n_probes=16, scan_engine="rank"),
+            bq_index, qd, 40)
+        refine_b = compiled_bytes(
+            lambda qq, cc: _refine(None, xd, qq, cc, 10), qd, cand)
+        rep["fused_bytes"] = fused_b
+        rep["two_pass_bytes"] = est_b + refine_b
+        rep["one_stream"] = bool(fused_b < est_b + refine_b)
+        emit("bq_scan", **rep)
+    except Exception as e:  # noqa: BLE001
+        emit("bq_scan", error=str(e)[:300])
+
     # ---- beam_search compiled vs the XLA engine (same seeds)
     try:
         from raft_tpu.neighbors.cagra import _search_batch

@@ -883,3 +883,52 @@ class TestRaggedScopeProofs:
         # the same source outside the hot set stays quiet
         assert lint_lib(R5_RAGGED_PACKING_VIOLATING, ["R5"],
                         rel="raft_tpu/label/sample.py").ok
+
+
+# PR 10 scope proof: the fused BQ kernel (conditional-DMA pallas_call
+# with an ANY-space operand — ops/bq_scan.py) is inside R4's reach: an
+# undeclared VMEM budget on a bq_scan-shaped kernel is a finding, not
+# a blind spot (the shipped module itself lints clean, suppression
+# snapshot unchanged).
+
+R4_BQ_KERNEL_VIOLATING = '''\
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _bq_kernel(u_ref, q_ref, data_ref, o_ref, vec, sem):
+    o_ref[:] = q_ref[:]
+
+
+def bq_scan(uniq, q, data, interpret=False):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(4,),
+        in_specs=[
+            pl.BlockSpec((8, 128), lambda i, u: (i, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((8, 128), lambda i, u: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 512, 128), jax.numpy.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        _bq_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((32, 128), q.dtype),
+        interpret=interpret,
+    )(uniq, q, data)
+'''
+
+
+class TestBqScanScopeProof:
+    def test_r4_bq_kernel_needs_budget(self):
+        bad = lint_lib(R4_BQ_KERNEL_VIOLATING, ["R4"],
+                       rel="raft_tpu/ops/bq_scan.py")
+        assert "R4" in rules_fired(bad)
+        assert any("vmem" in f.message.lower()
+                   for f in bad.findings), [
+            f.render() for f in bad.findings]

@@ -270,17 +270,19 @@ class TestShardedAnn:
                 queries, k)
 
         sharded = build_sharded(None, build_fn, search_fn, x, n_shards=4)
-        # deep over-fetch before the exact re-rank: 1-bit estimates are
-        # noisy, and the cross-shard merge keeps only estimate-ranked
-        # ids whose noise is per-shard-center dependent. 240 is the
-        # re-derived budget for the pinned rotation stream (120 was
-        # calibrated to an earlier jax's kmeans draws; measured 0.95
-        # at 240 vs 0.83 at 120 here)
-        _, cand = sharded.search(None, q, 240)
+        # each shard's fused scan re-ranks on-chip, so the cross-shard
+        # merge exchanges EXACT distances — the bound-derived budget
+        # collapses to k and the retired hand constant 240 (estimate
+        # noise was per-shard-center dependent) is gone; pin derived
+        # <= retired at a recall target above what 240 measured (0.95)
+        budget = max(ivf_bq.overfetch_budget(s, 10)
+                     for s in sharded.shards)
+        assert budget <= 240, budget
+        _, cand = sharded.search(None, q, budget)
         _, i = refine(None, x, q, cand, 10)
         _, gt_i = brute_force.knn(None, x, q, 10)
         r, _, _ = eval_recall(np.asarray(gt_i), np.asarray(i))
-        assert r >= 0.9, f"sharded bq recall {r}"
+        assert r >= 0.95, f"sharded bq recall {r}"
 
 
 class TestDistributedIvfFlat:

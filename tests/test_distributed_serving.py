@@ -682,3 +682,132 @@ class TestMeshProbeAccounting:
         assert len(planes) == 2
         for plane in planes.values():
             assert plane.sum() == q.shape[0] * 8
+
+
+class TestBqFusedMesh:
+    """RaBitQ IVF-BQ on the mesh (this PR's tentpole): the fused
+    estimate-then-rerank engines run shard-locally, probe_mode=global
+    stays bit-identical to the single-chip index per engine, and the
+    variance-corrected merge hits the 0.99 recall bar at (or under)
+    the budget the flat 2x over-fetch used to burn."""
+
+    @pytest.fixture(scope="class")
+    def bq_pair(self, comms, data):
+        x, _ = data
+        params = ivf_bq.IvfBqIndexParams(n_lists=32)
+        return (ivf_bq.build(None, params, x),
+                dist_bq.build_bq(None, comms, params, x))
+
+    @pytest.mark.parametrize("engine", ["rank", "xla", "pallas", "auto"])
+    def test_bq_bit_identical(self, data, bq_pair, engine):
+        _, q = data
+        single, dist = bq_pair
+        sp = ivf_bq.IvfBqSearchParams(n_probes=8, scan_engine=engine)
+        d0, i0 = ivf_bq.search(None, sp, single, q, 10)
+        d1, i1 = dist_bq.search_bq(None, sp, dist, q, 10)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_four_shard_recall_at_old_half_budget(self):
+        """Acceptance: sharded recall >= 0.99 at <= the old 2x merge
+        over-fetch budget on the 4-shard config. The fused engines
+        exchange EXACT distances, so merge_k collapses to k — half
+        the old 2x wire depth — and recall is limited only by the
+        probe set."""
+        import jax
+
+        from raft_tpu.comms.bootstrap import make_mesh
+        from raft_tpu.comms.comms import Comms
+        from raft_tpu.distributed.bq import merge_overfetch
+        from raft_tpu.neighbors import brute_force
+        from raft_tpu.utils import eval_recall
+
+        rng = np.random.default_rng(23)
+        x = rng.standard_normal((4096, 32)).astype(np.float32)
+        q = rng.standard_normal((64, 32)).astype(np.float32)
+        comms4 = Comms(make_mesh(("data",),
+                                 devices=jax.devices()[:4]), "data")
+        dist = dist_bq.build_bq(
+            None, comms4, ivf_bq.IvfBqIndexParams(n_lists=64), x)
+        merge_k = merge_overfetch(dist, 10)
+        assert merge_k <= 20, merge_k          # old budget was 2x k
+        sp = ivf_bq.IvfBqSearchParams(n_probes=48)
+        _, i = dist_bq.search_bq(None, sp, dist, q, 10)
+        _, gt = brute_force.knn(None, x, q, 10)
+        r, _, _ = eval_recall(np.asarray(gt), np.asarray(i))
+        assert r >= 0.99, r
+
+    def test_estimate_only_variance_corrected_merge(self, comms, data):
+        """A codes-only mesh index over-fetches the merge by the
+        MEASURED per-shard estimator variance (not a flat 2x): the
+        derived depth is recorded per shard at build, and the merged
+        estimate candidates rescue the exact top-k through refine."""
+        from raft_tpu.distributed.bq import merge_overfetch
+        from raft_tpu.neighbors import brute_force
+        from raft_tpu.neighbors.refine import refine
+        from raft_tpu.utils import eval_recall
+
+        x, q = data
+        dist = dist_bq.build_bq(
+            None, comms, ivf_bq.IvfBqIndexParams(
+                n_lists=32, store_vectors=False), x)
+        assert len(dist.shard_rel_err) == N_DEV
+        assert all(v > 0 for v in dist.shard_rel_err)
+        merge_k = merge_overfetch(dist, 10)
+        assert 10 < merge_k <= 240    # bound-derived, not hand-tuned
+        # exhaustive probes isolate the merge budget: recall measures
+        # the candidate depth, not the probe set
+        sp = ivf_bq.IvfBqSearchParams(n_probes=32)
+        _, gt = brute_force.knn(None, x, q, 10)
+
+        def recall_at(depth):
+            _, cand = dist_bq.search_bq(None, sp, dist, q, depth)
+            assert np.asarray(cand).shape[1] == depth
+            _, i = refine(None, x, q, cand, 10)
+            r, _, _ = eval_recall(np.asarray(gt), np.asarray(i))
+            return float(r)
+
+        r_derived = recall_at(merge_k)
+        r_flat2x = recall_at(20)
+        # the measured-variance depth beats the flat 2x it replaced by
+        # a wide margin on the estimator's hardest case (1-bit codes,
+        # unclustered gaussians — residual ≈ the whole vector)
+        assert r_derived >= r_flat2x + 0.2, (r_derived, r_flat2x)
+        assert r_derived >= 0.7, r_derived
+
+    @pytest.mark.parametrize("engine", ["xla", "pallas"])
+    @pytest.mark.parametrize("q_rows", [3, 11, 16])
+    def test_executor_bucketing_invariance(self, data, bq_pair, engine,
+                                           q_rows):
+        _, q = data
+        _, dist = bq_pair
+        sp = ivf_bq.IvfBqSearchParams(n_probes=8, scan_engine=engine)
+        ex = SearchExecutor()
+        d0, i0 = dist_bq.search_bq(None, sp, dist, q[:q_rows], 5)
+        d1, i1 = ex.search(dist, q[:q_rows], 5, params=sp)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_executor_engine_in_cache_key_zero_recompile(self, data,
+                                                         bq_pair):
+        """Engine switch = distinct executable; steady state on one
+        engine = zero recompiles (the new engine static is in the AOT
+        cache key)."""
+        _, q = data
+        _, dist = bq_pair
+        tracing.install_xla_compile_listener()
+        ex = SearchExecutor()
+        sp_x = ivf_bq.IvfBqSearchParams(n_probes=8, scan_engine="xla")
+        for n in (16, 13, 9):
+            ex.search(dist, q[:n], 5, params=sp_x)
+        c0 = ex.stats.compile_count
+        assert c0 == 1
+        backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        for n in (16, 13, 9, 13):
+            ex.search(dist, q[:n], 5, params=sp_x)
+        assert ex.stats.compile_count == c0
+        assert tracing.get_counter(tracing.XLA_COMPILE_COUNT) == backend0
+        sp_p = ivf_bq.IvfBqSearchParams(n_probes=8,
+                                        scan_engine="pallas")
+        ex.search(dist, q, 5, params=sp_p)
+        assert ex.stats.compile_count == c0 + 1

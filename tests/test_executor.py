@@ -602,3 +602,59 @@ class TestRaggedPlans:
         planes = ex.probe_frequencies()
         assert sum(int(v.sum()) for v in planes.values()) == \
             total + 3 * 5
+
+
+class TestBqEngines:
+    """RaBitQ IVF-BQ through the executor: the resolved scan engine
+    is in the AOT cache key (engine switch = distinct executable),
+    each fused engine is bit-identical to the direct search at every
+    bucket occupancy, and steady state stays zero-recompile."""
+
+    @pytest.mark.parametrize("engine", ["pallas", "xla", "rank"])
+    @pytest.mark.parametrize("rows", [16, 13, 9])
+    def test_bit_identity_per_engine(self, data, indexes, engine, rows):
+        _, q = data
+        sp = ivf_bq.IvfBqSearchParams(n_probes=8, scan_engine=engine)
+        ex = SearchExecutor()
+        d1, i1 = ex.search(indexes["ivf_bq"], q[:rows], 5, params=sp)
+        d0, i0 = ivf_bq.search(None, sp, indexes["ivf_bq"], q[:rows], 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_engine_in_cache_key_and_zero_recompile(self, data, indexes):
+        _, q = data
+        tracing.install_xla_compile_listener()
+        ex = SearchExecutor()
+        sp_x = ivf_bq.IvfBqSearchParams(n_probes=8, scan_engine="xla")
+        for n in (16, 13, 9):
+            ex.search(indexes["ivf_bq"], q[:n], 5, params=sp_x)
+        assert ex.stats.compile_count == 1
+        backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        for n in (16, 13, 9, 13, 16):
+            ex.search(indexes["ivf_bq"], q[:n], 5, params=sp_x)
+        assert ex.stats.compile_count == 1
+        assert tracing.get_counter(tracing.XLA_COMPILE_COUNT) == backend0
+        # engine switch compiles a DISTINCT executable (engine is in
+        # the key); epsilon is a static too — both fork deliberately
+        ex.search(indexes["ivf_bq"], q, 5,
+                  params=ivf_bq.IvfBqSearchParams(n_probes=8,
+                                                  scan_engine="pallas"))
+        assert ex.stats.compile_count == 2
+        ex.search(indexes["ivf_bq"], q, 5,
+                  params=ivf_bq.IvfBqSearchParams(n_probes=8,
+                                                  scan_engine="rank"))
+        assert ex.stats.compile_count == 3
+
+    def test_codes_only_index_degrades_to_rank(self, data):
+        """An index without the rerank plane serves through the
+        executor on the estimate-only path (auto resolves to rank),
+        bit-identical to the direct search."""
+        x, q = data
+        idx = ivf_bq.build(None, ivf_bq.IvfBqIndexParams(
+            n_lists=8, store_vectors=False), x)
+        sp = ivf_bq.IvfBqSearchParams(n_probes=8)
+        ex = SearchExecutor()
+        d1, i1 = ex.search(idx, q[:9], 5, params=sp)
+        d0, i0 = ivf_bq.search(None, sp, idx, q[:9], 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))

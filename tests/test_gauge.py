@@ -569,3 +569,106 @@ class TestDriftRebaseline:
         # the next update scores against the fresh baseline cleanly
         det.update(np.asarray(idx2.list_sizes, dtype=np.float64))
         assert det.score == pytest.approx(0.0, abs=1e-9)
+
+
+class TestParamsSweepShadow:
+    """Params-sweep shadow sampling (PR 8 follow-on): sampled
+    submissions re-run at alternative n_probes as extra background
+    legs against the same exact truth, so the recall gauges map the
+    live recall frontier, not just the operating point. ManualClock +
+    seeded sampler + threadless batcher = fully deterministic."""
+
+    def _serve_sweep(self, corpus, sweep, rounds=6, rows=8, k=10):
+        ex = SearchExecutor()
+        clock = ManualClock()
+        b = DynamicBatcher(
+            ex, BatcherConfig(max_wait_s=0.0,
+                              shed=LoadShed(background_priority=100)),
+            clock=clock, start=False)
+        sampler = ShadowSampler(
+            b, corpus["bf"],
+            ShadowConfig(fraction=1.0, seed=3, priority=100,
+                         timeout_s=None, window_s=1e9,
+                         sweep_probes=sweep))
+        p = ivf_flat.IvfFlatSearchParams(n_probes=2)
+        q = corpus["q"]
+        for r in range(rounds):
+            block = q[(r * rows) % 40:(r * rows) % 40 + rows]
+            sampler.submit(corpus["ivf"], block, k, params=p)
+            while b.pump():
+                pass
+        sampler.publish()
+        b.close()
+        return sampler
+
+    def test_sweep_windows_map_the_frontier(self, corpus):
+        metrics.reset()
+        sampler = self._serve_sweep(corpus, sweep=(4, 16))
+        now = sampler._clock.now()
+        # legs rotate round-robin: 6 sampled rounds -> 3 pairs each
+        e4 = sampler.sweep_windows[4].estimate(now)
+        e16 = sampler.sweep_windows[16].estimate(now)
+        assert e4["pairs"] == 3 and e16["pairs"] == 3
+        # deeper probes -> higher recall, and both bracket the
+        # operating point (n_probes=2) from above
+        e_op = sampler.window.estimate(now)
+        assert e_op["pairs"] == 6
+        assert e_op["estimate"] < e4["estimate"] <= e16["estimate"], (
+            e_op["estimate"], e4["estimate"], e16["estimate"])
+        # each sweep window's estimate matches exact recall at ITS
+        # n_probes on ITS sampled blocks (same pairs, same arithmetic)
+        q = corpus["q"]
+        for probes, est, blocks in ((4, e4, (0, 2, 4)),
+                                    (16, e16, (1, 3, 5))):
+            hits = trials = 0
+            pp = ivf_flat.IvfFlatSearchParams(n_probes=probes)
+            for r in blocks:
+                block = q[(r * 8) % 40:(r * 8) % 40 + 8]
+                truth = exact_recall(corpus["ivf"], corpus["bf"],
+                                     block, 10, pp)
+                hits += truth * 8 * 10
+                trials += 8 * 10
+            assert abs(est["estimate"] - hits / trials) <= 0.02
+
+    def test_sweep_gauges_published(self, corpus):
+        metrics.reset()
+        self._serve_sweep(corpus, sweep=(4,))
+        assert tracing.get_gauge(
+            "index.recall.sweep.p4.estimate") > 0.0
+        # a single sweep value gets every sampled round: 6 pairs
+        assert tracing.get_gauge(
+            "index.recall.sweep.p4.window_pairs") == 6.0
+        # the operating-point family is untouched by the sweep legs
+        assert tracing.get_gauge("index.recall.window_pairs") == 6.0
+        # lifecycle ledger still sums per PAIR (live + sweep legs)
+        assert tracing.get_counter("index.recall.shadow_submitted") \
+            == tracing.get_counter("index.recall.shadow_completed") == 12
+
+    def test_sweep_is_deterministic(self, corpus):
+        metrics.reset()
+        s1 = self._serve_sweep(corpus, sweep=(4, 16))
+        e1 = s1.sweep_windows[4].estimate(s1._clock.now())
+        metrics.reset()
+        s2 = self._serve_sweep(corpus, sweep=(4, 16))
+        e2 = s2.sweep_windows[4].estimate(s2._clock.now())
+        assert e1 == e2
+
+    def test_paramsless_submission_sweeps_nothing(self, corpus):
+        """A submission without an n_probes knob (params=None) takes
+        the plain shadow path — no sweep leg, no crash."""
+        metrics.reset()
+        ex = SearchExecutor()
+        b = DynamicBatcher(
+            ex, BatcherConfig(max_wait_s=0.0,
+                              shed=LoadShed(background_priority=100)),
+            clock=ManualClock(), start=False)
+        sampler = ShadowSampler(
+            b, corpus["bf"],
+            ShadowConfig(fraction=1.0, seed=3, priority=100,
+                         timeout_s=None, sweep_probes=(4,)))
+        sampler.submit(corpus["bf"], corpus["q"][:8], 10)
+        while b.pump():
+            pass
+        sampler.pump()
+        b.close()
+        assert tracing.get_counter("index.recall.shadow_submitted") == 1

@@ -168,8 +168,10 @@ class TestPipeline:
         assert abs(r - r2) < 0.12, (r, r2)
 
     def test_bq_build_streaming(self, tmp_path, rng_np):
-        """Streamed BQ build matches the in-memory build's search
-        results (same trainer shapes, same encoding)."""
+        """Streamed codes-only BQ build (the many-times-HBM regime)
+        matches the in-memory build's search results (same trainer
+        shapes, same encoding), with the over-fetch coming from the
+        bound-derived budget instead of the retired hand constant 60."""
         from raft_tpu.io import BinDataset, write_bin
         from raft_tpu.neighbors import ivf_bq
         from raft_tpu.neighbors.refine import refine
@@ -179,21 +181,23 @@ class TestPipeline:
         q = rng_np.standard_normal((16, 32)).astype(np.float32)
         path = tmp_path / "d.fbin"
         write_bin(path, x)
+        params = ivf_bq.IvfBqIndexParams(n_lists=16, bits=2,
+                                         store_vectors=False)
         with BinDataset(path) as ds:
-            index = ivf_bq.build_streaming(
-                None, ivf_bq.IvfBqIndexParams(n_lists=16, bits=2), ds,
-                chunk_rows=1024)
+            index = ivf_bq.build_streaming(None, params, ds,
+                                           chunk_rows=1024)
         assert index.size == 4000 and index.bits == 2
+        assert index.data is None     # codes + scalars only in HBM
 
-        mem = ivf_bq.build(None, ivf_bq.IvfBqIndexParams(
-            n_lists=16, bits=2), x)
+        mem = ivf_bq.build(None, params, x)
         sp = ivf_bq.IvfBqSearchParams(n_probes=16)
-        # 60-wide over-fetch re-derived for the pinned rotation stream:
-        # unclustered gaussians are the estimator's hardest case
-        # (residual ≈ the whole vector), measured 0.98 recall at 60 vs
-        # 0.76 at the old 20
-        _, i1 = ivf_bq.search(None, sp, index, q, 60)
-        _, i2 = ivf_bq.search(None, sp, mem, q, 60)
+        # the bound-derived budget (unclustered gaussians are the
+        # estimator's hardest case — residual ≈ the whole vector)
+        # lands <= the retired constant 60 at the same recall floor
+        budget = ivf_bq.overfetch_budget(index, 10)
+        assert 10 < budget <= 60, budget
+        _, i1 = ivf_bq.search(None, sp, index, q, budget)
+        _, i2 = ivf_bq.search(None, sp, mem, q, budget)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
         # end-to-end recall with refine
@@ -202,6 +206,29 @@ class TestPipeline:
         _, i = refine(None, x, q, i1, 10)
         r, _, _ = eval_recall(gt, np.asarray(i))
         assert r >= 0.8, r
+
+    def test_bq_build_streaming_with_vectors(self, tmp_path, rng_np):
+        """Streaming with store_vectors=True fills the rerank plane
+        chunk-by-chunk — fused search then matches the in-memory
+        index exactly."""
+        from raft_tpu.io import BinDataset, write_bin
+        from raft_tpu.neighbors import ivf_bq
+
+        x = rng_np.standard_normal((2000, 32)).astype(np.float32)
+        q = rng_np.standard_normal((8, 32)).astype(np.float32)
+        path = tmp_path / "dv.fbin"
+        write_bin(path, x)
+        params = ivf_bq.IvfBqIndexParams(n_lists=8)
+        with BinDataset(path) as ds:
+            index = ivf_bq.build_streaming(None, params, ds,
+                                           chunk_rows=512)
+        assert index.data is not None
+        mem = ivf_bq.build(None, params, x)
+        sp = ivf_bq.IvfBqSearchParams(n_probes=8)
+        d1, i1 = ivf_bq.search(None, sp, index, q, 5)
+        d2, i2 = ivf_bq.search(None, sp, mem, q, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
 
     def test_build_streaming_cancellable(self, tmp_path, rng_np):
         """cancel() from another thread interrupts a mid-flight

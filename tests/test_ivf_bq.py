@@ -1,7 +1,10 @@
-"""IVF-BQ tests — the 1-bit sign-quantized index (TPU-first, no
-reference analog; quantizer follows the RaBitQ line). Pattern matches
-the IVF-PQ suite: recall floor with refinement rescue, exhaustive-probe
-sanity, filters, serialization round-trip, packing invariants."""
+"""IVF-BQ tests — the RaBitQ-grade sign-quantized index. Covers the
+geometry-aware construction (word packing, unbiased estimator with a
+measured error bound), the fused estimate-then-rerank engines
+(pallas ≡ xla bit-parity, exact output distances), the bound-derived
+over-fetch budgets that retired the three hand-calibrated constants
+(self-hit 40, sharded merge 240, streamed-bits2 60), filters,
+serialization round-trip, and the estimate-only legacy path."""
 
 
 import numpy as np
@@ -14,8 +17,12 @@ from raft_tpu.neighbors import brute_force, ivf_bq
 from raft_tpu.neighbors.ivf_bq import (
     IvfBqIndexParams,
     IvfBqSearchParams,
-    _pack_bits,
+    _encode,
+    _pack_words,
     _unpack_pm1,
+    estimator_margin,
+    estimator_stats,
+    overfetch_budget,
 )
 from raft_tpu.neighbors.refine import refine
 from raft_tpu.utils import eval_recall
@@ -34,41 +41,155 @@ def dataset():
 
 class TestBitPacking:
     def test_roundtrip(self, rng_np):
-        r = rng_np.standard_normal((7, 48)).astype(np.float32)
-        packed = _pack_bits(jnp.asarray(r) >= 0)
-        assert packed.shape == (7, 6)
+        r = rng_np.standard_normal((7, 64)).astype(np.float32)
+        packed = _pack_words(jnp.asarray(r) >= 0)
+        assert packed.shape == (7, 2)
+        assert packed.dtype == jnp.int32
         pm1 = np.asarray(_unpack_pm1(packed))
         np.testing.assert_array_equal(pm1, np.where(r >= 0, 1.0, -1.0))
 
 
-class TestIvfBqSearch:
-    def test_recall_with_refine(self, dataset):
-        """1-bit codes + 4x over-fetch + exact re-rank hits the same
-        bar as the PQ tests."""
-        x, q = dataset
-        _, gt = brute_force.knn(None, x, q, 10)
-        index = ivf_bq.build(None, IvfBqIndexParams(n_lists=32), x)
-        _, cand = ivf_bq.search(None, IvfBqSearchParams(n_probes=16),
-                                index, q, 40)
-        _, i = refine(None, x, q, cand, 10)
-        r, _, _ = eval_recall(np.asarray(gt), np.asarray(i))
-        assert r >= 0.9, r
+class TestEstimatorContract:
+    """The RaBitQ construction's statistical contracts: unbiasedness
+    and the measured per-candidate error bound — what replaced the
+    calibrated fudge budgets."""
 
-    def test_exhaustive_probes_estimator_quality(self, dataset):
-        """Probing everything isolates the estimator: raw 1-bit recall
-        must clear a coarse floor, refined recall a high one."""
+    def test_collinearity_exact_self_reconstruction(self, rng_np):
+        """⟨r, Σ a_l s_l⟩ = ‖r‖² exactly (the gamma rescale), so a
+        vector's estimated distance to itself is 0 at any bit depth."""
+        r = rng_np.standard_normal((200, 64)).astype(np.float32)
+        for bits in (1, 2):
+            codes, rnorm, cfac, errw = _encode(jnp.asarray(r), bits)
+            pm1 = np.asarray(_unpack_pm1(codes, jnp.float32)).reshape(
+                200, bits, 64)
+            a = (np.asarray(rnorm)[:, None] * np.asarray(cfac))
+            recon = (a[:, :, None] * pm1).sum(axis=1)
+            ip = (r * recon).sum(axis=1)
+            rn2 = (r * r).sum(axis=1)
+            np.testing.assert_allclose(ip, rn2, rtol=1e-4)
+            # errw really is the unexplained residual norm
+            e = np.linalg.norm(r - recon, axis=1)
+            np.testing.assert_allclose(np.asarray(errw), e, rtol=1e-3,
+                                       atol=1e-4)
+
+    def test_unbiased_and_bound_holds(self):
+        """Across seeds: the popcount estimator's signed error is ~0
+        (unbiased), and |error| stays inside estimator_margin at
+        epsilon=3 for >= 97% of candidates (the stated confidence the
+        fused prune relies on)."""
+        from raft_tpu.ops.bq_scan import _estimate_block
+
+        means, covers, scales = [], [], []
+        for seed in range(6):
+            rng = np.random.default_rng(seed)
+            D = 64
+            resid = rng.standard_normal((800, D)).astype(np.float32)
+            qt = rng.standard_normal((4, D)).astype(np.float32)
+            codes, rnorm, cfac, errw = _encode(jnp.asarray(resid), 1)
+            cross, delta = _estimate_block(
+                jnp.asarray(qt), codes, jnp.asarray(rnorm)[None, :],
+                jnp.transpose(jnp.asarray(cfac)), dim_ext=D, bits=1,
+                query_bits=4)
+            exact = qt @ resid.T                          # (4, 800)
+            err = 2.0 * (exact - np.asarray(cross))
+            qcn = np.linalg.norm(qt, axis=1, keepdims=True)
+            m = np.asarray(estimator_margin(
+                jnp.asarray(qcn), jnp.asarray(rnorm)[None, :],
+                jnp.asarray(errw)[None, :], delta, D, 3.0))
+            means.append(err.mean())
+            covers.append((np.abs(err) <= m).mean())
+            scales.append(np.abs(err).mean())
+        # signed mean error two orders below the per-candidate error
+        # scale = unbiased for every practical purpose
+        assert abs(np.mean(means)) < 0.1 * np.mean(scales), (
+            np.mean(means), np.mean(scales))
+        assert min(covers) >= 0.97, covers
+
+    def test_derived_budgets_at_most_retired_constants(self, dataset):
+        """The bound-derived budgets are <= the three hand-calibrated
+        constants they retired, at the same recall targets (the
+        recall legs live in the tests that used each constant:
+        self-hit below, streamed-bits2 in test_io, sharded merge in
+        test_comms)."""
+        x, _ = dataset
+        est_only = ivf_bq.build(None, IvfBqIndexParams(
+            n_lists=16, store_vectors=False), x)
+        b_selfhit = overfetch_budget(est_only, 5)
+        assert 5 < b_selfhit <= 40, b_selfhit          # retired: 40
+
+        rng = np.random.default_rng(42)
+        x2 = rng.standard_normal((4000, 32)).astype(np.float32)
+        bits2 = ivf_bq.build(None, IvfBqIndexParams(
+            n_lists=16, bits=2, store_vectors=False), x2)
+        b_streamed = overfetch_budget(bits2, 10)
+        assert 10 < b_streamed <= 60, b_streamed       # retired: 60
+        # more bits -> tighter measured bound -> smaller relative
+        # over-fetch
+        assert (estimator_stats(bits2)["rel_err"]
+                < estimator_stats(est_only)["rel_err"])
+
+        # an index carrying the rerank plane needs no over-fetch at
+        # all: the fused scan returns exact distances (the sharded
+        # merge's retired 240 collapses to k — recall leg in
+        # test_comms::test_ivf_bq_shards)
+        reranked = ivf_bq.build(None, IvfBqIndexParams(n_lists=16), x)
+        assert overfetch_budget(reranked, 10) == 10
+
+
+class TestIvfBqSearch:
+    def test_fused_recall_no_refine(self, dataset):
+        """The fused engines return exact distances — recall at k
+        directly, no over-fetch, no separate refine pass."""
         x, q = dataset
-        _, gt = brute_force.knn(None, x, q, 10)
+        gt_d, gt = brute_force.knn(None, x, q, 10)
+        index = ivf_bq.build(None, IvfBqIndexParams(n_lists=32), x)
+        d, i = ivf_bq.search(None, IvfBqSearchParams(n_probes=16),
+                             index, q, 10)
+        r, _, _ = eval_recall(np.asarray(gt), np.asarray(i))
+        assert r >= 0.95, r
+        # output distances are exact (match brute force on agreeing ids)
+        match = np.asarray(i) == np.asarray(gt)
+        err = np.abs(np.asarray(d) - np.asarray(gt_d))[match]
+        assert err.max() <= 1e-2, err.max()
+
+    def test_pallas_xla_bit_parity(self, dataset):
+        """The two fused engines agree bit-for-bit (ids AND
+        distances) — one shared estimate/margin/prune code path."""
+        x, q = dataset
+        index = ivf_bq.build(None, IvfBqIndexParams(n_lists=32), x)
+        d_x, i_x = ivf_bq.search(
+            None, IvfBqSearchParams(n_probes=16, scan_engine="xla"),
+            index, q, 10)
+        d_p, i_p = ivf_bq.search(
+            None, IvfBqSearchParams(n_probes=16, scan_engine="pallas"),
+            index, q, 10)
+        np.testing.assert_array_equal(np.asarray(i_p), np.asarray(i_x))
+        np.testing.assert_array_equal(np.asarray(d_p), np.asarray(d_x))
+
+    def test_kernel_interpret_reference(self, dataset):
+        """Direct interpret-mode call of the fused kernel against the
+        XLA engine — the R6 ops-guard reference for bq_scan."""
+        from raft_tpu.ops.bq_scan import bq_list_major_scan
+
+        x, q = dataset
         index = ivf_bq.build(None, IvfBqIndexParams(n_lists=16), x)
-        _, cand = ivf_bq.search(None, IvfBqSearchParams(n_probes=16),
-                                index, q, 150)
-        # 32 bits/vector is a coarse estimator — the raw floor is low
-        # by design; the refined floor is the contract
-        raw, _, _ = eval_recall(np.asarray(gt), np.asarray(cand)[:, :10])
-        assert raw >= 0.2, raw
-        _, i = refine(None, x, q, cand, 10)
-        ref, _, _ = eval_recall(np.asarray(gt), np.asarray(i))
-        assert ref >= 0.95, ref
+        qf = jnp.asarray(q[:8], jnp.float32)
+        qrot = qf @ index.rotation.T
+        crot = index.centers @ index.rotation.T
+        cn = jnp.sum(jnp.square(index.centers), axis=1)
+        ip = qf @ index.centers.T
+        score = -(cn[None, :] - 2.0 * ip)
+        probes = jnp.argsort(-score, axis=1)[:, :8].astype(jnp.int32)
+        args = (qf, qrot, crot, index.codes, index.rnorm, index.cfac,
+                index.errw, index.indices, index.data,
+                index.data_norms, probes)
+        d_p, i_p = bq_list_major_scan(
+            *args, k=5, metric=index.metric, epsilon=3.0,
+            engine="pallas", interpret=True)
+        d_x, i_x = bq_list_major_scan(
+            *args, k=5, metric=index.metric, epsilon=3.0, engine="xla")
+        np.testing.assert_array_equal(np.asarray(i_p), np.asarray(i_x))
+        np.testing.assert_array_equal(np.asarray(d_p), np.asarray(d_x))
 
     def test_inner_product(self, dataset):
         x, q = dataset
@@ -78,26 +199,36 @@ class TestIvfBqSearch:
                                 metric=DistanceType.InnerProduct)
         index = ivf_bq.build(None, IvfBqIndexParams(
             n_lists=16, metric=DistanceType.InnerProduct), xn)
-        # normalized (angular) data has tiny similarity gaps between
-        # neighbors — the 1-bit estimator needs a deep over-fetch there
-        _, cand = ivf_bq.search(None, IvfBqSearchParams(n_probes=16),
-                                index, qn, 200)
-        _, i = refine(None, xn, qn, cand, 10,
-                      metric=DistanceType.InnerProduct)
+        # the fused rerank is exact, so normalized (angular) data's
+        # tiny similarity gaps no longer need a deep over-fetch
+        _, i = ivf_bq.search(None, IvfBqSearchParams(n_probes=16),
+                             index, qn, 10)
         r, _, _ = eval_recall(np.asarray(gt), np.asarray(i))
-        assert r >= 0.9, r
+        assert r >= 0.95, r
 
-    def test_self_hit_after_refine(self, dataset):
-        """An exact dataset point must surface as its own NN after the
-        exact re-rank. Over-fetch re-derived at 40 for the pinned
-        rotation stream (32-bit sign estimates rank a self hit outside
-        the top-20 of 5000 for some perfectly healthy draws — 2x the
-        fetch is the calibrated bound, not a regression)."""
+    def test_self_hit_fused(self, dataset):
+        """An exact dataset point surfaces as its own NN directly —
+        its estimate is exactly 0 (collinearity rescale), so the fused
+        prune always reranks it and the exact distance wins."""
         x, _ = dataset
         q = x[:8]
         index = ivf_bq.build(None, IvfBqIndexParams(n_lists=16), x)
+        d, i = ivf_bq.search(None, IvfBqSearchParams(n_probes=16),
+                             index, q, 5)
+        assert (np.asarray(i)[:, 0] == np.arange(8)).all()
+        assert np.asarray(d)[:, 0].max() <= 1e-3
+
+    def test_self_hit_estimate_only_derived_budget(self, dataset):
+        """The estimate-only path still rescues the self hit with the
+        bound-derived budget (<= the retired constant 40 — the recall
+        leg of the derived-budget contract)."""
+        x, _ = dataset
+        q = x[:8]
+        index = ivf_bq.build(None, IvfBqIndexParams(
+            n_lists=16, store_vectors=False), x)
+        budget = overfetch_budget(index, 5)
         _, cand = ivf_bq.search(None, IvfBqSearchParams(n_probes=16),
-                                index, q, 40)
+                                index, q, budget)
         _, i = refine(None, x, q, cand, 5)
         assert (np.asarray(i)[:, 0] == np.arange(8)).all()
 
@@ -108,20 +239,22 @@ class TestIvfBqSearch:
         index = ivf_bq.build(None, IvfBqIndexParams(n_lists=16), x)
         allowed = Bitset.from_mask(
             jnp.asarray(np.arange(len(x)) % 2 == 0))
-        _, i = ivf_bq.search(None, IvfBqSearchParams(n_probes=16),
-                             index, q, 10, sample_filter=allowed)
-        ids = np.asarray(i)
-        assert (ids[ids >= 0] % 2 == 0).all()
+        for engine in ("pallas", "xla", "rank"):
+            _, i = ivf_bq.search(
+                None, IvfBqSearchParams(n_probes=16, scan_engine=engine),
+                index, q, 10, sample_filter=allowed)
+            ids = np.asarray(i)
+            assert (ids[ids >= 0] % 2 == 0).all(), engine
 
-    def test_ragged_dim_pads_to_bytes(self, rng_np):
-        """dim not a multiple of 8 → rotation pads to dim_ext."""
+    def test_ragged_dim_pads_to_words(self, rng_np):
+        """dim not a multiple of 32 → rotation pads to the int32 word
+        extent."""
         x = rng_np.standard_normal((500, 20)).astype(np.float32)
         index = ivf_bq.build(None, IvfBqIndexParams(n_lists=8), x)
-        assert index.dim_ext == 24
-        assert index.codes.shape[2] == 3
-        _, cand = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
-                                index, x[:4], 20)
-        _, i = refine(None, x, x[:4], cand, 3)
+        assert index.dim_ext == 32
+        assert index.codes.shape[2] == 1
+        _, i = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
+                             index, x[:4], 3)
         assert (np.asarray(i)[:, 0] == np.arange(4)).all()
 
 
@@ -132,10 +265,25 @@ class TestIvfBqLifecycle:
         path = tmp_path / "bq.bin"
         ivf_bq.save(index, path)
         index2 = ivf_bq.load(None, path)
+        assert index2.data is not None
         d1, i1 = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
                                index, q, 10)
         d2, i2 = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
                                index2, q, 10)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+
+    def test_serialization_roundtrip_codes_only(self, dataset, tmp_path):
+        x, q = dataset
+        index = ivf_bq.build(None, IvfBqIndexParams(
+            n_lists=16, store_vectors=False), x)
+        path = tmp_path / "bq_codes.bin"
+        ivf_bq.save(index, path)
+        index2 = ivf_bq.load(None, path)
+        assert index2.data is None
+        _, i1 = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
+                              index, q, 10)
+        _, i2 = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
+                              index2, q, 10)
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
     def test_extend_appends(self, dataset):
@@ -144,10 +292,10 @@ class TestIvfBqLifecycle:
         assert index.size == 4000
         index = ivf_bq.extend(None, index, x[4000:])
         assert index.size == len(x)
+        assert index.data is not None and index.data.shape[2] == 32
         q = x[4000:4008]
-        _, cand = ivf_bq.search(None, IvfBqSearchParams(n_probes=16),
-                                index, q, 20)
-        _, i = refine(None, x, q, cand, 3)
+        _, i = ivf_bq.search(None, IvfBqSearchParams(n_probes=16),
+                             index, q, 3)
         assert (np.asarray(i)[:, 0] == 4000 + np.arange(8)).all()
 
     def test_build_without_data(self, dataset):
@@ -160,37 +308,38 @@ class TestIvfBqLifecycle:
 
 
 class TestMultiBit:
-    def test_more_bits_higher_recall(self, dataset):
-        """Residual levels monotonically improve the raw estimator, and
-        2 bits clears a high refined bar."""
+    def test_more_bits_tighter_estimates(self, dataset):
+        """Residual levels monotonically shrink the measured
+        unexplained residual (the estimator's whole error budget) and
+        the raw estimate-only recall improves with them."""
         x, q = dataset
         _, gt = brute_force.knn(None, x, q, 10)
-        raws = []
+        raws, errs = [], []
         for bits in (1, 2):
-            index = ivf_bq.build(
-                None, IvfBqIndexParams(n_lists=16, bits=bits), x)
+            index = ivf_bq.build(None, IvfBqIndexParams(
+                n_lists=16, bits=bits, store_vectors=False), x)
             assert index.bits == bits
+            errs.append(estimator_stats(index)["rel_err"])
             _, cand = ivf_bq.search(
                 None, IvfBqSearchParams(n_probes=16), index, q, 80)
             raw, _, _ = eval_recall(np.asarray(gt),
                                     np.asarray(cand)[:, :10])
             raws.append(float(raw))
         assert raws[1] > raws[0], raws
-        _, i = refine(None, x, q, cand, 10)
-        r, _, _ = eval_recall(np.asarray(gt), np.asarray(i))
-        assert r >= 0.9, r
+        assert errs[1] < errs[0], errs
 
     def test_bits2_self_distance_zero(self, rng_np):
         """The global collinearity rescale keeps self-estimates exact
-        at every bit depth."""
+        at every bit depth (estimate-only path)."""
         x = rng_np.standard_normal((500, 32)).astype(np.float32)
-        index = ivf_bq.build(None, IvfBqIndexParams(n_lists=8, bits=2), x)
+        index = ivf_bq.build(None, IvfBqIndexParams(
+            n_lists=8, bits=2, store_vectors=False), x)
         d, i = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
                              index, x[:8], 1)
         assert (np.asarray(i)[:, 0] == np.arange(8)).all()
         # exact in f32; the bf16 cross-term cast leaves rounding
         # proportional to the residual energy
-        scale = float(np.asarray(index.rnorm2).max())
+        scale = float(np.square(np.asarray(index.rnorm)).max())
         assert np.abs(np.asarray(d)[:, 0]).max() <= 0.02 * scale
 
     def test_bits2_roundtrip_and_extend(self, rng_np, tmp_path):

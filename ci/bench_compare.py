@@ -163,6 +163,11 @@ SNAPSHOT_FLOORS = {
     "serving.execute.modeled_flops": 0.0,
     "index.probe.dispatches": 0.0,
     "index.probe_freq.accounted": 0.0,
+    # graftflight (PR 11): trace ingestion and incident capture must
+    # stay alive — a refactor that silently disconnects the parser
+    # pipeline or the flight-recorder triggers zeroes these
+    "profiling.captures": 0.0,
+    "incident.bundles": 0.0,
 }
 
 

@@ -86,6 +86,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import functools
 import hashlib
 import threading
 import time
@@ -229,6 +230,42 @@ def _cost_gauge_values(digest: str, cost: dict) -> dict:
         base + "bytes_accessed": cost.get("bytes_accessed", 0.0),
         base + "peak_hbm_bytes": cost.get("peak_hbm_bytes", 0.0),
     }
+
+
+def _named_fn(fn: Callable, name: str) -> Callable:
+    """Wrap ``fn`` under a distinct ``__name__`` so jax names the HLO
+    module after it (``jit_<name>``). Every AOT entry compiles through
+    a digest-derived name (graftflight, PR 11): a profiler trace's
+    ``hlo_module`` arg then maps to exactly ONE resident executable —
+    without this, every bucket/engine specialization of one family
+    shares ``jit__search_impl_fn`` and device time cannot be
+    attributed per executable. ``functools.wraps`` keeps the original
+    signature visible (``__wrapped__``), so static/donate argname
+    resolution is untouched; the name is a pure function of the cache
+    key, so the persistent compilation cache stays stable across
+    restarts."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return fn(*args, **kwargs)
+
+    wrapper.__name__ = name
+    wrapper.__qualname__ = name
+    return wrapper
+
+
+def _module_name(compiled, fallback: str) -> str:
+    """The compiled executable's real HLO module name (what profiler
+    trace events carry in ``hlo_module``); falls back to the
+    ``jit_``-prefixed wrapper name when the backend exposes no module
+    introspection."""
+    try:
+        mods = compiled.runtime_executable().hlo_modules()
+        if mods:
+            return str(mods[0].name)
+    except Exception:  # noqa: BLE001 — introspection must never fail a compile
+        pass
+    return f"jit_{fallback}"
 
 
 def _sds(x) -> Optional[jax.ShapeDtypeStruct]:
@@ -967,8 +1004,13 @@ class SearchExecutor:
             return ent
         self.stats.cache_misses += 1
         tracing.inc_counter("serving.cache_misses")
+        # digest BEFORE compile: the HLO module is named after it
+        # (jit_rt_<family>_<digest>), so a profiler trace's hlo_module
+        # events correlate back to exactly this entry (graftflight)
+        digest = hashlib.sha1(repr(plan.key).encode()).hexdigest()[:12]
         t0 = time.perf_counter()
-        compiled = self._compile(plan, bucket, k)
+        compiled = self._compile(plan, bucket, k,
+                                 module=f"rt_{plan.key[0]}_{digest}")
         dt = time.perf_counter() - t0
         self.stats.compile_count += 1
         tracing.inc_counter("serving.compile_count")
@@ -984,7 +1026,10 @@ class SearchExecutor:
         # executable — so the per-dispatch accounting below is a plain
         # dict read with zero device interaction
         cost = _executable_cost(compiled)
-        digest = hashlib.sha1(repr(plan.key).encode()).hexdigest()[:12]
+        # the compile-time identity graftflight correlates trace events
+        # on: the real module name as the profiler will spell it
+        cost["hlo_module"] = _module_name(
+            compiled, f"rt_{plan.key[0]}_{digest}")
         info = {"family": plan.key[0], "bucket": bucket, "k": k,
                 "compile_seconds": dt, **cost}
         payload_model = None
@@ -1043,14 +1088,16 @@ class SearchExecutor:
                                        info["collective_payload"])
         tracing.set_gauges(vals)
 
-    def _compile(self, plan: _Plan, bucket: int, k: int):
+    def _compile(self, plan: _Plan, bucket: int, k: int,
+                 module: Optional[str] = None):
         donate = ()
         if self.donate:
             if plan.has_state:
                 donate += ("init_d", "init_i")
             if plan.probe is not None:
                 donate += ("probe_counts",)
-        jitted = jax.jit(plan.fn, static_argnames=tuple(plan.static),
+        fn = plan.fn if module is None else _named_fn(plan.fn, module)
+        jitted = jax.jit(fn, static_argnames=tuple(plan.static),
                          donate_argnames=donate)
         sds = _sds_sharded if plan.sharded else _sds
         args = [sds(a) for a in plan.pre]

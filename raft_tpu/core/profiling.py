@@ -1,0 +1,474 @@
+"""graftflight (PR 11) — device-truth attribution from profiler traces.
+
+Every device-side number graftscope publishes before this module is
+*modeled*: mesh phase spans carry ``collective_payload_model`` bytes
+over a shared host-side dispatch window, per-shard straggler timings
+come from a host readiness poll, and achieved GB/s divides modeled
+bytes by host wall-clock. The TPU-KNN roofline methodology (PAPERS.md)
+only means something against *measured* device time — and the
+``/profile`` endpoint (PR 7) already captures traces that nothing in
+the repo reads. This module closes that loop:
+
+1. **Trace ingestion** (:func:`load_trace` / :func:`parse_chrome_trace`)
+   — parse the Chrome-trace JSON a ``jax.profiler`` capture drops in
+   ``profile_dir`` (``plugins/profile/<run>/*.trace.json.gz``) into
+   :class:`DeviceOp` records. A device op is an ``"X"`` event whose
+   args carry ``hlo_module``/``hlo_op`` (the XLA executor's own
+   annotations — python host-thread events and threadpool noise carry
+   neither and are ignored); its device is the trace process name
+   (``/device:TPU:N`` per chip on a mesh, ``/host:CPU`` on the CPU
+   backend), and its ``scope`` is the framework op path when the
+   backend exports one (``tf_op``/``long_name`` — named-scope prefixes
+   like the mesh bodies' ``coarse_select``/``scan``/``merge`` markers
+   land there).
+2. **Correlation** (:func:`correlate` / :func:`attribute`) — ops
+   correlate back to :class:`~raft_tpu.core.executor.SearchExecutor`
+   entries by HLO module name: each AOT compile names its module after
+   the entry's cache-key digest (``jit_rt_<family>_<digest>``), so a
+   trace event maps to exactly one resident executable. The result is
+   MEASURED device seconds per executable, per mesh phase, and per
+   shard (device), plus the invocation count observed in the window.
+3. **Measured supersedes modeled** (:func:`publish`) — with an
+   attribution in hand, ``serving.mesh.{coarse_select,scan,merge}``
+   spans re-emit with ``modeled: False`` and device-measured windows,
+   the straggler gauges recompute from per-device seconds instead of
+   the post-dispatch host poll, and per-executable measured achieved
+   GB/s / GFLOP/s (modeled bytes x invocations / measured device
+   seconds) publish next to the wall-clock-derived numbers — see
+   ``serving.metrics.derived()`` — so the two accountings can disagree
+   visibly.
+
+Everything here is host-side file parsing and registry writes — pure
+stdlib, no jax import, nothing on the dispatch path. Timestamps in the
+re-emitted spans are in the CAPTURE's clock domain (profiler
+microseconds), a third domain next to the batcher clock and wall
+clock; the spans say so via ``source: "profiler"``.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import glob
+import gzip
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from raft_tpu.core import tracing
+
+# lifetime counters (ci/bench_compare.py snapshot floors): ingested
+# captures and the totals the measured/modeled disagreement is read on
+CAPTURES = "profiling.captures"
+DEVICE_OPS = "profiling.device_ops"
+ATTRIBUTED_SECONDS = "serving.attribution.device_seconds"
+ATTRIBUTED_BYTES = "serving.attribution.modeled_bytes"
+ATTRIBUTED_FLOPS = "serving.attribution.modeled_flops"
+
+# the mesh phase markers the distributed search bodies annotate with
+# jax.named_scope — ops whose scope path carries none land in
+# "unattributed" (the CPU backend's chrome export drops op scopes)
+PHASE_MARKERS = ("coarse_select", "scan", "merge")
+UNATTRIBUTED = "unattributed"
+
+# args keys a backend may carry the framework op path under
+_SCOPE_KEYS = ("tf_op", "long_name", "op_name", "scope")
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceOp:
+    """One measured device-op execution from a profiler capture.
+
+    ``device`` is the trace process name (one per chip on a mesh);
+    ``module`` the HLO module (= one compiled executable); ``scope``
+    the framework op path when the backend exports one, else ``""``.
+    Times are seconds in the capture's own clock domain."""
+
+    device: str
+    module: str
+    op: str
+    scope: str
+    start_s: float
+    dur_s: float
+
+    @property
+    def phase(self) -> str:
+        """Mesh phase of this op: the first
+        :data:`PHASE_MARKERS` entry appearing as a path component of
+        ``scope`` (the named-scope markers the distributed search
+        bodies plant), else :data:`UNATTRIBUTED`."""
+        if self.scope:
+            parts = self.scope.split("/")
+            for marker in PHASE_MARKERS:
+                if marker in parts:
+                    return marker
+        return UNATTRIBUTED
+
+
+def trace_snapshot(profile_dir: str) -> Dict[str, float]:
+    """``{path: mtime}`` of every ``*.trace.json[.gz]`` under a
+    ``jax.profiler`` capture directory (the profiler nests runs as
+    ``plugins/profile/<timestamp>/<host>.trace.json.gz``). A caller
+    that is about to run a capture takes this snapshot and resolves
+    the capture's own output with :func:`fresh_trace_file` — the
+    clock-free way to identify the file that capture produced (or
+    learn it produced none), instead of trusting "newest in the dir",
+    which silently substitutes a PREVIOUS capture's data when the
+    fresh one writes no chrome-trace sidecar. Mtimes matter: two
+    captures in the same second share a timestamped run dir and the
+    second OVERWRITES the first's file, so a bare path diff would
+    miss it."""
+    pats = (os.path.join(profile_dir, "plugins", "profile", "*",
+                         "*.trace.json*"),
+            os.path.join(profile_dir, "*.trace.json*"))
+    out: Dict[str, float] = {}
+    for pat in pats:
+        for p in glob.glob(pat):
+            if p.endswith((".trace.json", ".trace.json.gz")):
+                try:
+                    out[p] = os.path.getmtime(p)
+                except OSError:   # raced a cleanup — not a capture
+                    pass
+    return out
+
+
+def fresh_trace_file(profile_dir: str,
+                     before: Dict[str, float]) -> Optional[str]:
+    """The trace file a just-finished capture produced: the newest
+    path that is new — or rewritten — relative to the
+    :func:`trace_snapshot` taken before the capture. None when the
+    capture wrote no chrome trace (the honest answer; see
+    :func:`trace_snapshot` for why stale fallback is a bug)."""
+    now = trace_snapshot(profile_dir)
+    fresh = [p for p, m in now.items() if before.get(p) != m]
+    if not fresh:
+        return None
+    return max(fresh, key=lambda p: now[p])
+
+
+def latest_trace_file(profile_dir: str) -> Optional[str]:
+    """Newest capture trace file under ``profile_dir``, or None when
+    the directory holds no capture yet. For attributing a capture YOU
+    just ran, prefer the :func:`trace_snapshot` /
+    :func:`fresh_trace_file` pair — this entry point is for pointing
+    at whatever a directory already holds."""
+    found = trace_snapshot(profile_dir)
+    if not found:
+        return None
+    return max(found, key=lambda p: found[p])
+
+
+def load_trace(source) -> dict:
+    """Load a Chrome-trace JSON object from ``source``: a parsed dict
+    passes through; a ``.json``/``.json.gz`` file path is read; a
+    directory is treated as a ``jax.profiler`` ``profile_dir`` and its
+    newest capture is taken. Raises ``FileNotFoundError`` for a
+    directory holding no capture."""
+    if isinstance(source, dict):
+        return source
+    path = os.fspath(source)
+    if os.path.isdir(path):
+        found = latest_trace_file(path)
+        if found is None:
+            raise FileNotFoundError(
+                f"no *.trace.json[.gz] capture under {path!r}")
+        path = found
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt") as f:
+            return json.load(f)
+    with open(path) as f:
+        return json.load(f)
+
+
+def parse_chrome_trace(data: dict) -> List[DeviceOp]:
+    """Extract the device ops from one Chrome-trace JSON object.
+
+    Process names come from the ``"M"``/``process_name`` metadata
+    events; a device op is any ``"X"`` event whose args carry
+    ``hlo_module`` (XLA stamps ``hlo_module``/``hlo_op`` on every op
+    it executes — python host-thread events and threadpool markers
+    carry neither and are skipped). Timestamps convert from the
+    trace's microseconds to seconds."""
+    procs: Dict[int, str] = {}
+    events = data.get("traceEvents", [])
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            procs[ev.get("pid")] = ev.get("args", {}).get("name", "")
+    out: List[DeviceOp] = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        module = args.get("hlo_module")
+        if not module:
+            continue
+        scope = ""
+        for key in _SCOPE_KEYS:
+            if args.get(key):
+                scope = str(args[key])
+                break
+        pid = ev.get("pid")
+        out.append(DeviceOp(
+            device=procs.get(pid, f"pid:{pid}"),
+            module=str(module),
+            op=str(args.get("hlo_op", ev.get("name", ""))),
+            scope=scope,
+            start_s=float(ev.get("ts", 0.0)) * 1e-6,
+            dur_s=float(ev.get("dur", 0.0)) * 1e-6,
+        ))
+    return out
+
+
+@dataclasses.dataclass
+class ModuleAttribution:
+    """Measured device truth for ONE resident executable.
+
+    ``device_seconds`` is busy op-time summed over every device that
+    ran the module (the roofline denominator); ``invocations`` the
+    executions observed in the window — the MINIMUM positive
+    per-(device, op) event count: a top-level op runs exactly once
+    per execution, loop-body ops run once per iteration (which is why
+    the maximum wildly overcounts), and conditionally-executed ops
+    can only push the minimum DOWN, making the derived achieved
+    GB/s conservative rather than inflated; ``phase_seconds`` buckets
+    op time by the named-scope mesh phase markers; ``shard_seconds``
+    by device.
+    ``modeled_bytes_per_call``/``flops`` come from the entry's
+    compile-time cost analysis, so measured achieved GB/s is
+    ``bytes x invocations / device_seconds``."""
+
+    digest: str
+    module: str
+    family: str
+    device_seconds: float
+    invocations: int
+    phase_seconds: Dict[str, float]
+    shard_seconds: Dict[str, float]
+    window: Tuple[float, float]
+    modeled_bytes_per_call: float = 0.0
+    modeled_flops_per_call: float = 0.0
+    payload_model: Optional[dict] = None
+
+    @property
+    def mesh(self) -> bool:
+        """Whether this executable is a mesh (sharded) program — the
+        families whose modeled phase spans the measured ones
+        supersede."""
+        return (self.payload_model is not None
+                or self.family.startswith("dist_"))
+
+    def measured_gbps(self) -> float:
+        if self.device_seconds <= 0:
+            return 0.0
+        return (self.modeled_bytes_per_call * self.invocations
+                / self.device_seconds / 1e9)
+
+    def measured_gflops(self) -> float:
+        if self.device_seconds <= 0:
+            return 0.0
+        return (self.modeled_flops_per_call * self.invocations
+                / self.device_seconds / 1e9)
+
+    def to_dict(self) -> dict:
+        return {
+            "digest": self.digest,
+            "module": self.module,
+            "family": self.family,
+            "device_seconds": self.device_seconds,
+            "invocations": self.invocations,
+            "phase_seconds": dict(self.phase_seconds),
+            "shard_seconds": dict(self.shard_seconds),
+            "window": list(self.window),
+            "modeled_bytes_per_call": self.modeled_bytes_per_call,
+            "modeled_flops_per_call": self.modeled_flops_per_call,
+            "measured_gbps": self.measured_gbps(),
+            "measured_gflops": self.measured_gflops(),
+            "mesh": self.mesh,
+        }
+
+
+@dataclasses.dataclass
+class Attribution:
+    """One capture's full correlation result: per-executable measured
+    device truth plus the ops that matched no resident executable
+    (counted, not dropped silently — a capture dominated by
+    unmatched ops means the cost table and the trace disagree about
+    what is resident)."""
+
+    modules: Dict[str, ModuleAttribution]
+    unmatched_modules: Dict[str, float]
+    trace_file: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "modules": {d: m.to_dict() for d, m in self.modules.items()},
+            "unmatched_modules": dict(self.unmatched_modules),
+            "trace_file": self.trace_file,
+        }
+
+
+def correlate(ops: Iterable[DeviceOp], costs: dict) -> Attribution:
+    """Correlate parsed device ops back to executor entries.
+
+    ``costs`` is ``SearchExecutor.executable_costs()`` — each entry
+    carries the ``hlo_module`` name its compile stamped (unique per
+    executable: the module is named after the cache-key digest), plus
+    the modeled per-call bytes/flops and, for mesh entries, the
+    collective payload model the measured phase spans re-attach.
+    Pure function of its inputs — the committed capture fixture pins
+    the whole pipeline byte-exactly."""
+    modmap = {}
+    for digest, info in costs.items():
+        name = info.get("hlo_module")
+        if name:
+            modmap[name] = digest
+    by_module: Dict[str, List[DeviceOp]] = collections.defaultdict(list)
+    unmatched: Dict[str, float] = collections.defaultdict(float)
+    for op in ops:
+        if op.module in modmap:
+            by_module[op.module].append(op)
+        else:
+            unmatched[op.module] += op.dur_s
+    out: Dict[str, ModuleAttribution] = {}
+    for module, mops in by_module.items():
+        digest = modmap[module]
+        info = costs[digest]
+        phase: Dict[str, float] = collections.defaultdict(float)
+        shard: Dict[str, float] = collections.defaultdict(float)
+        op_counts: Dict[tuple, int] = collections.defaultdict(int)
+        total = 0.0
+        t0 = min(op.start_s for op in mops)
+        t1 = max(op.start_s + op.dur_s for op in mops)
+        for op in mops:
+            total += op.dur_s
+            phase[op.phase] += op.dur_s
+            shard[op.device] += op.dur_s
+            op_counts[(op.device, op.op)] += 1
+        out[digest] = ModuleAttribution(
+            digest=digest, module=module,
+            family=str(info.get("family", "")),
+            device_seconds=total,
+            # min, not max: loop-body ops repeat per iteration and
+            # would overcount executions (and inflate measured GB/s)
+            # by the trip count — see the class docstring
+            invocations=min(op_counts.values()),
+            phase_seconds=dict(phase),
+            shard_seconds=dict(shard),
+            window=(t0, t1),
+            modeled_bytes_per_call=float(info.get("bytes_accessed", 0.0)),
+            modeled_flops_per_call=float(info.get("flops", 0.0)),
+            payload_model=info.get("collective_payload"),
+        )
+    return Attribution(modules=out, unmatched_modules=dict(unmatched))
+
+
+def attribute(source, costs: dict) -> Attribution:
+    """The whole ingestion pipeline: load → parse → correlate.
+
+    ``source`` is anything :func:`load_trace` accepts (a profile dir,
+    a trace file, or an already-parsed dict); ``costs`` is the
+    executor's :meth:`executable_costs` table. Bumps the
+    ``profiling.captures`` / ``profiling.device_ops`` lifetime
+    counters — the CI snapshot floor's evidence that trace ingestion
+    stayed alive."""
+    data = load_trace(source)
+    ops = parse_chrome_trace(data)
+    attr = correlate(ops, costs)
+    if isinstance(source, (str, os.PathLike)):
+        path = os.fspath(source)
+        attr.trace_file = (latest_trace_file(path)
+                           if os.path.isdir(path) else path)
+    tracing.inc_counters({CAPTURES: 1.0, DEVICE_OPS: float(len(ops))})
+    return attr
+
+
+def _emit_measured_mesh(att: ModuleAttribution) -> None:
+    """Re-emit one mesh executable's phase + shard spans from measured
+    device time — the ``modeled: False`` counterpart of the modeled
+    spans ``mesh_trace`` records per dispatch.
+
+    Phase spans lay out sequentially from the capture window's start,
+    each covering its mean per-invocation measured duration (attrs
+    carry the totals); the modeled wire bytes ride along from the
+    entry's payload model so Perfetto shows bytes over MEASURED time.
+    Shard spans and the straggler gauges
+    (``serving.mesh.{shard_skew,slowest_shard}``) recompute from mean
+    per-invocation per-device busy seconds — superseding the
+    host-side readiness poll's numbers."""
+    inv = max(att.invocations, 1)
+    t = att.window[0]
+    phase_bytes = {}
+    if att.payload_model:
+        phase_bytes = {
+            "coarse_select": att.payload_model.get("coarse_bytes", 0),
+            "scan": 0,
+            "merge": att.payload_model.get("merge_bytes", 0),
+        }
+    for marker in PHASE_MARKERS + (UNATTRIBUTED,):
+        secs = att.phase_seconds.get(marker, 0.0)
+        if secs <= 0.0:
+            continue
+        mean = secs / inv
+        attrs = {"modeled": False, "source": "profiler",
+                 "family": att.family, "digest": att.digest,
+                 "device_seconds": secs, "invocations": att.invocations}
+        if marker in phase_bytes:
+            attrs["wire_bytes"] = phase_bytes[marker]
+        tracing.record_span(f"serving.mesh.{marker}", t, t + mean,
+                            attrs=attrs)
+        t += mean
+    if att.shard_seconds:
+        timings = [att.shard_seconds[d] / inv
+                   for d in sorted(att.shard_seconds)]
+        tracing.record_mesh_spans(
+            att.family, att.window[0],
+            att.window[0] + max(timings),
+            shard_timings=timings,
+            shard_attrs={"modeled": False, "source": "profiler",
+                         "digest": att.digest},
+            count_dispatch=False)
+
+
+def publish(attr: Attribution) -> dict:
+    """Publish one attribution into the live registries — the
+    "measured supersedes modeled" half of graftflight.
+
+    Per executable: ``serving.executable.<digest>.measured_*`` gauges
+    (device seconds, invocations, achieved GB/s / GFLOP/s from
+    modeled-bytes-over-measured-time — rendered as the labeled
+    ``serving_executable_measured_*{digest=...}`` Prometheus
+    families); mesh executables additionally re-emit their phase and
+    shard spans with ``modeled: False`` (see
+    :func:`_emit_measured_mesh`) — recomputing the straggler gauges
+    from device timings. Process totals land in the
+    ``serving.attribution.*`` counters ``serving.metrics.derived()``
+    divides for the measured achieved-bandwidth columns. Returns
+    ``{digest: measured-stats}``."""
+    out = {}
+    totals = {ATTRIBUTED_SECONDS: 0.0, ATTRIBUTED_BYTES: 0.0,
+              ATTRIBUTED_FLOPS: 0.0}
+    for digest, att in attr.modules.items():
+        base = f"serving.executable.{digest}."
+        stats = {
+            "device_seconds": att.device_seconds,
+            "invocations": att.invocations,
+            "gbps": att.measured_gbps(),
+            "gflops": att.measured_gflops(),
+        }
+        tracing.set_gauges({
+            base + "measured_device_seconds": att.device_seconds,
+            base + "measured_invocations": float(att.invocations),
+            base + "measured_gbps": stats["gbps"],
+            base + "measured_gflops": stats["gflops"],
+        })
+        totals[ATTRIBUTED_SECONDS] += att.device_seconds
+        totals[ATTRIBUTED_BYTES] += (att.modeled_bytes_per_call
+                                     * att.invocations)
+        totals[ATTRIBUTED_FLOPS] += (att.modeled_flops_per_call
+                                     * att.invocations)
+        if att.mesh:
+            _emit_measured_mesh(att)
+        out[digest] = stats
+    if attr.modules:
+        tracing.inc_counters(totals)
+    return out

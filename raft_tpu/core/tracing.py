@@ -626,7 +626,9 @@ def poll_shard_timings(parts, t0: float, *,
 def record_mesh_spans(family: str, t0: float, t1: float, *,
                       trace_ids: Tuple[int, ...] = (),
                       phases: Optional[dict] = None,
-                      shard_timings=None) -> dict:
+                      shard_timings=None,
+                      shard_attrs: Optional[dict] = None,
+                      count_dispatch: bool = True) -> dict:
     """Record one mesh dispatch into the flight recorder: a
     ``serving.mesh.<phase>`` span per entry of ``phases`` (attrs carry
     the modeled per-phase bytes — the phases share the dispatch window
@@ -637,7 +639,13 @@ def record_mesh_spans(family: str, t0: float, t1: float, *,
     output block became ready host-side). The straggler detector
     reduces the timings into the ``serving.mesh.*`` gauges and returns
     its stats. Everything here is host-side deque/dict work — no
-    device interaction, same discipline as every other recorder."""
+    device interaction, same discipline as every other recorder.
+
+    ``shard_attrs`` merges extra attrs onto every shard span —
+    graftflight's measured re-emission marks them ``modeled: False``
+    with ``source: "profiler"`` — and ``count_dispatch=False`` skips
+    the ``serving.mesh.dispatches`` bump (re-attributing already
+    counted dispatches from a capture is not a new dispatch)."""
     for phase, attrs in (phases or {}).items():
         a = dict(attrs or {})
         a["family"] = family
@@ -646,16 +654,19 @@ def record_mesh_spans(family: str, t0: float, t1: float, *,
     stats = straggler_stats(shard_timings or ())
     if shard_timings:
         for s, dt in enumerate(shard_timings):
+            a = {"family": family, "shard": s}
+            if shard_attrs:
+                a.update(shard_attrs)
             record_span("serving.mesh.shard", t0, t0 + float(dt),
-                        trace_ids=trace_ids,
-                        attrs={"family": family, "shard": s})
+                        trace_ids=trace_ids, attrs=a)
         set_gauges({
             MESH_SHARD_SKEW: stats["shard_skew"],
             MESH_SLOWEST_SHARD: float(stats["slowest_shard"]),
             MESH_SHARD_TIME_MAX: stats["max_s"],
             MESH_SHARD_TIME_MEAN: stats["mean_s"],
         })
-        inc_counter("serving.mesh.dispatches")
+        if count_dispatch:
+            inc_counter("serving.mesh.dispatches")
     return stats
 
 

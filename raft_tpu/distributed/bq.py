@@ -261,23 +261,26 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, rnorm, cfac,
         qf = qs.astype(jnp.float32)
         n_local = centers_l.shape[0]
 
-        ip = jax.lax.dot_general(
-            qf, centers_l, (((1,), (1,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32,
-        )
-        if ip_metric:
-            coarse = -ip
-            cn = None
-            qnorm = None
-        else:
-            cn = jnp.sum(jnp.square(centers_l), axis=1)
-            coarse = cn[None, :] - 2.0 * ip
-            qnorm = jnp.sum(jnp.square(qf), axis=1)
+        # graftflight phase markers (see ivf._dist_search_fn): pure
+        # HLO op-path metadata for measured per-phase attribution
+        with jax.named_scope("coarse_select"):
+            ip = jax.lax.dot_general(
+                qf, centers_l, (((1,), (1,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )
+            if ip_metric:
+                coarse = -ip
+                cn = None
+                qnorm = None
+            else:
+                cn = jnp.sum(jnp.square(centers_l), axis=1)
+                coarse = cn[None, :] - 2.0 * ip
+                qnorm = jnp.sum(jnp.square(qf), axis=1)
 
-        local, mine = select_probes_sharded(coarse, n_probes, axis,
-                                            probe_mode, coarse_algo,
-                                            probe_wire_dtype)
+            local, mine = select_probes_sharded(coarse, n_probes, axis,
+                                                probe_mode, coarse_algo,
+                                                probe_wire_dtype)
         if cnt is not None:
             from raft_tpu.ops.ivf_scan import probe_histogram
 
@@ -294,12 +297,13 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, rnorm, cfac,
             from raft_tpu.ops.bq_scan import bq_list_major_scan
 
             masked = jnp.where(mine, local, n_local)
-            best_d, best_i = bq_list_major_scan(
-                qf, qrot, centers_rot, codes_l, rn_l, cf_l, ew_l,
-                ids_l, data_l, dn_l, masked, None, ind, ini,
-                k=k, metric=metric, epsilon=epsilon,
-                engine=scan_engine,
-                interpret=jax.default_backend() != "tpu")
+            with jax.named_scope("scan"):
+                best_d, best_i = bq_list_major_scan(
+                    qf, qrot, centers_rot, codes_l, rn_l, cf_l, ew_l,
+                    ids_l, data_l, dn_l, masked, None, ind, ini,
+                    k=k, metric=metric, epsilon=epsilon,
+                    engine=scan_engine,
+                    interpret=jax.default_backend() != "tpu")
         else:
             def step(carry, rank_i):
                 best_d, best_i = carry
@@ -312,12 +316,14 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, rnorm, cfac,
                                   select_min), None
 
             init = (jnp.full_like(ind, pad_val), jnp.full_like(ini, -1))
-            (best_d, best_i), _ = jax.lax.scan(
-                step, init, jnp.arange(local.shape[1]))
+            with jax.named_scope("scan"):
+                (best_d, best_i), _ = jax.lax.scan(
+                    step, init, jnp.arange(local.shape[1]))
 
-        merged = merge_results_sharded(
-            best_d, best_i, axis, select_min, wire_dtype,
-            smallest_id_ties=scan_engine != "rank")
+        with jax.named_scope("merge"):
+            merged = merge_results_sharded(
+                best_d, best_i, axis, select_min, wire_dtype,
+                smallest_id_ties=scan_engine != "rank")
         if cnt is not None:
             return merged + (cnt,)
         return merged

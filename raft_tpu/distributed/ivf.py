@@ -504,21 +504,28 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
         n_local = centers_l.shape[0]
         qf = qs.astype(jnp.float32)
 
-        # coarse distances to this shard's centers
-        ip = jax.lax.dot_general(
-            qf, centers_l, (((1,), (1,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32,
-        )
-        if metric == DistanceType.InnerProduct:
-            coarse = -ip
-        else:
-            cn = jnp.sum(jnp.square(centers_l), axis=1)
-            coarse = cn[None, :] - 2.0 * ip
+        # graftflight phase markers: each mesh phase runs under a
+        # jax.named_scope so the HLO ops carry coarse_select/scan/
+        # merge in their op paths — a profiler capture then attributes
+        # MEASURED device time per phase (core/profiling.PHASE_MARKERS)
+        # instead of only the modeled byte windows. Pure metadata:
+        # zero ops added, bit-identity and zero-recompile untouched.
+        with jax.named_scope("coarse_select"):
+            # coarse distances to this shard's centers
+            ip = jax.lax.dot_general(
+                qf, centers_l, (((1,), (1,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )
+            if metric == DistanceType.InnerProduct:
+                coarse = -ip
+            else:
+                cn = jnp.sum(jnp.square(centers_l), axis=1)
+                coarse = cn[None, :] - 2.0 * ip
 
-        local, mine = select_probes_sharded(coarse, n_probes, axis,
-                                            probe_mode, coarse_algo,
-                                            probe_wire_dtype)
+            local, mine = select_probes_sharded(coarse, n_probes, axis,
+                                                probe_mode, coarse_algo,
+                                                probe_wire_dtype)
         if cnt is not None:
             from raft_tpu.ops.ivf_scan import probe_histogram
 
@@ -530,11 +537,12 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
             # list streams from HBM once and scores the whole query
             # tile in one MXU GEMM — the PR 2 single-chip engines,
             # unchanged, running inside the shard_map body
-            masked = jnp.where(mine, local, n_local).astype(jnp.int32)
-            best_d, best_i = list_major_scan(
-                qf, data_l, norms_l, ids_l, masked, None, ind, ini,
-                k=k, metric=metric, engine=scan_engine,
-                interpret=interpret)
+            with jax.named_scope("scan"):
+                masked = jnp.where(mine, local, n_local).astype(jnp.int32)
+                best_d, best_i = list_major_scan(
+                    qf, data_l, norms_l, ids_l, masked, None, ind, ini,
+                    k=k, metric=metric, engine=scan_engine,
+                    interpret=interpret)
         else:
             def step(carry, rank_i):
                 best_d, best_i = carry
@@ -558,12 +566,14 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
                                   select_min), None
 
             init = (jnp.full_like(ind, pad_val), jnp.full_like(ini, -1))
-            (best_d, best_i), _ = jax.lax.scan(
-                step, init, jnp.arange(local.shape[1]))
+            with jax.named_scope("scan"):
+                (best_d, best_i), _ = jax.lax.scan(
+                    step, init, jnp.arange(local.shape[1]))
 
-        merged = merge_results_sharded(
-            best_d, best_i, axis, select_min, wire_dtype,
-            smallest_id_ties=scan_engine != "rank")
+        with jax.named_scope("merge"):
+            merged = merge_results_sharded(
+                best_d, best_i, axis, select_min, wire_dtype,
+                smallest_id_ties=scan_engine != "rank")
         if cnt is not None:
             return merged + (cnt,)
         return merged
@@ -899,20 +909,23 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
         n_local = centers_l.shape[0]
         qf = qs.astype(jnp.float32)
 
-        ip = jax.lax.dot_general(
-            qf, centers_l, (((1,), (1,)), ((), ())),
-            precision=jax.lax.Precision.HIGHEST,
-            preferred_element_type=jnp.float32,
-        )
-        if ip_metric:
-            coarse = -ip
-        else:
-            cn = jnp.sum(jnp.square(centers_l), axis=1)
-            coarse = cn[None, :] - 2.0 * ip
+        # graftflight phase markers (see _dist_search_fn): pure HLO
+        # op-path metadata for measured per-phase device attribution
+        with jax.named_scope("coarse_select"):
+            ip = jax.lax.dot_general(
+                qf, centers_l, (((1,), (1,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST,
+                preferred_element_type=jnp.float32,
+            )
+            if ip_metric:
+                coarse = -ip
+            else:
+                cn = jnp.sum(jnp.square(centers_l), axis=1)
+                coarse = cn[None, :] - 2.0 * ip
 
-        local, mine = select_probes_sharded(coarse, n_probes, axis,
-                                            probe_mode, coarse_algo,
-                                            probe_wire_dtype)
+            local, mine = select_probes_sharded(coarse, n_probes, axis,
+                                                probe_mode, coarse_algo,
+                                                probe_wire_dtype)
         if cnt is not None:
             from raft_tpu.ops.ivf_scan import probe_histogram
 
@@ -964,8 +977,9 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
                                           k), None
 
             init = (jnp.full_like(ind, jnp.inf), jnp.full_like(ini, -1))
-            (best_d, best_i), _ = jax.lax.scan(
-                step, init, unique_lists(masked, n_local))
+            with jax.named_scope("scan"):
+                (best_d, best_i), _ = jax.lax.scan(
+                    step, init, unique_lists(masked, n_local))
             if not select_min:
                 best_d = -best_d
         else:
@@ -981,12 +995,14 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
                                   select_min), None
 
             init = (jnp.full_like(ind, pad_val), jnp.full_like(ini, -1))
-            (best_d, best_i), _ = jax.lax.scan(
-                step, init, jnp.arange(local.shape[1]))
+            with jax.named_scope("scan"):
+                (best_d, best_i), _ = jax.lax.scan(
+                    step, init, jnp.arange(local.shape[1]))
 
-        merged = merge_results_sharded(
-            best_d, best_i, axis, select_min, wire_dtype,
-            smallest_id_ties=scan_engine != "rank")
+        with jax.named_scope("merge"):
+            merged = merge_results_sharded(
+                best_d, best_i, axis, select_min, wire_dtype,
+                smallest_id_ties=scan_engine != "rank")
         if cnt is not None:
             return merged + (cnt,)
         return merged

@@ -28,6 +28,13 @@ letting tail latency or overload take the service down:
   snapshot, the span flight recorder as Chrome trace-event JSON for
   Perfetto overlays (``?trace_id=`` per-request filter), and a gated
   on-demand ``/profile`` capture.
+- :mod:`~raft_tpu.serving.flight` — :class:`FlightRecorder` (PR 11
+  graftflight): SLO-triggered incident capture — the multiburn alert
+  or a latency anomaly arms a short, rate-limited automatic profiler
+  capture whose parsed device-truth attribution
+  (:mod:`raft_tpu.core.profiling`) lands with the span ring, metrics
+  snapshot, cost table, and shed rung as an on-disk incident bundle,
+  retrievable at ``/incident.json``.
 
 graftscope v2 (PR 7) additions: deadline-SLO attainment counters and
 a sliding-window burn-rate gauge (:class:`~raft_tpu.serving.metrics
@@ -48,6 +55,11 @@ from raft_tpu.serving.batcher import (
     DynamicBatcher,
 )
 from raft_tpu.serving.exporter import MetricsExporter
+from raft_tpu.serving.flight import (
+    FlightConfig,
+    FlightRecorder,
+    LatencyAnomaly,
+)
 from raft_tpu.serving.gauge import (
     DriftDetector,
     IndexGauge,
@@ -79,7 +91,10 @@ __all__ = [
     "DeadlineExceeded",
     "DriftDetector",
     "DynamicBatcher",
+    "FlightConfig",
+    "FlightRecorder",
     "IndexGauge",
+    "LatencyAnomaly",
     "LoadShed",
     "MetricsExporter",
     "MultiBurnAlert",

@@ -521,6 +521,13 @@ class DynamicBatcher:
             return
         t2 = self._clock.now()
         metrics.observe_stage(metrics.EXECUTE, t2 - t1)
+        # per-params-class latency (graftflight satellite): the class
+        # label pairs this histogram with the params-sweep recall
+        # gauges (index.recall.sweep.p<NP>) — a coalesced batch shares
+        # one params object, so one observation covers the batch
+        cls = metrics.params_class(rep.params)
+        if cls is not None:
+            metrics.observe_execute_class(cls, t2 - t1)
         tracing.record_span("serving.execute", t1, t2, trace_ids=ids,
                             attrs={"requests": len(reqs), "rows": n_rows})
         delivered = [r.handle._set_result(d, i)
@@ -605,6 +612,13 @@ class DynamicBatcher:
             return
         t2 = self._clock.now()
         metrics.observe_stage(metrics.EXECUTE, t2 - t1)
+        # ragged tiles pack MIXED n_probes under one class: the shared
+        # execute latency lands once in each distinct class present,
+        # so every sweep operating point keeps a latency axis
+        for cls in dict.fromkeys(
+                metrics.params_class(p) for p in params_list):
+            if cls is not None:
+                metrics.observe_execute_class(cls, t2 - t1)
         tracing.record_span("serving.execute", t1, t2, trace_ids=ids,
                             attrs={"requests": len(ids), "rows": n_rows,
                                    "ragged": True})

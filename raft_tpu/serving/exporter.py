@@ -26,7 +26,15 @@ Endpoints:
   400 (no silent clamping — an operator asking for 120 s should learn
   the cap, not get a shorter capture than requested). Fetch
   ``/trace.json`` for the same window and open both in Perfetto — the
-  automated version of the overlay recipe.
+  automated version of the overlay recipe. The response carries the
+  capture's ``trace_file`` path (PR 11), so graftflight and operators
+  can find what was just captured.
+- ``/incident.json`` — the latest graftflight incident bundle (PR 11):
+  parsed device-truth attribution + span-ring snapshot + metrics
+  snapshot + cost table + live shed rung, produced automatically when
+  the multiburn alert or the latency-anomaly check fires (404 while no
+  incident has been captured, or no :class:`~raft_tpu.serving.flight
+  .FlightRecorder` is attached).
 - ``/healthz`` — liveness probe.
 
 Prometheus label support (PR 7): the per-executable cost gauges render
@@ -87,6 +95,12 @@ _HEALTH_GAUGE = re.compile(
     r"^index\.health\.([^.]+)\.([a-z0-9_]+)$")
 _DRIFT_GAUGE = re.compile(
     r"^index\.drift\.([^.]+)\.(score|alert|rebaselines)$")
+# per-params-class latency histograms (PR 11 graftflight satellite):
+# serving.batcher.execute_seconds.p<NP> renders as the base family
+# with a params_class label, pairing the sweep recall gauges
+# (index.recall.sweep.p<NP>) with a latency axis
+_HIST_CLASS = re.compile(
+    r"^(serving\.batcher\.[a-z0-9_]+_seconds)\.(p[0-9]+)$")
 
 # HELP text per family prefix (longest match wins; the generic
 # fallback keeps every family carrying *a* HELP line — the exposition
@@ -99,7 +113,11 @@ _HELP_PREFIXES = (
     ("serving.execute.", "executor dispatch accounting"),
     ("serving.mesh.", "mesh straggler attribution"),
     ("serving.slo.", "deadline-SLO attainment and burn rate"),
+    ("serving.attribution.", "graftflight measured device-time "
+                             "attribution totals"),
     ("serving.", "serving-path metric"),
+    ("profiling.", "graftflight profiler-trace ingestion"),
+    ("incident.", "graftflight incident-capture flight recorder"),
     ("index.probe_freq.", "graftgauge per-list probe-frequency "
                           "accounting"),
     ("index.probe.", "graftgauge probe-accounting dispatch heartbeat"),
@@ -232,17 +250,34 @@ def render_prometheus(counters: dict, gauges: dict, histograms: dict,
         emit_family(pn, "gauge", fam["help"])
         for labels, v in sorted(fam["samples"]):
             lines.append(f"{pn}{{{labels}}} {_fmt(v)}")
+    # histograms group into families first: a params-class variant
+    # (serving.batcher.execute_seconds.p<NP>) becomes a LABELED sample
+    # set of its base family — HELP/TYPE must be emitted once per
+    # family, never once per label value (the exposition grammar the
+    # line-by-line scrape test enforces)
+    hist_fams: dict = {}
     for name in sorted(histograms):
-        snap = histograms[name]
-        pn = prom_name(name)
-        emit_family(pn, "histogram", name)
-        bounds = snap.get("bucket_bounds", [])
-        cumulative = snap.get("bucket_counts", [])
-        for le, c in zip(bounds, cumulative):
-            lines.append(f'{pn}_bucket{{le="{_fmt(le)}"}} {c}')
-        lines.append(f'{pn}_bucket{{le="+Inf"}} {snap["count"]}')
-        lines.append(f"{pn}_sum {_fmt(snap['sum'])}")
-        lines.append(f"{pn}_count {snap['count']}")
+        m = _HIST_CLASS.match(name)
+        if m:
+            base, labels = m.group(1), f'params_class="{m.group(2)}"'
+        else:
+            base, labels = name, ""
+        fam = hist_fams.setdefault(prom_name(base),
+                                   {"help": base, "samples": []})
+        fam["samples"].append((labels, histograms[name]))
+    for pn in sorted(hist_fams):
+        fam = hist_fams[pn]
+        emit_family(pn, "histogram", fam["help"])
+        for labels, snap in sorted(fam["samples"], key=lambda s: s[0]):
+            pre = labels + "," if labels else ""
+            suf = f"{{{labels}}}" if labels else ""
+            bounds = snap.get("bucket_bounds", [])
+            cumulative = snap.get("bucket_counts", [])
+            for le, c in zip(bounds, cumulative):
+                lines.append(f'{pn}_bucket{{{pre}le="{_fmt(le)}"}} {c}')
+            lines.append(f'{pn}_bucket{{{pre}le="+Inf"}} {snap["count"]}')
+            lines.append(f"{pn}_sum{suf} {_fmt(snap['sum'])}")
+            lines.append(f"{pn}_count{suf} {snap['count']}")
     return "\n".join(lines) + "\n"
 
 
@@ -262,7 +297,7 @@ class MetricsExporter:
                  host: str = "127.0.0.1", port: int = 0,
                  profile_dir: Optional[str] = None,
                  legacy_executable_metrics: bool = False,
-                 index_gauge=None):
+                 index_gauge=None, flight=None):
         self.executor = executor
         self.batcher = batcher
         self.host = host
@@ -273,7 +308,17 @@ class MetricsExporter:
         # probe-frequency / recall / drift surface per scrape and backs
         # the /index.json endpoint (404 when not attached)
         self.index_gauge = index_gauge
+        # graftflight (PR 11): a FlightRecorder evaluates its incident
+        # triggers per scrape and backs /incident.json (404 while no
+        # incident has been captured — or no recorder is attached)
+        self.flight = flight
         self._profile_lock = threading.Lock()
+        if flight is not None and getattr(flight, "profile_lock",
+                                          None) is None:
+            # one profiler capture at a time, BOTH directions: the
+            # recorder's automatic capture defers while /profile runs,
+            # and /profile 409s while an incident is being captured
+            flight.profile_lock = self._profile_lock
         self._server: Optional[http.server.ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -328,12 +373,25 @@ class MetricsExporter:
                 "profile_dir=... to arm /profile")
         if not self._profile_lock.acquire(blocking=False):
             raise RuntimeError("a profiler capture is already running")
+        # the capture's trace file rides in the response (PR 11
+        # exporter hardening) so graftflight — and operators — can
+        # find what was just captured without globbing profile_dir.
+        # Only a file THIS capture produced qualifies (before/after
+        # diff): "newest in the dir" would name a previous capture's
+        # file — stale data presented as fresh — whenever the current
+        # one writes no chrome-trace sidecar; null is the honest
+        # answer then.
+        from raft_tpu.core import profiling
+
+        before = profiling.trace_snapshot(self.profile_dir)
         try:
             with tracing.capture(self.profile_dir):
                 time.sleep(seconds)
         finally:
             self._profile_lock.release()
-        return {"log_dir": self.profile_dir, "seconds": seconds}
+        return {"log_dir": self.profile_dir, "seconds": seconds,
+                "trace_file": profiling.fresh_trace_file(
+                    self.profile_dir, before)}
 
     def _refresh(self) -> None:
         """Re-publish the poll-style gauges from the attached executor
@@ -355,6 +413,13 @@ class MetricsExporter:
             # probe-frequency gauges and drift scoring, plus health
             # stats and the shadow-recall window refresh
             self.index_gauge.publish()
+        if self.flight is not None:
+            # graftflight: evaluate the incident triggers — a firing
+            # multiburn alert / latency anomaly captures here, rate
+            # limited by the recorder's cooldown (a triggered scrape
+            # blocks for the short capture; that is the design — the
+            # incident evidence is worth one slow scrape)
+            self.flight.check()
 
     def index_snapshot(self) -> dict:
         """The ``/index.json`` body: the attached
@@ -412,6 +477,15 @@ class MetricsExporter:
                         self._send(f"{e}\n".encode(), "text/plain", 404)
                         return
                     self._send(json.dumps(out, default=str).encode(),
+                               "application/json")
+                elif path == "/incident.json":
+                    bundle = (exporter.flight.latest()
+                              if exporter.flight is not None else None)
+                    if bundle is None:
+                        self._send(b"no incident captured\n",
+                                   "text/plain", 404)
+                        return
+                    self._send(json.dumps(bundle, default=str).encode(),
                                "application/json")
                 elif path == "/trace.json":
                     trace_id = None

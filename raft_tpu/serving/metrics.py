@@ -79,6 +79,22 @@ Per-stage latency **histograms** (log2 buckets, p50/p95/p99 estimates):
 - ``index.drift.score`` / ``index.drift.<name>.{score,alert}`` —
   streaming divergence of live traffic from the build-time baseline
 
+**graftflight surface** (PR 11):
+
+- ``serving.batcher.execute_seconds.p<NP>`` — per-params-class
+  execute-latency histograms (:func:`params_class` /
+  :func:`observe_execute_class`; rendered as
+  ``{params_class=...}``-labeled Prometheus families) — the latency
+  axis pairing the ``index.recall.sweep.p<NP>`` recall gauges
+- ``serving.attribution.{device_seconds,modeled_bytes,modeled_flops}``
+  + ``serving.executable.<digest>.measured_*`` — device-truth
+  attribution from profiler captures
+  (:mod:`raft_tpu.core.profiling`); :func:`derived` publishes
+  ``device_achieved_gbps``/``gflops`` and ``measured_executables``
+  next to the wall-clock-derived numbers
+- ``profiling.captures`` / ``incident.*`` — trace-ingestion and
+  flight-recorder (:mod:`raft_tpu.serving.flight`) lifetime counters
+
 Batch **occupancy** — the coalescing win the ISSUE's acceptance
 criterion gates on — is derived, not stored: ``requests / batches``
 (and ``rows / batches``) from one counters snapshot. Likewise the
@@ -92,10 +108,11 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import re
 import threading
 from typing import Optional
 
-from raft_tpu.core import tracing
+from raft_tpu.core import profiling, tracing
 
 PREFIX = "serving.batcher."
 
@@ -271,6 +288,51 @@ def observe_stage(name: str, seconds: float) -> None:
     tracing.observe(name, seconds)
 
 
+def params_class(params) -> Optional[str]:
+    """The latency label of a request's search params — ``p<NP>`` for
+    params carrying ``n_probes`` (graftflight satellite, the
+    graftgauge carried follow-on): the SAME spelling the params-sweep
+    recall gauges use (``index.recall.sweep.p<NP>``), so the sweep's
+    recall axis pairs with a measured latency axis and the live
+    recall/latency frontier is complete. None for params with no
+    ``n_probes`` knob (brute force, CAGRA) — those observe only the
+    unlabeled family."""
+    n_probes = getattr(params, "n_probes", None)
+    if n_probes is None:
+        return None
+    return f"p{int(n_probes)}"
+
+
+# label-cardinality bound for the per-params-class histograms:
+# n_probes is client-supplied, and histograms are process-lifetime —
+# without a cap, a client sweeping arbitrary values (an autotuner)
+# would grow the registry and every /metrics payload without bound
+# (the same leak PR 8's top-N probe gauges were engineered around).
+# 32 distinct classes covers any realistic sweep; overflow is counted,
+# not silent.
+EXECUTE_CLASS_CAP = 32
+_execute_classes: set = set()
+_execute_classes_lock = threading.Lock()
+
+
+def observe_execute_class(label: str, seconds: float) -> None:
+    """Record one dispatch's execute latency into the per-params-class
+    histogram (``serving.batcher.execute_seconds.<label>`` — rendered
+    by the exporter as the labeled
+    ``serving_batcher_execute_seconds{params_class="<label>"}``
+    Prometheus family next to the unlabeled aggregate). At most
+    :data:`EXECUTE_CLASS_CAP` distinct labels materialize per process;
+    past the cap a new label's observation lands only in the unlabeled
+    aggregate and bumps ``serving.batcher.execute_class_dropped``."""
+    with _execute_classes_lock:
+        if label not in _execute_classes:
+            if len(_execute_classes) >= EXECUTE_CLASS_CAP:
+                tracing.inc_counter(PREFIX + "execute_class_dropped")
+                return
+            _execute_classes.add(label)
+    tracing.observe(f"{EXECUTE}.{label}", seconds)
+
+
 def batch_dispatched(n_requests: int, n_rows: int) -> None:
     """Count one dispatched micro-batch."""
     tracing.inc_counter(PREFIX + "batches")
@@ -320,6 +382,31 @@ def derived() -> dict:
         out["modeled_bytes_total"] / exec_s / 1e9 if exec_s > 0 else 0.0)
     out["achieved_gflops"] = (
         out["modeled_flops_total"] / exec_s / 1e9 if exec_s > 0 else 0.0)
+    # graftflight (PR 11): the DEVICE-measured counterparts, published
+    # when a profiler capture was attributed — modeled bytes/flops over
+    # MEASURED device seconds, next to the wall-clock-derived numbers
+    # above so the two accountings can disagree visibly (wall clock
+    # includes dispatch/readiness overhead the device never saw)
+    att_s = tracing.get_counter(profiling.ATTRIBUTED_SECONDS)
+    out["measured_device_seconds_total"] = att_s
+    out["device_achieved_gbps"] = (
+        tracing.get_counter(profiling.ATTRIBUTED_BYTES) / att_s / 1e9
+        if att_s > 0 else 0.0)
+    out["device_achieved_gflops"] = (
+        tracing.get_counter(profiling.ATTRIBUTED_FLOPS) / att_s / 1e9
+        if att_s > 0 else 0.0)
+    # per-executable measured view, re-read from the attribution's
+    # gauges (one scrape shows each resident program's measured
+    # achieved GB/s / GFLOP/s — bytes-per-call x trace invocations
+    # over its own measured device seconds)
+    measured: dict = {}
+    pat = re.compile(
+        r"^serving\.executable\.([0-9a-f]+)\.measured_([a-z_]+)$")
+    for name, v in tracing.gauges("serving.executable.").items():
+        m = pat.match(name)
+        if m:
+            measured.setdefault(m.group(1), {})[m.group(2)] = v
+    out["measured_executables"] = measured
     return out
 
 
@@ -346,4 +433,7 @@ def reset() -> None:
     tracing.reset_counters("index.")
     tracing.reset_gauges("index.")
     tracing.reset_histograms(PREFIX)
+    # the class-label cap tracks the histograms it guards
+    with _execute_classes_lock:
+        _execute_classes.clear()
     tracing.reset_spans()

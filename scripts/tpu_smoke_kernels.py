@@ -290,6 +290,49 @@ def main():
     except Exception as e:  # noqa: BLE001
         emit("beam_search", error=str(e)[:300])
 
+    # ---- graftflight: capture-and-attribute on the real chip — a
+    # jax.profiler capture around compiled executor dispatches must
+    # correlate back to the digest-named modules, yielding MEASURED
+    # device seconds next to the modeled cost-analysis bytes (the
+    # on-chip evidence the measured-supersedes-modeled contract needs:
+    # on TPU the xplane export also carries the named-scope phase
+    # markers the CPU chrome export drops)
+    try:
+        import tempfile
+
+        from raft_tpu.core import profiling, tracing
+        from raft_tpu.core.executor import SearchExecutor
+        from raft_tpu.neighbors import ivf_flat
+
+        idx = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=64), x)
+        ex = SearchExecutor(min_bucket=16, max_bucket=16)
+        sp = ivf_flat.IvfFlatSearchParams(n_probes=8)
+        ex.search(idx, q[:16, :], 10, params=sp)     # compile + warm
+        prof_dir = tempfile.mkdtemp(prefix="graftflight_")
+        with tracing.capture(prof_dir):
+            for _ in range(8):
+                jax.block_until_ready(
+                    ex.search(idx, q[:16, :], 10, params=sp))
+        attr = profiling.attribute(prof_dir, ex.executable_costs())
+        measured = profiling.publish(attr)
+        from raft_tpu.serving import metrics as serving_metrics
+
+        derived = serving_metrics.derived()
+        emit("graftflight_attribution",
+             matched_executables=len(attr.modules),
+             unmatched_modules=len(attr.unmatched_modules),
+             invocations=sum(m.invocations
+                             for m in attr.modules.values()),
+             measured_device_seconds=sum(
+                 m.device_seconds for m in attr.modules.values()),
+             measured_gbps={d: s["gbps"] for d, s in measured.items()},
+             device_achieved_gbps=derived["device_achieved_gbps"],
+             phase_seconds={d: m.phase_seconds
+                            for d, m in attr.modules.items()})
+    except Exception as e:  # noqa: BLE001
+        emit("graftflight_attribution", error=str(e)[:300])
+
 
 if __name__ == "__main__":
     main()

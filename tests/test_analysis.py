@@ -566,6 +566,50 @@ class TestFixtureCorpus:
         assert lint_lib(ok, ["R5", "R7"],
                         rel="raft_tpu/serving/gauge.py").ok
 
+    def test_r5_r7_cover_graftflight_module(self):
+        """PR 11 satellite: the hot scope reaches the new graftflight
+        flight-recorder module by its real path — a host sync or a
+        bare clock read landing in ``raft_tpu/serving/flight.py`` is a
+        finding, not a blind spot (the shipped module itself lints
+        clean: its timestamps come from the injected clock, its only
+        wall-time touch is the capture's exempt ``time.sleep``, and
+        the bundle reads registries, never device arrays)."""
+        flight_sync = (
+            "def check(handles):\n"
+            "    return [h.depth.item() for h in handles]\n"
+        )
+        bad = lint_lib(flight_sync, ["R5"],
+                       rel="raft_tpu/serving/flight.py")
+        assert rules_fired(bad) == {"R5"}
+        flight_clock = (
+            "import time\n"
+            "\n"
+            "\n"
+            "def incident_stamp():\n"
+            "    return time.monotonic()\n"
+        )
+        bad = lint_lib(flight_clock, ["R7"],
+                       rel="raft_tpu/serving/flight.py")
+        assert rules_fired(bad) == {"R7"}
+        # the conforming discipline the module actually uses: clock
+        # injection for stamps, time.sleep (a duration) for captures
+        ok = (
+            "import time\n"
+            "\n"
+            "\n"
+            "def capture(clock, seconds):\n"
+            "    t = clock.now()\n"
+            "    time.sleep(seconds)\n"
+            "    return t\n"
+        )
+        assert lint_lib(ok, ["R5", "R7"],
+                        rel="raft_tpu/serving/flight.py").ok
+        # core/profiling.py is OFFLINE host-side parsing — outside the
+        # hot scopes by design (it must never run on a dispatch path);
+        # prove the scope boundary sits where the docs say it does
+        assert lint_lib(flight_clock, ["R7"],
+                        rel="raft_tpu/core/profiling.py").ok
+
     def test_r7_datetime_clock_reads(self):
         """PR 7: datetime.now()/utcnow()/date.today() are wall-clock
         reads — module-dotted and from-import spellings both fire;

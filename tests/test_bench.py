@@ -689,7 +689,9 @@ class TestBenchCompare:
                            "serving.execute.modeled_bytes": 1e6,
                            "serving.execute.modeled_flops": 1e7,
                            "index.probe.dispatches": 2.0,
-                           "index.probe_freq.accounted": 64.0}}
+                           "index.probe_freq.accounted": 64.0,
+                           "profiling.captures": 1.0,
+                           "incident.bundles": 1.0}}
         assert bc.check_snapshot(ok) == []
         dark = {"counters": {"serving.execute.calls": 5.0,
                              "serving.execute.modeled_bytes": 0.0}}
@@ -711,6 +713,8 @@ class TestBenchCompare:
                 "serving.execute.modeled_flops": 1e7,
                 "index.probe.dispatches": 2.0,
                 "index.probe_freq.accounted": 64.0,
+                "profiling.captures": 2.0,
+                "incident.bundles": 1.0,
             },
         }
         assert bc.check_snapshot(snap) == []
@@ -767,11 +771,45 @@ class TestBenchCompare:
             "serving.execute.modeled_flops": 1e7,
             "index.probe.dispatches": 3.0,
             "index.probe_freq.accounted": 0.0,     # went dark
+            "profiling.captures": 1.0,
+            "incident.bundles": 1.0,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("index.probe_freq.accounted" in m for m in msgs)
         dark["counters_lifetime"]["index.probe_freq.accounted"] = 96.0
         assert bc.check_snapshot(dark) == []
+
+    # -- PR 11: graftflight ingestion / incident-capture floors -------------
+
+    def test_snapshot_floors_include_graftflight(self, bc):
+        """graftflight satellite: the gate floor-checks trace
+        ingestion and incident capture — a refactor that disconnects
+        the parser pipeline or the flight-recorder triggers zeroes
+        these and fails structurally."""
+        assert "profiling.captures" in bc.SNAPSHOT_FLOORS
+        assert "incident.bundles" in bc.SNAPSHOT_FLOORS
+        dark = {"counters_lifetime": {
+            "serving.execute.calls": 5.0,
+            "serving.execute.modeled_bytes": 1e6,
+            "serving.execute.modeled_flops": 1e7,
+            "index.probe.dispatches": 3.0,
+            "index.probe_freq.accounted": 96.0,
+            "profiling.captures": 0.0,             # ingestion dark
+            "incident.bundles": 1.0,
+        }}
+        msgs = bc.check_snapshot(dark)
+        assert any("profiling.captures" in m for m in msgs)
+        dark["counters_lifetime"]["profiling.captures"] = 3.0
+        assert bc.check_snapshot(dark) == []
+        # the committed baseline carries the new floors too
+        import os
+
+        base_path = os.path.join(os.path.dirname(bc.__file__),
+                                 "bench_baseline.json")
+        with open(base_path) as f:
+            committed = json.load(f)
+        assert "profiling.captures" in committed["snapshot_floors"]
+        assert "incident.bundles" in committed["snapshot_floors"]
 
     def test_multi_baseline_gates_each(self, bc, record, tmp_path):
         import copy

@@ -1088,7 +1088,9 @@ class TestExporterV2Endpoints:
                 assert ei.value.code == 400
         b.close()
 
-    def test_profile_endpoint_gated_and_captures(self, tmp_path):
+    def test_profile_endpoint_gated_and_captures(self, tmp_path,
+                                                 monkeypatch):
+        import contextlib
         import json
         import os
         import urllib.request
@@ -1104,12 +1106,29 @@ class TestExporterV2Endpoints:
             assert ei.value.code == 403
         prof = tmp_path / "prof"
         prof.mkdir()
+
+        # layout-faithful fake capture: stop_trace serializes
+        # session-accumulated profiler state (~a minute late in a full
+        # suite) — the REAL capture path is proven by the core capture
+        # smoke and graftflight's live-correlation test; this test
+        # owns the HTTP contract (gating, arming, status codes)
+        @contextlib.contextmanager
+        def fake_capture(log_dir):
+            run = os.path.join(log_dir, "plugins", "profile", "r1")
+            os.makedirs(run, exist_ok=True)
+            with open(os.path.join(run, "host.trace.json"), "w") as f:
+                json.dump({"traceEvents": []}, f)
+            yield
+
+        monkeypatch.setattr(tracing, "capture", fake_capture)
         with MetricsExporter(batcher=b,
                              profile_dir=str(prof)) as exp:
             out = json.loads(urllib.request.urlopen(
                 exp.url("/profile?seconds=0"), timeout=60).read())
             assert out["log_dir"] == str(prof)
             assert os.listdir(prof), "capture wrote nothing"
+            # PR 11 exporter hardening: the response names the capture
+            assert out["trace_file"].startswith(str(prof))
             # bad seconds: 400 (malformed and out-of-range alike)
             for q in ("seconds=bogus", "seconds=-1", "seconds=999",
                       "seconds="):    # blank must 400, not default

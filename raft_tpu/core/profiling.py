@@ -43,6 +43,26 @@ stdlib, no jax import, nothing on the dispatch path. Timestamps in the
 re-emitted spans are in the CAPTURE's clock domain (profiler
 microseconds), a third domain next to the batcher clock and wall
 clock; the spans say so via ``source: "profiler"``.
+
+graftfleet (PR 12) grows the module into the STEADY-STATE half:
+
+4. **Per-dispatch invocation windows** (:func:`invocation_windows`) —
+   gap-clustering splits one module's capture events into the
+   dispatches that produced them, so ``invocations`` becomes an exact
+   per-window count instead of the MIN-per-(device, op) heuristic,
+   and straggler skew / phase timing attribute PER DISPATCH
+   (``serving.mesh.shard_skew_p99`` over the window skews).
+5. **Rolling attribution** (:class:`RollingAttribution`) — the
+   EWMA-folded state a continuous low-duty-cycle capture scheduler
+   (:mod:`raft_tpu.serving.continuous`) feeds: per-executable /
+   per-phase measured device seconds and achieved GB/s published as
+   ``serving.attribution.rolling.*`` gauges, so ``metrics.derived()``
+   carries a continuously-fresh measured number instead of the last
+   incident's snapshot.
+6. **xplane-pb ingestion** (:func:`parse_xplane` via
+   :mod:`raft_tpu.core.xplane`) — auto-selected when a capture
+   directory holds ``.xplane.pb`` but no chrome sidecar (the chrome
+   path stays primary; upstream is deprecating the TPU chrome export).
 """
 
 from __future__ import annotations
@@ -53,9 +73,10 @@ import glob
 import gzip
 import json
 import os
+import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from raft_tpu.core import tracing
+from raft_tpu.core import tracing, xplane
 
 # lifetime counters (ci/bench_compare.py snapshot floors): ingested
 # captures and the totals the measured/modeled disagreement is read on
@@ -64,6 +85,10 @@ DEVICE_OPS = "profiling.device_ops"
 ATTRIBUTED_SECONDS = "serving.attribution.device_seconds"
 ATTRIBUTED_BYTES = "serving.attribution.modeled_bytes"
 ATTRIBUTED_FLOPS = "serving.attribution.modeled_flops"
+# graftfleet (PR 12): rolling-attribution folds — the snapshot floor's
+# evidence that the continuous-capture pipeline stayed alive
+ROLLING_FOLDS = "profiling.rolling.folds"
+ROLLING_PREFIX = "serving.attribution.rolling."
 
 # the mesh phase markers the distributed search bodies annotate with
 # jax.named_scope — ops whose scope path carries none land in
@@ -105,9 +130,14 @@ class DeviceOp:
         return UNATTRIBUTED
 
 
+def _is_chrome(path: str) -> bool:
+    return path.endswith((".trace.json", ".trace.json.gz"))
+
+
 def trace_snapshot(profile_dir: str) -> Dict[str, float]:
-    """``{path: mtime}`` of every ``*.trace.json[.gz]`` under a
-    ``jax.profiler`` capture directory (the profiler nests runs as
+    """``{path: mtime}`` of every ``*.trace.json[.gz]`` AND
+    ``*.xplane.pb`` under a ``jax.profiler`` capture directory (the
+    profiler nests runs as
     ``plugins/profile/<timestamp>/<host>.trace.json.gz``). A caller
     that is about to run a capture takes this snapshot and resolves
     the capture's own output with :func:`fresh_trace_file` — the
@@ -120,11 +150,14 @@ def trace_snapshot(profile_dir: str) -> Dict[str, float]:
     miss it."""
     pats = (os.path.join(profile_dir, "plugins", "profile", "*",
                          "*.trace.json*"),
-            os.path.join(profile_dir, "*.trace.json*"))
+            os.path.join(profile_dir, "*.trace.json*"),
+            os.path.join(profile_dir, "plugins", "profile", "*",
+                         "*.xplane.pb"),
+            os.path.join(profile_dir, "*.xplane.pb"))
     out: Dict[str, float] = {}
     for pat in pats:
         for p in glob.glob(pat):
-            if p.endswith((".trace.json", ".trace.json.gz")):
+            if _is_chrome(p) or p.endswith(".xplane.pb"):
                 try:
                     out[p] = os.path.getmtime(p)
                 except OSError:   # raced a cleanup — not a capture
@@ -132,30 +165,42 @@ def trace_snapshot(profile_dir: str) -> Dict[str, float]:
     return out
 
 
+def _prefer_chrome(paths, mtimes) -> str:
+    """Newest chrome-trace sidecar when any exists, else the newest
+    ``.xplane.pb`` — the chrome path stays primary; the protobuf
+    reader is the fallback for captures (upcoming TPU exports) that
+    write no chrome sidecar at all."""
+    chrome = [p for p in paths if _is_chrome(p)]
+    pool = chrome or list(paths)
+    return max(pool, key=lambda p: (mtimes[p], p))
+
+
 def fresh_trace_file(profile_dir: str,
                      before: Dict[str, float]) -> Optional[str]:
     """The trace file a just-finished capture produced: the newest
     path that is new — or rewritten — relative to the
-    :func:`trace_snapshot` taken before the capture. None when the
-    capture wrote no chrome trace (the honest answer; see
-    :func:`trace_snapshot` for why stale fallback is a bug)."""
+    :func:`trace_snapshot` taken before the capture (chrome sidecar
+    preferred when the capture wrote both it and an ``.xplane.pb``).
+    None when the capture wrote no trace at all (the honest answer;
+    see :func:`trace_snapshot` for why stale fallback is a bug)."""
     now = trace_snapshot(profile_dir)
     fresh = [p for p, m in now.items() if before.get(p) != m]
     if not fresh:
         return None
-    return max(fresh, key=lambda p: now[p])
+    return _prefer_chrome(fresh, now)
 
 
 def latest_trace_file(profile_dir: str) -> Optional[str]:
-    """Newest capture trace file under ``profile_dir``, or None when
-    the directory holds no capture yet. For attributing a capture YOU
-    just ran, prefer the :func:`trace_snapshot` /
+    """Newest capture trace file under ``profile_dir`` (chrome sidecar
+    preferred; ``.xplane.pb`` when the directory holds only that), or
+    None when the directory holds no capture yet. For attributing a
+    capture YOU just ran, prefer the :func:`trace_snapshot` /
     :func:`fresh_trace_file` pair — this entry point is for pointing
     at whatever a directory already holds."""
     found = trace_snapshot(profile_dir)
     if not found:
         return None
-    return max(found, key=lambda p: found[p])
+    return _prefer_chrome(found, found)
 
 
 def load_trace(source) -> dict:
@@ -163,16 +208,28 @@ def load_trace(source) -> dict:
     passes through; a ``.json``/``.json.gz`` file path is read; a
     directory is treated as a ``jax.profiler`` ``profile_dir`` and its
     newest capture is taken. Raises ``FileNotFoundError`` for a
-    directory holding no capture."""
+    directory holding no capture. Chrome traces only — use
+    :func:`load_ops` for the format-dispatching entry point that also
+    reads ``.xplane.pb``."""
     if isinstance(source, dict):
         return source
     path = os.fspath(source)
     if os.path.isdir(path):
-        found = latest_trace_file(path)
-        if found is None:
+        # chrome-only resolution: trace_snapshot sees .xplane.pb too
+        # (PR 12), but feeding protobuf bytes to json.load would be
+        # an opaque decode error — an xplane-only directory stays the
+        # explicit "no chrome capture" failure it always was
+        found = {p: m for p, m in trace_snapshot(path).items()
+                 if _is_chrome(p)}
+        if not found:
             raise FileNotFoundError(
-                f"no *.trace.json[.gz] capture under {path!r}")
-        path = found
+                f"no *.trace.json[.gz] capture under {path!r} "
+                "(for .xplane.pb captures use load_ops)")
+        path = max(found, key=lambda p: (found[p], p))
+    if path.endswith(".xplane.pb"):
+        raise ValueError(
+            f"{path!r} is an xplane protobuf, not a chrome trace — "
+            "use load_ops/parse_xplane")
     if path.endswith(".gz"):
         with gzip.open(path, "rt") as f:
             return json.load(f)
@@ -219,20 +276,211 @@ def parse_chrome_trace(data: dict) -> List[DeviceOp]:
     return out
 
 
+def parse_xplane(source) -> List[DeviceOp]:
+    """Extract the device ops from one serialized XSpace
+    (``.xplane.pb`` path or raw bytes) via the stdlib wire-format
+    reader (:mod:`raft_tpu.core.xplane`) — the graftfleet satellite
+    closing the ROADMAP xplane-ingestion follow-on. Same contract as
+    :func:`parse_chrome_trace`: a device op is an event whose resolved
+    stats carry ``hlo_module`` (module-less python/threadpool events
+    are skipped), device = the plane name, scope = the framework op
+    path stat when present, times in seconds (line ``timestamp_ns``
+    base + event ``offset_ps``)."""
+    if isinstance(source, (bytes, bytearray)):
+        data = bytes(source)
+    else:
+        with open(os.fspath(source), "rb") as f:
+            data = f.read()
+    space = xplane.parse_xspace(data)
+    out: List[DeviceOp] = []
+    for plane in space["planes"]:
+        device = plane["name"]
+        for line in plane["lines"]:
+            t0 = float(line["timestamp_ns"]) * 1e-9
+            for ev in line["events"]:
+                stats = xplane.resolve_stats(ev, plane["stat_metadata"])
+                module = stats.get("hlo_module")
+                if not module or not isinstance(module, str):
+                    continue
+                scope = ""
+                for key in _SCOPE_KEYS:
+                    v = stats.get(key)
+                    if v and isinstance(v, str):
+                        scope = v
+                        break
+                out.append(DeviceOp(
+                    device=device,
+                    module=module,
+                    op=plane["event_metadata"].get(
+                        ev["metadata_id"], str(ev["metadata_id"])),
+                    scope=scope,
+                    start_s=t0 + float(ev["offset_ps"]) * 1e-12,
+                    dur_s=float(ev["duration_ps"]) * 1e-12,
+                ))
+    return out
+
+
+def load_ops(source) -> Tuple[List[DeviceOp], Optional[str]]:
+    """Format-dispatching ingestion front: ``(device ops, resolved
+    trace file)`` from a parsed chrome dict, a ``.trace.json[.gz]``
+    path, a ``.xplane.pb`` path, or a ``profile_dir`` (newest capture,
+    chrome sidecar preferred — the xplane reader is auto-selected only
+    when the directory holds ``.xplane.pb`` and no chrome trace)."""
+    if isinstance(source, dict):
+        return parse_chrome_trace(source), None
+    path = os.fspath(source)
+    if os.path.isdir(path):
+        found = latest_trace_file(path)
+        if found is None:
+            raise FileNotFoundError(
+                f"no *.trace.json[.gz] / *.xplane.pb capture under "
+                f"{path!r}")
+        path = found
+    if path.endswith(".xplane.pb"):
+        return parse_xplane(path), path
+    return parse_chrome_trace(load_trace(path)), path
+
+
+@dataclasses.dataclass
+class InvocationWindow:
+    """One dispatch's worth of a module's capture events (graftfleet):
+    the ops between two idle gaps the gap-clustering called dispatch
+    boundaries. ``shard_seconds`` is per-device busy time WITHIN the
+    window, so :attr:`skew` is the straggler skew of this one dispatch
+    — the per-dispatch sample the ``serving.mesh.shard_skew_p99``
+    distribution is built from."""
+
+    start_s: float
+    end_s: float
+    ops: int
+    device_seconds: float
+    phase_seconds: Dict[str, float]
+    shard_seconds: Dict[str, float]
+
+    @property
+    def skew(self) -> float:
+        """max − min per-device busy seconds (0.0 single-device)."""
+        if len(self.shard_seconds) < 2:
+            return 0.0
+        vals = self.shard_seconds.values()
+        return max(vals) - min(vals)
+
+    def to_dict(self) -> dict:
+        return {"start_s": self.start_s, "end_s": self.end_s,
+                "ops": self.ops, "device_seconds": self.device_seconds,
+                "phase_seconds": dict(self.phase_seconds),
+                "shard_seconds": dict(self.shard_seconds),
+                "skew": self.skew}
+
+
+# auto gap-clustering knob: a gap joins the dispatch boundaries when
+# it is at least this fraction of the smallest gap the op-count floor
+# already forced to be a boundary — catches the dispatches the
+# MIN-count heuristic undercounts (conditional top-level ops) without
+# promoting intra-dispatch idle (which sits well below real dispatch
+# gaps) into fake boundaries
+GAP_EXTEND_RATIO = 0.5
+
+
+def invocation_windows(ops: Iterable[DeviceOp], *,
+                       gap_s: Optional[float] = None,
+                       extend_ratio: float = GAP_EXTEND_RATIO
+                       ) -> List[InvocationWindow]:
+    """Split ONE module's capture events into per-dispatch invocation
+    windows by gap-clustering the merged (all-device) timeline.
+
+    Candidate boundaries are the positive idle gaps — instants where
+    every device of the module went quiet before the next op started
+    (overlapping devices merge: a mesh dispatch runs its shards
+    concurrently, so intra-dispatch "gaps" on one device are covered
+    by the other's ops). Which candidates become boundaries:
+
+    - With an explicit ``gap_s``: every gap above it.
+    - Auto (default): the op-count bounds anchor the clustering — a
+      top-level unconditional op runs exactly once per dispatch, so
+      the MIN positive per-(device, op) event count ``n_min`` is a
+      FLOOR on invocations and the MAX count ``n_max`` (loop-body ops
+      repeat per iteration) a CEILING. The largest ``n_min − 1`` gaps
+      are definite boundaries; remaining gaps within
+      ``extend_ratio`` of the smallest definite one also split
+      (dispatches the MIN heuristic undercounted because its op was
+      conditional).
+
+    Either way at most ``n_max − 1`` boundaries are kept, so windows
+    can never exceed the loop-iteration ceiling. Pure function of its
+    inputs — fixture-pinned, deterministic (ties break by event
+    order). An empty op list yields no windows; back-to-back
+    dispatches with NO idle gap merge into one window (the caller's
+    invocation count falls back to the ``n_min`` floor — see
+    :func:`correlate`)."""
+    mops = sorted(ops, key=lambda o: (o.start_s, o.dur_s, o.device))
+    if not mops:
+        return []
+    counts: Dict[tuple, int] = collections.defaultdict(int)
+    for op in mops:
+        counts[(op.device, op.op)] += 1
+    n_min = min(counts.values())
+    n_max = max(counts.values())
+    gaps: List[Tuple[float, int]] = []
+    max_end = mops[0].start_s + mops[0].dur_s
+    for i, op in enumerate(mops[1:], start=1):
+        g = op.start_s - max_end
+        if g > 0:
+            gaps.append((g, i))
+        max_end = max(max_end, op.start_s + op.dur_s)
+    by_size = sorted(gaps, key=lambda gi: (-gi[0], gi[1]))
+    if gap_s is not None:
+        chosen = [(g, i) for g, i in by_size if g > gap_s]
+    else:
+        definite = by_size[:max(n_min - 1, 0)]
+        chosen = list(definite)
+        if definite:
+            thresh = definite[-1][0] * extend_ratio
+            chosen += [(g, i) for g, i in by_size[len(definite):]
+                       if g >= thresh]
+    chosen = chosen[:max(n_max - 1, 0)]
+    cuts = sorted(i for _, i in chosen)
+    windows: List[InvocationWindow] = []
+    lo = 0
+    for cut in cuts + [len(mops)]:
+        chunk = mops[lo:cut]
+        lo = cut
+        if not chunk:
+            continue
+        phase: Dict[str, float] = collections.defaultdict(float)
+        shard: Dict[str, float] = collections.defaultdict(float)
+        for op in chunk:
+            phase[op.phase] += op.dur_s
+            shard[op.device] += op.dur_s
+        windows.append(InvocationWindow(
+            start_s=min(o.start_s for o in chunk),
+            end_s=max(o.start_s + o.dur_s for o in chunk),
+            ops=len(chunk),
+            device_seconds=sum(o.dur_s for o in chunk),
+            phase_seconds=dict(phase),
+            shard_seconds=dict(shard),
+        ))
+    return windows
+
+
 @dataclasses.dataclass
 class ModuleAttribution:
     """Measured device truth for ONE resident executable.
 
     ``device_seconds`` is busy op-time summed over every device that
     ran the module (the roofline denominator); ``invocations`` the
-    executions observed in the window — the MINIMUM positive
-    per-(device, op) event count: a top-level op runs exactly once
-    per execution, loop-body ops run once per iteration (which is why
-    the maximum wildly overcounts), and conditionally-executed ops
-    can only push the minimum DOWN, making the derived achieved
-    GB/s conservative rather than inflated; ``phase_seconds`` buckets
-    op time by the named-scope mesh phase markers; ``shard_seconds``
-    by device.
+    executions observed in the window — the exact per-dispatch window
+    count from :func:`invocation_windows` gap-clustering (PR 12),
+    floored by the MINIMUM positive per-(device, op) event count for
+    captures whose back-to-back dispatches leave no idle gap to
+    cluster on: a top-level op runs exactly once per execution,
+    loop-body ops run once per iteration (which is why the maximum
+    wildly overcounts), and conditionally-executed ops can only push
+    the minimum DOWN, so the floor keeps the derived achieved GB/s
+    conservative rather than inflated; ``phase_seconds`` buckets op
+    time by the named-scope mesh phase markers; ``shard_seconds`` by
+    device; ``windows`` the per-dispatch detail (per-window phase /
+    shard seconds and straggler skew).
     ``modeled_bytes_per_call``/``flops`` come from the entry's
     compile-time cost analysis, so measured achieved GB/s is
     ``bytes x invocations / device_seconds``."""
@@ -248,6 +496,15 @@ class ModuleAttribution:
     modeled_bytes_per_call: float = 0.0
     modeled_flops_per_call: float = 0.0
     payload_model: Optional[dict] = None
+    windows: List[InvocationWindow] = dataclasses.field(
+        default_factory=list)
+
+    def skew_samples(self) -> List[float]:
+        """One straggler-skew sample per invocation window that ran on
+        several devices — the per-dispatch distribution behind the
+        ``serving.mesh.shard_skew_p99`` gauge."""
+        return [w.skew for w in self.windows
+                if len(w.shard_seconds) > 1]
 
     @property
     def mesh(self) -> bool:
@@ -284,6 +541,7 @@ class ModuleAttribution:
             "measured_gbps": self.measured_gbps(),
             "measured_gflops": self.measured_gflops(),
             "mesh": self.mesh,
+            "invocation_windows": [w.to_dict() for w in self.windows],
         }
 
 
@@ -344,20 +602,25 @@ def correlate(ops: Iterable[DeviceOp], costs: dict) -> Attribution:
             phase[op.phase] += op.dur_s
             shard[op.device] += op.dur_s
             op_counts[(op.device, op.op)] += 1
+        windows = invocation_windows(mops)
         out[digest] = ModuleAttribution(
             digest=digest, module=module,
             family=str(info.get("family", "")),
             device_seconds=total,
-            # min, not max: loop-body ops repeat per iteration and
-            # would overcount executions (and inflate measured GB/s)
-            # by the trip count — see the class docstring
-            invocations=min(op_counts.values()),
+            # exact per-dispatch window count (PR 12 gap-clustering),
+            # floored by the min positive per-(device, op) count:
+            # loop-body ops repeat per iteration so the MAX overcounts,
+            # and back-to-back dispatches with no idle gap merge into
+            # one window so the clustering alone can UNDERcount — the
+            # floor keeps the derived GB/s conservative either way
+            invocations=max(len(windows), min(op_counts.values())),
             phase_seconds=dict(phase),
             shard_seconds=dict(shard),
             window=(t0, t1),
             modeled_bytes_per_call=float(info.get("bytes_accessed", 0.0)),
             modeled_flops_per_call=float(info.get("flops", 0.0)),
             payload_model=info.get("collective_payload"),
+            windows=windows,
         )
     return Attribution(modules=out, unmatched_modules=dict(unmatched))
 
@@ -365,19 +628,15 @@ def correlate(ops: Iterable[DeviceOp], costs: dict) -> Attribution:
 def attribute(source, costs: dict) -> Attribution:
     """The whole ingestion pipeline: load → parse → correlate.
 
-    ``source`` is anything :func:`load_trace` accepts (a profile dir,
-    a trace file, or an already-parsed dict); ``costs`` is the
-    executor's :meth:`executable_costs` table. Bumps the
-    ``profiling.captures`` / ``profiling.device_ops`` lifetime
-    counters — the CI snapshot floor's evidence that trace ingestion
-    stayed alive."""
-    data = load_trace(source)
-    ops = parse_chrome_trace(data)
+    ``source`` is anything :func:`load_ops` accepts (a profile dir, a
+    chrome-trace or ``.xplane.pb`` file, or an already-parsed chrome
+    dict); ``costs`` is the executor's :meth:`executable_costs` table.
+    Bumps the ``profiling.captures`` / ``profiling.device_ops``
+    lifetime counters — the CI snapshot floor's evidence that trace
+    ingestion stayed alive."""
+    ops, trace_file = load_ops(source)
     attr = correlate(ops, costs)
-    if isinstance(source, (str, os.PathLike)):
-        path = os.fspath(source)
-        attr.trace_file = (latest_trace_file(path)
-                           if os.path.isdir(path) else path)
+    attr.trace_file = trace_file
     tracing.inc_counters({CAPTURES: 1.0, DEVICE_OPS: float(len(ops))})
     return attr
 
@@ -426,6 +685,9 @@ def _emit_measured_mesh(att: ModuleAttribution) -> None:
             shard_timings=timings,
             shard_attrs={"modeled": False, "source": "profiler",
                          "digest": att.digest},
+            # per-dispatch skew distribution (PR 12): one sample per
+            # invocation window -> serving.mesh.shard_skew_p50/_p99
+            skew_samples=att.skew_samples(),
             count_dispatch=False)
 
 
@@ -472,3 +734,156 @@ def publish(attr: Attribution) -> dict:
     if attr.modules:
         tracing.inc_counters(totals)
     return out
+
+
+class RollingAttribution:
+    """EWMA-folded steady-state device truth (graftfleet, PR 12).
+
+    Incident captures (graftflight) publish a point-in-time snapshot;
+    the continuous low-duty-cycle scheduler
+    (:class:`~raft_tpu.serving.continuous.ContinuousCapture`) instead
+    folds every periodic capture window into THIS rolling state, so
+    ``serving.attribution.rolling.*`` always carries a
+    continuously-fresh measured number next to the wall-clock-derived
+    one in ``serving.metrics.derived()`` — not the last incident's
+    snapshot.
+
+    Fold semantics (pinned by scripted tests): per capture window the
+    totals (device seconds, modeled bytes/flops over all attributed
+    executables), per-phase seconds, per-executable device seconds /
+    bytes / flops, and the window's per-dispatch skew p99 each fold as
+    ``ewma = alpha * x + (1 - alpha) * ewma`` (first fold seeds the
+    state). Achieved GB/s is the RATIO of the byte and second EWMAs —
+    stabler than an EWMA of ratios, and exactly the roofline
+    accounting re-done on smoothed inputs. An executable ABSENT from a
+    window holds its last value: a 100 ms capture simply may not have
+    overlapped that program's traffic, which is no evidence it
+    changed. Thread-safe; pure host-side dict work.
+
+    Published gauges: ``serving.attribution.rolling.{windows,
+    device_seconds,modeled_bytes,modeled_flops,gbps,gflops,
+    shard_skew_p99}`` + ``.phase.<phase>_seconds``, and per
+    executable the labeled ``serving.executable.<digest>
+    .rolling_{gbps,device_seconds}`` family. The
+    ``profiling.rolling.folds`` lifetime counter is the CI snapshot
+    floor's evidence the pipeline stayed alive."""
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = float(alpha)
+        self._lock = threading.Lock()
+        self._totals: Dict[str, float] = {}
+        self._phases: Dict[str, float] = {}
+        self._execs: Dict[str, Dict[str, float]] = {}
+        self._skew_p99: Optional[float] = None
+        self.windows = 0
+
+    def _ewma(self, store: dict, key: str, x: float) -> float:
+        prev = store.get(key)
+        store[key] = (x if prev is None
+                      else self.alpha * x + (1.0 - self.alpha) * prev)
+        return store[key]
+
+    def fold(self, attr: Attribution) -> Optional[dict]:
+        """Fold one capture window's attribution; returns the rolling
+        snapshot (None for a window that attributed nothing — an empty
+        capture is not evidence of zero throughput)."""
+        if not attr.modules:
+            return None
+        win_secs = sum(m.device_seconds for m in attr.modules.values())
+        win_bytes = sum(m.modeled_bytes_per_call * m.invocations
+                        for m in attr.modules.values())
+        win_flops = sum(m.modeled_flops_per_call * m.invocations
+                        for m in attr.modules.values())
+        phases: Dict[str, float] = collections.defaultdict(float)
+        skews: List[float] = []
+        for m in attr.modules.values():
+            for ph, s in m.phase_seconds.items():
+                phases[ph] += s
+            skews.extend(m.skew_samples())
+        with self._lock:
+            self.windows += 1
+            self._ewma(self._totals, "device_seconds", win_secs)
+            self._ewma(self._totals, "modeled_bytes", win_bytes)
+            self._ewma(self._totals, "modeled_flops", win_flops)
+            for ph, s in phases.items():
+                self._ewma(self._phases, ph, s)
+            if skews:
+                x = tracing.sample_quantile(skews, 0.99)
+                self._skew_p99 = (
+                    x if self._skew_p99 is None
+                    else self.alpha * x
+                    + (1.0 - self.alpha) * self._skew_p99)
+            for digest, m in attr.modules.items():
+                ex = self._execs.setdefault(digest, {})
+                self._ewma(ex, "device_seconds", m.device_seconds)
+                self._ewma(ex, "modeled_bytes",
+                           m.modeled_bytes_per_call * m.invocations)
+                self._ewma(ex, "modeled_flops",
+                           m.modeled_flops_per_call * m.invocations)
+                self._ewma(ex, "invocations", float(m.invocations))
+            snap = self._snapshot_locked()
+        tracing.inc_counter(ROLLING_FOLDS)
+        self._publish(snap)
+        return snap
+
+    @staticmethod
+    def _rate(num: float, secs: float) -> float:
+        return num / secs / 1e9 if secs > 0 else 0.0
+
+    def _snapshot_locked(self) -> dict:
+        t = self._totals
+        out = {
+            "windows": self.windows,
+            "device_seconds": t.get("device_seconds", 0.0),
+            "modeled_bytes": t.get("modeled_bytes", 0.0),
+            "modeled_flops": t.get("modeled_flops", 0.0),
+            "gbps": self._rate(t.get("modeled_bytes", 0.0),
+                               t.get("device_seconds", 0.0)),
+            "gflops": self._rate(t.get("modeled_flops", 0.0),
+                                 t.get("device_seconds", 0.0)),
+            "phase_seconds": dict(self._phases),
+            "shard_skew_p99": self._skew_p99 or 0.0,
+            "executables": {},
+        }
+        for digest, ex in self._execs.items():
+            out["executables"][digest] = {
+                "device_seconds": ex.get("device_seconds", 0.0),
+                "invocations": ex.get("invocations", 0.0),
+                "gbps": self._rate(ex.get("modeled_bytes", 0.0),
+                                   ex.get("device_seconds", 0.0)),
+                "gflops": self._rate(ex.get("modeled_flops", 0.0),
+                                     ex.get("device_seconds", 0.0)),
+            }
+        return out
+
+    def snapshot(self) -> dict:
+        """The current rolling state (the gauges' source of truth)."""
+        with self._lock:
+            return self._snapshot_locked()
+
+    def _publish(self, snap: dict) -> None:
+        p = ROLLING_PREFIX
+        vals = {
+            p + "windows": float(snap["windows"]),
+            p + "device_seconds": snap["device_seconds"],
+            p + "modeled_bytes": snap["modeled_bytes"],
+            p + "modeled_flops": snap["modeled_flops"],
+            p + "gbps": snap["gbps"],
+            p + "gflops": snap["gflops"],
+            p + "shard_skew_p99": snap["shard_skew_p99"],
+        }
+        for ph, s in snap["phase_seconds"].items():
+            vals[f"{p}phase.{ph}_seconds"] = s
+        for digest, ex in snap["executables"].items():
+            base = f"serving.executable.{digest}."
+            vals[base + "rolling_gbps"] = ex["gbps"]
+            vals[base + "rolling_device_seconds"] = ex["device_seconds"]
+        tracing.set_gauges(vals)
+
+    def publish(self) -> dict:
+        """Re-publish the rolling gauges from the held state (scrape
+        refresh after a ``metrics.reset()``) and return the snapshot."""
+        snap = self.snapshot()
+        if snap["windows"]:
+            self._publish(snap)
+        return snap

@@ -558,6 +558,27 @@ MESH_SHARD_SKEW = "serving.mesh.shard_skew"
 MESH_SLOWEST_SHARD = "serving.mesh.slowest_shard"
 MESH_SHARD_TIME_MAX = "serving.mesh.shard_time_max_s"
 MESH_SHARD_TIME_MEAN = "serving.mesh.shard_time_mean_s"
+# per-dispatch skew distribution (graftfleet, PR 12): when a capture's
+# invocation windows yield one skew sample PER DISPATCH, the
+# distribution publishes next to the last-dispatch gauge above
+MESH_SHARD_SKEW_P50 = "serving.mesh.shard_skew_p50"
+MESH_SHARD_SKEW_P99 = "serving.mesh.shard_skew_p99"
+
+
+def sample_quantile(samples, q: float) -> float:
+    """Linear-interpolated q-quantile of a small host-side sample list
+    (numpy's default method, dependency-free) — 0.0 when empty. Pure
+    function: the per-dispatch skew gauges are pinned exactly by the
+    capture fixtures."""
+    ts = sorted(float(s) for s in samples)
+    if not ts:
+        return 0.0
+    if len(ts) == 1:
+        return ts[0]
+    pos = q * (len(ts) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(ts) - 1)
+    return ts[lo] + (ts[hi] - ts[lo]) * (pos - lo)
 
 
 def straggler_stats(timings) -> dict:
@@ -628,6 +649,7 @@ def record_mesh_spans(family: str, t0: float, t1: float, *,
                       phases: Optional[dict] = None,
                       shard_timings=None,
                       shard_attrs: Optional[dict] = None,
+                      skew_samples=None,
                       count_dispatch: bool = True) -> dict:
     """Record one mesh dispatch into the flight recorder: a
     ``serving.mesh.<phase>`` span per entry of ``phases`` (attrs carry
@@ -645,7 +667,14 @@ def record_mesh_spans(family: str, t0: float, t1: float, *,
     graftflight's measured re-emission marks them ``modeled: False``
     with ``source: "profiler"`` — and ``count_dispatch=False`` skips
     the ``serving.mesh.dispatches`` bump (re-attributing already
-    counted dispatches from a capture is not a new dispatch)."""
+    counted dispatches from a capture is not a new dispatch).
+
+    ``skew_samples`` (graftfleet, PR 12) carries one shard-skew sample
+    PER DISPATCH — the per-invocation-window skews a capture's
+    gap-clustering yields — and publishes their distribution as the
+    ``serving.mesh.shard_skew_p50``/``_p99`` gauges: a capture holding
+    several dispatches then attributes straggler skew per dispatch
+    instead of smearing it over the whole window."""
     for phase, attrs in (phases or {}).items():
         a = dict(attrs or {})
         a["family"] = family
@@ -667,6 +696,13 @@ def record_mesh_spans(family: str, t0: float, t1: float, *,
         })
         if count_dispatch:
             inc_counter("serving.mesh.dispatches")
+    if skew_samples:
+        stats["shard_skew_p50"] = sample_quantile(skew_samples, 0.50)
+        stats["shard_skew_p99"] = sample_quantile(skew_samples, 0.99)
+        set_gauges({
+            MESH_SHARD_SKEW_P50: stats["shard_skew_p50"],
+            MESH_SHARD_SKEW_P99: stats["shard_skew_p99"],
+        })
     return stats
 
 

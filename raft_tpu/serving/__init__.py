@@ -35,6 +35,17 @@ letting tail latency or overload take the service down:
   (:mod:`raft_tpu.core.profiling`) lands with the span ring, metrics
   snapshot, cost table, and shed rung as an on-disk incident bundle,
   retrievable at ``/incident.json``.
+- :mod:`~raft_tpu.serving.continuous` — :class:`ContinuousCapture`
+  (PR 12 graftfleet): the steady-state half — periodic ~100 ms
+  profiler captures under a ≤1% duty-cycle budget feed the rolling
+  EWMA attribution (``serving.attribution.rolling.*``), deferring to
+  operator and incident captures on the shared profile lock.
+- :mod:`~raft_tpu.serving.federation` — :class:`FleetAggregator`
+  (PR 12 graftfleet): N replicas' ``/snapshot.json`` merged with
+  type-correct semantics (lifetime-ledger counter sums that can never
+  go backwards, bucket-merged histograms, fleet probe coverage,
+  pooled-Wilson recall, pooled drift) served at ``/fleet.json`` and
+  as ``replica=``-labeled Prometheus families.
 
 graftscope v2 (PR 7) additions: deadline-SLO attainment counters and
 a sliding-window burn-rate gauge (:class:`~raft_tpu.serving.metrics
@@ -54,7 +65,12 @@ from raft_tpu.serving.batcher import (
     BatcherConfig,
     DynamicBatcher,
 )
+from raft_tpu.serving.continuous import (
+    ContinuousCapture,
+    ContinuousConfig,
+)
 from raft_tpu.serving.exporter import MetricsExporter
+from raft_tpu.serving.federation import FleetAggregator, FleetConfig
 from raft_tpu.serving.flight import (
     FlightConfig,
     FlightRecorder,
@@ -88,9 +104,13 @@ __all__ = [
     "AdmissionQueue",
     "BatcherConfig",
     "Cancelled",
+    "ContinuousCapture",
+    "ContinuousConfig",
     "DeadlineExceeded",
     "DriftDetector",
     "DynamicBatcher",
+    "FleetAggregator",
+    "FleetConfig",
     "FlightConfig",
     "FlightRecorder",
     "IndexGauge",

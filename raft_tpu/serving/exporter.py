@@ -35,6 +35,14 @@ Endpoints:
   the multiburn alert or the latency-anomaly check fires (404 while no
   incident has been captured, or no :class:`~raft_tpu.serving.flight
   .FlightRecorder` is attached).
+- ``/fleet.json`` — the merged multi-replica view (PR 12 graftfleet):
+  with a :class:`~raft_tpu.serving.federation.FleetAggregator`
+  attached, one scrape-and-merge over every replica's
+  ``/snapshot.json`` — lifetime-ledger counter sums, bucket-merged
+  histograms, fleet probe coverage, pooled-Wilson recall, pooled
+  drift, per-replica health (404 when no aggregator is attached).
+  The federated families also append to ``/metrics`` as
+  ``replica=``-labeled + fleet-aggregate samples.
 - ``/healthz`` — liveness probe.
 
 Prometheus label support (PR 7): the per-executable cost gauges render
@@ -95,6 +103,14 @@ _HEALTH_GAUGE = re.compile(
     r"^index\.health\.([^.]+)\.([a-z0-9_]+)$")
 _DRIFT_GAUGE = re.compile(
     r"^index\.drift\.([^.]+)\.(score|alert|rebaselines)$")
+# graftfleet (PR 12) labeled families: per-replica health gauges the
+# aggregator publishes, fleet probe coverage + drift per index
+_FLEET_REPLICA_GAUGE = re.compile(
+    r"^fleet\.replica\.([^.]+)\.([a-z0-9_]+)$")
+_FLEET_PROBE_GAUGE = re.compile(
+    r"^fleet\.probe_freq\.([^.]+)\.([a-z0-9_]+)$")
+_FLEET_DRIFT_GAUGE = re.compile(
+    r"^fleet\.drift\.([^.]+)\.(score)$")
 # per-params-class latency histograms (PR 11 graftflight satellite):
 # serving.batcher.execute_seconds.p<NP> renders as the base family
 # with a params_class label, pairing the sweep recall gauges
@@ -113,11 +129,19 @@ _HELP_PREFIXES = (
     ("serving.execute.", "executor dispatch accounting"),
     ("serving.mesh.", "mesh straggler attribution"),
     ("serving.slo.", "deadline-SLO attainment and burn rate"),
+    ("serving.attribution.rolling.", "graftfleet rolling device-truth "
+                                     "attribution (EWMA over "
+                                     "continuous capture windows)"),
     ("serving.attribution.", "graftflight measured device-time "
                              "attribution totals"),
+    ("serving.continuous.", "graftfleet continuous low-duty-cycle "
+                            "capture scheduler"),
     ("serving.", "serving-path metric"),
     ("profiling.", "graftflight profiler-trace ingestion"),
     ("incident.", "graftflight incident-capture flight recorder"),
+    ("continuous.", "graftfleet continuous-capture scheduling "
+                    "accounting"),
+    ("fleet.", "graftfleet multi-replica federation"),
     ("index.probe_freq.", "graftgauge per-list probe-frequency "
                           "accounting"),
     ("index.probe.", "graftgauge probe-accounting dispatch heartbeat"),
@@ -242,6 +266,23 @@ def render_prometheus(counters: dict, gauges: dict, histograms: dict,
                         f"index_drift_{prom_name(m.group(2))}",
                         "index.drift.", f'index="{m.group(1)}"', v)
                     continue
+                m = _FLEET_REPLICA_GAUGE.match(name)
+                if m:
+                    add_labeled(
+                        f"fleet_replica_{prom_name(m.group(2))}",
+                        "fleet.", f'replica="{m.group(1)}"', v)
+                    continue
+                m = _FLEET_PROBE_GAUGE.match(name)
+                if m:
+                    add_labeled(
+                        f"fleet_probe_freq_{prom_name(m.group(2))}",
+                        "fleet.", f'index="{m.group(1)}"', v)
+                    continue
+                m = _FLEET_DRIFT_GAUGE.match(name)
+                if m:
+                    add_labeled("fleet_drift_score", "fleet.",
+                                f'index="{m.group(1)}"', v)
+                    continue
         pn = prom_name(name)
         emit_family(pn, "gauge", name)
         lines.append(f"{pn} {_fmt(v)}")
@@ -297,7 +338,8 @@ class MetricsExporter:
                  host: str = "127.0.0.1", port: int = 0,
                  profile_dir: Optional[str] = None,
                  legacy_executable_metrics: bool = False,
-                 index_gauge=None, flight=None):
+                 index_gauge=None, flight=None, continuous=None,
+                 fleet=None):
         self.executor = executor
         self.batcher = batcher
         self.host = host
@@ -312,30 +354,59 @@ class MetricsExporter:
         # triggers per scrape and backs /incident.json (404 while no
         # incident has been captured — or no recorder is attached)
         self.flight = flight
+        # graftfleet (PR 12): a ContinuousCapture ticks per scrape —
+        # its low-duty-cycle captures keep the rolling attribution
+        # fresh — and a FleetAggregator backs /fleet.json plus the
+        # replica=-labeled exposition appended to /metrics
+        self.continuous = continuous
+        self.fleet = fleet
         self._profile_lock = threading.Lock()
-        if flight is not None and getattr(flight, "profile_lock",
-                                          None) is None:
-            # one profiler capture at a time, BOTH directions: the
-            # recorder's automatic capture defers while /profile runs,
-            # and /profile 409s while an incident is being captured
-            flight.profile_lock = self._profile_lock
+        for owner in (flight, continuous):
+            if owner is not None and getattr(owner, "profile_lock",
+                                             None) is None:
+                # one profiler capture at a time, ALL directions: the
+                # recorder's automatic capture defers while /profile
+                # runs, /profile 409s while an incident is being
+                # captured, and the continuous tick — the lowest-
+                # priority customer — defers to both
+                owner.profile_lock = self._profile_lock
         self._server: Optional[http.server.ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     # -- payloads (usable without the HTTP server, e.g. in tests) -----------
 
     def prometheus_text(self) -> str:
-        """The ``/metrics`` body: full registries, freshly read."""
+        """The ``/metrics`` body: full registries, freshly read; with
+        a :class:`~raft_tpu.serving.federation.FleetAggregator`
+        attached, the ``replica=``-labeled + fleet-aggregate federated
+        families append after the local ones."""
         self._refresh()
-        return render_prometheus(
+        if self.fleet is not None:
+            # one scrape-and-merge per exposition: refreshes the
+            # fleet.* gauges BEFORE the local registries render, so
+            # the health/coverage families below are current
+            self.fleet.fleet_snapshot()
+        text = render_prometheus(
             tracing.counters(), tracing.gauges(), tracing.histograms(),
             legacy_executable_metrics=self.legacy_executable_metrics)
+        if self.fleet is not None:
+            text += self.fleet.prometheus_text()
+        return text
 
     def snapshot(self) -> dict:
-        """The ``/snapshot.json`` body."""
+        """The ``/snapshot.json`` body. Since PR 12 it also carries
+        the federation inputs a :class:`~raft_tpu.serving.federation
+        .FleetAggregator` merges: ``counters_lifetime`` (the
+        reset-proof ledger fleet counters sum from — the live
+        ``counters`` view can go backwards across a
+        ``reset_counters()``, the ledger cannot) and, when an
+        :class:`~raft_tpu.serving.gauge.IndexGauge` is attached, the
+        ``federation`` block (full probe planes, raw recall trials,
+        drift state)."""
         self._refresh()
         out = dict(serving_metrics.snapshot())
         out["xla"] = tracing.counters("xla.")
+        out["counters_lifetime"] = tracing.lifetime_counters()
         if self.executor is not None and hasattr(self.executor,
                                                  "executable_costs"):
             out["executables"] = self.executor.executable_costs()
@@ -346,6 +417,9 @@ class MetricsExporter:
                 "shed_level": q.shed_level(),
                 "arrival_rate_hz": q.arrival_rate(),
             }
+        if self.index_gauge is not None and hasattr(
+                self.index_gauge, "federation_payload"):
+            out["federation"] = self.index_gauge.federation_payload()
         rec = tracing.span_recorder()
         out["spans"] = {"recorded": len(rec), "dropped": rec.dropped,
                         "capacity": rec.capacity}
@@ -420,6 +494,13 @@ class MetricsExporter:
             # blocks for the short capture; that is the design — the
             # incident evidence is worth one slow scrape)
             self.flight.check()
+        if self.continuous is not None:
+            # graftfleet: the continuous tick runs AFTER the incident
+            # check — incident captures grab the shared profile lock
+            # first and the tick defers to them (and to /profile); a
+            # due tick costs the scrape one short capture, the
+            # duty-cycle budget bounds how often
+            self.continuous.tick()
 
     def index_snapshot(self) -> dict:
         """The ``/index.json`` body: the attached
@@ -478,6 +559,15 @@ class MetricsExporter:
                         return
                     self._send(json.dumps(out, default=str).encode(),
                                "application/json")
+                elif path == "/fleet.json":
+                    if exporter.fleet is None:
+                        self._send(b"no FleetAggregator attached\n",
+                                   "text/plain", 404)
+                        return
+                    self._send(
+                        json.dumps(exporter.fleet.fleet_snapshot(),
+                                   default=str).encode(),
+                        "application/json")
                 elif path == "/incident.json":
                     bundle = (exporter.flight.latest()
                               if exporter.flight is not None else None)

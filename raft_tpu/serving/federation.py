@@ -1,0 +1,496 @@
+"""graftfleet metric federation (PR 12) — N replicas, one truth.
+
+Every gauge plane the repo grew through PRs 6-11 — probe frequency,
+recall windows, drift, SLO burn, attribution — is per-executor-
+process: a deployment serving millions of users across N replicas has
+N disconnected truths. :class:`FleetAggregator` closes that gap the
+Prometheus-federation way: it scrapes each replica's
+``/snapshot.json`` (stdlib urllib, bounded staleness, per-replica
+health) and merges them with TYPE-CORRECT semantics — summing a gauge
+that doesn't sum, or Wilson-intervaling per-replica estimates, would
+produce confident nonsense:
+
+- **Counters** sum from the **lifetime ledger**
+  (``counters_lifetime`` — :func:`raft_tpu.core.tracing
+  .lifetime_counters`), not the resettable live registries: a
+  replica's mid-scrape ``reset_counters()`` folds into its ledger
+  instead of vanishing, and the aggregator additionally holds a
+  per-(replica, counter) high-water mark so a fleet counter can NEVER
+  go backwards (regressions are clamped and counted in
+  ``fleet.monotonicity_violations`` — a restarted replica resets its
+  ledger legitimately; the fleet total must still be monotone).
+- **Histograms** merge bucket-wise (same log2 bounds across the repo;
+  cumulative bucket vectors sum elementwise) and the fleet quantiles
+  recompute from the MERGED distribution — never averaged p99s.
+- **Probe-frequency planes** sum per list into fleet hot/cold
+  coverage (:func:`raft_tpu.core.tracing.probe_freq_stats` over the
+  summed plane) — the tiered-storage placement signal at deployment
+  scope, not per replica.
+- **Recall windows** pool raw trials across replicas BEFORE the
+  Wilson interval — strictly tighter than any combination of
+  per-replica intervals.
+- **Drift** re-scores the POOLED live histogram against the pooled
+  baseline, so a drifted replica weighs by its traffic share.
+
+Staleness contract: a replica whose scrape fails keeps serving its
+last snapshot until ``staleness_s``, then drops unhealthy. CUMULATIVE
+surfaces (counters, probe planes) retain the stale replica's
+last-known values — they are monotone lower bounds on truth, and
+dropping them would make fleet counters jump backwards. WINDOWED and
+instantaneous surfaces (recall, drift, admission gauges, histograms)
+come from healthy replicas only — stale window contents are not
+current state.
+
+The merged view serves as ``/fleet.json`` plus a ``replica=``-labeled
+and fleet-aggregate Prometheus exposition through the aggregator's
+own :class:`~raft_tpu.serving.exporter.MetricsExporter`
+(``MetricsExporter(fleet=...)``). Clock discipline (graftlint R7):
+staleness ages come from the injected clock; host-sync discipline
+(R5): everything here is urllib + dict work — no device anywhere.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import json
+import threading
+import urllib.request
+from typing import Dict, List, Optional
+
+from raft_tpu.core import tracing
+from raft_tpu.serving.batcher import MonotonicClock
+from raft_tpu.serving.flight import window_quantile
+from raft_tpu.serving.gauge import wilson_interval
+
+SCRAPES = "fleet.scrapes"
+SCRAPE_ERRORS = "fleet.scrape_errors"
+MONOTONICITY_VIOLATIONS = "fleet.monotonicity_violations"
+BOUND_MISMATCHES = "fleet.histogram_bound_mismatches"
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """``staleness_s`` bounds how long a failed replica's last
+    snapshot keeps representing it; ``timeout_s`` is the per-replica
+    HTTP fetch timeout (a hung replica must not stall the whole fleet
+    scrape past it)."""
+
+    staleness_s: float = 60.0
+    timeout_s: float = 2.0
+
+
+@dataclasses.dataclass
+class ReplicaState:
+    """One replica's scrape bookkeeping (all timestamps clock-domain)."""
+
+    name: str
+    url: str
+    snapshot: Optional[dict] = None
+    scraped_at: Optional[float] = None
+    scrapes: int = 0
+    errors: int = 0
+    last_error: Optional[str] = None
+
+    def age_s(self, now: float) -> float:
+        return (float("inf") if self.scraped_at is None
+                else now - self.scraped_at)
+
+    def healthy(self, now: float, staleness_s: float) -> bool:
+        return self.snapshot is not None and \
+            self.age_s(now) <= staleness_s
+
+
+def _http_fetch(url: str, timeout: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def merge_histograms(snaps: List[dict]) -> Optional[dict]:
+    """Bucket-wise merge of same-bounds histogram snapshots (the
+    :meth:`~raft_tpu.core.tracing.Histogram.snapshot` shape):
+    cumulative bucket vectors sum elementwise, quantiles recompute
+    from the merged distribution. None when no snapshot matches the
+    first one's bounds (callers count the mismatch)."""
+    snaps = [s for s in snaps if s and s.get("bucket_bounds")]
+    if not snaps:
+        return None
+    bounds = list(snaps[0]["bucket_bounds"])
+    merged = [s for s in snaps if list(s["bucket_bounds"]) == bounds]
+    cum = [0] * (len(bounds) + 1)
+    count, total = 0, 0.0
+    for s in merged:
+        for i, c in enumerate(s["bucket_counts"]):
+            cum[i] += c
+        count += s["count"]
+        total += s["sum"]
+    return {
+        "count": count,
+        "sum": total,
+        "p50": window_quantile(bounds, cum, 0.50),
+        "p95": window_quantile(bounds, cum, 0.95),
+        "p99": window_quantile(bounds, cum, 0.99),
+        "bucket_bounds": bounds,
+        "bucket_counts": cum,
+        "replicas": len(merged),
+        "dropped_bound_mismatch": len(snaps) - len(merged),
+    }
+
+
+class FleetAggregator:
+    """Scrape-and-merge federation over N replica exporters.
+
+    ``replicas`` maps replica names to their ``/snapshot.json`` URLs
+    (a bare base URL gets the path appended); a plain list of URLs
+    auto-names them ``r0..rN``. ``fetch`` overrides the HTTP fetch
+    (tests and fixtures inject ``fetch(url, timeout) -> dict``).
+
+    Example::
+
+        agg = FleetAggregator({"a": "http://10.0.0.1:9100",
+                               "b": "http://10.0.0.2:9100"})
+        exp = MetricsExporter(fleet=agg, port=9200)
+        # curl :9200/fleet.json   — the merged fleet view
+        # curl :9200/metrics      — replica=-labeled + fleet families
+    """
+
+    def __init__(self, replicas, *,
+                 config: Optional[FleetConfig] = None, clock=None,
+                 fetch=None):
+        self.config = config or FleetConfig()
+        self._clock = clock if clock is not None else MonotonicClock()
+        self._fetch = fetch if fetch is not None else _http_fetch
+        if not isinstance(replicas, dict):
+            replicas = {f"r{i}": u for i, u in enumerate(replicas)}
+        self._lock = threading.Lock()
+        self._states: Dict[str, ReplicaState] = {}
+        for name, url in replicas.items():
+            if not url.endswith(".json"):
+                url = url.rstrip("/") + "/snapshot.json"
+            self._states[name] = ReplicaState(name=name, url=url)
+        # per-(replica, counter) high-water marks: the monotonicity
+        # assertion — a fleet counter can never go backwards, however
+        # a replica's registries were reset mid-scrape
+        self._high: Dict[str, Dict[str, float]] = {
+            name: {} for name in self._states}
+        # the last merged view (set by merge()): the exposition path
+        # renders from it instead of re-running the whole merge —
+        # /metrics already merged once in fleet_snapshot()
+        self._last_merged: Optional[dict] = None
+
+    # -- scraping -----------------------------------------------------------
+
+    def _clamp_counters_locked(self, name: str, snap: dict) -> None:
+        """Fold one snapshot's lifetime counters into the replica's
+        high-water marks. The LIFETIME ledger is the source — the
+        resettable live ``counters`` view is only a fallback for
+        payloads predating it — and any regression (replica restart)
+        clamps to the mark rather than dragging the fleet sum down."""
+        counters = snap.get("counters_lifetime")
+        if not isinstance(counters, dict):
+            counters = snap.get("counters") or {}
+        high = self._high[name]
+        for cname, v in counters.items():
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            prev = high.get(cname, 0.0)
+            if v < prev:
+                tracing.inc_counter(MONOTONICITY_VIOLATIONS)
+            high[cname] = max(prev, v)
+
+    def scrape(self, now: Optional[float] = None) -> int:
+        """Fetch every replica's snapshot once — CONCURRENTLY, so N
+        hung replicas stall the whole scrape by ~one ``timeout_s``,
+        not N of them stacked (the scrape runs inside the exporter's
+        ``/metrics`` handler; a partial outage must not push the
+        aggregator's own exposition past the Prometheus scrape
+        timeout exactly when the fleet view matters most). Returns
+        the healthy count. A failed fetch keeps the replica's
+        previous snapshot (bounded by ``staleness_s`` at merge time)
+        and counts into its error tally + ``fleet.scrape_errors``."""
+        if now is None:
+            now = self._clock.now()
+        tracing.inc_counter(SCRAPES)
+        states = list(self._states.values())
+        if len(states) == 1:
+            results = [self._fetch_one(states[0])]
+        else:
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(8, len(states))) as pool:
+                results = list(pool.map(self._fetch_one, states))
+        healthy = 0
+        for state, (snap, err) in zip(states, results):
+            if err is not None:
+                state.errors += 1
+                state.last_error = err
+                tracing.inc_counter(SCRAPE_ERRORS)
+                continue
+            with self._lock:
+                state.snapshot = snap
+                state.scraped_at = now
+                state.scrapes += 1
+                self._clamp_counters_locked(state.name, snap)
+        for state in states:
+            if state.healthy(now, self.config.staleness_s):
+                healthy += 1
+        return healthy
+
+    def _fetch_one(self, state: ReplicaState) -> tuple:
+        """(snapshot, None) or (None, error-text) — one replica's
+        fetch, exception-safe (one dead replica must not fail the
+        fleet scrape; pool.map would re-raise)."""
+        try:
+            snap = self._fetch(state.url, self.config.timeout_s)
+            if not isinstance(snap, dict):
+                raise ValueError(
+                    f"replica {state.name} returned "
+                    f"{type(snap).__name__}, not a snapshot dict")
+            return snap, None
+        except Exception as e:  # noqa: BLE001
+            return None, f"{type(e).__name__}: {e}"
+
+    # -- merging (pure functions of the scraped state) ----------------------
+
+    def _merge_locked(self, now: float) -> dict:
+        cfg = self.config
+        states = list(self._states.values())
+        healthy = [s for s in states
+                   if s.healthy(now, cfg.staleness_s)]
+        out: dict = {
+            "size": len(states),
+            "healthy": len(healthy),
+            "replicas": {
+                s.name: {
+                    "url": s.url,
+                    "healthy": s.healthy(now, cfg.staleness_s),
+                    "age_s": (None if s.scraped_at is None
+                              else now - s.scraped_at),
+                    "scrapes": s.scrapes,
+                    "errors": s.errors,
+                    "last_error": s.last_error,
+                } for s in states},
+        }
+        # counters: lifetime-ledger sums over the high-water marks —
+        # stale replicas retain their last-known (monotone lower
+        # bound) contribution; see the module docstring
+        counters: Dict[str, float] = {}
+        for name, high in self._high.items():
+            for cname, v in high.items():
+                counters[cname] = counters.get(cname, 0.0) + v
+        out["counters"] = counters
+        # histograms: bucket-wise merge over HEALTHY replicas
+        names: set = set()
+        for s in healthy:
+            names.update((s.snapshot.get("histograms") or {}))
+        hists = {}
+        for hname in sorted(names):
+            snaps = [(s.snapshot.get("histograms") or {}).get(hname)
+                     for s in healthy]
+            merged = merge_histograms([h for h in snaps if h])
+            if merged is None:
+                continue
+            if merged.pop("dropped_bound_mismatch", 0):
+                tracing.inc_counter(BOUND_MISMATCHES)
+            hists[hname] = merged
+        out["histograms"] = hists
+        # probe planes: elementwise sums (stale last-known retained —
+        # cumulative, like the counters) -> fleet hot/cold coverage
+        planes: Dict[str, List[int]] = {}
+        for s in states:
+            if s.snapshot is None:
+                continue
+            fed = s.snapshot.get("federation") or {}
+            for label, plane in (fed.get("probe_planes") or {}).items():
+                acc = planes.setdefault(label, [0] * len(plane))
+                if len(acc) != len(plane):
+                    continue
+                for i, v in enumerate(plane):
+                    acc[i] += int(v)
+        out["probe_freq"] = {
+            label: tracing.probe_freq_stats(plane)
+            for label, plane in planes.items()}
+        # recall: pool raw trials over healthy replicas, THEN Wilson
+        pooled: Dict[str, Dict[str, int]] = {}
+        for s in healthy:
+            fed = s.snapshot.get("federation") or {}
+            for key, raw in (fed.get("recall") or {}).items():
+                acc = pooled.setdefault(
+                    key, {"hits": 0, "trials": 0, "pairs": 0})
+                for k in acc:
+                    acc[k] += int(raw.get(k, 0))
+        recall = {}
+        for key, acc in pooled.items():
+            lo, hi = wilson_interval(acc["hits"], acc["trials"])
+            recall[key] = {
+                **acc,
+                "estimate": (acc["hits"] / acc["trials"]
+                             if acc["trials"] else 0.0),
+                "ci_low": lo, "ci_high": hi,
+            }
+        out["recall"] = recall
+        # drift: re-score the pooled live histogram vs pooled
+        # baseline. Each replica's live histogram is NORMALIZED (its
+        # DriftDetector EWMA-folds per-window distributions), so it
+        # must be scaled by the replica's ``traffic`` weight before
+        # summing — otherwise an idle replica weighs the same as one
+        # carrying 99% of fleet traffic and a heavily-drifted busy
+        # replica gets averaged away by quiet healthy peers. Payloads
+        # predating the weight fall back to 1.0 (equal weight).
+        drift_live: Dict[str, List[float]] = {}
+        drift_base: Dict[str, List[float]] = {}
+        for s in healthy:
+            fed = s.snapshot.get("federation") or {}
+            for iname, st in (fed.get("drift") or {}).items():
+                base = st.get("baseline") or []
+                live = st.get("live")
+                acc_b = drift_base.setdefault(iname, [0.0] * len(base))
+                if len(acc_b) == len(base):
+                    for i, v in enumerate(base):
+                        acc_b[i] += float(v)
+                if live is not None:
+                    w = float(st.get("traffic", 1.0)) or 1.0
+                    acc_l = drift_live.setdefault(
+                        iname, [0.0] * len(live))
+                    if len(acc_l) == len(live):
+                        for i, v in enumerate(live):
+                            acc_l[i] += w * float(v)
+        out["drift"] = {
+            iname: {
+                "score": tracing.js_divergence(
+                    drift_live.get(iname, []), base),
+                "replicas": sum(
+                    1 for s in healthy
+                    if iname in ((s.snapshot.get("federation") or {})
+                                 .get("drift") or {})),
+            }
+            for iname, base in drift_base.items()}
+        # admission: depth/rate sum (fleet-wide queue pressure), shed
+        # level is a rung — the fleet's worst rung is the signal
+        depth = rate = 0.0
+        shed = 0
+        for s in healthy:
+            adm = s.snapshot.get("admission") or {}
+            depth += float(adm.get("queue_depth", 0))
+            rate += float(adm.get("arrival_rate_hz", 0.0))
+            shed = max(shed, int(adm.get("shed_level", 0)))
+        out["admission"] = {"queue_depth": depth,
+                            "arrival_rate_hz": rate,
+                            "max_shed_level": shed}
+        return out
+
+    def merge(self, now: Optional[float] = None) -> dict:
+        """The merged fleet view from the current scraped state (no
+        fetches) — pure of everything but the stored snapshots, so
+        the fixture tests pin it exactly."""
+        if now is None:
+            now = self._clock.now()
+        with self._lock:
+            out = self._merge_locked(now)
+            self._last_merged = out
+        self._publish(out)
+        return out
+
+    def _publish(self, merged: dict) -> None:
+        """Re-publish the fleet gauges into the aggregator process's
+        own registries (its exporter renders them labeled)."""
+        vals = {
+            "fleet.replicas": float(merged["size"]),
+            "fleet.replicas_healthy": float(merged["healthy"]),
+        }
+        for name, r in merged["replicas"].items():
+            base = f"fleet.replica.{name}."
+            vals[base + "healthy"] = 1.0 if r["healthy"] else 0.0
+            vals[base + "age_s"] = (-1.0 if r["age_s"] is None
+                                    else r["age_s"])
+            vals[base + "errors"] = float(r["errors"])
+        for label, stats in merged["probe_freq"].items():
+            base = f"fleet.probe_freq.{label}."
+            for k in ("total", "probed_fraction", "coverage_p01",
+                      "coverage_p10"):
+                vals[base + k] = float(stats[k])
+        live = merged["recall"].get("live")
+        if live:
+            vals.update({
+                "fleet.recall.estimate": live["estimate"],
+                "fleet.recall.ci_low": live["ci_low"],
+                "fleet.recall.ci_high": live["ci_high"],
+                "fleet.recall.trials": float(live["trials"]),
+            })
+        for iname, d in merged["drift"].items():
+            vals[f"fleet.drift.{iname}.score"] = d["score"]
+        tracing.set_gauges(vals)
+
+    def fleet_snapshot(self, now: Optional[float] = None) -> dict:
+        """One scrape + merge — the ``/fleet.json`` body."""
+        if now is None:
+            now = self._clock.now()
+        self.scrape(now)
+        return self.merge(now)
+
+    # -- Prometheus exposition ----------------------------------------------
+
+    def prometheus_text(self, now: Optional[float] = None) -> str:
+        """``replica=``-labeled and fleet-aggregate exposition of the
+        federated counters and histograms (appended to the attached
+        exporter's ``/metrics`` body; the fleet gauges themselves ride
+        the normal registry rendering). Every federated family is
+        ``fleet_``-prefixed so it can never collide with a same-named
+        family of the aggregator process's OWN registries in one
+        exposition body. Per family: one sample per replica carrying
+        its clamped lifetime value, plus the ``replica="fleet"`` sum —
+        so dashboards slice per replica or fleet-wide with one PromQL
+        label matcher."""
+        from raft_tpu.serving.exporter import _fmt, help_text, prom_name
+
+        if now is None:
+            now = self._clock.now()
+        with self._lock:
+            # reuse the merge the preceding fleet_snapshot() already
+            # ran (the exporter calls them back to back) — merging
+            # every histogram twice per scrape doubles the handler's
+            # blocking work for nothing; standalone callers without a
+            # prior merge still get a fresh one
+            merged = (self._last_merged if self._last_merged is not None
+                      else self._merge_locked(now))
+            per_replica = {name: dict(high)
+                           for name, high in self._high.items()}
+            healthy = [s.name for s in self._states.values()
+                       if s.healthy(now, self.config.staleness_s)]
+            rep_hists = {
+                s.name: dict(s.snapshot.get("histograms") or {})
+                for s in self._states.values()
+                if s.name in healthy}
+        lines = []
+        for cname in sorted(merged["counters"]):
+            pn = "fleet_" + prom_name(cname)
+            lines.append(f"# HELP {pn} {help_text(cname)}")
+            lines.append(f"# TYPE {pn} counter")
+            for rname in sorted(per_replica):
+                v = per_replica[rname].get(cname)
+                if v is not None:
+                    lines.append(f'{pn}{{replica="{rname}"}} {_fmt(v)}')
+            lines.append(f'{pn}{{replica="fleet"}} '
+                         f'{_fmt(merged["counters"][cname])}')
+        for hname in sorted(merged["histograms"]):
+            pn = "fleet_" + prom_name(hname)
+            lines.append(f"# HELP {pn} {help_text(hname)}")
+            lines.append(f"# TYPE {pn} histogram")
+            samples = [(rname, rep_hists[rname][hname])
+                       for rname in sorted(rep_hists)
+                       if hname in rep_hists[rname]]
+            samples.append(("fleet", merged["histograms"][hname]))
+            for rname, snap in samples:
+                pre = f'replica="{rname}",'
+                for le, c in zip(snap.get("bucket_bounds", []),
+                                 snap.get("bucket_counts", [])):
+                    lines.append(
+                        f'{pn}_bucket{{{pre}le="{_fmt(le)}"}} {c}')
+                lines.append(f'{pn}_bucket{{{pre}le="+Inf"}} '
+                             f'{snap["count"]}')
+                lines.append(f'{pn}_sum{{replica="{rname}"}} '
+                             f'{_fmt(snap["sum"])}')
+                lines.append(f'{pn}_count{{replica="{rname}"}} '
+                             f'{snap["count"]}')
+        return "\n".join(lines) + "\n" if lines else ""

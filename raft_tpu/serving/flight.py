@@ -54,6 +54,22 @@ INCIDENT_BUNDLES = "incident.bundles"
 INCIDENT_SUPPRESSED = "incident.suppressed"
 
 
+def timed_capture(profile_dir: str, seconds: float) -> Optional[str]:
+    """One short ``jax.profiler`` capture into ``profile_dir``;
+    returns the trace file THIS capture produced, or None when it
+    wrote none (before/after mtime diff — falling back to "newest in
+    the dir" would republish a previous capture's device timings as
+    current evidence). Shared by the flight recorder's incident
+    capture and the continuous low-duty-cycle scheduler
+    (:mod:`raft_tpu.serving.continuous`); callers own the one-capture-
+    at-a-time lock discipline. ``time.sleep`` is a duration, not a
+    clock read — the R7 exemption ``/profile`` documents."""
+    before = profiling.trace_snapshot(profile_dir)
+    with tracing.capture(profile_dir):
+        time.sleep(seconds)
+    return profiling.fresh_trace_file(profile_dir, before)
+
+
 def window_quantile(bounds, cum_window, q: float) -> float:
     """Quantile estimate over a WINDOW histogram given as cumulative
     per-bucket counts (the delta of two
@@ -214,10 +230,8 @@ class FlightRecorder:
             return self.capture_fn()
         if self.profile_dir is None:
             return None
-        before = profiling.trace_snapshot(self.profile_dir)
-        with tracing.capture(self.profile_dir):
-            time.sleep(self.config.capture_seconds)
-        return profiling.fresh_trace_file(self.profile_dir, before)
+        return timed_capture(self.profile_dir,
+                             self.config.capture_seconds)
 
     def _build_bundle(self, now: float, reasons: List[str]) -> dict:
         attribution = None

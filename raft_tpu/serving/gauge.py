@@ -167,6 +167,18 @@ class RecallWindow:
         return {"estimate": est, "ci_low": lo, "ci_high": hi,
                 "pairs": pairs, "trials": trials}
 
+    def raw(self, now: float) -> dict:
+        """The window's UNWEIGHTED hit/trial counts as of ``now`` —
+        the federation payload (graftfleet): replicas pool raw trials
+        and the fleet aggregator applies the Wilson interval to the
+        POOLED sample, which is strictly tighter than any combination
+        of per-replica intervals."""
+        with self._lock:
+            self._prune_locked(now)
+            return {"hits": int(self._hits),
+                    "trials": int(self._trials),
+                    "pairs": len(self._events)}
+
     def publish(self, now: float) -> dict:
         """Re-publish the ``index.recall.*`` gauges as of ``now`` —
         called on every record and by the scrape-time refresh, so the
@@ -421,6 +433,11 @@ class DriftDetector:
         self._lock = threading.Lock()
         self._last: Optional[np.ndarray] = None
         self._ewma: Optional[np.ndarray] = None
+        # EWMA of per-window probe traffic (same alpha): the weight a
+        # fleet aggregator scales this replica's normalized live
+        # histogram by — without it, pooling would weigh an idle
+        # replica the same as one carrying 99% of fleet traffic
+        self._traffic = 0.0
         # identity watch (PR 8 follow-on): which index object this
         # baseline was snapshotted from. extend()/rebuild returns a NEW
         # index whose list_sizes shifted — scoring live traffic against
@@ -480,6 +497,7 @@ class DriftDetector:
             self.baseline = sizes
             self._last = None
             self._ewma = None
+            self._traffic = 0.0
             self.score = 0.0
             self.updates = 0         # folds against the NEW baseline
             self.rebaselines += 1
@@ -488,6 +506,25 @@ class DriftDetector:
     @property
     def alert(self) -> bool:
         return self.score >= self.alert_threshold
+
+    def state(self) -> dict:
+        """The streaming state as plain lists — the federation
+        payload (graftfleet): the fleet aggregator pools replicas'
+        smoothed live histograms and baselines and re-scores the
+        POOLED traffic. ``traffic`` (the EWMA of per-window probe
+        counts) is the pooling weight: the live histogram is
+        NORMALIZED per replica, so without it a drifted replica
+        carrying 99% of fleet traffic would be averaged away by idle
+        undrifted peers."""
+        with self._lock:
+            return {
+                "baseline": [float(v) for v in self.baseline],
+                "live": ([float(v) for v in self._ewma]
+                         if self._ewma is not None else None),
+                "traffic": self._traffic,
+                "score": self.score,
+                "updates": self.updates,
+            }
 
     def update(self, cumulative_counts) -> float:
         """Fold one scrape's cumulative probe plane into the score."""
@@ -502,6 +539,9 @@ class DriftDetector:
             self._ewma = (hist if self._ewma is None
                           else self.alpha * hist
                           + (1.0 - self.alpha) * self._ewma)
+            self._traffic = (float(delta.sum()) if self.updates == 0
+                             else self.alpha * float(delta.sum())
+                             + (1.0 - self.alpha) * self._traffic)
             self.score = tracing.js_divergence(self._ewma,
                                                self.baseline)
             self.updates += 1
@@ -591,4 +631,36 @@ class IndexGauge:
             tracing.set_gauge(tracing.DRIFT_SCORE, worst)
         if self.sampler is not None:
             out["recall"] = self.sampler.publish()
+        return out
+
+    def federation_payload(self) -> dict:
+        """The type-correct merge inputs a fleet aggregator needs
+        beyond the metric registries (graftfleet) — shipped inside
+        ``/snapshot.json`` when an :class:`IndexGauge` is attached:
+
+        - ``probe_planes`` — the FULL cumulative per-list probe
+          plane per label (the top-N gauge samples are a rendering,
+          not a mergeable plane; fleet hot/cold coverage needs every
+          list's count so replica sums land exactly),
+        - ``recall`` — raw windowed hit/trial counts per window
+          (operating point + each sweep leg), pooled fleet-side
+          BEFORE the Wilson interval,
+        - ``drift`` — per watched index the smoothed live histogram
+          and baseline, re-scored fleet-side on the pooled traffic.
+
+        One probe-plane fetch, at scrape time — never per dispatch."""
+        out: dict = {"probe_planes": {}, "recall": {}, "drift": {}}
+        if self.executor is not None and hasattr(self.executor,
+                                                 "probe_frequencies"):
+            out["probe_planes"] = {
+                label: [int(v) for v in plane]
+                for label, plane in
+                self.executor.probe_frequencies().items()}
+        if self.sampler is not None:
+            now = self.sampler._clock.now()
+            out["recall"]["live"] = self.sampler.window.raw(now)
+            for probes, w in self.sampler.sweep_windows.items():
+                out["recall"][f"sweep.p{probes}"] = w.raw(now)
+        for name, det in self.drift.items():
+            out["drift"][name] = det.state()
         return out

@@ -95,6 +95,22 @@ Per-stage latency **histograms** (log2 buckets, p50/p95/p99 estimates):
 - ``profiling.captures`` / ``incident.*`` — trace-ingestion and
   flight-recorder (:mod:`raft_tpu.serving.flight`) lifetime counters
 
+**graftfleet surface** (PR 12):
+
+- ``serving.attribution.rolling.*`` — the EWMA-folded steady-state
+  attribution (:class:`raft_tpu.core.profiling.RollingAttribution`)
+  the continuous low-duty-cycle scheduler
+  (:mod:`raft_tpu.serving.continuous`) feeds; :func:`derived` carries
+  the ``rolling_*`` columns next to the wall-clock and incident-
+  snapshot numbers
+- ``serving.mesh.shard_skew_p50``/``_p99`` — per-dispatch straggler
+  skew distribution from a capture's invocation windows
+- ``continuous.{ticks,captures,deferred,skipped,empty,errors}`` +
+  ``profiling.rolling.folds`` — scheduler/fold lifetime accounting
+- ``fleet.*`` — multi-replica federation
+  (:mod:`raft_tpu.serving.federation`): scrape/health counters, fleet
+  probe coverage, pooled recall, pooled drift
+
 Batch **occupancy** — the coalescing win the ISSUE's acceptance
 criterion gates on — is derived, not stored: ``requests / batches``
 (and ``rows / batches``) from one counters snapshot. Likewise the
@@ -395,6 +411,15 @@ def derived() -> dict:
     out["device_achieved_gflops"] = (
         tracing.get_counter(profiling.ATTRIBUTED_FLOPS) / att_s / 1e9
         if att_s > 0 else 0.0)
+    # graftfleet (PR 12): the ROLLING measured view — EWMA over the
+    # continuous scheduler's periodic capture windows, so this number
+    # is continuously fresh rather than the last incident's snapshot
+    rp = profiling.ROLLING_PREFIX
+    out["rolling_windows"] = tracing.get_gauge(rp + "windows")
+    out["rolling_device_seconds"] = tracing.get_gauge(
+        rp + "device_seconds")
+    out["rolling_gbps"] = tracing.get_gauge(rp + "gbps")
+    out["rolling_gflops"] = tracing.get_gauge(rp + "gflops")
     # per-executable measured view, re-read from the attribution's
     # gauges (one scrape shows each resident program's measured
     # achieved GB/s / GFLOP/s — bytes-per-call x trace invocations

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Regenerate the committed device-free ``.xplane.pb`` fixture
+(``tests/data/graftfleet_capture.xplane.pb``) — the protobuf twin of
+the chrome-trace fixture's mesh module, written with a minimal wire
+encoder so the test pins :mod:`raft_tpu.core.xplane` against bytes no
+jax/profiler version can move underneath it.
+
+Logical content mirrors ``graftflight_capture.trace.json``'s
+``jit_rt_dist_ivf_flat_bbbb02bbbb02`` events exactly — two mesh
+dispatches on two TPU device planes with the named-scope phase
+markers in ``tf_op`` — so ``profiling.attribute`` over either fixture
+yields the SAME pinned mesh attribution. One plane interns the module
+name through ``ref_value`` stats, the other carries plain
+``str_value`` stats: both resolution paths the reader supports are in
+the committed bytes. A host plane with module-less python events
+proves the skip path.
+
+Run:  python scripts/make_xplane_fixture.py
+"""
+
+import os
+import struct
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "data",
+    "graftfleet_capture.xplane.pb")
+
+MODULE = "jit_rt_dist_ivf_flat_bbbb02bbbb02"
+
+# (op name, tf_op scope, offset_us, dur_us) per device plane — the
+# same timings the chrome fixture pins (line timestamp carries the
+# 1000 us base, offsets are line-relative)
+EVENTS = {
+    "/device:TPU:0": [
+        ("all-gather.3", "jit(rt)/coarse_select/all_gather", 0, 100),
+        ("fusion.9", "jit(rt)/scan/while", 100, 400),
+        ("sort.12", "jit(rt)/merge/sort", 500, 50),
+        ("all-gather.3", "jit(rt)/coarse_select/all_gather", 1000, 100),
+        ("fusion.9", "jit(rt)/scan/while", 1100, 400),
+        ("sort.12", "jit(rt)/merge/sort", 1500, 50),
+    ],
+    "/device:TPU:1": [
+        ("all-gather.3", "jit(rt)/coarse_select/all_gather", 0, 100),
+        ("fusion.9", "jit(rt)/scan/while", 100, 600),
+        ("sort.12", "jit(rt)/merge/sort", 700, 50),
+        ("all-gather.3", "jit(rt)/coarse_select/all_gather", 1000, 100),
+        ("fusion.9", "jit(rt)/scan/while", 1100, 600),
+        ("sort.12", "jit(rt)/merge/sort", 1700, 50),
+    ],
+}
+LINE_T0_NS = 1_000_000          # 1000 us — matches the chrome fixture
+
+
+def varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | (0x80 if v else 0))
+        if not v:
+            return bytes(out)
+
+
+def field(num: int, wtype: int, payload: bytes) -> bytes:
+    return varint((num << 3) | wtype) + payload
+
+
+def fv(num: int, v: int) -> bytes:                 # varint field
+    return field(num, 0, varint(v))
+
+
+def fs(num: int, s) -> bytes:                      # length-delimited
+    b = s.encode() if isinstance(s, str) else s
+    return field(num, 2, varint(len(b)) + b)
+
+
+def stat_str(mid: int, s: str) -> bytes:           # XStat str_value
+    return fv(1, mid) + fs(5, s)
+
+
+def stat_ref(mid: int, ref: int) -> bytes:         # XStat ref_value
+    return fv(1, mid) + fv(7, ref)
+
+
+def stat_double(mid: int, v: float) -> bytes:      # XStat double_value
+    return fv(1, mid) + field(2, 1, struct.pack("<d", v))
+
+
+def event(md_id: int, offset_us: float, dur_us: float,
+          stats) -> bytes:
+    out = (fv(1, md_id) + fv(2, int(offset_us * 1e6))
+           + fv(3, int(dur_us * 1e6)))
+    for s in stats:
+        out += fs(4, s)
+    return out
+
+
+def map_entry(key: int, name: str) -> bytes:
+    md = fv(1, key) + fs(2, name)
+    return fv(1, key) + fs(2, md)
+
+
+def plane(name: str, events, *, intern_module: bool) -> bytes:
+    """One XPlane: event metadata ids intern the op names; stat
+    metadata ids 1/2 name the ``hlo_module``/``tf_op`` stats (and,
+    when ``intern_module``, id 10 interns the module STRING so the
+    module stat is a ``ref_value`` — the other resolution path)."""
+    op_ids = {}
+    for op, _, _, _ in events:
+        op_ids.setdefault(op, len(op_ids) + 1)
+    evs = []
+    for op, scope, off, dur in events:
+        if intern_module:
+            stats = [stat_ref(1, 10), stat_str(2, scope)]
+        else:
+            stats = [stat_str(1, MODULE), stat_str(2, scope)]
+        evs.append(event(op_ids[op], off, dur, stats))
+    line = fs(2, "XLA Ops") + fv(3, LINE_T0_NS)
+    for ev in evs:
+        line += fs(4, ev)
+    out = fs(2, name) + fs(3, line)
+    for op, mid in op_ids.items():
+        out += fs(4, map_entry(mid, op))
+    out += fs(5, map_entry(1, "hlo_module"))
+    out += fs(5, map_entry(2, "tf_op"))
+    if intern_module:
+        out += fs(5, map_entry(10, MODULE))
+    return out
+
+
+def host_plane() -> bytes:
+    """Module-less python events the reader must skip — plus an
+    unknown-kind stat (double) on one of them."""
+    line = fs(2, "python") + fv(3, LINE_T0_NS)
+    line += fs(4, event(1, 0, 500, [stat_double(2, 0.5)]))
+    line += fs(4, event(2, 600, 80, []))
+    out = fs(2, "/host:CPU") + fs(3, line)
+    out += fs(4, map_entry(1, "$lax_numpy.py:6155 ones"))
+    out += fs(4, map_entry(2, "ThreadpoolListener::StartRegion"))
+    out += fs(5, map_entry(2, "tf_op"))
+    return out
+
+
+def main() -> None:
+    space = (fs(1, plane("/device:TPU:0", EVENTS["/device:TPU:0"],
+                         intern_module=False))
+             + fs(1, plane("/device:TPU:1", EVENTS["/device:TPU:1"],
+                           intern_module=True))
+             + fs(1, host_plane()))
+    with open(OUT, "wb") as f:
+        f.write(space)
+    print(f"wrote {OUT} ({len(space)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -566,6 +566,64 @@ class TestFixtureCorpus:
         assert lint_lib(ok, ["R5", "R7"],
                         rel="raft_tpu/serving/gauge.py").ok
 
+    def test_r5_r7_cover_graftfleet_modules(self):
+        """PR 12 satellite: the hot scope reaches BOTH new graftfleet
+        serving modules by their real paths — a host sync landing in
+        the continuous scheduler or a bare clock read in the
+        federation aggregator is a finding, not a blind spot (the
+        shipped modules lint clean: timestamps come from injected
+        clocks, the capture's ``time.sleep`` is the documented
+        duration exemption, and federation is urllib + dict work)."""
+        cont_sync = (
+            "def tick(planes):\n"
+            "    return [p.total.item() for p in planes]\n"
+        )
+        bad = lint_lib(cont_sync, ["R5"],
+                       rel="raft_tpu/serving/continuous.py")
+        assert rules_fired(bad) == {"R5"}
+        cont_clock = (
+            "import time\n"
+            "\n"
+            "\n"
+            "def next_tick_due():\n"
+            "    return time.monotonic()\n"
+        )
+        bad = lint_lib(cont_clock, ["R7"],
+                       rel="raft_tpu/serving/continuous.py")
+        assert rules_fired(bad) == {"R7"}
+        fed_clock = (
+            "import time\n"
+            "\n"
+            "\n"
+            "def replica_age(scraped_at):\n"
+            "    return time.time() - scraped_at\n"
+        )
+        bad = lint_lib(fed_clock, ["R7"],
+                       rel="raft_tpu/serving/federation.py")
+        assert rules_fired(bad) == {"R7"}
+        fed_sync = (
+            "def merge_planes(planes):\n"
+            "    return sum(p.sum().item() for p in planes)\n"
+        )
+        bad = lint_lib(fed_sync, ["R5"],
+                       rel="raft_tpu/serving/federation.py")
+        assert rules_fired(bad) == {"R5"}
+        # the conforming discipline both modules actually use:
+        # injected-clock stamps, durations slept not read
+        ok = (
+            "import time\n"
+            "\n"
+            "\n"
+            "def tick(clock, seconds):\n"
+            "    t = clock.now()\n"
+            "    time.sleep(seconds)\n"
+            "    return t\n"
+        )
+        assert lint_lib(ok, ["R5", "R7"],
+                        rel="raft_tpu/serving/continuous.py").ok
+        assert lint_lib(ok, ["R5", "R7"],
+                        rel="raft_tpu/serving/federation.py").ok
+
     def test_r5_r7_cover_graftflight_module(self):
         """PR 11 satellite: the hot scope reaches the new graftflight
         flight-recorder module by its real path — a host sync or a

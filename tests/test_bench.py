@@ -691,7 +691,9 @@ class TestBenchCompare:
                            "index.probe.dispatches": 2.0,
                            "index.probe_freq.accounted": 64.0,
                            "profiling.captures": 1.0,
-                           "incident.bundles": 1.0}}
+                           "incident.bundles": 1.0,
+                           "profiling.rolling.folds": 2.0,
+                           "fleet.scrapes": 1.0}}
         assert bc.check_snapshot(ok) == []
         dark = {"counters": {"serving.execute.calls": 5.0,
                              "serving.execute.modeled_bytes": 0.0}}
@@ -715,6 +717,8 @@ class TestBenchCompare:
                 "index.probe_freq.accounted": 64.0,
                 "profiling.captures": 2.0,
                 "incident.bundles": 1.0,
+                "profiling.rolling.folds": 2.0,
+                "fleet.scrapes": 1.0,
             },
         }
         assert bc.check_snapshot(snap) == []
@@ -773,6 +777,8 @@ class TestBenchCompare:
             "index.probe_freq.accounted": 0.0,     # went dark
             "profiling.captures": 1.0,
             "incident.bundles": 1.0,
+            "profiling.rolling.folds": 2.0,
+            "fleet.scrapes": 1.0,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("index.probe_freq.accounted" in m for m in msgs)
@@ -796,6 +802,8 @@ class TestBenchCompare:
             "index.probe_freq.accounted": 96.0,
             "profiling.captures": 0.0,             # ingestion dark
             "incident.bundles": 1.0,
+            "profiling.rolling.folds": 2.0,
+            "fleet.scrapes": 1.0,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("profiling.captures" in m for m in msgs)
@@ -810,6 +818,45 @@ class TestBenchCompare:
             committed = json.load(f)
         assert "profiling.captures" in committed["snapshot_floors"]
         assert "incident.bundles" in committed["snapshot_floors"]
+
+    # -- PR 12: graftfleet rolling-attribution / federation floors ----------
+
+    def test_snapshot_floors_include_graftfleet(self, bc):
+        """graftfleet satellite: the gate floor-checks the
+        continuous-capture -> rolling-EWMA pipeline and the
+        federation scrape loop — disconnecting either zeroes these
+        and fails structurally — and carries the tight
+        continuous-overhead tolerance bands."""
+        assert "profiling.rolling.folds" in bc.SNAPSHOT_FLOORS
+        assert "fleet.scrapes" in bc.SNAPSHOT_FLOORS
+        dark = {"counters_lifetime": {
+            "serving.execute.calls": 5.0,
+            "serving.execute.modeled_bytes": 1e6,
+            "serving.execute.modeled_flops": 1e7,
+            "index.probe.dispatches": 3.0,
+            "index.probe_freq.accounted": 96.0,
+            "profiling.captures": 1.0,
+            "incident.bundles": 1.0,
+            "profiling.rolling.folds": 0.0,        # rolling dark
+            "fleet.scrapes": 1.0,
+        }}
+        msgs = bc.check_snapshot(dark)
+        assert any("profiling.rolling.folds" in m for m in msgs)
+        dark["counters_lifetime"]["profiling.rolling.folds"] = 4.0
+        assert bc.check_snapshot(dark) == []
+        # the continuous-capture overhead bands are gated, ratio tight
+        assert bc.DEFAULT_TOLERANCES[
+            "serving.continuous.p99_ratio"] == {"max_increase": 1.0}
+        assert "serving.continuous.capture_attempts" in \
+            bc.DEFAULT_TOLERANCES
+        import os
+
+        base_path = os.path.join(os.path.dirname(bc.__file__),
+                                 "bench_baseline.json")
+        with open(base_path) as f:
+            committed = json.load(f)
+        assert "profiling.rolling.folds" in committed["snapshot_floors"]
+        assert "fleet.scrapes" in committed["snapshot_floors"]
 
     def test_multi_baseline_gates_each(self, bc, record, tmp_path):
         import copy

@@ -1,0 +1,393 @@
+"""graftfleet federation tests (PR 12) — multi-replica merge
+semantics, pinned byte-exactly by the committed 3-replica snapshot
+fixtures (``tests/data/fleet_replica_r{0,1,2}.json``).
+
+The acceptance criteria this file carries: the aggregator reproduces
+the fixture fleet sums, the pooled-trials Wilson CI, and the fleet
+probe-coverage exactly, serves them at ``/fleet.json``, and renders a
+``replica=``-labeled + fleet-aggregate Prometheus exposition; a
+mid-scrape counter reset can NEVER make a fleet counter go backwards
+(lifetime ledger + high-water monotonicity assertion); a stale
+replica drops from windowed surfaces while its cumulative
+contributions are retained.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu.core import tracing
+from raft_tpu.serving import (
+    DriftDetector,
+    FleetAggregator,
+    FleetConfig,
+    IndexGauge,
+    MetricsExporter,
+)
+from raft_tpu.serving import federation as fed_mod
+from raft_tpu.serving import metrics
+from raft_tpu.serving.gauge import wilson_interval
+from raft_tpu.serving.harness import ManualClock
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+
+def load_replica(name):
+    with open(os.path.join(DATA, f"fleet_replica_{name}.json")) as f:
+        return json.load(f)
+
+
+def fixture_fetch(url, timeout):
+    for name in ("r0", "r1", "r2"):
+        if f"//{name}/" in url:
+            return load_replica(name)
+    raise ValueError(f"unknown fixture url {url!r}")
+
+
+def fixture_aggregator(clock=None, **kw):
+    return FleetAggregator(
+        {n: f"http://{n}/snapshot.json" for n in ("r0", "r1", "r2")},
+        clock=clock or ManualClock(), fetch=fixture_fetch, **kw)
+
+
+class TestFixturePinnedMerge:
+    def setup_method(self):
+        metrics.reset()
+        tracing.reset_gauges("fleet.")
+
+    def merged(self):
+        agg = fixture_aggregator()
+        scrapes0 = tracing.get_counter(fed_mod.SCRAPES)
+        out = agg.fleet_snapshot()
+        assert tracing.get_counter(fed_mod.SCRAPES) == scrapes0 + 1
+        return out
+
+    def test_replica_health(self):
+        out = self.merged()
+        assert out["size"] == 3 and out["healthy"] == 3
+        for name in ("r0", "r1", "r2"):
+            r = out["replicas"][name]
+            assert r["healthy"] and r["errors"] == 0
+            assert r["age_s"] == 0.0
+
+    def test_fleet_counter_sums_from_lifetime_ledger(self):
+        out = self.merged()
+        c = out["counters"]
+        # the LIFETIME values sum (r0's live view says 10 — a
+        # mid-session reset folded 90 into its ledger; the fleet
+        # number must be the reset-proof 100 + 200 + 50)
+        assert c["serving.execute.calls"] == 350.0
+        assert c["serving.slo.missed"] == 6.0
+        assert c["index.probe.dispatches"] == 60.0
+
+    def test_histograms_merge_bucket_wise(self):
+        h = self.merged()["histograms"]["serving.batcher.e2e_seconds"]
+        assert h["count"] == 9
+        assert h["sum"] == pytest.approx(0.5105)
+        assert h["bucket_counts"] == [3, 6, 8, 9]
+        assert h["replicas"] == 3
+        # quantiles recompute from the MERGED distribution — never
+        # averaged per-replica quantiles
+        assert h["p50"] == pytest.approx(0.0055)
+        assert h["p95"] == pytest.approx(0.155)
+        assert h["p99"] == pytest.approx(0.191)
+
+    def test_fleet_probe_coverage_exact(self):
+        pf = self.merged()["probe_freq"]["ivf:0"]
+        # summed plane [100, 5, 5, 0, 10, 0, 0, 5]
+        assert pf["total"] == 125
+        assert pf["probed_fraction"] == pytest.approx(5 / 8)
+        assert pf["coverage_p01"] == pytest.approx(0.8)
+        assert pf["coverage_p10"] == pytest.approx(0.8)
+        assert pf["top"][0] == (0, 100)
+        assert tracing.get_gauge(
+            "fleet.probe_freq.ivf:0.coverage_p01") == \
+            pytest.approx(0.8)
+
+    def test_recall_pools_trials_before_wilson(self):
+        rec = self.merged()["recall"]
+        live = rec["live"]
+        assert (live["hits"], live["trials"], live["pairs"]) == \
+            (315, 350, 32)
+        assert live["estimate"] == pytest.approx(0.9)
+        lo, hi = wilson_interval(315, 350)
+        assert live["ci_low"] == pytest.approx(lo)
+        assert live["ci_high"] == pytest.approx(hi)
+        # pooling strictly tightens: the fleet CI is narrower than
+        # the smallest replica's own window could support
+        lo2, hi2 = wilson_interval(45, 50)
+        assert hi - lo < hi2 - lo2
+        # a sweep leg present on one replica still federates
+        assert rec["sweep.p8"]["trials"] == 10
+        assert tracing.get_gauge("fleet.recall.estimate") == \
+            pytest.approx(0.9)
+
+    def test_drift_rescores_pooled_histogram(self):
+        drift = self.merged()["drift"]
+        # traffic-weighted pooled live (40x + 20x uniform) is EXACTLY
+        # proportional to the pooled baseline [30,30,30,30]: zero
+        # drift however each replica's own window wiggled
+        assert drift["main"]["score"] == pytest.approx(0.0)
+        assert drift["main"]["replicas"] == 3
+        # every replica skewed the same way: whatever the traffic
+        # weights, the pooled distribution is [1, 0] and the score is
+        # the JSD of it vs the pooled baseline [30, 30]
+        expect = tracing.js_divergence([24.0, 0.0], [30.0, 30.0])
+        assert drift["skew"]["score"] == pytest.approx(expect)
+        assert drift["skew"]["score"] == pytest.approx(0.311278,
+                                                       abs=1e-6)
+
+    def test_drift_pooling_weighs_by_traffic_share(self):
+        # a drifted replica carrying 99% of fleet traffic must NOT be
+        # averaged away by an idle healthy peer: each replica's live
+        # histogram is normalized, so the pool scales by ``traffic``
+        def snap(live, traffic):
+            return {"federation": {"drift": {"ix": {
+                "baseline": [50, 50], "live": live,
+                "traffic": traffic, "score": 0.0, "updates": 1}}}}
+
+        payload = {"http://busy/snapshot.json": snap([1.0, 0.0], 99.0),
+                   "http://idle/snapshot.json": snap([0.5, 0.5], 1.0)}
+        agg = FleetAggregator({"busy": "http://busy/",
+                               "idle": "http://idle/"},
+                              clock=ManualClock(),
+                              fetch=lambda url, t: payload[url])
+        score = agg.fleet_snapshot()["drift"]["ix"]["score"]
+        assert score == pytest.approx(
+            tracing.js_divergence([99.5, 0.5], [100.0, 100.0]))
+        # equal-weight fallback for payloads predating the weight
+        for s in payload.values():
+            del s["federation"]["drift"]["ix"]["traffic"]
+        agg2 = FleetAggregator({"busy": "http://busy/",
+                                "idle": "http://idle/"},
+                               clock=ManualClock(),
+                               fetch=lambda url, t: payload[url])
+        score2 = agg2.fleet_snapshot()["drift"]["ix"]["score"]
+        assert score2 == pytest.approx(
+            tracing.js_divergence([1.5, 0.5], [100.0, 100.0]))
+        # traffic weighting makes the busy drifted replica dominate:
+        # MORE fleet drift detected than the averaged-away pool shows
+        assert score > score2
+
+    def test_admission_rollup(self):
+        adm = self.merged()["admission"]
+        assert adm["queue_depth"] == 5.0
+        assert adm["arrival_rate_hz"] == 15.0
+        assert adm["max_shed_level"] == 1
+
+
+class TestMonotonicity:
+    def setup_method(self):
+        metrics.reset()
+
+    def test_mid_scrape_reset_cannot_regress_fleet_counter(self):
+        payloads = [
+            {"counters_lifetime": {"serving.execute.calls": 100.0}},
+            # a replica restart zeroed its ledger mid-scrape
+            {"counters_lifetime": {"serving.execute.calls": 40.0}},
+            {"counters_lifetime": {"serving.execute.calls": 60.0}},
+        ]
+        seq = iter(payloads)
+        agg = FleetAggregator(["http://a"], clock=ManualClock(),
+                              fetch=lambda url, t: next(seq))
+        v0 = tracing.get_counter(fed_mod.MONOTONICITY_VIOLATIONS)
+        assert agg.fleet_snapshot()["counters"][
+            "serving.execute.calls"] == 100.0
+        out = agg.fleet_snapshot()
+        # clamped to the high-water mark — asserted monotone — and
+        # the violation is counted, not silent
+        assert out["counters"]["serving.execute.calls"] == 100.0
+        assert tracing.get_counter(
+            fed_mod.MONOTONICITY_VIOLATIONS) == v0 + 1
+        # recovery below the mark still cannot move the fleet down
+        assert agg.fleet_snapshot()["counters"][
+            "serving.execute.calls"] == 100.0
+
+    def test_live_counters_fallback_for_old_payloads(self):
+        agg = FleetAggregator(
+            ["http://a"], clock=ManualClock(),
+            fetch=lambda url, t: {"counters": {"x": 7.0}})
+        assert agg.fleet_snapshot()["counters"]["x"] == 7.0
+
+
+class TestStaleness:
+    def setup_method(self):
+        metrics.reset()
+        tracing.reset_gauges("fleet.")
+
+    def test_stale_replica_drops_from_windowed_surfaces(self):
+        clock = ManualClock()
+        alive = {"r0": True, "r1": True}
+
+        def fetch(url, timeout):
+            name = "r0" if "//r0/" in url else "r1"
+            if not alive[name]:
+                raise urllib.error.URLError("connection refused")
+            return load_replica(name)
+
+        agg = FleetAggregator(
+            {"r0": "http://r0/", "r1": "http://r1/"},
+            config=FleetConfig(staleness_s=30.0), clock=clock,
+            fetch=fetch)
+        out = agg.fleet_snapshot()
+        assert out["healthy"] == 2
+        h2 = out["histograms"]["serving.batcher.e2e_seconds"]
+        assert h2["count"] == 8                  # r0 (4) + r1 (4)
+        alive["r1"] = False
+        # within the staleness bound the last snapshot still counts
+        clock.advance(10.0)
+        out = agg.fleet_snapshot()
+        assert out["healthy"] == 2
+        assert out["replicas"]["r1"]["errors"] == 1
+        # past it the replica drops unhealthy: windowed surfaces
+        # (histograms, recall) exclude it...
+        clock.advance(30.0)
+        errs0 = tracing.get_counter(fed_mod.SCRAPE_ERRORS)
+        out = agg.fleet_snapshot()
+        assert out["healthy"] == 1
+        assert not out["replicas"]["r1"]["healthy"]
+        assert tracing.get_counter(fed_mod.SCRAPE_ERRORS) == errs0 + 1
+        assert out["histograms"][
+            "serving.batcher.e2e_seconds"]["count"] == 4
+        assert out["recall"]["live"]["trials"] == 100   # r0 only
+        # ...while CUMULATIVE surfaces retain its last-known (monotone
+        # lower-bound) contribution — fleet counters cannot regress
+        assert out["counters"]["serving.execute.calls"] == 300.0
+        plane_total = out["probe_freq"]["ivf:0"]["total"]
+        assert plane_total == 100                # 60 (r0) + 40 (r1)
+        assert tracing.get_gauge("fleet.replica.r1.healthy") == 0.0
+        assert tracing.get_gauge("fleet.replicas_healthy") == 1.0
+
+
+class TestFleetHTTP:
+    """The served surface: /fleet.json and the replica=-labeled +
+    fleet-aggregate exposition, over real HTTP."""
+
+    def setup_method(self):
+        metrics.reset()
+        tracing.reset_gauges("fleet.")
+
+    def _get(self, url):
+        try:
+            with urllib.request.urlopen(url, timeout=30) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_fleet_json_serves_merged_view(self):
+        agg = fixture_aggregator()
+        with MetricsExporter(fleet=agg) as exp:
+            code, body = self._get(exp.url("/fleet.json"))
+            assert code == 200
+            out = json.loads(body)
+            assert out["healthy"] == 3
+            assert out["counters"]["serving.execute.calls"] == 350.0
+            assert out["recall"]["live"]["estimate"] == \
+                pytest.approx(0.9)
+            assert out["probe_freq"]["ivf:0"]["coverage_p01"] == \
+                pytest.approx(0.8)
+
+    def test_fleet_json_404_without_aggregator(self):
+        with MetricsExporter() as exp:
+            code, _ = self._get(exp.url("/fleet.json"))
+            assert code == 404
+
+    def test_labeled_exposition(self):
+        import re
+
+        agg = fixture_aggregator()
+        with MetricsExporter(fleet=agg) as exp:
+            code, text = self._get(exp.url("/metrics"))
+        assert code == 200
+        # per-replica lifetime samples + the fleet aggregate, in ONE
+        # fleet_-prefixed family (no collision with local families)
+        assert ('fleet_serving_execute_calls{replica="r0"} 100'
+                in text)
+        assert ('fleet_serving_execute_calls{replica="r1"} 200'
+                in text)
+        assert ('fleet_serving_execute_calls{replica="fleet"} 350'
+                in text)
+        assert text.count(
+            "# TYPE fleet_serving_execute_calls counter") == 1
+        # the merged histogram renders per replica and fleet-wide
+        assert re.search(
+            r'fleet_serving_batcher_e2e_seconds_bucket'
+            r'\{replica="fleet",le="[^"]+"\} \d+', text)
+        assert ('fleet_serving_batcher_e2e_seconds_count'
+                '{replica="fleet"} 9') in text
+        # the aggregator's own health gauges render as labeled
+        # families through the normal registry path
+        assert 'fleet_replica_healthy{replica="r0"} 1' in text
+        assert re.search(
+            r'fleet_probe_freq_coverage_p01\{index="ivf:0"\}', text)
+        # every non-comment line still parses against the exposition
+        # grammar (label values may carry ':' — quoted, so legal)
+        sample_re = re.compile(
+            r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'(\{[a-zA-Z_]+="[^"]*"(,[a-zA-Z_]+="[^"]*")*\})? '
+            r"[-+0-9.e]+$")
+        for line in text.strip().splitlines():
+            if not line.startswith("#"):
+                assert sample_re.match(line), line
+
+
+class FakePlaneExecutor:
+    def probe_frequencies(self):
+        return {"ivf:0": np.array([3, 0, 2, 0], dtype=np.int64)}
+
+    def publish_probe_gauges(self, top_n=8, planes=None):
+        return {}
+
+
+class TestSnapshotFederationPayload:
+    """The replica side: /snapshot.json must carry the merge inputs —
+    the lifetime ledger and (with an IndexGauge) the federation
+    block."""
+
+    def setup_method(self):
+        metrics.reset()
+
+    def test_snapshot_carries_lifetime_ledger(self):
+        tracing.inc_counter("serving.execute.calls", 5.0)
+        tracing.reset_counters("serving.")     # mid-scrape reset
+        tracing.inc_counter("serving.execute.calls", 2.0)
+        exp = MetricsExporter()
+        snap = exp.snapshot()
+        # the live view regressed to 2; the ledger the fleet sums
+        # from still carries the full 7
+        assert snap["counters"]["serving.execute.calls"] == 2.0
+        assert snap["counters_lifetime"][
+            "serving.execute.calls"] >= 7.0
+
+    def test_federation_block_with_index_gauge(self):
+        det = DriftDetector(np.array([1.0, 2.0, 3.0, 4.0]))
+        det.update(np.array([1, 0, 1, 0]))
+        gauge = IndexGauge(executor=FakePlaneExecutor(),
+                           drift={"main": det})
+        exp = MetricsExporter(index_gauge=gauge)
+        fed = exp.snapshot()["federation"]
+        assert fed["probe_planes"]["ivf:0"] == [3, 0, 2, 0]
+        assert fed["drift"]["main"]["baseline"] == [1.0, 2.0, 3.0, 4.0]
+        assert fed["drift"]["main"]["live"] is not None
+        # the pooling weight: an EWMA (alpha=0.2) of per-window
+        # traffic — first window seeds, the second folds
+        assert fed["drift"]["main"]["traffic"] == pytest.approx(2.0)
+        det.update(np.array([7, 2, 1, 0]))      # delta sum 8
+        assert det.state()["traffic"] == pytest.approx(
+            0.2 * 8.0 + 0.8 * 2.0)
+        # JSON-serializable end to end (the payload ships over HTTP)
+        json.dumps(fed)
+
+    def test_recall_raw_pools(self):
+        from raft_tpu.serving import RecallWindow
+
+        w = RecallWindow(window_s=60.0)
+        w.record(0.0, 8, 10)
+        w.record(10.0, 9, 10)
+        assert w.raw(10.0) == {"hits": 17, "trials": 20, "pairs": 2}
+        # pruned pairs leave the raw counts with the window
+        assert w.raw(65.0) == {"hits": 9, "trials": 10, "pairs": 1}

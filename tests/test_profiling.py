@@ -21,6 +21,19 @@ capture.
   path; ``/incident.json`` and ``/profile`` responses parse-checked
   field by field; per-params-class latency histograms render as
   labeled Prometheus families.
+
+graftfleet (PR 12) additions:
+
+- Per-dispatch invocation windows: gap-clustering determinism and
+  edge cases (single dispatch, overlapping devices, empty capture,
+  back-to-back fallback to the op-count floor), per-dispatch skew
+  distribution gauges — fixture-pinned.
+- xplane-pb ingestion: the committed ``.xplane.pb`` twin of the
+  chrome fixture's mesh module must yield the SAME attribution;
+  auto-selection only without a chrome sidecar.
+- The live round trip now drives ``ContinuousCapture`` over TWO real
+  profiler windows at the default duty cycle — rolling gauges from
+  two distinct windows, zero-recompile + bit-identity intact.
 """
 
 import json
@@ -152,6 +165,13 @@ class TestCorrelation:
         # the result-slice micro-program matched nothing and says so
         assert attr.unmatched_modules == {
             "jit_dynamic_slice": pytest.approx(5e-6, rel=1e-9)}
+        # per-dispatch invocation windows (PR 12): the gap-clustering
+        # found exactly one window per dispatch, and each mesh
+        # window's per-device busy time yields a PER-DISPATCH skew
+        assert len(a.windows) == 3 and len(b.windows) == 2
+        assert a.skew_samples() == []          # single-device module
+        assert b.skew_samples() == [
+            pytest.approx(200e-6, rel=1e-9)] * 2
 
     def test_attribute_bumps_ingestion_counters(self):
         before = tracing.get_counter(profiling.CAPTURES)
@@ -165,6 +185,86 @@ class TestCorrelation:
         attr = fixture_attr()
         assert attr.trace_file == FIXTURE
         assert attr.to_dict()["trace_file"] == FIXTURE
+
+
+class TestInvocationWindows:
+    """graftfleet (PR 12): gap-clustering determinism + edge cases.
+    The fixture pins the real shapes; the synthetic cases pin the
+    boundary rules."""
+
+    def ops(self, module="aaaa01aaaa01"):
+        all_ops = profiling.parse_chrome_trace(
+            profiling.load_trace(FIXTURE))
+        return [o for o in all_ops if o.module.endswith(module)]
+
+    def test_fixture_windows_pinned(self):
+        wins = profiling.invocation_windows(self.ops())
+        assert len(wins) == 3
+        assert [w.start_s for w in wins] == [
+            pytest.approx(t, rel=1e-9)
+            for t in (3000e-6, 4000e-6, 5000e-6)]
+        # per-window phase/device totals partition the capture totals
+        assert sum(w.device_seconds for w in wins) == \
+            pytest.approx(810e-6, rel=1e-9)
+        assert all(w.ops == 4 for w in wins)
+
+    def test_overlapping_devices_merge_into_shared_windows(self):
+        # the mesh module's two devices overlap in time: one device's
+        # intra-dispatch idle is covered by the other's ops, so the
+        # merged timeline yields exactly one window per DISPATCH
+        wins = profiling.invocation_windows(self.ops("bbbb02bbbb02"))
+        assert len(wins) == 2
+        for w in wins:
+            assert set(w.shard_seconds) == {"/device:TPU:0",
+                                            "/device:TPU:1"}
+            assert w.shard_seconds["/device:TPU:0"] == \
+                pytest.approx(550e-6, rel=1e-9)
+            assert w.shard_seconds["/device:TPU:1"] == \
+                pytest.approx(750e-6, rel=1e-9)
+            assert w.skew == pytest.approx(200e-6, rel=1e-9)
+            assert w.phase_seconds["scan"] == pytest.approx(
+                1000e-6, rel=1e-9)
+
+    def test_single_dispatch_yields_one_window(self):
+        # every op ran once (n_min == n_max == 1): whatever idle gaps
+        # the events carry, nothing may split
+        ops = [profiling.DeviceOp("d", "m", f"op{i}", "",
+                                  i * 1e-3, 1e-5)
+               for i in range(4)]
+        wins = profiling.invocation_windows(ops)
+        assert len(wins) == 1
+        assert wins[0].ops == 4
+
+    def test_empty_capture(self):
+        assert profiling.invocation_windows([]) == []
+
+    def test_explicit_gap_threshold(self):
+        wins = profiling.invocation_windows(self.ops(), gap_s=300e-6)
+        assert len(wins) == 3
+        # an explicit threshold above every gap keeps one window
+        wins = profiling.invocation_windows(self.ops(), gap_s=1.0)
+        assert len(wins) == 1
+
+    def test_deterministic(self):
+        a = profiling.invocation_windows(self.ops("bbbb02bbbb02"))
+        b = profiling.invocation_windows(self.ops("bbbb02bbbb02"))
+        assert [w.to_dict() for w in a] == [w.to_dict() for w in b]
+
+    def test_back_to_back_dispatches_fall_back_to_count_floor(self):
+        # two dispatches with ZERO idle between them: clustering
+        # cannot separate, correlate() falls back to the op-count
+        # floor for the invocation count
+        ops = []
+        for k in range(2):
+            t = k * 200e-6
+            ops.append(profiling.DeviceOp("d", "m", "dot", "",
+                                          t, 100e-6))
+            ops.append(profiling.DeviceOp("d", "m", "sort", "",
+                                          t + 100e-6, 100e-6))
+        assert len(profiling.invocation_windows(ops)) == 1
+        attr = profiling.correlate(ops, {
+            "x1": {"hlo_module": "m", "family": "f"}})
+        assert attr.modules["x1"].invocations == 2
 
 
 class TestMeasuredSupersedesModeled:
@@ -219,6 +319,14 @@ class TestMeasuredSupersedesModeled:
         # a re-attribution is not a new dispatch
         assert tracing.get_counter(
             "serving.mesh.dispatches") == dispatches
+        # per-dispatch skew distribution (PR 12): both fixture
+        # dispatches skew by exactly 200 us, so p50 == p99 == 200 us
+        assert tracing.get_gauge(
+            tracing.MESH_SHARD_SKEW_P99) == pytest.approx(200e-6,
+                                                          rel=1e-9)
+        assert tracing.get_gauge(
+            tracing.MESH_SHARD_SKEW_P50) == pytest.approx(200e-6,
+                                                          rel=1e-9)
 
     def test_derived_measured_columns(self):
         self.publish_fixture()
@@ -256,6 +364,103 @@ class TestMeasuredSupersedesModeled:
                                                               rel=1e-9)
 
 
+XPLANE_FIXTURE = os.path.join(os.path.dirname(__file__), "data",
+                              "graftfleet_capture.xplane.pb")
+
+
+class TestXplaneIngestion:
+    """graftfleet satellite: the stdlib protobuf wire-format reader
+    for the XSpace subset, pinned by the committed device-free
+    ``.xplane.pb`` sample (regenerate with
+    ``scripts/make_xplane_fixture.py``) — whose logical content
+    mirrors the chrome fixture's mesh module, so BOTH ingestion paths
+    must produce the same attribution."""
+
+    def test_fixture_parses_device_ops_only(self):
+        ops = profiling.parse_xplane(XPLANE_FIXTURE)
+        # the host plane's module-less python events are skipped;
+        # both TPU planes' events parse (one plane interns the module
+        # name through ref_value stats, the other carries str_value —
+        # both resolution paths are in the committed bytes)
+        assert len(ops) == 12
+        assert {op.device for op in ops} == {"/device:TPU:0",
+                                             "/device:TPU:1"}
+        assert all(op.module == "jit_rt_dist_ivf_flat_bbbb02bbbb02"
+                   for op in ops)
+        assert {op.phase for op in ops} == set(profiling.PHASE_MARKERS)
+
+    def test_xplane_attribution_matches_chrome(self):
+        """The protobuf twin yields the SAME pinned mesh attribution
+        as the chrome fixture — parse format must not leak into the
+        numbers."""
+        chrome = fixture_attr().modules["bbbb02bbbb02"]
+        attr = profiling.attribute(
+            XPLANE_FIXTURE,
+            {"bbbb02bbbb02": FIXTURE_COSTS["bbbb02bbbb02"]})
+        assert attr.trace_file == XPLANE_FIXTURE
+        x = attr.modules["bbbb02bbbb02"]
+        assert x.device_seconds == pytest.approx(chrome.device_seconds,
+                                                 rel=1e-9)
+        assert x.invocations == chrome.invocations == 2
+        for marker in profiling.PHASE_MARKERS:
+            assert x.phase_seconds[marker] == pytest.approx(
+                chrome.phase_seconds[marker], rel=1e-9)
+        assert x.shard_seconds == {
+            d: pytest.approx(s, rel=1e-9)
+            for d, s in chrome.shard_seconds.items()}
+        assert x.measured_gbps() == pytest.approx(1.0, rel=1e-6)
+        assert [w.skew for w in x.windows] == [
+            pytest.approx(200e-6, rel=1e-9)] * 2
+
+    def test_auto_selected_only_without_chrome_sidecar(self, tmp_path):
+        import shutil
+
+        # a capture dir holding ONLY an xplane file: auto-selected
+        run = tmp_path / "plugins" / "profile" / "r1"
+        run.mkdir(parents=True)
+        shutil.copyfile(XPLANE_FIXTURE, str(run / "h.xplane.pb"))
+        ops, path = profiling.load_ops(str(tmp_path))
+        assert path == str(run / "h.xplane.pb")
+        assert len(ops) == 12
+        # the chrome path stays primary: once a sidecar exists, it
+        # wins regardless of mtime order
+        shutil.copyfile(FIXTURE, str(run / "h.trace.json"))
+        os.utime(str(run / "h.xplane.pb"))     # xplane now newest
+        ops, path = profiling.load_ops(str(tmp_path))
+        assert path == str(run / "h.trace.json")
+        assert len(ops) == 25
+        # fresh_trace_file obeys the same preference
+        before = profiling.trace_snapshot(str(tmp_path))
+        os.utime(str(run / "h.xplane.pb"))
+        assert profiling.fresh_trace_file(
+            str(tmp_path), before) == str(run / "h.xplane.pb")
+
+    def test_load_trace_stays_chrome_only(self, tmp_path):
+        import shutil
+
+        # load_trace must NEVER feed protobuf bytes to json.load: an
+        # xplane-only directory stays the explicit "no chrome
+        # capture" failure it always was, and an explicit .xplane.pb
+        # path is rejected with a pointer at load_ops
+        run = tmp_path / "plugins" / "profile" / "r1"
+        run.mkdir(parents=True)
+        shutil.copyfile(XPLANE_FIXTURE, str(run / "h.xplane.pb"))
+        with pytest.raises(FileNotFoundError, match="load_ops"):
+            profiling.load_trace(str(tmp_path))
+        with pytest.raises(ValueError, match="load_ops"):
+            profiling.load_trace(str(run / "h.xplane.pb"))
+
+    def test_truncated_pb_is_an_error(self):
+        with open(XPLANE_FIXTURE, "rb") as f:
+            data = f.read()
+        with pytest.raises(ValueError):
+            profiling.parse_xplane(data[:len(data) // 2])
+
+    def test_empty_dir_still_an_explicit_error(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            profiling.load_ops(str(tmp_path))
+
+
 @pytest.fixture(scope="module")
 def real_setup():
     rng = np.random.default_rng(7)
@@ -288,18 +493,25 @@ class TestRealExecutorAttribution:
 
     def test_capture_attribute_zero_recompile_bit_identity(
             self, real_setup, tmp_path):
-        """ONE live capture covers both a single-chip and a mesh
-        executable (jax.profiler's stop_trace serializes
-        session-accumulated state, so every extra in-suite capture
-        costs real wall time — one window proves both halves):
-        the digest-named modules correlate, the mesh entry re-emits
-        measured ``modeled: False`` spans, and the zero-recompile +
-        bit-identity regressions hold with mesh_trace and attribution
-        enabled."""
+        """The live round trip, driven through the graftfleet
+        continuous scheduler at its DEFAULT duty cycle (PR 12): TWO
+        real ``jax.profiler`` windows — each covering both a
+        single-chip and a mesh executable — tick through
+        ``ContinuousCapture``, so the digest-named modules correlate,
+        the mesh entry re-emits measured ``modeled: False`` spans,
+        the ``serving.attribution.rolling.*`` gauges populate from
+        two distinct capture windows, and the zero-recompile +
+        bit-identity regressions hold with mesh_trace, attribution,
+        AND continuous capture enabled. (jax.profiler's stop_trace
+        serializes session-accumulated state, so every in-suite
+        capture costs real wall time — these two windows are the
+        suite's real-capture budget.)"""
         import jax
 
         from raft_tpu.comms import local_comms
         from raft_tpu.distributed import ivf as dist_ivf
+        from raft_tpu.serving import ContinuousCapture
+        from raft_tpu.serving.harness import ManualClock
 
         tracing.install_xla_compile_listener()
         comms = local_comms()
@@ -313,36 +525,61 @@ class TestRealExecutorAttribution:
         d0, i0 = ex.search(real_setup["ivf"], q, 5, params=p)
         dm0, im0 = ivf_flat.search(None, sp, single, q, 5)
         dm1, im1 = ex.search(dist, q, 5, params=sp)
-        with tracing.capture(str(tmp_path)):
-            for _ in range(2):
-                jax.block_until_ready(
-                    ex.search(real_setup["ivf"], q, 5, params=p))
-                jax.block_until_ready(ex.search(dist, q, 5, params=sp))
-        attr = profiling.attribute(str(tmp_path),
-                                   ex.executable_costs())
-        # the live capture correlated to BOTH resident executables
-        assert len(attr.modules) == 2
-        by_family = {m.family: m for m in attr.modules.values()}
-        assert set(by_family) == {"ivf_flat", "dist_ivf_flat"}
-        for att in by_family.values():
-            assert att.device_seconds > 0
-            assert att.invocations >= 1
-        mesh_att = by_family["dist_ivf_flat"]
-        assert mesh_att.mesh and mesh_att.payload_model is not None
+
+        def traffic_under_capture():
+            # a real capture window with real traffic inside it — the
+            # injected capture_fn stands in for the wall-clock sleep
+            # (ManualClock owns the schedule; the capture is genuine)
+            before = profiling.trace_snapshot(str(tmp_path))
+            with tracing.capture(str(tmp_path)):
+                for _ in range(2):
+                    jax.block_until_ready(
+                        ex.search(real_setup["ivf"], q, 5, params=p))
+                    jax.block_until_ready(
+                        ex.search(dist, q, 5, params=sp))
+            return profiling.fresh_trace_file(str(tmp_path), before)
+
+        clock = ManualClock()
+        cc = ContinuousCapture(executor=ex, clock=clock,
+                               capture_fn=traffic_under_capture)
+        assert cc.config.capture_seconds / cc.config.period_s <= \
+            cc.config.duty_cycle_budget      # the DEFAULT duty cycle
         tracing.reset_spans()
-        profiling.publish(attr)
-        # measured mesh spans re-emitted modeled: False (the CPU
-        # chrome export drops op scopes, so the measured time lands
-        # in the honest "unattributed" phase — a TPU capture's xplane
-        # carries the coarse_select/scan/merge markers the distributed
-        # bodies now plant via jax.named_scope)
+        snap1 = cc.tick()
+        assert snap1 is not None and snap1["windows"] == 1
+        clock.advance(cc.config.period_s)
+        snap2 = cc.tick()
+        assert snap2 is not None and snap2["windows"] == 2
+        # both live windows correlated to BOTH resident executables
+        digests = set(snap2["executables"])
+        costs = ex.executable_costs()
+        families = {costs[d]["family"] for d in digests}
+        assert families == {"ivf_flat", "dist_ivf_flat"}
+        for stats in snap2["executables"].values():
+            assert stats["device_seconds"] > 0
+            assert stats["invocations"] >= 1
+        # the rolling gauges populated from >= 2 distinct windows
+        assert tracing.get_gauge(
+            profiling.ROLLING_PREFIX + "windows") == 2.0
+        assert tracing.get_gauge(
+            profiling.ROLLING_PREFIX + "device_seconds") > 0
+        assert tracing.get_gauge(
+            profiling.ROLLING_PREFIX + "gbps") > 0
+        d = metrics.derived()
+        assert d["rolling_windows"] == 2.0
+        assert d["rolling_gbps"] > 0
+        # measured mesh spans re-emitted modeled: False per window
+        # (the CPU chrome export drops op scopes, so the measured
+        # time lands in the honest "unattributed" phase — a TPU
+        # capture's xplane carries the coarse_select/scan/merge
+        # markers the distributed bodies plant via jax.named_scope)
         rec = tracing.span_recorder()
         meshspans = [s for s in rec.spans()
                      if s.name.startswith("serving.mesh.")
                      and s.attrs.get("modeled") is False]
         assert meshspans, "no measured mesh spans re-emitted"
-        # attribution enabled changes nothing downstream: no new
-        # compiles, bit-identical results — single-chip AND mesh
+        # continuous capture enabled changes nothing downstream: no
+        # new compiles, bit-identical results — single-chip AND mesh
         before = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
         d1, i1 = ex.search(real_setup["ivf"], q, 5, params=p)
         dm2, im2 = ex.search(dist, q, 5, params=sp)

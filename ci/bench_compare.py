@@ -194,6 +194,11 @@ SNAPSHOT_FLOORS = {
     # alive the same way
     "profiling.rolling.folds": 0.0,
     "fleet.scrapes": 0.0,
+    # graftledger (PR 13): the dispatch-time watermark sample must
+    # stay wired into the executor — a refactor that disconnects
+    # MemoryLedger.sample_dispatch() from the dispatch path zeroes
+    # this and fails structurally
+    "memory.samples": 0.0,
 }
 
 

@@ -48,7 +48,7 @@ MODULES = [
     "raft_tpu.serving.harness", "raft_tpu.serving.gauge",
     "raft_tpu.serving.flight", "raft_tpu.serving.continuous",
     "raft_tpu.serving.federation", "raft_tpu.core.profiling",
-    "raft_tpu.core.xplane",
+    "raft_tpu.core.xplane", "raft_tpu.core.memwatch",
     "raft_tpu.comms", "raft_tpu.comms.bootstrap",
     "raft_tpu.distributed.ivf", "raft_tpu.distributed.knn",
     "raft_tpu.distributed.kmeans", "raft_tpu.distributed.sharded_ann",

@@ -32,10 +32,13 @@ __version__ = "0.1.0"
 
 from raft_tpu.core.resources import Resources, DeviceResources
 from raft_tpu.core.executor import SearchExecutor
+from raft_tpu.core.memwatch import CapacityExceeded, MemoryLedger
 
 __all__ = [
     "Resources",
     "DeviceResources",
     "SearchExecutor",
+    "CapacityExceeded",
+    "MemoryLedger",
     "__version__",
 ]

@@ -43,6 +43,13 @@ from raft_tpu.analysis import astutil
 from raft_tpu.analysis.core import Finding, Project, rule
 
 SERVING_PREFIX = "raft_tpu/serving/"
+# PR 13: graftledger's core module is additionally in scope — the
+# ledger publishes through the same scrape machinery the serving
+# frontend does, and a wall-clock read sneaking into it (a staleness
+# age, a sample timestamp) would split that surface across two time
+# domains exactly like a serving-module read would. The ledger keeps
+# no timestamps today; the rule keeps it that way.
+EXTRA_FILES = ("raft_tpu/core/memwatch.py",)
 
 # the clock-reading members of the time module
 CLOCK_FNS = {"time", "monotonic", "perf_counter",
@@ -140,7 +147,8 @@ def check_clock_discipline(project: Project) -> Iterable[Finding]:
     measured."""
     out: List[Finding] = []
     for f in project.lib():
-        if f.tree is None or not f.rel.startswith(SERVING_PREFIX):
+        if f.tree is None or (not f.rel.startswith(SERVING_PREFIX)
+                              and f.rel not in EXTRA_FILES):
             continue
         clock_spans = _clock_class_spans(f.tree)
         mod_aliases = _time_module_aliases(f.tree)

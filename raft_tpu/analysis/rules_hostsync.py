@@ -7,12 +7,14 @@ the whole buffer; a ``device_put`` inside a Python loop issues one
 transfer per iteration where one batched call would do.
 
 Scope — the hot modules named by the serving stack:
-``core/executor.py``, ``raft_tpu/ops/*``, ``raft_tpu/distributed/*``
-(except ``checkpoint.py``, which is the host-IO module by design),
-``raft_tpu/neighbors/*``, and the request frontend
-``raft_tpu/serving/*`` (PR 5 — the batcher sits on the per-request
-hot path: one stray ``.item()`` or per-iteration ``device_put`` in a
-dispatch loop taxes every request in the process). Within them:
+``core/executor.py``, ``core/memwatch.py`` (PR 13 — graftledger's
+watermark sample runs per dispatch), ``raft_tpu/ops/*``,
+``raft_tpu/distributed/*`` (except ``checkpoint.py``, which is the
+host-IO module by design), ``raft_tpu/neighbors/*``, and the request
+frontend ``raft_tpu/serving/*`` (PR 5 — the batcher sits on the
+per-request hot path: one stray ``.item()`` or per-iteration
+``device_put`` in a dispatch loop taxes every request in the
+process). Within them:
 
 - ``.item()`` anywhere (it is never right on the hot path);
 - ``np.asarray`` / ``np.array`` / ``jax.device_get``, and
@@ -34,7 +36,11 @@ from raft_tpu.analysis.core import Finding, Project, rule
 
 HOT_PREFIXES = ("raft_tpu/ops/", "raft_tpu/distributed/",
                 "raft_tpu/neighbors/", "raft_tpu/serving/")
-HOT_FILES = ("raft_tpu/core/executor.py",)
+# core/memwatch.py joined in PR 13: its watermark sample runs on the
+# executor's dispatch path, so a stray .item()/device_get there taxes
+# every search in the process (the module itself is shape/dtype
+# arithmetic + backend introspection by contract)
+HOT_FILES = ("raft_tpu/core/executor.py", "raft_tpu/core/memwatch.py")
 EXEMPT = ("raft_tpu/distributed/checkpoint.py",)
 
 _FETCH_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",

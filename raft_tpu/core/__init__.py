@@ -17,6 +17,7 @@ from raft_tpu.core.serialize import (
     deserialize_scalar,
 )
 from raft_tpu.core.bitset import Bitset
+from raft_tpu.core.memwatch import CapacityExceeded, MemoryLedger
 from raft_tpu.core import operators
 from raft_tpu.core.validation import (
     expect,
@@ -38,6 +39,8 @@ __all__ = [
     "serialize_scalar",
     "deserialize_scalar",
     "Bitset",
+    "CapacityExceeded",
+    "MemoryLedger",
     "operators",
     "expect",
     "check_matrix",

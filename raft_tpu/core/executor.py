@@ -409,6 +409,11 @@ class SearchExecutor:
         self._probe_info: dict = {}
         self._probe_totals: dict = {}
         self._probe_dead: list = []
+        # graftledger (PR 13): an attached MemoryLedger samples a
+        # live-memory watermark after every dispatch (host-only
+        # backend call — nothing enters the compiled program, so the
+        # cache keys and zero-recompile contract are untouched)
+        self._memwatch = None
         self.stats = ExecutorStats()
         self._cache: "collections.OrderedDict[tuple, _Entry]" = (
             collections.OrderedDict())
@@ -931,6 +936,12 @@ class SearchExecutor:
             amounts["index.probe.dispatches"] = 1.0
             amounts["index.probe.rows"] = float(q_real)
         tracing.inc_counters(amounts)
+        if self._memwatch is not None:
+            # graftledger watermark: a host-only memory_stats read
+            # folded into the ledger's high-water mark — no device
+            # sync, no traced op, degrades to a counter bump on
+            # backends without live stats
+            self._memwatch.sample_dispatch()
         if plan.has_state:
             # outputs alias the donated state storage; keep them as
             # the next call's state
@@ -1064,6 +1075,42 @@ class SearchExecutor:
         which programs are resident and what each costs per call)."""
         with self._lock:
             return {d: dict(info) for d, info in self._cost_table.items()}
+
+    def attach_memwatch(self, ledger) -> None:
+        """Wire a graftledger :class:`~raft_tpu.core.memwatch
+        .MemoryLedger`: every dispatch then folds a live-memory
+        watermark sample (host-only — see ``_execute_entry_locked``)
+        and the ledger's reservation forecast reads
+        :meth:`memory_reservations`."""
+        self._memwatch = ledger
+
+    def memory_reservations(self) -> dict:
+        """The executor-owned terms of graftledger's reservation
+        forecast, per device ordinal: the donated running top-k state
+        buffers of every cached entry, the graftgauge probe planes,
+        and the max compile-time ``temp_bytes`` over the resident
+        executables (any dispatch may be the one that peaks). Pure
+        host-side metadata read under the executor lock — shapes,
+        dtypes and the compile-time cost table; no device fetch."""
+        from raft_tpu.core.memwatch import per_device_bytes
+
+        donated: dict = {}
+        planes: dict = {}
+        with self._lock:
+            for ent in self._cache.values():
+                if ent.state is not None:
+                    for arr in ent.state:
+                        per_device_bytes(arr, donated)
+            for arr in self._probe_state.values():
+                per_device_bytes(arr, planes)
+            max_temp = max(
+                (float(info.get("temp_bytes", 0.0))
+                 for info in self._cost_table.values()), default=0.0)
+            n = len(self._cache)
+        return {"donated_state_bytes": donated,
+                "probe_plane_bytes": planes,
+                "max_temp_bytes": max_temp,
+                "executables": n}
 
     def publish_cost_gauges(self) -> None:
         """Re-publish every resident executable's cost gauges plus the

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from raft_tpu.core import tracing
+from raft_tpu.core import memwatch, tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.serialize import (
     check_version,
@@ -92,6 +92,12 @@ def build(
     expect(dataset.ndim == 2, "dataset must be (n, d)")
     if storage_dtype is not None:
         dataset = dataset.astype(storage_dtype)
+    # graftledger capacity gate (opt-in): the dataset copy plus its
+    # f32 norm plane is the whole resident footprint of this family
+    memwatch.admit(
+        int(dataset.shape[0]) * int(dataset.shape[1])
+        * dataset.dtype.itemsize + int(dataset.shape[0]) * 4,
+        "brute_force.build")
     dataset = res.put(dataset)
     norms = jnp.sum(jnp.square(dataset.astype(jnp.float32)), axis=1)
     return BruteForceIndex(dataset, norms, DistanceType(metric), metric_arg)

@@ -62,7 +62,7 @@ import numpy as np
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
-from raft_tpu.core import interruptible, tracing
+from raft_tpu.core import interruptible, memwatch, tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.serialize import (
     check_version,
@@ -410,6 +410,18 @@ def build_streaming(
             return data_buf.at[labels, ranks].set(rows)
 
         dim_ext = empty.dim_ext
+        # graftledger capacity gate (opt-in): one slot = packed words
+        # + the three correction scalars + the id plane (+ the raw
+        # vector when the rerank plane streams too) — the same slot
+        # model the extend gate admits against
+        slot = (params.bits * dim_ext // 32) * 4 + 4 + params.bits * 4 \
+            + 4 + 4
+        if params.store_vectors:
+            # raw vector plane + the f32 data_norms plane the
+            # store_vectors epilog materializes (_vector_norms)
+            slot += dim * 4 + 4
+        memwatch.admit(params.n_lists * int(max_size) * slot,
+                       "ivf_bq.build_streaming")
         codes_buf = jnp.zeros(
             (params.n_lists, max_size, params.bits * dim_ext // 32),
             jnp.int32)
@@ -515,6 +527,15 @@ def extend(
             jnp.ones((all_codes.shape[0],), jnp.int32), all_labels,
             num_segments=index.n_lists)
         max_size = padded_extent(sizes)
+        # graftledger capacity gate (opt-in): one slot carries the
+        # packed sign words (i32), the three correction scalars
+        # (rnorm + per-level cfac + errw, f32), the id plane, and —
+        # with the rerank plane — the raw f32 vector + its norm
+        slot = (all_codes.shape[1] * 4 + 4 + index.bits * 4 + 4 + 4)
+        if with_vectors:
+            slot += index.dim * 4 + 4
+        memwatch.admit(index.n_lists * int(max_size) * slot,
+                       "ivf_bq.extend")
         packed, sizes = _pack_lists(all_codes, all_rn, all_cf, all_ew,
                                     all_ids, all_labels, index.n_lists,
                                     max_size, vectors=all_vecs,

@@ -38,7 +38,7 @@ import numpy as np
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
-from raft_tpu.core import interruptible, tracing
+from raft_tpu.core import interruptible, memwatch, tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.serialize import (
     check_version,
@@ -318,6 +318,17 @@ def extend(
         # one host sync at build/extend time to fix the padded extent
         max_size = padded_extent(sizes)
 
+        # graftledger capacity gate (opt-in, no-op unless installed):
+        # the repack is the allocation event — admit its padded layout
+        # host-side BEFORE any device tensor materializes, so an index
+        # that cannot fit fails as a typed CapacityExceeded instead of
+        # a backend OOM
+        memwatch.admit(
+            memwatch.packed_layout_bytes(
+                index.n_lists, int(max_size),
+                index.dim * all_vecs.dtype.itemsize),
+            "ivf_flat.extend")
+
         data, norms, indices, sizes = _pack_lists(
             all_vecs, all_ids, all_labels, index.n_lists, max_size,
             sizes=sizes,
@@ -402,6 +413,13 @@ def build_streaming(
             return (data.at[list_ids, ranks].set(rows),
                     idx.at[list_ids, ranks].set(ids))
 
+        # graftledger capacity gate (opt-in): the donated padded
+        # buffers below are THE allocation of the streaming path —
+        # admit them host-side like the repack path does
+        memwatch.admit(
+            memwatch.packed_layout_bytes(params.n_lists, int(max_size),
+                                         d * 4),
+            "ivf_flat.build_streaming")
         data = jnp.zeros((params.n_lists, max_size, d), jnp.float32)
         indices = jnp.full((params.n_lists, max_size), -1, jnp.int32)
         fill = np.zeros((params.n_lists,), np.int64)

@@ -41,7 +41,7 @@ import numpy as np
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_balanced import KMeansBalancedParams
-from raft_tpu.core import interruptible, tracing
+from raft_tpu.core import interruptible, memwatch, tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.serialize import (
     check_version,
@@ -457,6 +457,13 @@ def build_streaming(
             return (codes_buf.at[labels, ranks].set(codes),
                     idx_buf.at[labels, ranks].set(ids))
 
+        # graftledger capacity gate (opt-in): admit the streaming
+        # path's padded code planes before they allocate (no norms
+        # plane in the PQ layout; this path never nibble-packs)
+        memwatch.admit(
+            memwatch.packed_layout_bytes(params.n_lists, int(max_size),
+                                         pq_dim, norms=False),
+            "ivf_pq.build_streaming")
         codes_buf = jnp.zeros((params.n_lists, max_size, pq_dim), jnp.uint8)
         idx_buf = jnp.full((params.n_lists, max_size), -1, jnp.int32)
         fill = np.zeros((params.n_lists,), np.int64)
@@ -571,6 +578,20 @@ def extend(
             num_segments=index.n_lists,
         )
         max_size = padded_extent(sizes)
+        # graftledger capacity gate (opt-in): admit the padded code
+        # planes host-side before the repack allocates them. The
+        # repack always materializes UNPACKED (pq_dim-wide) planes;
+        # a nibble-packed index then allocates the half-width copy
+        # BEFORE the unpacked one frees — the transient peak is what
+        # must fit, not the stored width. No norms plane in the PQ
+        # layout.
+        slot_width = index.pq_dim
+        if index.pq_bits == 4 and index.pq_dim % 2 == 0:
+            slot_width += index.pq_dim // 2
+        memwatch.admit(
+            memwatch.packed_layout_bytes(
+                index.n_lists, int(max_size), slot_width, norms=False),
+            "ivf_pq.extend")
         codes, indices, sizes = _pack_codes(all_codes, all_ids, all_labels,
                                             index.n_lists, max_size,
                                             sizes=sizes)

@@ -45,7 +45,14 @@ letting tail latency or overload take the service down:
   type-correct semantics (lifetime-ledger counter sums that can never
   go backwards, bucket-merged histograms, fleet probe coverage,
   pooled-Wilson recall, pooled drift) served at ``/fleet.json`` and
-  as ``replica=``-labeled Prometheus families.
+  as ``replica=``-labeled Prometheus families. PR 13 (graftledger)
+  added per-replica memory merging (headroom MIN, resident SUM), a
+  push mode for replicas behind NAT (``POST /push``), and
+  fleet-level multiburn alerting (``fleet.slo.alert``); the memory
+  plane itself lives in :mod:`raft_tpu.core.memwatch`
+  (:class:`~raft_tpu.core.memwatch.MemoryLedger`, attached to the
+  exporter via ``MetricsExporter(memory=...)`` → ``/memory.json`` +
+  ``memory_*`` families + the gated ``/memory_profile`` capture).
 
 graftscope v2 (PR 7) additions: deadline-SLO attainment counters and
 a sliding-window burn-rate gauge (:class:`~raft_tpu.serving.metrics

@@ -43,6 +43,24 @@ Endpoints:
   drift, per-replica health (404 when no aggregator is attached).
   The federated families also append to ``/metrics`` as
   ``replica=``-labeled + fleet-aggregate samples.
+- ``/memory.json`` — the graftledger memory truth (PR 13): with a
+  :class:`~raft_tpu.core.memwatch.MemoryLedger` attached, the
+  per-index resident-bytes model, live ``device.memory_stats()``
+  truth (honest ``supported: false`` on backends without it), the
+  reservation forecast, headroom, and the modeled-vs-live divergence
+  (404 when no ledger is attached).
+- ``/memory_profile`` — a gated ``jax.profiler
+  .device_memory_profile`` capture (PR 13): the per-buffer
+  device-memory breakdown in pprof wire format, written into
+  ``profile_dir`` — same gate (403 unarmed) and the same
+  one-capture-at-a-time lock as ``/profile`` (409 while any capture
+  runs, either direction).
+- ``POST /push?replica=<name>`` — federation push mode (PR 13): with
+  a :class:`~raft_tpu.serving.federation.FleetAggregator` attached,
+  a replica behind NAT POSTs its own ``/snapshot.json`` body here
+  instead of being scraped; the snapshot enters the SAME type-correct
+  merge path (400 without a replica name or a JSON-object body, 404
+  without an aggregator).
 - ``/healthz`` — liveness probe.
 
 Prometheus label support (PR 7): the per-executable cost gauges render
@@ -111,6 +129,14 @@ _FLEET_PROBE_GAUGE = re.compile(
     r"^fleet\.probe_freq\.([^.]+)\.([a-z0-9_]+)$")
 _FLEET_DRIFT_GAUGE = re.compile(
     r"^fleet\.drift\.([^.]+)\.(score)$")
+# graftledger (PR 13) labeled families: per-index resident-bytes
+# model samples and per-device live memory truth
+_MEM_INDEX_GAUGE = re.compile(
+    r"^memory\.index\.([^.]+)\.([a-z0-9_]+)$")
+_MEM_DEVICE_GAUGE = re.compile(
+    r"^memory\.device\.([0-9]+)\.([a-z0-9_]+)$")
+_FLEET_MEM_INDEX_GAUGE = re.compile(
+    r"^fleet\.memory\.index\.([^.]+)\.(resident_bytes)$")
 # per-params-class latency histograms (PR 11 graftflight satellite):
 # serving.batcher.execute_seconds.p<NP> renders as the base family
 # with a params_class label, pairing the sweep recall gauges
@@ -141,7 +167,13 @@ _HELP_PREFIXES = (
     ("incident.", "graftflight incident-capture flight recorder"),
     ("continuous.", "graftfleet continuous-capture scheduling "
                     "accounting"),
+    ("fleet.memory.", "graftledger federated memory view (headroom "
+                      "min, resident sum)"),
+    ("fleet.slo.", "graftledger fleet-level multiburn alert over the "
+                   "merged SLO windows"),
     ("fleet.", "graftfleet multi-replica federation"),
+    ("memory.", "graftledger device-memory truth (resident model, "
+                "live stats, reservation forecast)"),
     ("index.probe_freq.", "graftgauge per-list probe-frequency "
                           "accounting"),
     ("index.probe.", "graftgauge probe-accounting dispatch heartbeat"),
@@ -283,6 +315,24 @@ def render_prometheus(counters: dict, gauges: dict, histograms: dict,
                     add_labeled("fleet_drift_score", "fleet.",
                                 f'index="{m.group(1)}"', v)
                     continue
+                m = _MEM_INDEX_GAUGE.match(name)
+                if m:
+                    add_labeled(
+                        f"memory_index_{prom_name(m.group(2))}",
+                        "memory.", f'index="{m.group(1)}"', v)
+                    continue
+                m = _MEM_DEVICE_GAUGE.match(name)
+                if m:
+                    add_labeled(
+                        f"memory_device_{prom_name(m.group(2))}",
+                        "memory.", f'device="{m.group(1)}"', v)
+                    continue
+                m = _FLEET_MEM_INDEX_GAUGE.match(name)
+                if m:
+                    add_labeled("fleet_memory_index_resident_bytes",
+                                "fleet.memory.",
+                                f'index="{m.group(1)}"', v)
+                    continue
         pn = prom_name(name)
         emit_family(pn, "gauge", name)
         lines.append(f"{pn} {_fmt(v)}")
@@ -339,7 +389,7 @@ class MetricsExporter:
                  profile_dir: Optional[str] = None,
                  legacy_executable_metrics: bool = False,
                  index_gauge=None, flight=None, continuous=None,
-                 fleet=None):
+                 fleet=None, memory=None):
         self.executor = executor
         self.batcher = batcher
         self.host = host
@@ -360,7 +410,14 @@ class MetricsExporter:
         # replica=-labeled exposition appended to /metrics
         self.continuous = continuous
         self.fleet = fleet
+        # graftledger (PR 13): a MemoryLedger publishes the memory.*
+        # gauge surface per scrape, backs /memory.json, and ships the
+        # federation "memory" block inside /snapshot.json
+        self.memory = memory
         self._profile_lock = threading.Lock()
+        # /memory_profile capture sequence — a counter, not a clock
+        # read (R7): the file name only needs to be unique per process
+        self._memprof_seq = 0
         for owner in (flight, continuous):
             if owner is not None and getattr(owner, "profile_lock",
                                              None) is None:
@@ -420,6 +477,12 @@ class MetricsExporter:
         if self.index_gauge is not None and hasattr(
                 self.index_gauge, "federation_payload"):
             out["federation"] = self.index_gauge.federation_payload()
+        if self.memory is not None:
+            # graftledger: the memory block a FleetAggregator merges
+            # (headroom min, resident sum) — shipped like the
+            # graftgauge federation block, absent when no ledger is
+            # attached (the aggregator must tolerate that)
+            out["memory"] = self.memory.federation_payload()
         rec = tracing.span_recorder()
         out["spans"] = {"recorded": len(rec), "dropped": rec.dropped,
                         "capacity": rec.capacity}
@@ -467,6 +530,59 @@ class MetricsExporter:
                 "trace_file": profiling.fresh_trace_file(
                     self.profile_dir, before)}
 
+    def memory_snapshot(self) -> dict:
+        """The ``/memory.json`` body: the attached
+        :class:`~raft_tpu.core.memwatch.MemoryLedger`'s full
+        structured view (resident model, live device truth, forecast,
+        headroom, divergence, watermarks), freshly published. Raises
+        ``LookupError`` when no ledger is attached — the HTTP layer
+        maps it to 404."""
+        if self.memory is None:
+            raise LookupError(
+                "no MemoryLedger attached: construct MetricsExporter "
+                "with memory=... to arm /memory.json")
+        return self.memory.publish()
+
+    def memory_profile(self) -> dict:
+        """One gated ``jax.profiler.device_memory_profile`` capture
+        — the per-buffer device-memory breakdown (pprof wire format)
+        the live gauges summarize. Shares the ``/profile`` lock (one
+        profiler customer at a time, all directions) and its gate:
+        ``PermissionError`` without a configured ``profile_dir``
+        (403), ``RuntimeError`` while any capture runs (409). The
+        pprof bytes land in ``profile_dir`` as
+        ``memory_profile_<n>.pb.gz`` (sequence-numbered — no clock
+        read) and the response carries the path."""
+        if self.profile_dir is None:
+            raise PermissionError(
+                "profiling is disabled: construct MetricsExporter with "
+                "profile_dir=... to arm /memory_profile")
+        if not self._profile_lock.acquire(blocking=False):
+            raise RuntimeError("a profiler capture is already running")
+        try:
+            import os
+
+            import jax
+
+            data = jax.profiler.device_memory_profile()
+            os.makedirs(self.profile_dir, exist_ok=True)
+            # the sequence restarts with the process: skip names that
+            # already exist so a restarted service can never overwrite
+            # a previous run's capture — which may be the pre-crash
+            # evidence an operator is about to read
+            while True:
+                self._memprof_seq += 1
+                path = os.path.join(
+                    self.profile_dir,
+                    f"memory_profile_{self._memprof_seq:04d}.pb.gz")
+                if not os.path.exists(path):
+                    break
+            with open(path, "wb") as f:
+                f.write(data)
+        finally:
+            self._profile_lock.release()
+        return {"path": path, "bytes": len(data)}
+
     def _refresh(self) -> None:
         """Re-publish the poll-style gauges from the attached executor
         and batcher so a scrape of a quiet service (or one taken after
@@ -487,6 +603,11 @@ class MetricsExporter:
             # probe-frequency gauges and drift scoring, plus health
             # stats and the shadow-recall window refresh
             self.index_gauge.publish()
+        if self.memory is not None:
+            # graftledger: re-publish the memory truth (model + live
+            # stats + forecast) — BEFORE the flight check below, so a
+            # low-headroom trigger evaluates this scrape's numbers
+            self.memory.publish()
         if self.flight is not None:
             # graftflight: evaluate the incident triggers — a firing
             # multiburn alert / latency anomaly captures here, rate
@@ -568,6 +689,29 @@ class MetricsExporter:
                         json.dumps(exporter.fleet.fleet_snapshot(),
                                    default=str).encode(),
                         "application/json")
+                elif path == "/memory.json":
+                    try:
+                        out = exporter.memory_snapshot()
+                    except LookupError as e:
+                        self._send(f"{e}\n".encode(), "text/plain", 404)
+                        return
+                    self._send(json.dumps(out, default=str).encode(),
+                               "application/json")
+                elif path == "/memory_profile":
+                    try:
+                        out = exporter.memory_profile()
+                    except PermissionError as e:
+                        self._send(f"{e}\n".encode(), "text/plain", 403)
+                        return
+                    except RuntimeError as e:
+                        self._send(f"{e}\n".encode(), "text/plain", 409)
+                        return
+                    except Exception as e:  # noqa: BLE001 — report, don't die
+                        self._send(f"capture failed: {e}\n".encode(),
+                                   "text/plain", 500)
+                        return
+                    self._send(json.dumps(out).encode(),
+                               "application/json")
                 elif path == "/incident.json":
                     bundle = (exporter.flight.latest()
                               if exporter.flight is not None else None)
@@ -617,6 +761,56 @@ class MetricsExporter:
                     self._send(b"ok\n", "text/plain")
                 else:
                     self._send(b"not found\n", "text/plain", 404)
+
+            def do_POST(self):  # noqa: N802 — http.server API
+                path, _, query = self.path.partition("?")
+                qs = urllib.parse.parse_qs(query,
+                                           keep_blank_values=True)
+                if path != "/push":
+                    self._send(b"not found\n", "text/plain", 404)
+                    return
+                # federation push mode (PR 13): replicas behind NAT
+                # POST the same body they would serve at
+                # /snapshot.json; it enters the aggregator through
+                # the SAME type-correct merge path a scrape feeds
+                if exporter.fleet is None or not hasattr(
+                        exporter.fleet, "push"):
+                    self._send(b"no FleetAggregator attached\n",
+                               "text/plain", 404)
+                    return
+                replica = qs.get("replica", [""])[0]
+                if not replica:
+                    self._send(b"replica query parameter required\n",
+                               "text/plain", 400)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    # a snapshot body is a few MB at the very most —
+                    # an unbounded read would let one request buffer
+                    # arbitrary bytes into the aggregator process
+                    if length > 8 * 1024 * 1024:
+                        self._send(b"snapshot body too large\n",
+                                   "text/plain", 413)
+                        return
+                    snap = json.loads(
+                        self.rfile.read(length).decode())
+                    if not isinstance(snap, dict):
+                        raise ValueError("snapshot body must be a "
+                                         "JSON object")
+                except (ValueError, UnicodeDecodeError) as e:
+                    self._send(f"bad snapshot body: {e}\n".encode(),
+                               "text/plain", 400)
+                    return
+                try:
+                    exporter.fleet.push(replica, snap)
+                except ValueError as e:
+                    # the push-replica registry cap: refuse loudly —
+                    # 429 tells a legitimate replica to back off and
+                    # an operator that the registry is full
+                    self._send(f"{e}\n".encode(), "text/plain", 429)
+                    return
+                self._send(json.dumps({"accepted": replica}).encode(),
+                           "application/json")
 
         self._server = http.server.ThreadingHTTPServer(
             (self.host, self.port), Handler)

@@ -31,6 +31,26 @@ produce confident nonsense:
   per-replica intervals.
 - **Drift** re-scores the POOLED live histogram against the pooled
   baseline, so a drifted replica weighs by its traffic share.
+- **Memory** (PR 13 graftledger): per-replica ``memory`` blocks merge
+  as resident-bytes SUM (each replica holds its own copy) and
+  headroom MIN over replicas that measured one — the placement
+  question is "where does the hot tier still fit", answered by the
+  worst-off replica, never an average. Replicas without the block
+  (older builds, no ledger attached) are skipped and counted in
+  ``replicas_reporting`` — missing data must not read as zero bytes
+  or infinite room.
+
+Two PR 13 additions close the PR 12 follow-ons: **push mode**
+(:meth:`FleetAggregator.push` / the exporter's ``POST /push``) lets a
+replica behind NAT deliver the same ``/snapshot.json`` body the
+scraper would have fetched — it enters the same clamped-counter merge
+path and the same staleness contract; and **fleet-level multiburn
+alerting** (``FleetConfig(multiburn=...)``) folds each merge's delta
+of the summed attained/missed counters into a 5 m + 1 h
+:class:`~raft_tpu.serving.metrics.MultiBurnAlert` pair published as
+``fleet.slo.burn_rate.{5m,1h}`` / ``fleet.slo.alert`` — the page
+condition at deployment scope, where one burning replica hides inside
+N−1 healthy peers' averages.
 
 Staleness contract: a replica whose scrape fails keeps serving its
 last snapshot until ``staleness_s``, then drops unhealthy. CUMULATIVE
@@ -54,6 +74,8 @@ from __future__ import annotations
 import concurrent.futures
 import dataclasses
 import json
+import math
+import re
 import threading
 import urllib.request
 from typing import Dict, List, Optional
@@ -62,11 +84,32 @@ from raft_tpu.core import tracing
 from raft_tpu.serving.batcher import MonotonicClock
 from raft_tpu.serving.flight import window_quantile
 from raft_tpu.serving.gauge import wilson_interval
+from raft_tpu.serving.metrics import MultiBurnAlert, MultiBurnConfig
 
 SCRAPES = "fleet.scrapes"
 SCRAPE_ERRORS = "fleet.scrape_errors"
 MONOTONICITY_VIOLATIONS = "fleet.monotonicity_violations"
 BOUND_MISMATCHES = "fleet.histogram_bound_mismatches"
+PUSHES = "fleet.pushes"
+
+# names/labels that reach gauge registry names (and from there
+# Prometheus label values) must stay one dot-free segment of safe
+# characters — push names and pushed memory labels arrive off the
+# network, where a quote or newline in a label value is an exposition
+# forgery, not a spelling (same discipline as MemoryLedger.watch)
+_LABEL_SUB = re.compile(r"[^A-Za-z0-9_:-]").sub
+_LABEL_MAX = 64
+
+# at most this many pushed/merged per-index memory labels publish as
+# fleet gauges per merge (largest residents win): gauges are
+# process-lifetime, so unbounded label cardinality from ONE replica's
+# snapshot body would grow every exposition forever (the same leak
+# PR 8's top-N probe gauges and PR 11's params-class cap close)
+MEMORY_LABEL_CAP = 32
+
+
+def _safe_label(name: str) -> str:
+    return _LABEL_SUB("-", str(name))[:_LABEL_MAX] or "unnamed"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,15 +117,30 @@ class FleetConfig:
     """``staleness_s`` bounds how long a failed replica's last
     snapshot keeps representing it; ``timeout_s`` is the per-replica
     HTTP fetch timeout (a hung replica must not stall the whole fleet
-    scrape past it)."""
+    scrape past it). ``multiburn`` (PR 13) arms fleet-level burn-rate
+    alerting: per merge, the deltas of the summed replica
+    attained/missed counters fold into a 5 m + 1 h
+    :class:`~raft_tpu.serving.metrics.MultiBurnAlert` pair published
+    under ``fleet.slo.*`` — the page condition at deployment scope,
+    where one replica's burn can hide inside N−1 healthy peers'
+    averages (None keeps fleet alerting off)."""
 
     staleness_s: float = 60.0
     timeout_s: float = 2.0
+    multiburn: Optional[MultiBurnConfig] = None
+    # push mode auto-registers unseen replica names; the endpoint is
+    # network-reachable, so the registry must be bounded — a client
+    # minting a fresh name per request would otherwise grow the
+    # aggregator (and every merge/exposition walk) without limit
+    max_push_replicas: int = 64
 
 
 @dataclasses.dataclass
 class ReplicaState:
-    """One replica's scrape bookkeeping (all timestamps clock-domain)."""
+    """One replica's scrape bookkeeping (all timestamps clock-domain).
+    ``push: True`` marks a push-mode replica (PR 13): it is never
+    fetched — its snapshots arrive via ``POST /push`` — but ages,
+    merges, and goes stale exactly like a scraped one."""
 
     name: str
     url: str
@@ -91,6 +149,7 @@ class ReplicaState:
     scrapes: int = 0
     errors: int = 0
     last_error: Optional[str] = None
+    push: bool = False
 
     def age_s(self, now: float) -> float:
         return (float("inf") if self.scraped_at is None
@@ -177,6 +236,16 @@ class FleetAggregator:
         # renders from it instead of re-running the whole merge —
         # /metrics already merged once in fleet_snapshot()
         self._last_merged: Optional[dict] = None
+        # fleet-level multiburn alerting (PR 13): the merged
+        # attained/missed sums' last-seen values, and the paired
+        # windows the per-merge deltas fold into. The fleet sums are
+        # monotone by construction (high-water clamped), so the
+        # deltas are non-negative however replicas restart.
+        self._burn: Optional[MultiBurnAlert] = None
+        self._burn_prev: Optional[Dict[str, float]] = None
+        if self.config.multiburn is not None:
+            self._burn = MultiBurnAlert(self.config.multiburn,
+                                        prefix="fleet.slo.")
 
     # -- scraping -----------------------------------------------------------
 
@@ -194,6 +263,13 @@ class FleetAggregator:
             try:
                 v = float(v)
             except (TypeError, ValueError):
+                continue
+            if not math.isfinite(v):
+                # JSON `1e999` parses to inf: ratcheting a high-water
+                # mark to inf (or NaN) would poison every future
+                # fleet sum — and the multiburn delta's int() —
+                # irreversibly. Off the network, non-finite is
+                # garbage, not a measurement.
                 continue
             prev = high.get(cname, 0.0)
             if v < prev:
@@ -213,8 +289,12 @@ class FleetAggregator:
         if now is None:
             now = self._clock.now()
         tracing.inc_counter(SCRAPES)
-        states = list(self._states.values())
-        if len(states) == 1:
+        # push-mode replicas are never fetched — their snapshots
+        # arrive through push(); they still count into health below
+        states = [s for s in self._states.values() if not s.push]
+        if not states:
+            results = []
+        elif len(states) == 1:
             results = [self._fetch_one(states[0])]
         else:
             with concurrent.futures.ThreadPoolExecutor(
@@ -232,10 +312,61 @@ class FleetAggregator:
                 state.scraped_at = now
                 state.scrapes += 1
                 self._clamp_counters_locked(state.name, snap)
-        for state in states:
+        for state in self._states.values():
             if state.healthy(now, self.config.staleness_s):
                 healthy += 1
         return healthy
+
+    def push(self, name: str, snapshot: dict,
+             now: Optional[float] = None) -> None:
+        """Accept one pushed snapshot from replica ``name`` (the
+        ``POST /push`` body — the same JSON the replica would serve
+        at ``/snapshot.json``). Unknown names auto-register as
+        push-mode replicas; a pushed snapshot enters the SAME
+        clamped-counter bookkeeping a scrape does, so every merge
+        semantic — lifetime-ledger sums, monotonicity, staleness —
+        applies unchanged. A NAT replica that stops pushing simply
+        goes stale after ``staleness_s``. At most
+        ``config.max_push_replicas`` push-mode names may register
+        (``ValueError`` past the cap — the endpoint is network-
+        reachable, and an unbounded registry would let one
+        name-minting client grow every merge walk forever)."""
+        if not isinstance(snapshot, dict):
+            raise ValueError(
+                f"push for {name!r} got {type(snapshot).__name__}, "
+                "not a snapshot dict")
+        # the name reaches gauge registry names and Prometheus label
+        # values — sanitize it the way MemoryLedger.watch does (a
+        # quote/newline off the network is exposition forgery)
+        name = _safe_label(name)
+        if now is None:
+            now = self._clock.now()
+        with self._lock:
+            state = self._states.get(name)
+            if state is not None and not state.push:
+                # an unauthenticated push must never impersonate a
+                # configured scrape replica: overwriting its snapshot
+                # would ratchet its monotone high-water counters with
+                # whatever the pusher claims, irreversibly
+                raise ValueError(
+                    f"replica {name!r} is scrape-mode: refusing a "
+                    "pushed snapshot for it")
+            if state is None:
+                pushed = sum(1 for s in self._states.values() if s.push)
+                if pushed >= self.config.max_push_replicas:
+                    raise ValueError(
+                        f"push replica limit reached "
+                        f"({self.config.max_push_replicas}): refusing "
+                        f"to register {name!r}")
+                state = ReplicaState(name=name, url=f"push:{name}",
+                                     push=True)
+                self._states[name] = state
+                self._high.setdefault(name, {})
+            tracing.inc_counter(PUSHES)
+            state.snapshot = snapshot
+            state.scraped_at = now
+            state.scrapes += 1
+            self._clamp_counters_locked(name, snapshot)
 
     def _fetch_one(self, state: ReplicaState) -> tuple:
         """(snapshot, None) or (None, error-text) — one replica's
@@ -378,6 +509,66 @@ class FleetAggregator:
         out["admission"] = {"queue_depth": depth,
                             "arrival_rate_hz": rate,
                             "max_shed_level": shed}
+        # memory (PR 13 graftledger): instantaneous state, so healthy
+        # replicas only. Resident bytes SUM (each replica holds its
+        # own copy — the fleet figure is what the deployment spends);
+        # headroom takes the MIN over replicas that measured one
+        # (null headroom = no live stats; ignorance must not read as
+        # infinite room). Replicas predating the memory block — or
+        # running without a ledger — are skipped and counted, never
+        # guessed at.
+        # every field is validated per value, like the counter clamp:
+        # snapshots arrive from scrapes AND the network-reachable
+        # POST /push — one replica's malformed memory block must cost
+        # that replica's contribution, never the whole fleet merge
+        def _num(v, default=None):
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                return default
+            # non-finite values (JSON 1e999 -> inf) would poison the
+            # sums, break the label-cap sort (NaN is unordered), and
+            # corrupt headroom_min — garbage, not a measurement
+            return v if math.isfinite(v) else default
+
+        mem_resident: Dict[str, float] = {}
+        mem_total = 0.0
+        replica_headroom: Dict[str, float] = {}
+        forecast_max = 0.0
+        reporting = 0
+        for s in healthy:
+            mem = s.snapshot.get("memory")
+            if not isinstance(mem, dict):
+                continue
+            reporting += 1
+            mem_total += _num(mem.get("resident_total_bytes"), 0.0)
+            resident = mem.get("resident")
+            if isinstance(resident, dict):
+                for label, b in resident.items():
+                    b = _num(b)
+                    if b is not None:
+                        label = _safe_label(label)
+                        mem_resident[label] = \
+                            mem_resident.get(label, 0.0) + b
+            forecast_max = max(
+                forecast_max, _num(mem.get("forecast_peak_bytes"), 0.0))
+            room = _num(mem.get("headroom_bytes"))
+            if room is not None:
+                replica_headroom[s.name] = room
+        headroom_min_replica = (
+            min(replica_headroom, key=replica_headroom.get)
+            if replica_headroom else None)
+        out["memory"] = {
+            "replicas_reporting": reporting,
+            "resident_bytes": mem_total,
+            "resident": mem_resident,
+            "forecast_peak_max_bytes": forecast_max,
+            "headroom_min_bytes": (
+                replica_headroom[headroom_min_replica]
+                if headroom_min_replica is not None else None),
+            "headroom_min_replica": headroom_min_replica,
+            "replica_headroom_bytes": replica_headroom,
+        }
         return out
 
     def merge(self, now: Optional[float] = None) -> dict:
@@ -389,12 +580,50 @@ class FleetAggregator:
         with self._lock:
             out = self._merge_locked(now)
             self._last_merged = out
+            delta = None
+            if self._burn is not None:
+                # fleet-level multiburn (PR 13): claim this merge's
+                # delta of the summed attained/missed counters UNDER
+                # the lock — concurrent merges (ThreadingHTTPServer
+                # serves /metrics and /fleet.json in parallel) must
+                # each fold a DISJOINT slice, or the same outcomes
+                # enter the windows twice and inflate the burn rate.
+                # The fleet sums are high-water clamped, so deltas are
+                # non-negative however replicas restart; the outcomes
+                # were counted in their replica processes —
+                # record_batch windows them without re-counting.
+                cur = {k: out["counters"].get(k, 0.0)
+                       for k in ("serving.slo.attained",
+                                 "serving.slo.missed")}
+                prev = self._burn_prev or {k: cur[k] for k in cur}
+                self._burn_prev = cur
+                delta = (int(cur["serving.slo.attained"]
+                             - prev["serving.slo.attained"]),
+                         int(cur["serving.slo.missed"]
+                             - prev["serving.slo.missed"]))
+        if self._burn is not None:
+            # the fold itself runs outside the aggregator lock (the
+            # windows carry their own locks; disjoint deltas compose)
+            self._burn.record_batch(now, *delta)
+            out["slo"] = {
+                "burn_rates": dict(zip(
+                    (self.config.multiburn.short_label,
+                     self.config.multiburn.long_label),
+                    self._burn.burn_rates(now))),
+                "alert": self._burn.alert(now),
+            }
         self._publish(out)
         return out
 
     def _publish(self, merged: dict) -> None:
         """Re-publish the fleet gauges into the aggregator process's
-        own registries (its exporter renders them labeled)."""
+        own registries (its exporter renders them labeled). Stale
+        per-replica and memory gauges retire FIRST: a replica that
+        stopped reporting (or was dropped) must not keep advertising
+        its last headroom — that is exactly the stale room an
+        operator would place the hot tier on."""
+        tracing.reset_gauges("fleet.replica.")
+        tracing.reset_gauges("fleet.memory.")
         vals = {
             "fleet.replicas": float(merged["size"]),
             "fleet.replicas_healthy": float(merged["healthy"]),
@@ -420,6 +649,30 @@ class FleetAggregator:
             })
         for iname, d in merged["drift"].items():
             vals[f"fleet.drift.{iname}.score"] = d["score"]
+        mem = merged.get("memory") or {}
+        if mem.get("replicas_reporting"):
+            vals["fleet.memory.replicas_reporting"] = float(
+                mem["replicas_reporting"])
+            vals["fleet.memory.resident_bytes"] = mem["resident_bytes"]
+            vals["fleet.memory.forecast_peak_max_bytes"] = \
+                mem["forecast_peak_max_bytes"]
+            if mem.get("headroom_min_bytes") is not None:
+                vals["fleet.memory.headroom_min_bytes"] = \
+                    mem["headroom_min_bytes"]
+            # per-index gauges: at most MEMORY_LABEL_CAP publish
+            # (largest residents win) — gauges are process-lifetime,
+            # and label cardinality here is replica-supplied (see the
+            # cap's comment above; stale labels retired by the
+            # fleet.memory. reset above)
+            resident = sorted(mem.get("resident", {}).items(),
+                              key=lambda kv: -kv[1])
+            for label, b in resident[:MEMORY_LABEL_CAP]:
+                vals[f"fleet.memory.index.{label}.resident_bytes"] = b
+            # per-replica headroom rides the existing replica=-labeled
+            # family machinery (fleet.replica.<name>.<field>)
+            for rname, room in mem.get("replica_headroom_bytes",
+                                       {}).items():
+                vals[f"fleet.replica.{rname}.headroom_bytes"] = room
         tracing.set_gauges(vals)
 
     def fleet_snapshot(self, now: Optional[float] = None) -> dict:

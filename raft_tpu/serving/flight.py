@@ -24,6 +24,12 @@ exporter's scrape refresh drives):
   of the cumulative bucket counts, so a long-healthy service's history
   cannot mask a fresh stall, and the check is a pure function of the
   histogram snapshots — ManualClock tests pin it exactly).
+- **low_headroom** (PR 13 graftledger) — an attached
+  :class:`~raft_tpu.core.memwatch.MemoryLedger` reports device
+  headroom at/below ``FlightConfig.low_headroom_bytes``: the replica
+  is drifting toward an OOM, and the incident evidence worth having
+  is the one from BEFORE the crash. The bundle then also carries the
+  full memory snapshot (model, live stats, forecast, divergence).
 
 Rate limiting: at most one bundle per ``cooldown_s`` (clock domain —
 the batcher's injectable clock, so the manual-clock tests pin the
@@ -119,7 +125,12 @@ class FlightConfig:
     in-memory only — ``/incident.json`` still serves the latest);
     ``max_bundles`` bounds the in-memory ring. ``latency`` configures
     the anomaly trigger (None disables it; the multiburn trigger is
-    always live when the gauge exists)."""
+    always live when the gauge exists). ``low_headroom_bytes`` arms
+    the graftledger memory trigger (PR 13): an attached
+    :class:`~raft_tpu.core.memwatch.MemoryLedger` reporting headroom
+    at/below this many bytes is an incident (None keeps it off — and
+    a ledger that cannot measure headroom, e.g. on CPU, never
+    fires)."""
 
     cooldown_s: float = 300.0
     capture_seconds: float = 0.5
@@ -127,6 +138,7 @@ class FlightConfig:
     max_bundles: int = 16
     latency: Optional[LatencyAnomaly] = dataclasses.field(
         default_factory=LatencyAnomaly)
+    low_headroom_bytes: Optional[float] = None
 
 
 class FlightRecorder:
@@ -155,9 +167,13 @@ class FlightRecorder:
     def __init__(self, executor=None, batcher=None, *,
                  config: Optional[FlightConfig] = None, clock=None,
                  profile_dir: Optional[str] = None,
-                 capture_fn: Optional[Callable] = None):
+                 capture_fn: Optional[Callable] = None,
+                 memory=None):
         self.executor = executor
         self.batcher = batcher
+        # graftledger (PR 13): a MemoryLedger arms the low_headroom
+        # trigger and contributes the memory snapshot to every bundle
+        self.memory = memory
         self.config = config or FlightConfig()
         if clock is None:
             clock = (batcher._clock if batcher is not None
@@ -213,6 +229,21 @@ class FlightRecorder:
             if (count >= self.config.latency.min_count
                     and p99 >= self.config.latency.p99_threshold_s):
                 reasons.append("latency_anomaly")
+        if (self.memory is not None
+                and self.config.low_headroom_bytes is not None):
+            # a ledger that cannot measure headroom (None — no live
+            # stats, no configured capacity) never fires: ignorance
+            # is not an incident. The exporter's refresh publishes
+            # the ledger right before this check runs — read that
+            # snapshot instead of recomputing the same truth; only a
+            # recorder driven with no publish at all (standalone
+            # check() callers) pays the fresh read.
+            snap = getattr(self.memory, "last_snapshot", None)
+            room = (snap["headroom_bytes"] if snap is not None
+                    else self.memory.headroom_bytes())
+            if room is not None and \
+                    room <= self.config.low_headroom_bytes:
+                reasons.append("low_headroom")
         return reasons
 
     # -- capture ------------------------------------------------------------
@@ -272,6 +303,11 @@ class FlightRecorder:
         if self.executor is not None and hasattr(self.executor,
                                                  "executable_costs"):
             bundle["executables"] = self.executor.executable_costs()
+        if self.memory is not None:
+            # the graftledger snapshot at the moment of the incident:
+            # for a low_headroom trigger this IS the evidence; for
+            # any other trigger it rules memory pressure in or out
+            bundle["memory"] = self.memory.snapshot()
         if self.batcher is not None:
             q = self.batcher._queue
             bundle["shed_level"] = q.shed_level()

@@ -111,6 +111,30 @@ Per-stage latency **histograms** (log2 buckets, p50/p95/p99 estimates):
   (:mod:`raft_tpu.serving.federation`): scrape/health counters, fleet
   probe coverage, pooled recall, pooled drift
 
+**graftledger surface** (PR 13, published at scrape time by
+:class:`raft_tpu.core.memwatch.MemoryLedger`):
+
+- ``memory.index.<label>.{resident_bytes,shard_bytes}`` — the
+  resident-bytes model per watched index (labeled Prometheus
+  families); ``memory.resident.total_bytes`` the sum
+- ``memory.device.<ordinal>.{in_use,peak,limit}_bytes`` — live
+  ``device.memory_stats()`` truth (absent on backends without it;
+  ``memory.live.supported`` says which)
+- ``memory.forecast.peak_bytes`` / ``memory.reserved.*`` — the
+  reservation forecast (resident + donated state + probe planes +
+  max compile-time temp); ``memory.hbm.headroom_bytes`` the live
+  headroom (−1 when unknowable); ``memory.divergence_bytes`` the
+  modeled-vs-live gap (fragmentation / untracked allocations)
+- ``memory.watermark.{in_use,forecast}_peak_bytes`` — dispatch-time
+  high-water marks; ``memory.samples`` the heartbeat counter the CI
+  snapshot floor checks; ``memory.gate.{admitted,refused}`` the
+  capacity-gate ledger
+- ``fleet.memory.{resident_bytes,headroom_min_bytes}`` +
+  ``fleet.replica.<name>.headroom_bytes`` — the federated memory
+  view (headroom min / resident sum); ``fleet.slo.burn_rate.*`` /
+  ``fleet.slo.alert`` the fleet-level multiburn alert over the
+  merged windows
+
 Batch **occupancy** — the coalescing win the ISSUE's acceptance
 criterion gates on — is derived, not stored: ``requests / batches``
 (and ``rows / batches``) from one counters snapshot. Likewise the
@@ -179,37 +203,63 @@ class SloWindow:
     ``label`` suffixes the published gauge names
     (``serving.slo.burn_rate.<label>``) so several windows over the
     same outcome stream — the multiburn alert's 5 m + 1 h pair —
-    publish side by side; unlabeled keeps the original flat names."""
+    publish side by side; unlabeled keeps the original flat names.
+    ``prefix`` relocates the whole gauge family (default
+    ``serving.slo.`` — the fleet aggregator's federated windows
+    publish under ``fleet.slo.`` so a replica-local and a fleet-wide
+    burn rate can coexist in one registry)."""
 
     def __init__(self, config: Optional[SloConfig] = None, *,
-                 label: Optional[str] = None):
+                 label: Optional[str] = None,
+                 prefix: str = "serving.slo."):
         self.config = config or SloConfig()
         self.label = label
+        self.prefix = prefix
         self._suffix = f".{label}" if label else ""
         self._lock = threading.Lock()
+        # events are (timestamp, attained, n): n > 1 carries a BATCH
+        # of same-outcome outcomes in one entry — the federation path
+        # folds per-merge deltas of fleet counter sums, and appending
+        # thousands of unit events per merge would make the window
+        # O(fleet traffic) instead of O(merges)
         self._events: "collections.deque" = collections.deque()
+        self._total = 0
         self._missed = 0
 
     def _prune_locked(self, now: float) -> None:
         horizon = now - self.config.window_s
         while self._events and self._events[0][0] <= horizon:
-            _, ok = self._events.popleft()
+            _, ok, n = self._events.popleft()
+            self._total -= n
             if not ok:
-                self._missed -= 1
+                self._missed -= n
 
     def _counts(self, now: float):
         with self._lock:
             self._prune_locked(now)
-            return len(self._events), self._missed
+            return self._total, self._missed
 
-    def _append(self, now: float, attained: bool) -> None:
+    def _append(self, now: float, attained: bool, n: int = 1) -> None:
         """Window bookkeeping only — no counter bump, no publish. The
         multiburn alert fans one outcome into several windows and must
         bump the process-wide attained/missed counters exactly once."""
+        if n <= 0:
+            return
         with self._lock:
-            self._events.append((now, attained))
+            self._events.append((now, attained, n))
+            self._total += n
             if not attained:
-                self._missed += 1
+                self._missed += n
+
+    def record_batch(self, now: float, attained_n: int,
+                     missed_n: int) -> None:
+        """Fold a BATCH of outcomes into the window WITHOUT bumping
+        the process-wide attained/missed counters — the federation
+        path: the outcomes already counted in their replica processes,
+        and the aggregator only needs them windowed. Publishes."""
+        self._append(now, True, int(attained_n))
+        self._append(now, False, int(missed_n))
+        self.publish(now)
 
     def record(self, now: float, attained: bool) -> None:
         """Count one outcome at clock time ``now`` and re-publish."""
@@ -234,10 +284,10 @@ class SloWindow:
         total, missed = self._counts(now)
         budget = max(1.0 - self.config.target, 1e-9)
         tracing.set_gauges({
-            SLO_BURN_RATE + self._suffix:
+            self.prefix + "burn_rate" + self._suffix:
                 (missed / total) / budget if total else 0.0,
-            "serving.slo.window_total" + self._suffix: float(total),
-            "serving.slo.window_missed" + self._suffix: float(missed),
+            self.prefix + "window_total" + self._suffix: float(total),
+            self.prefix + "window_missed" + self._suffix: float(missed),
         })
 
 
@@ -268,11 +318,15 @@ class MultiBurnAlert:
     All timestamps are caller-clock-domain — the ManualClock tests pin
     window arithmetic and the alert transition exactly."""
 
-    def __init__(self, config: Optional[MultiBurnConfig] = None):
+    def __init__(self, config: Optional[MultiBurnConfig] = None, *,
+                 prefix: str = "serving.slo."):
         self.config = config or MultiBurnConfig()
+        self.prefix = prefix
         self.windows = (
-            SloWindow(self.config.short, label=self.config.short_label),
-            SloWindow(self.config.long, label=self.config.long_label),
+            SloWindow(self.config.short, label=self.config.short_label,
+                      prefix=prefix),
+            SloWindow(self.config.long, label=self.config.long_label,
+                      prefix=prefix),
         )
 
     def record(self, now: float, attained: bool) -> None:
@@ -280,6 +334,18 @@ class MultiBurnAlert:
         tracing.inc_counter(SLO_ATTAINED if attained else SLO_MISSED)
         for w in self.windows:
             w._append(now, attained)
+        self.publish(now)
+
+    def record_batch(self, now: float, attained_n: int,
+                     missed_n: int) -> None:
+        """Batched outcomes → both windows, NO process-counter bumps
+        — the federation path (see :meth:`SloWindow.record_batch`):
+        the fleet aggregator folds per-merge deltas of the summed
+        replica attained/missed counters, whose unit outcomes were
+        already counted where they happened."""
+        for w in self.windows:
+            w._append(now, True, int(attained_n))
+            w._append(now, False, int(missed_n))
         self.publish(now)
 
     def burn_rates(self, now: float) -> tuple:
@@ -296,7 +362,8 @@ class MultiBurnAlert:
         refresh decays both windows and may clear the alert."""
         for w in self.windows:
             w.publish(now)
-        tracing.set_gauge(SLO_ALERT, 1.0 if self.alert(now) else 0.0)
+        tracing.set_gauge(self.prefix + "alert",
+                          1.0 if self.alert(now) else 0.0)
 
 
 def observe_stage(name: str, seconds: float) -> None:
@@ -457,6 +524,8 @@ def reset() -> None:
     tracing.reset_gauges("serving.")
     tracing.reset_counters("index.")
     tracing.reset_gauges("index.")
+    tracing.reset_counters("memory.")
+    tracing.reset_gauges("memory.")
     tracing.reset_histograms(PREFIX)
     # the class-label cap tracks the histograms it guards
     with _execute_classes_lock:

@@ -668,6 +668,51 @@ class TestFixtureCorpus:
         assert lint_lib(flight_clock, ["R7"],
                         rel="raft_tpu/core/profiling.py").ok
 
+    def test_r5_r7_cover_graftledger_module(self):
+        """PR 13 satellite: the hot scopes reach ``core/memwatch.py``
+        by its real path — the watermark sample runs on the executor's
+        dispatch path, so a host sync there taxes every search, and a
+        bare clock read would split the scrape surface across time
+        domains (the shipped module lints clean: it is shape/dtype
+        arithmetic plus ``memory_stats()`` backend introspection, and
+        keeps no timestamps at all)."""
+        ledger_sync = (
+            "def sample_dispatch(planes):\n"
+            "    return sum(p.sum().item() for p in planes)\n"
+        )
+        bad = lint_lib(ledger_sync, ["R5"],
+                       rel="raft_tpu/core/memwatch.py")
+        assert rules_fired(bad) == {"R5"}
+        ledger_clock = (
+            "import time\n"
+            "\n"
+            "\n"
+            "def sample_stamp():\n"
+            "    return time.monotonic()\n"
+        )
+        bad = lint_lib(ledger_clock, ["R7"],
+                       rel="raft_tpu/core/memwatch.py")
+        assert rules_fired(bad) == {"R7"}
+        # the conforming discipline the module actually uses: pure
+        # metadata arithmetic, no clocks, no array fetches
+        ok = (
+            "def shard_bytes(shape, itemsize):\n"
+            "    b = itemsize\n"
+            "    for s in shape:\n"
+            "        b *= s\n"
+            "    return b\n"
+        )
+        assert lint_lib(ok, ["R5", "R7"],
+                        rel="raft_tpu/core/memwatch.py").ok
+        # the scope boundary: other core modules stay OUTSIDE both
+        # rules (profiling.py's R7 boundary is proven above; prove
+        # the R5 side the same way — memwatch is the one core file
+        # beyond executor.py on the dispatch path)
+        assert lint_lib(ledger_sync, ["R5"],
+                        rel="raft_tpu/core/serialize.py").ok
+        assert lint_lib(ledger_clock, ["R7"],
+                        rel="raft_tpu/core/serialize.py").ok
+
     def test_r7_datetime_clock_reads(self):
         """PR 7: datetime.now()/utcnow()/date.today() are wall-clock
         reads — module-dotted and from-import spellings both fire;

@@ -693,7 +693,8 @@ class TestBenchCompare:
                            "profiling.captures": 1.0,
                            "incident.bundles": 1.0,
                            "profiling.rolling.folds": 2.0,
-                           "fleet.scrapes": 1.0}}
+                           "fleet.scrapes": 1.0,
+                           "memory.samples": 8.0}}
         assert bc.check_snapshot(ok) == []
         dark = {"counters": {"serving.execute.calls": 5.0,
                              "serving.execute.modeled_bytes": 0.0}}
@@ -719,6 +720,7 @@ class TestBenchCompare:
                 "incident.bundles": 1.0,
                 "profiling.rolling.folds": 2.0,
                 "fleet.scrapes": 1.0,
+            "memory.samples": 8.0,
             },
         }
         assert bc.check_snapshot(snap) == []
@@ -779,6 +781,7 @@ class TestBenchCompare:
             "incident.bundles": 1.0,
             "profiling.rolling.folds": 2.0,
             "fleet.scrapes": 1.0,
+            "memory.samples": 8.0,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("index.probe_freq.accounted" in m for m in msgs)
@@ -804,6 +807,7 @@ class TestBenchCompare:
             "incident.bundles": 1.0,
             "profiling.rolling.folds": 2.0,
             "fleet.scrapes": 1.0,
+            "memory.samples": 8.0,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("profiling.captures" in m for m in msgs)
@@ -839,6 +843,7 @@ class TestBenchCompare:
             "incident.bundles": 1.0,
             "profiling.rolling.folds": 0.0,        # rolling dark
             "fleet.scrapes": 1.0,
+            "memory.samples": 8.0,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("profiling.rolling.folds" in m for m in msgs)
@@ -857,6 +862,39 @@ class TestBenchCompare:
             committed = json.load(f)
         assert "profiling.rolling.folds" in committed["snapshot_floors"]
         assert "fleet.scrapes" in committed["snapshot_floors"]
+
+    # -- PR 13: graftledger watermark floor ---------------------------------
+
+    def test_snapshot_floors_include_graftledger(self, bc):
+        """graftledger satellite: the gate floor-checks the
+        dispatch-time watermark heartbeat — a refactor that
+        disconnects ``MemoryLedger.sample_dispatch()`` from the
+        executor's dispatch core zeroes this and fails
+        structurally."""
+        assert "memory.samples" in bc.SNAPSHOT_FLOORS
+        dark = {"counters_lifetime": {
+            "serving.execute.calls": 5.0,
+            "serving.execute.modeled_bytes": 1e6,
+            "serving.execute.modeled_flops": 1e7,
+            "index.probe.dispatches": 3.0,
+            "index.probe_freq.accounted": 96.0,
+            "profiling.captures": 1.0,
+            "incident.bundles": 1.0,
+            "profiling.rolling.folds": 2.0,
+            "fleet.scrapes": 1.0,
+            "memory.samples": 0.0,                 # watermark dark
+        }}
+        msgs = bc.check_snapshot(dark)
+        assert any("memory.samples" in m for m in msgs)
+        dark["counters_lifetime"]["memory.samples"] = 8.0
+        assert bc.check_snapshot(dark) == []
+        import os
+
+        base_path = os.path.join(os.path.dirname(bc.__file__),
+                                 "bench_baseline.json")
+        with open(base_path) as f:
+            committed = json.load(f)
+        assert "memory.samples" in committed["snapshot_floors"]
 
     def test_multi_baseline_gates_each(self, bc, record, tmp_path):
         import copy

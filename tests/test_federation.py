@@ -391,3 +391,413 @@ class TestSnapshotFederationPayload:
         assert w.raw(10.0) == {"hits": 17, "trials": 20, "pairs": 2}
         # pruned pairs leave the raw counts with the window
         assert w.raw(65.0) == {"hits": 9, "trials": 10, "pairs": 1}
+
+
+class TestMemoryMerge:
+    """graftledger federation (PR 13): per-replica memory blocks merge
+    as resident SUM + headroom MIN; a replica missing the block (r2 —
+    older build, no ledger) is skipped and counted, never guessed at."""
+
+    def setup_method(self):
+        metrics.reset()
+        tracing.reset_gauges("fleet.")
+
+    def merged(self):
+        return fixture_aggregator().fleet_snapshot()
+
+    def test_resident_sum_and_headroom_min(self):
+        mem = self.merged()["memory"]
+        # r0 and r1 report; r2 has no memory block
+        assert mem["replicas_reporting"] == 2
+        assert mem["resident_bytes"] == 9_000_000.0
+        # per-index resident sums across replicas holding a copy
+        assert mem["resident"]["ivf:0"] == 8_000_000.0
+        assert mem["resident"]["pq:0"] == 1_000_000.0
+        # headroom is the MIN over measured replicas — r1's 1.5 MB,
+        # not an average, and r2's absence is not infinite room
+        assert mem["headroom_min_bytes"] == 1_500_000.0
+        assert mem["headroom_min_replica"] == "r1"
+        assert mem["forecast_peak_max_bytes"] == 6_000_000.0
+
+    def test_memory_gauges_published(self):
+        self.merged()
+        assert tracing.get_gauge(
+            "fleet.memory.resident_bytes") == 9_000_000.0
+        assert tracing.get_gauge(
+            "fleet.memory.headroom_min_bytes") == 1_500_000.0
+        assert tracing.get_gauge(
+            "fleet.memory.replicas_reporting") == 2.0
+        assert tracing.get_gauge(
+            "fleet.replica.r0.headroom_bytes") == 2_000_000.0
+        assert tracing.get_gauge(
+            "fleet.memory.index.ivf:0.resident_bytes") == 8_000_000.0
+
+    def test_no_replica_reporting(self):
+        """A fleet of memory-block-free replicas merges to an honest
+        zero-reporting block — no gauges invented."""
+        agg = FleetAggregator({"r2": "http://r2/snapshot.json"},
+                              clock=ManualClock(), fetch=fixture_fetch)
+        mem = agg.fleet_snapshot()["memory"]
+        assert mem["replicas_reporting"] == 0
+        assert mem["headroom_min_bytes"] is None
+        assert tracing.get_gauge(
+            "fleet.memory.resident_bytes", -1.0) == -1.0
+
+    def test_stale_replica_drops_from_memory(self):
+        """Memory is instantaneous state: a stale replica's block
+        leaves the merge (unlike its cumulative counters)."""
+        clock = ManualClock()
+        agg = fixture_aggregator(clock=clock)
+        agg.fleet_snapshot()
+        # r0 keeps scraping; r1 and r2 go dark past staleness
+        working = dict(agg._states)
+        def flaky(url, timeout):
+            if "//r0/" in url:
+                return load_replica("r0")
+            raise OSError("down")
+        agg._fetch = flaky
+        clock.advance(agg.config.staleness_s + 1.0)
+        mem = agg.fleet_snapshot()["memory"]
+        assert mem["replicas_reporting"] == 1
+        assert mem["headroom_min_bytes"] == 2_000_000.0
+        assert mem["headroom_min_replica"] == "r0"
+
+    def test_labeled_memory_exposition(self):
+        """fleet_memory_index_resident_bytes renders {index=}-labeled
+        through the exporter (the exposition parse-check satellite)."""
+        agg = fixture_aggregator()
+        exp = MetricsExporter(fleet=agg)
+        text = exp.prometheus_text()
+        assert ('fleet_memory_index_resident_bytes{index="ivf:0"} '
+                "8000000") in text
+        assert "# TYPE fleet_memory_index_resident_bytes gauge" in text
+        assert 'fleet_replica_headroom_bytes{replica="r1"} 1500000' \
+            in text
+
+
+class TestPushMode:
+    """Federation push mode (PR 13): replicas behind NAT POST their
+    /snapshot.json body; it enters the SAME type-correct merge path."""
+
+    def setup_method(self):
+        metrics.reset()
+        tracing.reset_gauges("fleet.")
+
+    def test_push_auto_registers_and_merges(self):
+        clock = ManualClock()
+        agg = FleetAggregator({}, clock=clock, fetch=fixture_fetch)
+        agg.push("nat0", load_replica("r0"))
+        out = agg.merge()
+        assert out["size"] == 1 and out["healthy"] == 1
+        assert out["replicas"]["nat0"]["healthy"]
+        # the merge path is the shared one: lifetime-ledger counters
+        assert out["counters"]["serving.execute.calls"] == 100.0
+        assert out["memory"]["replicas_reporting"] == 1
+
+    def test_push_replicas_are_never_fetched(self):
+        fetched = []
+        def spy(url, timeout):
+            fetched.append(url)
+            return fixture_fetch(url, timeout)
+        agg = FleetAggregator({"r0": "http://r0/snapshot.json"},
+                              clock=ManualClock(), fetch=spy)
+        pushes0 = tracing.get_counter(fed_mod.PUSHES)
+        agg.push("nat0", load_replica("r1"))
+        agg.fleet_snapshot()
+        assert fetched == ["http://r0/snapshot.json"]
+        assert tracing.get_counter(fed_mod.PUSHES) == pushes0 + 1.0
+
+    def test_pushed_counters_stay_monotone(self):
+        """A pushed restart (ledger regression) clamps exactly like a
+        scraped one — one merge path, one monotonicity contract."""
+        clock = ManualClock()
+        agg = FleetAggregator({}, clock=clock, fetch=fixture_fetch)
+        agg.push("nat0", {"counters_lifetime":
+                          {"serving.execute.calls": 100.0}})
+        assert agg.merge()["counters"][
+            "serving.execute.calls"] == 100.0
+        v0 = tracing.get_counter(fed_mod.MONOTONICITY_VIOLATIONS)
+        agg.push("nat0", {"counters_lifetime":
+                          {"serving.execute.calls": 10.0}})
+        assert agg.merge()["counters"][
+            "serving.execute.calls"] == 100.0     # clamped
+        assert tracing.get_counter(
+            fed_mod.MONOTONICITY_VIOLATIONS) == v0 + 1
+
+    def test_push_goes_stale_without_refresh(self):
+        clock = ManualClock()
+        agg = FleetAggregator({}, clock=clock, fetch=fixture_fetch)
+        agg.push("nat0", load_replica("r0"))
+        assert agg.merge()["healthy"] == 1
+        clock.advance(agg.config.staleness_s + 1.0)
+        out = agg.merge()
+        assert out["healthy"] == 0
+        # cumulative surfaces retain the stale lower bound
+        assert out["counters"]["serving.execute.calls"] == 100.0
+
+    def test_http_push_endpoint(self):
+        import urllib.request as ur
+
+        agg = FleetAggregator({}, clock=ManualClock(),
+                              fetch=fixture_fetch)
+        with MetricsExporter(fleet=agg) as exp:
+            body = json.dumps(load_replica("r0")).encode()
+            req = ur.Request(exp.url("/push?replica=nat0"), data=body,
+                             method="POST")
+            out = json.loads(ur.urlopen(req, timeout=10).read())
+            assert out == {"accepted": "nat0"}
+            # 400: no replica name
+            req = ur.Request(exp.url("/push"), data=body,
+                             method="POST")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                ur.urlopen(req, timeout=10)
+            assert e.value.code == 400
+            # 400: body not a JSON object
+            req = ur.Request(exp.url("/push?replica=nat0"),
+                             data=b"[1,2]", method="POST")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                ur.urlopen(req, timeout=10)
+            assert e.value.code == 400
+        assert agg.merge()["replicas"]["nat0"]["scrapes"] == 1
+
+    def test_malformed_push_memory_block_costs_only_that_replica(self):
+        """Review hardening: a pushed snapshot with garbage memory
+        fields (null totals, list-typed resident map) must not poison
+        the fleet merge for the staleness window — the bad replica's
+        contribution drops, everyone else's survives."""
+        clock = ManualClock()
+        agg = FleetAggregator({}, clock=clock, fetch=fixture_fetch)
+        agg.push("good", load_replica("r0"))
+        agg.push("bad", {"counters_lifetime": {},
+                         "memory": {"resident_total_bytes": None,
+                                    "resident": [1, 2],
+                                    "forecast_peak_bytes": "nan?",
+                                    "headroom_bytes": "x"}})
+        mem = agg.merge()["memory"]          # must not raise
+        assert mem["replicas_reporting"] == 2
+        assert mem["resident_bytes"] == 4_000_000.0   # r0 only
+        assert mem["headroom_min_replica"] == "good"
+
+    def test_push_registry_is_bounded(self):
+        """Review hardening: the network-reachable push endpoint
+        cannot grow the replica registry without bound."""
+        import urllib.request as ur
+
+        cfg = fed_mod.FleetConfig(max_push_replicas=2)
+        agg = FleetAggregator({}, clock=ManualClock(),
+                              fetch=fixture_fetch, config=cfg)
+        agg.push("a", {"counters_lifetime": {}})
+        agg.push("b", {"counters_lifetime": {}})
+        agg.push("a", {"counters_lifetime": {}})    # re-push is fine
+        with pytest.raises(ValueError, match="limit"):
+            agg.push("c", {"counters_lifetime": {}})
+        assert set(agg._states) == {"a", "b"}
+        # over HTTP the refusal is a 429, telling the replica to back
+        # off rather than silently dropping its snapshot
+        with MetricsExporter(fleet=agg) as exp:
+            req = ur.Request(exp.url("/push?replica=c"), data=b"{}",
+                             method="POST")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                ur.urlopen(req, timeout=10)
+            assert e.value.code == 429
+
+    def test_push_cannot_impersonate_scrape_replica(self):
+        """Review hardening: an unauthenticated push must never
+        overwrite a configured scrape replica's snapshot — that would
+        ratchet its monotone high-water counters with whatever the
+        pusher claims."""
+        agg = fixture_aggregator()
+        agg.fleet_snapshot()
+        with pytest.raises(ValueError, match="scrape-mode"):
+            agg.push("r0", {"counters_lifetime":
+                            {"serving.slo.missed": 1e15}})
+        # the real replica's clamped counters are untouched
+        assert agg.merge()["counters"]["serving.slo.missed"] == 6.0
+
+    def test_push_names_and_labels_sanitized(self):
+        """Review hardening: network-supplied names/labels reach
+        gauge registry names and Prometheus label values — quotes and
+        newlines must never survive into the exposition."""
+        agg = FleetAggregator({}, clock=ManualClock(),
+                              fetch=fixture_fetch)
+        agg.push('evil"}x\nup 1', {
+            "counters_lifetime": {},
+            "memory": {"resident_total_bytes": 10,
+                       "resident": {'bad"label\n': 10},
+                       "headroom_bytes": 5.0}})
+        out = agg.merge()
+        assert list(out["replicas"]) == ["evil--x-up-1"]
+        assert list(out["memory"]["resident"]) == ["bad-label-"]
+        text = MetricsExporter(fleet=agg).prometheus_text()
+        for line in text.splitlines():
+            assert '"}x' not in line and "up 1\"" not in line
+
+    def test_memory_label_cardinality_bounded(self):
+        """Review hardening: one replica's snapshot cannot mint
+        unbounded per-index fleet gauges — top-N largest publish,
+        stale labels retire."""
+        agg = FleetAggregator({}, clock=ManualClock(),
+                              fetch=fixture_fetch)
+        resident = {f"idx{i}": float(i) for i in range(100)}
+        agg.push("a", {"counters_lifetime": {},
+                       "memory": {"resident_total_bytes": 1,
+                                  "resident": resident}})
+        agg.merge()
+        published = tracing.gauges("fleet.memory.index.")
+        assert len(published) == fed_mod.MEMORY_LABEL_CAP
+        # largest residents won
+        assert "fleet.memory.index.idx99.resident_bytes" in published
+        assert "fleet.memory.index.idx0.resident_bytes" not in published
+        # a later merge with fewer labels retires the stale ones
+        agg.push("a", {"counters_lifetime": {},
+                       "memory": {"resident_total_bytes": 1,
+                                  "resident": {"only": 5.0}}})
+        agg.merge()
+        assert list(tracing.gauges("fleet.memory.index.")) == \
+            ["fleet.memory.index.only.resident_bytes"]
+
+    def test_nonfinite_pushed_values_are_garbage_not_measurements(self):
+        """Review hardening: JSON ``1e999`` parses to inf — a pushed
+        infinity must neither ratchet the monotone counter marks
+        (which would crash the multiburn int() delta on every later
+        merge) nor poison the fleet memory sums."""
+        from raft_tpu.serving import MultiBurnConfig
+        from raft_tpu.serving.metrics import SloConfig
+
+        clock = ManualClock()
+        agg = FleetAggregator(
+            {}, clock=clock, fetch=fixture_fetch,
+            config=fed_mod.FleetConfig(multiburn=MultiBurnConfig(
+                short=SloConfig(window_s=300.0),
+                long=SloConfig(window_s=3600.0))))
+        agg.push("x", json.loads(
+            '{"counters_lifetime": {"serving.slo.attained": 1e999,'
+            ' "serving.execute.calls": 7.0},'
+            ' "memory": {"resident_total_bytes": 1e999,'
+            ' "resident": {"a": 1e999, "b": 5.0},'
+            ' "headroom_bytes": 1e999}}'))
+        out = agg.merge()                      # must not raise
+        assert "serving.slo.attained" not in out["counters"]
+        assert out["counters"]["serving.execute.calls"] == 7.0
+        mem = out["memory"]
+        assert mem["resident_bytes"] == 0.0    # inf dropped, honest 0
+        assert mem["resident"] == {"a": 0.0, "b": 5.0} or \
+            mem["resident"] == {"b": 5.0}
+        assert mem["headroom_min_bytes"] is None
+        # merges keep working afterwards (the poison would have been
+        # permanent)
+        clock.advance(1.0)
+        agg.merge()
+
+    def test_stale_memory_and_replica_gauges_retire(self):
+        """Review hardening: a replica that stops reporting memory
+        (or drops entirely) must not keep advertising its last
+        headroom — stale room is what an operator would place the hot
+        tier on."""
+        clock = ManualClock()
+        agg = FleetAggregator({}, clock=clock, fetch=fixture_fetch)
+        agg.push("a", {"counters_lifetime": {},
+                       "memory": {"resident_total_bytes": 10,
+                                  "resident": {"i": 10},
+                                  "headroom_bytes": 8e9}})
+        agg.merge()
+        assert tracing.get_gauge(
+            "fleet.replica.a.headroom_bytes") == 8e9
+        # the replica goes stale -> memory and headroom gauges retire
+        clock.advance(agg.config.staleness_s + 1.0)
+        agg.merge()
+        assert tracing.get_gauge(
+            "fleet.replica.a.headroom_bytes", -1.0) == -1.0
+        assert tracing.get_gauge(
+            "fleet.memory.resident_bytes", -1.0) == -1.0
+        assert tracing.gauges("fleet.memory.index.") == {}
+        # the replica itself is still listed (unhealthy), so its
+        # health gauge re-publishes
+        assert tracing.get_gauge("fleet.replica.a.healthy") == 0.0
+
+    def test_http_push_404_without_aggregator(self):
+        import urllib.request as ur
+
+        with MetricsExporter() as exp:
+            req = ur.Request(exp.url("/push?replica=x"), data=b"{}",
+                             method="POST")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                ur.urlopen(req, timeout=10)
+            assert e.value.code == 404
+
+
+class TestFleetBurnAlert:
+    """Fleet-level multiburn alerting (PR 13, the PR 12 named
+    follow-on): per-merge deltas of the summed attained/missed fleet
+    counters fold into a 5m+1h MultiBurnAlert pair under fleet.slo.*,
+    ManualClock-pinned."""
+
+    def setup_method(self):
+        metrics.reset()
+        tracing.reset_gauges("fleet.")
+
+    def make(self, clock, short_s=300.0, long_s=3600.0):
+        from raft_tpu.serving import MultiBurnConfig
+        from raft_tpu.serving.metrics import SloConfig
+
+        return FleetAggregator(
+            {}, clock=clock, fetch=fixture_fetch,
+            config=fed_mod.FleetConfig(multiburn=MultiBurnConfig(
+                short=SloConfig(window_s=short_s, target=0.9),
+                long=SloConfig(window_s=long_s, target=0.9))))
+
+    def push_counts(self, agg, attained, missed):
+        agg.push("a", {"counters_lifetime": {
+            "serving.slo.attained": float(attained),
+            "serving.slo.missed": float(missed)}})
+
+    def test_first_merge_primes_baseline(self):
+        """History predating the aggregator is not re-judged: the
+        first merge seeds the delta baseline and records nothing."""
+        clock = ManualClock()
+        agg = self.make(clock)
+        self.push_counts(agg, 1000, 500)
+        out = agg.merge()
+        assert out["slo"]["burn_rates"] == {"5m": 0.0, "1h": 0.0}
+        assert out["slo"]["alert"] is False
+        assert tracing.get_gauge("fleet.slo.alert") == 0.0
+
+    def test_alert_fires_when_both_windows_burn(self):
+        clock = ManualClock()
+        agg = self.make(clock)
+        self.push_counts(agg, 100, 0)
+        agg.merge()
+        # 50% misses over the next merge window: burn 0.5/0.1 = 5.0
+        clock.advance(10.0)
+        self.push_counts(agg, 110, 10)
+        out = agg.merge()
+        assert out["slo"]["burn_rates"]["5m"] == pytest.approx(5.0)
+        assert out["slo"]["burn_rates"]["1h"] == pytest.approx(5.0)
+        assert out["slo"]["alert"] is True
+        assert tracing.get_gauge("fleet.slo.alert") == 1.0
+        assert tracing.get_gauge(
+            "fleet.slo.burn_rate.5m") == pytest.approx(5.0)
+
+    def test_short_window_recovery_clears_alert(self):
+        """The multiburn pattern at fleet scope: after the misses age
+        out of the SHORT window, healthy merges clear the alert even
+        while the long window still burns."""
+        clock = ManualClock()
+        agg = self.make(clock, short_s=60.0, long_s=3600.0)
+        self.push_counts(agg, 100, 0)
+        agg.merge()
+        clock.advance(10.0)
+        self.push_counts(agg, 100, 20)       # a burst of misses
+        assert agg.merge()["slo"]["alert"] is True
+        # an hour of healthy traffic later: short window clean, long
+        # window still carries the burst
+        clock.advance(120.0)
+        self.push_counts(agg, 400, 20)
+        out = agg.merge()
+        assert out["slo"]["burn_rates"]["5m"] == 0.0
+        assert out["slo"]["burn_rates"]["1h"] > 0.0
+        assert out["slo"]["alert"] is False
+
+    def test_no_multiburn_config_no_slo_block(self):
+        agg = fixture_aggregator()
+        assert "slo" not in agg.fleet_snapshot()

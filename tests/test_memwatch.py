@@ -1,0 +1,631 @@
+"""graftledger tests (PR 13) — the memory-truth plane.
+
+The acceptance criteria this file carries: the resident-bytes model is
+pinned BYTE-EXACT against at least one real index per family (flat /
+PQ / BQ, the BQ one with AND without its rerank plane) and per shard
+on the mesh; ``memory_stats()``-unsupported backends (CPU — the tier-1
+environment) degrade honestly to ``supported: False`` instead of
+invented numbers; the reservation forecast's arithmetic is pinned
+against the executor's real donated-state/temp reservations; the
+opt-in capacity gate refuses a build host-side with a typed
+:class:`~raft_tpu.core.memwatch.CapacityExceeded` BEFORE any device
+allocation; zero-recompile and bit-identity stay green with the
+ledger fully enabled, single-chip AND mesh; and the exporter /
+flight-recorder surfaces (``/memory.json``, ``/memory_profile``, the
+low-headroom incident trigger) serve the same numbers.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from raft_tpu.comms import local_comms
+from raft_tpu.core import memwatch, tracing
+from raft_tpu.core.executor import SearchExecutor
+from raft_tpu.core.memwatch import CapacityExceeded, MemoryLedger
+from raft_tpu.distributed import ivf as dist_ivf
+from raft_tpu.neighbors import brute_force, ivf_bq, ivf_flat, ivf_pq
+from raft_tpu.serving import metrics
+from raft_tpu.serving.harness import ManualClock
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((512, 32)).astype(np.float32)
+    q = rng.standard_normal((16, 32)).astype(np.float32)
+    return x, q
+
+
+@pytest.fixture(scope="module")
+def flat_index(data):
+    x, _ = data
+    return ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    metrics.reset()
+    memwatch.remove_gate()
+    yield
+    memwatch.remove_gate()
+
+
+def model_vs_nbytes(index):
+    """The byte-exact pin: the model must equal the arrays' own
+    ``nbytes``, component by component and in total."""
+    import dataclasses
+
+    model = memwatch.index_memory_model(index)
+    total = 0
+    for f in dataclasses.fields(index):
+        v = getattr(index, f.name, None)
+        if v is None or not hasattr(v, "nbytes"):
+            continue
+        assert model["components"][f.name]["bytes"] == v.nbytes, f.name
+        total += v.nbytes
+    assert model["resident_bytes"] == total
+    return model
+
+
+class TestResidentModel:
+    """Byte-exact pins of the resident-bytes model, per family."""
+
+    def test_flat_byte_exact(self, flat_index):
+        model = model_vs_nbytes(flat_index)
+        # single-chip: per-shard == global (nothing is sharded)
+        assert model["shard_resident_bytes"] == model["resident_bytes"]
+        assert set(model["components"]) == {
+            "centers", "center_norms", "data", "data_norms",
+            "indices", "list_sizes"}
+
+    def test_pq_byte_exact(self, data):
+        x, _ = data
+        idx = ivf_pq.build(
+            None, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8), x)
+        model = model_vs_nbytes(idx)
+        assert "codes" in model["components"]
+        assert "codebooks" in model["components"]
+
+    def test_bq_byte_exact_with_and_without_rerank_plane(self, data):
+        x, _ = data
+        with_plane = ivf_bq.build(
+            None, ivf_bq.IvfBqIndexParams(n_lists=8), x)
+        codes_only = ivf_bq.build(
+            None, ivf_bq.IvfBqIndexParams(n_lists=8,
+                                          store_vectors=False), x)
+        m1 = model_vs_nbytes(with_plane)
+        m0 = model_vs_nbytes(codes_only)
+        # the rerank plane is exactly the raw-vector + norm planes:
+        # the codes-only model must be smaller by exactly their bytes
+        assert "data" in m1["components"]
+        assert "data" not in m0["components"]
+        plane = (m1["components"]["data"]["bytes"]
+                 + m1["components"]["data_norms"]["bytes"])
+        assert m1["resident_bytes"] - m0["resident_bytes"] == plane
+        # the correction scalars and packed words are all modeled
+        for comp in ("codes", "rnorm", "cfac", "errw"):
+            assert comp in m0["components"]
+
+    def test_brute_force_byte_exact(self, data):
+        x, _ = data
+        idx = brute_force.build(None, x)
+        model = model_vs_nbytes(idx)
+        assert model["components"]["dataset"]["bytes"] == x.nbytes
+
+    def test_known_layout_pinned(self):
+        """The model against hand-computed numbers for a fixed
+        layout — a model change must move THIS pin, not only the
+        nbytes identity."""
+        x = np.zeros((256, 32), np.float32)
+        idx = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=4), x)
+        n_lists, mls, d = 4, idx.max_list_size, 32
+        expected = (
+            n_lists * d * 4            # centers f32
+            + n_lists * 4              # center_norms f32
+            + n_lists * mls * d * 4    # data f32
+            + n_lists * mls * 4        # data_norms f32
+            + n_lists * mls * 4        # indices i32
+            + n_lists * 4)             # list_sizes i32
+        model = memwatch.index_memory_model(idx)
+        assert model["resident_bytes"] == expected
+
+    def test_mesh_per_shard(self, data):
+        """Mesh-sharded index: global bytes match nbytes; per-shard
+        bytes follow each array's own sharding (list-sharded planes
+        are 1/R of global on the 8-virtual-device mesh)."""
+        x, _ = data
+        comms = local_comms()
+        didx = dist_ivf.build(
+            None, comms, ivf_flat.IvfFlatIndexParams(n_lists=32), x)
+        model = model_vs_nbytes(didx)
+        r = comms.size
+        comp = model["components"]
+        # list-sharded planes shard 1/R per device
+        assert comp["data"]["shard_bytes"] == comp["data"]["bytes"] // r
+        assert comp["indices"]["shard_bytes"] == \
+            comp["indices"]["bytes"] // r
+        assert model["shard_resident_bytes"] < model["resident_bytes"]
+        # per-device map covers every mesh device, and sums to the
+        # global total (each byte lands on exactly one device for
+        # fully-sharded planes)
+        assert set(model["per_device_bytes"]) == {
+            int(d.id) for d in comms.mesh.devices.flat}
+
+
+class TestLiveStatsFallback:
+    """The memory_stats()-unsupported path — CPU is the tier-1
+    backend, so this IS the honest-fallback proof."""
+
+    def test_supported_false_on_cpu(self):
+        stats = memwatch.device_memory_stats()
+        assert stats["supported"] is False
+        assert stats["devices"] == {}
+
+    def test_snapshot_degrades_honestly(self, flat_index):
+        ledger = MemoryLedger()
+        ledger.watch("flat", flat_index)
+        snap = ledger.snapshot()
+        assert snap["supported"] is False
+        assert snap["devices"] == {}
+        # no live truth -> no divergence, no invented headroom
+        assert snap["divergence_bytes"] is None
+        assert snap["headroom_bytes"] is None
+        # ... but the MODEL keeps working
+        assert snap["resident_total_bytes"] > 0
+        ledger.publish()
+        assert tracing.get_gauge("memory.live.supported") == 0.0
+        assert tracing.get_gauge("memory.hbm.headroom_bytes") == -1.0
+        assert tracing.get_gauge(
+            "memory.index.flat.resident_bytes") > 0
+
+    def test_capacity_override_restores_headroom(self, flat_index):
+        model = memwatch.index_memory_model(flat_index)
+        cap = model["resident_bytes"] + 10_000
+        ledger = MemoryLedger(capacity_bytes=cap)
+        ledger.watch("flat", flat_index)
+        room = ledger.headroom_bytes()
+        assert room == pytest.approx(
+            cap - ledger.forecast()["peak_bytes"])
+
+
+class TestLiveArithmetic:
+    """The supported-backend arithmetic (headroom, divergence,
+    watermark), pinned with injected stats — CPU cannot produce them
+    live, but the formulas must not wait for a TPU to be wrong."""
+
+    def fake_stats(self):
+        return {"supported": True, "devices": {
+            0: {"in_use_bytes": 6e6, "peak_bytes": 7e6,
+                "limit_bytes": 8e6},
+            1: {"in_use_bytes": 5e6, "peak_bytes": 6e6,
+                "limit_bytes": 8e6},
+        }}
+
+    def test_headroom_divergence_watermark(self, flat_index,
+                                           monkeypatch):
+        monkeypatch.setattr(memwatch, "device_memory_stats",
+                            lambda devices=None: self.fake_stats())
+        ledger = MemoryLedger()
+        ledger.watch("flat", flat_index)
+        # headroom = min over devices of limit - in_use (device 0)
+        assert ledger.headroom_bytes() == 8e6 - 6e6
+        snap = ledger.snapshot()
+        assert snap["supported"] is True
+        # divergence = total live in-use - modeled residency terms
+        model = memwatch.index_memory_model(flat_index)
+        assert snap["divergence_bytes"] == \
+            (6e6 + 5e6) - model["resident_bytes"]
+        # the dispatch watermark folds the in-use total
+        ledger.sample_dispatch()
+        assert ledger.snapshot()["watermark"]["in_use_peak_bytes"] \
+            == 6e6 + 5e6
+        ledger.publish()
+        assert tracing.get_gauge("memory.live.supported") == 1.0
+        assert tracing.get_gauge(
+            "memory.device.0.in_use_bytes") == 6e6
+        assert tracing.get_gauge("memory.hbm.headroom_bytes") == 2e6
+
+    def test_live_limit_beats_configured_capacity(self, flat_index,
+                                                  monkeypatch):
+        monkeypatch.setattr(memwatch, "device_memory_stats",
+                            lambda devices=None: self.fake_stats())
+        # a configured capacity is the fallback, not an override:
+        # measured truth wins when the backend provides it
+        ledger = MemoryLedger(capacity_bytes=1.0)
+        assert ledger.headroom_bytes() == 2e6
+
+    def test_snapshot_reads_backend_once(self, flat_index,
+                                         monkeypatch):
+        """Review hardening: one snapshot = one backend stats read +
+        one model walk — headroom/divergence derive from the same
+        inputs instead of re-reading per field."""
+        calls = {"n": 0}
+
+        def counting(devices=None):
+            calls["n"] += 1
+            return self.fake_stats()
+
+        monkeypatch.setattr(memwatch, "device_memory_stats", counting)
+        ledger = MemoryLedger(capacity_bytes=1e9)
+        ledger.watch("flat", flat_index)
+        ledger.snapshot()
+        assert calls["n"] == 1
+
+
+class TestForecast:
+    """The reservation forecast pinned against the executor's real
+    reservations — byte-exact arithmetic, no tolerance."""
+
+    def test_terms_pinned(self, data, flat_index):
+        _, q = data
+        ex = SearchExecutor(min_bucket=16, max_bucket=16)
+        ledger = MemoryLedger(executor=ex)
+        label = ledger.watch("flat", flat_index)
+        assert label == "flat"
+        ex.search(flat_index, q, 5,
+                  ivf_flat.IvfFlatSearchParams(scan_engine="xla"))
+        res = ex.memory_reservations()
+        # ONE xla-engine entry at bucket 16, k 5: the donated (16, 5)
+        # f32 + i32 state pair
+        assert sum(res["donated_state_bytes"].values()) == \
+            16 * 5 * (4 + 4)
+        assert res["executables"] == 1
+        costs = ex.executable_costs()
+        max_temp = max(c.get("temp_bytes", 0.0) for c in costs.values())
+        assert res["max_temp_bytes"] == max_temp
+        fc = ledger.forecast()
+        model = memwatch.index_memory_model(flat_index)
+        assert fc["resident_bytes"] == model["resident_bytes"]
+        assert fc["donated_state_bytes"] == 16 * 5 * 8
+        assert fc["max_temp_bytes"] == max_temp
+        # single chip: everything lands on device 0, and the peak is
+        # exactly the sum of the three terms
+        assert fc["peak_bytes"] == (model["resident_bytes"]
+                                    + 16 * 5 * 8 + max_temp)
+
+    def test_probe_plane_term(self, data, flat_index):
+        _, q = data
+        ex = SearchExecutor(min_bucket=16, max_bucket=16,
+                            probe_accounting=True)
+        ledger = MemoryLedger(executor=ex)
+        ledger.watch("flat", flat_index)
+        ex.search(flat_index, q, 5)
+        fc = ledger.forecast()
+        # one int32 plane of n_lists entries
+        assert fc["probe_plane_bytes"] == flat_index.n_lists * 4
+
+    def test_dead_index_drops_from_model(self, data):
+        x, _ = data
+        ledger = MemoryLedger()
+        idx = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=4), x)
+        ledger.watch("tmp", idx)
+        assert "tmp" in ledger.resident()
+        del idx
+        import gc
+
+        gc.collect()
+        assert "tmp" not in ledger.resident()
+
+
+class TestCapacityGate:
+    """fits() + the opt-in CapacityExceeded gate on build/extend."""
+
+    def test_fits_unknown_is_distinguishable(self, flat_index):
+        ledger = MemoryLedger()          # no live stats, no capacity
+        verdict = ledger.fits(flat_index)
+        assert verdict["fits"] is True and verdict["unknown"] is True
+        assert verdict["headroom_bytes"] is None
+
+    def test_fits_against_capacity(self, flat_index):
+        model = memwatch.index_memory_model(flat_index)
+        # room for one-and-a-half copies: the first fits, a second
+        # copy next to it does not
+        ledger = MemoryLedger(
+            capacity_bytes=1.5 * model["resident_bytes"])
+        assert ledger.fits(flat_index)["fits"] is True
+        ledger.watch("flat", flat_index)
+        assert ledger.fits(flat_index)["fits"] is False
+        # safety_fraction tightens the verdict further: with the full
+        # capacity free, reserving 60% refuses what 0% admits
+        empty = MemoryLedger(
+            capacity_bytes=1.5 * model["resident_bytes"])
+        assert empty.fits(flat_index,
+                          safety_fraction=0.6)["fits"] is False
+
+    def test_gate_refuses_build_host_side(self, data, flat_index):
+        x, _ = data
+        ledger = MemoryLedger(capacity_bytes=1000)
+        memwatch.install_gate(ledger)
+        with pytest.raises(CapacityExceeded) as e:
+            ivf_flat.build(
+                None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+        assert e.value.required_bytes > 1000
+        assert e.value.headroom_bytes == 1000
+        assert "ivf_flat.extend" in str(e.value)
+        assert tracing.get_counter("memory.gate.refused") >= 1
+        # gate removed -> same build admits again
+        memwatch.remove_gate()
+        ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+
+    def test_gate_covers_every_family(self, data):
+        x, _ = data
+        memwatch.install_gate(MemoryLedger(capacity_bytes=100))
+        with pytest.raises(CapacityExceeded, match="ivf_pq.extend"):
+            ivf_pq.build(
+                None, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8), x)
+        with pytest.raises(CapacityExceeded, match="ivf_bq.extend"):
+            ivf_bq.build(None, ivf_bq.IvfBqIndexParams(n_lists=8), x)
+        with pytest.raises(CapacityExceeded,
+                           match="brute_force.build"):
+            brute_force.build(None, x)
+
+    def test_gate_covers_build_streaming(self, data, tmp_path):
+        """Review hardening: the streaming builds allocate the full
+        padded layout directly — the gate must see them too (the
+        'corpus ≫ headroom' path is exactly what streaming serves)."""
+        from raft_tpu.io import BinDataset, write_bin
+
+        x, _ = data
+        path = tmp_path / "d.fbin"
+        write_bin(path, x)
+        memwatch.install_gate(MemoryLedger(capacity_bytes=100))
+        with BinDataset(path) as ds:
+            with pytest.raises(CapacityExceeded,
+                               match="ivf_flat.build_streaming"):
+                ivf_flat.build_streaming(
+                    None, ivf_flat.IvfFlatIndexParams(n_lists=8), ds,
+                    chunk_rows=256)
+            with pytest.raises(CapacityExceeded,
+                               match="ivf_pq.build_streaming"):
+                ivf_pq.build_streaming(
+                    None, ivf_pq.IvfPqIndexParams(n_lists=8, pq_dim=8),
+                    ds, chunk_rows=256)
+            with pytest.raises(CapacityExceeded,
+                               match="ivf_bq.build_streaming"):
+                ivf_bq.build_streaming(
+                    None, ivf_bq.IvfBqIndexParams(n_lists=8), ds,
+                    chunk_rows=256)
+
+    def test_gate_admits_within_capacity(self, data):
+        x, _ = data
+        memwatch.install_gate(MemoryLedger(capacity_bytes=10**9))
+        admitted0 = tracing.get_counter("memory.gate.admitted")
+        idx = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+        assert idx.size == x.shape[0]
+        assert tracing.get_counter("memory.gate.admitted") > admitted0
+
+    def test_extend_gated_on_growth(self, data):
+        """An extend that must grow the padded extent re-allocates —
+        the gate sees exactly that repack."""
+        x, _ = data
+        idx = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+        model = memwatch.index_memory_model(idx)
+        # capacity admits the index once but not a grown repack
+        memwatch.install_gate(
+            MemoryLedger(capacity_bytes=model["resident_bytes"]))
+        rng = np.random.default_rng(3)
+        with pytest.raises(CapacityExceeded):
+            ivf_flat.extend(
+                None, idx,
+                rng.standard_normal((512, 32)).astype(np.float32))
+
+
+class TestLedgerOnIdentity:
+    """The acceptance criterion: zero-recompile + bit-identity stay
+    green with the memory ledger fully enabled (watermark sampling on
+    every dispatch), single-chip and mesh."""
+
+    def test_single_chip(self, data, flat_index):
+        _, q = data
+        tracing.install_xla_compile_listener()
+        params = ivf_flat.IvfFlatSearchParams(n_probes=4)
+        bare = SearchExecutor(min_bucket=16, max_bucket=16)
+        d0, i0 = bare.search(flat_index, q, 5, params)
+        ex = SearchExecutor(min_bucket=16, max_bucket=16)
+        ledger = MemoryLedger(executor=ex)
+        ledger.watch("flat", flat_index)
+        samples0 = tracing.get_counter(memwatch.SAMPLES)
+        d1, i1 = ex.search(flat_index, q, 5, params)
+        # bit-identity vs the ledger-free executor
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        # zero-recompile in steady state with sampling live
+        backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        compiles0 = ex.stats.compile_count
+        for _ in range(4):
+            ex.search(flat_index, q, 5, params)
+            ledger.publish()
+        assert ex.stats.compile_count == compiles0
+        assert tracing.get_counter(
+            tracing.XLA_COMPILE_COUNT) == backend0
+        # the heartbeat the CI snapshot floor checks: one sample per
+        # dispatch, even on a backend without live stats
+        assert tracing.get_counter(memwatch.SAMPLES) == samples0 + 5
+
+    def test_mesh(self, data):
+        x, q = data
+        comms = local_comms()
+        didx = dist_ivf.build(
+            None, comms, ivf_flat.IvfFlatIndexParams(n_lists=32), x)
+        tracing.install_xla_compile_listener()
+        params = ivf_flat.IvfFlatSearchParams(n_probes=8)
+        bare = SearchExecutor(min_bucket=16, max_bucket=16)
+        d0, i0 = bare.search(didx, q, 5, params)
+        ex = SearchExecutor(min_bucket=16, max_bucket=16)
+        ledger = MemoryLedger(executor=ex)
+        ledger.watch("dist-flat", didx)
+        d1, i1 = ex.search(didx, q, 5, params)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        compiles0 = ex.stats.compile_count
+        for _ in range(3):
+            ex.search(didx, q, 5, params)
+            ledger.publish()
+        assert ex.stats.compile_count == compiles0
+        assert tracing.get_counter(
+            tracing.XLA_COMPILE_COUNT) == backend0
+        # the mesh model reached the gauges per shard
+        assert tracing.get_gauge(
+            "memory.index.dist-flat.shard_bytes") < tracing.get_gauge(
+            "memory.index.dist-flat.resident_bytes")
+
+
+class TestExporterSurface:
+    """/memory.json + /memory_profile + the labeled families."""
+
+    def test_memory_json_and_labeled_families(self, data, flat_index):
+        from raft_tpu.serving import MetricsExporter
+
+        _, q = data
+        ex = SearchExecutor(min_bucket=16, max_bucket=16)
+        ledger = MemoryLedger(executor=ex)
+        ledger.watch("flat", flat_index)
+        ex.search(flat_index, q, 5)
+        with MetricsExporter(executor=ex, memory=ledger) as exp:
+            body = json.loads(urllib.request.urlopen(
+                exp.url("/memory.json"), timeout=10).read())
+            text = urllib.request.urlopen(
+                exp.url("/metrics"), timeout=10).read().decode()
+            snap = json.loads(urllib.request.urlopen(
+                exp.url("/snapshot.json"), timeout=10).read())
+        model = memwatch.index_memory_model(flat_index)
+        assert body["supported"] is False
+        assert body["indexes"]["flat"]["resident_bytes"] == \
+            model["resident_bytes"]
+        assert body["forecast"]["peak_bytes"] >= model["resident_bytes"]
+        lines = text.splitlines()
+        assert any(l.startswith(
+            'memory_index_resident_bytes{index="flat"} ')
+            for l in lines)
+        assert "# TYPE memory_index_resident_bytes gauge" in lines
+        # the federation block rides /snapshot.json
+        assert snap["memory"]["resident"]["flat"] == \
+            model["resident_bytes"]
+        assert snap["memory"]["headroom_bytes"] is None
+
+    def test_memory_json_404_without_ledger(self):
+        from raft_tpu.serving import MetricsExporter
+
+        with MetricsExporter() as exp:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(exp.url("/memory.json"),
+                                       timeout=10)
+            assert e.value.code == 404
+
+    def test_memory_profile_gated_and_armed(self, tmp_path):
+        from raft_tpu.serving import MetricsExporter
+
+        # unarmed: 403, same gate as /profile
+        with MetricsExporter() as exp:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(exp.url("/memory_profile"),
+                                       timeout=10)
+            assert e.value.code == 403
+        # armed: the pprof bytes land under profile_dir and the
+        # response names the file
+        with MetricsExporter(profile_dir=str(tmp_path)) as exp:
+            out = json.loads(urllib.request.urlopen(
+                exp.url("/memory_profile"), timeout=10).read())
+        assert out["bytes"] > 0
+        import os
+
+        assert os.path.exists(out["path"])
+        assert out["path"].startswith(str(tmp_path))
+
+    def test_memory_profile_shares_profile_lock(self, tmp_path):
+        """One profiler customer at a time, both directions: a held
+        profile lock 409s /memory_profile."""
+        from raft_tpu.serving import MetricsExporter
+
+        exp = MetricsExporter(profile_dir=str(tmp_path))
+        assert exp._profile_lock.acquire(blocking=False)
+        try:
+            with pytest.raises(RuntimeError):
+                exp.memory_profile()
+        finally:
+            exp._profile_lock.release()
+        # released -> works
+        assert exp.memory_profile()["bytes"] > 0
+
+    def test_memory_profile_never_overwrites_across_restarts(
+            self, tmp_path):
+        """Review hardening: the capture sequence restarts with the
+        process — a 'restarted' exporter must skip existing names,
+        never overwrite the pre-crash evidence."""
+        from raft_tpu.serving import MetricsExporter
+
+        first = MetricsExporter(profile_dir=str(tmp_path))
+        p1 = first.memory_profile()["path"]
+        restarted = MetricsExporter(profile_dir=str(tmp_path))
+        p2 = restarted.memory_profile()["path"]
+        assert p1 != p2
+        import os
+
+        assert os.path.exists(p1) and os.path.exists(p2)
+
+
+class TestLowHeadroomIncident:
+    """The graftledger -> graftflight wiring: low headroom arms an
+    incident bundle carrying the memory snapshot; ManualClock pins
+    the cooldown."""
+
+    def make_flight(self, ledger, clock, **cfg):
+        from raft_tpu.serving.flight import FlightConfig, FlightRecorder
+
+        config = FlightConfig(cooldown_s=60.0, latency=None,
+                              low_headroom_bytes=10_000, **cfg)
+        return FlightRecorder(config=config, clock=clock,
+                              capture_fn=lambda: None, memory=ledger)
+
+    def test_trigger_and_bundle(self, flat_index):
+        model = memwatch.index_memory_model(flat_index)
+        # capacity barely above residency -> headroom under threshold
+        ledger = MemoryLedger(
+            capacity_bytes=model["resident_bytes"] + 100)
+        ledger.watch("flat", flat_index)
+        assert ledger.headroom_bytes() <= 10_000
+        clock = ManualClock()
+        flight = self.make_flight(ledger, clock)
+        bundle = flight.check(clock.now())
+        assert bundle is not None
+        assert bundle["triggers"] == ["low_headroom"]
+        # the bundle carries the evidence: the full memory snapshot
+        assert bundle["memory"]["headroom_bytes"] == \
+            ledger.headroom_bytes()
+        assert bundle["memory"]["indexes"]["flat"]["resident_bytes"] \
+            == model["resident_bytes"]
+        assert tracing.get_counter(
+            "incident.trigger.low_headroom") == 1
+
+    def test_cooldown_rate_limits(self, flat_index):
+        model = memwatch.index_memory_model(flat_index)
+        ledger = MemoryLedger(
+            capacity_bytes=model["resident_bytes"] + 100)
+        ledger.watch("flat", flat_index)
+        clock = ManualClock()
+        flight = self.make_flight(ledger, clock)
+        assert flight.check(clock.now()) is not None
+        clock.advance(1.0)
+        assert flight.check(clock.now()) is None    # suppressed
+        assert tracing.get_counter("incident.suppressed") == 1
+        clock.advance(120.0)
+        assert flight.check(clock.now()) is not None
+
+    def test_unknown_headroom_never_fires(self, flat_index):
+        # CPU, no capacity configured: headroom is None — ignorance
+        # is not an incident
+        ledger = MemoryLedger()
+        ledger.watch("flat", flat_index)
+        assert ledger.headroom_bytes() is None
+        clock = ManualClock()
+        flight = self.make_flight(ledger, clock)
+        assert flight.check(clock.now()) is None

@@ -1297,8 +1297,14 @@ class TestExpositionHelpTypePairing:
         gauge = __import__("raft_tpu.serving.gauge",
                            fromlist=["IndexGauge"]).IndexGauge(
             executor=ex, indexes={"main": real_setup["ivf"]})
+        # graftledger (PR 13): the memory.* families must carry
+        # HELP/TYPE and parse like every other labeled family
+        from raft_tpu.core.memwatch import MemoryLedger
+
+        ledger = MemoryLedger(executor=ex)
+        ledger.watch("main", real_setup["ivf"])
         with MetricsExporter(executor=ex, batcher=b,
-                             index_gauge=gauge) as exp:
+                             index_gauge=gauge, memory=ledger) as exp:
             text = urllib.request.urlopen(
                 exp.url("/metrics"), timeout=10).read().decode()
         b.close()
@@ -1336,6 +1342,12 @@ class TestExpositionHelpTypePairing:
         assert not missing_type, f"families without TYPE: {missing_type}"
         # the graftgauge labeled families are present and annotated
         assert "index_health_rows" in families
+        # the graftledger labeled + flat families are present and
+        # annotated (per-device families appear only on backends with
+        # live memory_stats — not CPU)
+        assert "memory_index_resident_bytes" in families
+        assert "memory_hbm_headroom_bytes" in families
+        assert "memory_live_supported" in families
         assert any(f.startswith("index_probe_freq") for f in families)
 
 

@@ -46,7 +46,11 @@ latency, shed rate and batch occupancy next to the one-request-per-
 call baseline QPS), BENCH_BQ=1 (RaBitQ IVF-BQ: fused
 estimate-then-rerank vs estimate+refine recall at equal over-fetch,
 modeled bytes/vector and one-stream bytes vs the two-pass model,
-achieved GB/s vs the stream_read_sum roofline).
+achieved GB/s vs the stream_read_sum roofline), BENCH_TIERED=1
+(grafttier: hot/cold tiered storage — bit-identity vs the all-HBM
+index, hot GB/s vs the HBM roofline and cold GB/s vs a host-link
+roofline, two live placement epochs with zero backend compiles and
+deterministic swap bytes).
 """
 
 import json
@@ -629,6 +633,15 @@ def child_main():
         except Exception as e:  # noqa: BLE001 — keep headline record
             log(f"bq rider failed ({e}); keeping headline record")
 
+    # opt-in rider: grafttier — hot/cold tiered storage under the
+    # dual-roofline accounting, with placement epochs live
+    if os.environ.get("BENCH_TIERED") == "1" and last_rec:
+        try:
+            last_rec["tiered"] = _tiered_rider()
+            print(json.dumps(last_rec), flush=True)
+        except Exception as e:  # noqa: BLE001 — keep headline record
+            log(f"tiered rider failed ({e}); keeping headline record")
+
 
 def _ivf_engine_sweep():
     """BENCH_IVF_SWEEP=1 rider: A/B the IVF-Flat probe-scan engines
@@ -1003,6 +1016,176 @@ def _bq_rider():
         "estimate_refine_best_s": round(est_stats["best_s"], 6),
         "estimate_refine_recall": round(est_recall, 4),
         "estimate_at_k_recall": round(recall(i_ek), 4),
+    }
+
+
+def _tiered_rider():
+    """BENCH_TIERED=1 rider: grafttier's billion-scale tiered storage
+    under the TPU-KNN DUAL-roofline accounting. Half the lists go
+    cold (host-resident where the backend supports memory kinds; the
+    honest device fallback elsewhere — ``host_resident`` says which),
+    and the record carries:
+
+    - the hot stream's achieved GB/s next to an HBM roofline
+      (``stream_read_sum`` over the hot plane) and the cold stream's
+      achieved GB/s next to a HOST-link roofline (a timed
+      host→device transfer of one cold-tier-sized buffer — the
+      ceiling the manual-DMA pipeline is judged against);
+    - ``bit_identical`` (tiered executor results vs the all-HBM
+      index — the correctness gate column);
+    - two LIVE placement epochs under a manual clock:
+      ``compiles_during_epochs`` (must stay 0 — re-placement only
+      permutes the fixed hot slots) and the per-epoch swap bytes
+      (deterministic at the pinned config: targeted traffic promotes
+      the same lists every run).
+
+    Env knobs: BENCH_TIER_N / BENCH_TIER_LISTS / BENCH_TIER_PROBES /
+    BENCH_TIER_SECONDS."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu import SearchExecutor
+    from raft_tpu.bench.prims import timeit_stats
+    from raft_tpu.core import tracing
+    from raft_tpu.neighbors import ivf_flat, tiered
+    from raft_tpu.ops.fused_topk import stream_read_sum
+    from raft_tpu.ops.ivf_scan import unique_lists
+    from raft_tpu.serving.harness import ManualClock
+    from raft_tpu.serving.placement import PlacementConfig, TierManager
+
+    n = int(os.environ.get("BENCH_TIER_N", 200_000))
+    n_lists = int(os.environ.get("BENCH_TIER_LISTS", 256))
+    n_probes = int(os.environ.get("BENCH_TIER_PROBES", 20))
+    budget = float(os.environ.get("BENCH_TIER_SECONDS", 8))
+
+    kd, kq = jax.random.split(jax.random.key(7))
+    x = jax.random.normal(kd, (n, D), jnp.float32)
+    queries = jax.random.normal(kq, (BATCH, D), jnp.float32)
+    log(f"tiered rider: building index ({n}x{D}, {n_lists} lists)")
+    index = ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(
+        n_lists=n_lists, kmeans_n_iters=10), x)
+    t = tiered.build_tiered(index, hot_fraction=0.5)
+    m = t.max_list_size
+    itemsize = 4
+    interp = jax.default_backend() != "tpu"
+
+    # --- dual rooflines. HBM: a pure streamed read of the hot plane.
+    # Host link: a timed host→device transfer of one cold-tier-sized
+    # buffer — the ceiling the cold manual-DMA stream is judged
+    # against (on CPU both pools are the same memory; the on-chip
+    # numbers are what the evidence debt item collects).
+    hot_flat = t.hot_data.reshape(t.n_hot * m, D)
+    st = timeit_stats(lambda: stream_read_sum(hot_flat,
+                                              interpret=interp),
+                      min(budget, 6.0))
+    hbm_roof_gbps = hot_flat.size * itemsize / st["best_s"] / 1e9
+    cold_host = np.zeros((t.n_cold * m, D), np.float32)
+    st = timeit_stats(
+        lambda: jax.block_until_ready(jax.device_put(cold_host)),
+        min(budget, 6.0))
+    host_roof_gbps = cold_host.nbytes / st["best_s"] / 1e9
+    log(f"tiered rooflines: HBM {hbm_roof_gbps:.1f} GB/s, host link "
+        f"{host_roof_gbps:.1f} GB/s")
+
+    # --- probed-union split for the per-tier byte models (host-side
+    # replay of the engines' own coarse selection — deterministic
+    # under the pinned seeds)
+    qf = queries.astype(jnp.float32)
+    ip = qf @ t.centers.T
+    score = -(t.center_norms[None, :] - 2.0 * ip)
+    probes = jax.lax.top_k(score, n_probes)[1].astype(jnp.int32)
+    uniq = np.asarray(unique_lists(probes, n_lists))
+    uniq = uniq[uniq < n_lists]
+    cold_map = np.asarray(t.cold_slot_map)
+    union_cold = int((cold_map[uniq] >= 0).sum())
+    union_hot = int(len(uniq) - union_cold)
+    # hot stream reads data+norms+ids from HBM; a cold list's data
+    # crosses the host link while its norm/id planes stay HBM
+    hot_model_bytes = (union_hot * m * (D * itemsize + 8)
+                       + union_cold * m * 8)
+    cold_model_bytes = union_cold * m * D * itemsize
+
+    # --- serving: tiered executor vs the all-HBM index
+    p = tiered.TieredSearchParams(n_probes=n_probes)
+    ex = SearchExecutor(probe_accounting=True)
+    ex.warmup(t, buckets=(ex.bucket_for(BATCH),), k=K, params=p)
+    stats = timeit_stats(
+        lambda: ex.search(t, queries, K, params=p), budget)
+    dt = stats["best_s"]
+    d_t, i_t = ex.search(t, queries, K, params=p)
+    pf = ivf_flat.IvfFlatSearchParams(n_probes=n_probes)
+    d_f, i_f = ivf_flat.search(None, pf, index, queries, K)
+    bit_identical = bool(
+        (np.asarray(d_t) == np.asarray(d_f)).all()
+        and (np.asarray(i_t) == np.asarray(i_f)).all())
+    hot_gbps = hot_model_bytes / dt / 1e9
+    cold_gbps = cold_model_bytes / dt / 1e9
+    log(f"tiered serving: {dt * 1e3:.2f} ms/iter, bit_identical="
+        f"{bit_identical}, hot {hot_gbps:.1f} GB/s "
+        f"({hot_gbps / hbm_roof_gbps:.3f} of HBM roofline), cold "
+        f"{cold_gbps:.1f} GB/s "
+        f"({cold_gbps / host_roof_gbps:.3f} of host roofline)")
+
+    # --- live placement epochs: targeted traffic at two cold lists,
+    # one warm epoch (the fixed-width swap programs specialize once),
+    # then two gated epochs — zero backend compiles, deterministic
+    # swap bytes
+    clock = ManualClock()
+    mgr = TierManager(t, ex, clock=clock, config=PlacementConfig(
+        epoch_every_s=1.0, max_swaps_per_epoch=4))
+    centers_np = np.asarray(t.centers)
+
+    def targeted(lid, seed):
+        rng = np.random.default_rng(seed)
+        return (np.tile(centers_np[lid], (BATCH, 1))
+                + 0.01 * rng.standard_normal((BATCH, D))
+                ).astype(np.float32)
+
+    ex.search(t, targeted(int(t.cold_lists[0]), 0), K, params=p)
+    mgr.epoch()                      # warm the swap programs
+    tracing.install_xla_compile_listener()
+    c0 = tracing.counters().get(tracing.XLA_COMPILE_COUNT, 0)
+    swap_bytes = []
+    for step in (1, 2):
+        for _ in range(2):
+            ex.search(t, targeted(int(t.cold_lists[0]), step), K,
+                      params=p)
+        b0 = tracing.get_counter("tier.swap_bytes")
+        mgr.epoch()
+        swap_bytes.append(
+            int(tracing.get_counter("tier.swap_bytes") - b0))
+        ex.search(t, queries, K, params=p)
+    compiles = int(tracing.counters().get(tracing.XLA_COMPILE_COUNT, 0)
+                   - c0)
+    d_t2, i_t2 = ex.search(t, queries, K, params=p)
+    post_identical = bool(
+        (np.asarray(d_t2) == np.asarray(d_f)).all()
+        and (np.asarray(i_t2) == np.asarray(i_f)).all())
+    log(f"tiered epochs: swap bytes {swap_bytes}, compiles during "
+        f"epochs {compiles}, post-epoch bit_identical={post_identical}")
+
+    return {
+        "n": n, "dim": D, "n_lists": n_lists, "n_probes": n_probes,
+        "batch": BATCH, "k": K, "max_list_size": m,
+        "hot_lists": t.n_hot, "cold_lists": t.n_cold,
+        "host_resident": int(t.host_resident),
+        "union_lists": int(len(uniq)),
+        "union_hot": union_hot, "union_cold": union_cold,
+        "hot_model_bytes": int(hot_model_bytes),
+        "cold_model_bytes": int(cold_model_bytes),
+        "best_s": round(dt, 6), "qps": round(BATCH / dt, 2),
+        "bit_identical": int(bit_identical and post_identical),
+        "hot_gbps": round(hot_gbps, 2),
+        "cold_gbps": round(cold_gbps, 2),
+        "hbm_roofline_gbps": round(hbm_roof_gbps, 2),
+        "host_roofline_gbps": round(host_roof_gbps, 2),
+        "vs_hbm_roofline": round(hot_gbps / hbm_roof_gbps, 4),
+        "vs_host_roofline": round(cold_gbps / host_roof_gbps, 4),
+        "epochs": 2,
+        "swap_bytes_per_epoch": swap_bytes,
+        "swap_bytes_total": int(sum(swap_bytes)),
+        "compiles_during_epochs": compiles,
     }
 
 

@@ -104,6 +104,13 @@ PINNED_ENV = {
     "BENCH_BQ_LISTS": "32",
     "BENCH_BQ_PROBES": "8",
     "BENCH_BQ_SECONDS": "2",
+    # grafttier (PR 14): tiered storage rider — half the lists cold,
+    # dual rooflines, two live placement epochs
+    "BENCH_TIERED": "1",
+    "BENCH_TIER_N": "20000",
+    "BENCH_TIER_LISTS": "32",
+    "BENCH_TIER_PROBES": "8",
+    "BENCH_TIER_SECONDS": "2",
 }
 
 # Tolerance bands, keyed by dotted path into the bench record.
@@ -170,6 +177,21 @@ DEFAULT_TOLERANCES = {
     # floors the integer count at 1)
     "serving.continuous.capture_attempts": {"min_ratio": 0.15},
     "serving.continuous.completed": {"min_ratio": 0.9},
+    # grafttier tiered storage (PR 14). Structural columns TIGHT:
+    # bit_identical is the correctness gate (tiered results must
+    # equal the all-HBM index, pre and post placement epochs);
+    # compiles_during_epochs pins the zero-recompile-across-
+    # re-placement contract; cold_lists and the per-epoch swap bytes
+    # are exact at the pinned config (pinned seeds → deterministic
+    # coarse selection → deterministic plans). GB/s columns keep the
+    # wide wall-clock bands.
+    "tiered.bit_identical": {"min_ratio": 1.0},
+    "tiered.compiles_during_epochs": {"max_increase": 0},
+    "tiered.cold_lists": {"min_ratio": 1.0, "max_increase": 0},
+    "tiered.swap_bytes_total": {"min_ratio": 1.0, "max_increase": 0},
+    "tiered.qps": {"min_ratio": 0.30},
+    "tiered.hot_gbps": {"min_ratio": 0.2},
+    "tiered.cold_gbps": {"min_ratio": 0.2},
 }
 
 # counters the test session's metrics snapshot must carry ABOVE these
@@ -199,6 +221,12 @@ SNAPSHOT_FLOORS = {
     # MemoryLedger.sample_dispatch() from the dispatch path zeroes
     # this and fails structurally
     "memory.samples": 0.0,
+    # grafttier (PR 14): placement swaps must actually move blocks —
+    # the tier-1 epoch suite promotes/demotes through apply_plan, so
+    # a refactor that disconnects the swap executor (or its byte
+    # accounting) zeroes the lifetime ledger and fails here
+    "tier.swaps": 0.0,
+    "tier.swap_bytes": 0.0,
 }
 
 

@@ -40,14 +40,16 @@ MODULES = [
     "raft_tpu.neighbors.refine",
     "raft_tpu.neighbors.ball_cover", "raft_tpu.neighbors.epsilon_neighborhood",
     "raft_tpu.neighbors.quantized", "raft_tpu.neighbors.filters",
-    "raft_tpu.neighbors.ivf_helpers",
+    "raft_tpu.neighbors.ivf_helpers", "raft_tpu.neighbors.tiered",
+    "raft_tpu.ops.tier_scan",
     "raft_tpu.spatial.knn",
     "raft_tpu.serving", "raft_tpu.serving.request",
     "raft_tpu.serving.batcher", "raft_tpu.serving.admission",
     "raft_tpu.serving.metrics", "raft_tpu.serving.exporter",
     "raft_tpu.serving.harness", "raft_tpu.serving.gauge",
     "raft_tpu.serving.flight", "raft_tpu.serving.continuous",
-    "raft_tpu.serving.federation", "raft_tpu.core.profiling",
+    "raft_tpu.serving.federation", "raft_tpu.serving.placement",
+    "raft_tpu.core.profiling",
     "raft_tpu.core.xplane", "raft_tpu.core.memwatch",
     "raft_tpu.comms", "raft_tpu.comms.bootstrap",
     "raft_tpu.distributed.ivf", "raft_tpu.distributed.knn",

@@ -163,6 +163,12 @@ class _Plan:
     # per-row probe-budget plane ((tile,) int32) right after the
     # packed queries — the ragged query-tile front of ops/ivf_scan
     ragged: bool = False
+    # grafttier: lower with each operand's OWN sharding even off the
+    # mesh — the tiered cold plane is committed to host memory, and
+    # an aval that dropped its memory kind would compile an
+    # executable that hauls the whole cold tier back into HBM per
+    # call (exactly the copy the tier exists to avoid)
+    keep_sharding: bool = False
 
 
 class _Entry:
@@ -815,6 +821,34 @@ class SearchExecutor:
 
     def _run(self, index, queries, k, params, fw, kw, row0: int = 0,
              trace_ids: Tuple[int, ...] = ()):
+        # grafttier placement race: an epoch swap DONATES the old hot
+        # plane / slot maps, and a dispatch that captured the
+        # pre-swap generation but enqueued after the swap finds its
+        # operands deleted (jax spells this RuntimeError or
+        # INVALID_ARGUMENT ValueError depending on the path). The
+        # swap serializes its enqueues with dispatch under the
+        # executor lock, so each failure means a COMPLETE newer
+        # generation is already in the container — rebuild and retry
+        # against it. Bounded: every retry needs a fresh swap to have
+        # landed in the capture→enqueue window, so under any sane
+        # epoch cadence one retry is the norm; the bound only guards
+        # against a pathological swap storm (any other error
+        # re-raises immediately).
+        for _ in range(4):
+            try:
+                return self._run_once(index, queries, k, params, fw,
+                                      kw, row0=row0,
+                                      trace_ids=trace_ids)
+            except (RuntimeError, ValueError) as e:
+                if "deleted" not in str(e).lower():
+                    raise
+                tracing.inc_counter(
+                    "serving.execute.placement_retries")
+        return self._run_once(index, queries, k, params, fw, kw,
+                              row0=row0, trace_ids=trace_ids)
+
+    def _run_once(self, index, queries, k, params, fw, kw,
+                  row0: int = 0, trace_ids: Tuple[int, ...] = ()):
         q = int(np.shape(queries)[0])
         bucket = self.bucket_for(q)
         plan = self._plan(index, params, k, bucket, fw, kw)
@@ -1146,7 +1180,8 @@ class SearchExecutor:
         fn = plan.fn if module is None else _named_fn(plan.fn, module)
         jitted = jax.jit(fn, static_argnames=tuple(plan.static),
                          donate_argnames=donate)
-        sds = _sds_sharded if plan.sharded else _sds
+        sds = _sds_sharded if (plan.sharded or plan.keep_sharding) \
+            else _sds
         args = [sds(a) for a in plan.pre]
         args.append(jax.ShapeDtypeStruct((bucket, plan.qdim), plan.qdtype,
                                          sharding=plan.qsharding))
@@ -1334,9 +1369,12 @@ class SearchExecutor:
         from raft_tpu.neighbors.ivf_bq import IvfBqIndex
         from raft_tpu.neighbors.ivf_flat import IvfFlatIndex
         from raft_tpu.neighbors.ivf_pq import IvfPqIndex
+        from raft_tpu.neighbors.tiered import TieredIvf
 
         if isinstance(index, BruteForceIndex):
             return self._plan_brute_force(index, k, bucket, fw, kw)
+        if isinstance(index, TieredIvf):
+            return self._plan_tiered(index, params, k, bucket, fw, kw)
         if isinstance(index, IvfFlatIndex):
             return self._plan_ivf_flat(index, params, k, bucket, fw, kw)
         if isinstance(index, IvfPqIndex):
@@ -1570,6 +1608,43 @@ class SearchExecutor:
         return _Plan(key=key, fn=m._search_impl_fn, static=static,
                      post=arrays, use_filter=True, qdim=index.dim,
                      has_state=engine != "pallas", probe=probe)
+
+    def _plan_tiered(self, index, params, k, bucket, fw, kw) -> _Plan:
+        from raft_tpu.neighbors import tiered as m
+        from raft_tpu.ops.tier_scan import resolve_tier_engine
+
+        params = params or m.TieredSearchParams()
+        expect(index.max_list_size > 0, "tiered index is empty")
+        n_probes = min(params.n_probes, index.n_lists)
+        # ONE consistent placement generation for this dispatch —
+        # tier_arrays() snapshots all four placement-affected arrays
+        # under the container's swap lock, so a concurrent epoch can
+        # never hand a plan a new hot plane against an old slot map
+        hot_data, cold_data, hot_map, cold_map = index.tier_arrays()
+        engine = resolve_tier_engine(params.scan_engine,
+                                     hot_data=hot_data,
+                                     filter_words=fw, k=k)
+        static = {"n_probes": n_probes, "k": k, "metric": index.metric,
+                  "coarse_algo": params.coarse_algo,
+                  "scan_engine": engine}
+        arrays = (index.centers, index.center_norms, hot_data,
+                  cold_data, hot_map, cold_map, index.data_norms,
+                  index.indices)
+        # the cache key is SHAPES + statics, never array identity: a
+        # placement epoch replaces hot_data/cold_data/slot maps with
+        # same-shape arrays, so re-placed traffic keeps hitting this
+        # exact executable — zero backend compiles across epochs (the
+        # grafttier serving contract, pinned in tests)
+        key = ("tiered_ivf", bucket, _sig(*arrays),
+               tuple(sorted((n, str(v)) for n, v in static.items())),
+               _filter_spec(fw))
+        key, probe = self._probe_plumbing(index, "tiered_ivf", key)
+        # keep_sharding: the cold plane's host memory kind must
+        # survive into the lowered avals (see _Plan.keep_sharding)
+        return _Plan(key=key, fn=m._tiered_search_fn, static=static,
+                     post=arrays, use_filter=True, qdim=index.dim,
+                     has_state=engine != "pallas", probe=probe,
+                     keep_sharding=True)
 
     def _plan_ivf_pq(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import ivf_pq as m

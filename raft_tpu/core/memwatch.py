@@ -92,6 +92,7 @@ import weakref
 from typing import Any, Dict, Optional
 
 import jax
+import numpy as np
 
 from raft_tpu.core import tracing
 from raft_tpu.core.validation import expect
@@ -175,40 +176,87 @@ def per_device_bytes(a, acc: Optional[Dict[int, int]] = None
     return acc
 
 
+# memory kinds that mean "this array's bytes live in HOST memory, not
+# HBM" — the grafttier cold plane's placement (an array committed via
+# jax.device_put(..., memory_kind="pinned_host")). Plain numpy arrays
+# are host-side by construction.
+_HOST_MEMORY_KINDS = ("pinned_host", "unpinned_host")
+
+
+def memory_tier(a) -> str:
+    """Which memory an array's bytes occupy: ``"host"`` for numpy
+    arrays and device arrays committed OFF their device's default
+    memory into a host kind (the grafttier cold tier), ``"device"``
+    otherwise. Pure metadata — reads the array's own sharding, never
+    the backend.
+
+    A host memory KIND alone does not mean off-device: the CPU
+    backend's default memory is itself ``unpinned_host`` (host and
+    device are one pool there), so classification compares against
+    the device's DEFAULT memory — only an array deliberately moved
+    off it counts as host-tier."""
+    if isinstance(a, np.ndarray):
+        return "host"
+    sharding = getattr(a, "sharding", None)
+    kind = getattr(sharding, "memory_kind", None)
+    if kind not in _HOST_MEMORY_KINDS:
+        return "device"
+    for d in getattr(sharding, "device_set", None) or ():
+        try:
+            if d.default_memory().kind == kind:
+                return "device"
+        except Exception:  # noqa: BLE001 — no memories API: kind decides
+            break
+    return "host"
+
+
 def index_memory_model(index) -> dict:
     """The resident-bytes model of one index: per-component (array
     field) global and per-shard bytes, plus the totals. Works for
     every frozen-dataclass index family — single-chip and mesh-
     sharded (``shard_bytes`` reads each array's own sharding) — and
     skips optional fields that are ``None`` (a codes-only BQ index
-    has no rerank plane, and models exactly that much smaller)."""
+    has no rerank plane, and models exactly that much smaller).
+
+    Components whose bytes live in HOST memory (:func:`memory_tier` —
+    the grafttier cold plane, numpy mirrors) fold into
+    ``host_resident_bytes`` INSTEAD of the device totals: the device
+    forecast, headroom arithmetic and divergence gauge must never
+    count bytes that were deliberately moved off-HBM, while the host
+    tier still shows up as its own accountable number."""
     expect(dataclasses.is_dataclass(index),
            f"index_memory_model needs an index dataclass, got "
            f"{type(index)!r}")
     components: dict = {}
     total = 0
     shard_total = 0
+    host_total = 0
     per_device: Dict[int, int] = {}
     for f in dataclasses.fields(index):
         v = getattr(index, f.name, None)
         if v is None or not _is_array(v):
             continue
         b = array_bytes(v)
-        sb = shard_bytes(v)
+        tier = memory_tier(v)
         components[f.name] = {
             "bytes": b,
-            "shard_bytes": sb,
+            "shard_bytes": shard_bytes(v),
             "shape": [int(s) for s in v.shape],
             "dtype": str(v.dtype),
+            "tier": tier,
         }
+        if tier == "host":
+            host_total += b
+            continue
         total += b
-        shard_total += sb
+        shard_total += components[f.name]["shard_bytes"]
         per_device_bytes(v, per_device)
     return {
         "family": type(index).__name__,
         "components": components,
         "resident_bytes": total,
         "shard_resident_bytes": shard_total,
+        "host_resident_bytes": host_total,
         "per_device_bytes": per_device,
     }
 
@@ -230,6 +278,23 @@ def packed_layout_bytes(n_lists: int, max_list_size: int,
     if indices:
         b += slots * 4
     return b
+
+
+def dealt_shard_bytes(arrays, r: int) -> int:
+    """Per-shard bytes of dealing these build-device tensors across
+    ``r`` shards — the slot model the DISTRIBUTED build staging
+    admits against (each mesh device receives ``ceil(rows / r)``
+    list blocks of every dealt tensor; headroom is per-device, so
+    per-shard bytes is the unit the gate must judge in). Pure shape
+    arithmetic, computed BEFORE ``place_dealt`` moves anything."""
+    total = 0
+    for a in arrays:
+        if a is None or not _is_array(a):
+            continue
+        rows = -(-int(a.shape[0]) // max(int(r), 1))
+        rest = int(math.prod(tuple(a.shape)[1:]))
+        total += rows * rest * int(a.dtype.itemsize)
+    return total
 
 
 def device_memory_stats(devices=None) -> dict:
@@ -520,11 +585,14 @@ class MemoryLedger:
         with self._lock:
             self._wm_forecast = max(self._wm_forecast, fc["peak_bytes"])
             wm_in_use, wm_forecast = self._wm_in_use, self._wm_forecast
+        host_total = sum(m.get("host_resident_bytes", 0)
+                         for m in models.values())
         return {
             "supported": live["supported"],
             "devices": live["devices"],
             "indexes": models,
             "resident_total_bytes": fc["resident_bytes"],
+            "host_resident_total_bytes": float(host_total),
             "forecast": fc,
             "headroom_bytes": headroom,
             "divergence_bytes": divergence,
@@ -544,6 +612,8 @@ class MemoryLedger:
         vals: Dict[str, float] = {
             "memory.live.supported": 1.0 if snap["supported"] else 0.0,
             "memory.resident.total_bytes": snap["resident_total_bytes"],
+            "memory.host.resident_bytes":
+                snap["host_resident_total_bytes"],
             "memory.reserved.donated_state_bytes":
                 snap["forecast"]["donated_state_bytes"],
             "memory.reserved.probe_planes_bytes":
@@ -567,6 +637,9 @@ class MemoryLedger:
                 model["resident_bytes"])
             vals[base + "shard_bytes"] = float(
                 model["shard_resident_bytes"])
+            if model.get("host_resident_bytes"):
+                vals[base + "host_bytes"] = float(
+                    model["host_resident_bytes"])
         for o, d in snap["devices"].items():
             base = f"memory.device.{o}."
             vals[base + "in_use_bytes"] = d["in_use_bytes"]
@@ -602,11 +675,126 @@ class MemoryLedger:
             "resident": {label: int(m["resident_bytes"])
                          for label, m in snap["indexes"].items()},
             "resident_total_bytes": int(snap["resident_total_bytes"]),
+            "host_resident_total_bytes":
+                int(snap["host_resident_total_bytes"]),
             "forecast_peak_bytes": snap["forecast"]["peak_bytes"],
             "headroom_bytes": snap["headroom_bytes"],
             "divergence_bytes": snap["divergence_bytes"],
             "devices": snap["devices"],
         }
+
+
+# ---------------------------------------------------------------------------
+# /memory_profile diffing — per-buffer divergence attribution
+# ---------------------------------------------------------------------------
+
+
+def parse_memory_profile(data: bytes) -> Dict[str, int]:
+    """Aggregate one ``jax.profiler.device_memory_profile`` capture
+    (pprof wire format, gzip or raw) into per-buffer-group byte
+    totals: ``{label_key: bytes}`` where ``label_key`` renders each
+    sample's pprof labels (``kind=buffer,shape=f32[...],...``) — the
+    grouping the divergence gauge can point AT, instead of at the
+    whole process. Pure stdlib: gzip + the protobuf wire reader
+    :func:`raft_tpu.core.xplane.fields` (varints and length-delimited
+    payloads only; unknown fields skipped per proto semantics).
+
+    The summed value is the sample type whose unit string is
+    ``bytes`` (pprof heap profiles carry ``(objects, bytes)`` pairs);
+    captures exposing no byte-typed value fall back to the LAST value
+    column, pprof's space convention."""
+    import gzip
+
+    from raft_tpu.core.xplane import _read_varint, fields
+
+    if data[:2] == b"\x1f\x8b":
+        data = gzip.decompress(data)
+
+    strings: list = []
+    sample_units: list = []
+    samples: list = []
+    for fnum, wtype, val in fields(data):
+        if fnum == 6 and wtype == 2:          # string_table
+            strings.append(val.decode("utf-8", "replace"))
+        elif fnum == 1 and wtype == 2:        # sample_type: ValueType
+            unit_idx = 0
+            for f2, w2, v2 in fields(val):
+                if f2 == 2 and w2 == 0:
+                    unit_idx = v2
+            sample_units.append(unit_idx)
+        elif fnum == 2 and wtype == 2:        # sample
+            samples.append(val)
+
+    def string_at(i: int) -> str:
+        return strings[i] if 0 <= i < len(strings) else ""
+
+    value_idx = len(sample_units) - 1
+    for i, unit in enumerate(sample_units):
+        if string_at(unit) == "bytes":
+            value_idx = i
+            break
+
+    out: Dict[str, int] = {}
+    for raw in samples:
+        values: list = []
+        labels: list = []
+        for fnum, wtype, val in fields(raw):
+            if fnum == 2:                     # value: repeated int64
+                if wtype == 0:
+                    values.append(val)
+                elif wtype == 2:              # packed
+                    pos = 0
+                    while pos < len(val):
+                        v, pos = _read_varint(val, pos)
+                        values.append(v)
+            elif fnum == 3 and wtype == 2:    # label
+                key = s = num = 0
+                has_num = False
+                for f2, w2, v2 in fields(val):
+                    if f2 == 1 and w2 == 0:
+                        key = v2
+                    elif f2 == 2 and w2 == 0:
+                        s = v2
+                    elif f2 == 3 and w2 == 0:
+                        num = v2
+                        has_num = True
+                kname = string_at(key)
+                if not kname:
+                    continue
+                value = string_at(s) if s else (
+                    str(num) if has_num else "")
+                labels.append(f"{kname}={value}")
+        if not values:
+            continue
+        v = values[value_idx] if value_idx < len(values) else values[-1]
+        label_key = ",".join(sorted(labels)) or "(unlabeled)"
+        out[label_key] = out.get(label_key, 0) + int(v)
+    return out
+
+
+def diff_memory_profiles(before: Dict[str, int],
+                         after: Dict[str, int]) -> dict:
+    """Per-buffer-group divergence between two parsed captures:
+    ``deltas`` (largest |delta| first; ties by label) name which
+    buffer groups grew or shrank across the window the two
+    sequence-numbered captures bracket — the attribution that turns
+    the process-wide divergence gauge into an answer."""
+    keys = sorted(set(before) | set(after))
+    deltas = []
+    for key in keys:
+        b = int(before.get(key, 0))
+        a = int(after.get(key, 0))
+        if a != b:
+            deltas.append({"label": key, "from_bytes": b,
+                           "to_bytes": a, "delta_bytes": a - b})
+    deltas.sort(key=lambda d: (-abs(d["delta_bytes"]), d["label"]))
+    return {
+        "deltas": deltas,
+        "total_before_bytes": int(sum(before.values())),
+        "total_after_bytes": int(sum(after.values())),
+        "total_delta_bytes": int(sum(after.values())
+                                 - sum(before.values())),
+    }
 
 
 # ---------------------------------------------------------------------------

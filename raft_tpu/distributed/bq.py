@@ -62,6 +62,7 @@ from raft_tpu.neighbors.ivf_bq import (
     score_probe,
 )
 from raft_tpu.distributed.ivf import (
+    admit_deal,
     collective_payload_model,
     deal_order,
     merge_results_sharded,
@@ -193,6 +194,12 @@ def build_bq(
         sizes = np.asarray(jax.device_get(index.list_sizes))
         perm = deal_order(sizes, r)
         rel = _shard_rel_err(index, perm, r)
+        # graftledger gate for the mesh deal (opt-in): per-shard slot
+        # model of every dealt plane, incl. the optional rerank plane
+        admit_deal(
+            (index.centers, index.codes, index.rnorm, index.cfac,
+             index.errw, index.indices, index.list_sizes, index.data,
+             index.data_norms), r, "distributed.ivf_bq.build.deal")
 
         def place(a):
             # streamed per-shard deal — no fully-permuted build-device copy

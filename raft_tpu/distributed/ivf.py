@@ -63,7 +63,7 @@ from raft_tpu.comms.comms import (
     resolve_wire_dtype,
     shard_map,
 )
-from raft_tpu.core import interruptible, tracing
+from raft_tpu.core import interruptible, memwatch, tracing
 from raft_tpu.core.resources import Resources, ensure_resources
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType, is_min_close
@@ -126,6 +126,21 @@ def deal_order(sizes: np.ndarray, r: int) -> np.ndarray:
 
 
 _gather_rows = jax.jit(lambda a, rows: jnp.take(a, rows, axis=0))
+
+
+def admit_deal(arrays, r: int, what: str) -> None:
+    """graftledger gate for the mesh deal (opt-in, no-op unless a
+    gate is installed): the single-chip build admitted the BUILD
+    device's packed layout, but the deal is a second allocation event
+    — every SHARD device receives its ``1/r`` slice of each dealt
+    tensor. Admit that per-shard slot model
+    (:func:`raft_tpu.core.memwatch.dealt_shard_bytes` — headroom is
+    per-device, so per-shard bytes is the unit) host-side BEFORE any
+    block moves, so a mesh that cannot hold the sharded index fails
+    as a typed ``CapacityExceeded`` instead of an OOM mid-deal.
+    Accepts arrays or ``ShapeDtypeStruct``s (the streaming build
+    admits its planned buffers before allocating them)."""
+    memwatch.admit(memwatch.dealt_shard_bytes(arrays, r), what)
 
 
 def place_dealt(a, perm: np.ndarray, comms: Comms):
@@ -447,6 +462,9 @@ def build(
         # per shard block per the shared layout policy
         sizes = np.asarray(jax.device_get(index.list_sizes))
         perm = deal_order(sizes, r)
+        admit_deal((index.centers, index.data, index.data_norms,
+                    index.indices, index.list_sizes), r,
+                   "distributed.ivf_flat.build.deal")
 
         def place(a):
             return place_dealt(a, perm, comms)
@@ -730,6 +748,14 @@ def build_streaming(
         dealt_pos[deal] = np.arange(n_lists, dtype=np.int32)
 
         shard = comms.sharding(comms.axis)
+        # gate the per-shard staging BEFORE the sharded buffers (and
+        # the norms plane derived later) allocate — planned shapes,
+        # nothing materialized yet
+        admit_deal(
+            (jax.ShapeDtypeStruct((n_lists, max_size, d), jnp.float32),
+             jax.ShapeDtypeStruct((n_lists, max_size), jnp.int32),
+             jax.ShapeDtypeStruct((n_lists, max_size), jnp.float32)),
+            r, "distributed.ivf_flat.build_streaming.deal")
         data = jax.device_put(
             jnp.zeros((n_lists, max_size, d), jnp.float32), shard)
         indices = jax.device_put(
@@ -852,12 +878,17 @@ def build_pq(
 
         sizes = np.asarray(jax.device_get(index.list_sizes))
         perm = deal_order(sizes, r)
+        per_cluster = params.codebook_kind == CodebookKind.PER_CLUSTER
+        admit_deal(
+            (index.centers, index.codes, index.indices,
+             index.list_sizes)
+            + ((index.codebooks,) if per_cluster else ()),
+            r, "distributed.ivf_pq.build.deal")
 
         def place(a):
             return place_dealt(a, perm, comms)
 
         rep = comms.replicated()
-        per_cluster = params.codebook_kind == CodebookKind.PER_CLUSTER
         return DistributedIvfPq(
             comms=comms,
             centers=place(index.centers),

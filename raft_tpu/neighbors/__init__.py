@@ -12,6 +12,7 @@ from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors import ivf_pq
 from raft_tpu.neighbors import nn_descent
 from raft_tpu.neighbors import quantized
+from raft_tpu.neighbors import tiered
 from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
 from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors
 # pylibraft parity: ``neighbors.refine`` is the function (the submodule
@@ -32,6 +33,7 @@ __all__ = [
     "nn_descent",
     "quantized",
     "refine",
+    "tiered",
     "IndexParams",
     "SearchParams",
 ]

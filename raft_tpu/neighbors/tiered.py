@@ -1,0 +1,559 @@
+"""grafttier — billion-scale tiered IVF storage (PR 14).
+
+Every index family so far is fully HBM-resident, which caps corpus
+size at device memory — far below the SIFT-1B north star ("millions
+of users, corpus ≫ HBM"). :class:`TieredIvf` splits an
+:class:`~raft_tpu.neighbors.ivf_flat.IvfFlatIndex`'s lists into an
+HBM-resident **hot tier** (fixed slot capacity, sized against
+graftledger's live headroom via :func:`resolve_hot_slots`) and a
+host-memory **cold tier** (committed via :func:`host_put` — honest
+fallback to device placement on backends without memory kinds, i.e.
+the CPU tier-1 environment), and serves the probed-list union in one
+pass through :mod:`raft_tpu.ops.tier_scan`: hot blocks ride the
+existing scalar-prefetched BlockSpec pipeline, cold blocks stream
+through a double-buffered manual-DMA pipeline from the host operand.
+
+The split moves ONLY the heavy raw-vector plane: centers, norms, ids,
+slot maps and list sizes (~2% of the bytes at serving dims) stay
+resident, so coarse selection, membership masking, filters and
+graftgauge's probe accounting are untouched — and search results are
+**bit-identical** to the all-HBM index per engine.
+
+**Shape stability is the serving contract.** The hot tier has a FIXED
+slot count decided once at construction; a placement epoch
+(:mod:`raft_tpu.serving.placement`) only PERMUTES which lists occupy
+those slots, via :func:`apply_plan`'s fixed-width donated block swaps
+(pad entries carry out-of-range slots — gathers clamp, scatters
+``mode="drop"`` — so every epoch runs the same compiled programs).
+Shapes never change ⇒ the ``SearchExecutor``'s AOT cache keys never
+change ⇒ steady-state serving stays at zero backend compiles across
+re-placement epochs (pinned in ``tests/test_tiered.py``). The
+container is deliberately MUTABLE (unlike the frozen index
+dataclasses): the arrays are re-placed in place across epochs while
+``id(index)`` — the coalesce key's and probe plane's identity — stays
+stable; the container itself never flows through jit, only its
+arrays do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import tracing
+from raft_tpu.core.resources import Resources, ensure_resources
+from raft_tpu.core.validation import expect
+from raft_tpu.distance.types import DistanceType
+from raft_tpu.neighbors._batching import coarse_select, tile_queries
+from raft_tpu.neighbors.ann_types import SearchParams
+from raft_tpu.neighbors.filters import resolve_filter_words
+from raft_tpu.neighbors.ivf_flat import IvfFlatIndex
+
+
+@dataclasses.dataclass(frozen=True)
+class TieredSearchParams(SearchParams):
+    """Search params of the tiered index. ``scan_engine`` selects the
+    tiered engine pair (:mod:`raft_tpu.ops.tier_scan`): ``"auto"`` is
+    the dual-source Pallas kernel on TPU and the tiered XLA scan
+    elsewhere; ``"pallas"`` degrades per ``resolve_tier_engine``."""
+
+    n_probes: int = 20
+    coarse_algo: str = "exact"   # "exact" | "approx"
+    scan_engine: str = "auto"    # "auto" | "pallas" | "xla"
+
+
+@dataclasses.dataclass
+class TieredIvf:
+    """Hot/cold tiered IVF container (MUTABLE — see module docstring;
+    placement epochs re-place the arrays in place, shapes fixed)."""
+
+    centers: jax.Array         # (n_lists, d) f32 — HBM
+    center_norms: jax.Array    # (n_lists,) f32
+    data_norms: jax.Array      # (n_lists, max_list_size) f32, full plane
+    indices: jax.Array         # (n_lists, max_list_size) int32, full plane
+    list_sizes: jax.Array      # (n_lists,) int32
+    hot_data: jax.Array        # (n_hot, max_list_size, d) f32 — HBM
+    cold_data: jax.Array       # (n_cold, max_list_size, d) f32 — host
+    hot_slot_map: jax.Array    # (n_lists,) int32, hot slot or -1
+    cold_slot_map: jax.Array   # (n_lists,) int32, cold slot or -1
+    hot_lists: np.ndarray      # (n_hot,) list id occupying each hot slot
+    cold_lists: np.ndarray     # (n_cold,) list id occupying each cold slot
+    metric: DistanceType
+    host_resident: bool        # did the cold tier land in host memory?
+    # serializes placement writes against serving reads: a search
+    # must capture the four placement-affected arrays as ONE
+    # consistent generation (all pre-swap or all post-swap, never
+    # mixed — a new hot plane against an old slot map would serve a
+    # list from the wrong slot). Not an array field, so the memwatch
+    # model walk skips it.
+    _swap_lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False)
+
+    def tier_arrays(self) -> tuple:
+        """Atomic snapshot of the placement generation:
+        ``(hot_data, cold_data, hot_slot_map, cold_slot_map)`` read
+        under the swap lock — THE way the serving path must capture
+        the tier arrays (:func:`apply_plan` replaces all four under
+        the same lock)."""
+        with self._swap_lock:
+            return (self.hot_data, self.cold_data,
+                    self.hot_slot_map, self.cold_slot_map)
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def max_list_size(self) -> int:
+        return self.hot_data.shape[1]
+
+    @property
+    def n_hot(self) -> int:
+        return self.hot_data.shape[0]
+
+    @property
+    def n_cold(self) -> int:
+        return self.cold_data.shape[0]
+
+    @property
+    def block_bytes(self) -> int:
+        """Bytes of one list block — the unit every placement swap
+        moves twice (one promotion + one demotion)."""
+        return (self.max_list_size * self.dim
+                * self.hot_data.dtype.itemsize)
+
+    @property
+    def hot_bytes(self) -> int:
+        return self.n_hot * self.block_bytes
+
+    @property
+    def cold_bytes(self) -> int:
+        return self.n_cold * self.block_bytes
+
+    def layout(self) -> dict:
+        """The host-side placement truth (the ``/tier.json`` body's
+        core): which lists are hot, which cold, and the byte split.
+        Read under the swap lock — a concurrent epoch must never show
+        a scrape new hot mirrors against old cold mirrors (a list in
+        both tiers, or neither)."""
+        with self._swap_lock:
+            return {
+                "n_lists": self.n_lists,
+                "n_hot": self.n_hot,
+                "n_cold": self.n_cold,
+                "hot_lists": [int(x) for x in self.hot_lists],
+                "cold_lists": [int(x) for x in self.cold_lists],
+                "hot_bytes": self.hot_bytes,
+                "cold_bytes": self.cold_bytes,
+                "block_bytes": self.block_bytes,
+                "host_resident": self.host_resident,
+            }
+
+
+def host_put(x) -> Tuple[jax.Array, bool]:
+    """Commit ``x`` to host memory (``pinned_host``) when the backend
+    supports memory kinds; returns ``(array, host_resident)``. The
+    fallback is HONEST: on backends without a host memory space (the
+    CPU tier-1 environment, where host and device memory are the same
+    pool anyway) the array stays on the default device and the flag
+    says so — nothing pretends bytes left HBM that didn't."""
+    x = jnp.asarray(x)
+    dev = x.devices().pop() if hasattr(x, "devices") \
+        else jax.devices()[0]
+    try:
+        kinds = tuple(m.kind for m in dev.addressable_memories())
+    except Exception:  # noqa: BLE001 — no memories API at all
+        kinds = ()
+    if "pinned_host" not in kinds:
+        # honest fallback, taken ONLY when the backend exposes no
+        # pinned-host memory space (the CPU tier-1 environment, whose
+        # single memory is already host RAM). COMMITTED placement
+        # (explicit sharding): the cold plane must present the same
+        # committed-ness from its first epoch that the
+        # out_shardings-pinned swap output carries ever after — an
+        # uncommitted first generation would re-specialize the swap
+        # program once, breaking the warm-one-epoch zero-recompile
+        # discipline.
+        return jax.device_put(
+            x, jax.sharding.SingleDeviceSharding(dev)), False
+    # the backend DOES support pinned host memory: a failure here is
+    # a real allocation problem (host RAM pressure, allocator error)
+    # and must stay loud — swallowing it would silently park the
+    # whole cold tier in the HBM it exists to vacate
+    sharding = jax.sharding.SingleDeviceSharding(
+        dev, memory_kind="pinned_host")
+    return jax.device_put(x, sharding), True
+
+
+def resolve_hot_slots(index: IvfFlatIndex, *, hot_slots=None,
+                      hot_fraction: float = 0.5, ledger=None,
+                      safety_fraction: float = 0.1) -> int:
+    """Decide the hot tier's FIXED slot capacity. Precedence:
+
+    1. an explicit ``hot_slots``;
+    2. a graftledger :class:`~raft_tpu.core.memwatch.MemoryLedger`
+       with known headroom: the largest slot count whose hot-tier
+       bytes fit ``headroom × (1 − safety_fraction)`` (the byte half
+       of the placement signal — live truth beats any fraction);
+    3. ``hot_fraction`` of the lists (the unknown-headroom default —
+       CPU tier-1, or no ledger attached).
+
+    Always clamped to [1, n_lists − 1]: an all-hot or all-cold split
+    is not a tiered index."""
+    n_lists = index.n_lists
+    block = (index.max_list_size * index.dim
+             * index.data.dtype.itemsize)
+    if hot_slots is None and ledger is not None:
+        headroom = ledger.headroom_bytes()
+        if headroom is not None:
+            usable = max(float(headroom) * (1.0 - safety_fraction), 0.0)
+            hot_slots = int(usable // max(block, 1))
+    if hot_slots is None:
+        hot_slots = int(n_lists * hot_fraction)
+    return max(1, min(int(hot_slots), n_lists - 1))
+
+
+def _slot_maps(hot_lists: np.ndarray, cold_lists: np.ndarray,
+               n_lists: int):
+    """The (hot_map, cold_map) numpy planes for one assignment: each
+    list's slot in its tier, −1 in the other — ONE implementation
+    shared by construction and the swap executor, so the two can
+    never disagree about the map convention."""
+    hot_map = np.full((n_lists,), -1, np.int32)
+    cold_map = np.full((n_lists,), -1, np.int32)
+    hot_map[hot_lists] = np.arange(len(hot_lists), dtype=np.int32)
+    cold_map[cold_lists] = np.arange(len(cold_lists), dtype=np.int32)
+    return hot_map, cold_map
+
+
+def build_tiered(index: IvfFlatIndex, *, hot_slots=None,
+                 hot_fraction: float = 0.5, ledger=None,
+                 safety_fraction: float = 0.1,
+                 probe_counts=None) -> TieredIvf:
+    """Split a built :class:`IvfFlatIndex` into the tiered layout.
+
+    ``probe_counts`` (optional ``(n_lists,)`` counts — graftgauge's
+    claimed probe-frequency plane, or any traffic prior) decides the
+    INITIAL placement: the hottest ``hot_slots`` lists by count (ties
+    to the smaller list id — deterministic) go hot, the rest cold.
+    Without counts, lists 0..H−1 go hot — the first placement epoch
+    corrects it from live traffic. ``ledger`` sizes the hot tier from
+    live headroom (see :func:`resolve_hot_slots`).
+
+    The tiered path is f32-only (the cold DMA scratch and hot blocks
+    must agree on layout); int8/bf16 tiering is a follow-on."""
+    expect(index.max_list_size > 0, "index is empty — extend() it first")
+    expect(index.data.dtype == jnp.float32,
+           "tiered storage supports f32 list data only")
+    n_lists = index.n_lists
+    h = resolve_hot_slots(index, hot_slots=hot_slots,
+                          hot_fraction=hot_fraction, ledger=ledger,
+                          safety_fraction=safety_fraction)
+    if probe_counts is None:
+        counts = np.zeros((n_lists,), np.int64)
+    else:
+        counts = np.asarray(probe_counts, np.int64)
+        expect(counts.shape == (n_lists,),
+               "probe_counts must be one count per list")
+    # hottest first, ties to the smaller lid (argsort is stable on
+    # the already-ordered lid axis)
+    order = np.argsort(-counts, kind="stable").astype(np.int32)
+    hot_lists = np.sort(order[:h])
+    cold_lists = np.sort(order[h:])
+
+    hot_map, cold_map = _slot_maps(hot_lists, cold_lists, n_lists)
+
+    # the placement-affected arrays are COMMITTED (explicit device)
+    # from construction: the epoch swap's jit outputs are committed,
+    # and a committed-ness flip between the first and second epoch
+    # would re-specialize the swap programs once — committing here
+    # makes epoch 0 already run the steady-state executables
+    dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    hot_data = jax.device_put(
+        _gather_blocks(index.data, jnp.asarray(hot_lists)), dev)
+    cold_dev = _gather_blocks(index.data, jnp.asarray(cold_lists))
+    cold_data, host_resident = host_put(cold_dev)
+    return TieredIvf(
+        centers=index.centers,
+        center_norms=index.center_norms,
+        data_norms=index.data_norms,
+        indices=index.indices,
+        list_sizes=index.list_sizes,
+        hot_data=hot_data,
+        cold_data=cold_data,
+        hot_slot_map=jax.device_put(jnp.asarray(hot_map), dev),
+        cold_slot_map=jax.device_put(jnp.asarray(cold_map), dev),
+        hot_lists=hot_lists,
+        cold_lists=cold_lists,
+        metric=index.metric,
+        host_resident=host_resident,
+    )
+
+
+_gather_blocks = jax.jit(lambda a, rows: jnp.take(a, rows, axis=0))
+
+
+# ---------------------------------------------------------------------------
+# placement execution — fixed-width donated block swaps
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _swap_hot_fn(hot_data, hot_slots, promoted):
+    """Hot half of one epoch's swap: scatter the promoted blocks
+    into the freed hot slots, DONATED — the hot tier is the scarce
+    HBM pool and must update in place (the ``place_dealt``
+    discipline: stream blocks, never materialize a permuted copy).
+    ``hot_slots`` is a FIXED-width int32 vector: live pairs carry
+    real slots, pad entries carry out-of-range slots the scatter
+    ``mode="drop"``s — every epoch runs this one compiled program
+    regardless of how many swaps it planned (zero-recompile)."""
+    return hot_data.at[hot_slots].set(promoted, mode="drop")
+
+
+@functools.lru_cache(maxsize=8)
+def _cold_scatter_for(sharding):
+    """Cold half of the swap, specialized per cold-tier sharding:
+    ``out_shardings`` pins the output to the cold plane's OWN
+    placement, so a host-committed (``pinned_host``) tier STAYS
+    host-committed across epochs — without it the first epoch's
+    output would land in default device memory, both hauling the
+    cold tier back into HBM and invalidating the executor's AOT
+    executable that was lowered with the host-memory aval
+    (``_Plan.keep_sharding``). Not donated: host RAM is the abundant
+    pool, and pinned-host donation semantics are backend-dependent —
+    a transient functional copy there is the safe trade. One cached
+    jit per sharding; the sharding is stable across epochs, so this
+    compiles once."""
+    return jax.jit(
+        lambda cold, slots, blocks: cold.at[slots].set(blocks,
+                                                       mode="drop"),
+        out_shardings=sharding)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _swap_maps_fn(hot_map, cold_map, promo_lids, demo_lids, hot_slots,
+                  cold_slots):
+    """Slot-map half of the swap (same fixed width + drop-mode pad
+    discipline): promoted lists take the freed hot slots, demoted
+    lists the freed cold slots, each list's other-tier slot goes
+    −1."""
+    hot_map = hot_map.at[promo_lids].set(hot_slots, mode="drop")
+    hot_map = hot_map.at[demo_lids].set(-1, mode="drop")
+    cold_map = cold_map.at[demo_lids].set(cold_slots, mode="drop")
+    cold_map = cold_map.at[promo_lids].set(-1, mode="drop")
+    return hot_map, cold_map
+
+
+def apply_plan(tiered: TieredIvf, promotions, demotions,
+               width: int, executor=None) -> int:
+    """Execute a placement plan IN PLACE: ``promotions[i]`` (a cold
+    list id) takes the hot slot ``demotions[i]`` frees, which takes
+    the cold slot ``promotions[i]`` frees. ``width`` is the fixed
+    compiled swap width (the policy's ``max_swaps_per_epoch``) — the
+    pair vectors pad to it with out-of-range slots (gathers clamp,
+    scatters drop), so every epoch reuses one executable per
+    (shapes, width). Returns the bytes moved (2 × block per pair:
+    one promotion + one demotion).
+
+    Concurrency discipline: the hot plane and the slot maps are
+    DONATED to the swap (in-place HBM update), which is only safe
+    against live traffic when swap enqueues serialize with dispatch
+    enqueues — pass the serving ``executor`` (the TierManager does)
+    and the swap runs under its dispatch lock. A dispatch that
+    captured the pre-swap generation and enqueues after the swap
+    hits jax's deleted-array error once and is retried by the
+    executor against the new generation (see
+    ``SearchExecutor._run``); readers always see a CONSISTENT
+    generation because the container's four placement arrays replace
+    atomically under the swap lock (:meth:`TieredIvf.tier_arrays`)."""
+    n = len(promotions)
+    expect(n == len(demotions), "promotions/demotions must pair up")
+    expect(n <= width, f"plan has {n} swaps, width is {width}")
+    if n == 0:
+        return 0
+    promo = np.asarray(promotions, np.int32)
+    demo = np.asarray(demotions, np.int32)
+    hot_map_np, cold_map_np = _slot_maps(
+        tiered.hot_lists, tiered.cold_lists, tiered.n_lists)
+    hot_slots = hot_map_np[demo]
+    cold_slots = cold_map_np[promo]
+    expect(bool((hot_slots >= 0).all()),
+           "every demotion must name a currently-hot list")
+    expect(bool((cold_slots >= 0).all()),
+           "every promotion must name a currently-cold list")
+
+    # fixed-width pad: out-of-range slots/lids — gathers clamp,
+    # scatters drop (see _swap_blocks_fn)
+    def pad_to(v, fill):
+        out = np.full((width,), fill, np.int32)
+        out[:n] = v
+        return jnp.asarray(out)
+
+    hs = pad_to(hot_slots, tiered.n_hot)
+    cs = pad_to(cold_slots, tiered.n_cold)
+    pl_ = pad_to(promo, tiered.n_lists)
+    dl = pad_to(demo, tiered.n_lists)
+
+    # contextlib.nullcontext would be cleaner, but the executor lock
+    # is the point: with a live executor attached, the donation
+    # enqueues below must not interleave with dispatch enqueues
+    ex_lock = getattr(executor, "_lock", None) if executor is not None \
+        else None
+    if ex_lock is not None:
+        ex_lock.acquire()
+    try:
+        old_hot, old_cold = tiered.hot_data, tiered.cold_data
+        hg = jnp.minimum(hs, old_hot.shape[0] - 1)
+        cg = jnp.minimum(cs, old_cold.shape[0] - 1)
+        # gathers BEFORE the donation consumes the hot plane; the
+        # promoted gather out of a host-committed cold plane lands in
+        # device memory (that copy IS the promotion transfer), and
+        # the demoted blocks ride into the sharding-pinned cold
+        # scatter (the demotion transfer)
+        demoted = _gather_blocks(old_hot, hg)
+        promoted = _gather_blocks(old_cold, cg)
+        hot_data = _swap_hot_fn(old_hot, hs, promoted)
+        cold_data = _cold_scatter_for(old_cold.sharding)(
+            old_cold, cs, demoted)
+        hot_map, cold_map = _swap_maps_fn(
+            tiered.hot_slot_map, tiered.cold_slot_map, pl_, dl, hs, cs)
+        # host-side mirrors (the layout truth /tier.json serves)
+        hot_lists = tiered.hot_lists.copy()
+        cold_lists = tiered.cold_lists.copy()
+        hot_lists[hot_slots] = promo
+        cold_lists[cold_slots] = demo
+        # the new generation replaces atomically: a concurrent
+        # tier_arrays() sees all-old or all-new, never a mix
+        with tiered._swap_lock:
+            tiered.hot_data = hot_data
+            tiered.cold_data = cold_data
+            tiered.hot_slot_map = hot_map
+            tiered.cold_slot_map = cold_map
+            tiered.hot_lists = hot_lists
+            tiered.cold_lists = cold_lists
+    finally:
+        if ex_lock is not None:
+            ex_lock.release()
+    moved = 2 * n * tiered.block_bytes
+    tracing.inc_counters({"tier.swaps": float(n),
+                          "tier.swap_bytes": float(moved)})
+    return moved
+
+
+# ---------------------------------------------------------------------------
+# search
+# ---------------------------------------------------------------------------
+
+
+def _tiered_search_fn(queries, centers, center_norms, hot_data,
+                      cold_data, hot_slot_map, cold_slot_map,
+                      data_norms, indices, filter_words, init_d=None,
+                      init_i=None, probe_counts=None, n_valid=None, *,
+                      n_probes: int, k: int, metric: DistanceType,
+                      coarse_algo: str = "exact",
+                      scan_engine: str = "xla"):
+    """Coarse select + tiered probe scan — the serving body (the
+    executor's ``tiered_ivf`` plan compiles this). Mirrors ivf_flat's
+    ``_search_impl_fn`` contract: the coarse stage and metric epilog
+    are char-identical, only the scan swaps in the tiered engines, so
+    results are bit-identical to the all-HBM index per engine.
+    ``probe_counts``/``n_valid`` thread graftgauge's donated plane
+    exactly like the un-tiered body. ``scan_engine`` must arrive
+    resolved (``pallas``/``xla``) — it is a jit static."""
+    from raft_tpu.ops.tier_scan import tiered_list_major_scan
+
+    qf = queries.astype(jnp.float32)
+
+    ip = jax.lax.dot_general(
+        qf, centers, (((1,), (1,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    score = (ip if metric == DistanceType.InnerProduct
+             else -(center_norms[None, :] - 2.0 * ip))
+    probes = coarse_select(score, n_probes, coarse_algo)
+    if probe_counts is not None:
+        from raft_tpu.ops.ivf_scan import probe_histogram
+
+        probe_counts = probe_histogram(probes, probe_counts, n_valid)
+
+    best_d, best_i = tiered_list_major_scan(
+        qf, hot_data, cold_data, hot_slot_map, cold_slot_map,
+        data_norms, indices, probes, filter_words, init_d, init_i,
+        k=k, metric=metric, engine=scan_engine,
+        interpret=jax.default_backend() != "tpu")
+
+    if metric != DistanceType.InnerProduct:
+        q_sq = jnp.sum(jnp.square(qf), axis=1, keepdims=True)
+        best_d = jnp.where(jnp.isfinite(best_d),
+                           jnp.maximum(best_d + q_sq, 0.0), best_d)
+        if metric == DistanceType.L2SqrtExpanded:
+            best_d = jnp.where(jnp.isfinite(best_d), jnp.sqrt(best_d),
+                               best_d)
+    if probe_counts is not None:
+        return best_d, best_i, probe_counts
+    return best_d, best_i
+
+
+_tiered_search = partial(jax.jit, static_argnames=(
+    "n_probes", "k", "metric", "coarse_algo",
+    "scan_engine"))(_tiered_search_fn)
+
+
+def search(
+    res: Optional[Resources],
+    params: TieredSearchParams,
+    tiered: TieredIvf,
+    queries,
+    k: int,
+    sample_filter=None,
+    query_tile: int = 4096,
+) -> Tuple[jax.Array, jax.Array]:
+    """ANN search over the tiered index — same contract as
+    ``ivf_flat.search`` (and bit-identical to it on the same lists):
+    returns (distances, indices) of shape (q, k), missing slots id
+    −1. The probe scan follows ``params.scan_engine`` (resolved per
+    backend/shape by :func:`raft_tpu.ops.tier_scan
+    .resolve_tier_engine`)."""
+    ensure_resources(res)
+    queries = jnp.asarray(queries)
+    expect(queries.ndim == 2 and queries.shape[1] == tiered.dim,
+           "queries must be (q, dim)")
+    expect(params.coarse_algo in ("exact", "approx"),
+           f"coarse_algo must be 'exact' or 'approx', got "
+           f"{params.coarse_algo!r}")
+    n_probes = min(params.n_probes, tiered.n_lists)
+    filter_words = resolve_filter_words(sample_filter)
+    from raft_tpu.ops.tier_scan import resolve_tier_engine
+
+    # one consistent placement generation for the whole call — a
+    # concurrent epoch swap must never hand this search a new hot
+    # plane against an old slot map
+    hot_data, cold_data, hot_map, cold_map = tiered.tier_arrays()
+    scan_engine = resolve_tier_engine(
+        params.scan_engine, hot_data=hot_data,
+        filter_words=filter_words, k=k)
+    with tracing.range("raft_tpu.tiered.search"):
+        def run(qt, fw):
+            return _tiered_search(
+                qt, tiered.centers, tiered.center_norms,
+                hot_data, cold_data, hot_map, cold_map,
+                tiered.data_norms, tiered.indices, fw,
+                n_probes=n_probes, k=k, metric=tiered.metric,
+                coarse_algo=params.coarse_algo,
+                scan_engine=scan_engine,
+            )
+
+        return tile_queries(run, queries, filter_words, query_tile)

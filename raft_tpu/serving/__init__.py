@@ -40,6 +40,14 @@ letting tail latency or overload take the service down:
   profiler captures under a ≤1% duty-cycle budget feed the rolling
   EWMA attribution (``serving.attribution.rolling.*``), deferring to
   operator and incident captures on the shared profile lock.
+- :mod:`~raft_tpu.serving.placement` — :class:`TierManager` (PR 14
+  grafttier): the traffic×bytes promote/demote policy for tiered
+  (HBM hot / host-RAM cold) indexes — a pure deterministic epoch
+  function of (claimed probe-frequency window, current assignment)
+  executed as fixed-width donated block swaps that only permute the
+  hot slots, so serving stays zero-recompile across re-placement
+  epochs; scrape-driven via ``MetricsExporter(tier=...)`` →
+  ``/tier.json`` + ``tier.*`` gauges.
 - :mod:`~raft_tpu.serving.federation` — :class:`FleetAggregator`
   (PR 12 graftfleet): N replicas' ``/snapshot.json`` merged with
   type-correct semantics (lifetime-ledger counter sums that can never
@@ -96,6 +104,12 @@ from raft_tpu.serving.metrics import (
     SloConfig,
     SloWindow,
 )
+from raft_tpu.serving.placement import (
+    PlacementConfig,
+    PlacementPlan,
+    TierManager,
+    plan_epoch,
+)
 from raft_tpu.serving.request import (
     Cancelled,
     DeadlineExceeded,
@@ -127,6 +141,8 @@ __all__ = [
     "MultiBurnAlert",
     "MultiBurnConfig",
     "Overloaded",
+    "PlacementConfig",
+    "PlacementPlan",
     "RecallWindow",
     "ResultHandle",
     "SearchRequest",
@@ -136,4 +152,6 @@ __all__ = [
     "ShutDown",
     "SloConfig",
     "SloWindow",
+    "TierManager",
+    "plan_epoch",
 ]

@@ -54,7 +54,20 @@ Endpoints:
   device-memory breakdown in pprof wire format, written into
   ``profile_dir`` — same gate (403 unarmed) and the same
   one-capture-at-a-time lock as ``/profile`` (409 while any capture
-  runs, either direction).
+  runs, either direction). ``?diff=<seq>`` (PR 14) additionally
+  parses THIS capture against the earlier sequence-numbered capture
+  ``<seq>`` and returns the per-buffer-group byte deltas
+  (:func:`raft_tpu.core.memwatch.diff_memory_profiles`) — two
+  captures bracketing a window attribute the divergence gauge to
+  buffers instead of the whole process (400 on an unknown or
+  malformed sequence number).
+- ``/tier.json`` — the grafttier placement truth (PR 14): with a
+  :class:`~raft_tpu.serving.placement.TierManager` attached, the
+  live hot/cold layout, the last placement epoch's plan + evidence
+  (window total, hot-window fraction) and the policy config (404
+  when no manager is attached). The scrape also drives the
+  manager's epoch pacing (``tick``), exactly like graftfleet's
+  continuous capture.
 - ``POST /push?replica=<name>`` — federation push mode (PR 13): with
   a :class:`~raft_tpu.serving.federation.FleetAggregator` attached,
   a replica behind NAT POSTs its own ``/snapshot.json`` body here
@@ -174,6 +187,8 @@ _HELP_PREFIXES = (
     ("fleet.", "graftfleet multi-replica federation"),
     ("memory.", "graftledger device-memory truth (resident model, "
                 "live stats, reservation forecast)"),
+    ("tier.", "grafttier hot/cold placement (layout, epoch policy, "
+              "swap accounting)"),
     ("index.probe_freq.", "graftgauge per-list probe-frequency "
                           "accounting"),
     ("index.probe.", "graftgauge probe-accounting dispatch heartbeat"),
@@ -389,7 +404,7 @@ class MetricsExporter:
                  profile_dir: Optional[str] = None,
                  legacy_executable_metrics: bool = False,
                  index_gauge=None, flight=None, continuous=None,
-                 fleet=None, memory=None):
+                 fleet=None, memory=None, tier=None):
         self.executor = executor
         self.batcher = batcher
         self.host = host
@@ -414,10 +429,18 @@ class MetricsExporter:
         # gauge surface per scrape, backs /memory.json, and ships the
         # federation "memory" block inside /snapshot.json
         self.memory = memory
+        # grafttier (PR 14): a TierManager backs /tier.json and its
+        # placement epochs pace off the scrape (tick), like the
+        # continuous capture — the exporter is the one periodic pulse
+        # every serving process already has
+        self.tier = tier
         self._profile_lock = threading.Lock()
         # /memory_profile capture sequence — a counter, not a clock
         # read (R7): the file name only needs to be unique per process
         self._memprof_seq = 0
+        # seq -> capture path, for ?diff=<seq> (restart-safe: a seq
+        # from a previous process resolves through the file name)
+        self._memprof_paths: dict = {}
         for owner in (flight, continuous):
             if owner is not None and getattr(owner, "profile_lock",
                                              None) is None:
@@ -543,7 +566,7 @@ class MetricsExporter:
                 "with memory=... to arm /memory.json")
         return self.memory.publish()
 
-    def memory_profile(self) -> dict:
+    def memory_profile(self, diff_seq: Optional[int] = None) -> dict:
         """One gated ``jax.profiler.device_memory_profile`` capture
         — the per-buffer device-memory breakdown (pprof wire format)
         the live gauges summarize. Shares the ``/profile`` lock (one
@@ -552,16 +575,42 @@ class MetricsExporter:
         (403), ``RuntimeError`` while any capture runs (409). The
         pprof bytes land in ``profile_dir`` as
         ``memory_profile_<n>.pb.gz`` (sequence-numbered — no clock
-        read) and the response carries the path."""
+        read) and the response carries the path and sequence number.
+
+        ``diff_seq`` (PR 14, ``?diff=<seq>`` over HTTP) additionally
+        parses this capture against the earlier capture ``<seq>`` —
+        two sequence-numbered captures bracketing a window — and
+        returns the per-buffer-group byte deltas
+        (:func:`raft_tpu.core.memwatch.diff_memory_profiles`), so
+        the divergence gauge's growth attributes to buffer groups
+        instead of the whole process. An unknown sequence number
+        raises ``ValueError`` (400 over HTTP); a restarted process
+        can diff against a previous run's on-disk capture by its
+        number."""
         if self.profile_dir is None:
             raise PermissionError(
                 "profiling is disabled: construct MetricsExporter with "
                 "profile_dir=... to arm /memory_profile")
+        import os
+
+        before_path = None
+        if diff_seq is not None:
+            before_path = self._memprof_paths.get(int(diff_seq))
+            if before_path is None:
+                # restart-safe: resolve a previous process's capture
+                # through the deterministic file name
+                cand = os.path.join(
+                    self.profile_dir,
+                    f"memory_profile_{int(diff_seq):04d}.pb.gz")
+                if os.path.exists(cand):
+                    before_path = cand
+            if before_path is None or not os.path.exists(before_path):
+                raise ValueError(
+                    f"no memory profile with sequence number "
+                    f"{diff_seq} exists to diff against")
         if not self._profile_lock.acquire(blocking=False):
             raise RuntimeError("a profiler capture is already running")
         try:
-            import os
-
             import jax
 
             data = jax.profiler.device_memory_profile()
@@ -579,9 +628,35 @@ class MetricsExporter:
                     break
             with open(path, "wb") as f:
                 f.write(data)
+            # captured into a local INSIDE the lock: a concurrent
+            # capture bumps _memprof_seq the moment we release, and
+            # the response (and diff.to_seq) must name THIS capture
+            seq = self._memprof_seq
+            self._memprof_paths[seq] = path
         finally:
             self._profile_lock.release()
-        return {"path": path, "bytes": len(data)}
+        out = {"path": path, "bytes": len(data), "seq": seq}
+        if before_path is not None:
+            from raft_tpu.core import memwatch
+
+            with open(before_path, "rb") as f:
+                before = memwatch.parse_memory_profile(f.read())
+            after = memwatch.parse_memory_profile(data)
+            out["diff"] = dict(
+                memwatch.diff_memory_profiles(before, after),
+                from_seq=int(diff_seq), to_seq=seq)
+        return out
+
+    def tier_snapshot(self) -> dict:
+        """The ``/tier.json`` body: the attached
+        :class:`~raft_tpu.serving.placement.TierManager`'s layout +
+        last-plan view. Raises ``LookupError`` when no manager is
+        attached — the HTTP layer maps it to 404."""
+        if self.tier is None:
+            raise LookupError(
+                "no TierManager attached: construct MetricsExporter "
+                "with tier=... to arm /tier.json")
+        return self.tier.snapshot()
 
     def _refresh(self) -> None:
         """Re-publish the poll-style gauges from the attached executor
@@ -608,6 +683,13 @@ class MetricsExporter:
             # stats + forecast) — BEFORE the flight check below, so a
             # low-headroom trigger evaluates this scrape's numbers
             self.memory.publish()
+        if self.tier is not None:
+            # grafttier: refresh the layout gauges and pace the
+            # placement epochs off the scrape (the manager's injected
+            # clock decides whether an epoch is due — one tick runs
+            # at most one epoch, like the continuous capture)
+            self.tier.publish_gauges()
+            self.tier.tick()
         if self.flight is not None:
             # graftflight: evaluate the incident triggers — a firing
             # multiburn alert / latency anomaly captures here, rate
@@ -697,9 +779,29 @@ class MetricsExporter:
                         return
                     self._send(json.dumps(out, default=str).encode(),
                                "application/json")
-                elif path == "/memory_profile":
+                elif path == "/tier.json":
                     try:
-                        out = exporter.memory_profile()
+                        out = exporter.tier_snapshot()
+                    except LookupError as e:
+                        self._send(f"{e}\n".encode(), "text/plain", 404)
+                        return
+                    self._send(json.dumps(out, default=str).encode(),
+                               "application/json")
+                elif path == "/memory_profile":
+                    diff_seq = None
+                    if "diff" in qs:
+                        try:
+                            diff_seq = int(qs["diff"][0])
+                        except ValueError:
+                            self._send(
+                                b"diff must be a capture sequence "
+                                b"number\n", "text/plain", 400)
+                            return
+                    try:
+                        out = exporter.memory_profile(diff_seq=diff_seq)
+                    except ValueError as e:
+                        self._send(f"{e}\n".encode(), "text/plain", 400)
+                        return
                     except PermissionError as e:
                         self._send(f"{e}\n".encode(), "text/plain", 403)
                         return

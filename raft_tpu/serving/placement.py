@@ -1,0 +1,249 @@
+"""grafttier placement — the traffic×bytes promote/demote policy.
+
+Placement is a SERVING-plane decision: the two halves of its signal
+already exist as observability planes — graftgauge's device-side
+probe-frequency accounting says which lists are hot (the
+``coverage_p01/p10`` tier-split evidence of PR 8), and graftledger's
+memory truth says what fits where (PR 13). This module closes the
+loop: a pure, deterministic **epoch function** (:func:`plan_epoch`)
+of (claimed probe-frequency window, current assignment) emits a
+promote/demote plan, and :class:`TierManager` executes it as
+:func:`raft_tpu.neighbors.tiered.apply_plan`'s fixed-width donated
+block swaps — which only permute which lists occupy the fixed hot
+slots, so every ``SearchExecutor`` plan stays zero-recompile across
+re-placement epochs.
+
+Policy shape: pair the hottest cold lists with the coldest hot lists,
+bounded by ``max_swaps_per_epoch`` (also the compiled swap width); a
+pair swaps only when the cold list's window traffic beats the hot
+list's by ``min_heat_ratio`` (hysteresis — border lists must not
+ping-pong a 2×block-bytes transfer every epoch on noise). Ties break
+to the smaller list id, so the plan is a pure function of its inputs
+and two replicas observing the same window converge on the same
+layout (ManualClock-pinned in ``tests/test_tiered.py``).
+
+Clock discipline (graftlint R7): the manager never reads a wall
+clock — epochs fire from an injected clock's ``now()`` (the batcher
+convention), and the exporter's scrape drives :meth:`TierManager
+.tick` exactly like graftfleet's continuous capture.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.core import tracing
+from raft_tpu.core.validation import expect
+
+EPOCHS = "tier.epochs"
+PROMOTIONS = "tier.promotions"
+DEMOTIONS = "tier.demotions"
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementConfig:
+    """Epoch policy knobs. ``max_swaps_per_epoch`` doubles as the
+    fixed compiled swap width — raising it re-specializes the swap
+    program once, never per epoch."""
+
+    epoch_every_s: float = 60.0
+    max_swaps_per_epoch: int = 8
+    min_heat_ratio: float = 1.5
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementPlan:
+    """One epoch's decision: ``promotions[i]`` (a cold list id) takes
+    the hot slot ``demotions[i]`` frees. ``window_total`` and
+    ``hot_window_fraction`` carry the evidence the plan was computed
+    from (the share of the window's probes that landed hot — the
+    tier hit rate the gauges publish)."""
+
+    promotions: Tuple[int, ...]
+    demotions: Tuple[int, ...]
+    window_total: int
+    hot_window_fraction: float
+
+
+def plan_epoch(window_counts, hot_lists, cold_lists, *,
+               max_swaps: int = 8,
+               min_heat_ratio: float = 1.5) -> PlacementPlan:
+    """THE epoch function — pure and deterministic: given one claimed
+    probe-frequency window (per-list counts) and the current
+    assignment, pair the hottest cold lists against the coldest hot
+    lists and keep each pair only while the cold side's traffic beats
+    the hot side's by ``min_heat_ratio`` (a cold list with zero
+    window traffic never promotes; a hot list with zero traffic
+    demotes against any cold traffic). Ties break to the smaller
+    list id on both sides."""
+    counts = np.asarray(window_counts, np.int64)
+    hot = np.asarray(hot_lists, np.int64)
+    cold = np.asarray(cold_lists, np.int64)
+    total = int(counts.sum())
+    hot_frac = float(counts[hot].sum() / total) if total > 0 else 0.0
+    # hottest cold first / coldest hot first, ties to smaller lid
+    # (lexsort's last key is primary; lid is the secondary key)
+    cold_order = cold[np.lexsort((cold, -counts[cold]))]
+    hot_order = hot[np.lexsort((hot, counts[hot]))]
+    promotions, demotions = [], []
+    for c, h in zip(cold_order[:max_swaps], hot_order[:max_swaps]):
+        cc, hc = int(counts[c]), int(counts[h])
+        if cc <= 0 or cc < min_heat_ratio * hc:
+            break
+        promotions.append(int(c))
+        demotions.append(int(h))
+    return PlacementPlan(promotions=tuple(promotions),
+                         demotions=tuple(demotions),
+                         window_total=total,
+                         hot_window_fraction=hot_frac)
+
+
+class TierManager:
+    """Drives placement epochs for one :class:`~raft_tpu.neighbors
+    .tiered.TieredIvf` served by one probe-accounting
+    ``SearchExecutor``.
+
+    The traffic window is the DELTA of the executor's lifetime probe
+    ledger between epochs (``probe_frequencies`` claims device
+    windows into a monotone host ledger; differencing it here means
+    however many scrapers also claim windows, no probe is ever lost
+    to or double-counted by placement). Epochs fire from the injected
+    ``clock`` when :meth:`tick` observes ``epoch_every_s`` elapsed —
+    the exporter's scrape drives it (``MetricsExporter(tier=...)``),
+    and tests drive :meth:`epoch` directly under a ManualClock.
+
+    Gauges (flat — one manager serves one tiered index):
+    ``tier.{hot_lists,cold_lists,hot_bytes,cold_bytes,host_resident,
+    hot_window_fraction,last_swaps,window_total}``; counters
+    ``tier.{epochs,promotions,demotions,swaps,swap_bytes}`` (the swap
+    pair live in :func:`~raft_tpu.neighbors.tiered.apply_plan`, where
+    the bytes actually move).
+    """
+
+    def __init__(self, tiered, executor, *,
+                 config: Optional[PlacementConfig] = None, clock=None):
+        from raft_tpu.serving.batcher import MonotonicClock
+
+        expect(getattr(executor, "probe_accounting", False),
+               "TierManager needs a probe-accounting SearchExecutor — "
+               "placement without the traffic signal would be blind "
+               "(construct SearchExecutor(probe_accounting=True))")
+        self.tiered = tiered
+        self.executor = executor
+        self.config = config or PlacementConfig()
+        self._clock = clock or MonotonicClock()
+        self._lock = threading.Lock()
+        self._last_epoch_t: Optional[float] = None
+        self._last_counts: Optional[np.ndarray] = None
+        self._epochs = 0
+        self._last_plan: Optional[PlacementPlan] = None
+
+    # -- the epoch ----------------------------------------------------------
+
+    def _claim_window(self) -> np.ndarray:
+        """This epoch's traffic window: the delta of the executor's
+        lifetime probe ledger since the last epoch (zeros before the
+        first accounted dispatch)."""
+        label = self.executor.probe_label(self.tiered)
+        n = self.tiered.n_lists
+        if label is None:
+            return np.zeros((n,), np.int64)
+        counts = self.executor.probe_frequencies().get(
+            label, np.zeros((n,), np.int64))
+        last = self._last_counts
+        self._last_counts = counts
+        if last is None:
+            return counts.copy()
+        return counts - last
+
+    def epoch(self) -> PlacementPlan:
+        """Run one placement epoch NOW: claim the window, plan, and
+        execute the swaps. Returns the plan (empty plans execute
+        nothing — the layout holds)."""
+        from raft_tpu.neighbors.tiered import apply_plan
+
+        cfg = self.config
+        with self._lock:
+            window = self._claim_window()
+            plan = plan_epoch(window, self.tiered.hot_lists,
+                              self.tiered.cold_lists,
+                              max_swaps=cfg.max_swaps_per_epoch,
+                              min_heat_ratio=cfg.min_heat_ratio)
+            # the executor rides along so the swap's donation
+            # enqueues serialize with dispatch enqueues (see
+            # apply_plan's concurrency discipline)
+            apply_plan(self.tiered, plan.promotions, plan.demotions,
+                       width=cfg.max_swaps_per_epoch,
+                       executor=self.executor)
+            self._epochs += 1
+            self._last_plan = plan
+        tracing.inc_counters({
+            EPOCHS: 1.0,
+            PROMOTIONS: float(len(plan.promotions)),
+            DEMOTIONS: float(len(plan.demotions)),
+        })
+        self.publish_gauges()
+        return plan
+
+    def tick(self) -> Optional[PlacementPlan]:
+        """Scrape-driven pacing: run an epoch when ``epoch_every_s``
+        has elapsed on the injected clock (the first tick only stamps
+        the baseline — an epoch needs a window to judge). Elapsed
+        multiples never stack: one tick runs at most one epoch."""
+        now = self._clock.now()
+        with self._lock:
+            if self._last_epoch_t is None:
+                self._last_epoch_t = now
+                return None
+            if now - self._last_epoch_t < self.config.epoch_every_s:
+                return None
+            self._last_epoch_t = now
+        return self.epoch()
+
+    # -- scrape surface -----------------------------------------------------
+
+    def publish_gauges(self) -> None:
+        t = self.tiered
+        plan = self._last_plan
+        tracing.set_gauges({
+            "tier.hot_lists": float(t.n_hot),
+            "tier.cold_lists": float(t.n_cold),
+            "tier.hot_bytes": float(t.hot_bytes),
+            "tier.cold_bytes": float(t.cold_bytes),
+            "tier.host_resident": 1.0 if t.host_resident else 0.0,
+            "tier.last_swaps":
+                float(len(plan.promotions)) if plan else 0.0,
+            "tier.window_total":
+                float(plan.window_total) if plan else 0.0,
+            "tier.hot_window_fraction":
+                plan.hot_window_fraction if plan else 0.0,
+        })
+
+    def snapshot(self) -> dict:
+        """The ``/tier.json`` body: the live layout, the last epoch's
+        plan and evidence, and the policy config."""
+        with self._lock:
+            plan = self._last_plan
+            epochs = self._epochs
+        out = {
+            "layout": self.tiered.layout(),
+            "epochs": epochs,
+            "config": {
+                "epoch_every_s": self.config.epoch_every_s,
+                "max_swaps_per_epoch": self.config.max_swaps_per_epoch,
+                "min_heat_ratio": self.config.min_heat_ratio,
+            },
+            "last_plan": None,
+        }
+        if plan is not None:
+            out["last_plan"] = {
+                "promotions": list(plan.promotions),
+                "demotions": list(plan.demotions),
+                "window_total": plan.window_total,
+                "hot_window_fraction": plan.hot_window_fraction,
+            }
+        return out

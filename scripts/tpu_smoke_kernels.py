@@ -236,6 +236,86 @@ def main():
     except Exception as e:  # noqa: BLE001
         emit("bq_scan", error=str(e)[:300])
 
+    # ---- grafttier tiered scan compiled (PR 14): on-chip pallas ≡
+    # xla on ids AND distances with half the lists host-cold, swap
+    # bit-stability through a placement epoch, and the compiled
+    # hot-vs-cold stream split via cost_analysis — the dual-roofline
+    # evidence: the tiered program's DEVICE bytes-accessed must sit
+    # close to the hot tier's share, not re-read the whole index
+    # (whether the cold operand truly stays host-resident on this
+    # jaxlib is reported, not assumed: host_resident says what
+    # host_put achieved)
+    try:
+        from raft_tpu.neighbors import ivf_flat, tiered
+
+        xs = jnp.asarray(rng.standard_normal((20_000, 128)).astype(
+            np.float32))
+        qs = jnp.asarray(rng.standard_normal((16, 128)).astype(
+            np.float32))
+        params = ivf_flat.IvfFlatIndexParams(n_lists=64,
+                                             kmeans_n_iters=5)
+        single = ivf_flat.build(None, params, xs)
+        t = tiered.build_tiered(single, hot_fraction=0.5)
+        rep = {"n_hot": t.n_hot, "n_cold": t.n_cold,
+               "host_resident": bool(t.host_resident)}
+        outs = {}
+        for eng in ("xla", "pallas"):
+            sp = tiered.TieredSearchParams(n_probes=8, scan_engine=eng)
+            d1, i1 = tiered.search(None, sp, t, qs, 10)
+            outs[eng] = (np.asarray(d1), np.asarray(i1))
+        sp0 = ivf_flat.IvfFlatSearchParams(n_probes=8,
+                                           scan_engine="xla")
+        d0, i0 = ivf_flat.search(None, sp0, single, qs, 10)
+        rep["pallas_bits_eq_xla"] = bool(
+            (outs["pallas"][0] == outs["xla"][0]).all()
+            and (outs["pallas"][1] == outs["xla"][1]).all())
+        rep["tiered_bits_eq_allhbm"] = bool(
+            (outs["xla"][0] == np.asarray(d0)).all()
+            and (outs["xla"][1] == np.asarray(i0)).all())
+        # placement swap on-chip: promote/demote 4 pairs, results
+        # must not move a bit
+        tiered.apply_plan(t, [int(x_) for x_ in t.cold_lists[:4]],
+                          [int(x_) for x_ in t.hot_lists[:4]],
+                          width=8)
+        sp = tiered.TieredSearchParams(n_probes=8,
+                                       scan_engine="pallas")
+        d2, i2 = tiered.search(None, sp, t, qs, 10)
+        rep["post_swap_bits_exact"] = bool(
+            (np.asarray(d2) == outs["pallas"][0]).all()
+            and (np.asarray(i2) == outs["pallas"][1]).all())
+
+        # compiled stream split: cost_analysis bytes of the tiered
+        # pallas program vs the all-HBM list-major program — with
+        # the cold plane host-side, device bytes-accessed should
+        # drop toward the hot share
+        def compiled_bytes(fn, *args, **kw):
+            comp = jax.jit(fn, static_argnames=tuple(kw)).lower(
+                *args, **kw).compile()
+            ca = comp.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            return float(ca.get("bytes accessed", 0.0))
+
+        fw = None
+        tiered_b = compiled_bytes(
+            lambda qq: tiered._tiered_search_fn(
+                qq, t.centers, t.center_norms, t.hot_data,
+                t.cold_data, t.hot_slot_map, t.cold_slot_map,
+                t.data_norms, t.indices, fw, n_probes=8, k=10,
+                metric=t.metric, scan_engine="pallas"), qs)
+        allhbm_b = compiled_bytes(
+            lambda qq: ivf_flat._search_impl_fn(
+                qq, single.centers, single.center_norms, single.data,
+                single.data_norms, single.indices, fw, n_probes=8,
+                k=10, metric=single.metric, scan_engine="pallas"), qs)
+        rep["tiered_compiled_bytes"] = tiered_b
+        rep["allhbm_compiled_bytes"] = allhbm_b
+        rep["hot_fraction_of_bytes"] = float(
+            t.hot_bytes / (t.hot_bytes + t.cold_bytes))
+        emit("tier_scan", **rep)
+    except Exception as e:  # noqa: BLE001
+        emit("tier_scan", error=str(e)[:300])
+
     # ---- beam_search compiled vs the XLA engine (same seeds)
     try:
         from raft_tpu.neighbors.cagra import _search_batch

@@ -1079,3 +1079,67 @@ class TestBqScanScopeProof:
         assert any("vmem" in f.message.lower()
                    for f in bad.findings), [
             f.render() for f in bad.findings]
+
+
+class TestGrafttierScopeProofs:
+    """PR 14 satellite: the lint scopes reach BOTH new grafttier
+    modules by their real paths — a budget-less pallas_call in the
+    tiered scan, a host sync in either module, or a bare clock read
+    in the placement policy is a finding, not a blind spot (the
+    shipped modules lint clean: the kernel declares its VMEM budget
+    from the shared footprint model, the scan is pure device work,
+    and the manager's epochs fire from an injected clock)."""
+
+    def test_r4_covers_tier_scan(self):
+        bad = lint_lib(R4_VIOLATING, ["R4"],
+                       rel="raft_tpu/ops/tier_scan.py")
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "without compiler_params" in msgs, msgs
+        assert lint_lib(R4_CONFORMING, ["R4"],
+                        rel="raft_tpu/ops/tier_scan.py").ok
+
+    def test_r5_covers_tier_scan_and_placement(self):
+        tier_sync = (
+            "def search_tiered(handles):\n"
+            "    return [h.best.item() for h in handles]\n"
+        )
+        bad = lint_lib(tier_sync, ["R5"],
+                       rel="raft_tpu/ops/tier_scan.py")
+        assert rules_fired(bad) == {"R5"}
+        bad = lint_lib(tier_sync, ["R5"],
+                       rel="raft_tpu/serving/placement.py")
+        assert rules_fired(bad) == {"R5"}
+        # device_put in a python loop — the per-swap antipattern the
+        # fixed-width batched swap exists to avoid
+        swap_loop = (
+            "import jax\n"
+            "\n"
+            "\n"
+            "def search_swap(blocks, devs):\n"
+            "    out = []\n"
+            "    for b in blocks:\n"
+            "        out.append(jax.device_put(b, devs[0]))\n"
+            "    return out\n"
+        )
+        bad = lint_lib(swap_loop, ["R5"],
+                       rel="raft_tpu/serving/placement.py")
+        assert rules_fired(bad) == {"R5"}
+
+    def test_r7_covers_placement(self):
+        epoch_clock = (
+            "import time\n"
+            "\n"
+            "\n"
+            "def epoch_due(last):\n"
+            "    return time.monotonic() - last > 60.0\n"
+        )
+        bad = lint_lib(epoch_clock, ["R7"],
+                       rel="raft_tpu/serving/placement.py")
+        assert rules_fired(bad) == {"R7"}
+        # the conforming discipline the module actually uses
+        ok = (
+            "def epoch_due(clock, last):\n"
+            "    return clock.now() - last > 60.0\n"
+        )
+        assert lint_lib(ok, ["R5", "R7"],
+                        rel="raft_tpu/serving/placement.py").ok

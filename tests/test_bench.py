@@ -694,7 +694,9 @@ class TestBenchCompare:
                            "incident.bundles": 1.0,
                            "profiling.rolling.folds": 2.0,
                            "fleet.scrapes": 1.0,
-                           "memory.samples": 8.0}}
+                           "memory.samples": 8.0,
+                           "tier.swaps": 2.0,
+                           "tier.swap_bytes": 1e5}}
         assert bc.check_snapshot(ok) == []
         dark = {"counters": {"serving.execute.calls": 5.0,
                              "serving.execute.modeled_bytes": 0.0}}
@@ -721,6 +723,8 @@ class TestBenchCompare:
                 "profiling.rolling.folds": 2.0,
                 "fleet.scrapes": 1.0,
             "memory.samples": 8.0,
+                "tier.swaps": 2.0,
+                "tier.swap_bytes": 1e5,
             },
         }
         assert bc.check_snapshot(snap) == []
@@ -782,6 +786,8 @@ class TestBenchCompare:
             "profiling.rolling.folds": 2.0,
             "fleet.scrapes": 1.0,
             "memory.samples": 8.0,
+            "tier.swaps": 2.0,
+            "tier.swap_bytes": 1e5,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("index.probe_freq.accounted" in m for m in msgs)
@@ -808,6 +814,8 @@ class TestBenchCompare:
             "profiling.rolling.folds": 2.0,
             "fleet.scrapes": 1.0,
             "memory.samples": 8.0,
+            "tier.swaps": 2.0,
+            "tier.swap_bytes": 1e5,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("profiling.captures" in m for m in msgs)
@@ -844,6 +852,8 @@ class TestBenchCompare:
             "profiling.rolling.folds": 0.0,        # rolling dark
             "fleet.scrapes": 1.0,
             "memory.samples": 8.0,
+            "tier.swaps": 2.0,
+            "tier.swap_bytes": 1e5,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("profiling.rolling.folds" in m for m in msgs)
@@ -883,6 +893,8 @@ class TestBenchCompare:
             "profiling.rolling.folds": 2.0,
             "fleet.scrapes": 1.0,
             "memory.samples": 0.0,                 # watermark dark
+            "tier.swaps": 2.0,
+            "tier.swap_bytes": 1e5,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("memory.samples" in m for m in msgs)
@@ -895,6 +907,52 @@ class TestBenchCompare:
         with open(base_path) as f:
             committed = json.load(f)
         assert "memory.samples" in committed["snapshot_floors"]
+
+    # -- PR 14: grafttier swap floor + tiered tolerance bands ---------------
+
+    def test_snapshot_floors_include_grafttier(self, bc):
+        """grafttier satellite: the gate floor-checks the placement
+        swap executor — a refactor that disconnects apply_plan's
+        block swaps (or their byte accounting) zeroes these and
+        fails structurally — and carries the tight tiered bands."""
+        assert "tier.swaps" in bc.SNAPSHOT_FLOORS
+        assert "tier.swap_bytes" in bc.SNAPSHOT_FLOORS
+        dark = {"counters_lifetime": {
+            "serving.execute.calls": 5.0,
+            "serving.execute.modeled_bytes": 1e6,
+            "serving.execute.modeled_flops": 1e7,
+            "index.probe.dispatches": 3.0,
+            "index.probe_freq.accounted": 96.0,
+            "profiling.captures": 1.0,
+            "incident.bundles": 1.0,
+            "profiling.rolling.folds": 2.0,
+            "fleet.scrapes": 1.0,
+            "memory.samples": 8.0,
+            "tier.swaps": 0.0,                     # swaps dark
+            "tier.swap_bytes": 1e5,
+        }}
+        msgs = bc.check_snapshot(dark)
+        assert any("tier.swaps" in m for m in msgs)
+        dark["counters_lifetime"]["tier.swaps"] = 2.0
+        assert bc.check_snapshot(dark) == []
+        # the correctness + zero-recompile columns are gated TIGHT
+        assert bc.DEFAULT_TOLERANCES["tiered.bit_identical"] == \
+            {"min_ratio": 1.0}
+        assert bc.DEFAULT_TOLERANCES[
+            "tiered.compiles_during_epochs"] == {"max_increase": 0}
+        assert "tiered.swap_bytes_total" in bc.DEFAULT_TOLERANCES
+        import os
+
+        base_path = os.path.join(os.path.dirname(bc.__file__),
+                                 "bench_baseline.json")
+        with open(base_path) as f:
+            committed = json.load(f)
+        assert "tier.swaps" in committed["snapshot_floors"]
+        # the committed baseline's tiered record holds the contract
+        # values the bands pin against
+        tiered = committed["record"]["tiered"]
+        assert tiered["bit_identical"] == 1
+        assert tiered["compiles_during_epochs"] == 0
 
     def test_multi_baseline_gates_each(self, bc, record, tmp_path):
         import copy

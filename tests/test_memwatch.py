@@ -629,3 +629,271 @@ class TestLowHeadroomIncident:
         clock = ManualClock()
         flight = self.make_flight(ledger, clock)
         assert flight.check(clock.now()) is None
+
+
+class TestHostTierModel:
+    """PR 14 (grafttier): host-tier bytes join the resident model —
+    components living OFF their device's default memory (or in plain
+    numpy) fold into ``host_resident_bytes`` and OUT of the device
+    totals the forecast/headroom/divergence arithmetic runs on."""
+
+    class _StubSharding:
+        def __init__(self, kind):
+            self.memory_kind = kind
+            self.device_set = ()
+
+    class _StubArray:
+        def __init__(self, shape, dtype, kind=None):
+            self.shape = shape
+            self.dtype = np.dtype(dtype)
+            self.sharding = (TestHostTierModel._StubSharding(kind)
+                            if kind else None)
+
+    def test_memory_tier_classification(self):
+        assert memwatch.memory_tier(np.zeros((2, 2))) == "host"
+        assert memwatch.memory_tier(
+            self._StubArray((2, 2), np.float32)) == "device"
+        assert memwatch.memory_tier(
+            self._StubArray((2, 2), np.float32,
+                            kind="pinned_host")) == "host"
+
+    def test_cpu_default_host_kind_counts_as_device(self):
+        """The CPU backend's default memory IS unpinned_host — an
+        ordinary CPU array must never be misclassified off-device
+        (that would zero the whole resident model on tier-1)."""
+        import jax.numpy as jnp
+
+        a = jnp.zeros((4, 4), jnp.float32)
+        assert memwatch.memory_tier(a) == "device"
+
+    def test_host_bytes_split_pinned(self):
+        """Byte-exact pin of the device/host split on a stub index
+        with one pinned-host component."""
+        import dataclasses as dc
+
+        @dc.dataclass(frozen=True)
+        class StubIndex:
+            hot: object
+            cold: object
+
+        idx = StubIndex(
+            hot=self._StubArray((8, 16, 4), np.float32),
+            cold=self._StubArray((24, 16, 4), np.float32,
+                                 kind="pinned_host"))
+        m = memwatch.index_memory_model(idx)
+        assert m["resident_bytes"] == 8 * 16 * 4 * 4
+        assert m["host_resident_bytes"] == 24 * 16 * 4 * 4
+        assert m["components"]["hot"]["tier"] == "device"
+        assert m["components"]["cold"]["tier"] == "host"
+        # the forecast's device peak excludes the host tier
+        ledger = MemoryLedger()
+        ledger.watch("stub", idx)
+        fc = ledger.forecast()
+        assert fc["resident_bytes"] == 8 * 16 * 4 * 4
+        snap = ledger.snapshot()
+        assert snap["host_resident_total_bytes"] == 24 * 16 * 4 * 4
+        ledger.publish()
+        g = tracing.gauges()
+        assert g["memory.host.resident_bytes"] == 24 * 16 * 4 * 4
+        assert g["memory.index.stub.host_bytes"] == 24 * 16 * 4 * 4
+        # the federation block carries it too
+        assert ledger.federation_payload()[
+            "host_resident_total_bytes"] == 24 * 16 * 4 * 4
+
+
+class TestDistributedDealGate:
+    """PR 14 satellite (ROADMAP graftledger follow-on (b)): the mesh
+    deal's per-shard staging is ADMITTED, not just modeled."""
+
+    def test_dealt_shard_bytes_arithmetic(self):
+        import jax
+        import jax.numpy as jnp
+
+        arrays = (
+            jax.ShapeDtypeStruct((32, 64, 8), jnp.float32),
+            jax.ShapeDtypeStruct((32, 64), jnp.int32),
+            jax.ShapeDtypeStruct((32,), jnp.int32),
+        )
+        # 8 shards: 4 rows each of every tensor
+        want = 4 * 64 * 8 * 4 + 4 * 64 * 4 + 4 * 4
+        assert memwatch.dealt_shard_bytes(arrays, 8) == want
+        # non-dividing row counts round up (the last shard's slack
+        # must not make the model optimistic), None entries skip
+        assert memwatch.dealt_shard_bytes(
+            (jax.ShapeDtypeStruct((10, 2), jnp.float32), None), 4) \
+            == 3 * 2 * 4
+
+    def test_deal_admission_refuses_per_shard_model(self, data):
+        """admit_deal is the distributed builds' gate seam: a
+        capacity below the per-shard slot model refuses with the
+        exact per-shard byte count, host-side."""
+        x, _ = data
+        idx = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+        arrays = (idx.centers, idx.data, idx.data_norms, idx.indices,
+                  idx.list_sizes)
+        per_shard = memwatch.dealt_shard_bytes(arrays, 8)
+        memwatch.install_gate(MemoryLedger(
+            capacity_bytes=per_shard - 1))
+        with pytest.raises(CapacityExceeded,
+                           match="ivf_flat.build.deal") as e:
+            dist_ivf.admit_deal(arrays, 8,
+                                "distributed.ivf_flat.build.deal")
+        assert e.value.required_bytes == per_shard
+
+    def test_distributed_build_is_gated_end_to_end(self, data):
+        """The whole mesh build path refuses under a tiny gate —
+        typed CapacityExceeded, never a backend OOM."""
+        from raft_tpu.comms import local_comms
+
+        x, _ = data
+        memwatch.install_gate(MemoryLedger(capacity_bytes=100))
+        with pytest.raises(CapacityExceeded):
+            dist_ivf.build(None, local_comms(),
+                           ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+
+    def test_deal_admissions_counted(self, data):
+        """With ample capacity the mesh build's deal admission rides
+        the same decision ledger as the single-chip gates."""
+        from raft_tpu.comms import local_comms
+
+        x, _ = data
+        memwatch.install_gate(MemoryLedger(capacity_bytes=10**12))
+        before = tracing.get_counter(memwatch.GATE_ADMITTED)
+        dist_ivf.build(None, local_comms(),
+                       ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+        after = tracing.get_counter(memwatch.GATE_ADMITTED)
+        # at least the single-chip extend admit AND the deal admit
+        assert after - before >= 2
+
+
+def _encode_varint(v):
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _encode_field(fnum, wtype, payload):
+    tag = _encode_varint((fnum << 3) | wtype)
+    if wtype == 0:
+        return tag + _encode_varint(payload)
+    return tag + _encode_varint(len(payload)) + payload
+
+
+def _make_pprof(samples, strings):
+    """Minimal pprof Profile: sample_type (objects, bytes), samples
+    with labels, a string table. ``samples`` is a list of
+    (label_pairs, objects, bytes) with label_pairs as (key_idx,
+    str_idx) tuples."""
+    body = bytearray()
+    # sample_type: {type, unit} — unit indices point at "objects"/"bytes"
+    for unit_idx in (strings.index("objects"), strings.index("bytes")):
+        vt = _encode_field(1, 0, 0) + _encode_field(2, 0, unit_idx)
+        body += _encode_field(1, 2, vt)
+    for label_pairs, n_obj, n_bytes in samples:
+        sample = bytearray()
+        sample += _encode_field(2, 0, n_obj)
+        sample += _encode_field(2, 0, n_bytes)
+        for key_idx, str_idx in label_pairs:
+            lab = (_encode_field(1, 0, key_idx)
+                   + _encode_field(2, 0, str_idx))
+            sample += _encode_field(3, 2, bytes(lab))
+        body += _encode_field(2, 2, bytes(sample))
+    for s in strings:
+        body += _encode_field(6, 2, s.encode())
+    return bytes(body)
+
+
+class TestMemoryProfileDiff:
+    """PR 14 satellite (ROADMAP graftledger follow-on (c)): two
+    sequence-numbered pprof captures diff into per-buffer divergence
+    attribution."""
+
+    STRINGS = ["", "objects", "bytes", "kind", "buffer", "shape",
+               "f32[128,64]", "f32[8,8]"]
+
+    def _profile(self, buf_bytes, small_bytes):
+        s = self.STRINGS
+        return _make_pprof(
+            [([(s.index("kind"), s.index("buffer")),
+               (s.index("shape"), s.index("f32[128,64]"))], 1,
+              buf_bytes),
+             ([(s.index("kind"), s.index("buffer")),
+               (s.index("shape"), s.index("f32[8,8]"))], 1,
+              small_bytes)],
+            s)
+
+    def test_parse_aggregates_by_label_set(self):
+        parsed = memwatch.parse_memory_profile(
+            self._profile(32768, 256))
+        assert parsed == {
+            "kind=buffer,shape=f32[128,64]": 32768,
+            "kind=buffer,shape=f32[8,8]": 256,
+        }
+
+    def test_parse_handles_gzip(self):
+        import gzip
+
+        raw = self._profile(1024, 64)
+        assert memwatch.parse_memory_profile(gzip.compress(raw)) \
+            == memwatch.parse_memory_profile(raw)
+
+    def test_parse_picks_bytes_value_column(self):
+        """The bytes-typed sample value is summed — not the objects
+        column sitting before it."""
+        parsed = memwatch.parse_memory_profile(self._profile(500, 7))
+        assert sum(parsed.values()) == 507
+
+    def test_diff_attributes_per_buffer_group(self):
+        before = memwatch.parse_memory_profile(
+            self._profile(32768, 256))
+        after = memwatch.parse_memory_profile(
+            self._profile(65536, 256))
+        diff = memwatch.diff_memory_profiles(before, after)
+        assert diff["total_delta_bytes"] == 32768
+        assert diff["deltas"] == [{
+            "label": "kind=buffer,shape=f32[128,64]",
+            "from_bytes": 32768, "to_bytes": 65536,
+            "delta_bytes": 32768,
+        }]
+        # disappearing and appearing groups both attribute
+        gone = memwatch.diff_memory_profiles(before, {})
+        assert gone["total_delta_bytes"] == -(32768 + 256)
+        assert len(gone["deltas"]) == 2
+        assert gone["deltas"][0]["delta_bytes"] == -32768
+
+    def test_http_diff_round_trip(self, tmp_path):
+        """?diff=<seq> over HTTP: capture, capture-with-diff, and the
+        400 contract for unknown/malformed sequence numbers."""
+        from raft_tpu.serving import MetricsExporter
+
+        exp = MetricsExporter(profile_dir=str(tmp_path))
+        exp.start()
+        try:
+            r1 = json.loads(urllib.request.urlopen(
+                exp.url("/memory_profile")).read())
+            assert r1["seq"] == 1 and r1["bytes"] > 0
+            r2 = json.loads(urllib.request.urlopen(
+                exp.url("/memory_profile?diff=1")).read())
+            assert r2["seq"] == 2
+            d = r2["diff"]
+            assert d["from_seq"] == 1 and d["to_seq"] == 2
+            assert isinstance(d["deltas"], list)
+            assert d["total_delta_bytes"] == (
+                d["total_after_bytes"] - d["total_before_bytes"])
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    exp.url("/memory_profile?diff=99"))
+            assert e.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    exp.url("/memory_profile?diff=abc"))
+            assert e.value.code == 400
+        finally:
+            exp.close()

@@ -1230,9 +1230,17 @@ def _serving_rider():
     sizes draw 1..max — the size variance the pad-waste A/B regime is
     defined over) / BENCH_SV_PERIOD_MS / BENCH_SV_WAIT_MS (batcher
     max-wait) / BENCH_SV_TIMEOUT_MS (per-request deadline) /
-    BENCH_SV_RAGGED_TILE (packed tile rows) / BENCH_SV_CONT (=1,
-    continuous A/B on) / BENCH_SV_CONT_PERIOD_MS /
-    BENCH_SV_CONT_CAPTURE_MS (scheduler cadence for the A/B)."""
+    BENCH_SV_RAGGED_TILE (packed tile rows) / BENCH_SV_RAGGED_SMALL
+    (dual small tile, 0 = off) / BENCH_SV_FAMILIES (=1: PQ + BQ +
+    mesh ragged legs) / BENCH_SV_MESH_SHARDS (mesh-leg device floor)
+    / BENCH_SV_CONT (=1, continuous A/B on) / BENCH_SV_CONT_PERIOD_MS
+    / BENCH_SV_CONT_CAPTURE_MS (scheduler cadence for the A/B).
+
+    PR 15 (graftragged): ``ragged_families`` legs drive the SAME
+    stream through the PQ, BQ, and mesh ragged fronts — the unified
+    ragged plan family across the index zoo — each gated on the
+    structural acceptance columns (≤ 2 executables via the dual
+    tile, tight compiles-during-load, pad waste ≤ 0.05 band)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1331,49 +1339,115 @@ def _serving_rider():
     except Exception as e:  # noqa: BLE001 — roofline probe is best-effort
         log(f"serving rider roofline probe failed ({e})")
     # ---- ragged A/B leg: the SAME stream through the packed-batch
-    # plan family — one executable (BENCH_SV_RAGGED_TILE rows),
-    # continuous admission with tile-boundary splits
+    # plan family — continuous admission with tile-boundary splits,
+    # one executable per tile (BENCH_SV_RAGGED_TILE rows, plus the
+    # optional BENCH_SV_RAGGED_SMALL dual tile — ≤ 2 total)
     ragged_tile = int(os.environ.get("BENCH_SV_RAGGED_TILE", 64))
-    ex_r = SearchExecutor(ragged_tile=ragged_tile)
-    ex_r.warmup_ragged(index, k=K, params=p)
-    sv_metrics.reset()
-    br = DynamicBatcher(ex_r, BatcherConfig(max_wait_s=max_wait_s,
-                                            full_batch_rows=256,
-                                            ragged=True))
-    clock_r = br._clock
-    backend0_r = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+    ragged_small = int(os.environ.get("BENCH_SV_RAGGED_SMALL", 0))
 
-    def submit_r(ordinal, _t):
-        return br.submit(index, blocks[ordinal], K, params=p,
-                         timeout_s=timeout_s)
+    def _ragged_executor():
+        return SearchExecutor(
+            ragged_tile=ragged_tile,
+            ragged_tile_small=ragged_small or None)
 
-    t0 = time.perf_counter()
-    handles_r = drive_open_loop(
-        submit_r, burst_schedule(n_bursts, burst, period_s,
-                                 start_s=clock_r.now()), clock_r)
-    done_r = sum(1 for h in handles_r
-                 if h.exception(timeout=30.0) is None)
-    dt_r = time.perf_counter() - t0
-    br.close()
-    snap_r = sv_metrics.snapshot()
-    der_r = snap_r["derived"]
-    e2e_r = snap_r["histograms"].get(sv_metrics.E2E, {})
-    occ_r = snap_r["occupancy"]
-    ragged_out = {
-        "tile_rows": ragged_tile,
-        "requests": len(handles_r), "completed": done_r,
-        "qps": round(done_r / dt_r, 2),
-        "p50_ms": round(e2e_r.get("p50", 0) * 1e3, 3),
-        "p95_ms": round(e2e_r.get("p95", 0) * 1e3, 3),
-        "p99_ms": round(e2e_r.get("p99", 0) * 1e3, 3),
-        "requests_per_batch": round(occ_r["requests_per_batch"], 2),
-        "rows_per_batch": round(occ_r["rows_per_batch"], 2),
-        "pad_waste_fraction": round(der_r["pad_waste_fraction"], 4),
-        "backend_compiles_during_load": (
-            tracing.get_counter(tracing.XLA_COMPILE_COUNT)
-            - backend0_r),
-        "executables": ex_r.ragged_executables(),
-    }
+    def _drive_ragged(idx, params, legs_bursts, **sub_kw):
+        """One ragged A/B leg: warm the packed executable(s), drive
+        the SAME mixed-size stream through BatcherConfig(ragged=True),
+        and report the acceptance columns (pad waste, executables,
+        compiles during load, p99 at the offered load)."""
+        ex_f = _ragged_executor()
+        ex_f.warmup_ragged(idx, k=K, params=params, **sub_kw)
+        sv_metrics.reset()
+        bf = DynamicBatcher(ex_f, BatcherConfig(max_wait_s=max_wait_s,
+                                                full_batch_rows=256,
+                                                ragged=True))
+        backend0_f = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+
+        def submit_f(ordinal, _t):
+            return bf.submit(idx, blocks[ordinal], K, params=params,
+                             timeout_s=timeout_s, **sub_kw)
+
+        t0 = time.perf_counter()
+        handles_f = drive_open_loop(
+            submit_f, burst_schedule(legs_bursts, burst, period_s,
+                                     start_s=bf._clock.now()),
+            bf._clock)
+        done_f = sum(1 for h in handles_f
+                     if h.exception(timeout=30.0) is None)
+        dt_f = time.perf_counter() - t0
+        bf.close()
+        snap_f = sv_metrics.snapshot()
+        e2e_f = snap_f["histograms"].get(sv_metrics.E2E, {})
+        occ_f = snap_f["occupancy"]
+        return {
+            "tile_rows": ragged_tile,
+            "tile_rows_small": ragged_small,
+            "requests": len(handles_f), "completed": done_f,
+            "qps": round(done_f / dt_f, 2),
+            "p50_ms": round(e2e_f.get("p50", 0) * 1e3, 3),
+            "p95_ms": round(e2e_f.get("p95", 0) * 1e3, 3),
+            "p99_ms": round(e2e_f.get("p99", 0) * 1e3, 3),
+            "requests_per_batch": round(occ_f["requests_per_batch"], 2),
+            "rows_per_batch": round(occ_f["rows_per_batch"], 2),
+            "pad_waste_fraction": round(
+                snap_f["derived"]["pad_waste_fraction"], 4),
+            "pad_waste_by_class":
+                snap_f["derived"]["pad_waste_by_class"],
+            "backend_compiles_during_load": (
+                tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+                - backend0_f),
+            "executables": ex_f.ragged_executables(),
+        }
+
+    ragged_out = _drive_ragged(index, p, n_bursts)
+
+    # ---- ragged family legs (graftragged): the SAME mixed-size
+    # stream through the PQ, BQ, and mesh ragged fronts — the whole
+    # index zoo serving from the one ragged plan family. Each leg
+    # gates the structural acceptance columns (≤ 2 executables, tight
+    # compiles-during-load, pad waste ≤ baseline + 0.05); the mesh
+    # leg needs >= BENCH_SV_MESH_SHARDS local devices (the pinned CI
+    # config forces virtual CPU devices via XLA_FLAGS) and is
+    # reported absent otherwise.
+    fam_out = {}
+    if os.environ.get("BENCH_SV_FAMILIES", "1") == "1":
+        from raft_tpu.neighbors import ivf_bq, ivf_pq
+
+        fam_bursts = max(2, n_bursts // 2)
+        log("serving rider: building PQ/BQ family-leg indexes")
+        pq_index = ivf_pq.build(None, ivf_pq.IvfPqIndexParams(
+            n_lists=n_lists, pq_dim=max(4, D // 8),
+            kmeans_n_iters=10), x)
+        # the list-major union engine is the raggable one (auto
+        # resolves to rank-major on CPU, which has no membership mask)
+        fam_out["pq"] = _drive_ragged(
+            pq_index, ivf_pq.IvfPqSearchParams(
+                n_probes=20, scan_engine="xla"), fam_bursts)
+        bq_index = ivf_bq.build(None, ivf_bq.IvfBqIndexParams(
+            n_lists=n_lists, bits=2, kmeans_n_iters=10), x)
+        fam_out["bq"] = _drive_ragged(
+            bq_index, ivf_bq.IvfBqSearchParams(
+                n_probes=20, scan_engine="xla"), fam_bursts)
+        mesh_shards = int(os.environ.get("BENCH_SV_MESH_SHARDS", 4))
+        if jax.device_count() >= mesh_shards:
+            from raft_tpu.comms import local_comms
+            from raft_tpu.distributed import ivf as dist_ivf
+
+            comms = local_comms(
+                shape=(jax.device_count(),))
+            log(f"serving rider: building {comms.size}-shard mesh "
+                "family-leg index")
+            mesh_index = dist_ivf.build(None, comms, ivf_flat.
+                                        IvfFlatIndexParams(
+                                            n_lists=n_lists,
+                                            kmeans_n_iters=10), x)
+            fam_out["mesh"] = dict(_drive_ragged(
+                mesh_index, ivf_flat.IvfFlatSearchParams(
+                    n_probes=20, scan_engine="xla"), fam_bursts),
+                shards=comms.size)
+        else:
+            log(f"serving rider: mesh family leg skipped — "
+                f"{jax.device_count()} device(s) < {mesh_shards}")
 
     # ---- continuous-capture overhead A/B (PR 12 graftfleet): the
     # SAME bucketed stream with a ContinuousCapture armed (REAL
@@ -1485,6 +1559,7 @@ def _serving_rider():
         "executables": len(ex.executable_costs()),
         "pad_waste_fraction": round(der["pad_waste_fraction"], 4),
         "ragged": ragged_out,
+        "ragged_families": fam_out,
         "continuous": cont_out,
     }
     log(f"serving rider: {out['qps']} req/s through the batcher "
@@ -1499,6 +1574,12 @@ def _serving_rider():
         f"{ragged_out['executables']} executable(s) vs "
         f"{out['executables']}, compiles during load "
         f"{ragged_out['backend_compiles_during_load']}")
+    for fam, rec in fam_out.items():
+        log(f"serving rider ragged {fam}: {rec['qps']} req/s, p99 "
+            f"{rec['p99_ms']} ms, pad waste "
+            f"{rec['pad_waste_fraction']}, {rec['executables']} "
+            f"executable(s), compiles during load "
+            f"{rec['backend_compiles_during_load']}")
     return out
 
 

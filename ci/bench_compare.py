@@ -84,6 +84,14 @@ PINNED_ENV = {
     "BENCH_SV_BURST": "8",
     "BENCH_SV_MAX_ROWS": "96",
     "BENCH_SV_RAGGED_TILE": "128",
+    # graftragged (PR 15): the dual small tile and the PQ/BQ/mesh
+    # family legs; the forced virtual CPU devices give the mesh leg
+    # its 4-shard mesh (every rider in the child sees 4 devices —
+    # single-device riders place on device 0 as before)
+    "BENCH_SV_RAGGED_SMALL": "32",
+    "BENCH_SV_FAMILIES": "1",
+    "BENCH_SV_MESH_SHARDS": "4",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
     "BENCH_SV_PERIOD_MS": "10",
     "BENCH_SV_WAIT_MS": "2",
     # generous deadline: on a loaded CI host the CPU executes batches
@@ -148,6 +156,43 @@ DEFAULT_TOLERANCES = {
     "serving.ragged.backend_compiles_during_load": {"max_increase": 5},
     "serving.ragged.executables": {"max_increase": 0},
     "serving.pad_waste_fraction": {"max_increase": 0.15},
+    # graftragged family legs (PR 15): PQ, BQ, and the 4-shard mesh
+    # serve the SAME mixed-size stream through the unified ragged plan
+    # family. Structural columns TIGHT per leg — at most the dual-tile
+    # executable pair, a near-zero during-load compile band (the
+    # packed path has no per-shape micro-programs; the small slack
+    # covers one-time lazily-created planes), pad waste inside the
+    # acceptance band — while wall-clock columns keep the wide
+    # CI-host bands.
+    "serving.ragged_families.pq.completed": {"min_ratio": 0.9},
+    "serving.ragged_families.pq.qps": {"min_ratio": 0.30},
+    "serving.ragged_families.pq.p99_ms": {"max_ratio": 4.0,
+                                          "max_increase": 50.0},
+    "serving.ragged_families.pq.pad_waste_fraction":
+        {"max_increase": 0.05},
+    "serving.ragged_families.pq.backend_compiles_during_load":
+        {"max_increase": 5},
+    "serving.ragged_families.pq.executables": {"max_increase": 0},
+    "serving.ragged_families.bq.completed": {"min_ratio": 0.9},
+    "serving.ragged_families.bq.qps": {"min_ratio": 0.30},
+    "serving.ragged_families.bq.p99_ms": {"max_ratio": 4.0,
+                                          "max_increase": 50.0},
+    "serving.ragged_families.bq.pad_waste_fraction":
+        {"max_increase": 0.05},
+    "serving.ragged_families.bq.backend_compiles_during_load":
+        {"max_increase": 5},
+    "serving.ragged_families.bq.executables": {"max_increase": 0},
+    "serving.ragged_families.mesh.completed": {"min_ratio": 0.9},
+    "serving.ragged_families.mesh.qps": {"min_ratio": 0.30},
+    "serving.ragged_families.mesh.p99_ms": {"max_ratio": 4.0,
+                                            "max_increase": 50.0},
+    "serving.ragged_families.mesh.pad_waste_fraction":
+        {"max_increase": 0.05},
+    "serving.ragged_families.mesh.backend_compiles_during_load":
+        {"max_increase": 5},
+    "serving.ragged_families.mesh.executables": {"max_increase": 0},
+    "serving.ragged_families.mesh.shards": {"min_ratio": 1.0,
+                                            "max_increase": 0},
     # RaBitQ IVF-BQ rider: the recall floor band (the fused exact
     # rerank must keep hitting the probe-set ceiling; the
     # deterministic pinned config makes these tight), the structural

@@ -22,7 +22,13 @@ R1 has two teeth:
   ``SearchRequest``) carry the same contract — an unhashable key there
   breaks request grouping, a data-dependent one silently splits every
   micro-batch (and on the ragged path would fork the ONE packed
-  executable per load shape, resurrecting the bucket ladder).
+  executable per load shape, resurrecting the bucket ladder). The
+  mesh ragged plan keys (graftragged) extend the same discipline to
+  RETURN position: ``ragged_key``/``coalesce_key``/``packing_key``
+  functions build their tuples in the return expression, and a mesh
+  key folding in device ids or wire-knob kwargs must keep them
+  hashable statics (``tuple()``-wrapped, never a bare list display or
+  a ``float()`` of runtime data).
 
 R2 follows donated buffers: an argument donated to a jitted call
 (``donate_argnums``/``donate_argnames`` at the ``jax.jit`` site, or
@@ -114,8 +120,14 @@ def check_recompile(project: Project) -> Iterable[Finding]:
                             "use lax.scan/fori_loop"))
 
         # cache-key discipline: `_Plan(key=...)` + the serving layer's
-        # `SearchRequest(compat_key=...)`, and the named key tuples
-        # that feed either
+        # `SearchRequest(compat_key=...)`, the named key tuples that
+        # feed either, and — since the mesh ragged plan family keys on
+        # (mesh devices, params-class tuples, wire-knob kw) — every
+        # RETURN of a key-returning function (`ragged_key` /
+        # `coalesce_key` / `packing_key` / `mesh_key` spellings): a
+        # list of device ids or a float() of runtime data in a mesh
+        # ragged key is exactly as cache-fatal as in a `_Plan(key=)`
+        # expression, and those keys are built in return position
         for node in ast.walk(f.tree):
             if isinstance(node, ast.Call):
                 nm = astutil.call_name(node) or ""
@@ -128,9 +140,20 @@ def check_recompile(project: Project) -> Iterable[Finding]:
                         and isinstance(node.targets[0], ast.Name)
                         and node.targets[0].id in (
                             "key", "cache_key", "coalesce_key",
-                            "compat_key", "ragged_key", "packing_key")
+                            "compat_key", "ragged_key", "packing_key",
+                            "mesh_ragged_key", "mesh_key")
                         and isinstance(node.value, ast.Tuple)):
                     _check_key_expr(f, node.value, out)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                if node.name in ("ragged_key", "coalesce_key",
+                                 "packing_key", "mesh_ragged_key"):
+                    for stmt in ast.walk(node):
+                        if (isinstance(stmt, ast.Return)
+                                and isinstance(stmt.value, (
+                                    ast.Tuple, ast.BinOp)
+                                    + _BANNED_DISPLAYS)):
+                            _check_key_expr(f, stmt.value, out)
     return out
 
 

@@ -74,6 +74,21 @@ rows) to the params axis (paid once, in compiles). See
 admits continuously into the open packed tile and splits requests at
 tile boundaries.
 
+**One ragged family for the whole index zoo (PR 15, graftragged).**
+The ragged plan DERIVES from each family's bucketed plan
+(:meth:`SearchExecutor._plan_ragged`): same arrays, statics, probe
+plumbing, shardings and donation split, with the serving fn swapped
+for a thin wrapper that turns on the ``row_probes`` budget hook in
+the SAME search body. Every IVF family — flat, PQ, BQ, single-chip
+and list-sharded mesh — serves ragged through the one shared
+dispatch core; the per-family bucketed plan paths shrank to the
+documented non-raggable residue (see :meth:`SearchExecutor
+.ragged_fallback_reason`). An opt-in small/large dual tile
+(``ragged_tile_small``) cuts partial-tile pad at light load without
+forking the params-class ladder: the tile is selected per dispatch
+by packed-row count and never joins :meth:`~SearchExecutor
+.ragged_key`.
+
 Small print: padding/slicing a batch to/from its bucket executes tiny
 device ops whose programs XLA caches per distinct batch size — the
 *search* program itself never recompiles, and once a batch size has
@@ -367,11 +382,19 @@ class SearchExecutor:
         one device fetch per plane per scrape, never per dispatch).
         Default off: enabling changes the compiled signature, so it is
         part of the executable cache key.
-      ragged_tile: row count of the ragged plan family's ONE packed
-        batch shape (:meth:`search_ragged`). Every ragged dispatch
-        runs ``(ragged_tile, dim)`` queries — under load the serving
+      ragged_tile: row count of the ragged plan family's packed batch
+        shape (:meth:`search_ragged`). Every ragged dispatch runs
+        ``(ragged_tile, dim)`` queries — under load the serving
         batcher keeps the tile full via tile-boundary splits, so pad
         waste collapses to timer-fired partial tiles.
+      ragged_tile_small: opt-in SMALL tile of the dual-tile pair
+        (e.g. 64 next to a 512 large tile): a packed batch whose
+        total rows fit it dispatches through the small executable,
+        cutting partial-tile pad at light load. Tile selection is a
+        dispatch-time row-count check — both tiles share one
+        :meth:`ragged_key`, so the params-class ladder does not fork
+        and steady state stays at ≤ 2 executables per (index shapes,
+        params class). Default off (one tile).
     """
 
     def __init__(self, res: Optional[Resources] = None, *,
@@ -379,7 +402,8 @@ class SearchExecutor:
                  max_entries: int = 64, donate: Optional[bool] = None,
                  mesh_trace: bool = False,
                  probe_accounting: bool = False,
-                 ragged_tile: int = 256):
+                 ragged_tile: int = 256,
+                 ragged_tile_small: Optional[int] = None):
         self.res = ensure_resources(res)
         expect(0 < min_bucket <= max_bucket,
                f"need 0 < min_bucket <= max_bucket, got "
@@ -396,11 +420,20 @@ class SearchExecutor:
             donate = jax.default_backend() not in ("cpu",)
         self.donate = donate
         expect(ragged_tile > 0, "ragged_tile must be > 0")
-        # the ragged plan family's ONE packed-batch shape: every
-        # ragged dispatch runs (ragged_tile, dim) queries, so one AOT
-        # entry per (index shapes, params class) serves every load
-        # shape — the bucket ladder collapsed to a single executable
+        expect(ragged_tile_small is None
+               or 0 < ragged_tile_small < ragged_tile,
+               "ragged_tile_small must be in (0, ragged_tile)")
+        # the ragged plan family's packed-batch shape(s): every ragged
+        # dispatch runs (tile, dim) queries, so one AOT entry per
+        # (index shapes, params class, tile) serves every load shape —
+        # the bucket ladder collapsed to one executable, or two with
+        # the opt-in dual tile (ragged_tile_small): a packed batch
+        # that fits the small tile dispatches through it, cutting
+        # partial-tile pad at light load WITHOUT forking the params
+        # class (both tiles share one ragged_key, so admission
+        # grouping and warmup are tile-oblivious)
         self.ragged_tile = ragged_tile
+        self.ragged_tile_small = ragged_tile_small
         self.mesh_trace = mesh_trace
         self.probe_accounting = probe_accounting
         # graftgauge probe-frequency planes: pkey -> device counter
@@ -562,10 +595,22 @@ class SearchExecutor:
                    **kw) -> Optional[tuple]:
         """Hashable packing key for the ragged continuous-batching
         path, or ``None`` when this (index, params, k) combination is
-        not servable ragged (non-IVF-flat families, approx coarse
-        select, the legacy rank-major engine, family-specific kwargs)
-        — the caller then falls back to :meth:`coalesce_key` and the
-        bucketed path.
+        not servable ragged — the caller then falls back to
+        :meth:`coalesce_key` and the bucketed path
+        (:meth:`ragged_fallback_reason` names why).
+
+        Raggable: every IVF family — flat, PQ, BQ, single-chip AND
+        list-sharded mesh — through its membership-masked list-major
+        engine with exact coarse select. The documented non-raggable
+        residue: CAGRA (seeds draw per absolute row),
+        ``coarse_algo="approx"`` (no prefix property at the class
+        cap), the rank-major engines (no membership mask), codes-only
+        BQ (resolves to the rank estimate scan), brute force (no
+        probe plane), ``TieredIvf`` (the dual-tier fetch plan is
+        placement-epoch state — see :meth:`ragged_fallback_reason`),
+        the int8 probe wire (its per-query scales depend on the
+        candidate block, breaking cap-vs-solo bit-identity), and 2-D
+        query-sharded mesh grids.
 
         Two submissions may share one packed ragged batch iff their
         keys are equal. Unlike :meth:`coalesce_key`, ``n_probes`` and
@@ -573,46 +618,102 @@ class SearchExecutor:
         power-of-two *params class* (``n_probes`` resolves per row
         through the engines' membership mask, ``k`` through a
         caller-side column slice), so mixed-``n_probes``/``k`` traffic
-        under one class cap shares ONE executable. The degradation
-        ladder's params override feeds this key like any other params
-        (the batcher applies it before keying), so a degraded
+        under one class cap shares ONE executable (two with the
+        opt-in dual tile — the tile is selected at dispatch and is
+        deliberately NOT part of this key). The degradation ladder's
+        params override feeds this key like any other params (the
+        batcher applies it before keying), so a degraded
         specialization that changes only ``n_probes`` keeps packing
-        with live traffic."""
+        with live traffic. Mesh keys fold the wire knobs in through
+        ``kw`` — mesh devices and params-class tuples stay hashable
+        statics (graftlint R1 covers this construction)."""
         fw = self._resolve_filter(sample_filter)
-        spec = self._ragged_spec(index, k, params, fw, kw)
+        spec, _ = self._ragged_resolve(index, k, params, fw, kw)
         if spec is None:
             return None
-        return (id(index), "ivf_flat_ragged", str(index.metric),
-                spec["engine"], spec["np_class"], spec["k_class"],
-                _filter_spec(fw))
+        return (id(index), spec["family"] + "_ragged",
+                str(index.metric), spec["engine"], spec["np_class"],
+                spec["k_class"], _filter_spec(fw),
+                tuple(sorted((n, str(v)) for n, v in kw.items())))
+
+    def ragged_fallback_reason(self, index, k: int, params=None,
+                               sample_filter=None, **kw) -> Optional[str]:
+        """Why this (index, params, k) combination is NOT servable by
+        the ragged plan family (``None`` when it is) — the explicit
+        plan-key reason the serving batcher's bucketed fallback can be
+        pinned against. The strings are stable test surface: each
+        names the residue class, not the call site."""
+        fw = self._resolve_filter(sample_filter)
+        _, reason = self._ragged_resolve(index, k, params, fw, kw)
+        return reason
 
     def warmup_ragged(self, index, *, k: int, params=None,
                       sample_filter=None, **kw) -> float:
-        """AOT-compile the ONE ragged executable of this (index,
-        params-class) — the whole warmup the ragged path needs, where
-        the bucketed ladder compiled one executable per bucket.
-        Raises on combinations :meth:`ragged_key` would refuse."""
+        """AOT-compile the ragged executable(s) of this (index,
+        params-class) — one per configured tile (a single tile by
+        default, the small+large pair with ``ragged_tile_small``) —
+        the whole warmup the ragged path needs, where the bucketed
+        ladder compiled one executable per bucket. Raises on
+        combinations :meth:`ragged_key` would refuse."""
         fw = self._resolve_filter(sample_filter)
-        spec = self._ragged_spec(index, k, params, fw, kw)
+        spec, reason = self._ragged_resolve(index, k, params, fw, kw)
         expect(spec is not None,
                "index/params combination is not servable by the ragged "
-               "plan family (see SearchExecutor.ragged_key)")
+               f"plan family: {reason}")
         t0 = time.perf_counter()
-        plan = self._plan_ivf_flat_ragged(index, fw, spec)
-        self._get_entry(plan, self.ragged_tile, spec["k_class"])
+        for tile in self._ragged_tiles():
+            plan = self._plan_ragged(index, fw, spec, tile)
+            self._get_entry(plan, tile, spec["k_class"])
         dt = time.perf_counter() - t0
         self.stats.warmup_seconds += dt
         tracing.inc_counter("serving.warmup_seconds", dt)
         return dt
+
+    def _place_ragged_chunk(self, plan: _Plan, qt, rpt):
+        """One packed tile's operands, placed for the plan: mesh
+        ragged plans put the tile and its budget plane replicated in
+        ONE batched transfer (exactly one placement per dispatched
+        tile — the same per-dispatch transfer the bucketed mesh path
+        pays); single-chip plans pass host arrays straight through
+        (the compiled call owns the transfer)."""
+        rpt = jnp.asarray(rpt)
+        if plan.qsharding is None:
+            return qt, rpt
+        return jax.device_put([jnp.asarray(qt, plan.qdtype), rpt],
+                              [plan.qsharding, plan.qsharding])
+
+    def _ragged_tiles(self) -> Tuple[int, ...]:
+        """The configured packed-tile ladder, small first (≤ 2 — the
+        dual-tile acceptance bound is structural)."""
+        if self.ragged_tile_small is not None:
+            return (self.ragged_tile_small, self.ragged_tile)
+        return (self.ragged_tile,)
+
+    def _ragged_tile_for(self, total: int) -> int:
+        """Dispatch-time tile selection: the small tile iff the whole
+        packed batch fits it — a host-side row-count check, so the
+        choice costs nothing and never forks the packing key."""
+        small = self.ragged_tile_small
+        if small is not None and total <= small:
+            return small
+        return self.ragged_tile
 
     def search_ragged(self, index, blocks, ks, params_list=None,
                       sample_filter=None,
                       trace_ids: Tuple[int, ...] = (), **kw):
         """Packed ragged-batch entry point: run several requests'
         query blocks — possibly with DIFFERENT per-request ``k`` and
-        ``params.n_probes`` — as packed ``(ragged_tile, dim)`` calls
-        of ONE compiled executable, and split the results back per
-        block.
+        ``params.n_probes`` — as packed ``(tile, dim)`` calls of ONE
+        compiled executable (per configured tile), and split the
+        results back per block. Serves every raggable family through
+        the same locked dispatch core: single-chip IVF flat/PQ/BQ and
+        the list-sharded mesh families (whose packed tile and budget
+        plane place replicated, with the donated per-shard top-k
+        state and the list-sharded probe plane threaded exactly as
+        bucketed mesh plans thread them; ``kw`` carries the mesh wire
+        knobs). ``mesh_trace`` span recording is a bucketed-dispatch
+        feature — ragged mesh dispatches skip it (the batcher's stage
+        spans still cover the packed call).
 
         ``blocks`` is a sequence of (m_j, dim) query arrays; ``ks``
         and ``params_list`` give each block's ``k`` / search params (a
@@ -649,13 +750,23 @@ class SearchExecutor:
         expect(len(ks) == n and len(params_list) == n,
                "ks/params_list must match blocks")
         fw = self._resolve_filter(sample_filter)
-        specs = [self._ragged_spec(index, kj, pj, fw, kw)
-                 for kj, pj in zip(ks, params_list)]
-        expect(all(s is not None for s in specs),
-               "a block is not servable by the ragged plan family "
-               "(see SearchExecutor.ragged_key)")
-        classes = {(s["engine"], s["np_class"], s["k_class"])
-                   for s in specs}
+        # blocks repeat few distinct (params, k) pairs, and resolution
+        # builds a base plan (one resolution authority — see
+        # _ragged_resolve): memoize per distinct pair so a packed
+        # dispatch of n blocks resolves once per pair, not n times
+        memo: dict = {}
+        specs = []
+        for kj, pj in zip(ks, params_list):
+            mk = (pj, kj)
+            if mk not in memo:
+                memo[mk] = self._ragged_resolve(index, kj, pj, fw, kw)
+            s, reason = memo[mk]
+            expect(s is not None,
+                   "a block is not servable by the ragged plan "
+                   f"family: {reason}")
+            specs.append(s)
+        classes = {(s["family"], s["engine"], s["np_class"],
+                    s["k_class"]) for s in specs}
         expect(len(classes) == 1,
                "blocks must agree on the ragged params class — group "
                "submissions by SearchExecutor.ragged_key")
@@ -672,8 +783,8 @@ class SearchExecutor:
         if fw is not None and fw.ndim == 2:
             expect(int(fw.shape[0]) == total,
                    "2-D filter rows must match the packed query rows")
-        tile = self.ragged_tile
-        plan = self._plan_ivf_flat_ragged(index, fw, spec)
+        tile = self._ragged_tile_for(total)
+        plan = self._plan_ragged(index, fw, spec, tile)
 
         # host-side packing: adjacent blocks, zero pad rows, per-row
         # probe budgets (0 on pads). numpy blocks (the serving path)
@@ -700,19 +811,34 @@ class SearchExecutor:
         if fw is not None and fw.ndim == 2 and padded_total > total:
             fwp = self._pad(fw, padded_total, fw.dtype)
 
+        # pad-waste attribution: the aggregate serving.execute.rows /
+        # .padded_rows counters (bumped per dispatch in the locked
+        # core) additionally split per (params class, tile) here, so
+        # metrics.derived()["pad_waste_by_class"] and the exporter's
+        # labeled family attribute waste to the small-vs-large tile
+        # choice. Class labels are pow2-bounded, tiles ≤ 2 — the
+        # counter-name cardinality is structural, not client-driven.
+        split = (f"p{spec['np_class']}.t{tile}")
         parts_d, parts_i, raw = [], [], []
         with self._lock:
             for start in range(0, padded_total, tile):
                 q_real = min(total - start, tile)
-                args = [packed[start:start + tile],
-                        jnp.asarray(row_probes[start:start + tile])]
+                qt, rpt = self._place_ragged_chunk(
+                    plan, packed[start:start + tile],
+                    row_probes[start:start + tile])
+                args = [qt, rpt]
                 args.extend(plan.post)
-                fwt = fwp
-                if fwp is not None and fwp.ndim == 2:
-                    fwt = fwp[start:start + tile]
-                args.append(fwt)
+                if plan.use_filter:
+                    fwt = fwp
+                    if fwp is not None and fwp.ndim == 2:
+                        fwt = fwp[start:start + tile]
+                    args.append(fwt)
                 _, out_d, out_i, _ = self._execute_entry_locked(
                     plan, tile, k_class, args, q_real)
+                tracing.inc_counters({
+                    f"serving.execute.rows.{split}": q_real,
+                    f"serving.execute.padded_rows.{split}": tile,
+                })
                 if plan.has_state:
                     # donated-state (xla) engine: the outputs ARE the
                     # state the next chunk (or the next caller)
@@ -751,64 +877,186 @@ class SearchExecutor:
             row += m
         return out
 
-    def _ragged_spec(self, index, k: int, params, fw, kw):
-        """Resolve one request onto the ragged plan family: the
-        engine + power-of-two class caps, or None when the request
-        must stay on the bucketed path. Raggable today: the IVF-flat
-        family through the list-major engines with exact coarse
-        select (only the exact coarse top-k has the prefix property
-        per-row budgets rely on; the rank-major engine has no
-        membership mask to resolve them through)."""
-        from raft_tpu.neighbors.ivf_flat import (
-            IvfFlatIndex,
-            IvfFlatSearchParams,
-        )
-        from raft_tpu.ops.ivf_scan import resolve_scan_engine
+    # the documented non-raggable residue, as stable reason strings —
+    # what ragged_fallback_reason returns and the fallback tests pin
+    _RAGGED_RESIDUE = {
+        "tiered": "tiered_ivf: the dual-tier fetch plan is "
+                  "placement-epoch state (hot/cold slot maps swap "
+                  "between dispatches) — bucketed path",
+        "cagra": "cagra: seeds draw per absolute row — per-block "
+                 "bucketed dispatch",
+        "brute_force": "brute_force: no probe plane to budget per "
+                       "row — bucketed path",
+        "approx": "coarse_algo='approx' has no prefix property at "
+                  "the class cap — bucketed path",
+        "rank": "scan_engine resolved to the rank-major scan, which "
+                "has no membership mask — bucketed path",
+        "kw": "family-specific kwargs stay on the bucketed path",
+        "empty": "empty index or k <= 0 — bucketed path",
+        "int8_probe_wire": "probe_wire_dtype='int8' scales depend on "
+                           "the candidate block, breaking cap-vs-solo "
+                           "bit-identity — bucketed path",
+        "query_axis": "query_axis grids serve through the direct "
+                      "distributed entry points — bucketed path",
+        "dist_filter": "distributed searches have no sample_filter "
+                       "support",
+        "family": "index family has no ragged front — bucketed path",
+    }
 
-        if not isinstance(index, IvfFlatIndex) or kw:
-            return None
-        params = params or IvfFlatSearchParams()
-        if params.coarse_algo != "exact" or params.scan_engine == "rank":
-            return None
-        if index.max_list_size <= 0 or k <= 0:
-            return None
+    def _ragged_resolve(self, index, k: int, params, fw, kw):
+        """Resolve one request onto the ragged plan family:
+        ``(spec, None)`` with the family tag, resolved engine and
+        power-of-two class caps, or ``(None, reason)`` when the
+        request must stay on the bucketed path. ONE resolver covers
+        every raggable family — flat/PQ/BQ, single-chip and mesh —
+        because the plan itself derives from the family's bucketed
+        plan (:meth:`_plan_ragged`); only raggability and the class
+        rounding live here."""
+        from raft_tpu.distributed.bq import DistributedIvfBq
+        from raft_tpu.distributed.ivf import (
+            DistributedIvfFlat,
+            DistributedIvfPq,
+        )
+        from raft_tpu.neighbors import ivf_bq, ivf_flat, ivf_pq
+        from raft_tpu.neighbors.tiered import TieredIvf
+
+        reasons = self._RAGGED_RESIDUE
+        families = (
+            (TieredIvf, None, None, "tiered"),
+            (DistributedIvfFlat, "dist_ivf_flat",
+             ivf_flat.IvfFlatSearchParams, None),
+            (DistributedIvfPq, "dist_ivf_pq",
+             ivf_pq.IvfPqSearchParams, None),
+            (DistributedIvfBq, "dist_ivf_bq",
+             ivf_bq.IvfBqSearchParams, None),
+            (ivf_flat.IvfFlatIndex, "ivf_flat",
+             ivf_flat.IvfFlatSearchParams, None),
+            (ivf_pq.IvfPqIndex, "ivf_pq",
+             ivf_pq.IvfPqSearchParams, None),
+            (ivf_bq.IvfBqIndex, "ivf_bq", ivf_bq.IvfBqSearchParams,
+             None),
+        )
+        family = params_cls_type = None
+        for typ, fam, pcls, refusal in families:
+            if isinstance(index, typ):
+                if refusal is not None:
+                    return None, reasons[refusal]
+                family, params_cls_type = fam, pcls
+                break
+        if family is None:
+            from raft_tpu.neighbors.cagra import CagraIndex
+
+            if isinstance(index, CagraIndex):
+                return None, reasons["cagra"]
+            from raft_tpu.neighbors.brute_force import BruteForceIndex
+
+            if isinstance(index, BruteForceIndex):
+                return None, reasons["brute_force"]
+            return None, reasons["family"]
+        mesh = family.startswith("dist_")
+        if mesh:
+            if kw.get("query_axis") is not None:
+                return None, reasons["query_axis"]
+            if kw.get("probe_wire_dtype", "f32") == "int8":
+                return None, reasons["int8_probe_wire"]
+            if not set(kw) <= {"probe_mode", "wire_dtype",
+                               "probe_wire_dtype"}:
+                return None, reasons["kw"]
+            if fw is not None:
+                return None, reasons["dist_filter"]
+        elif kw:
+            return None, reasons["kw"]
+        params = params or params_cls_type()
+        if params.coarse_algo != "exact":
+            return None, reasons["approx"]
+        if params.scan_engine == "rank":
+            return None, reasons["rank"]
+        # DistributedIvfBq carries no max_list_size property; its
+        # packed-codes extent plays the same role
+        extent = getattr(index, "max_list_size", None)
+        if extent is None:
+            extent = index.codes.shape[1]
+        if extent <= 0 or k <= 0:
+            return None, reasons["empty"]
         n_probes = min(params.n_probes, index.n_lists)
         np_class = min(_pow2_at_least(n_probes, 8), index.n_lists)
         k_class = _pow2_at_least(k, 8)
-        engine = resolve_scan_engine(params.scan_engine, data=index.data,
-                                     filter_words=fw, k=k_class)
-        if engine not in ("pallas", "xla"):
-            return None
-        return {"n_probes": n_probes, "np_class": np_class,
-                "k_class": k_class, "engine": engine}
+        # the resolved engine comes from the family's OWN bucketed
+        # plan at the class caps — one resolution authority, so the
+        # raggability decision and the compiled plan cannot disagree
+        params_cls = dataclasses.replace(params, n_probes=np_class)
+        base = self._plan(index, params_cls, k_class, self.buckets[0],
+                          fw, kw)
+        engine = base.static["scan_engine"]
+        if engine not in (("xla",) if family.endswith("ivf_pq")
+                          else ("pallas", "xla")):
+            return None, reasons["rank"]
+        return {"family": family, "engine": engine,
+                "np_class": np_class, "k_class": k_class,
+                "n_probes": n_probes, "params_cls": params_cls,
+                "kw": kw}, None
 
-    def _plan_ivf_flat_ragged(self, index, fw, spec) -> _Plan:
-        from raft_tpu.neighbors import ivf_flat as m
+    # family tag -> (module, attr) of the packed ragged-batch twin of
+    # that family's bucketed serving fn — each a thin wrapper over the
+    # SAME search body with the per-row budget hook live, so the two
+    # paths cannot drift. Module paths (not objects): the mapping must
+    # not force the distributed imports at module load
+    _RAGGED_FNS = {
+        "ivf_flat": ("raft_tpu.neighbors.ivf_flat",
+                     "_search_ragged_fn"),
+        "ivf_pq": ("raft_tpu.neighbors.ivf_pq", "_search_ragged_fn"),
+        "ivf_bq": ("raft_tpu.neighbors.ivf_bq", "_search_ragged_fn"),
+        "dist_ivf_flat": ("raft_tpu.distributed.ivf",
+                          "_dist_search_ragged_fn"),
+        "dist_ivf_pq": ("raft_tpu.distributed.ivf",
+                        "_dist_search_ragged_pq_fn"),
+        "dist_ivf_bq": ("raft_tpu.distributed.bq",
+                        "_dist_search_ragged_bq_fn"),
+    }
 
-        static = {"n_probes": spec["np_class"], "k": spec["k_class"],
-                  "metric": index.metric,
-                  "scan_engine": spec["engine"]}
-        arrays = (index.centers, index.center_norms, index.data,
-                  index.data_norms, index.indices)
-        key = ("ivf_flat_ragged", self.ragged_tile, _sig(*arrays),
-               tuple(sorted((n, str(v)) for n, v in static.items())),
-               _filter_spec(fw))
-        # probe planes are shared with the bucketed plans (same pkey),
-        # so one cumulative histogram covers an index however its
-        # traffic splits across the two path families
-        key, probe = self._probe_plumbing(index, "ivf_flat", key)
-        return _Plan(key=key, fn=m._search_ragged_fn, static=static,
-                     post=arrays, use_filter=True, qdim=index.dim,
-                     has_state=spec["engine"] != "pallas", probe=probe,
-                     ragged=True)
+    def _ragged_fn(self, family: str) -> Callable:
+        """Resolve one family's ragged serving fn (:data:`_RAGGED_FNS`
+        — a missing family is a KeyError, the single point a new
+        raggable family must register at)."""
+        import importlib
 
-    def ragged_executables(self) -> int:
+        module, attr = self._RAGGED_FNS[family]
+        return getattr(importlib.import_module(module), attr)
+
+    def _plan_ragged(self, index, fw, spec, tile: int) -> _Plan:
+        """One ragged plan builder for every raggable family — THE
+        deletion this PR exists for: the plan DERIVES from the
+        family's bucketed plan at the params-class caps (same arrays,
+        same statics minus the pinned-exact ``coarse_algo``, same
+        probe plumbing, same shardings/donation/payload model), with
+        the serving fn swapped for the family's ragged twin and the
+        family tag marked ``_ragged``. No per-family ragged plan code
+        paths remain — a family change lands in ONE builder and both
+        path families inherit it. Probe planes are shared with the
+        bucketed plans (same pkey), so one cumulative histogram
+        covers an index however its traffic splits across the two
+        path families."""
+        base = self._plan(index, spec["params_cls"], spec["k_class"],
+                          tile, fw, spec["kw"])
+        statics = {n: v for n, v in base.static.items()
+                   if n != "coarse_algo"}
+        key = (base.key[0] + "_ragged",) + base.key[1:]
+        return dataclasses.replace(
+            base, key=key, fn=self._ragged_fn(base.key[0]),
+            static=statics, ragged=True)
+
+    def ragged_executables(self, family: Optional[str] = None) -> int:
         """Resident ragged-plan executables — the acceptance surface
-        of the one-executable contract (steady state: exactly one per
-        (index shapes, params class) served)."""
+        of the one-executable contract (steady state: at most one per
+        (index shapes, params class) per configured tile — ≤ 2 per
+        family with the dual tile). ``family`` filters to one family
+        tag (e.g. ``"dist_ivf_bq"``)."""
         with self._lock:
-            return sum(1 for key in self._cache
-                       if key and key[0] == "ivf_flat_ragged")
+            return sum(
+                1 for key in self._cache
+                if key and isinstance(key[0], str)
+                and key[0].endswith("_ragged")
+                and (family is None or key[0] == family + "_ragged"))
 
     # -- internals ----------------------------------------------------------
 
@@ -1383,13 +1631,9 @@ class SearchExecutor:
             return self._plan_ivf_bq(index, params, k, bucket, fw, kw)
         if isinstance(index, CagraIndex):
             return self._plan_cagra(index, params, k, bucket, fw, kw)
-        if isinstance(index, DistributedIvfFlat):
-            return self._plan_dist_ivf_flat(index, params, k, bucket, fw,
-                                            kw)
-        if isinstance(index, DistributedIvfPq):
-            return self._plan_dist_ivf_pq(index, params, k, bucket, fw, kw)
-        if isinstance(index, DistributedIvfBq):
-            return self._plan_dist_ivf_bq(index, params, k, bucket, fw, kw)
+        if isinstance(index, (DistributedIvfFlat, DistributedIvfPq,
+                              DistributedIvfBq)):
+            return self._plan_dist(index, params, k, bucket, fw, kw)
         raise TypeError(f"SearchExecutor does not support {type(index)!r}")
 
     def _dist_statics(self, index, kw) -> tuple:
@@ -1416,138 +1660,96 @@ class SearchExecutor:
                "distributed search entry points for query_axis grids")
         return comms, probe_mode, wire_dtype, probe_wire_dtype
 
-    def _plan_dist_ivf_flat(self, index, params, k, bucket, fw, kw) -> _Plan:
-        from raft_tpu.distributed import ivf as dist_ivf
-        from raft_tpu.neighbors import ivf_flat as m
-        from raft_tpu.ops.ivf_scan import resolve_scan_engine
-
-        expect(fw is None,
-               "distributed searches have no sample_filter support")
-        params = params or m.IvfFlatSearchParams()
-        (comms, probe_mode, wire_dtype,
-         probe_wire_dtype) = self._dist_statics(index, kw)
-        n_probes = dist_ivf.resolve_probe_budget(
-            params.n_probes, index.n_lists, comms.size, probe_mode)
-        engine = resolve_scan_engine(params.scan_engine, data=index.data,
-                                     k=k)
-        static = {"axis": comms.axis, "mesh": comms.mesh,
-                  "n_probes": n_probes, "k": k, "metric": index.metric,
-                  "probe_mode": probe_mode,
-                  "coarse_algo": params.coarse_algo,
-                  "scan_engine": engine, "wire_dtype": wire_dtype,
-                  "probe_wire_dtype": probe_wire_dtype}
-        arrays = (index.centers, index.data, index.data_norms,
-                  index.indices)
-        key = ("dist_ivf_flat", bucket, _mesh_key(comms), _sig(*arrays),
-               tuple(sorted((n, str(v)) for n, v in static.items())),
-               _filter_spec(None))
-        key, probe = self._probe_plumbing(
-            index, "dist_ivf_flat", key,
-            sharding=comms.sharding(comms.axis))
-        # same engine/donation split as the single-chip ivf_flat plan:
-        # the rank and XLA list-major scans thread the donated per-shard
-        # (q, k) state through HBM; the Pallas kernel keeps it in VMEM
-        return _Plan(key=key, fn=dist_ivf._dist_search_fn, static=static,
-                     post=arrays, qdim=index.dim,
-                     has_state=engine != "pallas", sharded=True,
-                     probe=probe,
-                     qsharding=comms.replicated(),
-                     state_sharding=comms.replicated(),
-                     payload=("dist_ivf_flat",
-                              lambda: dist_ivf.collective_payload_model(
-                                  bucket, k, n_probes, index.n_lists,
-                                  comms.size, wire_dtype, probe_mode,
-                                  probe_wire_dtype)))
-
-    def _plan_dist_ivf_pq(self, index, params, k, bucket, fw, kw) -> _Plan:
-        from raft_tpu.distributed import ivf as dist_ivf
-        from raft_tpu.neighbors import ivf_pq as m
-
-        expect(fw is None,
-               "distributed searches have no sample_filter support")
-        params = params or m.IvfPqSearchParams()
-        (comms, probe_mode, wire_dtype,
-         probe_wire_dtype) = self._dist_statics(index, kw)
-        n_probes = dist_ivf.resolve_probe_budget(
-            params.n_probes, index.n_lists, comms.size, probe_mode)
-        engine = m.resolve_scan_engine(params.scan_engine)
-        score_mode = m.resolve_score_mode(params.score_mode,
-                                          index.codebooks.shape[1])
-        static = {"axis": comms.axis, "mesh": comms.mesh,
-                  "n_probes": n_probes, "k": k, "metric": index.metric,
-                  "probe_mode": probe_mode,
-                  "codebook_kind": index.codebook_kind,
-                  "score_mode": score_mode, "lut_dtype": params.lut_dtype,
-                  "coarse_algo": params.coarse_algo,
-                  "scan_engine": engine, "wire_dtype": wire_dtype,
-                  "probe_wire_dtype": probe_wire_dtype}
-        arrays = (index.centers, index.rotation, index.codebooks,
-                  index.codes, index.indices)
-        key = ("dist_ivf_pq", bucket, _mesh_key(comms), _sig(*arrays),
-               tuple(sorted((n, str(v)) for n, v in static.items())),
-               _filter_spec(None))
-        key, probe = self._probe_plumbing(
-            index, "dist_ivf_pq", key,
-            sharding=comms.sharding(comms.axis))
-        return _Plan(key=key, fn=dist_ivf._dist_search_pq_fn,
-                     static=static, post=arrays, qdim=index.dim,
-                     sharded=True, probe=probe,
-                     qsharding=comms.replicated(),
-                     state_sharding=comms.replicated(),
-                     payload=("dist_ivf_pq",
-                              lambda: dist_ivf.collective_payload_model(
-                                  bucket, k, n_probes, index.n_lists,
-                                  comms.size, wire_dtype, probe_mode,
-                                  probe_wire_dtype)))
-
-    def _plan_dist_ivf_bq(self, index, params, k, bucket, fw, kw) -> _Plan:
+    def _plan_dist(self, index, params, k, bucket, fw, kw) -> _Plan:
+        """ONE plan builder for the three list-sharded families —
+        they share everything but the per-family statics/arrays, so
+        the shared mesh plumbing (probe budget, mesh key, replicated
+        query/state shardings, list-sharded probe plane, payload
+        model) lives exactly once. The ragged plan family derives
+        from this same builder (:meth:`_plan_ragged`), which is what
+        retired the per-family bucketed/ragged plan-path copies."""
         from raft_tpu.distributed import bq as dist_bq
         from raft_tpu.distributed import ivf as dist_ivf
-        from raft_tpu.neighbors import ivf_bq as m
-
-        from raft_tpu.ops.bq_scan import resolve_bq_engine
+        from raft_tpu.distributed.ivf import DistributedIvfFlat, \
+            DistributedIvfPq
+        from raft_tpu.neighbors import ivf_bq, ivf_flat, ivf_pq
 
         expect(fw is None,
                "distributed searches have no sample_filter support")
-        params = params or m.IvfBqSearchParams()
         (comms, probe_mode, wire_dtype,
          probe_wire_dtype) = self._dist_statics(index, kw)
-        n_probes = dist_ivf.resolve_probe_budget(
-            params.n_probes, index.n_lists, comms.size, probe_mode)
-        engine = resolve_bq_engine(
-            params.scan_engine, data=index.data, filter_words=None,
-            k=k, dim_ext=index.dim_ext, bits=index.bits,
-            n_probes=n_probes)
+        if isinstance(index, DistributedIvfFlat):
+            from raft_tpu.ops.ivf_scan import resolve_scan_engine
+
+            family, fn = "dist_ivf_flat", dist_ivf._dist_search_fn
+            params = params or ivf_flat.IvfFlatSearchParams()
+            n_probes = dist_ivf.resolve_probe_budget(
+                params.n_probes, index.n_lists, comms.size, probe_mode)
+            engine = resolve_scan_engine(params.scan_engine,
+                                         data=index.data, k=k)
+            extra, key_extra = {}, ()
+            arrays = (index.centers, index.data, index.data_norms,
+                      index.indices)
+            # same engine/donation split as the single-chip plans: the
+            # rank and XLA list-major scans thread the donated
+            # per-shard (q, k) state through HBM; the Pallas kernel
+            # keeps it in VMEM scratch
+            has_state = engine != "pallas"
+        elif isinstance(index, DistributedIvfPq):
+            family, fn = "dist_ivf_pq", dist_ivf._dist_search_pq_fn
+            params = params or ivf_pq.IvfPqSearchParams()
+            n_probes = dist_ivf.resolve_probe_budget(
+                params.n_probes, index.n_lists, comms.size, probe_mode)
+            engine = ivf_pq.resolve_scan_engine(params.scan_engine)
+            extra = {"codebook_kind": index.codebook_kind,
+                     "score_mode": ivf_pq.resolve_score_mode(
+                         params.score_mode, index.codebooks.shape[1]),
+                     "lut_dtype": params.lut_dtype}
+            key_extra = ()
+            arrays = (index.centers, index.rotation, index.codebooks,
+                      index.codes, index.indices)
+            # both PQ scan engines build their carry from the donated
+            # init buffers
+            has_state = True
+        else:
+            from raft_tpu.ops.bq_scan import resolve_bq_engine
+
+            family, fn = "dist_ivf_bq", dist_bq._dist_search_bq_fn
+            params = params or ivf_bq.IvfBqSearchParams()
+            n_probes = dist_ivf.resolve_probe_budget(
+                params.n_probes, index.n_lists, comms.size, probe_mode)
+            engine = resolve_bq_engine(
+                params.scan_engine, data=index.data, filter_words=None,
+                k=k, dim_ext=index.dim_ext, bits=index.bits,
+                n_probes=n_probes)
+            extra = {"epsilon": params.epsilon}
+            key_extra = (("data", index.data is not None),)
+            arrays = (index.centers, index.rotation, index.codes,
+                      index.rnorm, index.cfac, index.errw,
+                      index.indices, index.data, index.data_norms)
+            has_state = engine != "pallas"
         static = {"axis": comms.axis, "mesh": comms.mesh,
-                  "n_probes": n_probes, "k": k,
-                  "metric": index.metric, "probe_mode": probe_mode,
+                  "n_probes": n_probes, "k": k, "metric": index.metric,
+                  "probe_mode": probe_mode,
                   "coarse_algo": params.coarse_algo,
-                  "scan_engine": engine, "epsilon": params.epsilon,
-                  "wire_dtype": wire_dtype,
-                  "probe_wire_dtype": probe_wire_dtype}
-        arrays = (index.centers, index.rotation, index.codes,
-                  index.rnorm, index.cfac, index.errw, index.indices,
-                  index.data, index.data_norms)
-        key = ("dist_ivf_bq", bucket, _mesh_key(comms),
-               _sig(*(a for a in arrays if a is not None)),
-               ("data", index.data is not None),
-               tuple(sorted((n, str(v)) for n, v in static.items())),
+                  "scan_engine": engine, "wire_dtype": wire_dtype,
+                  "probe_wire_dtype": probe_wire_dtype, **extra}
+        key = (family, bucket, _mesh_key(comms),
+               _sig(*(a for a in arrays if a is not None))) + key_extra \
+            + (tuple(sorted((n, str(v)) for n, v in static.items())),
                _filter_spec(None))
         key, probe = self._probe_plumbing(
-            index, "dist_ivf_bq", key,
-            sharding=comms.sharding(comms.axis))
-        # rank and xla engines thread the donated per-shard running
-        # state; the Pallas kernel keeps it in VMEM scratch
-        return _Plan(key=key, fn=dist_bq._dist_search_bq_fn, static=static,
-                     post=arrays, qdim=index.dim, sharded=True,
-                     probe=probe, has_state=engine != "pallas",
+            index, family, key, sharding=comms.sharding(comms.axis))
+        return _Plan(key=key, fn=fn, static=static, post=arrays,
+                     qdim=index.dim, sharded=True, probe=probe,
+                     has_state=has_state,
                      qsharding=comms.replicated(),
                      state_sharding=comms.replicated(),
-                     payload=("dist_ivf_bq",
+                     payload=(family,
                               lambda: dist_ivf.collective_payload_model(
-                                  bucket, k, n_probes,
-                                  index.n_lists, comms.size, wire_dtype,
-                                  probe_mode, probe_wire_dtype)))
+                                  bucket, k, n_probes, index.n_lists,
+                                  comms.size, wire_dtype, probe_mode,
+                                  probe_wire_dtype)))
 
     def _plan_brute_force(self, index, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import brute_force as bf

@@ -225,7 +225,8 @@ def build_bq(
 
 def _dist_search_bq_fn(queries, centers, rotation, codes, rnorm, cfac,
                        errw, indices, data, data_norms, init_d=None,
-                       init_i=None, probe_counts=None, n_valid=None, *,
+                       init_i=None, probe_counts=None, n_valid=None,
+                       row_probes=None, *,
                        axis: str, mesh, n_probes: int, k: int,
                        metric: DistanceType,
                        probe_mode: str, query_axis=None,
@@ -243,12 +244,15 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, rnorm, cfac,
     top-k storage (values are reset here; the serving path donates
     them). ``probe_counts`` optionally provides the donated
     list-sharded (n_lists,) int32 probe-frequency plane (graftgauge —
-    owned probes only, returned as a third output). ``scan_engine``
+    owned probes only, returned as a third output) and the optional
+    ragged ``row_probes`` budget plane (see
+    :func:`raft_tpu.distributed.ivf._dist_search_fn`). ``scan_engine``
     must arrive resolved (:func:`raft_tpu.ops.bq_scan
     .resolve_bq_engine`) — it is a jit static."""
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
     ip_metric = metric == DistanceType.InnerProduct
+    ragged = row_probes is not None
 
     if init_d is None:
         init_d = jnp.full((queries.shape[0], k), pad_val, jnp.float32)
@@ -258,13 +262,16 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, rnorm, cfac,
     with_data = data is not None
 
     def body(centers_l, codes_l, rn_l, cf_l, ew_l, ids_l, *rest):
+        rest = list(rest)
         if with_data:
             data_l, dn_l = rest[0], rest[1]
             rest = rest[2:]
         else:
             data_l, dn_l = None, None
         qs, ind, ini = rest[0], rest[1], rest[2]
-        cnt, nv = (rest[3], rest[4]) if len(rest) > 3 else (None, None)
+        rest = rest[3:]
+        rp = rest.pop(0) if ragged else None
+        cnt, nv = rest if rest else (None, None)
         qf = qs.astype(jnp.float32)
         n_local = centers_l.shape[0]
 
@@ -288,6 +295,13 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, rnorm, cfac,
             local, mine = select_probes_sharded(coarse, n_probes, axis,
                                                 probe_mode, coarse_algo,
                                                 probe_wire_dtype)
+            if rp is not None:
+                from raft_tpu.ops.ivf_scan import ragged_owned
+
+                mine = ragged_owned(
+                    mine, rp,
+                    shards=(mesh.shape[axis]
+                            if probe_mode == "local" else 1))
         if cnt is not None:
             from raft_tpu.ops.ivf_scan import probe_histogram
 
@@ -345,6 +359,9 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, rnorm, cfac,
     args += [queries, init_d, init_i]
     in_specs += [qspec, qspec, qspec]
     out_specs = [qspec, qspec]
+    if ragged:
+        args += [row_probes]
+        in_specs += [P()]           # replicated per-row budget plane
     if probe_counts is not None:
         args += [probe_counts, n_valid]
         in_specs += [P(axis), P()]
@@ -369,6 +386,35 @@ _dist_search_bq = partial(jax.jit, static_argnames=(
     "axis", "mesh", "n_probes", "k", "metric", "probe_mode",
     "query_axis", "coarse_algo", "scan_engine", "epsilon", "wire_dtype",
     "probe_wire_dtype"))(_dist_search_bq_fn)
+
+
+def _dist_search_ragged_bq_fn(queries, row_probes, centers, rotation,
+                              codes, rnorm, cfac, errw, indices, data,
+                              data_norms, init_d=None, init_i=None,
+                              probe_counts=None, n_valid=None, *,
+                              axis: str, mesh, n_probes: int, k: int,
+                              metric: DistanceType, probe_mode: str,
+                              scan_engine: str = "xla",
+                              epsilon: float = 3.0,
+                              wire_dtype: str = "f32",
+                              probe_wire_dtype: str = "f32"):
+    """Packed ragged-batch mesh BQ search — see
+    :func:`raft_tpu.distributed.ivf._dist_search_ragged_fn` for the
+    replicated-tile contract. The fused estimate-then-rerank engines
+    carry exact distances, so the lean merge stays lossless at the
+    class-cap ``k`` and per-request ``k`` is the usual column slice.
+    Fused engines only (a codes-only index resolves to the rank
+    estimate scan and stays bucketed)."""
+    expect(scan_engine in ("pallas", "xla"),
+           "mesh ragged BQ serving needs a fused membership-masked "
+           f"engine (pallas|xla), got {scan_engine!r}")
+    return _dist_search_bq_fn(
+        queries, centers, rotation, codes, rnorm, cfac, errw, indices,
+        data, data_norms, init_d, init_i, probe_counts, n_valid,
+        row_probes=row_probes, axis=axis, mesh=mesh, n_probes=n_probes,
+        k=k, metric=metric, probe_mode=probe_mode, coarse_algo="exact",
+        scan_engine=scan_engine, epsilon=epsilon, wire_dtype=wire_dtype,
+        probe_wire_dtype=probe_wire_dtype)
 
 
 def search_bq(

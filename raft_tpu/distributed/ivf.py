@@ -482,7 +482,7 @@ def build(
 
 def _dist_search_fn(queries, centers, data, data_norms, indices,
                     init_d=None, init_i=None, probe_counts=None,
-                    n_valid=None, *, axis: str, mesh,
+                    n_valid=None, row_probes=None, *, axis: str, mesh,
                     n_probes: int, k: int, metric: DistanceType,
                     probe_mode: str, query_axis: Optional[str] = None,
                     coarse_algo: str = "exact", scan_engine: str = "rank",
@@ -506,18 +506,33 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
     probe counts exactly once mesh-wide) and the updated plane returns
     as a third output. Replicated-query dispatches only (the mesh
     executor's mode; a ``query_axis`` grid would write divergent
-    replicas)."""
+    replicas).
+
+    ``row_probes`` (the mesh ragged front, via
+    :func:`_dist_search_ragged_fn`) optionally provides a packed
+    ragged tile's per-row GLOBAL probe budgets (replicated ``(tile,)``
+    int32, 0 on pad rows): the probe selection then runs at the class
+    cap ``n_probes`` and each row's ownership columns past its own
+    budget fold out of ``mine``
+    (:func:`raft_tpu.ops.ivf_scan.ragged_owned`) — the scan's sentinel
+    masking, the result merge, and the probe accounting all already
+    consume that mask, so ONE replicated-tile executable serves every
+    per-request ``n_probes`` in the class, bit-identical per request
+    to the bucketed dispatch."""
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
     interpret = jax.default_backend() != "tpu"
+    ragged = row_probes is not None
 
     if init_d is None:
         init_d = jnp.full((queries.shape[0], k), pad_val, jnp.float32)
     if init_i is None:
         init_i = jnp.full((queries.shape[0], k), -1, jnp.int32)
 
-    def body(centers_l, data_l, norms_l, ids_l, qs, ind, ini,
-             cnt=None, nv=None):
+    def body(centers_l, data_l, norms_l, ids_l, qs, ind, ini, *rest):
+        rest = list(rest)
+        rp = rest.pop(0) if ragged else None
+        cnt, nv = rest if rest else (None, None)
         q = qs.shape[0]
         n_local = centers_l.shape[0]
         qf = qs.astype(jnp.float32)
@@ -544,6 +559,16 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
             local, mine = select_probes_sharded(coarse, n_probes, axis,
                                                 probe_mode, coarse_algo,
                                                 probe_wire_dtype)
+            if rp is not None:
+                # ragged: a row owns only the probe columns below its
+                # own budget (columns are rank-ordered — the prefix
+                # property); local mode converts to per-shard budgets
+                from raft_tpu.ops.ivf_scan import ragged_owned
+
+                mine = ragged_owned(
+                    mine, rp,
+                    shards=(mesh.shape[axis]
+                            if probe_mode == "local" else 1))
         if cnt is not None:
             from raft_tpu.ops.ivf_scan import probe_histogram
 
@@ -605,6 +630,9 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
     in_specs = [P(axis, None), P(axis, None, None), P(axis, None),
                 P(axis, None), qspec, qspec, qspec]
     out_specs = [qspec, qspec]
+    if ragged:
+        args += [row_probes]
+        in_specs += [P()]           # replicated per-row budget plane
     if probe_counts is not None:
         args += [probe_counts, n_valid]
         in_specs += [P(axis), P()]
@@ -633,6 +661,39 @@ _dist_search = partial(jax.jit, static_argnames=(
     "axis", "mesh", "n_probes", "k", "metric", "probe_mode", "query_axis",
     "coarse_algo", "scan_engine", "wire_dtype",
     "probe_wire_dtype"))(_dist_search_fn)
+
+
+def _dist_search_ragged_fn(queries, row_probes, centers, data, data_norms,
+                           indices, init_d=None, init_i=None,
+                           probe_counts=None, n_valid=None, *, axis: str,
+                           mesh, n_probes: int, k: int,
+                           metric: DistanceType, probe_mode: str,
+                           scan_engine: str = "xla",
+                           wire_dtype: str = "f32",
+                           probe_wire_dtype: str = "f32"):
+    """Packed ragged-batch mesh search — the distributed IVF-flat
+    member of the serving executor's ragged plan family: ONE
+    replicated-tile executable per (mesh, params class) replaces the
+    distributed bucket ladder. The packing contract is
+    :func:`raft_tpu.neighbors.ivf_flat._search_ragged_fn`'s; the
+    per-row budgets ride the replicated ``row_probes`` plane into
+    :func:`_dist_search_fn`'s ownership mask
+    (:func:`raft_tpu.ops.ivf_scan.ragged_owned`), so the sharded body
+    — probe-ownership arithmetic, sentinel-masked shard-local scan,
+    donated per-shard top-k state, list-sharded probe plane, lean
+    result merge — is char-identical to the bucketed dispatch. Exact
+    coarse select only, list-major engines only (the rank-major scan's
+    positional-tie merge is not budget-prefix-stable)."""
+    expect(scan_engine in ("pallas", "xla"),
+           "mesh ragged serving needs a membership-masked list-major "
+           f"engine (pallas|xla), got {scan_engine!r}")
+    return _dist_search_fn(
+        queries, centers, data, data_norms, indices, init_d, init_i,
+        probe_counts, n_valid, row_probes=row_probes, axis=axis,
+        mesh=mesh, n_probes=n_probes, k=k, metric=metric,
+        probe_mode=probe_mode, coarse_algo="exact",
+        scan_engine=scan_engine, wire_dtype=wire_dtype,
+        probe_wire_dtype=probe_wire_dtype)
 
 
 def search(
@@ -906,7 +967,8 @@ def build_pq(
 
 def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
                        indices, init_d=None, init_i=None,
-                       probe_counts=None, n_valid=None, *, axis: str,
+                       probe_counts=None, n_valid=None, row_probes=None,
+                       *, axis: str,
                        mesh, n_probes: int, k: int, metric: DistanceType,
                        probe_mode: str, query_axis: Optional[str] = None,
                        codebook_kind: CodebookKind = (
@@ -920,7 +982,9 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
     :func:`_dist_search_fn` (``scan_engine: xla`` is the list-major
     union scan of :mod:`raft_tpu.neighbors.ivf_pq`, run per shard with
     not-owned probes masked to the sentinel id), including the optional
-    donated list-sharded ``probe_counts`` plane (owned probes only)."""
+    donated list-sharded ``probe_counts`` plane (owned probes only)
+    and the optional ragged ``row_probes`` budget plane (see
+    :func:`_dist_search_fn`)."""
     select_min = is_min_close(metric)
     pad_val = jnp.inf if select_min else -jnp.inf
     pq_dim = codes.shape[2]
@@ -928,14 +992,17 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
     ip_metric = metric == DistanceType.InnerProduct
     per_cluster = codebook_kind == CodebookKind.PER_CLUSTER
     score = ivf_pq_mod.score_fn(score_mode, codebooks.shape[1])
+    ragged = row_probes is not None
 
     if init_d is None:
         init_d = jnp.full((queries.shape[0], k), pad_val, jnp.float32)
     if init_i is None:
         init_i = jnp.full((queries.shape[0], k), -1, jnp.int32)
 
-    def body(centers_l, books_l, codes_l, ids_l, qs, ind, ini,
-             cnt=None, nv=None):
+    def body(centers_l, books_l, codes_l, ids_l, qs, ind, ini, *rest):
+        rest = list(rest)
+        rp = rest.pop(0) if ragged else None
+        cnt, nv = rest if rest else (None, None)
         q = qs.shape[0]
         n_local = centers_l.shape[0]
         qf = qs.astype(jnp.float32)
@@ -957,6 +1024,13 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
             local, mine = select_probes_sharded(coarse, n_probes, axis,
                                                 probe_mode, coarse_algo,
                                                 probe_wire_dtype)
+            if rp is not None:
+                from raft_tpu.ops.ivf_scan import ragged_owned
+
+                mine = ragged_owned(
+                    mine, rp,
+                    shards=(mesh.shape[axis]
+                            if probe_mode == "local" else 1))
         if cnt is not None:
             from raft_tpu.ops.ivf_scan import probe_histogram
 
@@ -1044,6 +1118,9 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
     in_specs = [P(axis, None), bspec, P(axis, None, None), P(axis, None),
                 qspec, qspec, qspec]
     out_specs = [qspec, qspec]
+    if ragged:
+        args += [row_probes]
+        in_specs += [P()]           # replicated per-row budget plane
     if probe_counts is not None:
         args += [probe_counts, n_valid]
         in_specs += [P(axis), P()]
@@ -1068,6 +1145,36 @@ _dist_search_pq = partial(jax.jit, static_argnames=(
     "axis", "mesh", "n_probes", "k", "metric", "probe_mode", "query_axis",
     "codebook_kind", "score_mode", "lut_dtype", "coarse_algo",
     "scan_engine", "wire_dtype", "probe_wire_dtype"))(_dist_search_pq_fn)
+
+
+def _dist_search_ragged_pq_fn(queries, row_probes, centers, rotation,
+                              codebooks, codes, indices, init_d=None,
+                              init_i=None, probe_counts=None,
+                              n_valid=None, *, axis: str, mesh,
+                              n_probes: int, k: int,
+                              metric: DistanceType, probe_mode: str,
+                              codebook_kind: CodebookKind = (
+                                  CodebookKind.PER_SUBSPACE),
+                              score_mode: str = "gather",
+                              lut_dtype=jnp.float32,
+                              scan_engine: str = "xla",
+                              wire_dtype: str = "f32",
+                              probe_wire_dtype: str = "f32"):
+    """Packed ragged-batch mesh PQ search — see
+    :func:`_dist_search_ragged_fn` for the replicated-tile contract;
+    per-row budgets fold into the shard body's ownership mask and the
+    LUT union scan serves the packed tile unchanged."""
+    expect(scan_engine == "xla",
+           "mesh ragged PQ serving needs the membership-masked "
+           f"list-major engine ('xla'), got {scan_engine!r}")
+    return _dist_search_pq_fn(
+        queries, centers, rotation, codebooks, codes, indices, init_d,
+        init_i, probe_counts, n_valid, row_probes=row_probes, axis=axis,
+        mesh=mesh, n_probes=n_probes, k=k, metric=metric,
+        probe_mode=probe_mode, codebook_kind=codebook_kind,
+        score_mode=score_mode, lut_dtype=lut_dtype,
+        coarse_algo="exact", scan_engine=scan_engine,
+        wire_dtype=wire_dtype, probe_wire_dtype=probe_wire_dtype)
 
 
 def search_pq(

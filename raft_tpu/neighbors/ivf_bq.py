@@ -676,8 +676,9 @@ def score_probe(lists, qrot, centers_rot, ip, cn, qnorm, codes, rnorm,
 def _search_impl_fn(queries, centers, rotation, codes, rnorm, cfac,
                     errw, indices, data, data_norms, filter_words,
                     init_d=None, init_i=None, probe_counts=None,
-                    n_valid=None, *, n_probes: int, k: int,
-                    metric: DistanceType, coarse_algo: str = "exact",
+                    n_valid=None, row_probes=None, *, n_probes: int,
+                    k: int, metric: DistanceType,
+                    coarse_algo: str = "exact",
                     scan_engine: str = "rank", epsilon: float = 3.0):
     """BQ probe scan: coarse select, then either the fused
     estimate-then-rerank list-major engines (``pallas``/``xla`` —
@@ -689,7 +690,12 @@ def _search_impl_fn(queries, centers, rotation, codes, rnorm, cfac,
     optionally provides the donated (n_lists,) int32 probe-frequency
     plane (graftgauge): selected probe ids scatter-add into it (rows
     past ``n_valid`` masked) and the updated plane returns as a third
-    output. ``scan_engine`` must arrive resolved (via
+    output. ``row_probes`` (the ragged front — see
+    :func:`_search_ragged_fn`) optionally provides a packed batch's
+    per-row probe budgets: the coarse stage selects at the class cap
+    and masks each row's slots past its own budget to the sentinel id,
+    which the fused engines' membership predicate already rejects.
+    ``scan_engine`` must arrive resolved (via
     :func:`raft_tpu.ops.bq_scan.resolve_bq_engine`): it is a jit
     static, so an unresolved ``"auto"`` would fork the compile cache."""
     q, dim = queries.shape
@@ -712,10 +718,16 @@ def _search_impl_fn(queries, centers, rotation, codes, rnorm, cfac,
         score = -(c_norms[None, :] - 2.0 * ip)
         qnorm = jnp.sum(jnp.square(qf), axis=1)
     probes = coarse_select(score, n_probes, coarse_algo)
+    if row_probes is not None:
+        from raft_tpu.ops.ivf_scan import ragged_probes
+
+        probes = ragged_probes(probes, row_probes, centers.shape[0])
     if probe_counts is not None:
         from raft_tpu.ops.ivf_scan import probe_histogram
 
-        probe_counts = probe_histogram(probes, probe_counts, n_valid)
+        probe_counts = probe_histogram(
+            probes, probe_counts,
+            None if row_probes is not None else n_valid)
     pad_val = jnp.inf if select_min else -jnp.inf
 
     # probe-invariant precomputation: the rotated query never changes,
@@ -766,6 +778,38 @@ def _search_impl_fn(queries, centers, rotation, codes, rnorm, cfac,
 _search_impl = partial(jax.jit, static_argnames=(
     "n_probes", "k", "metric", "coarse_algo", "scan_engine",
     "epsilon"))(_search_impl_fn)
+
+
+def _search_ragged_fn(queries, row_probes, centers, rotation, codes,
+                      rnorm, cfac, errw, indices, data, data_norms,
+                      filter_words, init_d=None, init_i=None,
+                      probe_counts=None, n_valid=None, *, n_probes: int,
+                      k: int, metric: DistanceType,
+                      scan_engine: str = "xla", epsilon: float = 3.0):
+    """Packed ragged-batch BQ search body — the BQ member of the
+    serving executor's ragged plan family (see
+    :func:`raft_tpu.neighbors.ivf_flat._search_ragged_fn` for the
+    packing contract). ``n_probes``/``k`` are the packed batch's
+    CLASS CAPS; per-row budgets ride ``row_probes`` into the fused
+    estimate-then-rerank engines' membership mask (the sentinel
+    machinery the list-sharded BQ bodies already use for not-owned
+    probes), and the running k-th-distance prune threshold is
+    per-row, so a row's prune decisions — and its exact reranked
+    output — are independent of what else shares the tile.
+    Bit-identical per request to :func:`_search_impl_fn` on that
+    request alone. Fused engines only: the rank-major estimate-only
+    scan has no membership mask (and a codes-only index resolves to
+    it, so codes-only BQ stays on the bucketed path)."""
+    del n_valid
+    expect(scan_engine in ("pallas", "xla"),
+           "ragged BQ serving needs a fused membership-masked engine "
+           f"(pallas|xla), got {scan_engine!r}")
+    return _search_impl_fn(
+        queries, centers, rotation, codes, rnorm, cfac, errw, indices,
+        data, data_norms, filter_words, init_d, init_i, probe_counts,
+        None, row_probes=row_probes, n_probes=n_probes, k=k,
+        metric=metric, coarse_algo="exact", scan_engine=scan_engine,
+        epsilon=epsilon)
 
 
 def search(

@@ -457,8 +457,9 @@ def build_streaming(
 
 def _search_impl_fn(queries, centers, center_norms, data, data_norms, indices,
                     filter_words, init_d=None, init_i=None,
-                    probe_counts=None, n_valid=None, *, n_probes: int,
-                    k: int, metric: DistanceType, coarse_algo: str = "exact",
+                    probe_counts=None, n_valid=None, row_probes=None, *,
+                    n_probes: int, k: int, metric: DistanceType,
+                    coarse_algo: str = "exact",
                     scan_engine: str = "rank"):
     """Coarse select + probe scan with running top-k merge.
 
@@ -473,6 +474,18 @@ def _search_impl_fn(queries, centers, center_norms, data, data_norms, indices,
     .probe_histogram`, pad rows past ``n_valid`` masked out) and the
     updated plane returns as a third output. The search results never
     read it, so enabling accounting cannot perturb them.
+
+    ``row_probes`` (the ragged query-tile front, via
+    :func:`_search_ragged_fn`) optionally provides the per-ROW probe
+    budget plane of a packed ragged batch: the coarse stage then
+    selects at the class cap ``n_probes`` and each row's slots past
+    its own budget mask to the sentinel id
+    (:func:`raft_tpu.ops.ivf_scan.ragged_probes`) — the scan below is
+    char-identical between the bucketed and ragged paths, which IS the
+    bit-identity argument. Pad rows carry budget 0, so ``n_valid``
+    masking is redundant on this path (every pad slot is already the
+    sentinel, which :func:`~raft_tpu.ops.ivf_scan.probe_histogram`
+    drops).
 
     ``scan_engine`` must arrive resolved (``rank``/``pallas``/``xla``,
     via :func:`raft_tpu.ops.ivf_scan.resolve_scan_engine`): it is a jit
@@ -491,10 +504,16 @@ def _search_impl_fn(queries, centers, center_norms, data, data_norms, indices,
     score = (ip if metric == DistanceType.InnerProduct
              else -(center_norms[None, :] - 2.0 * ip))          # larger=better
     probes = coarse_select(score, n_probes, coarse_algo)
+    if row_probes is not None:
+        from raft_tpu.ops.ivf_scan import ragged_probes
+
+        probes = ragged_probes(probes, row_probes, n_lists)
     if probe_counts is not None:
         from raft_tpu.ops.ivf_scan import probe_histogram
 
-        probe_counts = probe_histogram(probes, probe_counts, n_valid)
+        probe_counts = probe_histogram(
+            probes, probe_counts,
+            None if row_probes is not None else n_valid)
 
     pad_val = jnp.inf if select_min else -jnp.inf
 
@@ -580,52 +599,27 @@ def _search_ragged_fn(queries, row_probes, centers, center_norms, data,
     per-request ``n_probes`` resolves through the engines' existing
     membership mask, and per-request ``k`` is a caller-side column
     slice of the total-order top-``k``. Bit-identical per request to
-    :func:`_search_impl_fn` on that request alone.
+    :func:`_search_impl_fn` on that request alone — structurally: this
+    IS :func:`_search_impl_fn` with the ``row_probes`` hook live, so
+    the scan code cannot drift between the two paths.
 
     ``coarse_algo`` is deliberately NOT a knob: only the exact coarse
     top-k has the prefix property the class cap relies on
     (``approx_max_k`` at the cap is not a solo ``approx_max_k`` at the
     request's budget), so approx-coarse requests stay on the bucketed
-    path. ``probe_counts`` threads graftgauge's donated plane exactly
-    like the bucketed body; ``n_valid`` is accepted for signature
-    parity but unused — ``row_probes`` already zeroes pad rows out of
-    the histogram (their every slot carries the sentinel)."""
+    path; likewise the rank-major engine has no membership mask to
+    resolve per-row budgets through. ``n_valid`` is accepted for
+    signature parity but unused — ``row_probes`` already zeroes pad
+    rows out of the scan and the histogram."""
     del n_valid
-    from raft_tpu.ops.ivf_scan import list_major_scan, ragged_probes
-
-    n_lists = data.shape[0]
-    qf = queries.astype(jnp.float32)
-
-    # coarse select at the class cap — exact top-k only (prefix property)
-    ip = jax.lax.dot_general(
-        qf, centers, (((1,), (1,)), ((), ())),
-        precision=jax.lax.Precision.HIGHEST,
-        preferred_element_type=jnp.float32,
-    )
-    score = (ip if metric == DistanceType.InnerProduct
-             else -(center_norms[None, :] - 2.0 * ip))
-    probes = coarse_select(score, n_probes, "exact")
-    probes = ragged_probes(probes, row_probes, n_lists)
-    if probe_counts is not None:
-        from raft_tpu.ops.ivf_scan import probe_histogram
-
-        probe_counts = probe_histogram(probes, probe_counts)
-
-    best_d, best_i = list_major_scan(
-        qf, data, data_norms, indices, probes, filter_words,
-        init_d, init_i, k=k, metric=metric, engine=scan_engine,
-        interpret=jax.default_backend() != "tpu")
-
-    if metric != DistanceType.InnerProduct:
-        q_sq = jnp.sum(jnp.square(qf), axis=1, keepdims=True)
-        best_d = jnp.where(jnp.isfinite(best_d),
-                           jnp.maximum(best_d + q_sq, 0.0), best_d)
-        if metric == DistanceType.L2SqrtExpanded:
-            best_d = jnp.where(jnp.isfinite(best_d), jnp.sqrt(best_d),
-                               best_d)
-    if probe_counts is not None:
-        return best_d, best_i, probe_counts
-    return best_d, best_i
+    expect(scan_engine in ("pallas", "xla"),
+           "ragged serving needs a membership-masked list-major engine "
+           f"(pallas|xla), got {scan_engine!r}")
+    return _search_impl_fn(
+        queries, centers, center_norms, data, data_norms, indices,
+        filter_words, init_d, init_i, probe_counts, None,
+        row_probes=row_probes, n_probes=n_probes, k=k, metric=metric,
+        coarse_algo="exact", scan_engine=scan_engine)
 
 
 def search(

@@ -763,8 +763,8 @@ def quantize_lut(lut, lut_dtype):
 
 def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
                     filter_words, init_d=None, init_i=None,
-                    probe_counts=None, n_valid=None, *, n_probes: int,
-                    k: int, metric: DistanceType,
+                    probe_counts=None, n_valid=None, row_probes=None, *,
+                    n_probes: int, k: int, metric: DistanceType,
                     codebook_kind: CodebookKind, lut_dtype,
                     score_mode: str = "gather", packed: bool = False,
                     coarse_algo: str = "exact", scan_engine: str = "rank"):
@@ -775,6 +775,11 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
     probe-frequency plane (graftgauge): selected probe ids scatter-add
     into it (rows past ``n_valid`` masked) and the updated plane
     returns as a third output — the results never read it.
+    ``row_probes`` (the ragged front — see :func:`_search_ragged_fn`)
+    optionally provides a packed batch's per-row probe budgets: the
+    coarse stage selects at the class cap and masks each row's slots
+    past its own budget to the sentinel id, which the list-major
+    engine's membership predicate already rejects.
 
     ``scan_engine`` must arrive resolved (``rank``/``xla`` via
     :func:`resolve_scan_engine` — it is a jit static). ``rank`` scans
@@ -802,10 +807,16 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
     score = (ip if metric == DistanceType.InnerProduct
              else -(jnp.sum(jnp.square(centers), axis=1)[None, :] - 2.0 * ip))
     probes = coarse_select(score, n_probes, coarse_algo)
+    if row_probes is not None:
+        from raft_tpu.ops.ivf_scan import ragged_probes
+
+        probes = ragged_probes(probes, row_probes, n_lists)
     if probe_counts is not None:
         from raft_tpu.ops.ivf_scan import probe_histogram
 
-        probe_counts = probe_histogram(probes, probe_counts, n_valid)
+        probe_counts = probe_histogram(
+            probes, probe_counts,
+            None if row_probes is not None else n_valid)
 
     pad_val = jnp.inf if select_min else -jnp.inf
 
@@ -920,6 +931,38 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
 _search_impl = partial(jax.jit, static_argnames=(
     "n_probes", "k", "metric", "codebook_kind", "lut_dtype", "score_mode",
     "packed", "coarse_algo", "scan_engine"))(_search_impl_fn)
+
+
+def _search_ragged_fn(queries, row_probes, centers, rotation, codebooks,
+                      codes, indices, filter_words, init_d=None,
+                      init_i=None, probe_counts=None, n_valid=None, *,
+                      n_probes: int, k: int, metric: DistanceType,
+                      codebook_kind: CodebookKind, lut_dtype,
+                      score_mode: str = "gather", packed: bool = False,
+                      scan_engine: str = "xla"):
+    """Packed ragged-batch ADC search body — the PQ member of the
+    serving executor's ragged plan family (see
+    :func:`raft_tpu.neighbors.ivf_flat._search_ragged_fn` for the
+    packing contract; this is the same wrapper over the same hook).
+    ``n_probes``/``k`` are the packed batch's CLASS CAPS; per-row
+    budgets ride ``row_probes`` into the list-major engine's
+    membership mask, and each per-probe LUT depends only on its own
+    (query row, list) pair, so a row's scores are independent of what
+    else shares the tile — bit-identical per request to
+    :func:`_search_impl_fn` on that request alone. Exact coarse
+    select only (the prefix-property argument), list-major engine
+    only (the rank-major scan has no membership mask)."""
+    del n_valid
+    expect(scan_engine == "xla",
+           "ragged PQ serving needs the membership-masked list-major "
+           f"engine ('xla'), got {scan_engine!r}")
+    return _search_impl_fn(
+        queries, centers, rotation, codebooks, codes, indices,
+        filter_words, init_d, init_i, probe_counts, None,
+        row_probes=row_probes, n_probes=n_probes, k=k, metric=metric,
+        codebook_kind=codebook_kind, lut_dtype=lut_dtype,
+        score_mode=score_mode, packed=packed, coarse_algo="exact",
+        scan_engine=scan_engine)
 
 
 def search(

@@ -51,7 +51,13 @@ not-owned probes, so BOTH engines serve the packed tile unchanged —
 the membership predicate is the raggedness mechanism, and the
 scalar-prefetched index map streams only the union the packed batch
 actually probed. One executable therefore serves every load shape;
-the per-request results are bit-identical to solo calls.
+the per-request results are bit-identical to solo calls. The same
+front covers the whole index zoo (graftragged): the PQ LUT scan and
+the fused BQ engines consume the identical sentinel-masked probes,
+and on the mesh :func:`ragged_owned` folds each row's budget into
+the sharded probe-ownership mask — so a replicated packed tile
+serves the list-sharded families through their unchanged shard
+bodies.
 """
 
 from __future__ import annotations
@@ -203,6 +209,33 @@ def ragged_probes(probes: jax.Array, row_probes: jax.Array,
     slot = jnp.arange(probes.shape[1], dtype=jnp.int32)
     return jnp.where(slot[None, :] < row_probes[:, None], probes,
                      n_lists)
+
+
+def ragged_owned(mine: jax.Array, row_probes: jax.Array,
+                 shards: int = 1) -> jax.Array:
+    """Fold a packed ragged tile's per-row probe budgets into a
+    sharded probe-ownership mask — the mesh half of the ragged front.
+
+    ``mine`` is :func:`raft_tpu.distributed.ivf.select_probes_sharded`'s
+    per-(row, probe-rank) ownership mask, whose columns are
+    rank-ordered by the exact coarse top-k (a total order, so the
+    first ``b`` columns ARE the solo ``n_probes=b`` selection — the
+    same prefix property the single-chip front rides). A row keeps
+    only the slots below its own budget; everything downstream
+    (sentinel masking for the scan, ``owned=`` for
+    :func:`probe_histogram`) already consumes the mask, so the sharded
+    bodies serve packed tiles with one ``jnp.logical_and``.
+
+    ``shards`` converts the global per-row budget to the per-shard one
+    for ``probe_mode="local"`` (each shard probes its own
+    ``ceil(b / R)`` lists, exactly as
+    :func:`~raft_tpu.distributed.ivf.resolve_probe_budget` resolves
+    the scalar budget). Pad rows carry budget 0 and own nothing."""
+    slot = jnp.arange(mine.shape[1], dtype=jnp.int32)
+    budget = row_probes
+    if shards > 1:
+        budget = -(-row_probes // shards)       # ceil(b / R), 0 -> 0
+    return jnp.logical_and(mine, slot[None, :] < budget[:, None])
 
 
 def unique_lists(probes: jax.Array, n_lists: int) -> jax.Array:

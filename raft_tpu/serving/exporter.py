@@ -156,6 +156,13 @@ _FLEET_MEM_INDEX_GAUGE = re.compile(
 # (index.recall.sweep.p<NP>) with a latency axis
 _HIST_CLASS = re.compile(
     r"^(serving\.batcher\.[a-z0-9_]+_seconds)\.(p[0-9]+)$")
+# per-(params class, tile) pad-waste split counters (graftragged):
+# serving.execute.{rows,padded_rows}.p<NP>.t<TILE> render as labeled
+# families DISTINCT from the flat aggregates (suffix _split — one
+# family must not carry two HELP/TYPE headers), attributing pad waste
+# to the small-vs-large dual-tile choice
+_PAD_SPLIT = re.compile(
+    r"^serving\.execute\.(rows|padded_rows)\.(p[0-9]+)\.t([0-9]+)$")
 
 # HELP text per family prefix (longest match wins; the generic
 # fallback keeps every family carrying *a* HELP line — the exposition
@@ -253,10 +260,26 @@ def render_prometheus(counters: dict, gauges: dict, histograms: dict,
         lines.append(f"# HELP {pn} {help_text(help_name)}")
         lines.append(f"# TYPE {pn} {mtype}")
 
+    # labeled counter families (graftragged pad-waste split): the
+    # samples fold into ONE `_split`-suffixed family per base counter
+    # — reusing the flat aggregate's name would emit its HELP/TYPE
+    # header twice, which the exposition grammar forbids
+    labeled_counters: dict = {}
     for name in sorted(counters):
+        m = _PAD_SPLIT.match(name)
+        if m:
+            fam = f"serving_execute_{m.group(1)}_split"
+            labeled_counters.setdefault(fam, []).append(
+                (f'params_class="{m.group(2)}",tile="{m.group(3)}"',
+                 counters[name]))
+            continue
         pn = prom_name(name)
         emit_family(pn, "counter", name)
         lines.append(f"{pn} {_fmt(counters[name])}")
+    for pn in sorted(labeled_counters):
+        emit_family(pn, "counter", "serving.execute.")
+        for labels, v in sorted(labeled_counters[pn]):
+            lines.append(f"{pn}{{{labels}}} {_fmt(v)}")
 
     # family prom-name -> {"help": registry prefix, "samples": [...]}
     labeled: dict = {}

@@ -23,6 +23,11 @@ Per-stage latency **histograms** (log2 buckets, p50/p95/p99 estimates):
 - ``serving.execute.padded_rows``                 — dispatched row
   capacity incl. bucket/tile pad; with ``.rows`` it derives the
   pad-waste fraction the ragged-vs-bucketed A/B gates on
+- ``serving.execute.{rows,padded_rows}.p<NP>.t<T>`` — the ragged
+  dispatch core's per-(params class, tile) split of the two counters
+  above (graftragged): ``derived()["pad_waste_by_class"]`` and the
+  exporter's ``serving_execute_*{params_class=,tile=}`` labeled
+  families attribute pad waste to the small-vs-large tile choice
 - ``serving.batcher.group_starvation_s``          — (gauge) longest any
   dispatch-ready group waited while another was served — the
   cross-index fairness budget's observable
@@ -461,6 +466,20 @@ def derived() -> dict:
         "modeled_flops_total":
             tracing.get_counter("serving.execute.modeled_flops"),
     }
+    # per-(params class, tile) pad-waste attribution (graftragged):
+    # the ragged dispatch core splits its rows/padded_rows counters as
+    # serving.execute.{rows,padded_rows}.p<NP>.t<TILE>, so the waste
+    # attributes to the small-vs-large tile choice per class — the
+    # signal that says whether the dual tile earns its second
+    # executable at the observed load mix
+    by_class = {}
+    split_pad = tracing.counters("serving.execute.padded_rows.")
+    for name, pad in split_pad.items():
+        label = name[len("serving.execute.padded_rows."):]
+        r = tracing.get_counter("serving.execute.rows." + label)
+        if pad:
+            by_class[label] = 1.0 - r / pad
+    out["pad_waste_by_class"] = by_class
     out["achieved_gbps"] = (
         out["modeled_bytes_total"] / exec_s / 1e9 if exec_s > 0 else 0.0)
     out["achieved_gflops"] = (

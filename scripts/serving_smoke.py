@@ -10,6 +10,14 @@ size are the executor's documented small print). One JSON line per
 piece (commit the output as hardware evidence, like
 tpu_smoke_kernels.py).
 
+graftragged (PR 15) pieces: ``ragged`` (IVF-flat packed-batch
+acceptance), ``ragged_bq`` (the fused BQ engine through the same
+ragged plan family), and ``ragged_mesh`` (the list-sharded index
+serving packed replicated tiles on the REAL mesh) — each asserts
+bit-parity vs the bucketed executor path and a zero-recompile steady
+state, with the dual-tile executable count (≤ 2) and pad-waste split
+reported as evidence.
+
 Run: PYTHONPATH=/root/repo:/root/.axon_site python scripts/serving_smoke.py
 """
 
@@ -137,6 +145,75 @@ def main():
              sv_metrics.derived()["pad_waste_fraction"], 4))
     assert ragged_bits and ragged_failures == 0
     assert ex_r.ragged_executables() == 1
+
+    # graftragged acceptance on chip: the BQ fused engine and the
+    # real mesh serve the SAME ragged plan family — bit-parity vs the
+    # bucketed path and zero-recompile steady state, per family
+    def ragged_family_piece(piece, idx, params, make_params, **sub_kw):
+        """Drive mixed-k/mixed-n_probes traffic through one family's
+        ragged front (dual tile) and assert bit-parity vs that
+        family's bucketed executor path + zero steady-state backend
+        compiles."""
+        ex_f = SearchExecutor(ragged_tile=128, ragged_tile_small=32)
+        warm_f = ex_f.warmup_ragged(idx, k=8, params=params, **sub_kw)
+        sv_metrics.reset()
+        with DynamicBatcher(ex_f, BatcherConfig(max_wait_s=0.002,
+                                                ragged=True)) as bf:
+            for h in [bf.submit(idx, blk, 8, params=params, **sub_kw)
+                      for blk in blocks[:20]]:
+                h.result(timeout=120)        # primer (plane creation)
+            b0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+            hs, wants = [], []
+            for j, blk in enumerate(blocks[20:100]):
+                k_j, p_j = (8, params) if j % 2 else (7, make_params())
+                hs.append(bf.submit(idx, blk, k_j, params=p_j,
+                                    **sub_kw))
+                wants.append((blk, k_j, p_j))
+            fails = sum(1 for h in hs
+                        if h.exception(timeout=120) is not None)
+            compiles = (tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+                        - b0)
+            bits = True
+            for h, (blk, k_j, p_j) in zip(hs, wants):
+                want = ex_f.search(idx, blk, k_j, params=p_j, **sub_kw)
+                got = h.result(timeout=120)
+                bits = bits and np.array_equal(
+                    np.asarray(got[1]), np.asarray(want[1]))
+        emit(piece, ok=bool(bits and fails == 0),
+             warmup_seconds=round(warm_f, 3),
+             executables=ex_f.ragged_executables(),
+             backend_compiles_steady_state=int(compiles),
+             pad_waste_fraction=round(
+                 sv_metrics.derived()["pad_waste_fraction"], 4),
+             pad_waste_by_class=sv_metrics.derived()
+             ["pad_waste_by_class"])
+        assert bits and fails == 0
+        assert ex_f.ragged_executables() <= 2
+
+    from raft_tpu.neighbors import ivf_bq
+
+    bq_index = ivf_bq.build(
+        None, ivf_bq.IvfBqIndexParams(n_lists=64, bits=2), x)
+    ragged_family_piece(
+        "ragged_bq", bq_index, ivf_bq.IvfBqSearchParams(n_probes=8),
+        lambda: ivf_bq.IvfBqSearchParams(n_probes=5))
+
+    if jax.device_count() >= 2:
+        from raft_tpu.comms import local_comms
+        from raft_tpu.distributed import ivf as dist_ivf
+
+        comms = local_comms()
+        mesh_index = dist_ivf.build(
+            None, comms, ivf_flat.IvfFlatIndexParams(n_lists=64), x)
+        ragged_family_piece(
+            "ragged_mesh", mesh_index,
+            ivf_flat.IvfFlatSearchParams(n_probes=8),
+            lambda: ivf_flat.IvfFlatSearchParams(n_probes=5),
+            probe_mode="global")
+        emit("ragged_mesh_info", shards=comms.size)
+    else:
+        emit("ragged_mesh", skipped="single-device host — mesh ragged "
+             "needs a real mesh")
     emit("done", ok=True)
 
 

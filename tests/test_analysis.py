@@ -1143,3 +1143,44 @@ class TestGrafttierScopeProofs:
         )
         assert lint_lib(ok, ["R5", "R7"],
                         rel="raft_tpu/serving/placement.py").ok
+
+
+# graftragged scope proof: the MESH ragged plan keys fold mesh devices
+# and params-class tuples into RETURN position of ragged_key — R1's
+# key discipline covers that construction (the shipped executor's
+# ragged_key/coalesce_key lint clean, suppression snapshot unchanged).
+
+R1_MESH_RAGGED_KEY_VIOLATING = '''\
+def ragged_key(self, index, k, params=None, **kw):
+    return ("dist_ivf_flat_ragged",
+            [d.id for d in index.mesh_devices],
+            float(index.probe_budget),
+            {"wire": kw.get("wire_dtype")})
+'''
+R1_MESH_RAGGED_KEY_CONFORMING = '''\
+def ragged_key(self, index, k, params=None, **kw):
+    return ("dist_ivf_flat_ragged", index.mesh_key,
+            tuple(sorted((n, str(v)) for n, v in kw.items())),
+            k)
+'''
+
+
+class TestMeshRaggedKeyProofs:
+    """graftragged satellite: R1 key discipline reaches the mesh
+    ragged plan keys — device-id lists, runtime-data scalars, and
+    bare dict displays in a key-returning function's RETURN are
+    findings; the tuple-wrapped mesh-device + params-class + wire-kw
+    construction conforms."""
+
+    def test_mesh_ragged_key_violating(self):
+        bad = lint_lib(R1_MESH_RAGGED_KEY_VIOLATING, ["R1"],
+                       rel="raft_tpu/core/executor.py")
+        assert rules_fired(bad) == {"R1"}
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "unhashable list" in msgs
+        assert "float() of runtime data" in msgs
+        assert "unhashable dict" in msgs
+
+    def test_mesh_ragged_key_conforming(self):
+        assert lint_lib(R1_MESH_RAGGED_KEY_CONFORMING, ["R1"],
+                        rel="raft_tpu/core/executor.py").ok

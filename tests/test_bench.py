@@ -684,6 +684,40 @@ class TestBenchCompare:
         del extra["serving"]["modeled_exec_bytes"]
         assert bc.compare(extra, record) == []
 
+    def test_ragged_family_bands_gate(self, bc, record):
+        """graftragged: the per-family ragged legs gate structurally —
+        an executable-count or pad-waste regression in any family leg
+        fails the gate; identical records pass."""
+        import copy
+
+        base = copy.deepcopy(record)
+        base["serving"]["ragged_families"] = {
+            "pq": {"completed": 24.0, "qps": 5.0, "p99_ms": 20.0,
+                   "pad_waste_fraction": 0.01,
+                   "backend_compiles_during_load": 0.0,
+                   "executables": 2.0},
+            "mesh": {"completed": 24.0, "qps": 15.0, "p99_ms": 10.0,
+                     "pad_waste_fraction": 0.01,
+                     "backend_compiles_during_load": 0.0,
+                     "executables": 2.0, "shards": 4.0},
+        }
+        assert bc.compare(base, base) == []
+        worse = copy.deepcopy(base)
+        worse["serving"]["ragged_families"]["pq"]["executables"] = 5.0
+        msgs = bc.compare(base, worse)
+        assert any("ragged_families.pq.executables" in m for m in msgs)
+        padded = copy.deepcopy(base)
+        padded["serving"]["ragged_families"]["mesh"][
+            "pad_waste_fraction"] = 0.2
+        msgs = bc.compare(base, padded)
+        assert any("ragged_families.mesh.pad_waste_fraction" in m
+                   for m in msgs)
+        # a lost mesh shard is a measurement regression, not noise
+        fewer = copy.deepcopy(base)
+        fewer["serving"]["ragged_families"]["mesh"]["shards"] = 1.0
+        msgs = bc.compare(base, fewer)
+        assert any("ragged_families.mesh.shards" in m for m in msgs)
+
     def test_snapshot_floors(self, bc):
         ok = {"counters": {"serving.execute.calls": 5.0,
                            "serving.execute.modeled_bytes": 1e6,

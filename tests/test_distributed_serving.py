@@ -811,3 +811,161 @@ class TestBqFusedMesh:
                                         scan_engine="pallas")
         ex.search(dist, q, 5, params=sp_p)
         assert ex.stats.compile_count == c0 + 1
+
+
+class TestMeshRagged:
+    """graftragged: the list-sharded families serve through the SAME
+    ragged plan family — one replicated-tile executable per (mesh,
+    params class) replaces the distributed bucket ladder. Bit-identity
+    per request vs the bucketed mesh dispatch, zero-recompile mixed
+    load, probe accounting exact, and the mesh-specific residue
+    (int8 probe wire, query_axis) falls back with explicit reasons."""
+
+    @pytest.fixture(scope="class")
+    def mesh_indexes(self, comms, data):
+        x, _ = data
+        return {
+            "flat": dist_ivf.build(
+                None, comms, IvfFlatIndexParams(n_lists=32), x),
+            "pq": dist_ivf.build_pq(
+                None, comms,
+                IvfPqIndexParams(n_lists=32, pq_dim=8), x),
+            "bq": dist_bq.build_bq(
+                None, comms,
+                ivf_bq.IvfBqIndexParams(n_lists=32, bits=2), x),
+        }
+
+    def _blocks(self, seed=3):
+        rng = np.random.default_rng(seed)
+        return [rng.standard_normal((m, 32)).astype(np.float32)
+                for m in (3, 5, 2, 9)]
+
+    # three combos cover both axes (engine × probe mode) without the
+    # fourth's near-duplicate compile cost — tier-1 wall-time budget
+    @pytest.mark.parametrize("probe_mode,engine", [
+        ("global", "pallas"), ("global", "xla"), ("local", "xla")])
+    def test_flat_bit_identical_per_engine(self, mesh_indexes, engine,
+                                           probe_mode):
+        index = mesh_indexes["flat"]
+        ex = SearchExecutor(ragged_tile=16)
+        p1 = IvfFlatSearchParams(n_probes=5, scan_engine=engine)
+        p2 = IvfFlatSearchParams(n_probes=8, scan_engine=engine)
+        assert (ex.ragged_key(index, 4, params=p1,
+                              probe_mode=probe_mode)
+                == ex.ragged_key(index, 7, params=p2,
+                                 probe_mode=probe_mode))
+        blocks = self._blocks()
+        res = ex.search_ragged(index, blocks, [4, 7, 6, 5],
+                               params_list=[p1, p2, p1, p2],
+                               probe_mode=probe_mode)
+        for b, (d, i), kj, pj in zip(blocks, res, [4, 7, 6, 5],
+                                     [p1, p2, p1, p2]):
+            sd, si = ex.search(index, b, kj, params=pj,
+                               probe_mode=probe_mode)
+            np.testing.assert_array_equal(i, np.asarray(si))
+            np.testing.assert_array_equal(d, np.asarray(sd))
+        assert ex.ragged_executables("dist_ivf_flat") == 1
+
+    @pytest.mark.parametrize("fam", ["pq", "bq"])
+    def test_pq_bq_bit_identical(self, mesh_indexes, fam):
+        index = mesh_indexes[fam]
+        mk = (IvfPqSearchParams if fam == "pq"
+              else ivf_bq.IvfBqSearchParams)
+        p1 = mk(n_probes=5, scan_engine="xla")
+        p2 = mk(n_probes=8, scan_engine="xla")
+        ex = SearchExecutor(ragged_tile=16)
+        blocks = self._blocks(seed=5)
+        res = ex.search_ragged(index, blocks, [4, 7, 6, 5],
+                               params_list=[p1, p2, p1, p2])
+        for b, (d, i), kj, pj in zip(blocks, res, [4, 7, 6, 5],
+                                     [p1, p2, p1, p2]):
+            sd, si = ex.search(index, b, kj, params=pj)
+            np.testing.assert_array_equal(i, np.asarray(si))
+            np.testing.assert_array_equal(d, np.asarray(sd))
+        assert ex.ragged_executables("dist_ivf_" + fam) == 1
+
+    def test_zero_recompile_mixed_load(self, mesh_indexes):
+        """Warm the one executable, then mixed per-request n_probes/k
+        load — with probe accounting ON — serves with ZERO backend
+        compiles (after the one-time lazily-created probe plane)."""
+        index = mesh_indexes["flat"]
+        ex = SearchExecutor(ragged_tile=16, probe_accounting=True)
+        p1 = IvfFlatSearchParams(n_probes=5, scan_engine="xla")
+        p2 = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        ex.warmup_ragged(index, k=7, params=p1)
+        blocks = self._blocks(seed=7)
+        # primer dispatch creates the donated probe plane (one jnp
+        # broadcast compile, same one-time cost as the bucketed path)
+        ex.search_ragged(index, blocks[:1], 4, params_list=p1)
+        tracing.install_xla_compile_listener()
+        c0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        for _ in range(3):
+            ex.search_ragged(index, blocks, [4, 7, 6, 5],
+                             params_list=[p1, p2, p1, p2])
+        assert (tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+                - c0 == 0)
+        assert ex.ragged_executables() == 1
+        # probe accounting: every dispatched row lands exactly its own
+        # budget, counted once mesh-wide on the owning shard
+        planes = ex.probe_frequencies()
+        (label,) = planes.keys()
+        assert label.startswith("dist_ivf_flat-")
+        # primer: 3 rows at n_probes=5; then 3 rounds of the mixed
+        # stream (rows x that request's OWN budget)
+        rows_by_budget = 3 * 5 + 3 * (3 * 5 + 5 * 8 + 2 * 5 + 9 * 8)
+        assert planes[label].sum() == rows_by_budget
+
+    def test_mesh_residue_reasons(self, mesh_indexes):
+        index = mesh_indexes["flat"]
+        ex = SearchExecutor()
+        p = IvfFlatSearchParams(n_probes=5, scan_engine="xla")
+        assert ex.ragged_key(index, 4, params=p,
+                             probe_wire_dtype="int8") is None
+        assert "int8" in ex.ragged_fallback_reason(
+            index, 4, params=p, probe_wire_dtype="int8")
+        assert ex.ragged_key(index, 4, params=p,
+                             query_axis="q") is None
+        assert "query_axis" in ex.ragged_fallback_reason(
+            index, 4, params=p, query_axis="q")
+        # bf16 wires stay raggable (per-element rounding keeps the
+        # budget-prefix property)
+        assert ex.ragged_key(index, 4, params=p, wire_dtype="bf16",
+                             probe_wire_dtype="bf16") is not None
+
+    def test_bf16_wire_bit_identical(self, mesh_indexes):
+        index = mesh_indexes["flat"]
+        ex = SearchExecutor(ragged_tile=16)
+        p1 = IvfFlatSearchParams(n_probes=5, scan_engine="xla")
+        p2 = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        blocks = self._blocks(seed=9)[:2]
+        res = ex.search_ragged(index, blocks, 4, params_list=[p1, p2],
+                               wire_dtype="bf16",
+                               probe_wire_dtype="bf16")
+        for b, (d, i), pj in zip(blocks, res, [p1, p2]):
+            sd, si = ex.search(index, b, 4, params=pj,
+                               wire_dtype="bf16",
+                               probe_wire_dtype="bf16")
+            np.testing.assert_array_equal(i, np.asarray(si))
+            np.testing.assert_array_equal(d, np.asarray(sd))
+
+    def test_batcher_serves_mesh_ragged(self, mesh_indexes):
+        """BatcherConfig(ragged=True) covers the mesh families in
+        continuous admission: submissions group by the mesh ragged
+        key and complete bit-identical to the executor path."""
+        from raft_tpu.serving import BatcherConfig, DynamicBatcher
+
+        index = mesh_indexes["flat"]
+        ex = SearchExecutor(ragged_tile=16)
+        p = IvfFlatSearchParams(n_probes=5, scan_engine="xla")
+        blocks = self._blocks(seed=13)
+        with DynamicBatcher(ex, BatcherConfig(max_wait_s=0.002,
+                                              ragged=True)) as b:
+            hs = [b.submit(index, blk, 5, params=p,
+                           probe_mode="global") for blk in blocks]
+            for h, blk in zip(hs, blocks):
+                got = h.result(timeout=120)
+                want = ex.search(index, blk, 5, params=p,
+                                 probe_mode="global")
+                np.testing.assert_array_equal(
+                    np.asarray(got[1]), np.asarray(want[1]))
+        assert ex.ragged_executables("dist_ivf_flat") == 1

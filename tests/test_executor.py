@@ -658,3 +658,174 @@ class TestBqEngines:
         d0, i0 = ivf_bq.search(None, sp, idx, q[:9], 5)
         np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
         np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+
+class TestRaggedFamilies:
+    """graftragged: the PQ and BQ fronts serve through the SAME
+    ragged plan family — bit-identical per request to the bucketed
+    path, one executable per (index shapes, params class, tile), and
+    the documented non-raggable residue falls back with an explicit
+    reason."""
+
+    @pytest.fixture(scope="class")
+    def fam_setup(self):
+        rng = np.random.default_rng(23)
+        x = rng.standard_normal((2000, 32)).astype(np.float32)
+        return (
+            ivf_pq.build(None, ivf_pq.IvfPqIndexParams(
+                n_lists=16, pq_dim=8), x),
+            ivf_bq.build(None, ivf_bq.IvfBqIndexParams(
+                n_lists=16, bits=2), x),
+            rng,
+        )
+
+    def test_pq_bit_identical_and_zero_recompile(self, fam_setup):
+        pq_index, _, rng = fam_setup
+        ex = SearchExecutor(ragged_tile=16)
+        p1 = ivf_pq.IvfPqSearchParams(n_probes=5, scan_engine="xla")
+        p2 = ivf_pq.IvfPqSearchParams(n_probes=8, scan_engine="xla")
+        # mixed n_probes AND k inside one pow2 class share the key
+        assert (ex.ragged_key(pq_index, 4, params=p1)
+                == ex.ragged_key(pq_index, 7, params=p2))
+        ex.warmup_ragged(pq_index, k=7, params=p1)
+        assert ex.ragged_executables("ivf_pq") == 1
+        tracing.install_xla_compile_listener()
+        blocks = [rng.standard_normal((m, 32)).astype(np.float32)
+                  for m in (3, 5, 2, 9)]
+        c0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        res = ex.search_ragged(pq_index, blocks, [4, 7, 6, 5],
+                               params_list=[p1, p2, p1, p2])
+        assert (tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+                - c0 == 0)
+        assert ex.ragged_executables("ivf_pq") == 1
+        for b, (d, i), kj, pj in zip(blocks, res, [4, 7, 6, 5],
+                                     [p1, p2, p1, p2]):
+            sd, si = ex.search(pq_index, b, kj, params=pj)
+            np.testing.assert_array_equal(i, np.asarray(si))
+            np.testing.assert_array_equal(d, np.asarray(sd))
+
+    def test_bq_bit_identical_and_zero_recompile(self, fam_setup):
+        _, bq_index, rng = fam_setup
+        ex = SearchExecutor(ragged_tile=16)
+        pb = ivf_bq.IvfBqSearchParams(n_probes=6, scan_engine="xla")
+        pb2 = ivf_bq.IvfBqSearchParams(n_probes=3, scan_engine="xla")
+        ex.warmup_ragged(bq_index, k=5, params=pb)
+        assert ex.ragged_executables("ivf_bq") == 1
+        tracing.install_xla_compile_listener()
+        blocks = [rng.standard_normal((m, 32)).astype(np.float32)
+                  for m in (4, 2, 7)]
+        c0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        res = ex.search_ragged(bq_index, blocks, [5, 3, 4],
+                               params_list=[pb, pb2, pb])
+        assert (tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+                - c0 == 0)
+        for b, (d, i), kj, pj in zip(blocks, res, [5, 3, 4],
+                                     [pb, pb2, pb]):
+            sd, si = ex.search(bq_index, b, kj, params=pj)
+            np.testing.assert_array_equal(i, np.asarray(si))
+            np.testing.assert_array_equal(d, np.asarray(sd))
+
+    def test_probe_accounting_shared_with_bucketed(self, fam_setup):
+        """The PQ ragged plan threads the SAME donated probe plane as
+        the bucketed plans — one cumulative histogram per index,
+        exact across the path split."""
+        pq_index, _, rng = fam_setup
+        ex = SearchExecutor(ragged_tile=16, probe_accounting=True)
+        p1 = ivf_pq.IvfPqSearchParams(n_probes=4, scan_engine="xla")
+        b1 = rng.standard_normal((3, 32)).astype(np.float32)
+        b2 = rng.standard_normal((2, 32)).astype(np.float32)
+        ex.search_ragged(pq_index, [b1, b2], 4, params_list=p1)
+        ex.search(pq_index, b1, 4, params=p1)        # bucketed leg
+        planes = ex.probe_frequencies()
+        (label,) = planes.keys()
+        assert label.startswith("ivf_pq-")
+        # every dispatched row probed exactly n_probes=4 lists
+        assert planes[label].sum() == (3 + 2 + 3) * 4
+
+    def test_residue_reasons(self, fam_setup, indexes, data):
+        pq_index, bq_index, _ = fam_setup
+        x, _ = data
+        ex = SearchExecutor()
+        assert ex.ragged_fallback_reason(
+            pq_index, 4, params=ivf_pq.IvfPqSearchParams(
+                scan_engine="rank")).startswith("scan_engine")
+        assert ex.ragged_fallback_reason(
+            pq_index, 4, params=ivf_pq.IvfPqSearchParams(
+                coarse_algo="approx")).startswith("coarse_algo")
+        assert ex.ragged_fallback_reason(
+            indexes["cagra"], 4,
+            params=cagra.CagraSearchParams(
+                itopk_size=16)).startswith("cagra")
+        assert ex.ragged_fallback_reason(
+            indexes["brute_force"], 4).startswith("brute_force")
+        # codes-only BQ resolves to the rank estimate scan
+        codes_only = ivf_bq.build(None, ivf_bq.IvfBqIndexParams(
+            n_lists=8, store_vectors=False), x)
+        assert ex.ragged_key(codes_only, 4) is None
+        assert "rank" in ex.ragged_fallback_reason(codes_only, 4)
+        # raggable combinations report no reason
+        assert ex.ragged_fallback_reason(
+            bq_index, 4, params=ivf_bq.IvfBqSearchParams(
+                scan_engine="xla")) is None
+
+
+class TestRaggedDualTile:
+    """The opt-in small/large tile pair: tile selection happens at
+    dispatch by packed-row count, the packing key never forks, and
+    steady state holds at ≤ 2 executables per params class."""
+
+    @pytest.fixture(scope="class")
+    def dual_setup(self):
+        rng = np.random.default_rng(29)
+        x = rng.standard_normal((1500, 24)).astype(np.float32)
+        index = ivf_flat.build(
+            None, ivf_flat.IvfFlatIndexParams(n_lists=16), x)
+        return x, index, rng
+
+    def test_warmup_compiles_both_tiles(self, dual_setup):
+        _, index, _ = dual_setup
+        p = ivf_flat.IvfFlatSearchParams(n_probes=6)
+        ex = SearchExecutor(ragged_tile=32, ragged_tile_small=8)
+        ex.warmup_ragged(index, k=4, params=p)
+        assert ex.ragged_executables() == 2
+        assert ex.ragged_executables("ivf_flat") == 2
+
+    def test_dispatch_selects_tile_and_stays_compiled(self, dual_setup):
+        _, index, rng = dual_setup
+        p = ivf_flat.IvfFlatSearchParams(n_probes=6)
+        ex = SearchExecutor(ragged_tile=32, ragged_tile_small=8)
+        ex.warmup_ragged(index, k=4, params=p)
+        tracing.install_xla_compile_listener()
+        small = [rng.standard_normal((3, 24)).astype(np.float32)]
+        big = [rng.standard_normal((9, 24)).astype(np.float32)
+               for _ in range(5)]
+        c0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        tracing.reset_counters("serving.execute.")
+        res_s = ex.search_ragged(index, small, 4, params_list=p)
+        res_b = ex.search_ragged(index, big, 4, params_list=p)
+        assert (tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+                - c0 == 0)
+        assert ex.ragged_executables() == 2
+        # the split counters attribute the dispatches per tile
+        assert tracing.get_counter(
+            "serving.execute.padded_rows.p8.t8") == 8.0
+        assert tracing.get_counter(
+            "serving.execute.padded_rows.p8.t32") == 64.0
+        from raft_tpu.serving import metrics as sv_metrics
+
+        by_class = sv_metrics.derived()["pad_waste_by_class"]
+        assert set(by_class) >= {"p8.t8", "p8.t32"}
+        # both tiles are bit-identical to the bucketed path
+        sd, si = ex.search(index, small[0], 4, params=p)
+        np.testing.assert_array_equal(res_s[0][1], np.asarray(si))
+        for b, (d, i) in zip(big, res_b):
+            _, si = ex.search(index, b, 4, params=p)
+            np.testing.assert_array_equal(i, np.asarray(si))
+
+    def test_tile_never_joins_the_key(self, dual_setup):
+        _, index, _ = dual_setup
+        p = ivf_flat.IvfFlatSearchParams(n_probes=6)
+        ex1 = SearchExecutor(ragged_tile=32)
+        ex2 = SearchExecutor(ragged_tile=32, ragged_tile_small=8)
+        assert (ex1.ragged_key(index, 4, params=p)
+                == ex2.ragged_key(index, 4, params=p))

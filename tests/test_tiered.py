@@ -650,3 +650,39 @@ class TestLivePlacementRace:
         d2, i2 = ex.search(t, q, 10, params=p)
         assert (np.asarray(d2) == d_ref).all()
         del i_ref, i2
+
+
+class TestRaggedFallback:
+    """graftragged compatibility pin: TieredIvf is documented
+    non-raggable residue — ragged_key refuses with the explicit
+    placement-epoch reason, and BatcherConfig(ragged=True) serves it
+    through the bucketed path bit-identical to a direct executor
+    call. If a tiered ragged front ever lands, THIS is the test that
+    must change — ragged=True cannot silently break grafttier either
+    way."""
+
+    def test_refusal_reason_pinned(self, tiered_index):
+        ex = SearchExecutor()
+        p = TieredSearchParams(n_probes=8)
+        assert ex.ragged_key(tiered_index, 5, params=p) is None
+        reason = ex.ragged_fallback_reason(tiered_index, 5, params=p)
+        assert reason.startswith("tiered_ivf:")
+        assert "placement-epoch" in reason
+
+    def test_ragged_batcher_falls_back_bucketed(self, data,
+                                                tiered_index):
+        from raft_tpu.serving import BatcherConfig, DynamicBatcher
+
+        _, q = data
+        ex = SearchExecutor()
+        p = TieredSearchParams(n_probes=8)
+        want_d, want_i = ex.search(tiered_index, q[:7], 5, params=p)
+        with DynamicBatcher(ex, BatcherConfig(max_wait_s=0.002,
+                                              ragged=True)) as b:
+            h = b.submit(tiered_index, q[:7], 5, params=p)
+            got_d, got_i = h.result(timeout=120)
+        np.testing.assert_array_equal(np.asarray(got_i),
+                                      np.asarray(want_i))
+        np.testing.assert_array_equal(np.asarray(got_d),
+                                      np.asarray(want_d))
+        assert ex.ragged_executables() == 0

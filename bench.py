@@ -46,7 +46,11 @@ latency, shed rate and batch occupancy next to the one-request-per-
 call baseline QPS), BENCH_BQ=1 (RaBitQ IVF-BQ: fused
 estimate-then-rerank vs estimate+refine recall at equal over-fetch,
 modeled bytes/vector and one-stream bytes vs the two-pass model,
-achieved GB/s vs the stream_read_sum roofline), BENCH_TIERED=1
+achieved GB/s vs the stream_read_sum roofline), BENCH_CAGRA=1
+(graftbeam CAGRA A/B: random-pool vs coarse-plane seeding vs
+coarse + BQ-coded traversal — recall, QPS, modeled gather bytes vs
+the stream roofline, survivor-fraction estimator replay, pad waste
+and compiles-during-measure), BENCH_TIERED=1
 (grafttier: hot/cold tiered storage — bit-identity vs the all-HBM
 index, hot GB/s vs the HBM roofline and cold GB/s vs a host-link
 roofline, two live placement epochs with zero backend compiles and
@@ -633,6 +637,15 @@ def child_main():
         except Exception as e:  # noqa: BLE001 — keep headline record
             log(f"bq rider failed ({e}); keeping headline record")
 
+    # opt-in rider: graftbeam — the rebuilt CAGRA serving path, three
+    # seed/traversal arms on one index with modeled gather bytes
+    if os.environ.get("BENCH_CAGRA") == "1" and last_rec:
+        try:
+            last_rec["cagra"] = _cagra_rider()
+            print(json.dumps(last_rec), flush=True)
+        except Exception as e:  # noqa: BLE001 — keep headline record
+            log(f"cagra rider failed ({e}); keeping headline record")
+
     # opt-in rider: grafttier — hot/cold tiered storage under the
     # dual-roofline accounting, with placement epochs live
     if os.environ.get("BENCH_TIERED") == "1" and last_rec:
@@ -1017,6 +1030,187 @@ def _bq_rider():
         "estimate_refine_recall": round(est_recall, 4),
         "estimate_at_k_recall": round(recall(i_ek), 4),
     }
+
+
+def _cagra_rider():
+    """BENCH_CAGRA=1 rider: the graftbeam A/B — three arms of the
+    rebuilt CAGRA serving path on ONE index (seed plane + BQ record
+    plane built once):
+
+    - ``pool``: the legacy query-aware strided seed pool at a big
+      ``seed_pool`` budget;
+    - ``coarse``: IVF-coarse seeding from the build-time k-means seed
+      plane at an 8x smaller ``seed_pool`` — the frontier-shift claim
+      is ``pool_shrink_factor`` next to the two recall columns;
+    - ``coarse_bq``: coarse seeding + BQ-coded traversal — graph
+      neighbors scored by the packed-record XOR+popcount estimate,
+      exact distances DMA'd only for estimate-survivors.
+
+    Each arm reports recall@K, QPS, and a deterministic modeled
+    gather-byte account (seed-stage rows + per-iteration candidate
+    gathers; the BQ arm charges the record plane ONCE — its tile
+    loads are VMEM-resident — plus the survivor fraction of raw-row
+    DMAs, where the survivor fraction is a host-side replay of the
+    shared estimator margin rule against each query's TRUE k-th
+    distance) against a ``stream_read_sum`` roofline. ``compiles_during_measure`` must stay 0 — every arm
+    serves AOT through the executor — and ``raggable`` records that
+    the default-params CAGRA plan joins the ragged family (the PR 15
+    fallback pin retired).
+
+    Env knobs: BENCH_CAGRA_N / BENCH_CAGRA_DEG / BENCH_CAGRA_BITS /
+    BENCH_CAGRA_POOL / BENCH_CAGRA_COARSE_POOL / BENCH_CAGRA_SECONDS.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from raft_tpu import SearchExecutor
+    from raft_tpu.bench.prims import timeit_stats
+    from raft_tpu.core import tracing
+    from raft_tpu.neighbors import brute_force, cagra
+    from raft_tpu.ops.bq_scan import (
+        _block_estimate,
+        auto_query_bits,
+        unpack_bq_records,
+    )
+    from raft_tpu.ops.fused_topk import stream_read_sum
+
+    n = int(os.environ.get("BENCH_CAGRA_N", 100_000))
+    deg = int(os.environ.get("BENCH_CAGRA_DEG", 32))
+    bits = int(os.environ.get("BENCH_CAGRA_BITS", 2))
+    pool_big = int(os.environ.get("BENCH_CAGRA_POOL", 8192))
+    pool_small = int(os.environ.get("BENCH_CAGRA_COARSE_POOL", 1024))
+    budget = float(os.environ.get("BENCH_CAGRA_SECONDS", 8))
+    kd, kq = jax.random.split(jax.random.key(11))
+    x = jax.random.normal(kd, (n, D), jnp.float32)
+    queries = jax.random.normal(kq, (BATCH, D), jnp.float32)
+    log(f"cagra rider: building graph index ({n}x{D}, degree {deg}, "
+        f"seed plane + {bits}-bit BQ record plane)")
+    index = cagra.build(None, cagra.CagraIndexParams(
+        graph_degree=deg, bq_bits=bits), x)
+    jax.block_until_ready(index.graph)
+    _, gt = brute_force.knn(None, x, queries, K)
+    gt = np.asarray(gt)
+
+    def recall(ids):
+        ids = np.asarray(ids)
+        return float(np.mean([len(set(ids[r]) & set(gt[r])) / K
+                              for r in range(ids.shape[0])]))
+
+    itemsize = jnp.dtype(index.dataset.dtype).itemsize
+    interp = jax.default_backend() != "tpu"
+    st = timeit_stats(
+        lambda: stream_read_sum(index.dataset, interpret=interp),
+        min(budget, 6.0))
+    roof_gbps = index.dataset.size * itemsize / st["best_s"] / 1e9
+    log(f"cagra roofline (stream_read_sum dataset): "
+        f"{roof_gbps:.1f} GB/s")
+
+    # survivor fraction for the BQ arm: replay the SHARED estimator
+    # (the exact _block_estimate math both engines run) on a strided
+    # row sample against each query's TRUE k-th exact distance — a
+    # deterministic margin/prune-math signal, like the bq rider's
+    words = bits * ((D + 31) // 32)
+    de = ((D + 31) // 32) * 32
+    codes, rnorm, cfac, errw = unpack_bq_records(
+        index.bq_records, n, words, bits)
+    samp = jnp.arange(0, n, max(1, n // 4096))[:4096]
+    qrot = cagra._rotate_queries(queries, index.bq_rotation)
+    est, margin = _block_estimate(
+        qrot, index.bq_center_rot,
+        rnorm[samp][None, :], errw[samp][None, :],
+        jnp.transpose(cfac[samp]), codes[samp],
+        dim_ext=de, bits=bits, query_bits=auto_query_bits(bits),
+        epsilon=cagra.CagraSearchParams().bq_epsilon, ip_metric=False)
+    qf = np.asarray(queries, np.float32)
+    xf = np.asarray(index.dataset, np.float32)
+    d_all = (np.sum(qf * qf, 1)[:, None] + np.sum(xf * xf, 1)[None, :]
+             - 2.0 * qf @ xf.T)
+    kth = np.partition(d_all, K - 1, axis=1)[:, K - 1:K]
+    surv_frac = float(np.mean(
+        (np.asarray(est) - np.asarray(margin)) < kth))
+    log(f"cagra bq estimator replay: survivor fraction "
+        f"{surv_frac:.4f} over {int(samp.shape[0])} sampled rows")
+
+    cap = int(index.seed_members.shape[1])
+    n_lists = int(index.seed_centers.shape[0])
+    arms = {
+        "pool": cagra.CagraSearchParams(
+            seed_mode="pool", seed_pool=pool_big),
+        "coarse": cagra.CagraSearchParams(
+            seed_mode="coarse", seed_pool=pool_small),
+        "coarse_bq": cagra.CagraSearchParams(
+            seed_mode="coarse", seed_pool=pool_small,
+            bq_traversal="on"),
+    }
+    tracing.install_xla_compile_listener()
+    out = {"n": n, "dim": D, "degree": deg, "bits": bits, "k": K,
+           "batch": BATCH, "roofline_gbps": round(roof_gbps, 2),
+           "survivor_row_fraction": round(surv_frac, 4),
+           "pool_shrink_factor": round(pool_big / pool_small, 2)}
+    compiles_total = 0
+    for name, p in arms.items():
+        ex = SearchExecutor()
+        bucket = ex.bucket_for(BATCH)
+        ex.warmup(index, buckets=(bucket,), k=K, params=p)
+        b0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        stats = timeit_stats(
+            lambda: ex.search(index, queries, K, params=p), budget)
+        compiles = int(tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+                       - b0)
+        compiles_total += compiles
+        d_a, i_a = ex.search(index, queries, K, params=p)
+        cfg = cagra.derive_search_config(p, index, K)
+        c_width = cfg["w"] * deg
+        # seed stage: pool arm scores `seed_pool` strided raw rows per
+        # query; coarse scores the center plane (f32) once per query
+        # plus the probed lists' member rows
+        if name == "pool":
+            seed_bytes = BATCH * min(pool_big, n) * D * itemsize
+        else:
+            probes = max(1, min(-(-pool_small // cap), n_lists))
+            seed_bytes = BATCH * (n_lists * D * 4
+                                  + probes * cap * D * itemsize)
+        # traversal: C candidate gathers per iteration per query. The
+        # BQ arm's record-tile loads are VMEM-resident (the plane
+        # streams into VMEM ONCE — charged here), so its HBM side is
+        # only the survivor fraction of exact-row DMAs
+        hops = BATCH * cfg["max_iters"] * c_width
+        if name == "coarse_bq":
+            trav_bytes = (index.bq_records.size * 4
+                          + surv_frac * hops * D * itemsize)
+        else:
+            trav_bytes = hops * D * itemsize
+        model_bytes = int(seed_bytes + trav_bytes)
+        dt = stats["best_s"]
+        gbps = model_bytes / dt / 1e9
+        raggable = ex.ragged_key(index, K, params=p) is not None
+        log(f"cagra {name}: {dt * 1e3:.2f} ms/iter, recall@{K} "
+            f"{recall(i_a):.4f}, {gbps:.1f} GB/s modeled "
+            f"({gbps / roof_gbps:.3f} of roofline), "
+            f"{compiles} compiles during measure")
+        out[name] = {
+            "seed_pool": int(p.seed_pool),
+            "recall": round(recall(i_a), 4),
+            "best_s": round(dt, 6),
+            "qps": round(BATCH / dt, 2),
+            "model_bytes": model_bytes,
+            "model_gbps": round(gbps, 2),
+            "vs_roofline": round(gbps / roof_gbps, 4),
+            "compiles_during_measure": compiles,
+            "raggable": bool(raggable),
+        }
+    out["compiles_during_measure"] = compiles_total
+    out["raggable"] = int(all(out[a]["raggable"] for a in arms))
+    out["bq_byte_reduction"] = round(
+        out["coarse"]["model_bytes"]
+        / max(out["coarse_bq"]["model_bytes"], 1), 4)
+    # pad waste of the bucketed front at this batch size (the ragged
+    # family's pad behavior is gated by the serving rider's legs)
+    bucket = SearchExecutor().bucket_for(BATCH)
+    out["bucket"] = int(bucket)
+    out["pad_fraction"] = round(1.0 - BATCH / bucket, 4)
+    return out
 
 
 def _tiered_rider():

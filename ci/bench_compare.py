@@ -112,6 +112,17 @@ PINNED_ENV = {
     "BENCH_BQ_LISTS": "32",
     "BENCH_BQ_PROBES": "8",
     "BENCH_BQ_SECONDS": "2",
+    # graftbeam (PR 16): the CAGRA A/B rider — pool vs coarse-plane
+    # seeding vs coarse + BQ traversal on one small graph index; the
+    # coarse pool is pinned 8x under the legacy pool (the frontier
+    # claim the recall bands then hold at)
+    "BENCH_CAGRA": "1",
+    "BENCH_CAGRA_N": "8000",
+    "BENCH_CAGRA_DEG": "16",
+    "BENCH_CAGRA_BITS": "2",
+    "BENCH_CAGRA_POOL": "4096",
+    "BENCH_CAGRA_COARSE_POOL": "512",
+    "BENCH_CAGRA_SECONDS": "2",
     # grafttier (PR 14): tiered storage rider — half the lists cold,
     # dual rooflines, two live placement epochs
     "BENCH_TIERED": "1",
@@ -207,6 +218,26 @@ DEFAULT_TOLERANCES = {
     "bq.bytes_per_vector_codes": {"max_increase": 0},
     "bq.survivor_row_fraction": {"max_increase": 0.05},
     "bq.fused_qps": {"min_ratio": 0.30},
+    # graftbeam CAGRA rider (PR 16). Recall bands per arm (the pinned
+    # seeds make recall deterministic on CPU; the ratio band absorbs
+    # platform-precision wiggle); pool_shrink_factor is structural —
+    # the coarse arm must keep serving from a pool >= 8x smaller;
+    # compiles_during_measure pins the AOT steady state; raggable
+    # pins the retired per-block dispatch exemption (the default
+    # CAGRA plan must stay inside the ragged family). QPS keeps the
+    # wide wall-clock band; modeled byte columns are reported, and
+    # the BQ arm's byte reduction is banded loosely (the survivor
+    # fraction moves it only through margin/prune-math changes).
+    "cagra.pool.recall": {"min_ratio": 0.95},
+    "cagra.coarse.recall": {"min_ratio": 0.95},
+    "cagra.coarse_bq.recall": {"min_ratio": 0.95},
+    "cagra.coarse.qps": {"min_ratio": 0.30},
+    "cagra.coarse_bq.qps": {"min_ratio": 0.30},
+    "cagra.pool_shrink_factor": {"min_ratio": 1.0, "max_increase": 0},
+    "cagra.bq_byte_reduction": {"min_ratio": 0.9},
+    "cagra.compiles_during_measure": {"max_increase": 0},
+    "cagra.raggable": {"min_ratio": 1.0},
+    "cagra.survivor_row_fraction": {"max_increase": 0.05},
     # graftfleet continuous-capture overhead A/B (PR 12): the same
     # bucketed stream with real profiler windows armed. The RATIO
     # band is the tight one — p99 with the duty cycle on may not

@@ -354,3 +354,80 @@ def fold_shape(shape_expr: ast.AST, env: Optional[Env]) -> Optional[List[int]]:
             return None
         dims.append(int(v))
     return dims
+
+
+def upper_bound(expr: ast.AST, env: Optional[Env] = None,
+                _seen: Optional[Set[str]] = None):
+    """Best-effort numeric *upper bound* for shape arithmetic.
+
+    Where ``const_fold`` gives up the moment any input is dynamic,
+    this keeps going through the bounding constructs shape code
+    actually uses: ``min(n, CAP)`` is bounded by CAP even when ``n``
+    is a runtime value, ``a % b`` by ``b - 1``, ``a // c`` by
+    ``bound(a) // c``.  Assumes nonnegative operands — true for the
+    dimension arithmetic this serves — so products/sums of bounds are
+    bounds.  None when no finite bound can be established.
+    """
+    v = const_fold(expr, env)
+    if v is not None:
+        return v
+    _seen = _seen or set()
+    if isinstance(expr, ast.Name):
+        if env is None or expr.id in env.multi \
+                or expr.id not in env.bindings or expr.id in _seen:
+            return None
+        return upper_bound(env.bindings[expr.id], env, _seen | {expr.id})
+    if isinstance(expr, ast.Call):
+        nm = call_name(expr)
+        if nm == "min" and expr.args and not expr.keywords:
+            # min is bounded by ANY bounded arm
+            known = [b for b in (upper_bound(a, env, _seen)
+                                 for a in expr.args) if b is not None]
+            return min(known) if known else None
+        if nm == "max" and expr.args and not expr.keywords:
+            # max needs every arm bounded
+            bounds = [upper_bound(a, env, _seen) for a in expr.args]
+            if any(b is None for b in bounds):
+                return None
+            return max(bounds)
+        if nm == "int" and len(expr.args) == 1:
+            b = upper_bound(expr.args[0], env, _seen)
+            return None if b is None else int(b)
+        return None
+    if isinstance(expr, ast.BinOp):
+        if isinstance(expr.op, ast.Mod):
+            b = const_fold(expr.right, env)
+            return b - 1 if b is not None and b > 0 else None
+        left = upper_bound(expr.left, env, _seen)
+        if left is None:
+            return None
+        if isinstance(expr.op, (ast.Add, ast.Mult)):
+            right = upper_bound(expr.right, env, _seen)
+            if right is None:
+                return None
+            return left + right if isinstance(expr.op, ast.Add) \
+                else left * right
+        if isinstance(expr.op, (ast.FloorDiv, ast.Sub)):
+            # only a *constant* right keeps the bound direction sound
+            right = const_fold(expr.right, env)
+            if right is None:
+                return None
+            if isinstance(expr.op, ast.Sub):
+                return left - right
+            return left // right if right > 0 else None
+    return None
+
+
+def shape_upper_bound(shape_expr: ast.AST,
+                      env: Optional[Env]) -> Optional[List[int]]:
+    """Per-dim upper bounds for a literal shape tuple; None when any
+    dim admits no finite bound."""
+    if not isinstance(shape_expr, (ast.Tuple, ast.List)):
+        return None
+    dims: List[int] = []
+    for el in shape_expr.elts:
+        v = upper_bound(el, env)
+        if v is None:
+            return None
+        dims.append(int(v))
+    return dims

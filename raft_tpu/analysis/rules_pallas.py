@@ -192,14 +192,23 @@ def check_pallas_budget(project: Project) -> Iterable[Finding]:
                     else:
                         vmem_limit = astutil.const_fold(vl, env)
 
-            # static VMEM estimate — only when every shape folds
+            # static VMEM estimate — exact when every shape folds;
+            # when a dim doesn't const-fold, fall back to a symbolic
+            # upper bound (min(n, CAP) is bounded by CAP even when n
+            # is runtime) so bounded-dynamic kernels stay inside the
+            # rule's reach instead of silently escaping it
             blockspecs, scratch = _collect_specs(call, fn)
             total = 0
             all_static = bool(blockspecs or scratch)
+            bounded = False
             for bs in blockspecs:
                 shape = bs.args[0] if bs.args else _kw(bs, "block_shape")
                 dims = astutil.fold_shape(shape, env) if shape is not None \
                     else None
+                if dims is None and shape is not None:
+                    dims = astutil.shape_upper_bound(shape, env)
+                    if dims is not None:
+                        bounded = True
                 if dims is None:
                     all_static = False
                     break
@@ -209,8 +218,12 @@ def check_pallas_budget(project: Project) -> Iterable[Finding]:
                 total += 2 * n * 4  # double-buffered, f32-conservative
             if all_static:
                 for sc in scratch:
-                    dims = astutil.fold_shape(
-                        sc.args[0] if sc.args else None, env)
+                    shape = sc.args[0] if sc.args else None
+                    dims = astutil.fold_shape(shape, env)
+                    if dims is None and shape is not None:
+                        dims = astutil.shape_upper_bound(shape, env)
+                        if dims is not None:
+                            bounded = True
                     if dims is None:
                         all_static = False
                         break
@@ -223,9 +236,11 @@ def check_pallas_budget(project: Project) -> Iterable[Finding]:
                 budget = min(vmem_limit or VMEM_PHYSICAL_BYTES,
                              VMEM_PHYSICAL_BYTES)
                 if total > budget:
+                    kind = ("VMEM upper bound" if bounded
+                            else "static VMEM footprint")
                     out.append(Finding(
                         "R4", f.rel, call.lineno,
-                        f"static VMEM footprint ~{total >> 20} MiB "
+                        f"{kind} ~{total >> 20} MiB "
                         "(double-buffered blocks + scratch) exceeds "
                         f"the {int(budget) >> 20} MiB budget — shrink "
                         "the BlockSpecs or raise vmem_limit_bytes"))

@@ -152,10 +152,6 @@ class _Plan:
     has_state: bool = True
     qdtype: Any = jnp.float32
     qdim: int = 0
-    # thread the tile's absolute row offset as a traced scalar (CAGRA
-    # seeds are per absolute row, so oversized batches tile through one
-    # executable and stay bit-identical to the direct path)
-    pass_row0: bool = False
     # mesh-sharded (distributed) plans: abstract avals carry the index
     # arrays' NamedShardings, padded queries and the donated state
     # buffers are placed with these shardings before the call
@@ -523,7 +519,7 @@ class SearchExecutor:
             qt = queries[start:start + max_b]
             fwt = fw[start:start + max_b] if (
                 fw is not None and fw.ndim == 2) else fw
-            d, i = self._run(index, qt, k, params, fwt, kw, row0=start,
+            d, i = self._run(index, qt, k, params, fwt, kw,
                              trace_ids=trace_ids)
             outs_d.append(d)
             outs_i.append(i)
@@ -556,25 +552,12 @@ class SearchExecutor:
         a direct :meth:`search` of that block alone (bucketing pads
         with inert rows, so coalescing cannot perturb results).
 
-        CAGRA plans are the one family whose results depend on a row's
-        absolute position in the batch (seeds draw per absolute row, so
-        *tiles of one batch* are invariant but *concatenated requests*
-        would shift each other's rows) — those dispatch one call per
-        block, preserving the per-block bit-identity contract at the
-        cost of coalescing."""
+        Every family concatenates — CAGRA's seeds became a pure
+        function of query content (PR 16), which retired the last
+        per-block dispatch special case."""
         expect(len(blocks) > 0, "search_blocks needs at least one block")
         sizes = [int(np.shape(b)[0]) for b in blocks]
         fw = self._resolve_filter(sample_filter)
-        plan = self._plan(index, params, k, self.buckets[0], fw, kw)
-        if plan.pass_row0:
-            out, start = [], 0
-            for b, m in zip(blocks, sizes):
-                fwb = fw[start:start + m] if (
-                    fw is not None and fw.ndim == 2) else fw
-                out.append(self.search(index, b, k, params, fwb,
-                                       trace_ids=trace_ids, **kw))
-                start += m
-            return out
         if len(blocks) == 1:
             cat = blocks[0]
         elif all(isinstance(b, np.ndarray) for b in blocks):
@@ -601,16 +584,19 @@ class SearchExecutor:
 
         Raggable: every IVF family — flat, PQ, BQ, single-chip AND
         list-sharded mesh — through its membership-masked list-major
-        engine with exact coarse select. The documented non-raggable
-        residue: CAGRA (seeds draw per absolute row),
-        ``coarse_algo="approx"`` (no prefix property at the class
-        cap), the rank-major engines (no membership mask), codes-only
-        BQ (resolves to the rank estimate scan), brute force (no
-        probe plane), ``TieredIvf`` (the dual-tier fetch plan is
-        placement-epoch state — see :meth:`ragged_fallback_reason`),
-        the int8 probe wire (its per-query scales depend on the
-        candidate block, breaking cap-vs-solo bit-identity), and 2-D
-        query-sharded mesh grids.
+        engine with exact coarse select, and CAGRA (PR 16: seeds are
+        a pure function of query content; the per-row plane carries
+        iteration budgets and the params class rounds
+        ``max_iterations``). The documented non-raggable residue:
+        CAGRA whose ``k`` class cap exceeds ``itopk_size`` (the beam
+        buffer is the result surface), ``coarse_algo="approx"`` (no
+        prefix property at the class cap), the rank-major engines (no
+        membership mask), codes-only BQ (resolves to the rank
+        estimate scan), brute force (no probe plane), ``TieredIvf``
+        (the dual-tier fetch plan is placement-epoch state — see
+        :meth:`ragged_fallback_reason`), the int8 probe wire (its
+        per-query scales depend on the candidate block, breaking
+        cap-vs-solo bit-identity), and 2-D query-sharded mesh grids.
 
         Two submissions may share one packed ragged batch iff their
         keys are equal. Unlike :meth:`coalesce_key`, ``n_probes`` and
@@ -883,8 +869,9 @@ class SearchExecutor:
         "tiered": "tiered_ivf: the dual-tier fetch plan is "
                   "placement-epoch state (hot/cold slot maps swap "
                   "between dispatches) — bucketed path",
-        "cagra": "cagra: seeds draw per absolute row — per-block "
-                 "bucketed dispatch",
+        "cagra_k": "cagra: the k class cap exceeds itopk_size, so the "
+                   "class executable's beam buffer would differ from "
+                   "the solo run's — bucketed path",
         "brute_force": "brute_force: no probe plane to budget per "
                        "row — bucketed path",
         "approx": "coarse_algo='approx' has no prefix property at "
@@ -947,7 +934,8 @@ class SearchExecutor:
             from raft_tpu.neighbors.cagra import CagraIndex
 
             if isinstance(index, CagraIndex):
-                return None, reasons["cagra"]
+                return self._ragged_resolve_cagra(index, k, params, fw,
+                                                  kw)
             from raft_tpu.neighbors.brute_force import BruteForceIndex
 
             if isinstance(index, BruteForceIndex):
@@ -996,6 +984,38 @@ class SearchExecutor:
                 "n_probes": n_probes, "params_cls": params_cls,
                 "kw": kw}, None
 
+    def _ragged_resolve_cagra(self, index, k: int, params, fw, kw):
+        """CAGRA onto the ragged plan family (PR 16): seeds are a pure
+        function of query content, so any split packs; the per-row
+        budget plane carries each request's ITERATION budget (the role
+        ``n_probes`` plays for the IVF families), and the params class
+        rounds ``max_iterations`` up to a power of two — budget no-op
+        iterations are bit-neutral in both engines, so each row equals
+        its solo bucketed run. Only the class ``k`` cap must stay
+        under ``itopk_size``: the beam buffer IS the result surface,
+        and widening it would change the beam itself."""
+        from raft_tpu.neighbors import cagra as m
+
+        reasons = self._RAGGED_RESIDUE
+        if kw:
+            return None, reasons["kw"]
+        params = params or m.CagraSearchParams()
+        if index.graph.shape[0] == 0 or k <= 0:
+            return None, reasons["empty"]
+        k_class = _pow2_at_least(k, 8)
+        if k_class > params.itopk_size:
+            return None, reasons["cagra_k"]
+        cfg = m.derive_search_config(params, index, k)
+        iters_class = _pow2_at_least(cfg["max_iters"], 8)
+        params_cls = dataclasses.replace(params,
+                                         max_iterations=iters_class)
+        base = self._plan(index, params_cls, k_class, self.buckets[0],
+                          fw, kw)
+        return {"family": "cagra", "engine": base.static["engine"],
+                "np_class": iters_class, "k_class": k_class,
+                "n_probes": cfg["max_iters"], "params_cls": params_cls,
+                "kw": kw}, None
+
     # family tag -> (module, attr) of the packed ragged-batch twin of
     # that family's bucketed serving fn — each a thin wrapper over the
     # SAME search body with the per-row budget hook live, so the two
@@ -1006,6 +1026,7 @@ class SearchExecutor:
                      "_search_ragged_fn"),
         "ivf_pq": ("raft_tpu.neighbors.ivf_pq", "_search_ragged_fn"),
         "ivf_bq": ("raft_tpu.neighbors.ivf_bq", "_search_ragged_fn"),
+        "cagra": ("raft_tpu.neighbors.cagra", "_search_ragged_fn"),
         "dist_ivf_flat": ("raft_tpu.distributed.ivf",
                           "_dist_search_ragged_fn"),
         "dist_ivf_pq": ("raft_tpu.distributed.ivf",
@@ -1067,7 +1088,7 @@ class SearchExecutor:
 
         return resolve_filter_words(sample_filter)
 
-    def _run(self, index, queries, k, params, fw, kw, row0: int = 0,
+    def _run(self, index, queries, k, params, fw, kw,
              trace_ids: Tuple[int, ...] = ()):
         # grafttier placement race: an epoch swap DONATES the old hot
         # plane / slot maps, and a dispatch that captured the
@@ -1085,18 +1106,17 @@ class SearchExecutor:
         for _ in range(4):
             try:
                 return self._run_once(index, queries, k, params, fw,
-                                      kw, row0=row0,
-                                      trace_ids=trace_ids)
+                                      kw, trace_ids=trace_ids)
             except (RuntimeError, ValueError) as e:
                 if "deleted" not in str(e).lower():
                     raise
                 tracing.inc_counter(
                     "serving.execute.placement_retries")
         return self._run_once(index, queries, k, params, fw, kw,
-                              row0=row0, trace_ids=trace_ids)
+                              trace_ids=trace_ids)
 
     def _run_once(self, index, queries, k, params, fw, kw,
-                  row0: int = 0, trace_ids: Tuple[int, ...] = ()):
+                  trace_ids: Tuple[int, ...] = ()):
         q = int(np.shape(queries)[0])
         bucket = self.bucket_for(q)
         plan = self._plan(index, params, k, bucket, fw, kw)
@@ -1106,8 +1126,6 @@ class SearchExecutor:
         if plan.qsharding is not None:
             qp = jax.device_put(qp, plan.qsharding)
         args = list(plan.pre) + [qp]
-        if plan.pass_row0:
-            args.append(jnp.asarray(row0, jnp.int32))
         args.extend(plan.post)
         if plan.use_filter:
             fwp = fw
@@ -1436,8 +1454,6 @@ class SearchExecutor:
         if plan.ragged:
             # per-row probe-budget plane of the packed ragged batch
             args.append(jax.ShapeDtypeStruct((bucket,), jnp.int32))
-        if plan.pass_row0:
-            args.append(jax.ShapeDtypeStruct((), jnp.int32))
         args.extend(sds(a) for a in plan.post)
         if plan.use_filter:
             fw_spec = plan.key[-1]  # _filter_spec tuple
@@ -1887,9 +1903,13 @@ class SearchExecutor:
         engine = resolve_bq_engine(
             params.scan_engine, data=index.data, filter_words=fw, k=k,
             dim_ext=index.dim_ext, bits=index.bits, n_probes=n_probes)
+        from raft_tpu.ops.bq_scan import auto_query_bits
+
+        qb = params.query_bits or auto_query_bits(index.bits)
         static = {"n_probes": n_probes, "k": k,
                   "metric": index.metric, "coarse_algo": params.coarse_algo,
-                  "scan_engine": engine, "epsilon": params.epsilon}
+                  "scan_engine": engine, "epsilon": params.epsilon,
+                  "query_bits": qb}
         arrays = (index.centers, index.rotation, index.codes, index.rnorm,
                   index.cfac, index.errw, index.indices, index.data,
                   index.data_norms)
@@ -1906,25 +1926,36 @@ class SearchExecutor:
 
     def _plan_cagra(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import cagra as m
+        from raft_tpu.ops.bq_scan import auto_query_bits
 
         params = params or m.CagraSearchParams()
         use_kernel = m._resolve_search_algo(params, index, fw)
-        static = dict(m.derive_search_config(params, index, k, self.res.seed),
-                      metric=index.metric, seed_pool=params.seed_pool)
-        if use_kernel:
-            static["deg"] = index.graph_degree
-            static["interpret"] = jax.default_backend() != "tpu"
-            arrays = (index.dataset, index.padded_graph)
-            key = ("cagra_kernel", bucket, _sig(*arrays),
-                   tuple(sorted((n, str(v)) for n, v in static.items())),
-                   _filter_spec(None))
-            return _Plan(key=key, fn=m._serving_kernel_fn, static=static,
-                         pre=arrays, has_state=False, qdim=index.dim,
-                         pass_row0=True)
-        arrays = (index.dataset, index.graph)
-        key = ("cagra_xla", bucket, _sig(*arrays),
+        seed_mode = m._resolve_seed_mode(params, index)
+        use_bq = m._resolve_bq_traversal(params, index, use_kernel)
+        engine = "pallas" if use_kernel else "xla"
+        # seeds are a pure function of query content (PR 16), so one
+        # "cagra" family serves any block mix — the resolved engine and
+        # plane presence join the statics/key exactly like ivf_bq's
+        static = dict(m.derive_search_config(params, index, k),
+                      metric=index.metric, engine=engine,
+                      seed_mode=seed_mode, seed_pool=params.seed_pool,
+                      bq_bits=index.bq_bits if use_bq else 0,
+                      bq_query_bits=(auto_query_bits(index.bq_bits)
+                                     if use_bq else 4),
+                      bq_epsilon=params.bq_epsilon,
+                      deg=index.graph_degree,
+                      interpret=jax.default_backend() != "tpu")
+        arrays = (index.dataset,
+                  index.padded_graph if use_kernel else index.graph,
+                  index.seed_centers, index.seed_members,
+                  index.bq_rotation if use_bq else None,
+                  index.bq_center_rot if use_bq else None,
+                  index.bq_records if use_bq else None)
+        key = ("cagra", bucket,
+               _sig(*(a for a in arrays if a is not None)),
+               ("planes", index.seed_centers is not None, use_bq),
                tuple(sorted((n, str(v)) for n, v in static.items())),
-               _filter_spec(fw))
-        return _Plan(key=key, fn=m._serving_xla_fn, static=static,
-                     pre=arrays, use_filter=True, has_state=False,
-                     qdim=index.dim, pass_row0=True)
+               _filter_spec(fw if not use_kernel else None))
+        return _Plan(key=key, fn=m._serving_fn, static=static,
+                     post=arrays, use_filter=not use_kernel,
+                     has_state=False, qdim=index.dim)

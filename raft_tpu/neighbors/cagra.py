@@ -27,6 +27,20 @@ TPU re-design:
   re-proposed candidates can never re-enter unexplored, so termination
   ("all buffer entries explored") is exact. Queries are tiled host-side;
   every shape is static.
+- **seeding**: every beam starts from a build-time IVF-coarse *seed
+  plane* (balanced k-means centers + a padded member table, serialized
+  with the index): a query probes its nearest centroids and the beam
+  opens from the best member rows — a pure function of query CONTENT,
+  never of batch position, so blocks concatenate and CAGRA serves
+  through the executor's batched + ragged plans like every other
+  family. Indexes without the plane (``from_graph``, hnswlib loads)
+  fall back to the query-aware strided pool, which is content-pure too.
+- **BQ-coded traversal** (opt-in ``bq_bits`` at build): gathered graph
+  neighbors are first scored by the RaBitQ XOR+popcount estimate
+  against a packed per-row code plane and only estimate-survivors are
+  exactly reranked — ``ops/bq_scan``'s estimate-then-rerank discipline
+  on the beam's neighbor-gather path, in BOTH engines (the Pallas
+  kernel skips the raw-row DMA for survivor-free batches).
 """
 
 from __future__ import annotations
@@ -60,7 +74,7 @@ from raft_tpu.neighbors.filters import resolve_filter_words, test_filter
 from raft_tpu.neighbors.nn_descent import _reverse_sample
 from raft_tpu.neighbors.refine import refine
 
-_SERIALIZATION_VERSION = 4
+_SERIALIZATION_VERSION = 5
 
 
 class BuildAlgo(enum.Enum):
@@ -93,6 +107,16 @@ class CagraIndexParams:
     # storage_dtype: None keeps the input dtype; accepts a dtype or
     # its name (JSON configs pass "bfloat16").
     storage_dtype: Optional[Any] = None
+    # coarse seed plane: number of balanced-k-means lists trained at
+    # build time for IVF-coarse beam seeding. 0 → auto (≈ sqrt(n),
+    # capped at 1024). The plane is always built — it is the batching-
+    # invariant seed source — and serializes with the index.
+    seed_n_lists: int = 0
+    # BQ-coded traversal plane: RaBitQ code bits per dimension level
+    # (1..4) packed into the per-row record plane the beam's
+    # estimate-then-rerank phase scores against. 0 (default) skips the
+    # plane; traversal then always reranks exactly.
+    bq_bits: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,14 +129,27 @@ class CagraSearchParams:
     search_width: int = 1
     max_iterations: int = 0
     num_random_samplings: int = 1
-    rand_xor_mask: int = 0x128394  # seed salt, role of the reference field
     query_tile: int = 256
-    # Query-aware seeding (beyond the reference): score this many
-    # strided dataset rows per query and start the beam from the best
-    # of them instead of uniform-random ids. One extra (q, pool) MXU
-    # tile; on clustered data it removes the "did a random seed land in
-    # the right cluster" recall ceiling. 0 = reference behavior.
+    # Rows scored per query before the beam opens: in "coarse" mode the
+    # member rows of ~ceil(seed_pool / list_cap) probed lists, in
+    # "pool" mode a strided dataset sample of this width. 0 → auto
+    # (max(256, 4·n_seeds)). The coarse plane reaches the pool-mode
+    # entry quality at ~8× smaller pools — the probed lists are the
+    # query's own neighborhoods, not a blind stride.
     seed_pool: int = 0
+    # "coarse": IVF-coarse seeding from the build-time seed plane
+    # (requires it); "pool": the query-aware strided pool; "auto":
+    # coarse when the index carries the plane, else pool. Every mode is
+    # a pure function of query content — batching-invariant.
+    seed_mode: str = "auto"
+    # "on": estimate-then-rerank neighbor scoring against the build-time
+    # BQ record plane (requires bq_bits ≥ 1 at build); "off": always
+    # rerank exactly; "auto": on when the plane exists (and, on the
+    # kernel path, fits the VMEM budget).
+    bq_traversal: str = "auto"
+    # RaBitQ margin multiplier for the traversal prune — same role as
+    # IvfBqSearchParams.epsilon (3σ of the estimator error model).
+    bq_epsilon: float = 3.0
     # "pallas": the one-dispatch VMEM-resident beam-search kernel
     # (ops/beam_search, role of the reference's persistent single-CTA
     # kernel); "xla": the lax.while_loop path; "auto": pallas on TPU
@@ -131,13 +168,29 @@ class CagraIndex:
     dataset: jax.Array      # (n, d)
     graph: jax.Array        # (n, graph_degree) int32
     metric: DistanceType
+    # IVF-coarse seed plane (built by :func:`build`, None on directly
+    # assembled indexes): balanced-k-means centers + the -1-padded
+    # member table mapping each list to its dataset rows
+    seed_centers: Optional[jax.Array] = None    # (n_lists, d) f32
+    seed_members: Optional[jax.Array] = None    # (n_lists, cap) int32
+    # BQ traversal plane (built when CagraIndexParams.bq_bits ≥ 1):
+    # the pinned rotation, the rotated global center row, and the
+    # packed per-row record plane of ops/bq_scan.pack_bq_records
+    bq_rotation: Optional[jax.Array] = None     # (dim_ext, d) f32
+    bq_center_rot: Optional[jax.Array] = None   # (1, dim_ext) f32
+    bq_records: Optional[jax.Array] = None      # (T, PW) int32
+    bq_bits: int = 0
 
     def tree_flatten(self):
-        return (self.dataset, self.graph), (self.metric,)
+        return ((self.dataset, self.graph, self.seed_centers,
+                 self.seed_members, self.bq_rotation, self.bq_center_rot,
+                 self.bq_records),
+                (self.metric, self.bq_bits))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], aux[0])
+        return cls(children[0], children[1], aux[0], *children[2:],
+                   bq_bits=aux[1])
 
     @property
     def size(self) -> int:
@@ -336,6 +389,66 @@ def optimize(
         return _merge_forward_reverse(knn_graph, fwd, rev, out_degree)
 
 
+def _auto_seed_lists(n: int) -> int:
+    """Default coarse-plane list count: ≈ sqrt(n) puts ~sqrt(n) rows in
+    each list, so one probed list already carries a beam's worth of
+    entry candidates; 1024 caps the center-scoring GEMM."""
+    return max(1, min(1024, int(round(np.sqrt(max(n, 1))))))
+
+
+def _build_seed_plane(res, dataset, metric: DistanceType, n_lists: int):
+    """Train the IVF-coarse seed plane: balanced-k-means centers plus a
+    dense -1-padded member table (list → dataset rows). Always built by
+    :func:`build` — it is the batching-invariant seed source the
+    serving path's block-concatenation rests on."""
+    from raft_tpu.cluster import kmeans_balanced
+
+    x = jnp.asarray(dataset).astype(jnp.float32)
+    n = x.shape[0]
+    n_lists = min(n_lists or _auto_seed_lists(n), n)
+    km = kmeans_balanced.KMeansBalancedParams(
+        metric=DistanceType(metric), seed=res.seed)
+    centers, labels, sizes = kmeans_balanced.build_clusters(
+        res, km, x, n_lists)
+    labels_np = np.asarray(labels)
+    cap = max(1, int(np.asarray(sizes).max()))
+    members = np.full((n_lists, cap), -1, np.int32)
+    order = np.argsort(labels_np, kind="stable")
+    sl = labels_np[order]
+    ranks = np.arange(n) - np.searchsorted(sl, sl)
+    members[sl, ranks] = order
+    # drop empty lists (degenerate data collapses k-means): a probed
+    # empty list would contribute zero valid seeds, and a query whose
+    # every probe lands empty would open the beam with no entries
+    keep = np.flatnonzero(np.asarray(sizes) > 0)
+    if keep.size < n_lists:
+        centers = jnp.asarray(np.asarray(centers)[keep])
+        members = members[keep]
+    return centers.astype(jnp.float32), jnp.asarray(members)
+
+
+def _build_bq_plane(dataset, bits: int, seed: int):
+    """Encode the dataset into the packed BQ traversal plane: the
+    ivf_bq pinned rotation + per-row RaBitQ codes about the GLOBAL
+    dataset mean (one center, so the beam estimator needs no per-list
+    bookkeeping), packed per-row by
+    :func:`raft_tpu.ops.bq_scan.pack_bq_records`."""
+    from raft_tpu.neighbors.ivf_bq import _encode, _pinned_rotation
+    from raft_tpu.ops.bq_scan import pack_bq_records
+
+    x = jnp.asarray(dataset).astype(jnp.float32)
+    d = x.shape[1]
+    dim_ext = -(-d // 32) * 32
+    rotation = _pinned_rotation(seed, dim_ext, d)
+    center = jnp.mean(x, axis=0, keepdims=True)
+    center_rot = jnp.einsum("od,ed->oe", center, rotation,
+                            precision=jax.lax.Precision.HIGHEST)
+    rot = jnp.einsum("nd,ed->ne", x - center, rotation,
+                     precision=jax.lax.Precision.HIGHEST)
+    codes, rnorm, cfac, errw = _encode(rot, bits)
+    return rotation, center_rot, pack_bq_records(codes, rnorm, cfac, errw)
+
+
 def build(
     res: Optional[Resources],
     params: CagraIndexParams,
@@ -409,11 +522,27 @@ def build(
                 params.refine_rate,
             )
         graph = optimize(res, knn_graph, odeg)
+        seed_centers, seed_members = _build_seed_plane(
+            res, dataset, params.metric, params.seed_n_lists)
+        bq_rotation = bq_center_rot = bq_records = None
+        if params.bq_bits:
+            expect(1 <= params.bq_bits <= 4,
+                   f"bq_bits must be 0 (off) or 1..4, got {params.bq_bits}")
+            bq_rotation, bq_center_rot, bq_records = _build_bq_plane(
+                dataset, params.bq_bits, res.seed)
         stored = dataset
         if params.storage_dtype is not None:
             stored = jnp.asarray(dataset).astype(params.storage_dtype)
-        return CagraIndex(dataset=res.put(stored), graph=graph,
-                          metric=DistanceType(params.metric))
+        return CagraIndex(
+            dataset=res.put(stored), graph=graph,
+            metric=DistanceType(params.metric),
+            seed_centers=res.put(seed_centers),
+            seed_members=res.put(seed_members),
+            bq_rotation=None if bq_rotation is None else res.put(bq_rotation),
+            bq_center_rot=(None if bq_center_rot is None
+                           else res.put(bq_center_rot)),
+            bq_records=None if bq_records is None else res.put(bq_records),
+            bq_bits=params.bq_bits)
 
 
 def from_graph(res, dataset, graph,
@@ -474,38 +603,35 @@ def _pooled_seeds(dataset, queries, pool: int, n_seeds: int,
     return cand[pos]
 
 
-@partial(jax.jit, static_argnames=("rows", "n_seeds", "n"))
-def _draw_seeds(base_key, row0, rows: int, n_seeds: int, n: int):
-    """Per-row seed draws, invariant to batching: row ``r`` of any call
-    derives everything from ``fold_in(base_key, row0 + r)``, so a query
-    at a given absolute position gets the same seeds no matter how the
-    batch was tiled, padded or bucketed — the property the serving
-    path's bit-identical-results guarantee rests on.
-
-    Each row takes a random offset plus an even stride over the id
-    space (iid uniform draws can leave whole clusters unsampled; the
-    stride guarantees coverage, the per-row random offset and jitter
-    keep rows decorrelated). Duplicate draws are harmless — the beam
-    merge dedups them."""
-    rids = row0 + jnp.arange(rows)
-    keys = jax.vmap(lambda r: jax.random.fold_in(base_key, r))(rids)
-    stride = max(1, n // n_seeds)
-
-    def one(kk):
-        off, jit_k = jax.random.split(kk)
-        base = jax.random.randint(off, (), 0, n, jnp.int32)
-        jitter = jax.random.randint(jit_k, (n_seeds,), 0, stride, jnp.int32)
-        lattice = jnp.arange(n_seeds, dtype=jnp.int32) * stride
-        return (base + lattice + jitter) % n
-
-    return jax.vmap(one)(keys)
+@partial(jax.jit, static_argnames=("n_probes", "n_seeds", "metric"))
+def _coarse_seeds(dataset, centers, members, queries, *, n_probes: int,
+                  n_seeds: int, metric: DistanceType):
+    """IVF-coarse seeding: each query probes its ``n_probes`` nearest
+    seed-plane centers, gathers their member rows, and the beam opens
+    from the ``n_seeds`` best of them. Strictly row-wise (one GEMM on
+    the center plane + one gathered-distance tile), hence a pure
+    function of query content — the batching-invariance contract."""
+    qf = queries.astype(jnp.float32)
+    ip = jnp.einsum("qd,cd->qc", qf, centers,
+                    precision=jax.lax.Precision.HIGHEST)
+    if metric == DistanceType.InnerProduct:
+        cdist = -ip
+    else:
+        cdist = jnp.sum(jnp.square(centers), axis=1)[None, :] - 2.0 * ip
+    _, probes = jax.lax.top_k(-cdist, n_probes)          # (q, n_probes)
+    cand = jnp.take(members, probes, axis=0).reshape(qf.shape[0], -1)
+    d = gathered_distances(qf, dataset, cand, metric)    # -1 pads → inf
+    _, pos = jax.lax.top_k(-d, n_seeds)
+    seeds = jnp.take_along_axis(cand, pos, axis=1)
+    return jnp.where(
+        jnp.isfinite(jnp.take_along_axis(d, pos, axis=1)), seeds, -1)
 
 
-def derive_search_config(params: "CagraSearchParams", index: "CagraIndex",
-                         k: int, seed: int) -> dict:
-    """THE beam-search shape derivation (L, w, max_iters, n_seeds,
-    seed_salt), shared by :func:`search` and the serving path
-    (``core/executor.py``) — their bit-identity depends on these five
+def derive_search_config(params: "CagraSearchParams",
+                         index: "CagraIndex", k: int) -> dict:
+    """THE beam-search shape derivation (L, w, max_iters, n_seeds),
+    shared by :func:`search` and the serving path
+    (``core/executor.py``) — their bit-identity depends on these
     values agreeing, so they are derived in exactly one place.
 
     One seed-count formula for both engines (their parity depends on
@@ -523,35 +649,109 @@ def derive_search_config(params: "CagraSearchParams", index: "CagraIndex",
         "w": w,
         "max_iters": params.max_iterations or (L // w + 24),
         "n_seeds": n_seeds,
-        "seed_salt": seed ^ params.rand_xor_mask,
     }
 
 
-def _make_seeds(dataset, qt, row0, n_seeds: int, metric: DistanceType,
-                seed_pool: int, base_key):
+def _resolve_seed_mode(params: CagraSearchParams,
+                       index: CagraIndex) -> str:
+    """Resolve ``params.seed_mode`` against what the index carries."""
+    mode = params.seed_mode
+    expect(mode in ("auto", "coarse", "pool"),
+           f"seed_mode must be 'auto'/'coarse'/'pool', got {mode!r}")
+    if mode == "coarse":
+        expect(index.seed_centers is not None,
+               "seed_mode='coarse' needs the build-time seed plane "
+               "(cagra.build); this index was assembled without one")
+        return "coarse"
+    if mode == "auto" and index.seed_centers is not None:
+        return "coarse"
+    return "pool"
+
+
+def _make_seeds(dataset, seed_centers, seed_members, qt, n_seeds: int,
+                metric: DistanceType, seed_mode: str, seed_pool: int):
     """Shared seed policy for the direct and serving search paths:
-    query-aware pooled seeds when ``seed_pool > 0``, else per-row
-    uniform draws (both rowwise — pad rows cannot perturb real rows)."""
+    IVF-coarse seeds from the build-time plane, or the query-aware
+    strided pool for plane-less indexes. Both are pure functions of
+    query content (row-wise) — blocks concatenate, pad rows cannot
+    perturb real rows, and the ragged family can pack any split."""
     n = dataset.shape[0]
-    if seed_pool > 0:
-        seeds = _pooled_seeds(dataset, qt, min(seed_pool, n),
-                              min(n_seeds, seed_pool, n), metric)
-        if seeds.shape[1] < n_seeds:
-            # pad to the shared width by repeating the best seeds
-            # (dedup makes repeats free)
-            reps = -(-n_seeds // seeds.shape[1])
-            seeds = jnp.tile(seeds, (1, reps))[:, :n_seeds]
-        return seeds
-    return _draw_seeds(base_key, row0, qt.shape[0], n_seeds, n)
+    pool = seed_pool if seed_pool > 0 else max(256, 4 * n_seeds)
+    if seed_mode == "coarse":
+        cap = seed_members.shape[1]
+        n_probes = max(1, min(-(-pool // cap), seed_centers.shape[0]))
+        seeds = _coarse_seeds(
+            dataset, seed_centers, seed_members, qt, n_probes=n_probes,
+            n_seeds=min(n_seeds, n_probes * cap), metric=metric)
+    else:
+        pool = min(pool, n)
+        seeds = _pooled_seeds(dataset, qt, pool, min(n_seeds, pool),
+                              metric)
+    if seeds.shape[1] < n_seeds:
+        # pad to the shared width by repeating the best seeds
+        # (dedup makes repeats free)
+        reps = -(-n_seeds // seeds.shape[1])
+        seeds = jnp.tile(seeds, (1, reps))[:, :n_seeds]
+    return seeds
 
 
-def _search_batch_fn(dataset, graph, queries, seed_ids, filter_words, *,
+def _rotate_queries(queries, rotation):
+    """Rotate queries into the BQ estimator basis — ONE implementation
+    for both engines and both call paths, so the estimate inputs (and
+    hence the prune decisions) are bit-identical everywhere."""
+    return jnp.einsum("qd,ed->qe", queries.astype(jnp.float32), rotation,
+                      precision=jax.lax.Precision.HIGHEST)
+
+
+def _resolve_bq_traversal(params: CagraSearchParams, index: CagraIndex,
+                          use_kernel: bool) -> bool:
+    """Resolve ``params.bq_traversal`` against the index plane and (on
+    the kernel path) the VMEM budget the record plane must co-reside
+    in."""
+    mode = params.bq_traversal
+    expect(mode in ("auto", "on", "off"),
+           f"bq_traversal must be 'auto'/'on'/'off', got {mode!r}")
+    if mode == "off":
+        return False
+    if index.bq_records is None:
+        expect(mode != "on",
+               "bq_traversal='on' needs an index built with bq_bits >= 1")
+        return False
+    if use_kernel:
+        from raft_tpu.ops.fused_topk import _default_vmem_mb
+
+        # same rule the kernel wrapper enforces: the plane is
+        # VMEM-resident in both dataset modes and must leave the ~8 MB
+        # scratch headroom (the dataset then places around it)
+        fits = (4 * index.bq_records.size
+                <= (_default_vmem_mb() - 8) * 1024 * 1024)
+        if mode == "on":
+            expect(fits, "bq_traversal='on': the BQ record plane "
+                   "exceeds the kernel VMEM budget")
+        return fits
+    return True
+
+
+def _search_batch_fn(dataset, graph, queries, seed_ids, filter_words,
+                     row_iters=None, bq_records=None, bq_qrot=None,
+                     bq_center_rot=None, *,
                      k: int, L: int, w: int, max_iters: int,
-                     metric: DistanceType):
+                     metric: DistanceType, bq_bits: int = 0,
+                     bq_query_bits: int = 4, bq_epsilon: float = 3.0):
+    """The XLA beam engine. ``row_iters`` (q,) optionally caps each
+    row's live iterations (the ragged-serving budget — iterations past
+    it are bit-exact no-ops for that row). ``bq_records``/``bq_qrot``/
+    ``bq_center_rot`` enable the estimate-then-prune candidate gate —
+    the same shared :func:`raft_tpu.ops.bq_scan._block_estimate` math
+    as the Pallas kernel, so prune decisions (and hence results) are
+    engine-parity-exact. This engine still gathers every candidate row
+    (it is the portable correctness engine); only the kernel converts
+    the prune into skipped DMA traffic."""
     q, dim = queries.shape
     n, deg = graph.shape
     qf = queries.astype(jnp.float32)
     ip_metric = metric == DistanceType.InnerProduct
+    use_bq = bq_records is not None
 
     def score(cand):                                     # (q, c) ids → dists
         d = gathered_distances(qf, dataset, cand, metric)
@@ -562,16 +762,60 @@ def _search_batch_fn(dataset, graph, queries, seed_ids, filter_words, *,
             d = jnp.where(test_filter(filter_words, cand), d, jnp.inf)
         return d
 
-    # random seeding (role of the reference's random_samplings)
-    seed_d = score(seed_ids)
-    ids, dists, explored = _buffer_merge(
-        jnp.full((q, L), -1, jnp.int32), jnp.full((q, L), jnp.inf),
-        jnp.zeros((q, L), bool), seed_ids, seed_d, L,
-    )
+    if use_bq:
+        from raft_tpu.ops.bq_scan import _block_estimate, bq_record_geometry
+
+        words = bq_bits * ((dim + 31) // 32)
+        dim_ext = ((dim + 31) // 32) * 32
+        _, rec_pad, _, _ = bq_record_geometry(words, bq_bits)
+        rows2d = bq_records.reshape(-1, rec_pad)
+
+        def bq_survivors(cand, dists):
+            """(q, C) candidate ids → bool survivor mask: estimate
+            minus margin still beats the row's running L-th exact
+            distance. Record extraction mirrors the kernel's lane
+            split bit-for-bit."""
+            r = jnp.take(rows2d, jnp.maximum(cand, 0), axis=0)
+            codes_wb = r[..., :words]                    # (q, C, words)
+            scal = jax.lax.bitcast_convert_type(
+                r[..., words:words + bq_bits + 2], jnp.float32)
+
+            def one(qr, codes_q, sc):
+                rn = sc[:, 0][None, :]                   # (1, C)
+                cf = jnp.transpose(sc[:, 1:1 + bq_bits])  # (bits, C)
+                ew = sc[:, 1 + bq_bits][None, :]
+                return _block_estimate(
+                    qr[None, :], bq_center_rot, rn, ew, cf, codes_q,
+                    dim_ext=dim_ext, bits=bq_bits,
+                    query_bits=bq_query_bits, epsilon=bq_epsilon,
+                    ip_metric=ip_metric)
+            est, margin = jax.vmap(one)(bq_qrot, codes_wb, scal)
+            kth = dists[:, L - 1:L]
+            return ((est[:, 0, :] - margin[:, 0, :]) < kth) & (cand >= 0)
+
+    ids = jnp.full((q, L), -1, jnp.int32)
+    dists = jnp.full((q, L), jnp.inf)
+    explored = jnp.zeros((q, L), bool)
+    if use_bq:
+        # seed rounds merge in C-wide chunks with the evolving buffer's
+        # L-th distance as the prune bar — the kernel's exact order
+        C = w * deg
+        for chunk in range(seed_ids.shape[1] // C):
+            cand = seed_ids[:, chunk * C:(chunk + 1) * C]
+            cd = jnp.where(bq_survivors(cand, dists), score(cand),
+                           jnp.inf)
+            ids, dists, explored = _buffer_merge(ids, dists, explored,
+                                                 cand, cd, L)
+    else:
+        # seeding (role of the reference's random_samplings)
+        ids, dists, explored = _buffer_merge(
+            ids, dists, explored, seed_ids, score(seed_ids), L)
 
     def cond(state):
         ids, dists, explored, it = state
         frontier = (~explored) & jnp.isfinite(dists)
+        if row_iters is not None:
+            frontier = frontier & (it < row_iters)[:, None]
         return (it < max_iters) & jnp.any(frontier)
 
     def body(state):
@@ -579,6 +823,10 @@ def _search_batch_fn(dataset, graph, queries, seed_ids, filter_words, *,
         masked = jnp.where(explored | (ids < 0), jnp.inf, dists)
         _, ppos = jax.lax.top_k(-masked, w)              # (q, w) parents
         valid = jnp.isfinite(jnp.take_along_axis(masked, ppos, axis=1))
+        if row_iters is not None:
+            # a row past its budget contributes no parents and marks
+            # nothing explored — the whole iteration is a no-op for it
+            valid = valid & (it < row_iters)[:, None]
         parents = jnp.where(valid,
                             jnp.take_along_axis(ids, ppos, axis=1), -1)
         explored = explored.at[
@@ -588,6 +836,9 @@ def _search_batch_fn(dataset, graph, queries, seed_ids, filter_words, *,
         cand = jnp.where((parents >= 0)[:, :, None], cand, -1)
         cand = cand.reshape(q, w * deg)
         cand_d = score(cand)
+        if use_bq:
+            cand_d = jnp.where(bq_survivors(cand, dists), cand_d,
+                               jnp.inf)
         ids, dists, explored = _buffer_merge(ids, dists, explored, cand,
                                              cand_d, L)
         return ids, dists, explored, it + 1
@@ -609,43 +860,90 @@ def _search_batch_fn(dataset, graph, queries, seed_ids, filter_words, *,
 
 
 _search_batch = partial(jax.jit, static_argnames=(
-    "k", "L", "w", "max_iters", "metric"))(_search_batch_fn)
+    "k", "L", "w", "max_iters", "metric", "bq_bits", "bq_query_bits",
+    "bq_epsilon"))(_search_batch_fn)
 
 
-def _serving_xla_fn(dataset, graph, queries, row0, filter_words, *, k: int,
-                    L: int, w: int, max_iters: int, metric: DistanceType,
-                    n_seeds: int, seed_salt: int, seed_pool: int):
-    """One-program serving entry (seeds + beam search) for the XLA
-    engine — what ``core/executor.py`` AOT-compiles per bucket. Seeds
-    are drawn per absolute row ``row0 + r`` (``_draw_seeds``; ``row0``
-    is traced so oversized batches tile through ONE executable), so
-    results for real rows are bit-identical to the direct
-    :func:`search` path."""
-    base_key = jax.random.key(seed_salt)
-    seeds = _make_seeds(dataset, queries, row0, n_seeds, metric, seed_pool,
-                        base_key)
-    return _search_batch_fn(dataset, graph, queries, seeds, filter_words,
-                            k=k, L=L, w=w, max_iters=max_iters, metric=metric)
+def _serve_impl(queries, row_iters, dataset, graph, seed_centers,
+                seed_members, bq_rotation, bq_center_rot, bq_records,
+                filter_words, *, engine: str, k: int, L: int, w: int,
+                max_iters: int, n_seeds: int, metric: DistanceType,
+                seed_mode: str, seed_pool: int, bq_bits: int,
+                bq_query_bits: int, bq_epsilon: float, deg: int,
+                interpret: bool):
+    """Seeds + beam + metric epilog for BOTH engines — what
+    ``core/executor.py`` AOT-compiles per bucket (``_serving_fn``) and
+    per ragged params class (``_search_ragged_fn``). Seeds are a pure
+    function of query content, so blocks concatenate and results for
+    real rows are bit-identical to the direct :func:`search` path.
+    ``graph`` arrives pre-padded (``pad_graph``) on the kernel
+    engine."""
+    seeds = _make_seeds(dataset, seed_centers, seed_members, queries,
+                        n_seeds, metric, seed_mode, seed_pool)
+    use_bq = bq_records is not None
+    qrot = _rotate_queries(queries, bq_rotation) if use_bq else None
+    if engine == "pallas":
+        from raft_tpu.ops.beam_search import beam_search
+
+        d, i = beam_search(
+            queries, dataset, graph, seeds, k, L, w, max_iters, metric,
+            row_iters=row_iters, bq_records=bq_records, bq_qrot=qrot,
+            bq_crot=bq_center_rot, bq_bits=bq_bits if use_bq else 0,
+            bq_query_bits=bq_query_bits, bq_epsilon=bq_epsilon,
+            deg=deg, interpret=interpret)
+        if metric == DistanceType.InnerProduct:
+            d = -d
+        elif metric == DistanceType.L2SqrtExpanded:
+            d = jnp.where(jnp.isfinite(d),
+                          jnp.sqrt(jnp.maximum(d, 0.0)), d)
+        return d, i
+    return _search_batch_fn(
+        dataset, graph, queries, seeds, filter_words,
+        row_iters=row_iters, bq_records=bq_records, bq_qrot=qrot,
+        bq_center_rot=bq_center_rot, k=k, L=L, w=w,
+        max_iters=max_iters, metric=metric,
+        bq_bits=bq_bits if use_bq else 0,
+        bq_query_bits=bq_query_bits, bq_epsilon=bq_epsilon)
 
 
-def _serving_kernel_fn(dataset, padded_graph, queries, row0, *, k: int,
-                       L: int, w: int, max_iters: int, metric: DistanceType,
-                       deg: int, n_seeds: int, seed_salt: int,
-                       seed_pool: int, interpret: bool = False):
-    """Serving entry for the Pallas beam kernel (TPU), mirroring the
-    kernel branch of :func:`search` including its distance postprocess."""
-    from raft_tpu.ops.beam_search import beam_search
+def _serving_fn(queries, dataset, graph, seed_centers, seed_members,
+                bq_rotation, bq_center_rot, bq_records,
+                filter_words=None, *, engine: str, k: int, L: int,
+                w: int, max_iters: int, n_seeds: int,
+                metric: DistanceType, seed_mode: str, seed_pool: int,
+                bq_bits: int, bq_query_bits: int, bq_epsilon: float,
+                deg: int, interpret: bool):
+    """Bucketed serving entry (see :func:`_serve_impl`)."""
+    return _serve_impl(
+        queries, None, dataset, graph, seed_centers, seed_members,
+        bq_rotation, bq_center_rot, bq_records, filter_words,
+        engine=engine, k=k, L=L, w=w, max_iters=max_iters,
+        n_seeds=n_seeds, metric=metric, seed_mode=seed_mode,
+        seed_pool=seed_pool, bq_bits=bq_bits,
+        bq_query_bits=bq_query_bits, bq_epsilon=bq_epsilon, deg=deg,
+        interpret=interpret)
 
-    base_key = jax.random.key(seed_salt)
-    seeds = _make_seeds(dataset, queries, row0, n_seeds, metric, seed_pool,
-                        base_key)
-    d, i = beam_search(queries, dataset, padded_graph, seeds, k, L, w,
-                       max_iters, metric, deg=deg, interpret=interpret)
-    if metric == DistanceType.InnerProduct:
-        d = -d
-    elif metric == DistanceType.L2SqrtExpanded:
-        d = jnp.where(jnp.isfinite(d), jnp.sqrt(jnp.maximum(d, 0.0)), d)
-    return d, i
+
+def _search_ragged_fn(queries, row_iters, dataset, graph, seed_centers,
+                      seed_members, bq_rotation, bq_center_rot,
+                      bq_records, filter_words=None, *, engine: str,
+                      k: int, L: int, w: int, max_iters: int,
+                      n_seeds: int, metric: DistanceType,
+                      seed_mode: str, seed_pool: int, bq_bits: int,
+                      bq_query_bits: int, bq_epsilon: float, deg: int,
+                      interpret: bool):
+    """Ragged serving entry: one packed query tile, per-row iteration
+    budgets (the per-request ``max_iterations``, resolved by the
+    executor) folded into the beam as bit-exact no-op iterations —
+    each row's columns equal a solo bucketed run at its own params."""
+    return _serve_impl(
+        queries, row_iters, dataset, graph, seed_centers, seed_members,
+        bq_rotation, bq_center_rot, bq_records, filter_words,
+        engine=engine, k=k, L=L, w=w, max_iters=max_iters,
+        n_seeds=n_seeds, metric=metric, seed_mode=seed_mode,
+        seed_pool=seed_pool, bq_bits=bq_bits,
+        bq_query_bits=bq_query_bits, bq_epsilon=bq_epsilon, deg=deg,
+        interpret=interpret)
 
 
 def _resolve_search_algo(params: CagraSearchParams, index: CagraIndex,
@@ -696,11 +994,19 @@ def search(
            "queries must be (q, dim)")
     if queries.shape[0] == 0:
         return (jnp.zeros((0, k), jnp.float32), jnp.zeros((0, k), jnp.int32))
-    cfg = derive_search_config(params, index, k, res.seed)
+    cfg = derive_search_config(params, index, k)
     L, w, max_iters, n_seeds = (cfg["L"], cfg["w"], cfg["max_iters"],
                                 cfg["n_seeds"])
     filter_words = resolve_filter_words(sample_filter)
     use_kernel = _resolve_search_algo(params, index, filter_words)
+    seed_mode = _resolve_seed_mode(params, index)
+    use_bq = _resolve_bq_traversal(params, index, use_kernel)
+    if use_bq:
+        from raft_tpu.ops.bq_scan import auto_query_bits
+
+        bq_query_bits = auto_query_bits(index.bq_bits)
+    else:
+        bq_query_bits = 4
     if filter_words is not None and filter_words.ndim == 2:
         expect(filter_words.shape[0] == queries.shape[0],
                "per-query BitmapFilter rows must match the query count")
@@ -711,20 +1017,28 @@ def search(
         # padded once per index, not per search call or query tile
         # (the kernel DMAs whole 128-lane-aligned adjacency rows)
         padded_graph = index.padded_graph if use_kernel else None
-        base_key = jax.random.key(cfg["seed_salt"])
         for start in range(0, queries.shape[0], tile):
             qt = queries[start : start + tile]
             fw = filter_words
             if fw is not None and fw.ndim == 2:
                 fw = fw[start : start + tile]
-            seeds = _make_seeds(index.dataset, qt, start, n_seeds,
-                                index.metric, params.seed_pool, base_key)
+            seeds = _make_seeds(index.dataset, index.seed_centers,
+                                index.seed_members, qt, n_seeds,
+                                index.metric, seed_mode, params.seed_pool)
+            qrot = (_rotate_queries(qt, index.bq_rotation)
+                    if use_bq else None)
             if use_kernel:
                 from raft_tpu.ops.beam_search import beam_search
 
                 d, i = beam_search(
                     qt, index.dataset, padded_graph, seeds, k, L, w,
                     max_iters, index.metric,
+                    bq_records=index.bq_records if use_bq else None,
+                    bq_qrot=qrot,
+                    bq_crot=index.bq_center_rot if use_bq else None,
+                    bq_bits=index.bq_bits if use_bq else 0,
+                    bq_query_bits=bq_query_bits,
+                    bq_epsilon=params.bq_epsilon,
                     deg=index.graph_degree,
                     interpret=jax.default_backend() != "tpu")
                 if index.metric == DistanceType.InnerProduct:
@@ -733,9 +1047,15 @@ def search(
                     d = jnp.where(jnp.isfinite(d),
                                   jnp.sqrt(jnp.maximum(d, 0.0)), d)
             else:
-                d, i = _search_batch(index.dataset, index.graph, qt, seeds,
-                                     fw, k=k, L=L, w=w, max_iters=max_iters,
-                                     metric=index.metric)
+                d, i = _search_batch(
+                    index.dataset, index.graph, qt, seeds, fw, None,
+                    index.bq_records if use_bq else None, qrot,
+                    index.bq_center_rot if use_bq else None,
+                    k=k, L=L, w=w, max_iters=max_iters,
+                    metric=index.metric,
+                    bq_bits=index.bq_bits if use_bq else 0,
+                    bq_query_bits=bq_query_bits,
+                    bq_epsilon=params.bq_epsilon)
             outs_d.append(d)
             outs_i.append(i)
         if len(outs_d) == 1:
@@ -758,6 +1078,18 @@ def save(index: CagraIndex, fh_or_path, include_dataset: bool = True) -> None:
         serialize_array(fh, index.graph)
         if include_dataset:
             serialize_array(fh, index.dataset)
+        has_seed = index.seed_centers is not None
+        serialize_scalar(fh, 1 if has_seed else 0, np.int32)
+        if has_seed:
+            serialize_array(fh, index.seed_centers)
+            serialize_array(fh, index.seed_members)
+        has_bq = index.bq_records is not None
+        serialize_scalar(fh, 1 if has_bq else 0, np.int32)
+        if has_bq:
+            serialize_scalar(fh, index.bq_bits, np.int32)
+            serialize_array(fh, index.bq_rotation)
+            serialize_array(fh, index.bq_center_rot)
+            serialize_array(fh, index.bq_records)
     finally:
         if own:
             fh.close()
@@ -774,8 +1106,22 @@ def load(res: Optional[Resources], fh_or_path, dataset=None) -> CagraIndex:
         graph = res.put(deserialize_array(fh))
         if has_ds:
             dataset = res.put(deserialize_array(fh))
+        seed_centers = seed_members = None
+        if int(deserialize_scalar(fh)) != 0:
+            seed_centers = res.put(deserialize_array(fh))
+            seed_members = res.put(deserialize_array(fh))
+        bq_rotation = bq_center_rot = bq_records = None
+        bq_bits = 0
+        if int(deserialize_scalar(fh)) != 0:
+            bq_bits = int(deserialize_scalar(fh))
+            bq_rotation = res.put(deserialize_array(fh))
+            bq_center_rot = res.put(deserialize_array(fh))
+            bq_records = res.put(deserialize_array(fh))
     finally:
         if own:
             fh.close()
     expect(dataset is not None, "index was saved without its dataset")
-    return CagraIndex(jnp.asarray(dataset), jnp.asarray(graph), metric)
+    return CagraIndex(jnp.asarray(dataset), jnp.asarray(graph), metric,
+                      seed_centers=seed_centers, seed_members=seed_members,
+                      bq_rotation=bq_rotation, bq_center_rot=bq_center_rot,
+                      bq_records=bq_records, bq_bits=bq_bits)

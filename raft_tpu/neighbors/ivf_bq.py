@@ -154,6 +154,12 @@ class IvfBqSearchParams(SearchParams):
     # trigger a re-rank): 3.0 covers ≥ 99% of estimator errors —
     # measured in tests/test_ivf_bq.py::TestEstimatorContract
     epsilon: float = 3.0
+    # query-side quantization grid width for the popcount estimate
+    # (RaBitQ's asymmetric query treatment). 0 resolves per code
+    # ladder (raft_tpu.ops.bq_scan.auto_query_bits): 4 below 3 code
+    # bits, 8 at bits >= 3 — where the code estimate is sharp enough
+    # that the 4-bit query grid becomes the dominant noise source
+    query_bits: int = 0
 
 
 @jax.tree_util.register_pytree_node_class
@@ -580,27 +586,37 @@ def estimator_stats(index) -> dict:
             "rel_err": rel, "dim_ext": index.dim_ext}
 
 
-def overfetch_budget(index, k: int, *, confidence: float = 1.0) -> int:
+def overfetch_budget(index, k: int, *, confidence: float = 1.0,
+                     query_bits: int = 4) -> int:
     """Bound-derived candidate budget for the estimate-only path: how
     many estimate-ranked candidates to fetch so the true top-k survive
     the exact re-rank (:func:`raft_tpu.neighbors.refine`).
 
-    ``budget = ceil(k · (1 + confidence·κ·ρ))`` where ``ρ`` is the
+    ``budget = ceil(k · (1 + confidence·κ_eff·ρ))`` where ``ρ`` is the
     index's measured relative estimator error
-    (:func:`estimator_stats`) and ``κ`` is the one calibration
+    (:func:`estimator_stats`) and ``κ_eff`` scales the one calibration
     constant ``_OVERFETCH_KAPPA`` (displacement per unit relative
-    error, measured against the pinned rotation stream) — replacing
-    the three hand-calibrated constants (self-hit 40, sharded merge
-    240, streamed-bits2 60; ``tests/test_ivf_bq.py`` pins derived ≤
-    old at equal recall targets). An index carrying the rerank plane
-    needs no over-fetch at all: the fused scan already returns exact
-    distances, so the budget is ``k``."""
+    error, measured against the pinned rotation stream at the 4-bit
+    query grid) by the query grid actually searched with:
+    ``κ_eff = κ·(2^(4−query_bits) + 1)/2`` — the quantization noise
+    term halves per extra query bit while the rotation term stays, so
+    the identity holds at ``query_bits=4`` and an 8-bit grid
+    (``auto_query_bits`` at a bits≥3 ladder) buys ~47% less
+    over-fetch. Replaces the three hand-calibrated constants
+    (self-hit 40, sharded merge 240, streamed-bits2 60;
+    ``tests/test_ivf_bq.py`` pins derived ≤ old at equal recall
+    targets, and pins the ladder's monotone budget drop). An index
+    carrying the rerank plane needs no over-fetch at all: the fused
+    scan already returns exact distances, so the budget is ``k``."""
     expect(k >= 1, "k must be >= 1")
+    expect(1 <= query_bits <= 8,
+           f"query_bits must be 1..8, got {query_bits}")
     if index.data is not None:
         return k
     stats = estimator_stats(index)
+    kappa_eff = _OVERFETCH_KAPPA * (2.0 ** (4 - query_bits) + 1.0) / 2.0
     budget = math.ceil(
-        k * (1.0 + confidence * _OVERFETCH_KAPPA * stats["rel_err"]))
+        k * (1.0 + confidence * kappa_eff * stats["rel_err"]))
     return max(k, min(budget, index.size))
 
 
@@ -679,7 +695,8 @@ def _search_impl_fn(queries, centers, rotation, codes, rnorm, cfac,
                     n_valid=None, row_probes=None, *, n_probes: int,
                     k: int, metric: DistanceType,
                     coarse_algo: str = "exact",
-                    scan_engine: str = "rank", epsilon: float = 3.0):
+                    scan_engine: str = "rank", epsilon: float = 3.0,
+                    query_bits: int = 0):
     """BQ probe scan: coarse select, then either the fused
     estimate-then-rerank list-major engines (``pallas``/``xla`` —
     :mod:`raft_tpu.ops.bq_scan`, exact output distances) or the legacy
@@ -739,13 +756,15 @@ def _search_impl_fn(queries, centers, rotation, codes, rnorm, cfac,
         # fused estimate-then-rerank (ops/bq_scan): stream each unique
         # probed list's codes once, XOR+popcount estimates, exact f32
         # re-rank of surviving rows from the same resident block
-        from raft_tpu.ops.bq_scan import bq_list_major_scan
+        from raft_tpu.ops.bq_scan import auto_query_bits, bq_list_major_scan
 
+        qb = query_bits if query_bits else auto_query_bits(
+            int(cfac.shape[2]))
         best_d, best_i = bq_list_major_scan(
             qf, qrot, centers_rot, codes, rnorm, cfac, errw, indices,
             data, data_norms, probes, filter_words, init_d, init_i,
             k=k, metric=metric, epsilon=epsilon, engine=scan_engine,
-            interpret=jax.default_backend() != "tpu")
+            query_bits=qb, interpret=jax.default_backend() != "tpu")
     else:
         def step(carry, rank):
             best_d, best_i = carry
@@ -777,7 +796,7 @@ def _search_impl_fn(queries, centers, rotation, codes, rnorm, cfac,
 
 _search_impl = partial(jax.jit, static_argnames=(
     "n_probes", "k", "metric", "coarse_algo", "scan_engine",
-    "epsilon"))(_search_impl_fn)
+    "epsilon", "query_bits"))(_search_impl_fn)
 
 
 def _search_ragged_fn(queries, row_probes, centers, rotation, codes,
@@ -785,7 +804,8 @@ def _search_ragged_fn(queries, row_probes, centers, rotation, codes,
                       filter_words, init_d=None, init_i=None,
                       probe_counts=None, n_valid=None, *, n_probes: int,
                       k: int, metric: DistanceType,
-                      scan_engine: str = "xla", epsilon: float = 3.0):
+                      scan_engine: str = "xla", epsilon: float = 3.0,
+                      query_bits: int = 0):
     """Packed ragged-batch BQ search body — the BQ member of the
     serving executor's ragged plan family (see
     :func:`raft_tpu.neighbors.ivf_flat._search_ragged_fn` for the
@@ -809,7 +829,7 @@ def _search_ragged_fn(queries, row_probes, centers, rotation, codes,
         data, data_norms, filter_words, init_d, init_i, probe_counts,
         None, row_probes=row_probes, n_probes=n_probes, k=k,
         metric=metric, coarse_algo="exact", scan_engine=scan_engine,
-        epsilon=epsilon)
+        epsilon=epsilon, query_bits=query_bits)
 
 
 def search(
@@ -837,6 +857,9 @@ def search(
     expect(params.coarse_algo in ("exact", "approx"),
            f"coarse_algo must be 'exact' or 'approx', got "
            f"{params.coarse_algo!r}")
+    expect(params.query_bits == 0 or 1 <= params.query_bits <= 8,
+           "query_bits must be 0 (auto) or 1..8, got "
+           f"{params.query_bits}")
     filter_words = resolve_filter_words(sample_filter)
     from raft_tpu.ops.bq_scan import resolve_bq_engine
 
@@ -852,7 +875,7 @@ def search(
                 index.data, index.data_norms, fw,
                 n_probes=n_probes, k=k, metric=index.metric,
                 coarse_algo=params.coarse_algo, scan_engine=scan_engine,
-                epsilon=params.epsilon)
+                epsilon=params.epsilon, query_bits=params.query_bits)
 
         return tile_queries(run, queries, filter_words, query_tile)
 

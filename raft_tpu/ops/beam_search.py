@@ -22,7 +22,19 @@ do worst. This kernel instead runs the WHOLE walk in one ``pallas_call``:
 - parent selection, id-dedup, and the top-L merge are the same
   extract-min VPU network as ``ops/fused_topk`` — no sorts anywhere;
 - queries run in blocks of ``block_q`` per grid step, so scoring is a
-  few small MXU contractions per iteration rather than scalar work.
+  few small MXU contractions per iteration rather than scalar work;
+- **per-row iteration budgets** arrive as a scalar-prefetched vector
+  (``row_iters``): a row past its budget contributes inert no-op
+  iterations, so one compiled executable serves every per-request
+  ``max_iterations`` in a ragged batch bit-identically to a solo run;
+- **BQ-coded traversal** (``bq_records``): gathered neighbors are first
+  scored by the RaBitQ XOR+popcount estimate against a packed per-row
+  record plane (:func:`raft_tpu.ops.bq_scan.bq_record_geometry`), and
+  the raw dataset rows of a query's candidate batch are fetched ONLY
+  when some candidate's estimate-minus-margin beats the running L-th
+  exact distance (``pl.when`` conditional DMA — the bq_scan discipline
+  on the neighbor-gather path). HBM traffic for the non-survivor
+  majority drops from full-precision rows to code records.
 
 Scope (the wrapper in ``neighbors/cagra`` falls back to the XLA path
 otherwise): L2Expanded/L2SqrtExpanded/InnerProduct, f32/bf16/int8
@@ -42,6 +54,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.core.validation import expect
 from raft_tpu.distance.types import DistanceType
+from raft_tpu.ops.bq_scan import _block_estimate, bq_record_geometry
 from raft_tpu.ops.fused_topk import _COMPILER_PARAMS
 from raft_tpu.neighbors._exact import dedup_candidate_mask
 from raft_tpu.ops.fused_topk import _default_vmem_mb, _extract_topk
@@ -51,14 +64,16 @@ _SUPPORTED = (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
 
 
 def beam_search_fits(n: int, dim: int, itemsize: int,
-                     vmem_mb: int = 0) -> bool:
+                     vmem_mb: int = 0, extra_bytes: int = 0) -> bool:
     """Whether (n, dim) fits the VMEM-resident dataset budget (with
     ~8 MB headroom for the kernel's scratch and queries). Since the
     HBM-resident mode landed this decides *placement* (``ds_mode``
-    auto), not whether the kernel applies at all."""
+    auto), not whether the kernel applies at all. ``extra_bytes``
+    charges co-resident planes (the BQ record plane) to the same
+    budget."""
     if vmem_mb <= 0:
         vmem_mb = _default_vmem_mb()
-    return n * dim * itemsize <= (vmem_mb - 8) * 1024 * 1024
+    return n * dim * itemsize + extra_bytes <= (vmem_mb - 8) * 1024 * 1024
 
 
 def pad_graph(graph) -> jax.Array:
@@ -72,10 +87,24 @@ def pad_graph(graph) -> jax.Array:
     return jnp.pad(graph, ((0, 0), (0, Gp - deg)), constant_values=-1)
 
 
-def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
-                 cand_ref, cand_sm, dist_ref, rows_ref, gsm, sem, *dsem,
+def _beam_kernel(riters_ref, q_ref, seeds_ref, ds_ref, graph_ref, *rest,
                  L: int, w: int, k: int, C: int, deg: int, Gp: int,
-                 max_iters: int, ip_metric: bool, ds_vmem: bool):
+                 max_iters: int, ip_metric: bool, ds_vmem: bool,
+                 bq_bits: int, bq_query_bits: int, bq_epsilon: float):
+    use_bq = bq_bits > 0
+    pos = 0
+    if use_bq:
+        qrot_ref, crot_ref, rec_ref = rest[pos:pos + 3]
+        pos += 3
+    outd_ref, outi_ref = rest[pos:pos + 2]
+    pos += 2
+    cand_ref, cand_sm, dist_ref, rows_ref, gsm, sem = rest[pos:pos + 6]
+    pos += 6
+    if use_bq:
+        bqtiles_ref, surv_ref = rest[pos:pos + 2]
+        pos += 2
+    dsem = rest[pos:]
+
     B, d = q_ref.shape
     qf = q_ref[:].astype(jnp.float32)                       # (B, d)
     qn = jnp.sum(jnp.square(qf), axis=1, keepdims=True)     # (B, 1)
@@ -85,6 +114,18 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
     # fused_topk._knn_kernel and _exact.gathered_distances
     prec = (jax.lax.Precision.HIGHEST if ds_ref.dtype == jnp.float32
             else jax.lax.Precision.DEFAULT)
+
+    # per-row iteration budget: B scalar SMEM reads select into a
+    # (B, 1) lane vector the loop body compares its index against
+    base = pl.program_id(0) * B
+    rowi = jax.lax.broadcasted_iota(jnp.int32, (B, 1), 0)
+    it_vec = jnp.zeros((B, 1), jnp.int32)
+    for b in range(B):
+        it_vec = jnp.where(rowi == b, riters_ref[base + b], it_vec)
+
+    if use_bq:
+        words = bq_bits * d // 32
+        _, rec_pad, rpt, _ = bq_record_geometry(words, bq_bits)
 
     def score_rows(b, rows):
         """(C, d) gathered rows -> min-form distances into dist_ref[b]
@@ -104,13 +145,52 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
             dist_ref[pl.ds(b, 1), :] = jnp.maximum(
                 rn - 2.0 * ip + qn[b], 0.0)
 
-    def score_cand(cand):
+    def estimate_cand(cand, dvals):
+        """BQ phase: per query, gather each candidate's packed record
+        tile (dynamic VMEM loads — the plane is VMEM-resident), select
+        the record's lane window, and run the shared
+        :func:`raft_tpu.ops.bq_scan._block_estimate` math. A candidate
+        survives iff its estimate minus the RaBitQ margin could still
+        beat the query's running L-th exact distance."""
+        for b in range(B):
+            def gtile(c, _):
+                tid = cand_sm[b, c] // rpt
+                bqtiles_ref[pl.ds(c, 1), :] = rec_ref[pl.ds(tid, 1), :]
+                return 0
+            jax.lax.fori_loop(0, C, gtile, 0, unroll=1)
+            tiles = bqtiles_ref[:]                          # (C, PW)
+            offc = jnp.transpose(jnp.maximum(cand[b:b + 1], 0) % rpt)
+            recs = tiles[:, 0:rec_pad]
+            for o in range(1, rpt):
+                recs = jnp.where(
+                    offc == o, tiles[:, o * rec_pad:(o + 1) * rec_pad],
+                    recs)                                   # (C, rec_pad)
+            codes_wb = recs[:, :words]
+            scal = jax.lax.bitcast_convert_type(
+                recs[:, words:words + bq_bits + 2], jnp.float32)
+            rnorm_row = jnp.transpose(scal[:, 0:1])         # (1, C)
+            cfac_t = jnp.transpose(scal[:, 1:1 + bq_bits])  # (bits, C)
+            errw_row = jnp.transpose(scal[:, 1 + bq_bits:2 + bq_bits])
+            est, margin = _block_estimate(
+                qrot_ref[b:b + 1].astype(jnp.float32), crot_ref[:],
+                rnorm_row, errw_row, cfac_t, codes_wb,
+                dim_ext=d, bits=bq_bits, query_bits=bq_query_bits,
+                epsilon=bq_epsilon, ip_metric=ip_metric)
+            kth = dvals[b:b + 1, L - 1:L]
+            surv = ((est - margin) < kth) & (cand[b:b + 1] >= 0)
+            surv_ref[pl.ds(b, 1), :] = surv.astype(jnp.int32)
+
+    def score_cand(cand, dvals):
         """(B, C) candidate ids -> (B, C) min-form distances.
 
         VMEM-resident dataset: dynamic VMEM row loads (cycles each).
         HBM-resident dataset: per-query DMA batches, double-buffered —
         query b+1's C row fetches are in flight on the other
-        buffer/semaphore while query b's rows score on the MXU."""
+        buffer/semaphore while query b's rows score on the MXU.
+
+        With BQ traversal the estimate phase runs first and a query's
+        raw-row batch is gathered/DMA'd ONLY when it still holds an
+        estimate-survivor — non-survivor batches cost codes, not rows."""
         # ids must be scalars for dynamic addressing: VMEM -> SMEM.
         # Invalid ids (-1) are clamped for the gather only — compiled
         # Mosaic has no OOB clamp; masking happens on the way out.
@@ -118,16 +198,27 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
         cp = pltpu.make_async_copy(cand_ref, cand_sm, sem)
         cp.start()
         cp.wait()
+        if use_bq:
+            estimate_cand(cand, dvals)
+
+            def anyb(b):
+                return jnp.any(surv_ref[pl.ds(b, 1), :] == 1)
         if ds_vmem:
             for b in range(B):
-                def gather(c, _):
-                    rid = cand_sm[b, c]
-                    rows_ref[pl.ds(c, 1), :] = ds_ref[pl.ds(rid, 1), :]
-                    return 0
-                # Mosaic lowers fori_loop only at unroll=1 or a full
-                # unroll; partial unrolls are rejected at compile time.
-                jax.lax.fori_loop(0, C, gather, 0, unroll=1)
-                score_rows(b, rows_ref[:].astype(jnp.float32))
+                def scoreb(b=b):
+                    def gather(c, _):
+                        rid = cand_sm[b, c]
+                        rows_ref[pl.ds(c, 1), :] = ds_ref[pl.ds(rid, 1), :]
+                        return 0
+                    # Mosaic lowers fori_loop only at unroll=1 or a full
+                    # unroll; partial unrolls are rejected at compile
+                    # time.
+                    jax.lax.fori_loop(0, C, gather, 0, unroll=1)
+                    score_rows(b, rows_ref[:].astype(jnp.float32))
+                if use_bq:
+                    pl.when(anyb(b))(scoreb)
+                else:
+                    scoreb()
         else:
             dsem_ref = dsem[0]
 
@@ -157,13 +248,30 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
                     rows_ref.at[slot],
                     dsem_ref.at[slot]).wait()
 
-            fetch(0, 0)
+            def maybe(b, fn):
+                # the fetch/drain/score trio for query b shares ONE
+                # predicate (surv_ref is stable inside score_cand), so
+                # a skipped fetch can never strand a drain
+                if use_bq:
+                    pl.when(anyb(b))(fn)
+                else:
+                    fn()
+
+            maybe(0, lambda: fetch(0, 0))
             for b in range(B):
                 slot = b % 2
                 if b + 1 < B:
-                    fetch(b + 1, (b + 1) % 2)
-                drain(slot)
-                score_rows(b, rows_ref[slot].astype(jnp.float32))
+                    maybe(b + 1,
+                          lambda b=b: fetch(b + 1, (b + 1) % 2))
+
+                def retire(b=b, slot=slot):
+                    drain(slot)
+                    score_rows(b, rows_ref[slot].astype(jnp.float32))
+                maybe(b, retire)
+        if use_bq:
+            # skipped rows hold stale dist lanes — the survivor mask
+            # (which already folds cand >= 0) is the source of truth
+            return jnp.where(surv_ref[:] == 1, dist_ref[:], jnp.inf)
         return jnp.where(cand < 0, jnp.inf, dist_ref[:])
 
     def merge(ids, dvals, expl, cand, cd):
@@ -195,14 +303,17 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
     for chunk in range(seeds.shape[1] // C):
         cand = seeds[:, chunk * C:(chunk + 1) * C]
         ids, dvals, expl = merge(ids, dvals, expl, cand,
-                                 score_cand(cand))
+                                 score_cand(cand, dvals))
 
-    def body(_, state):
+    def body(it, state):
         ids, dvals, expl = state
-        # ---- pick w best unexplored as parents (extract-min rounds)
+        # ---- pick w best unexplored as parents (extract-min rounds).
+        # A row past its iteration budget contributes no parents: its
+        # candidates are all -1, its explored flags untouched — the
+        # whole iteration is a bit-exact no-op for that row.
         masked = jnp.where((expl == 1) | (ids < 0), jnp.inf, dvals)
         _, parents = _extract_topk(masked, ids, w)          # (B, w)
-        pvalid = parents >= 0
+        pvalid = (parents >= 0) & (it < it_vec)
         # mark parents explored (ids are unique in the buffer)
         expl = jnp.where(
             jnp.any(ids[:, :, None] == jnp.where(
@@ -241,7 +352,7 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
             ok = ok | ((lane == j) & pvalid[:, j:j + 1])
         cand = jnp.where(ok, cand, -1)
 
-        cd = score_cand(cand)
+        cd = score_cand(cand, dvals)
         return merge(ids, dvals, expl, cand, cd)
 
     ids, dvals, _ = jax.lax.fori_loop(0, max_iters, body,
@@ -253,9 +364,14 @@ def _beam_kernel(q_ref, seeds_ref, ds_ref, graph_ref, outd_ref, outi_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "L", "w", "max_iters", "metric", "block_q",
-                     "interpret", "vmem_mb", "deg", "ds_mode"))
+                     "interpret", "vmem_mb", "deg", "ds_mode",
+                     "bq_bits", "bq_query_bits", "bq_epsilon"))
 def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
                 max_iters: int, metric: DistanceType, *,
+                row_iters=None,
+                bq_records=None, bq_qrot=None, bq_crot=None,
+                bq_bits: int = 0, bq_query_bits: int = 4,
+                bq_epsilon: float = 3.0,
                 block_q: int = 8, interpret: bool = False,
                 vmem_mb: int = 0,
                 deg: int = 0,
@@ -266,6 +382,19 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
     rounds reuse the candidate scoring path in w·deg-wide chunks.
     Returns min-form (q, k) distances + ids; the caller applies sqrt /
     IP negation.
+
+    ``row_iters``: optional (q,) int32 per-row iteration budgets for
+    ragged serving — row r runs ``min(row_iters[r], max_iters)`` live
+    iterations and inert no-ops after, bit-identical to a solo run at
+    ``max_iterations=row_iters[r]``. None means every row runs
+    ``max_iters``.
+
+    ``bq_records``/``bq_qrot``/``bq_crot`` (+ the ``bq_*`` statics)
+    enable BQ-coded traversal: records is the
+    :func:`raft_tpu.ops.bq_scan.pack_bq_records` plane over the WHOLE
+    dataset, qrot the rotated queries (q, d), crot the rotated center
+    row (1, d). The plane must be VMEM-co-resident with the kernel's
+    scratch.
 
     ``deg``: the graph's logical degree, when ``graph`` arrives with
     its rows already padded to a 128 multiple (see ``pad_graph``) —
@@ -289,11 +418,44 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
     if vmem_mb <= 0:
         vmem_mb = _default_vmem_mb()
 
+    use_bq = bq_records is not None
+    plane_bytes = 0
+    if use_bq:
+        expect(1 <= bq_bits <= 8,
+               "beam_search: bq_records needs bq_bits in 1..8")
+        # dim is lane-aligned, so dim_ext == d and the rotated query
+        # carries exactly d lanes
+        words = bq_bits * d // 32
+        _, rec_pad, rpt, pw = bq_record_geometry(words, bq_bits)
+        expect(tuple(bq_records.shape) == (-(-n // rpt), pw),
+               "beam_search: bq_records does not match "
+               f"bq_record_geometry(words={words}, bits={bq_bits}) "
+               f"for n={n}")
+        expect(bq_qrot is not None and tuple(bq_qrot.shape) == (q, d),
+               "beam_search: bq_qrot must be (q, dim) rotated queries")
+        expect(bq_crot is not None and tuple(bq_crot.shape) == (1, d),
+               "beam_search: bq_crot must be the (1, dim) rotated "
+               "center")
+        # the plane is VMEM-resident in BOTH dataset modes (it is the
+        # prune side of the conditional DMA) — it must leave the ~8 MB
+        # scratch headroom; dataset placement charges it as
+        # extra_bytes below
+        plane_bytes = 4 * bq_records.shape[0] * pw
+        expect(plane_bytes <= (vmem_mb - 8) * 1024 * 1024,
+               "beam_search: BQ record plane exceeds the VMEM budget")
+
     B = block_q
+    if row_iters is None:
+        row_iters = jnp.full((q,), max_iters, jnp.int32)
+    expect(row_iters.shape == (q,),
+           "beam_search: row_iters must be (q,)")
     pad_q = (-q) % B
     if pad_q:
         queries = jnp.pad(queries, ((0, pad_q), (0, 0)))
         seeds = jnp.pad(seeds, ((0, pad_q), (0, 0)))
+        row_iters = jnp.pad(row_iters, (0, pad_q))
+        if use_bq:
+            bq_qrot = jnp.pad(bq_qrot, ((0, pad_q), (0, 0)))
     qp = q + pad_q
     # bf16 halves and int8 quarters the VMEM residency (int8 is the
     # CAGRA-Q role: quantized scan + exact refine outside)
@@ -314,9 +476,11 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
     itemsize = jnp.dtype(ds.dtype).itemsize
     if ds_mode == "auto":
         ds_mode = ("vmem" if beam_search_fits(n, ds.shape[1], itemsize,
-                                              vmem_mb) else "hbm")
+                                              vmem_mb, plane_bytes)
+                   else "hbm")
     elif ds_mode == "vmem":
-        expect(beam_search_fits(n, ds.shape[1], itemsize, vmem_mb),
+        expect(beam_search_fits(n, ds.shape[1], itemsize, vmem_mb,
+                                plane_bytes),
                f"beam_search: dataset ({n}x{ds.shape[1]} {ds.dtype}) "
                "exceeds the VMEM budget; use ds_mode='hbm' or 'auto'")
     ds_vmem = ds_mode == "vmem"
@@ -325,29 +489,48 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
         _beam_kernel, L=L, w=w, k=k, C=C, deg=deg, Gp=Gp,
         max_iters=max_iters,
         ip_metric=metric == DistanceType.InnerProduct,
-        ds_vmem=ds_vmem)
+        ds_vmem=ds_vmem,
+        bq_bits=bq_bits if use_bq else 0,
+        bq_query_bits=bq_query_bits, bq_epsilon=bq_epsilon)
     # HBM mode: candidate rows land in a (2, C, d) double buffer with a
     # per-buffer DMA semaphore; VMEM mode gathers into one (C, d) block
     if ds_vmem:
-        ds_spec = pl.BlockSpec((n, ds.shape[1]), lambda i: (0, 0))
+        ds_spec = pl.BlockSpec((n, ds.shape[1]), lambda i, rr: (0, 0))
         rows_scratch = pltpu.VMEM((C, d), ds.dtype)
         extra_scratch = []
     else:
         ds_spec = pl.BlockSpec(memory_space=pl.ANY)
         rows_scratch = pltpu.VMEM((2, C, d), ds.dtype)
         extra_scratch = [pltpu.SemaphoreType.DMA((2,))]
+    operands = [jnp.asarray(row_iters, jnp.int32), qs, seeds, ds, graph]
+    in_specs = [
+        pl.BlockSpec((B, d), lambda i, rr: (i, 0)),                # queries
+        pl.BlockSpec((B, seeds.shape[1]), lambda i, rr: (i, 0)),   # seeds
+        ds_spec,                                                   # dataset
+        pl.BlockSpec(memory_space=pl.ANY),                  # graph (HBM)
+    ]
+    bq_scratch = []
+    if use_bq:
+        operands += [bq_qrot.astype(jnp.float32),
+                     bq_crot.astype(jnp.float32),
+                     bq_records]
+        in_specs += [
+            pl.BlockSpec((B, d), lambda i, rr: (i, 0)),            # qrot
+            pl.BlockSpec((1, d), lambda i, rr: (0, 0)),            # crot
+            pl.BlockSpec(bq_records.shape,
+                         lambda i, rr: (0, 0)),       # record plane (VMEM)
+        ]
+        bq_scratch = [
+            pltpu.VMEM((C, pw), jnp.int32),     # gathered record tiles
+            pltpu.VMEM((B, C), jnp.int32),      # estimate survivors
+        ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=0,
+        num_scalar_prefetch=1,
         grid=(qp // B,),
-        in_specs=[
-            pl.BlockSpec((B, d), lambda i: (i, 0)),                # queries
-            pl.BlockSpec((B, seeds.shape[1]), lambda i: (i, 0)),   # seeds
-            ds_spec,                                               # dataset
-            pl.BlockSpec(memory_space=pl.ANY),                     # graph (HBM)
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((B, k), lambda i: (i, 0)),
-            pl.BlockSpec((B, k), lambda i: (i, 0)),
+            pl.BlockSpec((B, k), lambda i, rr: (i, 0)),
+            pl.BlockSpec((B, k), lambda i, rr: (i, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((B, C), jnp.int32),      # cand staging
@@ -356,7 +539,7 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
             rows_scratch,                       # gathered rows
             pltpu.VMEM((B * w, Gp), jnp.int32),  # graph rows landing
             pltpu.SemaphoreType.DMA,
-        ] + extra_scratch,
+        ] + bq_scratch + extra_scratch,
     )
     outd, outi = pl.pallas_call(
         kernel,
@@ -369,5 +552,5 @@ def beam_search(queries, dataset, graph, seeds, k: int, L: int, w: int,
             dimension_semantics=("arbitrary",),
             vmem_limit_bytes=vmem_mb * 1024 * 1024),
         interpret=interpret,
-    )(qs, seeds, ds, graph)
+    )(*operands)
     return outd[:q], outi[:q]

@@ -43,6 +43,7 @@ f32 assembly order, so their output ids are bit-identical."""
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -68,6 +69,18 @@ from raft_tpu.ops.ivf_scan import (
 # (RaBitQ's asymmetric query treatment): 4 bits keeps the
 # quantization-noise term of the margin well under the rotation term
 _QUERY_BITS = 4
+
+
+def auto_query_bits(bits: int) -> int:
+    """Query quantization width matched to the code-ladder width.
+
+    At ``bits < 3`` the 4-bit query grid's noise term is already well under
+    the code's own quantization error; at 3+ code bits the code estimate is
+    sharp enough that the query grid becomes the dominant noise source, so
+    widen it to 8 bits (the widest grid the i32 cross-term accumulators
+    admit without overflow headroom changes).
+    """
+    return 4 if bits < 3 else 8
 
 
 def resolve_bq_engine(engine: str, *, data=None, filter_words=None,
@@ -244,6 +257,56 @@ def _block_estimate(qrot, crot, rnorm_row, errw_row, cfac_t, codes_wb,
     margin = estimator_margin(qcn, rnorm_row, errw_row, delta,
                               dim_ext, epsilon)
     return est, margin
+
+
+def bq_record_geometry(words: int, bits: int):
+    """Row geometry of the packed per-row BQ record plane used by the
+    graph-traversal estimator (:mod:`raft_tpu.ops.beam_search`).
+
+    A record is one dataset row's complete estimator input laid out
+    contiguously so a beam gather touches ONE aligned slice per
+    candidate instead of four strided planes: ``words`` int32 code
+    words, then ``rnorm | cfac[bits] | errw`` as f32 bitcast to int32
+    lanes. Records pad to a 4-lane multiple (``rec_pad``) and
+    ``rpt = 128/gcd(rec_pad, 128)`` records tile one 128-lane-aligned
+    plane row of ``pw`` lanes — every record starts on a lane boundary
+    a DMA slice can address. Returns ``(rec, rec_pad, rpt, pw)``."""
+    rec = words + bits + 2
+    rec_pad = -(-rec // 4) * 4
+    rpt = 128 // math.gcd(rec_pad, 128)
+    return rec, rec_pad, rpt, rpt * rec_pad
+
+
+def pack_bq_records(codes, rnorm, cfac, errw):
+    """Pack per-row estimator inputs into the aligned record plane of
+    :func:`bq_record_geometry` — ``(ceil(n/rpt), rpt·rec_pad)`` int32.
+    Pad rows are all-zero; a zero record decodes to rnorm = 0 codes,
+    which estimate-survives nothing once the candidate mask (ids ≥ 0)
+    is applied, so padding never needs a side channel."""
+    n, words = codes.shape
+    bits = cfac.shape[1]
+    _, rec_pad, rpt, _ = bq_record_geometry(words, bits)
+    scal = jnp.concatenate(
+        [rnorm[:, None], cfac, errw[:, None]], axis=1).astype(jnp.float32)
+    row = jnp.concatenate(
+        [codes.astype(jnp.int32),
+         jax.lax.bitcast_convert_type(scal, jnp.int32)], axis=1)
+    n_pad = -(-n // rpt) * rpt
+    row = jnp.pad(row, ((0, n_pad - n), (0, rec_pad - row.shape[1])))
+    return row.reshape(n_pad // rpt, rpt * rec_pad)
+
+
+def unpack_bq_records(records, n: int, words: int, bits: int):
+    """Exact inverse of :func:`pack_bq_records` — returns
+    ``(codes (n, words) i32, rnorm (n,), cfac (n, bits), errw (n,))``.
+    The XLA beam twin unpacks the SAME plane the kernel gathers from,
+    so both engines estimate from identical bit patterns."""
+    _, rec_pad, _, _ = bq_record_geometry(words, bits)
+    rows = records.reshape(-1, rec_pad)[:n]
+    codes = rows[:, :words]
+    scal = jax.lax.bitcast_convert_type(
+        rows[:, words:words + bits + 2], jnp.float32)
+    return codes, scal[:, 0], scal[:, 1:1 + bits], scal[:, 1 + bits]
 
 
 def bq_list_major_scan(qf, qrot, centers_rot, codes, rnorm, cfac, errw,

@@ -32,10 +32,12 @@ the degradation ladder's params override feeds the packing key
 exactly as it fed the coalesce key. Since graftragged (PR 15) the
 raggable set is the whole IVF zoo — flat, PQ, BQ, single-chip AND
 list-sharded mesh indexes (mesh wire knobs ride the submit ``kw``
-into the packing key) — so continuous admission covers every family
-the executor can pack. Non-raggable submissions (the documented
-residue: CAGRA's per-block exemption, approx coarse select, the
-rank-major engines, codes-only BQ, ``TieredIvf``, brute force) fall
+into the packing key) — and since graftbeam (PR 16) CAGRA packs too
+(content-pure seeds; per-row iteration budgets ride the budget
+plane), so continuous admission covers every family the executor can
+pack. Non-raggable submissions (the documented residue: approx
+coarse select, the rank-major engines, codes-only BQ, ``TieredIvf``,
+brute force, CAGRA at a ``k`` class cap past ``itopk_size``) fall
 back to the bucketed path transparently, with
 :meth:`~raft_tpu.core.executor.SearchExecutor.ragged_fallback_reason`
 naming why.
@@ -138,15 +140,15 @@ class BatcherConfig:
     ``ragged`` (off by default) routes raggable submissions onto the
     executor's packed-batch plan family: requests group by
     ``executor.ragged_key`` (mixed ``n_probes``/``k`` under one params
-    class share ONE executable; flat, PQ, BQ, and the list-sharded
-    mesh families all pack since graftragged), admit continuously
-    into the open packed tile (``executor.ragged_tile`` rows — the
-    tile-full half of the dual trigger; a dual-tile executor picks
-    its small tile at dispatch), and SPLIT at tile boundaries instead
-    of waiting for a tile they fully fit. Non-raggable submissions
-    (CAGRA, brute force, tiered, approx coarse select, the rank
-    engines, codes-only BQ) fall back to the bucketed path
-    transparently. ``group_budget`` caps consecutive
+    class share ONE executable; flat, PQ, BQ, the list-sharded mesh
+    families, and CAGRA all pack since graftragged/graftbeam), admit
+    continuously into the open packed tile (``executor.ragged_tile``
+    rows — the tile-full half of the dual trigger; a dual-tile
+    executor picks its small tile at dispatch), and SPLIT at tile
+    boundaries instead of waiting for a tile they fully fit.
+    Non-raggable submissions (brute force, tiered, approx coarse
+    select, the rank engines, codes-only BQ) fall back to the
+    bucketed path transparently. ``group_budget`` caps consecutive
     dispatches from one compatibility group while another group is
     dispatch-ready (0 disables): one slow index family's group cannot
     monopolize the worker loop, and the wait of the groups passed over

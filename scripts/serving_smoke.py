@@ -18,6 +18,11 @@ bit-parity vs the bucketed executor path and a zero-recompile steady
 state, with the dual-tile executable count (≤ 2) and pad-waste split
 reported as evidence.
 
+graftbeam (PR 16) pieces: ``ragged_cagra`` and ``ragged_cagra_bq``
+— the rebuilt CAGRA (content-pure coarse seeds, per-request
+iteration budgets on the packed tile mask, BQ-coded traversal in the
+bq piece) through the same ragged family, same assertions.
+
 Run: PYTHONPATH=/root/repo:/root/.axon_site python scripts/serving_smoke.py
 """
 
@@ -197,6 +202,28 @@ def main():
     ragged_family_piece(
         "ragged_bq", bq_index, ivf_bq.IvfBqSearchParams(n_probes=8),
         lambda: ivf_bq.IvfBqSearchParams(n_probes=5))
+
+    # graftbeam acceptance on chip: CAGRA — coarse seeds are a pure
+    # function of query content, so its blocks concatenate and it
+    # serves through the SAME ragged plan family (the per-block
+    # dispatch exemption is deleted, not bypassed). Evidence debt the
+    # two pieces retire on real silicon: per-request iteration
+    # budgets riding the packed tile mask keep bit-parity with the
+    # bucketed path, and (bq piece) the packed record plane's
+    # bitcast_convert_type lanes + non-128-lane record window selects
+    # survive Mosaic compilation inside the serving executable.
+    from raft_tpu.neighbors import cagra
+
+    g_index = cagra.build(None, cagra.CagraIndexParams(
+        graph_degree=32, bq_bits=2), x)
+    ragged_family_piece(
+        "ragged_cagra", g_index, cagra.CagraSearchParams(),
+        lambda: cagra.CagraSearchParams(max_iterations=100))
+    ragged_family_piece(
+        "ragged_cagra_bq", g_index,
+        cagra.CagraSearchParams(bq_traversal="on"),
+        lambda: cagra.CagraSearchParams(bq_traversal="on",
+                                        max_iterations=100))
 
     if jax.device_count() >= 2:
         from raft_tpu.comms import local_comms

@@ -370,6 +370,59 @@ def main():
     except Exception as e:  # noqa: BLE001
         emit("beam_search", error=str(e)[:300])
 
+    # ---- graftbeam: the rebuilt CAGRA serving path compiled on chip
+    # — coarse-plane seeding + BQ-coded traversal. On-chip evidence
+    # debt this piece retires: the packed record plane's
+    # bitcast_convert_type lanes and the rec_pad-lane (non-128) record
+    # window selects must compile under Mosaic, and the kernel's
+    # conditional exact-rerank DMA (estimate-survivors only) must keep
+    # id parity with the XLA twin's dense replay of the same
+    # _block_estimate math.
+    try:
+        import dataclasses as _dc
+
+        from raft_tpu.neighbors import cagra
+
+        gidx = cagra.build(None, cagra.CagraIndexParams(
+            graph_degree=32, bq_bits=2), x)
+        rep = {"seed_lists": int(gidx.seed_centers.shape[0])}
+        d_full = (np.sum(q.astype(np.float64)**2, 1)[:, None]
+                  + np.sum(x.astype(np.float64)**2, 1)[None, :]
+                  - 2.0 * q.astype(np.float64) @ x.astype(np.float64).T)
+        gt = np.argsort(d_full, axis=1, kind="stable")[:, :10]
+
+        def rec10(ids):
+            ids = np.asarray(ids)
+            return float(np.mean([
+                len(set(ids[r]) & set(gt[r])) / 10
+                for r in range(ids.shape[0])]))
+
+        for nm, p in {
+            "pool": cagra.CagraSearchParams(seed_mode="pool",
+                                            seed_pool=4096),
+            "coarse": cagra.CagraSearchParams(seed_mode="coarse",
+                                              seed_pool=512),
+            "coarse_bq": cagra.CagraSearchParams(
+                seed_mode="coarse", seed_pool=512,
+                bq_traversal="on"),
+        }.items():
+            _, i_a = cagra.search(None, p, gidx, qd, 10)
+            rep[f"{nm}_recall"] = rec10(i_a)
+        # pallas-vs-xla bit parity with BQ pruning ON, compiled — the
+        # riskiest Mosaic surface of the rewrite
+        p_k = cagra.CagraSearchParams(seed_mode="coarse", seed_pool=512,
+                                      bq_traversal="on", algo="pallas")
+        p_x = _dc.replace(p_k, algo="xla")
+        dk, ik = cagra.search(None, p_k, gidx, qd, 10)
+        dx, ix = cagra.search(None, p_x, gidx, qd, 10)
+        rep["bq_pallas_ids_vs_xla"] = float(
+            (np.asarray(ik) == np.asarray(ix)).mean())
+        rep["bq_pallas_max_d_err_vs_xla"] = float(np.nanmax(np.abs(
+            np.asarray(dk) - np.asarray(dx))))
+        emit("graftbeam_cagra", **rep)
+    except Exception as e:  # noqa: BLE001
+        emit("graftbeam_cagra", error=str(e)[:300])
+
     # ---- graftflight: capture-and-attribute on the real chip — a
     # jax.profiler capture around compiled executor dispatches must
     # correlate back to the digest-named modules, yielding MEASURED

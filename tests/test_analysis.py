@@ -232,6 +232,59 @@ def run(x, n, interpret=False):
     )(x)
 '''
 
+R4_SYMBOLIC_VIOLATING = '''\
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_COMPILER_PARAMS = pltpu.CompilerParams
+
+
+def kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def run(x, interpret=False):
+    n = x.shape[0]
+    rows = min(n, 65536)  # dynamic, but bounded by the cap
+    cols = 4096
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((rows, cols), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((rows, cols), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), x.dtype),
+        compiler_params=_COMPILER_PARAMS(vmem_limit_bytes=64 << 20),
+        interpret=interpret,
+    )(x)
+'''
+R4_SYMBOLIC_CONFORMING = '''\
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_COMPILER_PARAMS = pltpu.CompilerParams
+
+
+def kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:]
+
+
+def run(x, interpret=False):
+    n = x.shape[0]
+    rows = min(n, 256)  # dynamic, bounded well inside the budget
+    cols = 512
+    return pl.pallas_call(
+        kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((rows, cols), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((rows, cols), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), x.dtype),
+        compiler_params=_COMPILER_PARAMS(vmem_limit_bytes=64 << 20),
+        interpret=interpret,
+    )(x)
+'''
+
 R5_VIOLATING = '''\
 import numpy as np
 
@@ -482,6 +535,14 @@ class TestFixtureCorpus:
         bad = lint_lib(R4_BUDGET_VIOLATING, ["R4"])
         msgs = " ".join(f.message for f in bad.findings)
         assert "exceeds" in msgs and "MiB" in msgs, msgs
+
+    def test_r4_symbolic_upper_bound(self):
+        # a dim that doesn't const-fold (min(n, CAP)) no longer
+        # escapes the budget check — the CAP bounds it
+        bad = lint_lib(R4_SYMBOLIC_VIOLATING, ["R4"])
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "upper bound" in msgs and "exceeds" in msgs, msgs
+        assert lint_lib(R4_SYMBOLIC_CONFORMING, ["R4"]).ok
 
     def test_r5(self):
         bad = lint_lib(R5_VIOLATING, ["R5"])

@@ -459,6 +459,192 @@ class TestBeamKernel:
                          x[:4], 5)
 
 
+class TestGraftbeamSeeds:
+    """graftbeam seed contract: coarse seeding from the build-time
+    plane, purity under batching, and the ~8x seed_pool reduction the
+    acceptance criteria pin."""
+
+    @pytest.fixture(scope="class")
+    def clustered(self):
+        rng = np.random.default_rng(3)
+        centers = rng.standard_normal((64, 16)) * 6
+        x = (centers[rng.integers(0, 64, 8000)]
+             + rng.standard_normal((8000, 16))).astype(np.float32)
+        q = (centers[rng.integers(0, 64, 64)]
+             + rng.standard_normal((64, 16))).astype(np.float32)
+        index = cagra.build(None, CagraIndexParams(
+            graph_degree=24, intermediate_graph_degree=48,
+            build_algo=BuildAlgo.NN_DESCENT), x)
+        gt = np.argsort(spd.cdist(q, x, "sqeuclidean"), axis=1,
+                        kind="stable")[:, :10]
+        return x, q, index, gt
+
+    def test_batching_invariance(self, dataset):
+        """Seeds are a pure function of query content: any
+        concatenation of query blocks returns each block's solo rows
+        bit-identically (the property the executor's per-block
+        dispatch exemption died for)."""
+        x, q = dataset
+        index = cagra.build(None, CagraIndexParams(
+            graph_degree=16, intermediate_graph_degree=32,
+            build_algo=BuildAlgo.NN_DESCENT), x)
+        sp = CagraSearchParams(itopk_size=32, search_width=2)
+        d_all, i_all = cagra.search(None, sp, index, q, 5)
+        d_all, i_all = np.asarray(d_all), np.asarray(i_all)
+        for lo, hi in ((0, 7), (7, 12), (12, 32)):
+            d, i = cagra.search(None, sp, index, q[lo:hi], 5)
+            np.testing.assert_array_equal(np.asarray(i), i_all[lo:hi])
+            np.testing.assert_array_equal(np.asarray(d), d_all[lo:hi])
+
+    def test_coarse_beats_pool_at_8x_smaller_budget(self, clustered):
+        """The frontier shift in miniature: coarse seeding at
+        seed_pool=256 reaches the recall the strided pool needs
+        seed_pool=2048 for (8x)."""
+        x, q, index, gt = clustered
+        assert index.seed_centers is not None
+        sp_pool = CagraSearchParams(itopk_size=32, search_width=1,
+                                    seed_mode="pool", seed_pool=2048)
+        _, i_pool = cagra.search(None, sp_pool, index, q, 10)
+        r_pool, _, _ = eval_recall(gt, np.asarray(i_pool))
+        sp_coarse = CagraSearchParams(itopk_size=32, search_width=1,
+                                      seed_mode="coarse", seed_pool=256)
+        _, i_coarse = cagra.search(None, sp_coarse, index, q, 10)
+        r_coarse, _, _ = eval_recall(gt, np.asarray(i_coarse))
+        assert r_coarse >= r_pool, (r_coarse, r_pool)
+        assert r_coarse >= 0.95, r_coarse
+
+    def test_seed_plane_serializes(self, clustered):
+        """Round-tripped indexes keep the coarse plane (and hence
+        bit-identical coarse-seeded results)."""
+        _, q, index, _ = clustered
+        buf = io.BytesIO()
+        cagra.save(index, buf)
+        buf.seek(0)
+        loaded = cagra.load(None, buf)
+        assert loaded.seed_centers is not None
+        sp = CagraSearchParams(itopk_size=32, seed_mode="coarse")
+        d0, i0 = cagra.search(None, sp, index, q, 10)
+        d1, i1 = cagra.search(None, sp, loaded, q, 10)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_degenerate_data_drops_empty_lists(self):
+        """Duplicate-heavy data collapses balanced k-means; the plane
+        must keep only non-empty lists so every probed list yields at
+        least one valid seed (a query probing an empty list would open
+        the beam with no entries -> all-inf row)."""
+        from raft_tpu.core.resources import ensure_resources
+        from raft_tpu.neighbors.cagra import _build_seed_plane
+
+        rng = np.random.default_rng(0)
+        base = rng.standard_normal((10, 16)).astype(np.float32)
+        x = np.concatenate([np.repeat(base, 90, axis=0),
+                            np.zeros((100, 16), np.float32)])
+        centers, members = _build_seed_plane(
+            ensure_resources(None), x, DistanceType.L2Expanded, 32)
+        sizes = np.asarray((np.asarray(members) >= 0).sum(axis=1))
+        assert (sizes > 0).all()
+        assert centers.shape[0] == members.shape[0] <= 32
+        # every dataset row appears exactly once across the lists
+        flat = np.asarray(members).ravel()
+        assert np.array_equal(np.sort(flat[flat >= 0]),
+                              np.arange(x.shape[0]))
+
+    def test_plane_less_index_falls_back_to_pool(self, dataset):
+        """Hand-assembled indexes (no build(): hnsw round-trips, raw
+        CagraIndex) keep working through the query-aware pool."""
+        import jax.numpy as jnp
+
+        x, q = dataset
+        built = cagra.build(None, CagraIndexParams(
+            graph_degree=16, intermediate_graph_degree=32,
+            build_algo=BuildAlgo.NN_DESCENT), x)
+        bare = cagra.CagraIndex(dataset=jnp.asarray(x),
+                                graph=built.graph, metric=built.metric)
+        _, gt = _gt(x, q, 10)
+        _, i = cagra.search(None, CagraSearchParams(itopk_size=64),
+                            bare, q, 10)
+        r, _, _ = eval_recall(gt, np.asarray(i))
+        assert r >= 0.9, r
+        from raft_tpu.core.validation import RaftError
+
+        with pytest.raises(RaftError, match="coarse"):
+            cagra.search(None, CagraSearchParams(seed_mode="coarse"),
+                         bare, q, 10)
+
+
+class TestBqTraversal:
+    """graftbeam BQ-coded traversal: estimate-then-exact-rerank on the
+    neighbor-gather path, engine parity with the plane on and off."""
+
+    @pytest.fixture(scope="class")
+    def bq_setup(self):
+        rng = np.random.default_rng(11)
+        centers = rng.standard_normal((10, 128)) * 4
+        x = (centers[rng.integers(0, 10, 1500)]
+             + rng.standard_normal((1500, 128))).astype(np.float32)
+        q = (centers[rng.integers(0, 10, 20)]
+             + rng.standard_normal((20, 128))).astype(np.float32)
+        index = cagra.build(None, CagraIndexParams(
+            graph_degree=16, intermediate_graph_degree=32,
+            build_algo=BuildAlgo.NN_DESCENT, bq_bits=2), x)
+        return x, q, index
+
+    def test_recall_holds_with_bq_pruning(self, bq_setup):
+        """Exact rerank of estimate-survivors: the margin keeps the
+        pruned beam's recall at the unpruned beam's level."""
+        x, q, index = bq_setup
+        assert index.bq_records is not None and index.bq_bits == 2
+        _, gt = _gt(x, q, 10)
+        sp_off = CagraSearchParams(itopk_size=64, search_width=4,
+                                   bq_traversal="off")
+        _, i_off = cagra.search(None, sp_off, index, q, 10)
+        r_off, _, _ = eval_recall(gt, np.asarray(i_off))
+        sp_on = CagraSearchParams(itopk_size=64, search_width=4,
+                                  bq_traversal="on")
+        _, i_on = cagra.search(None, sp_on, index, q, 10)
+        r_on, _, _ = eval_recall(gt, np.asarray(i_on))
+        assert r_on >= r_off - 0.02, (r_on, r_off)
+        assert r_on >= 0.9, r_on
+
+    @pytest.mark.parametrize("bq", ["on", "off"])
+    def test_pallas_xla_parity(self, bq_setup, bq):
+        """The kernel's per-candidate record gather + estimate prunes
+        the SAME candidates as the XLA twin: identical ids either
+        way."""
+        _, q, index = bq_setup
+        kw = dict(itopk_size=64, search_width=4, bq_traversal=bq)
+        dx, ix = cagra.search(None, CagraSearchParams(algo="xla", **kw),
+                              index, q, 10)
+        dp, ip = cagra.search(
+            None, CagraSearchParams(algo="pallas", **kw), index, q, 10)
+        np.testing.assert_array_equal(np.asarray(ix), np.asarray(ip))
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(dp),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bq_serializes(self, bq_setup):
+        _, q, index = bq_setup
+        buf = io.BytesIO()
+        cagra.save(index, buf)
+        buf.seek(0)
+        loaded = cagra.load(None, buf)
+        assert loaded.bq_bits == 2 and loaded.bq_records is not None
+        sp = CagraSearchParams(itopk_size=64, bq_traversal="on")
+        _, i0 = cagra.search(None, sp, index, q, 10)
+        _, i1 = cagra.search(None, sp, loaded, q, 10)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_bq_on_requires_plane(self, dataset):
+        from raft_tpu.core.validation import RaftError
+
+        x, q = dataset
+        index = cagra.build(None, CagraIndexParams(
+            graph_degree=16, intermediate_graph_degree=32,
+            build_algo=BuildAlgo.NN_DESCENT), x)
+        with pytest.raises(RaftError, match="bq_bits"):
+            cagra.search(None, CagraSearchParams(bq_traversal="on"),
+                         index, q, 5)
+
+
 class TestBf16Dataset:
     def test_store_dtype_build(self, dataset):
         """build(store_dtype='bfloat16') halves storage; search quality

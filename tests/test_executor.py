@@ -93,9 +93,10 @@ class TestBitIdentity:
         np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
 
     def test_oversized_batch_cagra_seeds_stay_aligned(self, data, indexes):
-        """CAGRA seeds are drawn per absolute row; tiles after the
-        first must pass their row offset through, or rows past
-        max_bucket would replay tile 0's seeds."""
+        """CAGRA seeds are a pure function of query content
+        (graftbeam), so oversized batches tile through the shared
+        bucketed executable with rows bit-identical to the direct
+        path — no row-offset plumbing."""
         rng = np.random.default_rng(4)
         big = rng.standard_normal((70, 16)).astype(np.float32)
         p = cagra.CagraSearchParams(itopk_size=16)
@@ -516,8 +517,13 @@ class TestRaggedPlans:
             n_probes=5, scan_engine="rank")) is None
         assert ex.ragged_key(index, 4, params=ivf_flat.IvfFlatSearchParams(
             n_probes=5, coarse_algo="approx")) is None
+        # CAGRA packs since graftbeam (content-pure seeds) — only a k
+        # class cap past itopk_size refuses
         assert ex.ragged_key(indexes["cagra"], 4,
-                             params=cagra.CagraSearchParams()) is None
+                             params=cagra.CagraSearchParams()) is not None
+        assert ex.ragged_key(
+            indexes["cagra"], 40,
+            params=cagra.CagraSearchParams(itopk_size=16)) is None
         assert ex.ragged_key(indexes["brute_force"], 4) is None
 
     def test_tile_overflow_streams_chunks(self, ragged_setup):
@@ -752,10 +758,15 @@ class TestRaggedFamilies:
         assert ex.ragged_fallback_reason(
             pq_index, 4, params=ivf_pq.IvfPqSearchParams(
                 coarse_algo="approx")).startswith("coarse_algo")
+        # CAGRA's only residue since graftbeam: a k class cap the beam
+        # buffer cannot carry
         assert ex.ragged_fallback_reason(
-            indexes["cagra"], 4,
+            indexes["cagra"], 20,
             params=cagra.CagraSearchParams(
                 itopk_size=16)).startswith("cagra")
+        assert ex.ragged_fallback_reason(
+            indexes["cagra"], 4,
+            params=cagra.CagraSearchParams(itopk_size=16)) is None
         assert ex.ragged_fallback_reason(
             indexes["brute_force"], 4).startswith("brute_force")
         # codes-only BQ resolves to the rank estimate scan

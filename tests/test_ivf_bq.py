@@ -359,6 +359,73 @@ class TestMultiBit:
         np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
 
 
+class TestQueryBitsLadder:
+    """graftbeam satellite: multi-bit query quantization for the
+    bits>=3 code ladder — auto resolution, engine parity at the wide
+    grid, and the re-calibrated over-fetch margins pinned."""
+
+    def test_auto_query_bits_per_ladder(self):
+        from raft_tpu.ops.bq_scan import auto_query_bits
+
+        assert auto_query_bits(1) == 4
+        assert auto_query_bits(2) == 4
+        assert auto_query_bits(3) == 8
+        assert auto_query_bits(4) == 8
+
+    def test_engine_parity_at_8bit_grid(self, dataset):
+        """The wide query grid rides BOTH fused engines through the
+        shared estimate path: ids and distances stay bit-identical."""
+        x, q = dataset
+        index = ivf_bq.build(None, IvfBqIndexParams(n_lists=32, bits=4),
+                             x)
+        p = dict(n_probes=16, query_bits=8)
+        d_x, i_x = ivf_bq.search(
+            None, IvfBqSearchParams(scan_engine="xla", **p), index, q,
+            10)
+        d_p, i_p = ivf_bq.search(
+            None, IvfBqSearchParams(scan_engine="pallas", **p), index,
+            q, 10)
+        np.testing.assert_array_equal(np.asarray(i_p), np.asarray(i_x))
+        np.testing.assert_array_equal(np.asarray(d_p), np.asarray(d_x))
+
+    def test_explicit_4bit_matches_auto_below_ladder(self, dataset):
+        """query_bits=0 resolves to the pinned 4-bit grid below 3 code
+        bits — explicit 4 is the SAME executable path, bit-identical."""
+        x, q = dataset
+        index = ivf_bq.build(None, IvfBqIndexParams(n_lists=16, bits=2),
+                             x)
+        d0, i0 = ivf_bq.search(None, IvfBqSearchParams(n_probes=8),
+                               index, q, 10)
+        d4, i4 = ivf_bq.search(
+            None, IvfBqSearchParams(n_probes=8, query_bits=4), index,
+            q, 10)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i4))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d4))
+
+    def test_overfetch_recalibrated_pins(self, dataset):
+        """kappa_eff identity at the calibration grid (4-bit) and a
+        monotone budget ladder: wider query grids buy strictly smaller
+        over-fetch, never below k, never above the 4-bit pin."""
+        x, _ = dataset
+        est_only = ivf_bq.build(None, IvfBqIndexParams(
+            n_lists=16, bits=4, store_vectors=False), x)
+        b4 = overfetch_budget(est_only, 5, query_bits=4)
+        assert b4 == overfetch_budget(est_only, 5)    # identity pin
+        b2 = overfetch_budget(est_only, 5, query_bits=2)
+        b8 = overfetch_budget(est_only, 5, query_bits=8)
+        assert b8 <= b4 <= b2, (b8, b4, b2)
+        assert b8 < b2, (b8, b2)
+        assert b8 >= 5
+        # recall leg: the 8-bit-grid budget still recovers the 4-bit
+        # arm's self-hit recall after exact re-rank
+        q8 = x[:16]
+        _, cand = ivf_bq.search(
+            None, IvfBqSearchParams(n_probes=16, query_bits=8),
+            est_only, q8, int(b8))
+        hit = (np.asarray(cand) == np.arange(16)[:, None]).any(axis=1)
+        assert hit.mean() >= 0.95, hit.mean()
+
+
 class TestApproxCoarse:
     def test_approx_coarse(self, dataset):
         x, q = dataset

@@ -453,8 +453,9 @@ class TestRealExecutor:
 
 class TestFiltersAndCagra:
     """Post-review coverage: filters coalesce safely (or not at all)
-    and CAGRA keeps per-block bit-identity despite its absolute-row
-    seed draw."""
+    and CAGRA keeps per-block bit-identity while coalescing —
+    graftbeam made its seeds a pure function of query content, so
+    concatenated blocks cannot perturb each other."""
 
     def test_distinct_shared_filters_never_coalesce(self, real_setup):
         from raft_tpu.core.bitset import Bitset
@@ -525,8 +526,8 @@ class TestFiltersAndCagra:
             graph_degree=8, intermediate_graph_degree=16,
             build_algo=cagra.BuildAlgo.NN_DESCENT), x)
         ex = SearchExecutor()
-        # direct solo searches are the oracle: coalescing must not
-        # shift absolute rows (CAGRA seeds draw per absolute row)
+        # direct solo searches are the oracle: coalesced CAGRA blocks
+        # concatenate (content-pure seeds) yet stay bit-identical
         want = [np.asarray(ex.search(index, q[lo:hi], 5)[1])
                 for lo, hi in ((0, 7), (7, 12), (12, 24))]
         clock = ManualClock()
@@ -1491,7 +1492,8 @@ class TestRaggedBatcher:
 class TestRaggedRealExecutor:
     """Acceptance criteria of the ragged path against the real
     executor: per-request bit-identity with direct bucketed calls,
-    zero recompiles after the ONE warmup, CAGRA exemption intact."""
+    zero recompiles after the ONE warmup, CAGRA packing through the
+    same family (graftbeam)."""
 
     def test_bit_identity_and_zero_recompile(self, real_setup):
         ex = SearchExecutor(ragged_tile=16)
@@ -1547,32 +1549,35 @@ class TestRaggedRealExecutor:
             ex.search(index, blk, 5, params=p)
         assert metrics.derived()["pad_waste_fraction"] == 0.5
 
-    def test_cagra_exempt_under_ragged_config(self, real_setup):
-        """CAGRA requests under a ragged batcher ride the bucketed
-        per-block path (seeds draw per absolute row) — solo
-        bit-identity preserved."""
+    def test_cagra_packs_through_ragged_family(self, real_setup):
+        """CAGRA requests under a ragged batcher pack into ONE ragged
+        executable (graftbeam retired the per-block exemption:
+        content-pure seeds, per-row iteration budgets) and each
+        request stays bit-identical to its direct bucketed search."""
         from raft_tpu.neighbors import cagra
 
-        rng = np.random.default_rng(5)
         x = real_setup["x"]
         gindex = cagra.build(None, cagra.CagraIndexParams(
             graph_degree=8, intermediate_graph_degree=16,
             build_algo=cagra.BuildAlgo.NN_DESCENT), x)
-        ex = SearchExecutor()
+        ex = SearchExecutor(ragged_tile=16)
         clock = ManualClock()
         b = DynamicBatcher(ex, BatcherConfig(max_wait_s=0.01,
                                              ragged=True),
                            clock=clock, start=False)
         p = cagra.CagraSearchParams(itopk_size=16)
+        assert ex.ragged_key(gindex, 4, params=p) is not None
         q = real_setup["q"]
         h1 = b.submit(gindex, q[:5], 4, params=p)
         h2 = b.submit(gindex, q[5:9], 4, params=p)
         clock.advance(0.01)
         b.pump()
+        assert ex.ragged_executables(family="cagra") >= 1
         for h, blk in ((h1, q[:5]), (h2, q[5:9])):
             d, i = h.result(timeout=0)
             dd, ii = ex.search(gindex, blk, 4, params=p)
             np.testing.assert_array_equal(np.asarray(i), np.asarray(ii))
+            np.testing.assert_array_equal(np.asarray(d), np.asarray(dd))
         b.close()
 
     def test_2d_filter_slices_ride_the_split(self, real_setup):

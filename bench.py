@@ -744,9 +744,16 @@ def _multichip_rider():
     + jax's backend-compile ground truth, so a recompiling steady state
     is machine-visible), and the modeled lean collective payloads
     (O(q · n_probes) probe candidates, O(q · k) merge, per wire_dtype)
-    next to the dense coarse-block baseline they replaced. Env knobs:
-    BENCH_MC_N / BENCH_MC_LISTS / BENCH_MC_PROBES / BENCH_MC_SECONDS
-    (per-case budget)."""
+    next to the dense coarse-block baseline they replaced.
+
+    graftwire adds two sub-blocks: ``kmeans_wire`` (quantized-vs-f32
+    distributed k-means build A/B — per-iteration wall clock, modeled
+    wire bytes, inertia delta per reduce wire) and ``grid2d`` (the 2-D
+    query×list grid under mixed-size load, with the
+    compiles-during-load column that pins the zero-recompile steady
+    state). Env knobs: BENCH_MC_N / BENCH_MC_LISTS / BENCH_MC_PROBES /
+    BENCH_MC_SECONDS (per-case budget) / BENCH_MC_KMEANS_ITERS /
+    BENCH_MC_KMEANS_ROWS."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -813,10 +820,100 @@ def _multichip_rider():
             f"coarse {model['coarse_bytes']}B vs dense "
             f"{model['dense_coarse_bytes']}B, merge "
             f"{model['merge_bytes']}B")
+    # graftwire rider: quantized-vs-f32 distributed k-means build A/B —
+    # per-iteration wall clock, the payload model's per-iteration wire
+    # bytes, and the inertia delta the narrow wire costs
+    from raft_tpu.distributed import kmeans as dist_kmeans
+
+    km_iters = int(os.environ.get("BENCH_MC_KMEANS_ITERS", 10))
+    km_clusters = min(n_lists, 256)
+    km_rows = int(os.environ.get("BENCH_MC_KMEANS_ROWS", 32_768))
+    km_rows = -(-km_rows // comms.size) * comms.size
+    kx = jax.random.normal(jax.random.key(5), (km_rows, D),
+                           jnp.float32)
+    kmeans_cases = {}
+    inertia_f32 = None
+    for wire in ("f32", "bf16", "int8"):
+        def _fit(wire=wire):
+            c, i = dist_kmeans.fit(comms, kx, km_clusters,
+                                   n_iters=km_iters, wire_dtype=wire)
+            jax.block_until_ready(c)
+            return i
+        inertia = float(_fit())  # warm the compile, capture inertia
+        stats = timeit_stats(_fit, budget / 2)
+        per_iter = stats["best_s"] / km_iters
+        if wire == "f32":
+            inertia_f32 = inertia
+        model = dist_kmeans.collective_payload_model(km_clusters, D,
+                                                     wire)
+        # dict keyed by wire (not a list) so the CI gate's dotted
+        # tolerance paths reach the columns
+        kmeans_cases[wire] = {
+            "per_iter_s": round(per_iter, 6),
+            "modeled_iter_wire_bytes": model["iter_bytes"],
+            "inertia": round(inertia, 2),
+            "inertia_vs_f32": round(inertia / inertia_f32, 6),
+        }
+        log(f"multichip kmeans {wire}: {per_iter * 1e3:.2f} ms/iter, "
+            f"{model['iter_bytes']}B/iter wire, inertia x"
+            f"{inertia / inertia_f32:.4f}")
+
+    # graftwire rider: the 2-D query×list grid serves bucketed with
+    # ZERO steady-state compiles — the compiles-during-load column is
+    # the acceptance gate (it used to recompile per batch size)
+    grid2d = None
+    if n_dev >= 4 and n_dev % 2 == 0:
+        from jax.sharding import Mesh
+
+        from raft_tpu.comms.comms import Comms
+
+        devs = np.array(jax.devices()).reshape(n_dev // 2, 2)
+        comms2 = Comms(Mesh(devs, ("lists", "queries")), "lists")
+        index2 = dist_ivf.build(None, comms2, ivf_flat.IvfFlatIndexParams(
+            n_lists=n_lists, kmeans_n_iters=4), x)
+        p2 = ivf_flat.IvfFlatSearchParams(n_probes=n_probes,
+                                          scan_engine="auto")
+        ex2 = SearchExecutor()
+        ex2.warmup(index2, buckets=(ex2.bucket_for(BATCH),), k=K,
+                   params=p2, query_axis="queries")
+        qs = np.asarray(queries)
+        # primer sweep compiles the per-size pad micro-programs
+        sizes = tuple(sorted({BATCH, max(1, BATCH - 3),
+                              BATCH // 2 + 1}))
+        for m in sizes:
+            ex2.search(index2, qs[:m], K, params=p2,
+                       query_axis="queries")
+        backend0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        t0 = time.perf_counter()
+        rounds = 0
+        while time.perf_counter() - t0 < budget / 2:
+            for m in sizes:
+                jax.block_until_ready(ex2.search(
+                    index2, qs[:m], K, params=p2,
+                    query_axis="queries")[0])
+            rounds += 1
+        dt = (time.perf_counter() - t0) / max(rounds * len(sizes), 1)
+        grid2d = {
+            "mesh_shape": [n_dev // 2, 2],
+            "best_s": round(dt, 6),
+            "qps": round(BATCH / dt, 2),
+            "compiles_during_load": (
+                tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+                - backend0),
+        }
+        log(f"multichip 2-D grid {n_dev // 2}x2: {dt * 1e3:.2f} ms/iter"
+            f", {grid2d['compiles_during_load']:.0f} compiles under "
+            "mixed-size load")
+
     return {"n": n, "dim": D, "n_lists": n_lists, "n_probes": n_probes,
             "batch": BATCH, "n_chips": n_dev,
             "build_peak_deal_block_bytes": int(build_peak),
-            "cases": cases}
+            "cases": cases,
+            "kmeans_wire": {"n_rows": int(kx.shape[0]),
+                            "n_clusters": km_clusters,
+                            "n_iters": km_iters,
+                            "cases": kmeans_cases},
+            "grid2d": grid2d}
 
 
 def _bq_rider():

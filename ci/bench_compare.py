@@ -123,6 +123,18 @@ PINNED_ENV = {
     "BENCH_CAGRA_POOL": "4096",
     "BENCH_CAGRA_COARSE_POOL": "512",
     "BENCH_CAGRA_SECONDS": "2",
+    # graftwire (this PR): the multichip rider on the 4 forced virtual
+    # CPU devices — the quantized-vs-f32 k-means build A/B and the 2-D
+    # query×list grid's compiles-during-load column; small enough for
+    # seconds-scale CI, sharded enough that the wires actually cross
+    # shard boundaries
+    "BENCH_MULTICHIP": "1",
+    "BENCH_MC_N": "4096",
+    "BENCH_MC_LISTS": "32",
+    "BENCH_MC_PROBES": "5",
+    "BENCH_MC_SECONDS": "1",
+    "BENCH_MC_KMEANS_ITERS": "3",
+    "BENCH_MC_KMEANS_ROWS": "2048",
     # grafttier (PR 14): tiered storage rider — half the lists cold,
     # dual rooflines, two live placement epochs
     "BENCH_TIERED": "1",
@@ -268,6 +280,25 @@ DEFAULT_TOLERANCES = {
     "tiered.qps": {"min_ratio": 0.30},
     "tiered.hot_gbps": {"min_ratio": 0.2},
     "tiered.cold_gbps": {"min_ratio": 0.2},
+    # graftwire multichip rider (this PR). Structural columns TIGHT:
+    # the 2-D query×list grid must keep serving mixed batch sizes with
+    # ZERO backend compiles after warmup+primer (the recompile hole
+    # this PR closed — any regression reopens it); the modeled
+    # per-EM-iteration wire bytes are exact at the pinned config, so
+    # the int8 < bf16 < f32 ordering is encoded in the recorded
+    # values with zero slack; the narrow-wire inertia ratios may not
+    # drift past 2% of the f32 EM (the same tolerance the tier-1
+    # convergence test pins). Wall-clock columns keep the wide bands.
+    "multichip.grid2d.compiles_during_load": {"max_increase": 0},
+    "multichip.grid2d.qps": {"min_ratio": 0.30},
+    "multichip.kmeans_wire.cases.bf16.modeled_iter_wire_bytes":
+        {"max_increase": 0},
+    "multichip.kmeans_wire.cases.int8.modeled_iter_wire_bytes":
+        {"max_increase": 0},
+    "multichip.kmeans_wire.cases.bf16.inertia_vs_f32":
+        {"max_increase": 0.02},
+    "multichip.kmeans_wire.cases.int8.inertia_vs_f32":
+        {"max_increase": 0.02},
 }
 
 # counters the test session's metrics snapshot must carry ABOVE these

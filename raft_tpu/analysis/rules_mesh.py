@@ -47,6 +47,10 @@ VENEER_AXIS_POS = {
     "alltoall": 1, "device_send": 2, "device_recv": 2,
     "device_sendrecv": 2, "barrier": 0, "rank": 0, "size": 0,
     "mark_varying": 1, "timed_dispatch": 2,
+    # graftwire quantized veneers (same positional axis slot as their
+    # exact twins)
+    "allreduce_quantized": 2, "reducescatter_quantized": 2,
+    "allgather_quantized": 1,
 }
 
 

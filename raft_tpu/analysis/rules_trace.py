@@ -146,8 +146,12 @@ def check_recompile(project: Project) -> Iterable[Finding]:
                     _check_key_expr(f, node.value, out)
             elif isinstance(node, (ast.FunctionDef,
                                    ast.AsyncFunctionDef)):
-                if node.name in ("ragged_key", "coalesce_key",
-                                 "packing_key", "mesh_ragged_key"):
+                # lstrip covers private spellings like _mesh_key — the
+                # 2-D mesh identity tuple feeds every dist plan key, so
+                # a lossy coercion there is cache-fatal mesh-wide
+                if node.name.lstrip("_") in (
+                        "ragged_key", "coalesce_key", "packing_key",
+                        "mesh_ragged_key", "mesh_key"):
                     for stmt in ast.walk(node):
                         if (isinstance(stmt, ast.Return)
                                 and isinstance(stmt.value, (

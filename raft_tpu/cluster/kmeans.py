@@ -51,6 +51,10 @@ class KMeansParams:
     seed: int = 0
     oversampling_factor: float = 2.0  # kept for API parity (|| init)
     batch_samples: int = 1 << 15      # mini-batch E-step tile
+    # wire format of the distributed EM's per-iteration centroid-sum
+    # allreduce (f32|bf16|int8|auto — raft_tpu.distributed.kmeans.fit);
+    # the single-chip fit has no wire and ignores it
+    wire_dtype: str = "f32"
 
 
 def _check_metric(params: "KMeansParams") -> None:

@@ -213,10 +213,161 @@ def allgather_wire(x, axis: str = "data", wire_dtype: str = "f32"):
     return jax.lax.all_gather(xw, axis).astype(x.dtype)
 
 
+# wire formats for result-*reducing* collectives (allreduce /
+# reducescatter): SUM tolerates int8 too — EQuARX's recipe quantizes
+# per feature block, moves codes + scale planes on the wire, and sums
+# in ONE dequantized f32 epilog, so the narrow wire never compounds
+# per-hop rounding
+REDUCE_WIRE_DTYPES = ("f32", "bf16", "int8")
+
+# feature-block width of the EQuARX block-wise scales: one f32 scale
+# per 128 payload elements — the lane width, and small enough that one
+# outlier only poisons its own block's resolution
+QUANT_BLOCK = 128
+
+
+def resolve_reduce_wire_dtype(wire_dtype: str) -> str:
+    """Validate a reducing-collective ``wire_dtype`` (identity mapping —
+    ``int8`` has no jnp carrier; the quantized collectives pack it with
+    explicit block-wise scale planes)."""
+    if wire_dtype not in REDUCE_WIRE_DTYPES:
+        raise ValueError(
+            f"reduce wire_dtype must be one of {REDUCE_WIRE_DTYPES}, "
+            f"got {wire_dtype!r}")
+    return wire_dtype
+
+
+def _quantize_blocks(x, block: int):
+    """Symmetric EQuARX block quantization along the last axis: pad to
+    a multiple of ``block``, one f32 scale (``max|block| / 127``) per
+    feature block. Returns ``(codes int8 (..., nb, block),
+    scales f32 (..., nb, 1))`` — the uncounted prolog shared by the
+    quantized reducing collectives."""
+    n = x.shape[-1]
+    nb = -(-n // block)
+    pad = nb * block - n
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    xb = x.reshape(x.shape[:-1] + (nb, block))
+    scale = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)
+    q8 = jnp.clip(jnp.round(xb * (127.0 / scale)), -127, 127)
+    return q8.astype(jnp.int8), scale
+
+
+def _dequantize_blocks(xb, n: int):
+    """Flatten a dequantized (..., nb, block) f32 block view back to
+    (..., n) — the epilog twin of :func:`_quantize_blocks`."""
+    flat = xb.reshape(xb.shape[:-2] + (xb.shape[-2] * xb.shape[-1],))
+    return flat[..., :n]
+
+
+def allreduce_quantized(x, op: Op = Op.SUM, axis: str = "data",
+                        wire_dtype: str = "f32",
+                        block: int = QUANT_BLOCK):
+    """:func:`allreduce` with an opt-in quantized wire (the EQuARX
+    move applied to the *reducing* collectives — the distributed
+    k-means centroid-sum path):
+
+    - ``"f32"``: delegates to the exact all-reduce (counted under this
+      veneer's own ledger family).
+    - integer payloads (counts): ALWAYS the exact int32 wire,
+      whatever ``wire_dtype`` says — quantizing a count is never
+      acceptable, and int32 already matches f32's wire bytes.
+    - ``"bf16"``: the payload travels as bf16 and every rank's
+      contribution is summed in ONE f32 epilog (gather + sum), so the
+      narrow wire never compounds per-hop rounding.
+    - ``"int8"``: block-wise scales (:data:`QUANT_BLOCK` features per
+      f32 scale) ride beside the int8 codes; one dequantized f32
+      epilog sums the per-rank contributions.
+
+    Narrow wires are SUM-only (MAX/MIN/PROD of quantized codes would
+    reduce *rounded* values with no epilog to repair them)."""
+    resolve_reduce_wire_dtype(wire_dtype)
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        xi = x.astype(jnp.int32)
+        _count_collective("allreduce_quantized", xi)
+        return _allreduce_impl(xi, op, axis).astype(x.dtype)
+    if wire_dtype == "f32":
+        _count_collective("allreduce_quantized", x)
+        return _allreduce_impl(x, op, axis)
+    if op != Op.SUM:
+        raise ValueError(
+            f"quantized allreduce wires are SUM-only, got {op}")
+    if wire_dtype == "bf16":
+        xw = x.astype(jnp.bfloat16)
+        _count_collective("allreduce_quantized", xw)
+        return jnp.sum(jax.lax.all_gather(xw, axis).astype(jnp.float32),
+                       axis=0)
+    q8, scale = _quantize_blocks(x, block)
+    _count_collective("allreduce_quantized", (q8, scale))
+    all_q = jax.lax.all_gather(q8, axis)
+    all_s = jax.lax.all_gather(scale, axis)
+    acc = jnp.sum(all_q.astype(jnp.float32) * (all_s * (1.0 / 127.0)),
+                  axis=0)
+    return _dequantize_blocks(acc, x.shape[-1])
+
+
+def reducescatter_quantized(x, op: Op = Op.SUM, axis: str = "data",
+                            wire_dtype: str = "f32",
+                            block: int = QUANT_BLOCK, fold=None):
+    """:func:`reducescatter` with an opt-in quantized wire: quantize →
+    exchange row blocks in the narrow dtype (+ scale planes) → ONE
+    dequantized fold epilog. Rank r returns the ``op``-reduction of
+    every rank's r-th row block (leading dim must divide the axis).
+
+    ``fold`` replaces the ``op``-reduction with the caller's own
+    associative merge over the dequantized ``(R, rows/R, ...)`` f32
+    rank stack — the hook the 2-D mesh query×list top-k merge folds
+    through (its reduction is a sort-merge, not an :class:`Op`; the
+    received blocks stack in rank order, matching the allgather-merge
+    candidate order exactly).
+
+    Integer payloads always take the exact int32 wire; the pure
+    ``f32``/``SUM``/no-``fold`` case lowers to the native
+    psum_scatter."""
+    resolve_reduce_wire_dtype(wire_dtype)
+    if (wire_dtype == "f32" and op == Op.SUM and fold is None
+            and not jnp.issubdtype(x.dtype, jnp.integer)):
+        _count_collective("reducescatter_quantized", x)
+        return jax.lax.psum_scatter(x, axis, tiled=True)
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        xi = x.astype(jnp.int32)
+        _count_collective("reducescatter_quantized", xi)
+        stack = _alltoall_impl(xi, axis).astype(x.dtype)
+    elif wire_dtype == "f32":
+        _count_collective("reducescatter_quantized", x)
+        stack = _alltoall_impl(x, axis)
+    elif wire_dtype == "bf16":
+        xw = x.astype(jnp.bfloat16)
+        _count_collective("reducescatter_quantized", xw)
+        stack = _alltoall_impl(xw, axis).astype(jnp.float32)
+    else:
+        if op != Op.SUM and fold is None:
+            raise ValueError(
+                f"quantized reducescatter wires are SUM-only, got {op}")
+        q8, scale = _quantize_blocks(x, block)
+        _count_collective("reducescatter_quantized", (q8, scale))
+        all_q = _alltoall_impl(q8, axis)
+        all_s = _alltoall_impl(scale, axis)
+        stack = _dequantize_blocks(
+            all_q.astype(jnp.float32) * (all_s * (1.0 / 127.0)),
+            x.shape[-1])
+    if fold is not None:
+        return fold(stack)
+    if op == Op.SUM:
+        return jnp.sum(stack, axis=0)
+    if op == Op.MAX:
+        return jnp.max(stack, axis=0)
+    if op == Op.MIN:
+        return jnp.min(stack, axis=0)
+    return jnp.prod(stack, axis=0)
+
+
 # wire formats for the coarse/probe-candidate exchange: the payload is
 # *candidate scores* (compared, never accumulated), so it tolerates a
-# harder squeeze than the result merge — int8 with a per-row scale
-# (the EQuARX block-scaling recipe) quarters the bytes of f32
+# harder squeeze than the result merge — int8 with a per-row affine
+# scale pair (the EQuARX block-scaling recipe) quarters the bytes of f32
 PROBE_WIRE_DTYPES = ("f32", "bf16", "int8")
 
 
@@ -231,27 +382,41 @@ def resolve_probe_wire_dtype(wire_dtype: str) -> str:
     return wire_dtype
 
 
-def allgather_quantized(x, axis: str = "data", wire_dtype: str = "f32"):
+def allgather_quantized(x, axis: str = "data", wire_dtype: str = "f32",
+                        scale_ref=None):
     """:func:`allgather` of a (rows, n) score block with an opt-in
     quantized wire format, dequantized after the collective:
 
     - ``"f32"`` / ``"bf16"``: :func:`allgather_wire` (cast-only).
-    - ``"int8"``: symmetric per-row quantization — each row travels as
-      int8 codes plus one f32 scale (``max|row| / 127``), so the
-      payload is ~1/4 of f32 for n >> 1. Rounding is
+    - ``"int8"``: affine per-row quantization — each row travels as
+      int8 codes plus TWO f32 planes (the row's minimum and range), so
+      the payload is ~1/4 of f32 for n >> 1. Rounding is
       round-half-to-even (jnp.round), deterministic across shards.
 
-    Quantization error creates ties the caller must break
-    deterministically (the probe selects sort by (distance, id))."""
+    ``scale_ref`` (int8 only) supplies the block the per-row affine
+    scales derive from — pass the FULL pre-selection score block when
+    ``x`` is a selected subset, and the codes become independent of
+    *which* candidates were selected (and of how many): the
+    block-independence the ragged serving family's cap-vs-solo
+    bit-identity contract needs (PR 17 retired the int8 ragged pin on
+    exactly this property). Quantization is monotone per row, so
+    ranking survives up to the ties it creates — the caller must break
+    those deterministically (the probe selects sort by
+    (distance, id))."""
     if wire_dtype != "int8":
         return allgather_wire(x, axis, wire_dtype)
-    scale = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
-    scale = jnp.maximum(scale, jnp.finfo(jnp.float32).tiny)
-    q8 = jnp.clip(jnp.round(x * (127.0 / scale)), -127, 127)
-    _count_collective("allgather_quantized", (q8.astype(jnp.int8), scale))
-    all_q = jax.lax.all_gather(q8.astype(jnp.int8), axis)
-    all_s = jax.lax.all_gather(scale, axis)
-    return all_q.astype(jnp.float32) * (all_s * (1.0 / 127.0))
+    ref = x if scale_ref is None else scale_ref
+    lo = jnp.min(ref, axis=-1, keepdims=True)
+    span = jnp.max(ref, axis=-1, keepdims=True) - lo
+    span = jnp.maximum(span, jnp.finfo(jnp.float32).tiny)
+    q8 = jnp.clip(jnp.round((x - lo) * (254.0 / span)) - 127.0,
+                  -127, 127).astype(jnp.int8)
+    _count_collective("allgather_quantized", (q8, lo, span))
+    all_q = jax.lax.all_gather(q8, axis)
+    all_lo = jax.lax.all_gather(lo, axis)
+    all_sp = jax.lax.all_gather(span, axis)
+    return ((all_q.astype(jnp.float32) + 127.0) * (all_sp * (1.0 / 254.0))
+            + all_lo)
 
 
 def gather(x, root: int = 0, axis: str = "data", tiled: bool = False):
@@ -287,13 +452,20 @@ def reducescatter(x, op: Op = Op.SUM, axis: str = "data"):
     return jax.lax.psum_scatter(x, axis, tiled=True)
 
 
+def _alltoall_impl(x, axis: str):
+    """Uncounted all-to-all body — :func:`reducescatter_quantized`'s
+    row-block exchange routes through this so one logical quantized
+    collective bumps the ledger exactly once, under its own family."""
+    n = axis_size(axis)
+    blocks = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+    return jax.lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0)
+
+
 def alltoall(x, axis: str = "data"):
     """``comms_t`` device_multicast/alltoall: exchange row blocks so rank
     r receives block r from every rank (``lax.all_to_all``)."""
     _count_collective("alltoall", x)
-    n = axis_size(axis)
-    blocks = x.reshape((n, x.shape[0] // n) + x.shape[1:])
-    return jax.lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0)
+    return _alltoall_impl(x, axis)
 
 
 def _ring_permute(x, offset: int, axis: str):

@@ -180,6 +180,12 @@ class _Plan:
     # executable that hauls the whole cold tier back into HBM per
     # call (exactly the copy the tier exists to avoid)
     keep_sharding: bool = False
+    # 2-D query-sharded mesh plans (graftwire): the padded row count
+    # when it differs from the bucket — the bucket rounded up to a
+    # multiple of the query×list grid extent, so the query shards
+    # split evenly AND each list shard's scatter-merge slice stays
+    # whole. Dispatch pads/compiles to this instead of the bucket.
+    rows: Optional[int] = None
 
 
 class _Entry:
@@ -306,11 +312,16 @@ def _sds_sharded(x) -> Optional[jax.ShapeDtypeStruct]:
 def _mesh_key(comms) -> tuple:
     """Cache-key component identifying a mesh precisely (axis, names,
     shape, device ids) — ``str(mesh)`` alone would collide across
-    different device sets of the same shape."""
+    different device sets of the same shape. Covers 2-D grids whole:
+    BOTH axis names, the full device-grid shape, and the flat device
+    ordering are in the tuple, so a transposed or re-axed mesh can
+    never reuse another grid's executable. Everything here is already
+    a hashable static (graftlint R1 watches this function — no lossy
+    coercions on the key path)."""
     mesh = comms.mesh
     return ("mesh", comms.axis, tuple(mesh.axis_names),
-            tuple(int(s) for s in mesh.devices.shape),
-            tuple(int(d.id) for d in mesh.devices.flat))
+            tuple(mesh.devices.shape),
+            tuple(d.id for d in mesh.devices.flat))
 
 
 def _sig(*arrays) -> tuple:
@@ -486,7 +497,7 @@ class SearchExecutor:
         for b in (buckets if buckets is not None else self.buckets):
             expect(b in self.buckets, f"bucket {b} not in {self.buckets}")
             plan = self._plan(index, params, k, b, fw, kw)
-            self._get_entry(plan, b, k)
+            self._get_entry(plan, plan.rows or b, k)
         dt = time.perf_counter() - t0
         self.stats.warmup_seconds += dt
         tracing.inc_counter("serving.warmup_seconds", dt)
@@ -594,9 +605,12 @@ class SearchExecutor:
         membership mask), codes-only BQ (resolves to the rank
         estimate scan), brute force (no probe plane), ``TieredIvf``
         (the dual-tier fetch plan is placement-epoch state — see
-        :meth:`ragged_fallback_reason`), the int8 probe wire (its
-        per-query scales depend on the candidate block, breaking
-        cap-vs-solo bit-identity), and 2-D query-sharded mesh grids.
+        :meth:`ragged_fallback_reason`), and 2-D query-sharded mesh
+        grids (served zero-recompile by the bucketed 2-D plans
+        instead). The int8 probe wire rides ragged since its scales
+        went block-independent (per-row affine over the FULL local
+        coarse block — codes no longer depend on the candidate set,
+        so cap-vs-solo bit-identity holds).
 
         Two submissions may share one packed ragged batch iff their
         keys are equal. Unlike :meth:`coalesce_key`, ``n_probes`` and
@@ -880,11 +894,9 @@ class SearchExecutor:
                 "has no membership mask — bucketed path",
         "kw": "family-specific kwargs stay on the bucketed path",
         "empty": "empty index or k <= 0 — bucketed path",
-        "int8_probe_wire": "probe_wire_dtype='int8' scales depend on "
-                           "the candidate block, breaking cap-vs-solo "
-                           "bit-identity — bucketed path",
-        "query_axis": "query_axis grids serve through the direct "
-                      "distributed entry points — bucketed path",
+        "query_axis": "query_axis grids serve through the bucketed "
+                      "2-D plans (zero-recompile, scatter-merged) — "
+                      "no ragged front yet",
         "dist_filter": "distributed searches have no sample_filter "
                        "support",
         "family": "index family has no ragged front — bucketed path",
@@ -945,8 +957,6 @@ class SearchExecutor:
         if mesh:
             if kw.get("query_axis") is not None:
                 return None, reasons["query_axis"]
-            if kw.get("probe_wire_dtype", "f32") == "int8":
-                return None, reasons["int8_probe_wire"]
             if not set(kw) <= {"probe_mode", "wire_dtype",
                                "probe_wire_dtype"}:
                 return None, reasons["kw"]
@@ -1059,8 +1069,11 @@ class SearchExecutor:
         path families."""
         base = self._plan(index, spec["params_cls"], spec["k_class"],
                           tile, fw, spec["kw"])
+        # coarse_algo is pinned exact; query_axis is always None here
+        # (2-D grids are refused upstream) and the ragged fns don't
+        # take it
         statics = {n: v for n, v in base.static.items()
-                   if n != "coarse_algo"}
+                   if n not in ("coarse_algo", "query_axis")}
         key = (base.key[0] + "_ragged",) + base.key[1:]
         return dataclasses.replace(
             base, key=key, fn=self._ragged_fn(base.key[0]),
@@ -1122,7 +1135,10 @@ class SearchExecutor:
         plan = self._plan(index, params, k, bucket, fw, kw)
         expect(int(np.shape(queries)[1]) == plan.qdim, "query dim mismatch")
 
-        qp = self._pad(queries, bucket, plan.qdtype)
+        # 2-D query-sharded plans round the padded block up to the
+        # grid extent (plan.rows); every other plan pads to the bucket
+        rows = plan.rows or bucket
+        qp = self._pad(queries, rows, plan.qdtype)
         if plan.qsharding is not None:
             qp = jax.device_put(qp, plan.qsharding)
         args = list(plan.pre) + [qp]
@@ -1130,12 +1146,12 @@ class SearchExecutor:
         if plan.use_filter:
             fwp = fw
             if fw is not None and fw.ndim == 2:
-                fwp = self._pad(fw, bucket, fw.dtype)
+                fwp = self._pad(fw, rows, fw.dtype)
             args.append(fwp)
         ret = None
         with self._lock:
             entry, out_d, out_i, t0 = self._execute_entry_locked(
-                plan, bucket, k, args, q)
+                plan, rows, k, args, q)
             if plan.has_state and self.donate:
                 # outputs alias the donated state storage: the result
                 # slice (or, at full bucket, a copy — the un-padded
@@ -1143,7 +1159,7 @@ class SearchExecutor:
                 # before the lock releases, or a concurrent dispatch
                 # of the same plan could re-donate the buffers first
                 ret = ((jnp.copy(out_d), jnp.copy(out_i))
-                       if q == bucket
+                       if q == rows
                        else (out_d[:q], out_i[:q]))
         # mesh recording AFTER the lock releases: the readiness poll
         # lasts as long as the slowest shard, and holding the executor
@@ -1654,10 +1670,15 @@ class SearchExecutor:
 
     def _dist_statics(self, index, kw) -> tuple:
         """Shared mesh-plan pieces: (comms, probe_mode, wire_dtype,
-        probe_wire_dtype) — validated; the mesh-aware executor serves
-        the 1-D list-sharded layout with replicated queries
-        (``query_axis`` grids go through the direct search entry
-        points)."""
+        probe_wire_dtype, query_axis) — validated. ``query_axis``
+        (graftwire) names a second mesh axis to shard the padded query
+        block over: 2-D list×query grids serve through the same
+        bucketed AOT plans as 1-D meshes — the bucket rounds up to the
+        grid extent and the cache key carries the full 2-D mesh
+        identity (:func:`_mesh_key`), so steady state is
+        zero-recompile. ``"auto"`` wire dtypes resolve against the
+        modeled payload in :meth:`_plan_dist` (after the probe budget
+        is known)."""
         from raft_tpu.comms.comms import (
             resolve_probe_wire_dtype,
             resolve_wire_dtype,
@@ -1667,14 +1688,18 @@ class SearchExecutor:
         probe_mode = kw.get("probe_mode", "global")
         wire_dtype = kw.get("wire_dtype", "f32")
         probe_wire_dtype = kw.get("probe_wire_dtype", "f32")
+        query_axis = kw.get("query_axis")
         expect(probe_mode in ("global", "local"),
                f"probe_mode must be 'global' or 'local', got {probe_mode!r}")
-        resolve_wire_dtype(wire_dtype)
-        resolve_probe_wire_dtype(probe_wire_dtype)
-        expect(kw.get("query_axis") is None,
-               "SearchExecutor serves replicated queries; use the direct "
-               "distributed search entry points for query_axis grids")
-        return comms, probe_mode, wire_dtype, probe_wire_dtype
+        if wire_dtype != "auto":
+            resolve_wire_dtype(wire_dtype)
+        if probe_wire_dtype != "auto":
+            resolve_probe_wire_dtype(probe_wire_dtype)
+        if query_axis is not None:
+            expect(query_axis in comms.mesh.axis_names
+                   and query_axis != comms.axis,
+                   f"query_axis {query_axis!r} must be another mesh axis")
+        return comms, probe_mode, wire_dtype, probe_wire_dtype, query_axis
 
     def _plan_dist(self, index, params, k, bucket, fw, kw) -> _Plan:
         """ONE plan builder for the three list-sharded families —
@@ -1692,8 +1717,8 @@ class SearchExecutor:
 
         expect(fw is None,
                "distributed searches have no sample_filter support")
-        (comms, probe_mode, wire_dtype,
-         probe_wire_dtype) = self._dist_statics(index, kw)
+        (comms, probe_mode, wire_dtype, probe_wire_dtype,
+         query_axis) = self._dist_statics(index, kw)
         if isinstance(index, DistributedIvfFlat):
             from raft_tpu.ops.ivf_scan import resolve_scan_engine
 
@@ -1744,26 +1769,47 @@ class SearchExecutor:
                       index.rnorm, index.cfac, index.errw,
                       index.indices, index.data, index.data_norms)
             has_state = engine != "pallas"
+        rows = bucket
+        if query_axis is not None:
+            # the padded query block must divide the whole 2-D grid:
+            # a multiple of the query-axis extent (even query shards)
+            # × the list-axis extent (whole scatter-merge slices per
+            # list shard) — the bucketed-block move that makes 2-D
+            # grids zero-recompile like 1-D meshes
+            grid = comms.mesh.shape[query_axis] * comms.size
+            rows = -(-bucket // grid) * grid
+        wire_dtype, probe_wire_dtype = dist_ivf.resolve_auto_wires(
+            rows, k, n_probes, index.n_lists, comms.size, wire_dtype,
+            probe_mode, probe_wire_dtype)
         static = {"axis": comms.axis, "mesh": comms.mesh,
                   "n_probes": n_probes, "k": k, "metric": index.metric,
                   "probe_mode": probe_mode,
                   "coarse_algo": params.coarse_algo,
                   "scan_engine": engine, "wire_dtype": wire_dtype,
-                  "probe_wire_dtype": probe_wire_dtype, **extra}
-        key = (family, bucket, _mesh_key(comms),
+                  "probe_wire_dtype": probe_wire_dtype,
+                  "query_axis": query_axis, **extra}
+        key = (family, rows, _mesh_key(comms),
                _sig(*(a for a in arrays if a is not None))) + key_extra \
             + (tuple(sorted((n, str(v)) for n, v in static.items())),
                _filter_spec(None))
-        key, probe = self._probe_plumbing(
-            index, family, key, sharding=comms.sharding(comms.axis))
+        if query_axis is None:
+            key, probe = self._probe_plumbing(
+                index, family, key, sharding=comms.sharding(comms.axis))
+            qsharding = comms.replicated()
+        else:
+            # a query-sharded dispatch would write divergent replicas
+            # into the probe plane — 2-D plans skip the accounting
+            probe = None
+            qsharding = comms.sharding(query_axis, None)
         return _Plan(key=key, fn=fn, static=static, post=arrays,
                      qdim=index.dim, sharded=True, probe=probe,
                      has_state=has_state,
-                     qsharding=comms.replicated(),
-                     state_sharding=comms.replicated(),
+                     qsharding=qsharding,
+                     state_sharding=qsharding,
+                     rows=rows if query_axis is not None else None,
                      payload=(family,
                               lambda: dist_ivf.collective_payload_model(
-                                  bucket, k, n_probes, index.n_lists,
+                                  rows, k, n_probes, index.n_lists,
                                   comms.size, wire_dtype, probe_mode,
                                   probe_wire_dtype)))
 

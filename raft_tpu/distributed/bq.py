@@ -342,9 +342,13 @@ def _dist_search_bq_fn(queries, centers, rotation, codes, rnorm, cfac,
                     step, init, jnp.arange(local.shape[1]))
 
         with jax.named_scope("merge"):
+            # 2-D grids scatter-merge: each list shard merges a
+            # disjoint query slice instead of the whole replicated
+            # candidate table (bit-identical — rank-order stacks)
             merged = merge_results_sharded(
                 best_d, best_i, axis, select_min, wire_dtype,
-                smallest_id_ties=scan_engine != "rank")
+                smallest_id_ties=scan_engine != "rank",
+                scatter=query_axis is not None)
         if cnt is not None:
             return merged + (cnt,)
         return merged
@@ -455,6 +459,11 @@ def search_bq(
     expect(params.coarse_algo in ("exact", "approx"),
            f"coarse_algo must be 'exact' or 'approx', got "
            f"{params.coarse_algo!r}")
+    from raft_tpu.distributed.ivf import resolve_auto_wires
+
+    wire_dtype, probe_wire_dtype = resolve_auto_wires(
+        queries.shape[0], k, n_probes, index.n_lists, comms.size,
+        wire_dtype, probe_mode, probe_wire_dtype)
     resolve_wire_dtype(wire_dtype)
     resolve_probe_wire_dtype(probe_wire_dtype)
     from raft_tpu.ops.bq_scan import resolve_bq_engine

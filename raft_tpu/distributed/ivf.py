@@ -58,10 +58,13 @@ from raft_tpu.comms.comms import (
     allgather,
     allgather_quantized,
     allgather_wire,
+    alltoall,
     rank as comm_rank,
+    reducescatter_quantized,
     resolve_probe_wire_dtype,
     resolve_wire_dtype,
     shard_map,
+    size as comm_size,
 )
 from raft_tpu.core import interruptible, memwatch, tracing
 from raft_tpu.core.resources import Resources, ensure_resources
@@ -217,12 +220,17 @@ def select_probes_sharded(coarse, n_probes: int, axis: str,
     single-chip searches use (lean mode applies it to the local stage).
 
     ``probe_wire_dtype`` compresses the exchanged coarse *distances*
-    on the wire (``f32|bf16|int8`` — int8 rides a per-query scale,
-    :func:`raft_tpu.comms.comms.allgather_quantized`); candidate ids
-    stay exact int32, and the final probe select sorts (distance, id)
-    so compression-induced ties resolve deterministically. This trades
-    probe-selection fidelity (hence a little recall) for 2-4x fewer
-    coarse-exchange bytes — recall-checked in
+    on the wire (``f32|bf16|int8`` — int8 rides per-query affine
+    scales, :func:`raft_tpu.comms.comms.allgather_quantized`);
+    candidate ids stay exact int32, and the final probe select sorts
+    (distance, id) so compression-induced ties resolve
+    deterministically. The int8 scales derive from the FULL local
+    coarse block (``scale_ref=coarse``), BEFORE candidate selection —
+    each candidate's code is therefore independent of which (and how
+    many) candidates were selected, which is what lets the int8 wire
+    ride the ragged serving family's cap-vs-solo bit-identity
+    contract. This trades probe-selection fidelity (hence a little
+    recall) for 2-4x fewer coarse-exchange bytes — recall-checked in
     ``tests/test_distributed_serving.py``.
     """
     q, n_local = coarse.shape
@@ -235,8 +243,10 @@ def select_probes_sharded(coarse, n_probes: int, axis: str,
             dloc = jnp.take_along_axis(coarse, loc, axis=1)
             gid = loc.astype(jnp.int32) + rank.astype(jnp.int32) * n_local
             # (R, q, local_k); distances optionally ride a quantized
-            # wire format, ids always exact
-            all_d = allgather_quantized(dloc, axis, probe_wire_dtype)
+            # wire format (scales from the full pre-selection block —
+            # candidate-set-independent), ids always exact
+            all_d = allgather_quantized(dloc, axis, probe_wire_dtype,
+                                        scale_ref=coarse)
             all_g = allgather(gid, axis)
             r = all_d.shape[0]
             cand_d = jnp.moveaxis(all_d, 0, 1).reshape(q, r * local_k)
@@ -261,7 +271,8 @@ def select_probes_sharded(coarse, n_probes: int, axis: str,
 
 def merge_results_sharded(best_d, best_i, axis: str, select_min: bool,
                           wire_dtype: str = "f32",
-                          smallest_id_ties: bool = True):
+                          smallest_id_ties: bool = True,
+                          scatter: bool = False):
     """All-gather each shard's locally-reduced (q, k) top-k and merge —
     the O(q · k) result collective of every list-sharded search (the
     ``knn_merge_parts`` pattern inside the program).
@@ -276,10 +287,43 @@ def merge_results_sharded(best_d, best_i, axis: str, select_min: bool,
     the list-major engines' order, bit-identical to the single-chip
     engines even on exact-duplicate ties. ``False`` keeps the legacy
     positional ``knn_merge_parts`` tie-break of the rank-major and BQ
-    paths."""
+    paths.
+
+    ``scatter=True`` (the 2-D query×list grids) replaces the
+    all-ranks gather — where every list shard redundantly merges the
+    SAME (q, r·k) candidate table — with a scatter-merge: the
+    distances ride
+    :func:`raft_tpu.comms.comms.reducescatter_quantized`'s wire (fold
+    = this sort-merge), so each list shard receives all ranks'
+    candidates for a DISJOINT q/r query slice, merges only that
+    slice, and one (q/r, k) allgather reassembles the rows in rank
+    order — ~r/2× fewer merge bytes per shard. The received blocks
+    stack in rank order, matching the gathered candidate order
+    exactly, so the merged results are bit-identical to the
+    allgather path (which stays the static fallback when r does not
+    divide q)."""
+    r = comm_size(axis)
+    q, k = best_d.shape
+    if scatter and q % r == 0 and q >= r:
+        sub_i = alltoall(best_i, axis)                    # (R, q/r, k)
+        merged = reducescatter_quantized(
+            best_d, axis=axis, wire_dtype=wire_dtype,
+            fold=lambda sub_d: _merge_candidates(
+                sub_d, sub_i, k, select_min, smallest_id_ties))
+        return (allgather(merged[0], axis, tiled=True),
+                allgather(merged[1], axis, tiled=True))
     all_d = allgather_wire(best_d, axis, wire_dtype)      # (R, q, k)
     all_i = allgather(best_i, axis)
-    r, q, k = all_d.shape
+    return _merge_candidates(all_d, all_i, k, select_min,
+                             smallest_id_ties)
+
+
+def _merge_candidates(all_d, all_i, k: int, select_min: bool,
+                      smallest_id_ties: bool):
+    """Shared merge epilog of the gather and scatter wires: concat the
+    (R, rows, k) rank stacks in rank order and reduce each row's r·k
+    candidates to its top-k."""
+    r, q, _ = all_d.shape
     cat_d = jnp.moveaxis(all_d, 0, 1).reshape(q, r * k)
     cat_i = jnp.moveaxis(all_i, 0, 1).reshape(q, r * k)
     if not smallest_id_ties:
@@ -303,12 +347,14 @@ def collective_payload_model(q: int, k: int, n_probes: int, n_lists: int,
     ``coarse_bytes``/``merge_bytes`` are what the current implementation
     moves per shard; ``dense_coarse_bytes`` is the pre-lean coarse-block
     gather for comparison. ``probe_wire_dtype`` prices the quantized
-    candidate exchange (int8 adds one f32 scale per (query, shard))."""
+    candidate exchange (int8 adds TWO f32 affine-scale planes — min and
+    range — per (query, shard); the block-independent scheme the
+    ragged family's bit-identity contract rides)."""
     n_local = max(n_lists // max(r, 1), 1)
     local_k = min(n_probes, n_local)
     wire_itemsize = 2 if wire_dtype == "bf16" else 4
     probe_itemsize = {"f32": 4, "bf16": 2, "int8": 1}[probe_wire_dtype]
-    scale = 4 if probe_wire_dtype == "int8" else 0  # per-row f32 scale
+    scale = 8 if probe_wire_dtype == "int8" else 0  # per-row (min, range)
     dense = q * (n_local * probe_itemsize + scale)
     lean = q * (local_k * (probe_itemsize + 4) + scale)  # + int32 ids
     coarse = 0
@@ -405,6 +451,34 @@ def publish_payload_gauges(family: str, model: dict) -> None:
         base + "dense_coarse_bytes": float(model["dense_coarse_bytes"]),
         base + "merge_bytes": float(model["merge_bytes"]),
     })
+
+
+def resolve_auto_wires(q: int, k: int, n_probes: int, n_lists: int,
+                       r: int, wire_dtype: str, probe_mode: str,
+                       probe_wire_dtype: str) -> Tuple[str, str]:
+    """Resolve ``"auto"`` wire selections by argmin over the modeled
+    per-shard payload (:func:`collective_payload_model`) — the byte
+    accounting the comms ledger and bench riders publish, closing its
+    own loop. The merge wire argmins ``merge_bytes`` over the
+    result-wire formats, the probe wire ``coarse_bytes`` over the
+    probe-wire formats (the candidate orderings differ: int8's affine
+    scale planes can outweigh its code savings on tiny candidate
+    sets). Ties prefer the wider (less lossy) wire; concrete dtypes
+    pass through unchanged."""
+    from raft_tpu.comms.comms import PROBE_WIRE_DTYPES, WIRE_DTYPES
+
+    def bytes_for(wd: str, pwd: str) -> dict:
+        return collective_payload_model(q, k, n_probes, n_lists, r,
+                                        wd, probe_mode, pwd)
+
+    if wire_dtype == "auto":
+        wire_dtype = min(WIRE_DTYPES,
+                         key=lambda wd: bytes_for(wd, "f32")["merge_bytes"])
+    if probe_wire_dtype == "auto":
+        probe_wire_dtype = min(
+            PROBE_WIRE_DTYPES,
+            key=lambda pwd: bytes_for("f32", pwd)["coarse_bytes"])
+    return wire_dtype, probe_wire_dtype
 
 
 def resolve_query_sharding(comms: Comms, queries, query_axis):
@@ -614,9 +688,13 @@ def _dist_search_fn(queries, centers, data, data_norms, indices,
                     step, init, jnp.arange(local.shape[1]))
 
         with jax.named_scope("merge"):
+            # 2-D grids scatter-merge: each list shard merges a
+            # disjoint query slice instead of the whole replicated
+            # candidate table (bit-identical — rank-order stacks)
             merged = merge_results_sharded(
                 best_d, best_i, axis, select_min, wire_dtype,
-                smallest_id_ties=scan_engine != "rank")
+                smallest_id_ties=scan_engine != "rank",
+                scatter=query_axis is not None)
         if cnt is not None:
             return merged + (cnt,)
         return merged
@@ -735,6 +813,9 @@ def search(
     expect(params.coarse_algo in ("exact", "approx"),
            f"coarse_algo must be 'exact' or 'approx', got "
            f"{params.coarse_algo!r}")
+    wire_dtype, probe_wire_dtype = resolve_auto_wires(
+        queries.shape[0], k, n_probes, index.n_lists, comms.size,
+        wire_dtype, probe_mode, probe_wire_dtype)
     resolve_wire_dtype(wire_dtype)
     resolve_probe_wire_dtype(probe_wire_dtype)
     from raft_tpu.ops.ivf_scan import resolve_scan_engine
@@ -1105,9 +1186,13 @@ def _dist_search_pq_fn(queries, centers, rotation, codebooks, codes,
                     step, init, jnp.arange(local.shape[1]))
 
         with jax.named_scope("merge"):
+            # 2-D grids scatter-merge: each list shard merges a
+            # disjoint query slice instead of the whole replicated
+            # candidate table (bit-identical — rank-order stacks)
             merged = merge_results_sharded(
                 best_d, best_i, axis, select_min, wire_dtype,
-                smallest_id_ties=scan_engine != "rank")
+                smallest_id_ties=scan_engine != "rank",
+                scatter=query_axis is not None)
         if cnt is not None:
             return merged + (cnt,)
         return merged
@@ -1207,6 +1292,9 @@ def search_pq(
     expect(params.coarse_algo in ("exact", "approx"),
            f"coarse_algo must be 'exact' or 'approx', got "
            f"{params.coarse_algo!r}")
+    wire_dtype, probe_wire_dtype = resolve_auto_wires(
+        queries.shape[0], k, n_probes, index.n_lists, comms.size,
+        wire_dtype, probe_mode, probe_wire_dtype)
     resolve_wire_dtype(wire_dtype)
     resolve_probe_wire_dtype(probe_wire_dtype)
     scan_engine = ivf_pq_mod.resolve_scan_engine(params.scan_engine)

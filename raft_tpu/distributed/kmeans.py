@@ -19,9 +19,59 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from raft_tpu.cluster.kmeans import _kmeanspp_init
-from raft_tpu.comms.comms import Comms, Op, allreduce, shard_map
+from raft_tpu.comms.comms import (
+    QUANT_BLOCK,
+    REDUCE_WIRE_DTYPES,
+    Comms,
+    Op,
+    allreduce,
+    allreduce_quantized,
+    shard_map,
+)
 from raft_tpu.core import tracing
 from raft_tpu.core.validation import expect
+
+
+def collective_payload_model(n_clusters: int, dim: int,
+                             wire_dtype: str = "f32",
+                             block: int = QUANT_BLOCK) -> dict:
+    """Modeled per-EM-iteration wire bytes per shard — the build-side
+    twin of :func:`raft_tpu.distributed.ivf.collective_payload_model`
+    (what the bench rider emits next to the measured A/B, and what
+    ``wire_dtype="auto"`` argmins over).
+
+    ``sums_bytes`` prices the centroid-sum allreduce on the chosen
+    wire (int8 adds one f32 scale per :data:`QUANT_BLOCK` feature
+    block per centroid); ``counts_bytes`` is the exact int32 count
+    reduction, wire-dtype-independent by design."""
+    itemsize = {"f32": 4, "bf16": 2, "int8": 1}[wire_dtype]
+    nb = -(-dim // block)
+    scale = n_clusters * nb * 4 if wire_dtype == "int8" else 0
+    sums = n_clusters * dim * itemsize + scale
+    counts = n_clusters * 4
+    return {
+        "sums_bytes": sums,
+        "counts_bytes": counts,
+        "iter_bytes": sums + counts,
+        "wire_dtype": wire_dtype,
+    }
+
+
+def resolve_kmeans_wire(wire_dtype: str, n_clusters: int,
+                        dim: int) -> str:
+    """Resolve the EM ``wire_dtype``: ``"auto"`` argmins the modeled
+    per-iteration bytes (:func:`collective_payload_model`) over the
+    reduce-wire formats — the byte accounting closing its own loop;
+    ties prefer the wider (less lossy) wire."""
+    if wire_dtype == "auto":
+        return min(REDUCE_WIRE_DTYPES,
+                   key=lambda wd: collective_payload_model(
+                       n_clusters, dim, wd)["iter_bytes"])
+    if wire_dtype not in REDUCE_WIRE_DTYPES:
+        raise ValueError(
+            f"wire_dtype must be 'auto' or one of {REDUCE_WIRE_DTYPES}, "
+            f"got {wire_dtype!r}")
+    return wire_dtype
 
 
 def fit(
@@ -30,13 +80,35 @@ def fit(
     n_clusters: int,
     n_iters: int = 20,
     seed: int = 0,
+    wire_dtype: str = "f32",
+    params=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Fit k-means over a row-sharded dataset.
 
     Returns (centers (k, d) replicated, inertia scalar). Matches the
     single-device :func:`raft_tpu.cluster.kmeans.fit` EM up to shard
     summation order.
+
+    ``wire_dtype`` (``f32|bf16|int8|auto``, default exact f32 — also
+    settable via :class:`raft_tpu.cluster.kmeans.KMeansParams`
+    ``.wire_dtype``) compresses the per-iteration centroid-sum
+    allreduce on the wire (EQuARX block-wise scales,
+    :func:`raft_tpu.comms.comms.allreduce_quantized`); the count
+    reduction always rides the exact int32 wire and the convergence
+    inertia stays f32, so a narrow wire perturbs only the M-step's
+    summed coordinates — convergence vs the f32 EM is pinned in
+    ``tests/test_comms.py``. ``"auto"`` argmins the modeled
+    per-iteration bytes (:func:`collective_payload_model`).
+
+    ``params`` (a :class:`raft_tpu.cluster.kmeans.KMeansParams`)
+    optionally carries the wire choice instead: its ``.wire_dtype``
+    wins over the keyword when given — the opt-in surface callers who
+    already thread KMeansParams use.
     """
+    if params is not None:
+        wire_dtype = params.wire_dtype
+    wire_dtype = resolve_kmeans_wire(wire_dtype, n_clusters,
+                                     jnp.asarray(x).shape[-1])
     x = jnp.asarray(x, jnp.float32)
     expect(x.ndim == 2, "x must be (n, d)")
     n, d = x.shape
@@ -67,11 +139,20 @@ def fit(
                 labels = jnp.argmin(d2, axis=1)
                 sums = jax.ops.segment_sum(x_loc, labels,
                                            num_segments=n_clusters)
-                counts = jax.ops.segment_sum(
-                    jnp.ones((x_loc.shape[0],), jnp.float32), labels,
-                    num_segments=n_clusters)
-                sums = allreduce(sums, Op.SUM, axis)
-                counts = allreduce(counts, Op.SUM, axis)
+                if wire_dtype == "f32":
+                    sums = allreduce(sums, Op.SUM, axis)
+                    counts = allreduce(jax.ops.segment_sum(
+                        jnp.ones((x_loc.shape[0],), jnp.float32),
+                        labels, num_segments=n_clusters), Op.SUM, axis)
+                else:
+                    # quantized centroid-sum wire; counts ride the
+                    # exact int32 path inside the same veneer
+                    sums = allreduce_quantized(sums, Op.SUM, axis,
+                                               wire_dtype=wire_dtype)
+                    counts = allreduce_quantized(jax.ops.segment_sum(
+                        jnp.ones((x_loc.shape[0],), jnp.int32),
+                        labels, num_segments=n_clusters),
+                        Op.SUM, axis).astype(jnp.float32)
                 new = sums / jnp.maximum(counts, 1.0)[:, None]
                 return jnp.where((counts > 0)[:, None], new, centers)
 
@@ -84,9 +165,12 @@ def fit(
             inertia = allreduce(jnp.sum(jnp.min(d2, axis=1)), Op.SUM, axis)
             return centers, inertia
 
+        # check_vma=False: the quantized allreduce's gather+sum epilog
+        # is replicated by construction but not statically inferrable
+        # (same stance as the serving fns)
         return shard_map(
             body, mesh=comms.mesh, in_specs=(P(axis, None), P()),
-            out_specs=(P(), P()),
+            out_specs=(P(), P()), check_vma=False,
         )(x_sh, c0)
 
     with tracing.range("raft_tpu.distributed.kmeans_fit"):

@@ -173,6 +173,79 @@ def main():
     except Exception as e:  # noqa: BLE001
         emit("dist_ivf_scan", error=str(e)[:300])
 
+    # ---- graftwire quantized collectives on the real mesh: the
+    # EQuARX-style block-scaled reduce wires (allreduce /
+    # reducescatter) and the block-independent affine probe gather,
+    # compiled through shard_map across every visible chip, plus the
+    # quantized k-means EM's convergence vs the exact f32 wire — a
+    # 1-chip "mesh" still compiles the full quantize → narrow
+    # collective → dequantize program end to end
+    try:
+        from jax.sharding import PartitionSpec as P
+
+        from raft_tpu.comms import local_comms
+        from raft_tpu.comms.comms import (
+            Op,
+            allgather_quantized,
+            allreduce_quantized,
+            reducescatter_quantized,
+        )
+        from raft_tpu.distributed import kmeans as dist_kmeans
+
+        comms = local_comms()
+        axis, nd = comms.axis, comms.size
+        rep = {"n_chips": nd}
+        mat = jnp.asarray(
+            rng.standard_normal((nd * 128, 256)).astype(np.float32))
+        mat = mat.at[:, 128:192].multiply(100.0)  # stress the scales
+        want = np.asarray(mat).reshape(nd, -1, 256).sum(0)
+        ref = max(float(np.abs(want).max()), 1e-9)
+
+        # check_vma=False on the replicated-out calls: the quantized
+        # epilogs are replicated by construction but not statically
+        # inferrable (same stance as the serving fns)
+        def _run(fn):
+            return np.asarray(comms.run(
+                fn, mat, in_specs=(P(axis, None),), out_specs=P(),
+                check_vma=False))
+
+        for wd in ("bf16", "int8"):
+            got = _run(lambda m, wd=wd: allreduce_quantized(
+                m, Op.SUM, axis, wire_dtype=wd))
+            rep[f"allreduce_{wd}_rel_err"] = float(
+                np.abs(got - want).max() / ref)
+        mi = (mat * 3.0).astype(jnp.int32)
+        got_i = np.asarray(comms.run(
+            lambda m: allreduce_quantized(m, Op.SUM, axis,
+                                          wire_dtype="int8"),
+            mi, in_specs=(P(axis, None),), out_specs=P(),
+            check_vma=False))
+        want_i = np.asarray(mi).reshape(nd, -1, 256).sum(0)
+        rep["allreduce_int32_exact"] = bool((got_i == want_i).all())
+        rs = np.asarray(comms.run(
+            lambda m: reducescatter_quantized(m, Op.SUM, axis,
+                                              wire_dtype="int8"),
+            mat, in_specs=(P(axis, None),), out_specs=P(axis, None)))
+        rep["reducescatter_int8_rel_err"] = float(
+            np.abs(rs - want).max() / ref)
+        gath = np.asarray(comms.run(
+            lambda m: allgather_quantized(m, axis, "int8"),
+            mat, in_specs=(P(axis, None),), out_specs=P(),
+            check_vma=False))          # stacked (n_shards, rows, n)
+        rep["allgather_int8_rel_err"] = float(
+            np.abs(gath.reshape(-1, mat.shape[1])
+                   - np.asarray(mat)).max()
+            / max(float(np.abs(np.asarray(mat)).max()), 1e-9))
+        kx2 = jnp.asarray(rng.standard_normal(
+            (4096 - 4096 % nd, 64)).astype(np.float32))
+        _, in_f = dist_kmeans.fit(comms, kx2, 32, n_iters=8)
+        _, in_q = dist_kmeans.fit(comms, kx2, 32, n_iters=8,
+                                  wire_dtype="int8")
+        rep["kmeans_int8_inertia_vs_f32"] = float(in_q) / float(in_f)
+        emit("quantized_wire", **rep)
+    except Exception as e:  # noqa: BLE001
+        emit("quantized_wire", error=str(e)[:300])
+
     # ---- fused BQ estimate-then-rerank compiled: on-chip pallas ≡
     # xla parity on ids + the one-stream byte check (the compiled
     # fused program's cost_analysis bytes must sit well under the

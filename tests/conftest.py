@@ -32,6 +32,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: beyond the tier-1 budget (e.g. the 16-shard point of "
+        "the quantized-wire recall study) — deselected by -m 'not "
+        "slow'")
+
+
 @pytest.fixture
 def rng_np():
     return np.random.default_rng(42)

@@ -443,6 +443,61 @@ def dispatch(thunk):
     return timed_dispatch("knn", thunk, "data"), spec
 '''
 
+# graftwire: the quantized-collective veneers join R3's axis-literal
+# discipline at the same positional slots as their exact twins
+R3_QUANTIZED_VIOLATING = '''\
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import (
+    Op,
+    allgather_quantized,
+    allreduce_quantized,
+    reducescatter_quantized,
+)
+
+
+def reduce_sums(sums, coarse):
+    spec = P("data")
+    s = allreduce_quantized(sums, Op.SUM, "dataa", wire_dtype="int8")
+    m = reducescatter_quantized(sums, Op.SUM, axis="datb")
+    g = allgather_quantized(coarse, "datc", "int8")
+    return s, m, g, spec
+'''
+R3_QUANTIZED_CONFORMING = '''\
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.comms.comms import (
+    Op,
+    allgather_quantized,
+    allreduce_quantized,
+    reducescatter_quantized,
+)
+
+
+def reduce_sums(sums, coarse):
+    spec = P("data")
+    s = allreduce_quantized(sums, Op.SUM, "data", wire_dtype="int8")
+    m = reducescatter_quantized(sums, Op.SUM, axis="data")
+    g = allgather_quantized(coarse, "data", "int8")
+    return s, m, g, spec
+'''
+
+# graftwire: R1's key discipline extends to mesh_key-spelled builders —
+# the 2-D mesh identity tuple feeds every dist plan key
+R1_MESH_KEY_VIOLATING = '''\
+def _mesh_key(comms):
+    mesh = comms.mesh
+    return ("mesh", comms.axis, [d.id for d in mesh.devices.flat],
+            int(mesh.devices.size))
+'''
+R1_MESH_KEY_CONFORMING = '''\
+def _mesh_key(comms):
+    mesh = comms.mesh
+    return ("mesh", comms.axis, tuple(mesh.axis_names),
+            tuple(mesh.devices.shape),
+            tuple(d.id for d in mesh.devices.flat))
+'''
+
 R6_OPS_VIOLATING = '''\
 from jax.experimental import pallas as pl
 
@@ -523,6 +578,21 @@ class TestFixtureCorpus:
         assert rules_fired(bad) == {"R3"}
         assert "'dataa'" in bad.findings[0].message
         assert lint_lib(R3_AXIS_CONFORMING, ["R3"]).ok
+
+    def test_r3_quantized_veneers(self):
+        bad = lint_lib(R3_QUANTIZED_VIOLATING, ["R3"])
+        assert rules_fired(bad) == {"R3"}
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "allreduce_quantized" in msgs, msgs
+        assert "reducescatter_quantized" in msgs, msgs
+        assert "allgather_quantized" in msgs, msgs
+        assert lint_lib(R3_QUANTIZED_CONFORMING, ["R3"]).ok
+
+    def test_r1_mesh_key_discipline(self):
+        bad = lint_lib(R1_MESH_KEY_VIOLATING, ["R1"])
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "unhashable" in msgs and "int()" in msgs, msgs
+        assert lint_lib(R1_MESH_KEY_CONFORMING, ["R1"]).ok
 
     def test_r4_missing_params_and_grid(self):
         bad = lint_lib(R4_VIOLATING, ["R4"])

@@ -92,17 +92,25 @@ class TestCollectives:
 
     def test_ledger_one_bump_per_call(self, comms):
         """Delegating veneers (reduce → allreduce body, non-SUM
-        reducescatter, device_recv → ring permute) must bump the
-        trace-time collective ledger exactly once, under their OWN
-        family — a scrape reading comms.* must not see one logical
-        collective double-counted (graftscope v2 wire-cost ledger)."""
-        from raft_tpu.comms.comms import device_recv, reduce
+        reducescatter, device_recv → ring permute, the quantized
+        collectives → gather/alltoall bodies) must bump the trace-time
+        collective ledger exactly once, under their OWN family — a
+        scrape reading comms.* must not see one logical collective
+        double-counted (graftscope v2 wire-cost ledger)."""
+        from raft_tpu.comms.comms import (
+            allreduce_quantized,
+            device_recv,
+            reduce,
+            reducescatter_quantized,
+        )
         from raft_tpu.core import tracing
 
         x = np.tile(np.arange(N_DEV, dtype=np.float32), N_DEV)
+        fams = ("reducescatter", "allreduce", "reduce", "device_send",
+                "device_recv", "allreduce_quantized",
+                "reducescatter_quantized", "allgather", "alltoall")
         before = {k: tracing.get_counter(f"comms.{k}.calls")
-                  for k in ("reducescatter", "allreduce", "reduce",
-                            "device_send", "device_recv")}
+                  for k in fams}
         comms.run(lambda v: reducescatter(v, Op.MAX, comms.axis),
                   self._shard(comms, x),
                   in_specs=P(comms.axis), out_specs=P(comms.axis))
@@ -112,11 +120,26 @@ class TestCollectives:
         comms.run(lambda v: device_recv(v, 1, comms.axis),
                   self._shard(comms, np.arange(N_DEV, dtype=np.float32)),
                   in_specs=P(comms.axis), out_specs=P(comms.axis))
+        # int8 wires route through the uncounted all_gather/alltoall
+        # bodies — only the quantized family may bump
+        m = x.reshape(N_DEV * N_DEV, 1)
+        comms.run(lambda v: allreduce_quantized(
+                      v, Op.SUM, comms.axis, wire_dtype="int8"),
+                  self._shard(comms, m),
+                  in_specs=P(comms.axis, None),
+                  out_specs=P(comms.axis, None))
+        comms.run(lambda v: reducescatter_quantized(
+                      v, Op.SUM, comms.axis, wire_dtype="int8"),
+                  self._shard(comms, m),
+                  in_specs=P(comms.axis, None),
+                  out_specs=P(comms.axis, None))
         delta = {k: tracing.get_counter(f"comms.{k}.calls") - before[k]
                  for k in before}
         assert delta == {"reducescatter": 1.0, "allreduce": 0.0,
                          "reduce": 1.0, "device_send": 0.0,
-                         "device_recv": 1.0}
+                         "device_recv": 1.0, "allreduce_quantized": 1.0,
+                         "reducescatter_quantized": 1.0,
+                         "allgather": 0.0, "alltoall": 0.0}
 
     def test_alltoall(self, comms):
         # rank r holds rows [r*8, (r+1)*8); after alltoall rank r holds
@@ -159,7 +182,154 @@ class TestCollectives:
             c.split("nope")
 
 
+class TestQuantizedCollectives:
+    """graftwire: the EQuARX-style quantized reducing collectives —
+    block-wise scales on the wire, ONE dequantized f32 epilog (never
+    per-hop accumulation in the narrow dtype), integer payloads always
+    exact int32."""
+
+    def _run(self, comms, fn, x):
+        return np.asarray(comms.run(
+            fn, jax.device_put(jnp.asarray(x), comms.sharding("data",
+                                                              None)),
+            in_specs=P("data", None), out_specs=P("data", None)))
+
+    @pytest.fixture(scope="class")
+    def payload(self):
+        rng = np.random.default_rng(7)
+        # mixed magnitudes so per-block scales matter: column blocks
+        # at very different dynamic ranges
+        x = rng.standard_normal((N_DEV * 16, 300)).astype(np.float32)
+        x[:, 128:256] *= 100.0
+        return x
+
+    @pytest.mark.parametrize("wire,tol", [
+        ("f32", 0.0), ("bf16", 5e-3), ("int8", 2e-2)])
+    def test_allreduce_sum_close(self, comms, payload, wire, tol):
+        from raft_tpu.comms.comms import allreduce_quantized
+
+        got = self._run(comms, lambda v: allreduce_quantized(
+            v, Op.SUM, "data", wire_dtype=wire), payload)
+        ref = payload.reshape(N_DEV, -1, 300).sum(axis=0)
+        ref_full = np.tile(ref, (N_DEV, 1))
+        scale = np.abs(ref).max()
+        if wire == "f32":
+            np.testing.assert_array_equal(got, ref_full)
+        else:
+            assert np.abs(got - ref_full).max() / scale <= tol
+
+    def test_allreduce_integer_exact(self, comms, payload):
+        """Counts and other integer payloads NEVER quantize — the wire
+        is exact int32 whatever wire_dtype asks for."""
+        from raft_tpu.comms.comms import allreduce_quantized
+
+        xi = (payload * 10).astype(np.int32)
+        got = self._run(comms, lambda v: allreduce_quantized(
+            v, Op.SUM, "data", wire_dtype="int8"), xi)
+        ref = np.tile(xi.reshape(N_DEV, -1, 300).sum(axis=0),
+                      (N_DEV, 1))
+        np.testing.assert_array_equal(got, ref)
+        assert got.dtype == np.int32
+
+    def test_narrow_non_sum_raises(self, comms, payload):
+        from raft_tpu.comms.comms import allreduce_quantized
+
+        with pytest.raises(ValueError, match="SUM"):
+            self._run(comms, lambda v: allreduce_quantized(
+                v, Op.MAX, "data", wire_dtype="int8"), payload)
+
+    def test_bad_wire_dtype_raises(self, comms, payload):
+        from raft_tpu.comms.comms import allreduce_quantized
+
+        with pytest.raises(ValueError, match="wire_dtype"):
+            self._run(comms, lambda v: allreduce_quantized(
+                v, Op.SUM, "data", wire_dtype="f16"), payload)
+
+    @pytest.mark.parametrize("wire,tol", [
+        ("f32", 0.0), ("bf16", 5e-3), ("int8", 2e-2)])
+    def test_reducescatter_sum_close(self, comms, payload, wire, tol):
+        from raft_tpu.comms.comms import reducescatter_quantized
+
+        got = self._run(comms, lambda v: reducescatter_quantized(
+            v, Op.SUM, "data", wire_dtype=wire), payload)
+        ref = payload.reshape(N_DEV, -1, 300).sum(axis=0)
+        scale = np.abs(ref).max()
+        if wire == "f32":
+            np.testing.assert_array_equal(got, ref)
+        else:
+            assert np.abs(got - ref).max() / scale <= tol
+
+    def test_reducescatter_max(self, comms, payload):
+        from raft_tpu.comms.comms import reducescatter_quantized
+
+        got = self._run(comms, lambda v: reducescatter_quantized(
+            v, Op.MAX, "data", wire_dtype="f32"), payload)
+        ref = payload.reshape(N_DEV, -1, 300).max(axis=0)
+        np.testing.assert_array_equal(got, ref)
+
+    def test_fold_hook(self, comms, payload):
+        """The ``fold`` epilog receives the stacked per-source blocks
+        — the 2-D scatter-merge's entry point (it folds a top-k merge
+        instead of a sum)."""
+        from raft_tpu.comms.comms import reducescatter_quantized
+
+        got = self._run(comms, lambda v: reducescatter_quantized(
+            v, axis="data", wire_dtype="f32",
+            fold=lambda stack: jnp.min(stack, axis=0)), payload)
+        ref = payload.reshape(N_DEV, -1, 300).min(axis=0)
+        np.testing.assert_array_equal(got, ref)
+
+
 class TestDistributedKMeans:
+    @pytest.mark.parametrize("wire", ["bf16", "int8", "auto"])
+    def test_quantized_wire_converges(self, rng_np, wire):
+        """Acceptance (graftwire): the quantized centroid-sum wire
+        converges to an inertia within a pinned tolerance of the f32
+        EM on >= 4 shards, and the modeled per-iteration bytes order
+        int8 < bf16 < f32."""
+        from raft_tpu.comms import Comms
+        from raft_tpu.comms.bootstrap import make_mesh
+        from raft_tpu.distributed import kmeans as dkm
+
+        comms4 = Comms(make_mesh(("data",),
+                                 devices=jax.devices()[:4]), "data")
+        centers_true = rng_np.standard_normal((16, 48)) * 6
+        x = (centers_true[rng_np.integers(0, 16, 4096)]
+             + rng_np.standard_normal((4096, 48))).astype(np.float32)
+        _, in_f32 = dkm.fit(comms4, x, 16, n_iters=12, wire_dtype="f32")
+        _, in_q = dkm.fit(comms4, x, 16, n_iters=12, wire_dtype=wire)
+        assert float(in_q) <= float(in_f32) * 1.02, (wire, float(in_q),
+                                                     float(in_f32))
+
+    def test_payload_model_and_auto(self):
+        from raft_tpu.distributed import kmeans as dkm
+
+        models = {wd: dkm.collective_payload_model(64, 96, wd)
+                  for wd in ("f32", "bf16", "int8")}
+        # counts always ride the exact int32 wire
+        assert all(m["counts_bytes"] == 64 * 4 for m in models.values())
+        # int8 pays one f32 scale per 128-feature block per centroid
+        assert models["int8"]["sums_bytes"] == 64 * 96 + 64 * 4
+        assert (models["int8"]["iter_bytes"]
+                < models["bf16"]["iter_bytes"]
+                < models["f32"]["iter_bytes"])
+        assert dkm.resolve_kmeans_wire("auto", 64, 96) == "int8"
+        with pytest.raises(ValueError, match="wire_dtype"):
+            dkm.resolve_kmeans_wire("f16", 64, 96)
+
+    def test_params_carry_wire_dtype(self, rng_np):
+        """KMeansParams.wire_dtype is the opt-in surface: a params
+        object with a narrow wire serves the same fit as the keyword."""
+        from raft_tpu.cluster.kmeans import KMeansParams
+        from raft_tpu.distributed import kmeans as dkm
+
+        comms = local_comms()
+        x = rng_np.standard_normal((1024, 32)).astype(np.float32)
+        _, i_kw = dkm.fit(comms, x, 8, n_iters=5, wire_dtype="int8")
+        _, i_p = dkm.fit(comms, x, 8, n_iters=5,
+                         params=KMeansParams(wire_dtype="int8"))
+        assert float(i_kw) == float(i_p)
+
     def test_matches_global_clustering(self, rng_np):
         comms = local_comms()
         centers_true = rng_np.standard_normal((8, 16)) * 6

@@ -187,32 +187,48 @@ class TestLeanCollectives:
         assert m["merge_bytes"] == 64 * 10 * (2 + 4)  # bf16 wire + ids
 
 
+def _sharded_flat(n_shards):
+    """A flat index list-sharded over ``n_shards`` devices + queries —
+    the quantized-wire recall study's fixture builder."""
+    import jax
+
+    from raft_tpu.comms.comms import Comms
+    from raft_tpu.comms.bootstrap import make_mesh
+
+    rng = np.random.default_rng(23)
+    x = rng.standard_normal((4096, 32)).astype(np.float32)
+    q = rng.standard_normal((64, 32)).astype(np.float32)
+    comms = Comms(make_mesh(("data",),
+                            devices=jax.devices()[:n_shards]), "data")
+    dist = dist_ivf.build(None, comms, IvfFlatIndexParams(n_lists=64), x)
+    return dist, q
+
+
 class TestQuantizedProbeExchange:
     """ROADMAP item: the probe-candidate exchange rides the
-    ``probe_wire_dtype`` quantized wire (bf16, opt-in int8 with a
-    per-query scale) — recall-checked at 4 shards against the exact
-    f32 exchange."""
+    ``probe_wire_dtype`` quantized wire (bf16, and int8 with
+    block-independent per-row affine scales) — recall swept vs shard
+    count against the exact f32 exchange (graftwire satellite: the
+    16-shard point is the slow-marked tail of the study)."""
 
     @pytest.fixture(scope="class")
     def four_shard(self):
-        import jax
-
-        from raft_tpu.comms.comms import Comms
-        from raft_tpu.comms.bootstrap import make_mesh
-
-        rng = np.random.default_rng(23)
-        x = rng.standard_normal((4096, 32)).astype(np.float32)
-        q = rng.standard_normal((64, 32)).astype(np.float32)
-        comms4 = Comms(make_mesh(("data",),
-                                 devices=jax.devices()[:4]), "data")
-        dist = dist_ivf.build(None, comms4, IvfFlatIndexParams(n_lists=64),
-                              x)
-        return dist, q
+        return _sharded_flat(4)
 
     @pytest.mark.parametrize("probe_wire", ["bf16", "int8"])
-    def test_recall_at_4_shards(self, four_shard, probe_wire):
-        dist, q = four_shard
-        # n_local = 16, n_probes = 4 -> lean candidate exchange
+    @pytest.mark.parametrize("n_shards", [
+        4, 8,
+        pytest.param(16, marks=pytest.mark.slow),
+    ])
+    def test_recall_vs_shards(self, n_shards, probe_wire):
+        import jax
+
+        if len(jax.devices()) < n_shards:
+            pytest.skip(f"needs {n_shards} devices")
+        dist, q = _sharded_flat(n_shards)
+        # n_local = 64 / n_shards, n_probes = 4 -> the exchange goes
+        # lean at 4/8 shards and dense at 16 (2*4 >= 64/16) — the
+        # sweep covers both wire layouts
         sp = IvfFlatSearchParams(n_probes=4, scan_engine="xla")
         _, i_exact = dist_ivf.search(None, sp, dist, q, 10)
         _, i_q = dist_ivf.search(None, sp, dist, q, 10,
@@ -223,7 +239,7 @@ class TestQuantizedProbeExchange:
             len(set(got[r]) & set(exact[r])) / 10
             for r in range(exact.shape[0])])
         floor = 0.99 if probe_wire == "bf16" else 0.95
-        assert recall >= floor, (probe_wire, recall)
+        assert recall >= floor, (n_shards, probe_wire, recall)
 
     def test_dense_fallback_also_quantizes(self, four_shard):
         """Probing most of the index takes the dense coarse-block
@@ -272,7 +288,9 @@ class TestQuantizedProbeExchange:
             probe_wire_dtype="int8")
         assert f32["coarse_bytes"] == 64 * 32 * 8
         assert bf16["coarse_bytes"] == 64 * 32 * 6
-        assert i8["coarse_bytes"] == 64 * (32 * 5 + 4)  # + f32 scale
+        # + per-row (min, range) f32 affine scale pair — the
+        # block-independent scheme that lets int8 ride ragged
+        assert i8["coarse_bytes"] == 64 * (32 * 5 + 8)
         assert i8["coarse_bytes"] < bf16["coarse_bytes"] \
             < f32["coarse_bytes"]
 
@@ -370,7 +388,7 @@ class TestMeshExecutor:
         np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
         np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
 
-    def test_rejects_filter_and_query_axis(self, data, flat_pair):
+    def test_rejects_filter_and_bad_query_axis(self, data, flat_pair):
         from raft_tpu.core.bitset import Bitset
         from raft_tpu.core.validation import RaftError
         from raft_tpu.neighbors.filters import BitsetFilter
@@ -382,9 +400,116 @@ class TestMeshExecutor:
         with pytest.raises(RaftError, match="sample_filter"):
             ex.search(dist, q, 5, params=IvfFlatSearchParams(n_probes=4),
                       sample_filter=BitsetFilter(bs))
+        # query_axis must name ANOTHER axis of the index's mesh — a
+        # 1-D mesh has none to offer
         with pytest.raises(RaftError, match="query_axis"):
             ex.search(dist, q, 5, params=IvfFlatSearchParams(n_probes=4),
                       query_axis="queries")
+
+
+def _grid_pair(data):
+    """The same dataset list-sharded over a 1-D 4-device mesh and over
+    the lists axis of a 4×2 (lists × queries) grid — built with the
+    same params so the quantizer and deal are identical."""
+    import jax
+    from jax.sharding import Mesh
+
+    from raft_tpu.comms.comms import Comms
+
+    x, _ = data
+    params = IvfFlatIndexParams(n_lists=32)
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    c2 = Comms(Mesh(devs, ("lists", "queries")), "lists")
+    c1 = Comms(Mesh(np.array(jax.devices()[:4]), ("data",)), "data")
+    return (dist_ivf.build(None, c1, params, x),
+            dist_ivf.build(None, c2, params, x))
+
+
+class Test2DMeshExecutor:
+    """graftwire: 2-D query×list grids join the zero-recompile world —
+    the executor's bucketed plans shard the padded query block over
+    ``query_axis``, scatter-merge within the list axis, and key the
+    AOT cache on the full 2-D mesh identity."""
+
+    @pytest.fixture(scope="class")
+    def grid_pair(self, data):
+        return _grid_pair(data)
+
+    @pytest.mark.parametrize("engine", ["rank", "xla", "pallas"])
+    def test_bit_identical_to_1d(self, data, grid_pair, engine):
+        _, q = data
+        d1, d2 = grid_pair
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine=engine)
+        ex = SearchExecutor()
+        a_d, a_i = ex.search(d1, q, 5, params=sp)
+        b_d, b_i = ex.search(d2, q, 5, params=sp,
+                             query_axis="queries")
+        np.testing.assert_array_equal(np.asarray(a_i), np.asarray(b_i))
+        np.testing.assert_array_equal(np.asarray(a_d), np.asarray(b_d))
+
+    def test_quantized_wires_bit_identical_to_1d(self, data, grid_pair):
+        _, q = data
+        d1, d2 = grid_pair
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        ex = SearchExecutor()
+        kw = dict(wire_dtype="bf16", probe_wire_dtype="int8")
+        a_d, a_i = ex.search(d1, q, 5, params=sp, **kw)
+        b_d, b_i = ex.search(d2, q, 5, params=sp,
+                             query_axis="queries", **kw)
+        np.testing.assert_array_equal(np.asarray(a_i), np.asarray(b_i))
+        np.testing.assert_array_equal(np.asarray(a_d), np.asarray(b_d))
+
+    def test_zero_recompiles_under_load(self, data, grid_pair):
+        """Warm the ladder once, then prime-sized batches serve with
+        ZERO backend compiles — the recompile hole the 2-D mesh used
+        to have (the per-query-shard block is bucketed and the plan
+        key carries the 2-D mesh)."""
+        rng = np.random.default_rng(31)
+        _, d2 = grid_pair
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        dist = d2
+        ex = SearchExecutor(min_bucket=16, max_bucket=64)
+        ex.warmup(dist, k=5, params=sp, query_axis="queries")
+        # primer: one dispatch per bucket compiles nothing new but
+        # creates the tiny per-size pad programs
+        for n in (16, 13, 9, 64, 33):
+            ex.search(dist, rng.standard_normal(
+                (n, 32)).astype(np.float32), 5, params=sp,
+                query_axis="queries")
+        tracing.install_xla_compile_listener()
+        c0 = tracing.get_counter(tracing.XLA_COMPILE_COUNT)
+        compiles0 = ex.stats.compile_count
+        for n in (16, 13, 9, 64, 33, 9, 13):
+            ex.search(dist, rng.standard_normal(
+                (n, 32)).astype(np.float32), 5, params=sp,
+                query_axis="queries")
+        assert ex.stats.compile_count == compiles0
+        assert tracing.get_counter(tracing.XLA_COMPILE_COUNT) == c0
+
+    def test_auto_wire_selection(self, data, grid_pair):
+        """``wire_dtype="auto"``/``probe_wire_dtype="auto"`` close the
+        loop on the payload model: the resolved plan serves, and the
+        model's argmin picks the narrowest wire at this shape."""
+        _, q = data
+        d1, d2 = grid_pair
+        sp = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        ex = SearchExecutor()
+        b_d, b_i = ex.search(d2, q, 5, params=sp, query_axis="queries",
+                             wire_dtype="auto", probe_wire_dtype="auto")
+        assert np.asarray(b_d).shape == (16, 5)
+        # at this tiny grid int8's scale plane ties bf16's dense block
+        # — the tie prefers the wider (less lossy) wire
+        wd, pwd = dist_ivf.resolve_auto_wires(
+            16, 5, 8, 32, 4, "auto", "global", "auto")
+        assert wd == "bf16" and pwd == "bf16"
+        # at a serving-scale candidate shape the int8 codes dwarf
+        # their scale plane and the argmin flips to int8
+        _, pwd_big = dist_ivf.resolve_auto_wires(
+            64, 10, 32, 4096, 8, "auto", "global", "auto")
+        assert pwd_big == "int8"
+        # concrete dtypes pass through untouched
+        assert dist_ivf.resolve_auto_wires(
+            16, 5, 8, 32, 4, "f32", "global", "bf16") == ("f32", "bf16")
 
 
 class TestStreamedBuildDeal:
@@ -819,7 +944,9 @@ class TestMeshRagged:
     params class) replaces the distributed bucket ladder. Bit-identity
     per request vs the bucketed mesh dispatch, zero-recompile mixed
     load, probe accounting exact, and the mesh-specific residue
-    (int8 probe wire, query_axis) falls back with explicit reasons."""
+    (query_axis grids) falls back with an explicit reason; the int8
+    probe wire rides ragged since graftwire's block-independent
+    scales."""
 
     @pytest.fixture(scope="class")
     def mesh_indexes(self, comms, data):
@@ -919,10 +1046,13 @@ class TestMeshRagged:
         index = mesh_indexes["flat"]
         ex = SearchExecutor()
         p = IvfFlatSearchParams(n_probes=5, scan_engine="xla")
+        # graftwire: the int8 probe wire went block-independent
+        # (per-row affine scales over the FULL local coarse block), so
+        # its ragged pin is retired — int8 is raggable now
         assert ex.ragged_key(index, 4, params=p,
-                             probe_wire_dtype="int8") is None
-        assert "int8" in ex.ragged_fallback_reason(
-            index, 4, params=p, probe_wire_dtype="int8")
+                             probe_wire_dtype="int8") is not None
+        assert ex.ragged_fallback_reason(
+            index, 4, params=p, probe_wire_dtype="int8") is None
         assert ex.ragged_key(index, 4, params=p,
                              query_axis="q") is None
         assert "query_axis" in ex.ragged_fallback_reason(
@@ -931,6 +1061,24 @@ class TestMeshRagged:
         # budget-prefix property)
         assert ex.ragged_key(index, 4, params=p, wire_dtype="bf16",
                              probe_wire_dtype="bf16") is not None
+
+    def test_int8_probe_wire_bit_identical(self, mesh_indexes):
+        """The retired pin's acceptance: an int8-probe-wire ragged
+        dispatch is bit-identical to the solo bucketed search — the
+        block-independent scales make codes independent of what else
+        shares the tile (cap-vs-solo)."""
+        index = mesh_indexes["flat"]
+        ex = SearchExecutor(ragged_tile=16)
+        p1 = IvfFlatSearchParams(n_probes=5, scan_engine="xla")
+        p2 = IvfFlatSearchParams(n_probes=8, scan_engine="xla")
+        blocks = self._blocks(seed=13)[:2]
+        res = ex.search_ragged(index, blocks, 4, params_list=[p1, p2],
+                               probe_wire_dtype="int8")
+        for b, (d, i), pj in zip(blocks, res, [p1, p2]):
+            sd, si = ex.search(index, b, 4, params=pj,
+                               probe_wire_dtype="int8")
+            np.testing.assert_array_equal(i, np.asarray(si))
+            np.testing.assert_array_equal(d, np.asarray(sd))
 
     def test_bf16_wire_bit_identical(self, mesh_indexes):
         index = mesh_indexes["flat"]

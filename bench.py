@@ -1456,6 +1456,91 @@ def _tiered_rider():
     log(f"tiered epochs: swap bytes {swap_bytes}, compiles during "
         f"epochs {compiles}, post-epoch bit_identical={post_identical}")
 
+    # --- prefetch A/B (PR 18 graftcast): the SAME seeded drifting
+    # hot set served twice — reactive epochs vs the forecast-driven
+    # prefetcher. A forecast hit moved its block at stage time, so
+    # the epoch path's cold-stream bytes (tier.promote_cold_bytes)
+    # must STRICTLY drop with the prefetcher on; and after one warm
+    # drift cycle (the stage/mix programs specialize once, like the
+    # warm epoch above) the measured window must add ZERO backend
+    # compiles. Both legs replay identical traffic (pinned rng), so
+    # their epochs run identical plans — the bytes column isolates
+    # the prefetcher.
+    from raft_tpu.serving.prefetch import HITS, ISSUED, MISSES
+    from raft_tpu.serving.prefetch import PrefetchConfig
+
+    def _prefetch_leg(with_prefetch):
+        t2 = tiered.build_tiered(index, hot_fraction=0.5)
+        ex2 = SearchExecutor(probe_accounting=True)
+        clk = ManualClock()
+        mgr2 = TierManager(t2, ex2, clock=clk, config=PlacementConfig(
+            epoch_every_s=60.0, max_swaps_per_epoch=4,
+            prefetch_lead_s=10.0))
+        if with_prefetch:
+            mgr2.enable_prefetch(config=PrefetchConfig(alpha=0.5))
+        hot0 = [int(lid) for lid in t2.hot_lists[:8]]
+        cold0 = [int(lid) for lid in t2.cold_lists[:8]]
+        ex2.warmup(t2, buckets=(ex2.bucket_for(BATCH),), k=K, params=p)
+        lat = []
+
+        def drive(lists, ticks, measure=False):
+            rng = np.random.default_rng(11)
+            lists = np.asarray(lists)
+            for _ in range(ticks):
+                lids = lists[rng.integers(0, len(lists), BATCH)]
+                q2 = (centers_np[lids]
+                      + 0.01 * rng.standard_normal((BATCH, D))
+                      ).astype(np.float32)
+                t0 = time.perf_counter()
+                jax.block_until_ready(
+                    ex2.search(t2, q2, K, params=p)[0])
+                if measure:
+                    lat.append(time.perf_counter() - t0)
+                clk.advance(11.0)
+                mgr2.tick()
+
+        drive(hot0, 12)              # settle on hot0
+        drive(cold0, 14)             # warm drift cycle (specialize)
+        c0 = dict(tracing.counters())
+        drive(hot0, 14, measure=True)   # measured drift-back
+        c1 = dict(tracing.counters())
+
+        def delta(name):
+            return float(c1.get(name, 0) - c0.get(name, 0))
+
+        lat.sort()
+        return {
+            "promotions": delta("tier.promotions"),
+            "promote_cold_bytes": delta("tier.promote_cold_bytes"),
+            "prefetch_issued": delta(ISSUED),
+            "prefetch_hits": delta(HITS),
+            "prefetch_misses": delta(MISSES),
+            "compiles_during_load": delta(tracing.XLA_COMPILE_COUNT),
+            "p99_ms": round(
+                lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1e3, 3),
+        }
+
+    log("tiered prefetch A/B: reactive leg")
+    reactive = _prefetch_leg(False)
+    log("tiered prefetch A/B: prefetch-on leg")
+    on = _prefetch_leg(True)
+    pf_total = on["prefetch_hits"] + on["prefetch_misses"]
+    prefetch_ab = {
+        "reactive": reactive,
+        "on": on,
+        "hit_rate": round(on["prefetch_hits"] / pf_total, 4)
+        if pf_total else 0.0,
+        "cold_bytes_saved": reactive["promote_cold_bytes"]
+        - on["promote_cold_bytes"],
+        "reduces_cold_bytes": int(
+            on["promote_cold_bytes"] < reactive["promote_cold_bytes"]),
+    }
+    log(f"tiered prefetch A/B: hits {on['prefetch_hits']:.0f}/"
+        f"{on['prefetch_issued']:.0f} issued, cold bytes "
+        f"{reactive['promote_cold_bytes']:.0f} -> "
+        f"{on['promote_cold_bytes']:.0f}, compiles during load "
+        f"{on['compiles_during_load']:.0f}")
+
     return {
         "n": n, "dim": D, "n_lists": n_lists, "n_probes": n_probes,
         "batch": BATCH, "k": K, "max_list_size": m,
@@ -1477,6 +1562,7 @@ def _tiered_rider():
         "swap_bytes_per_epoch": swap_bytes,
         "swap_bytes_total": int(sum(swap_bytes)),
         "compiles_during_epochs": compiles,
+        "prefetch": prefetch_ab,
     }
 
 

@@ -280,6 +280,21 @@ DEFAULT_TOLERANCES = {
     "tiered.qps": {"min_ratio": 0.30},
     "tiered.hot_gbps": {"min_ratio": 0.2},
     "tiered.cold_gbps": {"min_ratio": 0.2},
+    # graftcast prefetch A/B (PR 18). Structural columns TIGHT:
+    # reduces_cold_bytes is the acceptance criterion itself —
+    # prefetch-on must STRICTLY beat the reactive leg's cold-stream
+    # bytes on the identical seeded drift (both legs replay the same
+    # traffic, so the promotions match and only staged hits separate
+    # them); compiles_during_load pins "the prefetcher adds zero" (the
+    # measured window runs after one warm drift cycle, like the epoch
+    # warm above); hit_rate keeps a generous floor band (the forecast
+    # is deterministic at the pinned seeds, the band absorbs plan-
+    # policy tuning). p99 keeps the wide wall-clock band.
+    "tiered.prefetch.reduces_cold_bytes": {"min_ratio": 1.0},
+    "tiered.prefetch.on.compiles_during_load": {"max_increase": 0},
+    "tiered.prefetch.hit_rate": {"min_ratio": 0.5},
+    "tiered.prefetch.on.p99_ms": {"max_ratio": 4.0,
+                                  "max_increase": 50.0},
     # graftwire multichip rider (this PR). Structural columns TIGHT:
     # the 2-D query×list grid must keep serving mixed batch sizes with
     # ZERO backend compiles after warmup+primer (the recompile hole

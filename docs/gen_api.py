@@ -49,6 +49,7 @@ MODULES = [
     "raft_tpu.serving.harness", "raft_tpu.serving.gauge",
     "raft_tpu.serving.flight", "raft_tpu.serving.continuous",
     "raft_tpu.serving.federation", "raft_tpu.serving.placement",
+    "raft_tpu.serving.prefetch",
     "raft_tpu.core.profiling",
     "raft_tpu.core.xplane", "raft_tpu.core.memwatch",
     "raft_tpu.comms", "raft_tpu.comms.bootstrap",

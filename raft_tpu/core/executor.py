@@ -880,9 +880,6 @@ class SearchExecutor:
     # the documented non-raggable residue, as stable reason strings —
     # what ragged_fallback_reason returns and the fallback tests pin
     _RAGGED_RESIDUE = {
-        "tiered": "tiered_ivf: the dual-tier fetch plan is "
-                  "placement-epoch state (hot/cold slot maps swap "
-                  "between dispatches) — bucketed path",
         "cagra_k": "cagra: the k class cap exceeds itopk_size, so the "
                    "class executable's beam buffer would differ from "
                    "the solo run's — bucketed path",
@@ -917,11 +914,20 @@ class SearchExecutor:
             DistributedIvfPq,
         )
         from raft_tpu.neighbors import ivf_bq, ivf_flat, ivf_pq
-        from raft_tpu.neighbors.tiered import TieredIvf
+        from raft_tpu.neighbors import tiered as tiered_mod
 
         reasons = self._RAGGED_RESIDUE
         families = (
-            (TieredIvf, None, None, "tiered"),
+            # graftcast: the tiered containers joined the ragged
+            # family — their plans are placement-generation-stable
+            # (shape-keyed, re-snapshotted per dispatch), so epochs
+            # permute placement without touching the one executable
+            (tiered_mod.TieredIvf, "tiered_ivf",
+             tiered_mod.TieredSearchParams, None),
+            (tiered_mod.TieredIvfPq, "tiered_ivf_pq",
+             ivf_pq.IvfPqSearchParams, None),
+            (tiered_mod.TieredIvfBq, "tiered_ivf_bq",
+             ivf_bq.IvfBqSearchParams, None),
             (DistributedIvfFlat, "dist_ivf_flat",
              ivf_flat.IvfFlatSearchParams, None),
             (DistributedIvfPq, "dist_ivf_pq",
@@ -1036,6 +1042,12 @@ class SearchExecutor:
                      "_search_ragged_fn"),
         "ivf_pq": ("raft_tpu.neighbors.ivf_pq", "_search_ragged_fn"),
         "ivf_bq": ("raft_tpu.neighbors.ivf_bq", "_search_ragged_fn"),
+        "tiered_ivf": ("raft_tpu.neighbors.tiered",
+                       "_tiered_search_ragged_fn"),
+        "tiered_ivf_pq": ("raft_tpu.neighbors.tiered",
+                          "_tiered_pq_search_ragged_fn"),
+        "tiered_ivf_bq": ("raft_tpu.neighbors.tiered",
+                          "_tiered_bq_search_ragged_fn"),
         "cagra": ("raft_tpu.neighbors.cagra", "_search_ragged_fn"),
         "dist_ivf_flat": ("raft_tpu.distributed.ivf",
                           "_dist_search_ragged_fn"),
@@ -1649,12 +1661,22 @@ class SearchExecutor:
         from raft_tpu.neighbors.ivf_bq import IvfBqIndex
         from raft_tpu.neighbors.ivf_flat import IvfFlatIndex
         from raft_tpu.neighbors.ivf_pq import IvfPqIndex
-        from raft_tpu.neighbors.tiered import TieredIvf
+        from raft_tpu.neighbors.tiered import (
+            TieredIvf,
+            TieredIvfBq,
+            TieredIvfPq,
+        )
 
         if isinstance(index, BruteForceIndex):
             return self._plan_brute_force(index, k, bucket, fw, kw)
         if isinstance(index, TieredIvf):
             return self._plan_tiered(index, params, k, bucket, fw, kw)
+        if isinstance(index, TieredIvfPq):
+            return self._plan_tiered_pq(index, params, k, bucket, fw,
+                                        kw)
+        if isinstance(index, TieredIvfBq):
+            return self._plan_tiered_bq(index, params, k, bucket, fw,
+                                        kw)
         if isinstance(index, IvfFlatIndex):
             return self._plan_ivf_flat(index, params, k, bucket, fw, kw)
         if isinstance(index, IvfPqIndex):
@@ -1909,6 +1931,74 @@ class SearchExecutor:
                      post=arrays, use_filter=True, qdim=index.dim,
                      has_state=engine != "pallas", probe=probe,
                      keep_sharding=True)
+
+    def _plan_tiered_pq(self, index, params, k, bucket, fw,
+                        kw) -> _Plan:
+        """Tiered-PQ plan (graftcast) — the ``_plan_ivf_pq`` statics
+        with the codes plane split hot/cold. Same
+        generation-snapshot + shape-keyed discipline as
+        :meth:`_plan_tiered`: the placement arrays never enter the
+        cache key, every dispatch re-snapshots one consistent
+        generation, so epochs are zero-recompile."""
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.neighbors import tiered as m
+        from raft_tpu.ops.tier_scan import resolve_tier_pq_engine
+
+        params = params or ivf_pq.IvfPqSearchParams()
+        expect(index.max_list_size > 0, "tiered index is empty")
+        score_mode = ivf_pq.resolve_score_mode(params.score_mode,
+                                               index.pq_book_size)
+        engine = resolve_tier_pq_engine(params.scan_engine)
+        (hot_codes,), (cold_codes,), hot_map, cold_map, _ = \
+            index.tier_planes()
+        static = {"n_probes": min(params.n_probes, index.n_lists),
+                  "k": k, "metric": index.metric,
+                  "codebook_kind": index.codebook_kind,
+                  "lut_dtype": params.lut_dtype,
+                  "score_mode": score_mode, "packed": index.packed,
+                  "coarse_algo": params.coarse_algo,
+                  "scan_engine": engine}
+        arrays = (index.centers, index.rotation, index.codebooks,
+                  hot_codes, cold_codes, hot_map, cold_map,
+                  index.indices)
+        key = ("tiered_ivf_pq", bucket, _sig(*arrays),
+               tuple(sorted((n, str(v)) for n, v in static.items())),
+               _filter_spec(fw))
+        key, probe = self._probe_plumbing(index, "tiered_ivf_pq", key)
+        return _Plan(key=key, fn=m._tiered_pq_search_fn,
+                     static=static, post=arrays, use_filter=True,
+                     qdim=index.dim, probe=probe, keep_sharding=True)
+
+    def _plan_tiered_bq(self, index, params, k, bucket, fw,
+                        kw) -> _Plan:
+        """Tiered-BQ plan (graftcast) — the ``_plan_ivf_bq`` statics
+        with the five record planes split hot/cold under one slot
+        decision. Generation-snapshot + shape-keyed like the other
+        tiered plans."""
+        from raft_tpu.neighbors import ivf_bq
+        from raft_tpu.neighbors import tiered as m
+        from raft_tpu.ops.bq_scan import auto_query_bits
+        from raft_tpu.ops.tier_scan import resolve_tier_bq_engine
+
+        params = params or ivf_bq.IvfBqSearchParams()
+        expect(index.max_list_size > 0, "tiered index is empty")
+        engine = resolve_tier_bq_engine(params.scan_engine)
+        qb = params.query_bits or auto_query_bits(index.bits)
+        hots, colds, hot_map, cold_map, _ = index.tier_planes()
+        static = {"n_probes": min(params.n_probes, index.n_lists),
+                  "k": k, "metric": index.metric,
+                  "coarse_algo": params.coarse_algo,
+                  "scan_engine": engine, "epsilon": params.epsilon,
+                  "query_bits": qb}
+        arrays = (index.centers, index.rotation) + hots + colds + (
+            hot_map, cold_map, index.indices, index.data_norms)
+        key = ("tiered_ivf_bq", bucket, _sig(*arrays),
+               tuple(sorted((n, str(v)) for n, v in static.items())),
+               _filter_spec(fw))
+        key, probe = self._probe_plumbing(index, "tiered_ivf_bq", key)
+        return _Plan(key=key, fn=m._tiered_bq_search_fn,
+                     static=static, post=arrays, use_filter=True,
+                     qdim=index.dim, probe=probe, keep_sharding=True)
 
     def _plan_ivf_pq(self, index, params, k, bucket, fw, kw) -> _Plan:
         from raft_tpu.neighbors import ivf_pq as m

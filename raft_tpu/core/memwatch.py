@@ -368,6 +368,11 @@ class MemoryLedger:
         self._devices = None
         self._wm_in_use = 0.0
         self._wm_forecast = 0.0
+        # named byte holds (graftcast prefetch and friends): bytes a
+        # background channel has claimed but serving must still see
+        # as spoken for — headroom subtracts them, so an admission
+        # racing a prefetch can never both win the same bytes
+        self._reservations: Dict[str, int] = {}
         # the last snapshot publish() produced (the flight recorder's
         # low-headroom trigger reads it instead of recomputing the
         # whole truth the same scrape just published)
@@ -475,18 +480,22 @@ class MemoryLedger:
         thunkable None when live stats decide) — shared by the public
         :meth:`headroom_bytes` and :meth:`snapshot` so one scrape
         never re-reads the backend or re-walks the model for the same
-        answer."""
+        answer. Named holds (:meth:`reserve`) subtract LAST: reserved
+        bytes are spoken for whichever source measured the room."""
+        base: Optional[float] = None
         if stats["supported"] and stats["devices"]:
             rooms = [d["limit_bytes"] - d["in_use_bytes"]
                      for d in stats["devices"].values()
                      if d["limit_bytes"] > 0]
             if rooms:
-                return float(min(rooms))
-        if self.capacity_bytes is not None:
+                base = float(min(rooms))
+        if base is None and self.capacity_bytes is not None:
             if fc is None:
                 fc = self.forecast()
-            return float(self.capacity_bytes - fc["peak_bytes"])
-        return None
+            base = float(self.capacity_bytes - fc["peak_bytes"])
+        if base is None:
+            return None
+        return base - self.reserved_bytes()
 
     def headroom_bytes(self) -> Optional[float]:
         """Remaining per-device headroom: min over devices of
@@ -535,6 +544,52 @@ class MemoryLedger:
             raise CapacityExceeded(what, nbytes,
                                    verdict["headroom_bytes"])
         tracing.inc_counter(GATE_ADMITTED)
+
+    # -- named reservations (graftcast prefetch) ----------------------------
+
+    def reserve(self, what: str, nbytes: int) -> None:
+        """Set the named hold ``what`` to ``nbytes``: the bytes a
+        background channel (the tier prefetcher's staged miss cache)
+        has claimed ahead of placement. Held bytes subtract from
+        every subsequent :meth:`headroom_bytes` read, so a build /
+        extend / sibling-prefetch admission racing this channel can
+        never be granted the same bytes — a prefetch can never OOM
+        what serving needs. GROWING a hold passes through the
+        capacity gate (:class:`CapacityExceeded` on refusal, decision
+        counted like :meth:`admit`; the prior hold is kept);
+        shrinking — including to 0 — is always admissible."""
+        nbytes = int(nbytes)
+        expect(nbytes >= 0, "a reservation cannot hold negative bytes")
+        with self._lock:
+            prev = int(self._reservations.pop(what, 0))
+            if nbytes <= prev:
+                if nbytes > 0:
+                    self._reservations[what] = nbytes
+                return
+        # growth: judged against headroom WITHOUT the prior hold
+        # (popped above) — the gate prices the full new hold, not
+        # the delta on top of bytes it already refused once
+        verdict = self.fits(nbytes)
+        if not verdict["fits"]:
+            with self._lock:
+                if prev > 0:
+                    self._reservations[what] = prev
+            tracing.inc_counter(GATE_REFUSED)
+            raise CapacityExceeded(what, nbytes,
+                                   verdict["headroom_bytes"])
+        tracing.inc_counter(GATE_ADMITTED)
+        with self._lock:
+            self._reservations[what] = nbytes
+
+    def release(self, what: str) -> None:
+        """Drop the named hold entirely (idempotent)."""
+        with self._lock:
+            self._reservations.pop(what, None)
+
+    def reserved_bytes(self) -> float:
+        """Total bytes across all named holds."""
+        with self._lock:
+            return float(sum(self._reservations.values()))
 
     # -- dispatch-time watermark --------------------------------------------
 
@@ -594,6 +649,7 @@ class MemoryLedger:
             "resident_total_bytes": fc["resident_bytes"],
             "host_resident_total_bytes": float(host_total),
             "forecast": fc,
+            "reserved_held_bytes": self.reserved_bytes(),
             "headroom_bytes": headroom,
             "divergence_bytes": divergence,
             "watermark": {"in_use_peak_bytes": wm_in_use,
@@ -620,6 +676,7 @@ class MemoryLedger:
                 snap["forecast"]["probe_plane_bytes"],
             "memory.reserved.max_temp_bytes":
                 snap["forecast"]["max_temp_bytes"],
+            "memory.reserved.held_bytes": snap["reserved_held_bytes"],
             "memory.forecast.peak_bytes": snap["forecast"]["peak_bytes"],
             "memory.hbm.headroom_bytes":
                 -1.0 if snap["headroom_bytes"] is None

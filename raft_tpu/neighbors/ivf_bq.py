@@ -692,7 +692,9 @@ def score_probe(lists, qrot, centers_rot, ip, cn, qnorm, codes, rnorm,
 def _search_impl_fn(queries, centers, rotation, codes, rnorm, cfac,
                     errw, indices, data, data_norms, filter_words,
                     init_d=None, init_i=None, probe_counts=None,
-                    n_valid=None, row_probes=None, *, n_probes: int,
+                    n_valid=None, row_probes=None, cold_planes=None,
+                    hot_slot_map=None, cold_slot_map=None, *,
+                    n_probes: int,
                     k: int, metric: DistanceType,
                     coarse_algo: str = "exact",
                     scan_engine: str = "rank", epsilon: float = 3.0,
@@ -714,8 +716,17 @@ def _search_impl_fn(queries, centers, rotation, codes, rnorm, cfac,
     which the fused engines' membership predicate already rejects.
     ``scan_engine`` must arrive resolved (via
     :func:`raft_tpu.ops.bq_scan.resolve_bq_engine`): it is a jit
-    static, so an unresolved ``"auto"`` would fork the compile cache."""
+    static, so an unresolved ``"auto"`` would fork the compile cache.
+    ``cold_planes`` (graftcast) optionally carries the cold halves of
+    the five per-row record planes — ``codes``/``rnorm``/``cfac``/
+    ``errw``/``data`` are then the HOT halves and the fused XLA
+    engine selects each list's planes from one tier through
+    ``(hot_slot_map, cold_slot_map)`` (same body, same estimates,
+    same prune decisions ⇒ bit-identical to all-HBM)."""
     q, dim = queries.shape
+    if cold_planes is not None:
+        assert scan_engine == "xla", \
+            "tiered BQ record planes need the fused XLA engine"
     select_min = is_min_close(metric)
     qf = queries.astype(jnp.float32)
     ip_metric = metric == DistanceType.InnerProduct
@@ -763,6 +774,7 @@ def _search_impl_fn(queries, centers, rotation, codes, rnorm, cfac,
         best_d, best_i = bq_list_major_scan(
             qf, qrot, centers_rot, codes, rnorm, cfac, errw, indices,
             data, data_norms, probes, filter_words, init_d, init_i,
+            cold_planes, hot_slot_map, cold_slot_map,
             k=k, metric=metric, epsilon=epsilon, engine=scan_engine,
             query_bits=qb, interpret=jax.default_backend() != "tpu")
     else:

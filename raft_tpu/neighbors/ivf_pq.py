@@ -763,7 +763,9 @@ def quantize_lut(lut, lut_dtype):
 
 def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
                     filter_words, init_d=None, init_i=None,
-                    probe_counts=None, n_valid=None, row_probes=None, *,
+                    probe_counts=None, n_valid=None, row_probes=None,
+                    cold_codes=None, hot_slot_map=None,
+                    cold_slot_map=None, *,
                     n_probes: int, k: int, metric: DistanceType,
                     codebook_kind: CodebookKind, lut_dtype,
                     score_mode: str = "gather", packed: bool = False,
@@ -787,9 +789,25 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
     union of probed lists list-major (``ops/ivf_scan`` formulation):
     each unique list's code plane streams once, scores against every
     query in the tile, and a per-query membership predicate masks
-    queries that did not probe it."""
+    queries that did not probe it.
+
+    ``cold_codes``/``hot_slot_map``/``cold_slot_map`` (graftcast —
+    the tiered PQ cold engine) optionally split the codes plane:
+    ``codes`` is then the HOT plane ``(n_hot, m, pq_dim)`` and each
+    list-major step selects its block from its tier
+    (:func:`raft_tpu.ops.tier_scan.tier_block_select`). Everything
+    downstream of the fetch is THIS same body, so the tiered LUT
+    union scan is bit-identical to the all-HBM scan by construction.
+    List-major only: the rank-major gather has no per-list fetch
+    step to steer (``resolve_tier_pq_engine`` rejects it)."""
     q, dim = queries.shape
-    n_lists, max_size, pq_dim = codes.shape
+    tiered_codes = cold_codes is not None
+    assert not (tiered_codes and scan_engine == "rank"), \
+        "tiered PQ codes need the list-major engine"
+    # with a tiered codes plane, codes.shape[0] is the HOT slot count,
+    # not the list count — the resident centers plane is the authority
+    n_lists = centers.shape[0]
+    max_size, pq_dim = codes.shape[1], codes.shape[2]
     if packed:
         pq_dim = pq_dim * 2
     book_size = codebooks.shape[1]
@@ -869,7 +887,18 @@ def _search_impl_fn(queries, centers, rotation, codebooks, codes, indices,
             best_d, best_i = carry
             lidc = jnp.minimum(lid, n_lists - 1)       # sentinel-safe
             lists = jnp.full((q,), lidc, jnp.int32)
-            rows1 = jax.lax.dynamic_index_in_dim(codes, lidc, 0, False)
+            if tiered_codes:
+                from raft_tpu.ops.tier_scan import (
+                    tier_block_select,
+                    tier_slot_pair,
+                )
+
+                hs, cs = tier_slot_pair(hot_slot_map, cold_slot_map,
+                                        lidc)
+                rows1 = tier_block_select(codes, cold_codes, hs, cs)
+            else:
+                rows1 = jax.lax.dynamic_index_in_dim(codes, lidc, 0,
+                                                     False)
             ids1 = jax.lax.dynamic_index_in_dim(indices, lidc, 0, False)
             if packed:
                 rows1 = _unpack_nibbles(rows1)  # once, before broadcast

@@ -311,7 +311,9 @@ def unpack_bq_records(records, n: int, words: int, bits: int):
 
 def bq_list_major_scan(qf, qrot, centers_rot, codes, rnorm, cfac, errw,
                        indices, data, data_norms, probes,
-                       filter_words=None, init_d=None, init_i=None, *,
+                       filter_words=None, init_d=None, init_i=None,
+                       cold_planes=None, hot_slot_map=None,
+                       cold_slot_map=None, *,
                        k: int, metric: DistanceType, epsilon: float,
                        engine: str = "xla", query_bits: int = _QUERY_BITS,
                        interpret: bool = False):
@@ -329,7 +331,19 @@ def bq_list_major_scan(qf, qrot, centers_rot, codes, rnorm, cfac, errw,
 
     Probe slots carrying the sentinel value ``n_lists`` are masked
     probes (ragged rows, shard-unowned lists); both engines ignore
-    them through the shared membership predicate."""
+    them through the shared membership predicate.
+
+    ``cold_planes`` (graftcast — the tiered BQ cold engine)
+    optionally provides the cold halves of the five per-row record
+    planes as ``(cold_codes, cold_rnorm, cold_cfac, cold_errw,
+    cold_data)``; ``codes``/``rnorm``/``cfac``/``errw``/``data`` are
+    then the HOT halves and each step selects every plane of its
+    list from ONE tier via the shared
+    ``(hot_slot_map, cold_slot_map)`` pair (:func:`raft_tpu.ops
+    .tier_scan.tier_slot_pair` — one slot decision per step, so the
+    estimate and its rerank rows can never split across tiers). XLA
+    engine only: the dual-source fused kernel is the on-chip
+    follow-on (``resolve_tier_bq_engine`` degrades)."""
     expect(engine in ("pallas", "xla"),
            f"bq_list_major_scan engine must be pallas|xla, got "
            f"{engine!r}")
@@ -337,13 +351,19 @@ def bq_list_major_scan(qf, qrot, centers_rot, codes, rnorm, cfac, errw,
            "fused BQ scan needs the raw-vector rerank plane "
            "(build with store_vectors=True)")
     if engine == "pallas":
+        expect(cold_planes is None,
+               "the fused BQ Pallas kernel has no dual-tier source "
+               "yet — tiered BQ resolves to engine='xla' "
+               "(resolve_tier_bq_engine)")
         return _bq_scan_pallas(
             qf, qrot, centers_rot, codes, rnorm, cfac, errw, indices,
             data, data_norms, probes, filter_words, k=k, metric=metric,
             epsilon=epsilon, query_bits=query_bits, interpret=interpret)
     return _bq_scan_xla(
         qf, qrot, centers_rot, codes, rnorm, cfac, errw, indices, data,
-        data_norms, probes, filter_words, init_d, init_i, k=k,
+        data_norms, probes, filter_words, init_d, init_i,
+        cold_planes=cold_planes, hot_slot_map=hot_slot_map,
+        cold_slot_map=cold_slot_map, k=k,
         metric=metric, epsilon=epsilon, query_bits=query_bits)
 
 
@@ -354,12 +374,20 @@ def bq_list_major_scan(qf, qrot, centers_rot, codes, rnorm, cfac, errw,
 
 def _bq_scan_xla(qf, qrot, centers_rot, codes, rnorm, cfac, errw,
                  indices, data, data_norms, probes, filter_words,
-                 init_d=None, init_i=None, *, k: int,
+                 init_d=None, init_i=None, cold_planes=None,
+                 hot_slot_map=None, cold_slot_map=None, *, k: int,
                  metric: DistanceType, epsilon: float, query_bits: int):
     from raft_tpu.neighbors.filters import test_filter
 
     q, d = qf.shape
-    n_lists = codes.shape[0]
+    # with a tiered record plane, codes.shape[0] is the HOT slot
+    # count, not the list count — the resident id plane is the
+    # authority (it is never tiered: ids gather per unique list)
+    n_lists = indices.shape[0]
+    tiered = cold_planes is not None
+    if tiered:
+        cold_codes, cold_rnorm, cold_cfac, cold_errw, cold_data = \
+            cold_planes
     dim_ext = centers_rot.shape[1]
     bits = cfac.shape[2]
     ip_metric = metric == DistanceType.InnerProduct
@@ -378,6 +406,11 @@ def _bq_scan_xla(qf, qrot, centers_rot, codes, rnorm, cfac, errw,
         if d_pad != d:
             qf = jnp.pad(qf, ((0, 0), (0, d_pad - d)))
             data = jnp.pad(data, ((0, 0), (0, 0), (0, d_pad - d)))
+            if tiered:
+                # the cold rerank plane must pad identically or the
+                # hot/cold dots diverge from the all-HBM reference
+                cold_data = jnp.pad(
+                    cold_data, ((0, 0), (0, 0), (0, d_pad - d)))
         if de_pad != dim_ext:
             qrot = jnp.pad(qrot, ((0, 0), (0, de_pad - dim_ext)))
             centers_rot = jnp.pad(centers_rot,
@@ -401,10 +434,26 @@ def _bq_scan_xla(qf, qrot, centers_rot, codes, rnorm, cfac, errw,
         best_d, best_i = carry
         lid, ids_row = xs
         lidc = jnp.minimum(lid, n_lists - 1)      # sentinel-safe index
-        codes_b = jax.lax.dynamic_index_in_dim(codes, lidc, 0, False)
-        rn = jax.lax.dynamic_index_in_dim(rnorm, lidc, 0, False)
-        cf = jax.lax.dynamic_index_in_dim(cfac, lidc, 0, False)
-        ew = jax.lax.dynamic_index_in_dim(errw, lidc, 0, False)
+        if tiered:
+            from raft_tpu.ops.tier_scan import (
+                tier_block_select,
+                tier_slot_pair,
+            )
+
+            # ONE slot decision per list — the estimate planes and
+            # the rerank rows always come from the same tier
+            hs, cs = tier_slot_pair(hot_slot_map, cold_slot_map,
+                                    lidc)
+            codes_b = tier_block_select(codes, cold_codes, hs, cs)
+            rn = tier_block_select(rnorm, cold_rnorm, hs, cs)
+            cf = tier_block_select(cfac, cold_cfac, hs, cs)
+            ew = tier_block_select(errw, cold_errw, hs, cs)
+        else:
+            codes_b = jax.lax.dynamic_index_in_dim(codes, lidc, 0,
+                                                   False)
+            rn = jax.lax.dynamic_index_in_dim(rnorm, lidc, 0, False)
+            cf = jax.lax.dynamic_index_in_dim(cfac, lidc, 0, False)
+            ew = jax.lax.dynamic_index_in_dim(errw, lidc, 0, False)
         crot = jax.lax.dynamic_index_in_dim(centers_rot, lidc, 0, True)
         est, margin = _block_estimate(
             qrot, crot, rn[None, :], ew[None, :], jnp.transpose(cf),
@@ -420,7 +469,10 @@ def _bq_scan_xla(qf, qrot, centers_rot, codes, rnorm, cfac, errw,
         # bound) still beats the running k-th exact distance re-rank
         kth = best_d[:, k - 1 : k]
         cand = (est - margin) < kth
-        xb = jax.lax.dynamic_index_in_dim(data, lidc, 0, False)
+        if tiered:
+            xb = tier_block_select(data, cold_data, hs, cs)
+        else:
+            xb = jax.lax.dynamic_index_in_dim(data, lidc, 0, False)
         xn = jax.lax.dynamic_index_in_dim(data_norms, lidc, 0, False)
         ipx = jax.lax.dot_general(
             qf, xb.astype(jnp.float32), (((1,), (1,)), ((), ())),

@@ -107,6 +107,67 @@ def resolve_tier_engine(engine: str, *, hot_data=None, filter_words=None,
     return engine
 
 
+def resolve_tier_pq_engine(engine: str) -> str:
+    """Resolve a tiered-PQ ``scan_engine`` param. The tiered PQ cold
+    engine is the LUT union scan with the per-step dual-tier block
+    select (graftcast): list-major only — the rank-major PQ scan
+    gathers per (query, rank) and has no per-list fetch step to
+    steer through the slot maps, so ``rank`` is rejected rather than
+    silently served from the wrong tier. ``auto`` is always the XLA
+    union scan (there is no Pallas PQ engine, tiered or not)."""
+    expect(engine in ("auto", "xla"),
+           "tiered PQ scan_engine must be 'auto' or 'xla' — the "
+           "rank-major scan has no per-list fetch step to steer "
+           f"through the tier slot maps, got {engine!r}")
+    return "xla"
+
+
+def resolve_tier_bq_engine(engine: str) -> str:
+    """Resolve a tiered-BQ ``scan_engine`` param. The tiered BQ cold
+    engine is the XOR+popcount estimate-then-rerank union scan with
+    every per-row plane (codes/corrections/rerank vectors) selected
+    from its tier per step. ``auto`` and ``pallas`` both resolve to
+    ``xla`` for now: the fused BQ kernel's conditional rerank DMA
+    already rides the ANY-operand discipline, but its dual-source
+    (hot BlockSpec + cold DMA) variant is the on-chip follow-on
+    (ROADMAP) — degrading here keeps the engine choice honest
+    instead of serving cold lists from a kernel that cannot reach
+    them. ``rank`` is rejected (no per-list fetch step)."""
+    expect(engine in ("auto", "pallas", "xla"),
+           "tiered BQ scan_engine must be 'auto', 'pallas' or 'xla' "
+           f"— got {engine!r}")
+    return "xla"
+
+
+def tier_slot_pair(hot_slot_map, cold_slot_map, lidc):
+    """One step's (hot_slot, cold_slot) pair for clamped list id
+    ``lidc`` — computed ONCE per scan step and shared by every
+    plane's :func:`tier_block_select`, so a multi-plane family (BQ's
+    codes + corrections + rerank vectors) cannot read two planes of
+    the same list from different tiers."""
+    return (jnp.take(hot_slot_map, lidc),
+            jnp.take(cold_slot_map, lidc))
+
+
+def tier_block_select(hot_plane, cold_plane, hs, cs):
+    """THE dual-tier block fetch — the one divergence every tiered
+    engine has from its all-HBM twin: step ``j``'s block comes from
+    its tier via the slot pair of :func:`tier_slot_pair`. ``lax.cond``
+    keeps the cold branch a real conditional (only the probed tier's
+    block is read — the cold stream pays for exactly its own bytes);
+    the selected values are the stored rows either way, so everything
+    downstream is bit-identical to the un-tiered scan. Shared by the
+    tiered flat XLA engine and the graftcast PQ/BQ cold engines
+    (LUT union scan / XOR+popcount estimate)."""
+    return jax.lax.cond(
+        cs >= 0,
+        lambda: jax.lax.dynamic_index_in_dim(
+            cold_plane, jnp.maximum(cs, 0), 0, False),
+        lambda: jax.lax.dynamic_index_in_dim(
+            hot_plane, jnp.maximum(hs, 0), 0, False),
+    )
+
+
 def _tier_vmem_plan(m_pad: int, d_pad: int, k: int):
     """The tiered kernel's VMEM footprint model, shared by
     :func:`resolve_tier_engine` (the degrade decision) and
@@ -212,20 +273,11 @@ def _tier_scan_xla(qf, hot_data, cold_data, hot_slot_map, cold_slot_map,
     def step(carry, lid):
         best_d, best_i = carry
         lidc = jnp.minimum(lid, n_lists - 1)      # sentinel-safe index
-        hs = jnp.take(hot_slot_map, lidc)
-        cs = jnp.take(cold_slot_map, lidc)
+        hs, cs = tier_slot_pair(hot_slot_map, cold_slot_map, lidc)
         # the ONE tiered divergence from ivf_scan's _scan_xla: the
-        # block comes from its tier. lax.cond keeps the cold branch a
-        # real conditional (only the probed tier's block is read); the
-        # selected values are the stored rows either way, so the dot
-        # below is bit-identical to the un-tiered scan's.
-        rows = jax.lax.cond(
-            cs >= 0,
-            lambda: jax.lax.dynamic_index_in_dim(
-                cold_data, jnp.maximum(cs, 0), 0, False),
-            lambda: jax.lax.dynamic_index_in_dim(
-                hot_data, jnp.maximum(hs, 0), 0, False),
-        ).astype(jnp.float32)                                  # (m, d)
+        # block comes from its tier (see tier_block_select).
+        rows = tier_block_select(hot_data, cold_data, hs,
+                                 cs).astype(jnp.float32)       # (m, d)
         row_ids = jax.lax.dynamic_index_in_dim(indices, lidc, 0, False)
         ip = jax.lax.dot_general(
             qf, rows, (((1,), (1,)), ((), ())),

@@ -411,6 +411,25 @@ class FleetAggregator:
             for cname, v in high.items():
                 counters[cname] = counters.get(cname, 0.0) + v
         out["counters"] = counters
+        # tiering (PR 18 graftcast): the placement + prefetch
+        # counters already entered the monotone clamped sums above —
+        # restate them as one structured block (the /fleet.json
+        # surface an operator reads for fleet-wide tier behaviour),
+        # with the derived prefetch hit rate. A replica predating
+        # tiering simply contributes zeros.
+        tier = {
+            "epochs": counters.get("tier.epochs", 0.0),
+            "promotions": counters.get("tier.promotions", 0.0),
+            "demotions": counters.get("tier.demotions", 0.0),
+            "prefetch": {
+                k: counters.get(f"tier.prefetch.{k}", 0.0)
+                for k in ("issued", "hits", "misses", "cancelled")},
+        }
+        pf_total = (tier["prefetch"]["hits"]
+                    + tier["prefetch"]["misses"])
+        tier["prefetch"]["hit_rate"] = (
+            tier["prefetch"]["hits"] / pf_total if pf_total else None)
+        out["tier"] = tier
         # histograms: bucket-wise merge over HEALTHY replicas
         names: set = set()
         for s in healthy:
@@ -649,6 +668,15 @@ class FleetAggregator:
             })
         for iname, d in merged["drift"].items():
             vals[f"fleet.drift.{iname}.score"] = d["score"]
+        tier = merged.get("tier") or {}
+        pf = tier.get("prefetch") or {}
+        if tier.get("epochs") or pf.get("issued"):
+            for k in ("epochs", "promotions", "demotions"):
+                vals[f"fleet.tier.{k}"] = float(tier[k])
+            for k in ("issued", "hits", "misses", "cancelled"):
+                vals[f"fleet.tier.prefetch.{k}"] = float(pf[k])
+            if pf.get("hit_rate") is not None:
+                vals["fleet.tier.prefetch.hit_rate"] = pf["hit_rate"]
         mem = merged.get("memory") or {}
         if mem.get("replicas_reporting"):
             vals["fleet.memory.replicas_reporting"] = float(

@@ -48,11 +48,15 @@ DEMOTIONS = "tier.demotions"
 class PlacementConfig:
     """Epoch policy knobs. ``max_swaps_per_epoch`` doubles as the
     fixed compiled swap width — raising it re-specializes the swap
-    program once, never per epoch."""
+    program once, never per epoch. ``prefetch_lead_s`` is how far
+    BEFORE the epoch tick the graftcast prefetcher (when attached)
+    stages its forecast promotions — enough lead for the background
+    cold→HBM copies to complete off the epoch path."""
 
     epoch_every_s: float = 60.0
     max_swaps_per_epoch: int = 8
     min_heat_ratio: float = 1.5
+    prefetch_lead_s: float = 10.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -125,7 +129,8 @@ class TierManager:
     """
 
     def __init__(self, tiered, executor, *,
-                 config: Optional[PlacementConfig] = None, clock=None):
+                 config: Optional[PlacementConfig] = None, clock=None,
+                 prefetcher=None):
         from raft_tpu.serving.batcher import MonotonicClock
 
         expect(getattr(executor, "probe_accounting", False),
@@ -141,6 +146,22 @@ class TierManager:
         self._last_counts: Optional[np.ndarray] = None
         self._epochs = 0
         self._last_plan: Optional[PlacementPlan] = None
+        self.prefetcher = prefetcher
+        # one prefetch per epoch window: armed at each epoch, spent
+        # at the lead-time tick
+        self._prefetch_armed = True
+
+    def enable_prefetch(self, *, config=None, ledger=None):
+        """Attach a :class:`~raft_tpu.serving.prefetch.TierPrefetcher`
+        sized to this manager's swap width and return it (a disabled
+        one — zero capacity after the ledger gate — still attaches:
+        every call degrades to the reactive path)."""
+        from raft_tpu.serving.prefetch import TierPrefetcher
+
+        self.prefetcher = TierPrefetcher(
+            self.tiered, width=self.config.max_swaps_per_epoch,
+            config=config, ledger=ledger)
+        return self.prefetcher
 
     # -- the epoch ----------------------------------------------------------
 
@@ -160,27 +181,66 @@ class TierManager:
             return counts.copy()
         return counts - last
 
+    def _peek_window(self) -> np.ndarray:
+        """READ-ONLY view of the window accumulating toward the next
+        epoch (lifetime ledger minus the last claim's baseline) — the
+        prefetcher's forecast input. Never advances ``_last_counts``,
+        so the epoch's claim still folds every probe exactly once;
+        peeking double-counts nothing."""
+        label = self.executor.probe_label(self.tiered)
+        n = self.tiered.n_lists
+        if label is None:
+            return np.zeros((n,), np.int64)
+        counts = self.executor.probe_frequencies().get(
+            label, np.zeros((n,), np.int64))
+        if self._last_counts is None:
+            return counts.copy()
+        return counts - self._last_counts
+
+    def _epoch_locked(self) -> PlacementPlan:
+        """The epoch body — ONE critical section (caller holds
+        ``self._lock``): the probe window is claimed exactly once and
+        that single claim feeds BOTH the placement plan and the
+        prefetcher's forecast EWMA. Splitting the claim from either
+        consumer would let a racing scrape double-fold a window (the
+        exact bug class :class:`~raft_tpu.serving.gauge.DriftDetector`
+        locks against — its ``_last`` diff and EWMA fold share one
+        lock for the same reason)."""
+        from raft_tpu.neighbors.tiered import apply_plan
+
+        cfg = self.config
+        window = self._claim_window()
+        pf = self.prefetcher
+        if pf is not None:
+            pf.observe(window)
+        plan = plan_epoch(window, self.tiered.hot_lists,
+                          self.tiered.cold_lists,
+                          max_swaps=cfg.max_swaps_per_epoch,
+                          min_heat_ratio=cfg.min_heat_ratio)
+        staged = None
+        if pf is not None and plan.promotions:
+            # resolve against the miss cache AT the pre-swap
+            # generation: stale rows (an epoch or re-demotion moved
+            # the placement since they staged) are refused inside
+            # take() and counted cancelled
+            staged = pf.take(plan.promotions, self.tiered.generation)
+        # the executor rides along so the swap's donation
+        # enqueues serialize with dispatch enqueues (see
+        # apply_plan's concurrency discipline)
+        apply_plan(self.tiered, plan.promotions, plan.demotions,
+                   width=cfg.max_swaps_per_epoch,
+                   executor=self.executor, staged=staged)
+        self._epochs += 1
+        self._last_plan = plan
+        self._prefetch_armed = True
+        return plan
+
     def epoch(self) -> PlacementPlan:
         """Run one placement epoch NOW: claim the window, plan, and
         execute the swaps. Returns the plan (empty plans execute
         nothing — the layout holds)."""
-        from raft_tpu.neighbors.tiered import apply_plan
-
-        cfg = self.config
         with self._lock:
-            window = self._claim_window()
-            plan = plan_epoch(window, self.tiered.hot_lists,
-                              self.tiered.cold_lists,
-                              max_swaps=cfg.max_swaps_per_epoch,
-                              min_heat_ratio=cfg.min_heat_ratio)
-            # the executor rides along so the swap's donation
-            # enqueues serialize with dispatch enqueues (see
-            # apply_plan's concurrency discipline)
-            apply_plan(self.tiered, plan.promotions, plan.demotions,
-                       width=cfg.max_swaps_per_epoch,
-                       executor=self.executor)
-            self._epochs += 1
-            self._last_plan = plan
+            plan = self._epoch_locked()
         tracing.inc_counters({
             EPOCHS: 1.0,
             PROMOTIONS: float(len(plan.promotions)),
@@ -193,23 +253,55 @@ class TierManager:
         """Scrape-driven pacing: run an epoch when ``epoch_every_s``
         has elapsed on the injected clock (the first tick only stamps
         the baseline — an epoch needs a window to judge). Elapsed
-        multiples never stack: one tick runs at most one epoch."""
+        multiples never stack: one tick runs at most one epoch. The
+        epoch runs INSIDE the pacing lock acquisition — stamping the
+        time and then re-locking for the epoch would open a gap where
+        a racing direct :meth:`epoch` claims the window this tick
+        decided to consume.
+
+        With a prefetcher attached, the tick ``prefetch_lead_s``
+        before the next epoch stages the forecast promotions (once
+        per epoch window), and every non-epoch tick runs the miss
+        cache's headroom maintenance — both OUTSIDE the lock: the
+        background channel must never block a racing epoch."""
         now = self._clock.now()
+        plan = None
+        partial = None
+        cfg = self.config
         with self._lock:
             if self._last_epoch_t is None:
                 self._last_epoch_t = now
-                return None
-            if now - self._last_epoch_t < self.config.epoch_every_s:
-                return None
-            self._last_epoch_t = now
-        return self.epoch()
+            elif now - self._last_epoch_t >= cfg.epoch_every_s:
+                self._last_epoch_t = now
+                plan = self._epoch_locked()
+            elif (self.prefetcher is not None and self._prefetch_armed
+                  and now - self._last_epoch_t
+                  >= cfg.epoch_every_s - cfg.prefetch_lead_s):
+                self._prefetch_armed = False
+                # the forecast input peeks INSIDE the claim lock so
+                # it is consistent with the baseline it diffs against
+                partial = self._peek_window()
+        if plan is not None:
+            tracing.inc_counters({
+                EPOCHS: 1.0,
+                PROMOTIONS: float(len(plan.promotions)),
+                DEMOTIONS: float(len(plan.demotions)),
+            })
+            self.publish_gauges()
+            return plan
+        if partial is not None:
+            self.prefetcher.prefetch(
+                max_swaps=cfg.max_swaps_per_epoch, window=partial)
+        if self.prefetcher is not None:
+            self.prefetcher.maintain()
+        return None
 
     # -- scrape surface -----------------------------------------------------
 
     def publish_gauges(self) -> None:
         t = self.tiered
         plan = self._last_plan
-        tracing.set_gauges({
+        vals = {
             "tier.hot_lists": float(t.n_hot),
             "tier.cold_lists": float(t.n_cold),
             "tier.hot_bytes": float(t.hot_bytes),
@@ -221,7 +313,15 @@ class TierManager:
                 float(plan.window_total) if plan else 0.0,
             "tier.hot_window_fraction":
                 plan.hot_window_fraction if plan else 0.0,
-        })
+        }
+        if self.prefetcher is not None:
+            ps = self.prefetcher.snapshot()
+            vals["tier.prefetch.enabled"] = 1.0 if ps["enabled"] else 0.0
+            vals["tier.prefetch.capacity"] = float(ps["capacity"])
+            vals["tier.prefetch.staged"] = float(ps["staged"])
+            vals["tier.prefetch.staged_bytes"] = float(
+                ps["staged_bytes"])
+        tracing.set_gauges(vals)
 
     def snapshot(self) -> dict:
         """The ``/tier.json`` body: the live layout, the last epoch's
@@ -236,8 +336,11 @@ class TierManager:
                 "epoch_every_s": self.config.epoch_every_s,
                 "max_swaps_per_epoch": self.config.max_swaps_per_epoch,
                 "min_heat_ratio": self.config.min_heat_ratio,
+                "prefetch_lead_s": self.config.prefetch_lead_s,
             },
             "last_plan": None,
+            "prefetch": (self.prefetcher.snapshot()
+                         if self.prefetcher is not None else None),
         }
         if plan is not None:
             out["last_plan"] = {

@@ -23,6 +23,12 @@ graftbeam (PR 16) pieces: ``ragged_cagra`` and ``ragged_cagra_bq``
 iteration budgets on the packed tile mask, BQ-coded traversal in the
 bq piece) through the same ragged family, same assertions.
 
+graftcast (PR 18) piece: ``prefetch_overlap`` — a seeded drifting
+hot set with the forecast-driven prefetcher armed: lead-time stage
+DMAs overlap live serving, the measured drift cycle shows prefetch
+hits with ZERO backend compiles, and every dispatch stays
+bit-identical to the all-HBM index.
+
 Run: PYTHONPATH=/root/repo:/root/.axon_site python scripts/serving_smoke.py
 """
 
@@ -224,6 +230,80 @@ def main():
         cagra.CagraSearchParams(bq_traversal="on"),
         lambda: cagra.CagraSearchParams(bq_traversal="on",
                                         max_iterations=100))
+
+    # graftcast acceptance on chip (PR 18): forecast-driven prefetch
+    # overlapping live serving — a seeded drifting hot set drives
+    # lead-time stage DMAs; after one warm drift cycle the measured
+    # drift must show prefetch HITS (the epoch consumed staged
+    # blocks), ZERO backend compiles, and per-dispatch bit-identity
+    # to the all-HBM index throughout. Evidence CI cannot collect:
+    # whether the stage DMA truly overlaps the serving stream on the
+    # real chip (CPU serializes host work), and the ICI/host-link
+    # contention a concurrent stage creates — the on-chip numbers
+    # this piece records.
+    try:
+        from raft_tpu.neighbors import tiered as tiered_mod
+        from raft_tpu.serving.harness import ManualClock
+        from raft_tpu.serving.placement import (PlacementConfig,
+                                                TierManager)
+        from raft_tpu.serving.prefetch import PrefetchConfig
+
+        t_idx = tiered_mod.build_tiered(index, hot_fraction=0.5)
+        tp = tiered_mod.TieredSearchParams(n_probes=8)
+        ex_t = SearchExecutor(probe_accounting=True)
+        ex_t.warmup(t_idx, buckets=(ex_t.bucket_for(16),), k=10,
+                    params=tp)
+        clk = ManualClock()
+        mgr = TierManager(t_idx, ex_t, clock=clk,
+                          config=PlacementConfig(
+                              epoch_every_s=60.0,
+                              max_swaps_per_epoch=4,
+                              prefetch_lead_s=10.0))
+        mgr.enable_prefetch(config=PrefetchConfig(alpha=0.5))
+        centers_np = np.asarray(t_idx.centers)
+        hot0 = [int(lid) for lid in t_idx.hot_lists[:8]]
+        cold0 = [int(lid) for lid in t_idx.cold_lists[:8]]
+
+        def drift(lists, ticks):
+            bits = True
+            rng2 = np.random.default_rng(11)
+            lists = np.asarray(lists)
+            for _ in range(ticks):
+                lids = lists[rng2.integers(0, len(lists), 16)]
+                qd = (centers_np[lids] + 0.01 * rng2.standard_normal(
+                    (16, 128))).astype(np.float32)
+                dt_, it_ = ex_t.search(t_idx, qd, 10, params=tp)
+                df_, if_ = ex_t.search(index, qd, 10, params=p)
+                bits = bits and np.array_equal(
+                    np.asarray(it_), np.asarray(if_))
+                clk.advance(11.0)
+                mgr.tick()
+            return bits
+
+        ok_bits = drift(hot0, 12)
+        ok_bits = drift(cold0, 14) and ok_bits   # warm drift cycle
+        pc0 = dict(tracing.counters())
+        ok_bits = drift(hot0, 14) and ok_bits    # measured drift
+        pc1 = dict(tracing.counters())
+
+        def pdelta(name):
+            return float(pc1.get(name, 0) - pc0.get(name, 0))
+
+        hits = pdelta("tier.prefetch.hits")
+        emit("prefetch_overlap", ok=bool(ok_bits and hits > 0),
+             bit_identical=bool(ok_bits),
+             prefetch_issued=pdelta("tier.prefetch.issued"),
+             prefetch_hits=hits,
+             prefetch_misses=pdelta("tier.prefetch.misses"),
+             promote_cold_bytes=pdelta("tier.promote_cold_bytes"),
+             backend_compiles_steady_state=int(
+                 pdelta(tracing.XLA_COMPILE_COUNT)))
+        assert ok_bits and hits > 0
+        assert pdelta(tracing.XLA_COMPILE_COUNT) == 0
+    except AssertionError:
+        raise
+    except Exception as e:  # noqa: BLE001
+        emit("prefetch_overlap", error=str(e)[:300])
 
     if jax.device_count() >= 2:
         from raft_tpu.comms import local_comms

@@ -389,6 +389,65 @@ def main():
     except Exception as e:  # noqa: BLE001
         emit("tier_scan", error=str(e)[:300])
 
+    # ---- graftcast tiered PQ/BQ compiled (PR 18): the compressed
+    # planes tier the same way — codes plane (PQ) / 5-plane record
+    # (BQ) half host-cold, results bit-identical to the all-HBM
+    # index on-chip, and still bit-identical after a placement swap.
+    # The on-chip questions CI cannot answer (dual-source BQ kernel,
+    # sparse cold gather) ride the ROADMAP evidence list.
+    try:
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.neighbors import tiered as tiered_mod
+
+        pqp = ivf_pq.IvfPqIndexParams(n_lists=64, pq_dim=16,
+                                      kmeans_n_iters=5)
+        pq_idx = ivf_pq.build(None, pqp, xs)
+        tpq = tiered_mod.build_tiered_pq(pq_idx, hot_fraction=0.5)
+        spq = ivf_pq.IvfPqSearchParams(n_probes=8)
+        d0, i0 = ivf_pq.search(None, spq, pq_idx, qs, 10)
+        d1, i1 = tiered_mod.search_pq(None, spq, tpq, qs, 10)
+        rep = {"n_hot": tpq.n_hot, "n_cold": tpq.n_cold,
+               "host_resident": bool(tpq.host_resident),
+               "bits_eq_allhbm": bool(
+                   (np.asarray(d1) == np.asarray(d0)).all()
+                   and (np.asarray(i1) == np.asarray(i0)).all())}
+        tiered_mod.apply_plan(
+            tpq, [int(x_) for x_ in tpq.cold_lists[:4]],
+            [int(x_) for x_ in tpq.hot_lists[:4]], width=8)
+        d2, i2 = tiered_mod.search_pq(None, spq, tpq, qs, 10)
+        rep["post_swap_bits_exact"] = bool(
+            (np.asarray(d2) == np.asarray(d1)).all()
+            and (np.asarray(i2) == np.asarray(i1)).all())
+        emit("tiered_pq", **rep)
+    except Exception as e:  # noqa: BLE001
+        emit("tiered_pq", error=str(e)[:300])
+
+    try:
+        from raft_tpu.neighbors import ivf_bq
+        from raft_tpu.neighbors import tiered as tiered_mod
+
+        bqp = ivf_bq.IvfBqIndexParams(n_lists=64, kmeans_n_iters=5)
+        bq_idx = ivf_bq.build(None, bqp, xs)
+        tbq = tiered_mod.build_tiered_bq(bq_idx, hot_fraction=0.5)
+        sbq = ivf_bq.IvfBqSearchParams(n_probes=8)
+        d0, i0 = ivf_bq.search(None, sbq, bq_idx, qs, 10)
+        d1, i1 = tiered_mod.search_bq(None, sbq, tbq, qs, 10)
+        rep = {"n_hot": tbq.n_hot, "n_cold": tbq.n_cold,
+               "host_resident": bool(tbq.host_resident),
+               "bits_eq_allhbm": bool(
+                   (np.asarray(d1) == np.asarray(d0)).all()
+                   and (np.asarray(i1) == np.asarray(i0)).all())}
+        tiered_mod.apply_plan(
+            tbq, [int(x_) for x_ in tbq.cold_lists[:4]],
+            [int(x_) for x_ in tbq.hot_lists[:4]], width=8)
+        d2, i2 = tiered_mod.search_bq(None, sbq, tbq, qs, 10)
+        rep["post_swap_bits_exact"] = bool(
+            (np.asarray(d2) == np.asarray(d1)).all()
+            and (np.asarray(i2) == np.asarray(i1)).all())
+        emit("tiered_bq", **rep)
+    except Exception as e:  # noqa: BLE001
+        emit("tiered_bq", error=str(e)[:300])
+
     # ---- beam_search compiled vs the XLA engine (same seeds)
     try:
         from raft_tpu.neighbors.cagra import _search_batch

@@ -844,6 +844,71 @@ class TestFixtureCorpus:
         assert lint_lib(ledger_clock, ["R7"],
                         rel="raft_tpu/core/serialize.py").ok
 
+    def test_r5_r7_cover_graftcast_prefetch_module(self):
+        """PR 18 satellite: the hot scopes reach the new graftcast
+        prefetcher module by its real path — a bare clock read there
+        would re-couple the lead-time pacing to the wall clock (the
+        forecast must replay deterministically under the ManualClock
+        fault suite), and a device-array fetch would stall the stage
+        DMA behind serving's dispatch stream (the shipped module
+        lints clean: pacing lives in the TierManager's injected
+        clock, slot truth comes from the host-side list mirrors, and
+        eviction recency is a logical sequence number)."""
+        prefetch_clock = (
+            "import time\n"
+            "\n"
+            "\n"
+            "def lead_due(last_epoch_at, lead_s):\n"
+            "    return time.monotonic() - last_epoch_at >= lead_s\n"
+        )
+        bad = lint_lib(prefetch_clock, ["R7"],
+                       rel="raft_tpu/serving/prefetch.py")
+        assert rules_fired(bad) == {"R7"}
+        prefetch_sync = (
+            "def staged_rows(planes):\n"
+            "    return [p.sum().item() for p in planes]\n"
+        )
+        bad = lint_lib(prefetch_sync, ["R5"],
+                       rel="raft_tpu/serving/prefetch.py")
+        assert rules_fired(bad) == {"R5"}
+        # the conforming discipline the module actually uses: logical
+        # recency, injected pacing, host-side slot mirrors
+        ok = (
+            "def evict_candidate(row_age, active):\n"
+            "    best = None\n"
+            "    for row in active:\n"
+            "        if best is None or row_age[row] < row_age[best]:\n"
+            "            best = row\n"
+            "    return best\n"
+        )
+        assert lint_lib(ok, ["R5", "R7"],
+                        rel="raft_tpu/serving/prefetch.py").ok
+
+    def test_r5_covers_tier_scan_cold_engines(self):
+        """PR 18 satellite: the R5 hot scope reaches the tiered cold
+        engines by their real path — the list-major cold scan runs
+        per dispatch, so one stray ``.item()`` (say, reading a cold
+        slot id off the device map instead of the host mirror) taxes
+        every tiered search exactly like an executor-side sync."""
+        cold_sync = (
+            "def cold_slot_of(cold_slot_map, lid):\n"
+            "    return cold_slot_map[lid].item()\n"
+        )
+        bad = lint_lib(cold_sync, ["R5"],
+                       rel="raft_tpu/ops/tier_scan.py")
+        assert rules_fired(bad) == {"R5"}
+        # the conforming discipline the engines actually use: slot
+        # arithmetic on host mirrors, device work stays traced
+        ok = (
+            "def cold_slot_of(cold_lists, lid):\n"
+            "    for slot, cl in enumerate(cold_lists):\n"
+            "        if cl == lid:\n"
+            "            return slot\n"
+            "    return -1\n"
+        )
+        assert lint_lib(ok, ["R5"],
+                        rel="raft_tpu/ops/tier_scan.py").ok
+
     def test_r7_datetime_clock_reads(self):
         """PR 7: datetime.now()/utcnow()/date.today() are wall-clock
         reads — module-dotted and from-import spellings both fire;

@@ -84,6 +84,28 @@ class TestFixturePinnedMerge:
         assert c["serving.slo.missed"] == 6.0
         assert c["index.probe.dispatches"] == 60.0
 
+    def test_tier_block_sums_and_hit_rate(self):
+        """graftcast: the tier placement + prefetch counters merge
+        like every lifetime counter (monotone clamped sums) and
+        restate as the structured ``tier`` block — r2 predates
+        tiering and contributes zeros, never an error."""
+        out = self.merged()
+        t = out["tier"]
+        assert t["epochs"] == 7.0          # 4 + 3 + 0
+        assert t["promotions"] == 14.0
+        assert t["demotions"] == 14.0
+        pf = t["prefetch"]
+        assert pf["issued"] == 11.0        # 6 + 5
+        assert pf["hits"] == 7.0 and pf["misses"] == 4.0
+        assert pf["cancelled"] == 1.0
+        assert pf["hit_rate"] == pytest.approx(7.0 / 11.0)
+        assert tracing.get_gauge("fleet.tier.epochs") == 7.0
+        assert tracing.get_gauge(
+            "fleet.tier.prefetch.hits") == 7.0
+        assert tracing.get_gauge(
+            "fleet.tier.prefetch.hit_rate") == \
+            pytest.approx(7.0 / 11.0)
+
     def test_histograms_merge_bucket_wise(self):
         h = self.merged()["histograms"]["serving.batcher.e2e_seconds"]
         assert h["count"] == 9

@@ -897,3 +897,96 @@ class TestMemoryProfileDiff:
             assert e.value.code == 400
         finally:
             exp.close()
+
+
+class TestNamedReservations:
+    """graftcast: named byte holds (the prefetcher's staged miss
+    cache) subtract from headroom and pass growth through the
+    capacity gate."""
+
+    def test_reserve_subtracts_from_headroom(self):
+        ledger = MemoryLedger(capacity_bytes=1000)
+        assert ledger.headroom_bytes() == 1000
+        ledger.reserve("tier.prefetch", 300)
+        assert ledger.reserved_bytes() == 300
+        assert ledger.headroom_bytes() == 700
+        # a second named hold stacks; same-name re-reserve replaces
+        ledger.reserve("other", 100)
+        assert ledger.headroom_bytes() == 600
+        ledger.reserve("tier.prefetch", 200)
+        assert ledger.reserved_bytes() == 300
+        assert ledger.headroom_bytes() == 700
+
+    def test_release_is_idempotent(self):
+        ledger = MemoryLedger(capacity_bytes=1000)
+        ledger.reserve("tier.prefetch", 400)
+        ledger.release("tier.prefetch")
+        assert ledger.reserved_bytes() == 0
+        assert ledger.headroom_bytes() == 1000
+        ledger.release("tier.prefetch")   # no such hold: no error
+        ledger.release("never-held")
+        assert ledger.headroom_bytes() == 1000
+
+    def test_growth_gated_refusal_restores_prior_hold(self):
+        ledger = MemoryLedger(capacity_bytes=1000)
+        ledger.reserve("tier.prefetch", 400)
+        refused0 = tracing.get_counter("memory.gate.refused")
+        # growth is judged WITHOUT the prior hold: 900 <= 1000 - 0
+        # admits even though 900 > headroom-with-hold (600)
+        ledger.reserve("tier.prefetch", 900)
+        assert ledger.reserved_bytes() == 900
+        # but beyond capacity refuses and keeps the 900 hold intact
+        with pytest.raises(CapacityExceeded) as e:
+            ledger.reserve("tier.prefetch", 1200)
+        assert e.value.required_bytes == 1200
+        assert ledger.reserved_bytes() == 900
+        assert (tracing.get_counter("memory.gate.refused")
+                == refused0 + 1)
+
+    def test_shrink_always_admitted(self):
+        ledger = MemoryLedger(capacity_bytes=1000)
+        ledger.reserve("tier.prefetch", 800)
+        # other pressure appears: even with zero headroom, shrinking
+        # (and zeroing) the hold must never raise
+        ledger.reserve("other", 200)
+        assert ledger.headroom_bytes() == 0
+        ledger.reserve("tier.prefetch", 100)
+        assert ledger.reserved_bytes() == 300
+        ledger.reserve("tier.prefetch", 0)
+        assert ledger.reserved_bytes() == 200
+
+    def test_gate_admission_sees_holds(self, data):
+        """A build racing the prefetcher's hold is refused the bytes
+        the hold already claimed."""
+        x, _ = data
+        model_bytes = memwatch.index_memory_model(
+            ivf_flat.build(
+                None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+        )["resident_bytes"]
+        ledger = MemoryLedger(capacity_bytes=1.5 * model_bytes)
+        memwatch.install_gate(ledger)
+        ledger.reserve("tier.prefetch", model_bytes)
+        with pytest.raises(CapacityExceeded):
+            ivf_flat.build(
+                None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+        ledger.release("tier.prefetch")
+        ivf_flat.build(None, ivf_flat.IvfFlatIndexParams(n_lists=8), x)
+
+    def test_snapshot_and_gauge_publish_holds(self, flat_index):
+        ledger = MemoryLedger(capacity_bytes=10**9)
+        ledger.watch("flat", flat_index)
+        ledger.reserve("tier.prefetch", 12345)
+        snap = ledger.publish()
+        assert snap["reserved_held_bytes"] == 12345
+        assert (tracing.gauges().get("memory.reserved.held_bytes")
+                == 12345)
+
+    def test_unknown_headroom_stays_unknown(self, flat_index):
+        """No capacity + no live stats: holds don't invent a number —
+        headroom stays None and growth is un-gateable (admitted)."""
+        ledger = MemoryLedger()
+        ledger.reserve("tier.prefetch", 500)
+        assert ledger.headroom_bytes() is None
+        assert ledger.reserved_bytes() == 500
+        verdict = ledger.fits(flat_index)
+        assert verdict["fits"] is True and verdict["unknown"] is True

@@ -652,25 +652,23 @@ class TestLivePlacementRace:
         del i_ref, i2
 
 
-class TestRaggedFallback:
-    """graftragged compatibility pin: TieredIvf is documented
-    non-raggable residue — ragged_key refuses with the explicit
-    placement-epoch reason, and BatcherConfig(ragged=True) serves it
-    through the bucketed path bit-identical to a direct executor
-    call. If a tiered ragged front ever lands, THIS is the test that
-    must change — ragged=True cannot silently break grafttier either
-    way."""
+class TestRaggedTiered:
+    """graftcast retires the tiered ragged refusal (PR 15 pinned it;
+    this is the flip that pin documented): the tiered plan key
+    carries only shapes + statics — the placement generation never
+    enters it — so an epoch swap can't invalidate the one packed
+    ragged executable, and tiered serving rides the same packed-tile
+    path as every other IVF family, bit-identical to its bucketed
+    dispatch."""
 
-    def test_refusal_reason_pinned(self, tiered_index):
+    def test_fallback_pin_retired(self, tiered_index):
         ex = SearchExecutor()
         p = TieredSearchParams(n_probes=8)
-        assert ex.ragged_key(tiered_index, 5, params=p) is None
-        reason = ex.ragged_fallback_reason(tiered_index, 5, params=p)
-        assert reason.startswith("tiered_ivf:")
-        assert "placement-epoch" in reason
+        assert ex.ragged_key(tiered_index, 5, params=p) is not None
+        assert ex.ragged_fallback_reason(tiered_index, 5,
+                                         params=p) is None
 
-    def test_ragged_batcher_falls_back_bucketed(self, data,
-                                                tiered_index):
+    def test_ragged_batcher_serves_tiered(self, data, tiered_index):
         from raft_tpu.serving import BatcherConfig, DynamicBatcher
 
         _, q = data
@@ -685,4 +683,408 @@ class TestRaggedFallback:
                                       np.asarray(want_i))
         np.testing.assert_array_equal(np.asarray(got_d),
                                       np.asarray(want_d))
-        assert ex.ragged_executables() == 0
+        assert ex.ragged_executables() == 1
+
+    def test_ragged_stable_across_epochs(self, data, flat_index):
+        """The generation-stable packing contract: ONE ragged
+        executable serves across a placement swap, and its results
+        track the (bit-identical) bucketed path on both sides."""
+        _, q = data
+        t = build_tiered(flat_index, hot_fraction=0.5)
+        ex = SearchExecutor(probe_accounting=True)
+        p = TieredSearchParams(n_probes=8)
+        for _ in range(2):
+            want_d, want_i = ex.search(t, q[:7], 5, params=p)
+            (got_d, got_i), = ex.search_ragged(t, [q[:7]], 5,
+                                               params_list=p)
+            np.testing.assert_array_equal(np.asarray(got_i),
+                                          np.asarray(want_i))
+            np.testing.assert_array_equal(np.asarray(got_d),
+                                          np.asarray(want_d))
+            tiered.apply_plan(t, [int(t.cold_lists[0])],
+                              [int(t.hot_lists[0])], width=4,
+                              executor=ex)
+        assert ex.ragged_executables() == 1
+
+
+# ---------------------------------------------------------------------------
+# graftcast (PR 18): tiered PQ/BQ planes
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def pq_index(data):
+    from raft_tpu.neighbors import ivf_pq
+
+    x, _ = data
+    return ivf_pq.build(None, ivf_pq.IvfPqIndexParams(
+        n_lists=32, pq_dim=8, kmeans_n_iters=6), x)
+
+
+@pytest.fixture(scope="module")
+def bq_index(data):
+    from raft_tpu.neighbors import ivf_bq
+
+    x, _ = data
+    return ivf_bq.build(None, ivf_bq.IvfBqIndexParams(
+        n_lists=32, kmeans_n_iters=6), x)
+
+
+class TestTieredCompressed:
+    """Tiered PQ codes plane and BQ record planes: bit-identical to
+    the all-HBM index with half the lists cold — direct, through the
+    executor, through the ragged tile, and across placement swaps
+    (the ONE shared scan body guarantees it by construction; these
+    pin that the tier steering doesn't perturb it)."""
+
+    def _pq_pair(self, pq_index, t, q, k=10, n_probes=8):
+        from raft_tpu.neighbors import ivf_pq
+
+        p = ivf_pq.IvfPqSearchParams(n_probes=n_probes,
+                                     scan_engine="xla")
+        d0, i0 = ivf_pq.search(None, p, pq_index, q, k)
+        d1, i1 = tiered.search_pq(None, p, t, q, k)
+        return (np.asarray(d0), np.asarray(i0),
+                np.asarray(d1), np.asarray(i1))
+
+    def _bq_pair(self, bq_index, t, q, k=10, n_probes=8):
+        from raft_tpu.neighbors import ivf_bq
+
+        p = ivf_bq.IvfBqSearchParams(n_probes=n_probes,
+                                     scan_engine="xla")
+        d0, i0 = ivf_bq.search(None, p, bq_index, q, k)
+        d1, i1 = tiered.search_bq(None, p, t, q, k)
+        return (np.asarray(d0), np.asarray(i0),
+                np.asarray(d1), np.asarray(i1))
+
+    def test_pq_half_cold_bit_identical(self, data, pq_index):
+        _, q = data
+        t = tiered.build_tiered_pq(pq_index, hot_fraction=0.5)
+        d0, i0, d1, i1 = self._pq_pair(pq_index, t, q)
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_array_equal(d0, d1)
+
+    def test_bq_half_cold_bit_identical(self, data, bq_index):
+        _, q = data
+        t = tiered.build_tiered_bq(bq_index, hot_fraction=0.5)
+        d0, i0, d1, i1 = self._bq_pair(bq_index, t, q)
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_array_equal(d0, d1)
+
+    def test_pq_packed_half_cold_bit_identical(self, data):
+        from raft_tpu.neighbors import ivf_pq
+
+        x, q = data
+        idx = ivf_pq.build(None, ivf_pq.IvfPqIndexParams(
+            n_lists=16, pq_dim=16, pq_bits=4, kmeans_n_iters=4), x)
+        t = tiered.build_tiered_pq(idx, hot_fraction=0.5)
+        d0, i0, d1, i1 = self._pq_pair(idx, t, q)
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_array_equal(d0, d1)
+
+    def test_bit_identical_after_swaps(self, data, pq_index, bq_index):
+        _, q = data
+        tpq = tiered.build_tiered_pq(pq_index, hot_fraction=0.5)
+        tbq = tiered.build_tiered_bq(bq_index, hot_fraction=0.5)
+        for t in (tpq, tbq):
+            promo = [int(t.cold_lists[0]), int(t.cold_lists[1])]
+            demo = [int(t.hot_lists[0]), int(t.hot_lists[1])]
+            tiered.apply_plan(t, promo, demo, width=4)
+            assert t.generation == 1
+        d0, i0, d1, i1 = self._pq_pair(pq_index, tpq, q)
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_array_equal(d0, d1)
+        d0, i0, d1, i1 = self._bq_pair(bq_index, tbq, q)
+        np.testing.assert_array_equal(i0, i1)
+        np.testing.assert_array_equal(d0, d1)
+
+    def test_executor_and_ragged_paths(self, data, pq_index, bq_index):
+        from raft_tpu.neighbors import ivf_bq, ivf_pq
+
+        _, q = data
+        tpq = tiered.build_tiered_pq(pq_index, hot_fraction=0.5)
+        tbq = tiered.build_tiered_bq(bq_index, hot_fraction=0.5)
+        ex = SearchExecutor()
+        for t, p in ((tpq, ivf_pq.IvfPqSearchParams(n_probes=8)),
+                     (tbq, ivf_bq.IvfBqSearchParams(n_probes=8))):
+            assert ex.ragged_key(t, 5, params=p) is not None
+            assert ex.ragged_fallback_reason(t, 5, params=p) is None
+            want_d, want_i = ex.search(t, q[:7], 5, params=p)
+            (got_d, got_i), = ex.search_ragged(t, [q[:7]], 5,
+                                               params_list=p)
+            np.testing.assert_array_equal(np.asarray(got_i),
+                                          np.asarray(want_i))
+            np.testing.assert_array_equal(np.asarray(got_d),
+                                          np.asarray(want_d))
+
+    def test_rank_engine_rejected_for_tiered_pq(self, pq_index):
+        from raft_tpu.ops.tier_scan import resolve_tier_pq_engine
+
+        with pytest.raises(Exception):
+            resolve_tier_pq_engine("rank")
+
+    def test_block_bytes_prices_all_planes(self, pq_index, bq_index):
+        tpq = tiered.build_tiered_pq(pq_index, hot_fraction=0.5)
+        tbq = tiered.build_tiered_bq(bq_index, hot_fraction=0.5)
+        assert tpq.block_bytes == (
+            int(np.prod(tpq.hot_codes.shape[1:]))
+            * tpq.hot_codes.dtype.itemsize)
+        per_plane = sum(
+            int(np.prod(getattr(tbq, h).shape[1:]))
+            * getattr(tbq, h).dtype.itemsize
+            for h, _ in type(tbq)._PLANE_PAIRS)
+        assert tbq.block_bytes == per_plane
+
+
+# ---------------------------------------------------------------------------
+# graftcast (PR 18): forecast-driven prefetch
+# ---------------------------------------------------------------------------
+
+
+class TestPrefetch:
+    """The predictive tiering loop: forecast = the epoch policy over
+    (rolling window + EWMA prior); staged promotions hit at the
+    epoch; stale stages are refused by the generation check; the miss
+    cache respects the ledger's capacity gate and shrinking
+    headroom."""
+
+    def _manager(self, flat_index, clock, capacity=1 << 30,
+                 lead=10.0, **pf_kw):
+        from raft_tpu.serving.prefetch import PrefetchConfig
+
+        t = build_tiered(flat_index, hot_fraction=0.5)
+        ex = SearchExecutor(probe_accounting=True)
+        ledger = memwatch.MemoryLedger(executor=ex,
+                                       capacity_bytes=capacity)
+        mgr = TierManager(t, ex, config=PlacementConfig(
+            epoch_every_s=60.0, max_swaps_per_epoch=4,
+            prefetch_lead_s=lead), clock=clock)
+        pf = mgr.enable_prefetch(
+            config=PrefetchConfig(alpha=0.5, **pf_kw), ledger=ledger)
+        return t, ex, mgr, pf, ledger
+
+    def _drive(self, ex, t, mgr, clock, q, ticks, flat_index=None):
+        p = TieredSearchParams(n_probes=4)
+        for _ in range(ticks):
+            d, i = ex.search(t, q, 10, params=p)
+            if flat_index is not None:
+                d2, i2 = ex.search(
+                    flat_index, q, 10,
+                    params=ivf_flat.IvfFlatSearchParams(n_probes=4))
+                np.testing.assert_array_equal(np.asarray(i),
+                                              np.asarray(i2))
+                np.testing.assert_array_equal(np.asarray(d),
+                                              np.asarray(d2))
+            clock.advance(11.0)
+            mgr.tick()
+
+    @staticmethod
+    def _near(flat_index, lids, n=64, seed=7):
+        rng = np.random.default_rng(seed)
+        centers = np.asarray(jax.device_get(flat_index.centers))
+        qs = centers[np.asarray(lids)[rng.integers(0, len(lids), n)]]
+        qs = qs + 0.01 * rng.standard_normal(qs.shape)
+        return qs.astype(np.float32)
+
+    def test_forecast_is_the_epoch_policy(self):
+        from raft_tpu.serving.prefetch import forecast_plan
+
+        window = np.array([0, 50, 1, 40, 2, 3], np.int64)
+        hot = np.array([0, 1, 2])
+        cold = np.array([3, 4, 5])
+        want = plan_epoch(window, hot, cold, max_swaps=2)
+        got = forecast_plan(np.zeros(6), hot, cold, max_swaps=2,
+                            window=window)
+        assert got.promotions == want.promotions
+        assert got.demotions == want.demotions
+
+    def test_prefetch_hits_and_zero_recompile(self, flat_index):
+        """Drifting hot set under a ManualClock: the lead-time stage
+        hits at the epoch, cold bytes leave the epoch path, and —
+        after one warm drift cycle — further epochs with the
+        prefetcher on add ZERO backend compiles (bit-identity to the
+        flat index asserted on every dispatch)."""
+        clock = ManualClock()
+        t, ex, mgr, pf, _ = self._manager(flat_index, clock)
+        assert pf.enabled
+        hot0 = [int(lid) for lid in t.hot_lists[:8]]
+        cold0 = [int(lid) for lid in t.cold_lists[:8]]
+        tracing.install_xla_compile_listener()
+        # warm: settle on hot0, then one full drift cycle compiles
+        # the stage/mix executables exactly once
+        self._drive(ex, t, mgr, clock, self._near(flat_index, hot0),
+                    12, flat_index)
+        self._drive(ex, t, mgr, clock, self._near(flat_index, cold0),
+                    14, flat_index)
+        base = dict(tracing.counters())
+        n0 = base.get(tracing.XLA_COMPILE_COUNT, 0)
+        # measured: drift BACK — prefetch stages ahead, zero compiles
+        self._drive(ex, t, mgr, clock, self._near(flat_index, hot0),
+                    14, flat_index)
+        c = tracing.counters()
+        assert c.get(tracing.XLA_COMPILE_COUNT, 0) - n0 == 0
+        assert c.get("tier.prefetch.issued", 0) > base.get(
+            "tier.prefetch.issued", 0)
+        assert c.get("tier.prefetch.hits", 0) > base.get(
+            "tier.prefetch.hits", 0)
+        # a hit's bytes moved at stage time: the epoch path charged
+        # fewer cold bytes than its promotions would cost reactively
+        promoted = (c.get("tier.promotions", 0)
+                    - base.get("tier.promotions", 0))
+        cold_bytes = (c.get("tier.promote_cold_bytes", 0)
+                      - base.get("tier.promote_cold_bytes", 0))
+        assert cold_bytes < promoted * t.block_bytes
+
+    def test_stale_promotion_cancelled(self, flat_index):
+        """A prefetch that lands after the placement moved under it
+        (the list was promoted/demoted by a racing epoch) is refused
+        by the generation check and counted cancelled — never mixed
+        into a swap."""
+        clock = ManualClock()
+        t, ex, mgr, pf, _ = self._manager(flat_index, clock)
+        lid = int(t.cold_lists[0])
+        window = np.zeros((t.n_lists,), np.int64)
+        window[lid] = 100
+        assert pf.prefetch(max_swaps=4, window=window) == 1
+        gen0 = t.generation
+        # racing epoch: promote lid reactively, then demote it again
+        tiered.apply_plan(t, [lid], [int(t.hot_lists[0])], width=4)
+        tiered.apply_plan(t, [int(t.cold_lists[0])], [lid], width=4)
+        assert t.generation == gen0 + 2
+        base = dict(tracing.counters())
+        staged = pf.take([lid], t.generation)
+        assert staged is None
+        c = tracing.counters()
+        assert (c.get("tier.prefetch.cancelled", 0)
+                == base.get("tier.prefetch.cancelled", 0) + 1)
+        assert (c.get("tier.prefetch.hits", 0)
+                == base.get("tier.prefetch.hits", 0))
+
+    def test_epoch_mid_prefetch_generation_wins(self, data,
+                                                flat_index):
+        """Epoch fires between stage and take: the stale row is
+        cancelled, the epoch streams reactively, and serving stays
+        bit-identical to the flat index across the whole exchange."""
+        _, q = data
+        clock = ManualClock()
+        t, ex, mgr, pf, _ = self._manager(flat_index, clock)
+        p = TieredSearchParams(n_probes=8)
+        lid = int(t.cold_lists[0])
+        window = np.zeros((t.n_lists,), np.int64)
+        window[lid] = 100
+        assert pf.prefetch(max_swaps=4, window=window) == 1
+        # the mid-prefetch epoch (another list's traffic wins)
+        tiered.apply_plan(t, [int(t.cold_lists[1])],
+                          [int(t.hot_lists[0])], width=4, executor=ex)
+        staged = pf.take([lid], t.generation)
+        assert staged is None                 # stale: refused
+        tiered.apply_plan(t, [int(t.cold_lists[0])],
+                          [int(t.hot_lists[1])], width=4, executor=ex)
+        d1, i1 = ex.search(t, q, 10, params=p)
+        d0, i0 = ivf_flat.search(
+            None, ivf_flat.IvfFlatSearchParams(n_probes=8),
+            flat_index, q, 10)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+
+    def test_miss_cache_evicts_under_shrinking_headroom(self,
+                                                        flat_index):
+        clock = ManualClock()
+        t, ex, mgr, pf, ledger = self._manager(flat_index, clock)
+        window = np.zeros((t.n_lists,), np.int64)
+        lids = [int(lid) for lid in t.cold_lists[:3]]
+        window[lids] = (300, 200, 100)
+        assert pf.prefetch(max_swaps=4, window=window) == 3
+        assert pf.snapshot()["staged"] == 3
+        assert ledger.reserved_bytes() == 3 * t.block_bytes
+        # headroom collapses: everything but one block's worth goes
+        ledger.capacity_bytes = (
+            ledger.forecast()["peak_bytes"] + ledger.reserved_bytes()
+            - 1.5 * t.block_bytes)
+        before = tracing.counters().get("tier.prefetch.cancelled", 0)
+        evicted = pf.maintain()
+        assert evicted >= 2
+        assert pf.snapshot()["staged"] == 3 - evicted
+        assert tracing.counters().get("tier.prefetch.cancelled",
+                                      0) == before + evicted
+        assert ledger.reserved_bytes() == (
+            (3 - evicted) * t.block_bytes)
+
+    def test_capacity_exceeded_degrades_to_reactive(self, data,
+                                                    flat_index):
+        """The gate refusing a stage never surfaces: the prefetcher
+        cancels, the epoch promotes reactively, searches succeed."""
+        _, q = data
+        clock = ManualClock()
+        t, ex, mgr, pf, ledger = self._manager(flat_index, clock)
+        # collapse headroom BEFORE any stage: every reserve refuses
+        ledger.capacity_bytes = ledger.forecast()["peak_bytes"] + 1.0
+        window = np.zeros((t.n_lists,), np.int64)
+        window[int(t.cold_lists[0])] = 100
+        before = tracing.counters().get("tier.prefetch.cancelled", 0)
+        assert pf.prefetch(max_swaps=4, window=window) == 0
+        assert tracing.counters().get("tier.prefetch.cancelled",
+                                      0) == before + 1
+        # serving and the reactive epoch are untouched
+        p = TieredSearchParams(n_probes=8)
+        d, i = ex.search(t, q, 10, params=p)
+        plan = mgr.epoch()
+        assert plan is not None
+        d, i = ex.search(t, q, 10, params=p)
+        assert np.asarray(d).shape == (q.shape[0], 10)
+
+    def test_window_claimed_once_per_epoch(self, flat_index):
+        """The satellite-6 lock fix: one epoch claims the probe
+        window EXACTLY once, and the same single claim feeds both the
+        plan and the prefetcher's EWMA — a racing scrape can't
+        double-fold (the DriftDetector.update locking model)."""
+        import threading
+
+        clock = ManualClock()
+        t, ex, mgr, pf, _ = self._manager(flat_index, clock)
+        calls = []
+        orig = ex.probe_frequencies
+
+        def counting():
+            calls.append(threading.get_ident())
+            return orig()
+
+        ex.probe_frequencies = counting
+        p = TieredSearchParams(n_probes=4)
+        ex.search(t, self._near(flat_index, [0, 1, 2]), 10, params=p)
+        mgr.tick()                            # baseline stamp: no claim
+        base_calls = len(calls)
+        clock.advance(61.0)
+        n_threads = 4
+        barrier = threading.Barrier(n_threads)
+        plans = []
+
+        def racer():
+            barrier.wait()
+            plans.append(mgr.tick())
+
+        threads = [threading.Thread(target=racer)
+                   for _ in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60)
+        ran = [pl for pl in plans if pl is not None]
+        assert len(ran) == 1                  # one epoch, n racers
+        # exactly one ledger claim for that epoch (the lead-time
+        # peek is read-only and did not run here)
+        assert len(calls) == base_calls + 1
+        assert pf._epochs_observed == 1
+
+    def test_disabled_prefetcher_is_reactive(self, flat_index):
+        from raft_tpu.serving.prefetch import PrefetchConfig
+
+        clock = ManualClock()
+        t = build_tiered(flat_index, hot_fraction=0.5)
+        ex = SearchExecutor(probe_accounting=True)
+        mgr = TierManager(t, ex, clock=clock)
+        pf = mgr.enable_prefetch(config=PrefetchConfig(capacity=0))
+        assert not pf.enabled
+        assert pf.prefetch(max_swaps=4) == 0
+        assert pf.take([1], t.generation) is None
+        assert mgr.epoch() is not None

@@ -1,35 +1,28 @@
 #!/usr/bin/env python
-"""Style gate — thin wrapper over graftlint rule R0.
+"""DEPRECATED shim — use ``python -m raft_tpu.analysis --rules=R0``.
 
-The AST style pass that used to live in this file (syntax, unused
-imports, whitespace, no print-in-lib, no NotImplementedError stubs) is
-now rule R0 of ``raft_tpu.analysis`` (graftlint), behind the shared
-rule registry, so style and the serving-path invariant rules R1–R6 run
-one traversal and one suppression mechanism.
-
-Run: ``python ci/check_style.py`` (exit 1 on any finding).
-The full analyzer is ``python -m raft_tpu.analysis`` — ci/test.sh runs
-that as the real gate; this entry point stays for the quick
-style-only loop.
+The style pass lives in graftlint (``raft_tpu.analysis``) as rule R0;
+this file survives only so old muscle memory and scripts keep working.
+It prints a pointer and delegates to the real CLI with the same exit
+code. It will be removed once nothing invokes it.
 """
 from __future__ import annotations
 
 import pathlib
+import subprocess
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-sys.path.insert(0, str(ROOT))
 
 
 def main() -> int:
-    from raft_tpu.analysis import Project, run
-    from raft_tpu.analysis.report import render_text
-
-    report = run(Project.from_root(ROOT), rules=["R0"])
-    out = render_text(report)
-    print(out.replace("graftlint:", "check_style [graftlint R0]:"),
-          end="")
-    return 0 if report.ok else 1
+    sys.stderr.write(
+        "ci/check_style.py is deprecated; run "
+        "`python -m raft_tpu.analysis --rules=R0` instead "
+        "(full analyzer: `python -m raft_tpu.analysis`).\n")
+    return subprocess.call(
+        [sys.executable, "-m", "raft_tpu.analysis", "--rules=R0",
+         "--root", str(ROOT)], cwd=str(ROOT))
 
 
 if __name__ == "__main__":

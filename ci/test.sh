@@ -13,7 +13,8 @@ echo "== graftlint =="
 # exits non-zero on any unsuppressed finding; the JSON report lands
 # next to the bench JSONs as a build artifact
 JAX_PLATFORMS=cpu python -m raft_tpu.analysis --format=ci \
-    --output ci/graftlint_report.json
+    --output ci/graftlint_report.json \
+    --lockgraph ci/graftlint_lockgraph.json
 
 echo "== packaging smoke =="
 python -m pip install -e . --no-deps --no-build-isolation --quiet

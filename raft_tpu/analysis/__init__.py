@@ -12,11 +12,23 @@ only through the injectable clock (R7). Runtime tests catch violations
 one configuration at a time; graftlint machine-checks them on every
 diff.
 
+v3 adds the whole-program analyses, built on one shared parse pass
+(:mod:`~raft_tpu.analysis.proggraph`): guarded state is only touched
+under its annotated lock and the static lock-order graph stays
+acyclic (R8), donated buffers never escape through object fields into
+a read-after-donation — interprocedurally (R2 v2), and the registered
+metric inventory, the ARCHITECTURE.md tables, the CI snapshot floors,
+and the exporter HELP table all agree (R9).
+
 Run::
 
     python -m raft_tpu.analysis               # text report, exit 1 on findings
     python -m raft_tpu.analysis --format=ci   # findings + suppression inventory
     python -m raft_tpu.analysis --format=json --output=report.json
+    python -m raft_tpu.analysis --lockgraph ci/graftlint_lockgraph.json
+
+Repo runs keep an incremental content-hash cache at
+``ci/.graftlint_cache.json`` (``--no-cache`` bypasses it).
 
 Suppress a finding only with a written reason::
 
@@ -30,12 +42,14 @@ live here as rule R0).
 from raft_tpu.analysis.core import (
     DEFAULT_DIRS,
     Finding,
+    LintCache,
     Project,
     Report,
     RULES,
     Rule,
     Suppression,
     rule,
+    ruleset_version,
     run,
 )
 
@@ -46,12 +60,15 @@ from raft_tpu.analysis import rules_mesh  # noqa: F401
 from raft_tpu.analysis import rules_pallas  # noqa: F401
 from raft_tpu.analysis import rules_hostsync  # noqa: F401
 from raft_tpu.analysis import rules_clock  # noqa: F401
+from raft_tpu.analysis import rules_locks  # noqa: F401
+from raft_tpu.analysis import rules_metrics  # noqa: F401
 
 
-def lint_texts(texts, rules=None) -> Report:
+def lint_texts(texts, rules=None, aux=None) -> Report:
     """Lint an in-memory {relative path: source} mapping — the fixture
-    corpus entry point used by ``tests/test_analysis.py``."""
-    return run(Project.from_texts(texts), rules=rules)
+    corpus entry point used by ``tests/test_analysis.py``. ``aux``
+    opts a fixture into the doc-conformance checks (R9)."""
+    return run(Project.from_texts(texts, aux=aux), rules=rules)
 
 
 def lint_root(root, rules=None) -> Report:
@@ -60,6 +77,7 @@ def lint_root(root, rules=None) -> Report:
 
 
 __all__ = [
-    "DEFAULT_DIRS", "Finding", "Project", "Report", "RULES", "Rule",
-    "Suppression", "rule", "run", "lint_texts", "lint_root",
+    "DEFAULT_DIRS", "Finding", "LintCache", "Project", "Report",
+    "RULES", "Rule", "Suppression", "rule", "ruleset_version", "run",
+    "lint_texts", "lint_root",
 ]

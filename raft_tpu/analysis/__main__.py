@@ -4,10 +4,11 @@ on any unsuppressed finding."""
 from __future__ import annotations
 
 import argparse
+import json
 import pathlib
 import sys
 
-from raft_tpu.analysis import Project, run
+from raft_tpu.analysis import LintCache, Project, ruleset_version, run
 from raft_tpu.analysis.report import (
     render_ci,
     render_rules,
@@ -37,8 +38,18 @@ def main(argv=None) -> int:
                     choices=("text", "json", "ci"))
     ap.add_argument("--output", default=None,
                     help="also write the JSON report to this path")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the incremental content-hash cache "
+                         "(ci/.graftlint_cache.json)")
+    ap.add_argument("--lockgraph", default=None, metavar="PATH",
+                    help="also dump the R8 static lock-acquisition "
+                         "graph (locks, edges, cycles) as JSON")
     ap.add_argument("--list-rules", action="store_true")
-    ap.add_argument("--list-suppressions", action="store_true")
+    ap.add_argument("--list-suppressions", action="store_true",
+                    help="print the suppression inventory instead of "
+                         "the findings (JSON with --format=json — the "
+                         "same [path, rule, reason] rows the report "
+                         "and the snapshot test read)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -47,16 +58,31 @@ def main(argv=None) -> int:
 
     root = pathlib.Path(args.root) if args.root else _default_root()
     rules = args.rules.split(",") if args.rules else None
+    project = Project.from_root(root)
+    cache = None
+    if not args.no_cache:
+        cache = LintCache(root / "ci" / ".graftlint_cache.json",
+                          ruleset_version())
     try:
-        report = run(Project.from_root(root), rules=rules)
+        report = run(project, rules=rules, cache=cache)
     except ValueError as e:
         sys.stderr.write(f"graftlint: {e}\n")
         return 2
 
+    if args.lockgraph:
+        from raft_tpu.analysis.rules_locks import build_lock_graph
+
+        graph = build_lock_graph(project)
+        pathlib.Path(args.lockgraph).write_text(
+            json.dumps(graph.to_dict(), indent=2) + "\n")
     if args.output:
         pathlib.Path(args.output).write_text(report.to_json())
     if args.list_suppressions:
-        sys.stdout.write(render_suppressions(report))
+        if args.fmt == "json":
+            sys.stdout.write(json.dumps(
+                report.suppression_inventory(), indent=2) + "\n")
+        else:
+            sys.stdout.write(render_suppressions(report))
         return 0
     if args.fmt == "json":
         sys.stdout.write(report.to_json())

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import hashlib
 import io
 import json
 import pathlib
@@ -79,6 +80,11 @@ def parse_pragma_items(payload: str):
 #: directories scanned by default, relative to the repo root — the same
 #: set the old ci/check_style.py walked.
 DEFAULT_DIRS = ("raft_tpu", "tests", "examples", "scripts")
+
+#: non-scanned files the whole-program rules read as evidence: R9
+#: cross-checks the registered metric names against ARCHITECTURE.md's
+#: inventory tables and the ``SNAPSHOT_FLOORS`` dict in the bench gate
+AUX_FILES = ("ARCHITECTURE.md", "ci/bench_compare.py")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -205,13 +211,22 @@ class SourceFile:
 
 
 class Project:
-    """The set of files one analysis run sees."""
+    """The set of files one analysis run sees.
+
+    ``aux`` carries the non-Python evidence whole-program rules
+    cross-check against (ARCHITECTURE.md's metric tables, the bench
+    gate's ``SNAPSHOT_FLOORS``) as ``{repo-relative path: text}`` —
+    absent entries simply disable the corresponding check, so fixture
+    projects opt in per test.
+    """
 
     def __init__(self, files: Sequence[SourceFile],
-                 root: Optional[pathlib.Path] = None):
+                 root: Optional[pathlib.Path] = None,
+                 aux: Optional[Dict[str, str]] = None):
         self.files = list(files)
         self.root = root
         self.by_rel = {f.rel: f for f in self.files}
+        self.aux = dict(aux or {})
 
     @classmethod
     def from_root(cls, root, dirs: Sequence[str] = DEFAULT_DIRS
@@ -227,13 +242,19 @@ class Project:
                     continue
                 rel = path.relative_to(root).as_posix()
                 files.append(SourceFile(rel, path.read_text()))
-        return cls(files, root)
+        aux = {}
+        for rel in AUX_FILES:
+            p = root / rel
+            if p.exists():
+                aux[rel] = p.read_text()
+        return cls(files, root, aux)
 
     @classmethod
-    def from_texts(cls, texts: Dict[str, str]) -> "Project":
+    def from_texts(cls, texts: Dict[str, str],
+                   aux: Optional[Dict[str, str]] = None) -> "Project":
         """Synthetic project for the fixture corpus: path -> source."""
         return cls([SourceFile(rel, text)
-                    for rel, text in sorted(texts.items())])
+                    for rel, text in sorted(texts.items())], aux=aux)
 
     def lib(self) -> List[SourceFile]:
         return [f for f in self.files if f.kind == "raft_tpu"]
@@ -253,21 +274,144 @@ class Rule:
     name: str
     doc: str
     check: Callable[[Project], Iterable[Finding]]
+    #: "file" — findings for a file depend only on that file's text, so
+    #: the incremental cache can key them per (file sha, rule-set
+    #: version); "program" — findings depend on the whole tree (cross
+    #: -module graph, test↔lib coverage, doc cross-checks), cached per
+    #: project digest instead
+    scope: str = "file"
 
 
 RULES: Dict[str, Rule] = {}
 
 
-def rule(rule_id: str, name: str):
+def rule(rule_id: str, name: str, scope: str = "file"):
     """Register a checker under a rule id. The checker's docstring is
     the rule's documentation (surfaced by ``--list-rules``)."""
+    assert scope in ("file", "program"), scope
 
     def deco(fn):
         doc = " ".join((fn.__doc__ or "").split())
-        RULES[rule_id] = Rule(rule_id, name, doc, fn)
+        RULES[rule_id] = Rule(rule_id, name, doc, fn, scope)
         return fn
 
     return deco
+
+
+# ---------------------------------------------------------------------------
+# incremental cache
+# ---------------------------------------------------------------------------
+
+
+def _sha1(text: str) -> str:
+    return hashlib.sha1(text.encode("utf-8", "replace")).hexdigest()
+
+
+def ruleset_version(package_dir: Optional[pathlib.Path] = None) -> str:
+    """Content hash of the analysis package itself — any edit to a rule
+    or this runner invalidates every cache entry, so a stale cache can
+    never mask a new rule's findings."""
+    base = package_dir or pathlib.Path(__file__).resolve().parent
+    h = hashlib.sha1()
+    for p in sorted(base.glob("*.py")):
+        h.update(p.name.encode())
+        h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+class LintCache:
+    """Content-hash finding cache (``ci/.graftlint_cache.json``).
+
+    File-scope rules key per ``(file sha, rule-set version)``;
+    whole-program rules key on the project digest (every file sha +
+    every aux text). Raw findings are cached *pre-suppression* — the
+    pragma fold is cheap and always runs fresh, so editing only a
+    pragma still flips a finding's suppressed state on a full cache
+    hit.
+    """
+
+    def __init__(self, path, version: str):
+        self.path = pathlib.Path(path)
+        self.version = version
+        self.hits = 0
+        self.misses = 0
+        self._dirty = False
+        data: dict = {}
+        try:
+            data = json.loads(self.path.read_text())
+        except (OSError, ValueError):
+            data = {}
+        if data.get("version") != version:
+            data = {}
+        self._files: dict = data.get("files", {})
+        self._program: dict = data.get("program", {})
+
+    @staticmethod
+    def _load(items) -> List[Finding]:
+        return [Finding(**d) for d in items]
+
+    def get_file(self, rule_id: str, rel: str,
+                 sha: str) -> Optional[List[Finding]]:
+        entry = self._files.get(rel)
+        if entry is None or entry.get("sha") != sha:
+            return None
+        found = entry.get("rules", {}).get(rule_id)
+        return None if found is None else self._load(found)
+
+    def put_file(self, rule_id: str, rel: str, sha: str,
+                 findings: List[Finding]) -> None:
+        entry = self._files.setdefault(rel, {"sha": sha, "rules": {}})
+        if entry.get("sha") != sha:
+            self._files[rel] = entry = {"sha": sha, "rules": {}}
+        entry["rules"][rule_id] = [dataclasses.asdict(f)
+                                   for f in findings]
+        self._dirty = True
+
+    def get_program(self, rule_id: str,
+                    digest: str) -> Optional[List[Finding]]:
+        entry = self._program.get(rule_id)
+        if entry is None or entry.get("digest") != digest:
+            return None
+        return self._load(entry.get("findings", []))
+
+    def put_program(self, rule_id: str, digest: str,
+                    findings: List[Finding]) -> None:
+        self._program[rule_id] = {
+            "digest": digest,
+            "findings": [dataclasses.asdict(f) for f in findings]}
+        self._dirty = True
+
+    def prune(self, live_rels: Iterable[str]) -> None:
+        """Drop entries for files no longer in the project."""
+        live = set(live_rels)
+        for rel in list(self._files):
+            if rel not in live:
+                del self._files[rel]
+                self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        payload = {"version": self.version, "files": self._files,
+                   "program": self._program}
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            self.path.write_text(json.dumps(payload) + "\n")
+        except OSError:
+            pass  # a read-only checkout still lints, just uncached
+
+
+def project_digest(project: Project) -> str:
+    """One hash over every file and aux text — the whole-program cache
+    key component."""
+    h = hashlib.sha1()
+    for f in sorted(project.files, key=lambda f: f.rel):
+        h.update(f.rel.encode())
+        h.update(_sha1(f.text).encode())
+    for rel in sorted(project.aux):
+        h.update(rel.encode())
+        h.update(_sha1(project.aux[rel]).encode())
+    return h.hexdigest()
 
 
 # ---------------------------------------------------------------------------
@@ -282,16 +426,29 @@ class Report:
     suppressions: List[Suppression]         # full inventory
     rules_run: List[str]
     n_files: int
+    cache_hits: int = 0
+    cache_misses: int = 0
+    cache_enabled: bool = False
 
     @property
     def ok(self) -> bool:
         return not self.findings
+
+    def suppression_inventory(self) -> List[List[str]]:
+        """The canonical ``[path, rule, reason]`` inventory, sorted —
+        the ONE shape the snapshot test, ``--list-suppressions``, and
+        the ``ci/graftlint_report.json`` artifact all read."""
+        return sorted([s.path, s.rule, s.reason]
+                      for s in self.suppressions)
 
     def to_dict(self) -> dict:
         return {
             "ok": self.ok,
             "rules_run": self.rules_run,
             "n_files": self.n_files,
+            "cache": {"enabled": self.cache_enabled,
+                      "hits": self.cache_hits,
+                      "misses": self.cache_misses},
             "findings": [dataclasses.asdict(f) for f in self.findings],
             "suppressed": [
                 dict(dataclasses.asdict(f), reason=reason)
@@ -299,23 +456,77 @@ class Report:
             ],
             "suppressions": [dataclasses.asdict(s)
                              for s in self.suppressions],
+            "suppression_inventory": self.suppression_inventory(),
         }
 
     def to_json(self) -> str:
         return json.dumps(self.to_dict(), indent=2) + "\n"
 
 
-def run(project: Project, rules: Optional[Sequence[str]] = None) -> Report:
+def _run_rules(project: Project, selected: Sequence[str],
+               cache: Optional[LintCache]) -> List[Finding]:
+    """Raw (pre-suppression) findings, served from the cache where the
+    content hashes allow."""
+    raw: List[Finding] = []
+    if cache is None:
+        for rid in selected:
+            raw.extend(RULES[rid].check(project))
+        return raw
+
+    digest = project_digest(project)
+    shas = {f.rel: _sha1(f.text) for f in project.files}
+    cache.prune(shas)
+    for rid in selected:
+        r = RULES[rid]
+        if r.scope == "program":
+            cached = cache.get_program(rid, digest)
+            if cached is not None:
+                cache.hits += 1
+                raw.extend(cached)
+            else:
+                cache.misses += 1
+                found = list(r.check(project))
+                cache.put_program(rid, digest, found)
+                raw.extend(found)
+            continue
+        # file scope: serve per-file hits, re-lint only the misses as
+        # a sub-project (sound because a file-scope rule's findings
+        # for a file depend only on that file's text)
+        missing: List[SourceFile] = []
+        for f in project.files:
+            cached = cache.get_file(rid, f.rel, shas[f.rel])
+            if cached is not None:
+                cache.hits += 1
+                raw.extend(cached)
+            else:
+                cache.misses += 1
+                missing.append(f)
+        if not missing:
+            continue
+        sub = Project(missing, project.root, project.aux)
+        fresh = list(r.check(sub))
+        by_rel: Dict[str, List[Finding]] = {f.rel: [] for f in missing}
+        for fd in fresh:
+            by_rel.setdefault(fd.path, []).append(fd)
+        for f in missing:
+            cache.put_file(rid, f.rel, shas[f.rel],
+                           by_rel.get(f.rel, []))
+        raw.extend(fresh)
+    return raw
+
+
+def run(project: Project, rules: Optional[Sequence[str]] = None,
+        cache: Optional[LintCache] = None) -> Report:
     """Run ``rules`` (default: all registered) over ``project`` and
-    fold in suppression pragmas + pragma hygiene."""
+    fold in suppression pragmas + pragma hygiene. With ``cache``,
+    unchanged (file sha, rule-set version) work is served from the
+    content-hash cache and the hit/miss counts land in the report."""
     selected = list(rules) if rules is not None else sorted(RULES)
     unknown = [r for r in selected if r not in RULES]
     if unknown:
         raise ValueError(f"unknown rule(s) {unknown}; have {sorted(RULES)}")
 
-    raw: List[Finding] = []
-    for rid in selected:
-        raw.extend(RULES[rid].check(project))
+    raw = _run_rules(project, selected, cache)
 
     findings: List[Finding] = []
     suppressed: List[tuple] = []
@@ -351,6 +562,11 @@ def run(project: Project, rules: Optional[Sequence[str]] = None) -> Report:
                     f"unused suppression of {s.rule} — the rule no "
                     "longer fires here; delete the pragma"))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if cache is not None:
+        cache.save()
     return Report(findings=findings, suppressed=suppressed,
                   suppressions=inventory, rules_run=selected,
-                  n_files=len(project.files))
+                  n_files=len(project.files),
+                  cache_hits=cache.hits if cache else 0,
+                  cache_misses=cache.misses if cache else 0,
+                  cache_enabled=cache is not None)

@@ -325,7 +325,7 @@ def interpret_covered_names(project: Project) -> Set[str]:
     return covered
 
 
-@rule("R6", "interpret-coverage")
+@rule("R6", "interpret-coverage", scope="program")
 def check_interpret_coverage(project: Project) -> Iterable[Finding]:
     """Every pallas_call module under raft_tpu/ops/ exposes public
     entries with an ``interpret`` knob, and every entry has an

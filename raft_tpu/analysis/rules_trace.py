@@ -37,14 +37,33 @@ points) is dead storage after the call — reading it again raises
 jax's deleted-array error on backends that honor donation and
 silently "works" on CPU, which is exactly the kind of
 configuration-dependent regression this rule exists to catch.
+
+**v2 (interprocedural escape).** Donation is tracked as *dotted
+paths*, not bare names, and propagates across function boundaries
+through the program graph:
+
+- a donated buffer reached through an object field (``entry.state``)
+  kills that path — and any alias it escaped into earlier
+  (``self._plane = x`` before ``x`` is donated makes ``self._plane``
+  dead too);
+- a function that donates (a field of) one of its parameters without
+  rebinding it before returning earns a *donation summary*; every
+  resolved intra-repo call site applies the summary to its argument,
+  so a read-after-donation two calls away from the ``jax.jit`` site
+  is a finding in the caller;
+- the blessed ``state = step(state)`` threading — rebinding the path
+  on (or after) the donating call line — conforms at every level, as
+  does the executor's documented donated-plane lifecycle (rebind
+  before return kills the summary).
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Iterable, List, Optional, Set
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from raft_tpu.analysis import astutil
+from raft_tpu.analysis import astutil, proggraph
 from raft_tpu.analysis.core import Finding, Project, rule
 
 _KEY_WRAPPERS = ("tuple", "frozenset")
@@ -229,114 +248,349 @@ def _decorator_donated_argnums(fn) -> Optional[Set[int]]:
     return None
 
 
-def _scan_reads_after(f, scope, call_stmt_line: int,
-                      donated: Set[str], out: List[Finding],
-                      how: str) -> None:
-    """Flag loads of donated names after the donating call, up to the
-    first rebind (a rebind on the call line itself is the blessed
-    ``state = step(state)`` threading idiom)."""
-    loads = []
-    stores = {}
+def _prefixes(path: str) -> List[str]:
+    """``entry.state`` → ``["entry", "entry.state"]`` — a store to any
+    of them rebinds (part of) the donated region."""
+    parts = path.split(".")
+    return [".".join(parts[:i]) for i in range(1, len(parts) + 1)]
+
+
+def _path_index(scope) -> Tuple[List[Tuple[int, str]],
+                                Dict[str, List[int]]]:
+    """Dotted-path loads and stores in ``scope`` (``del`` counts as a
+    store — explicitly dropping a donated ref is the safe ending)."""
+    loads: List[Tuple[int, str]] = []
+    stores: Dict[str, List[int]] = {}
     for n in ast.walk(scope):
-        if isinstance(n, ast.Name) and n.id in donated:
-            if isinstance(n.ctx, ast.Load):
-                loads.append((n.lineno, n.id))
-            else:
-                stores.setdefault(n.id, []).append(n.lineno)
-    for name in donated:
-        rebinds = [ln for ln in stores.get(name, ())
+        if not isinstance(n, (ast.Name, ast.Attribute)):
+            continue
+        p = astutil.dotted(n)
+        if p is None:
+            continue
+        if isinstance(n.ctx, ast.Load):
+            loads.append((n.lineno, p))
+        else:
+            stores.setdefault(p, []).append(n.lineno)
+    return loads, stores
+
+
+def _scan_reads_after(f, call_stmt_line: int, call_end_line: int,
+                      donated: Set[str], loads, stores,
+                      out: List[Finding], how: str,
+                      seen: Set[tuple]) -> None:
+    """Flag loads of donated paths (or anything under them) after the
+    donating call, up to the first rebind of the path or a prefix of
+    it (a rebind on the call line itself is the blessed
+    ``state = step(state)`` threading idiom). Loads count as "after"
+    only past the call's last line — a multi-line call's own argument
+    expressions are the donation, not a read-after."""
+    for path in sorted(donated):
+        rebinds = [ln for pre in _prefixes(path)
+                   for ln in stores.get(pre, ())
                    if ln >= call_stmt_line]
         horizon = min(rebinds) if rebinds else float("inf")
-        for ln, nm in loads:
-            if nm == name and call_stmt_line < ln < horizon:
+        for ln, p in sorted(loads):
+            if p != path and not p.startswith(path + "."):
+                continue
+            if not (call_end_line < ln < horizon):
+                continue
+            key = (f.rel, ln, path)
+            if key not in seen:
+                seen.add(key)
                 out.append(Finding(
                     "R2", f.rel, ln,
-                    f"'{name}' is read after being donated "
+                    f"'{path}' is read after being donated "
                     f"({how} at line {call_stmt_line}) — donated "
                     "buffers are deleted on donating backends; thread "
                     "the result instead"))
-                break  # one finding per donated name is enough
+            break  # one finding per donated path per site is enough
 
 
-@rule("R2", "donation-safety")
+def _escaped_aliases(scope, call_stmt_line: int,
+                     donated: Set[str]) -> Set[str]:
+    """Paths the donated buffer escaped into BEFORE the donating call:
+    ``self._plane = x`` then ``donate(x)`` leaves ``self._plane``
+    dangling too (one aliasing hop)."""
+    extra: Set[str] = set()
+    for stmt in ast.walk(scope):
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and stmt.lineno < call_stmt_line):
+            continue
+        target = astutil.dotted(stmt.targets[0])
+        source = astutil.dotted(stmt.value)
+        if not target or not source:
+            continue
+        for p in donated:
+            if p == source or p.startswith(source + "."):
+                extra.add(target + p[len(source):])
+    return extra
+
+
+def _module_donating(f, resolve_fn, all_fns) -> Dict[str, Set[int]]:
+    """Donating callables visible from any scope of ``f``: module-level
+    ``g = jax.jit(f, donate_*)`` bindings and decorator-form
+    ``@partial(jax.jit, donate_*)`` defs (keyed by bare name)."""
+    donating: Dict[str, Set[int]] = {}
+    for stmt in astutil.walk_in_order(f.tree.body):
+        if (isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)):
+            nums = _donated_argnums(stmt.value, resolve_fn)
+            if nums:
+                donating[stmt.targets[0].id] = nums
+    for fn in all_fns:
+        nums = _decorator_donated_argnums(fn)
+        if nums:
+            donating[fn.name] = nums
+    return donating
+
+
+def _local_bindings(body, resolve_fn) -> Dict[str, Set[int]]:
+    """Names bound to a donating ``jax.jit(...)`` inside this scope."""
+    donating: Dict[str, Set[int]] = {}
+    for stmt in astutil.walk_in_order(body):
+        if (isinstance(stmt, ast.Assign)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)):
+            nums = _donated_argnums(stmt.value, resolve_fn)
+            if nums:
+                donating[stmt.targets[0].id] = nums
+    return donating
+
+
+def _direct_sites(scope, donating
+                  ) -> List[Tuple[int, int, Set[str], str]]:
+    """(line, end line, donated paths, how) for every jit-donation /
+    ``donate=True`` call lexically in ``scope``."""
+    body = getattr(scope, "body", [])
+    if not isinstance(body, list):
+        return []
+    sites: List[Tuple[int, int, Set[str], str]] = []
+    visited: Set[int] = set()
+    for stmt in astutil.walk_in_order(body):
+        for call in ast.walk(stmt):
+            if not isinstance(call, ast.Call) or id(call) in visited:
+                continue
+            visited.add(id(call))
+            nm = astutil.call_name(call) or ""
+            donated: Set[str] = set()
+            how = ""
+            if isinstance(call.func, ast.Name) \
+                    and call.func.id in donating:
+                for i in donating[call.func.id]:
+                    if i < len(call.args):
+                        p = astutil.dotted(call.args[i])
+                        if p:
+                            donated.add(p)
+                how = f"donate_argnums of '{call.func.id}'"
+            elif any(kw.arg == "donate"
+                     and isinstance(kw.value, ast.Constant)
+                     and kw.value.value is True
+                     for kw in call.keywords):
+                # entry-point convention: fn(res, index, ...,
+                # donate=True) donates the INDEX-owned buffers
+                # (second positional or index= keyword) — later
+                # args (new rows, ids) stay caller-owned
+                donated = {p for p in (astutil.dotted(a)
+                                       for a in call.args[1:2]) if p}
+                donated |= {p for p in (astutil.dotted(kw.value)
+                                        for kw in call.keywords
+                                        if kw.arg == "index") if p}
+                how = f"donate=True call to '{nm}'"
+            if donated:
+                sites.append((call.lineno,
+                              call.end_lineno or call.lineno,
+                              donated, how))
+    return sites
+
+
+# -- interprocedural summaries ----------------------------------------------
+
+
+@dataclasses.dataclass
+class _FnFacts:
+    """Per-function facts feeding the donation-summary fixpoint."""
+
+    info: proggraph.FunctionInfo
+    params: List[str]
+    direct: List[Tuple[int, int, Set[str], str]]
+    loads: List[Tuple[int, str]]
+    stores: Dict[str, List[int]]
+
+
+def _is_static(fn_node) -> bool:
+    return any((astutil.dotted(d) or "").split(".")[-1] == "staticmethod"
+               for d in fn_node.decorator_list)
+
+
+def _arg_for_param(call: ast.Call, callee: proggraph.FunctionInfo,
+                   idx: int) -> Optional[ast.AST]:
+    """The caller expression bound to the callee's positional param
+    ``idx`` — methods bind the receiver to param 0 (``self``), so
+    ``entry.claim()`` maps a ``(0, '.state')`` summary to
+    ``entry.state`` in the caller."""
+    pos = _positional_names(callee.node)
+    shift = 0
+    if callee.cls is not None and not _is_static(callee.node):
+        if isinstance(call.func, ast.Attribute):
+            if idx == 0:
+                return call.func.value
+        elif idx == 0:
+            return None  # ClassName(...): the receiver is the new object
+        shift = 1
+    j = idx - shift
+    if 0 <= j < len(call.args) \
+            and not isinstance(call.args[j], ast.Starred):
+        return call.args[j]
+    if idx < len(pos):
+        for kw in call.keywords:
+            if kw.arg == pos[idx]:
+                return kw.value
+    return None
+
+
+def _summary_paths(call: ast.Call, callee: proggraph.FunctionInfo,
+                   summary) -> Set[str]:
+    """Apply a callee's donation summary at one call site → the donated
+    dotted paths in the caller's scope."""
+    paths: Set[str] = set()
+    for idx, suffix in summary:
+        arg = _arg_for_param(call, callee, idx)
+        p = astutil.dotted(arg) if arg is not None else None
+        if p:
+            paths.add(p + suffix)
+    return paths
+
+
+def _rebound(stores: Dict[str, List[int]], path: str,
+             line: int) -> bool:
+    return any(ln >= line for pre in _prefixes(path)
+               for ln in stores.get(pre, ()))
+
+
+def _collect_facts(graph, project) -> Dict[str, _FnFacts]:
+    facts: Dict[str, _FnFacts] = {}
+    for rel, mod in graph.modules.items():
+        f = project.by_rel.get(rel)
+        if f is None or f.tree is None:
+            continue
+        all_fns = astutil.collect_functions(f.tree)
+        by_name: Dict[str, ast.AST] = {}
+        for fn in all_fns:
+            by_name.setdefault(fn.name, fn)
+
+        def resolve_fn(arg, _by=by_name):
+            return _by.get(arg.id) if isinstance(arg, ast.Name) else None
+
+        module_donating = _module_donating(f, resolve_fn, all_fns)
+        infos = list(mod.functions.values())
+        for cls in mod.classes.values():
+            infos.extend(cls.methods.values())
+        for fi in infos:
+            donating = dict(module_donating)
+            donating.update(_local_bindings(fi.node.body, resolve_fn))
+            loads, stores = _path_index(fi.node)
+            facts[fi.qualname] = _FnFacts(
+                info=fi, params=_positional_names(fi.node),
+                direct=_direct_sites(fi.node, donating),
+                loads=loads, stores=stores)
+    return facts
+
+
+def _summaries(graph, facts: Dict[str, _FnFacts]
+               ) -> Dict[str, Set[Tuple[int, str]]]:
+    """Fixpoint: ``summary[qualname] = {(param_index, attr_suffix)}``
+    — paths of a parameter the function donates (directly, or through
+    a summarized callee) and does NOT rebind before returning. A
+    jit-decorated donating def seeds its declared argnums."""
+    summ: Dict[str, Set[Tuple[int, str]]] = {}
+    for qn, fi in graph.functions.items():
+        nums = _decorator_donated_argnums(fi.node)
+        if nums:
+            summ[qn] = {(i, "") for i in nums}
+    for _ in range(12):  # diameter cap; repo call chains are shallow
+        changed = False
+        for qn, fx in facts.items():
+            new = set(summ.get(qn, set()))
+            sites = list(fx.direct)
+            for callee, call in graph.callees(fx.info):
+                s = summ.get(callee.qualname)
+                if s:
+                    paths = _summary_paths(call, callee, s)
+                    if paths:
+                        sites.append((call.lineno,
+                                      call.end_lineno or call.lineno,
+                                      paths, ""))
+            for line, _end, paths, _how in sites:
+                for p in paths:
+                    root = p.split(".", 1)[0]
+                    if root not in fx.params:
+                        continue
+                    if _rebound(fx.stores, p, line):
+                        continue
+                    new.add((fx.params.index(root), p[len(root):]))
+            if new != summ.get(qn, set()):
+                summ[qn] = new
+                changed = True
+        if not changed:
+            break
+    return summ
+
+
+@rule("R2", "donation-safety", scope="program")
 def check_donation(project: Project) -> Iterable[Finding]:
-    """Arguments donated to a jitted call (donate_argnums at the
-    jax.jit site, or the ``donate=True`` entry-point convention) must
-    not be read after the call site."""
+    """Buffers donated to a jitted call (donate_argnums at the jax.jit
+    site, or the ``donate=True`` entry-point convention) must not be
+    read after the call site — tracked as dotted paths, through field
+    escapes, and across function boundaries via donation summaries."""
     out: List[Finding] = []
+    seen: Set[tuple] = set()
+    graph = proggraph.get_graph(project)
+    facts = _collect_facts(graph, project)
+    summ = _summaries(graph, facts)
+    by_node = {id(fx.info.node): fx for fx in facts.values()}
+
     for f in project.lib():
         if f.tree is None:
             continue
         all_fns = astutil.collect_functions(f.tree)
-        by_name = {}
+        by_name: Dict[str, ast.AST] = {}
         for fn in all_fns:
             by_name.setdefault(fn.name, fn)
 
-        def resolve_fn(arg):
-            return by_name.get(arg.id) if isinstance(arg, ast.Name) \
-                else None
+        def resolve_fn(arg, _by=by_name):
+            return _by.get(arg.id) if isinstance(arg, ast.Name) else None
 
-        # donating callables visible from any scope: module-level
-        # `g = jax.jit(f, donate_*)` bindings and decorator-form
-        # `@partial(jax.jit, donate_*)` defs
-        module_donating: dict = {}
-        for stmt in astutil.walk_in_order(f.tree.body):
-            if (isinstance(stmt, ast.Assign)
-                    and len(stmt.targets) == 1
-                    and isinstance(stmt.targets[0], ast.Name)
-                    and isinstance(stmt.value, ast.Call)):
-                nums = _donated_argnums(stmt.value, resolve_fn)
-                if nums:
-                    module_donating[stmt.targets[0].id] = nums
-        for fn in all_fns:
-            nums = _decorator_donated_argnums(fn)
-            if nums:
-                module_donating[fn.name] = nums
-        scopes = [f.tree] + all_fns
-        for scope in scopes:
+        module_donating = _module_donating(f, resolve_fn, all_fns)
+        for scope in [f.tree] + all_fns:
             body = getattr(scope, "body", [])
             if not isinstance(body, list):
                 continue
-            donating: dict = dict(module_donating)
-            # pass 1: names bound to donating jax.jit(...) in this scope
-            for stmt in astutil.walk_in_order(body):
-                if (isinstance(stmt, ast.Assign)
-                        and len(stmt.targets) == 1
-                        and isinstance(stmt.targets[0], ast.Name)
-                        and isinstance(stmt.value, ast.Call)):
-                    nums = _donated_argnums(stmt.value, resolve_fn)
-                    if nums:
-                        donating[stmt.targets[0].id] = nums
-            # pass 2: call sites
-            for stmt in astutil.walk_in_order(body):
-                for call in ast.walk(stmt):
-                    if not isinstance(call, ast.Call):
+            donating = dict(module_donating)
+            donating.update(_local_bindings(body, resolve_fn))
+            sites = _direct_sites(scope, donating)
+            fx = by_node.get(id(scope))
+            if fx is not None:
+                # interprocedural: calls into functions whose summary
+                # says they donate (a field of) this argument
+                for callee, call in graph.callees(fx.info):
+                    s = summ.get(callee.qualname)
+                    if not s:
                         continue
-                    nm = astutil.call_name(call) or ""
-                    donated: Set[str] = set()
-                    how = ""
-                    if isinstance(call.func, ast.Name) \
-                            and call.func.id in donating:
-                        for i in donating[call.func.id]:
-                            if i < len(call.args) and isinstance(
-                                    call.args[i], ast.Name):
-                                donated.add(call.args[i].id)
-                        how = f"donate_argnums of '{call.func.id}'"
-                    elif any(kw.arg == "donate"
-                             and isinstance(kw.value, ast.Constant)
-                             and kw.value.value is True
-                             for kw in call.keywords):
-                        # entry-point convention: fn(res, index, ...,
-                        # donate=True) donates the INDEX-owned buffers
-                        # (second positional or index= keyword) — later
-                        # args (new rows, ids) stay caller-owned
-                        donated = {a.id for a in call.args[1:2]
-                                   if isinstance(a, ast.Name)}
-                        donated |= {kw.value.id for kw in call.keywords
-                                    if kw.arg == "index"
-                                    and isinstance(kw.value, ast.Name)}
-                        how = f"donate=True call to '{nm}'"
-                    if donated:
-                        _scan_reads_after(f, scope, call.lineno,
-                                          donated, out, how)
+                    paths = _summary_paths(call, callee, s)
+                    if paths:
+                        sites.append((
+                            call.lineno,
+                            call.end_lineno or call.lineno, paths,
+                            f"donation escaping through "
+                            f"'{callee.name}'"))
+            if not sites:
+                continue
+            loads, stores = _path_index(scope)
+            for line, end, paths, how in sites:
+                paths = set(paths) | _escaped_aliases(scope, line, paths)
+                _scan_reads_after(f, line, end, paths, loads, stores,
+                                  out, how, seen)
     return out

@@ -451,9 +451,11 @@ class SearchExecutor:
         # int64 lifetime totals, and the pkeys whose index a weakref
         # finalizer reported garbage-collected (drained under the
         # lock — GC callbacks only append)
-        self._probe_state: dict = {}
-        self._probe_info: dict = {}
-        self._probe_totals: dict = {}
+        self._probe_state: dict = {}   # guarded-by: _lock
+        self._probe_info: dict = {}    # guarded-by: _lock
+        self._probe_totals: dict = {}  # guarded-by: _lock
+        # NOT lock-guarded: GC finalizers append without the lock
+        # (GIL-atomic); the list drains under _lock
         self._probe_dead: list = []
         # graftledger (PR 13): an attached MemoryLedger samples a
         # live-memory watermark after every dispatch (host-only
@@ -461,11 +463,11 @@ class SearchExecutor:
         # cache keys and zero-recompile contract are untouched)
         self._memwatch = None
         self.stats = ExecutorStats()
-        self._cache: "collections.OrderedDict[tuple, _Entry]" = (
+        self._cache: "collections.OrderedDict[tuple, _Entry]" = (  # guarded-by: _lock
             collections.OrderedDict())
         # digest -> {family, bucket, flops, bytes_accessed, ...}: the
         # JSON-snapshot view of the per-executable cost gauges
-        self._cost_table: dict = {}
+        self._cost_table: dict = {}  # guarded-by: _lock
         # multi-threaded frontends share one executor: the cache and
         # the donated per-entry state buffers must hand off atomically
         # (two threads donating the same state would hit jax's
@@ -1125,9 +1127,15 @@ class SearchExecutor:
         # generation is already in the container — rebuild and retry
         # against it. Bounded: every retry needs a fresh swap to have
         # landed in the capture→enqueue window, so under any sane
-        # epoch cadence one retry is the norm; the bound only guards
+        # epoch cadence one retry is the norm; the bound guards
         # against a pathological swap storm (any other error
-        # re-raises immediately).
+        # re-raises immediately). The final attempt runs WHOLLY under
+        # the dispatch lock: plan capture and enqueue become atomic
+        # against apply_plan (which swaps under this same RLock), so
+        # a swap storm can starve at most four attempts — the fifth
+        # cannot observe a donated plane. Lock order stays
+        # executor._lock -> container._swap_lock, the order
+        # apply_plan already established.
         for _ in range(4):
             try:
                 return self._run_once(index, queries, k, params, fw,
@@ -1137,8 +1145,9 @@ class SearchExecutor:
                     raise
                 tracing.inc_counter(
                     "serving.execute.placement_retries")
-        return self._run_once(index, queries, k, params, fw, kw,
-                              trace_ids=trace_ids)
+        with self._lock:
+            return self._run_once(index, queries, k, params, fw, kw,
+                                  trace_ids=trace_ids)
 
     def _run_once(self, index, queries, k, params, fw, kw,
                   trace_ids: Tuple[int, ...] = ()):

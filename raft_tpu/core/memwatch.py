@@ -357,7 +357,7 @@ class MemoryLedger:
         # label -> weakref(index): a dropped index must not be held
         # resident by its own accounting (mirrors the executor's
         # probe-plane death watch)
-        self._watched: "dict[str, weakref.ref]" = {}
+        self._watched: "dict[str, weakref.ref]" = {}  # guarded-by: _lock
         # memory_stats support is probed once: on unsupported
         # backends the per-dispatch sample degrades to the heartbeat
         # counter instead of paying a doomed backend call per dispatch
@@ -366,13 +366,13 @@ class MemoryLedger:
         # dispatch core: cache the device list once so the hot path
         # never re-enumerates backends, only reads their stats
         self._devices = None
-        self._wm_in_use = 0.0
-        self._wm_forecast = 0.0
+        self._wm_in_use = 0.0     # guarded-by: _lock
+        self._wm_forecast = 0.0   # guarded-by: _lock
         # named byte holds (graftcast prefetch and friends): bytes a
         # background channel has claimed but serving must still see
         # as spoken for — headroom subtracts them, so an admission
         # racing a prefetch can never both win the same bytes
-        self._reservations: Dict[str, int] = {}
+        self._reservations: Dict[str, int] = {}  # guarded-by: _lock
         # the last snapshot publish() produced (the flight recorder's
         # low-headroom trigger reads it instead of recomputing the
         # whole truth the same scrape just published)
@@ -858,7 +858,7 @@ def diff_memory_profiles(before: Dict[str, int],
 # the opt-in build/extend capacity gate
 # ---------------------------------------------------------------------------
 
-_GATE: Optional[MemoryLedger] = None
+_GATE: Optional[MemoryLedger] = None  # guarded-by: _GATE_LOCK
 _GATE_LOCK = threading.Lock()
 
 

@@ -109,11 +109,11 @@ def start_server(port: int = 9999):
 # counters — process-wide serving metrics registry
 # ---------------------------------------------------------------------------
 
-_counters: dict = {}
+_counters: dict = {}  # guarded-by: _counters_lock
 # process-lifetime totals: everything reset_counters() has folded away.
 # Session-scoped artifacts (the CI metrics snapshot) read these so
 # per-test isolation resets can't blank the session's accounting.
-_counters_lifetime: dict = {}
+_counters_lifetime: dict = {}  # guarded-by: _counters_lock
 _counters_lock = threading.Lock()
 
 
@@ -183,7 +183,7 @@ def lifetime_counters(prefix: str = "") -> dict:
 # gauges — last-value metrics (cost-analysis numbers, queue depth, rates)
 # ---------------------------------------------------------------------------
 
-_gauges: dict = {}
+_gauges: dict = {}  # guarded-by: _counters_lock
 
 
 def set_gauge(name: str, value: float) -> None:
@@ -251,9 +251,9 @@ class Histogram:
 
     def __init__(self, bounds=_HIST_BOUNDS):
         self.bounds = tuple(bounds)
-        self.counts = [0] * (len(self.bounds) + 1)  # +overflow bucket
-        self.count = 0
-        self.sum = 0.0
+        self.counts = [0] * (len(self.bounds) + 1)  # +overflow bucket; guarded-by: _lock
+        self.count = 0   # guarded-by: _lock
+        self.sum = 0.0   # guarded-by: _lock
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -392,13 +392,13 @@ class SpanRecorder:
 
     def __init__(self, capacity: int = 8192):
         self._lock = threading.Lock()
-        self._buf: "collections.deque[Span]" = collections.deque(
+        self._buf: "collections.deque[Span]" = collections.deque(  # guarded-by: _lock
             maxlen=max(int(capacity), 1))
-        self._dropped = 0
+        self._dropped = 0  # guarded-by: _lock
 
     @property
     def capacity(self) -> int:
-        return self._buf.maxlen
+        return self._buf.maxlen  # graftlint: disable=R8(deque reference never rebinds; maxlen is immutable)
 
     @property
     def dropped(self) -> int:

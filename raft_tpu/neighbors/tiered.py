@@ -184,13 +184,13 @@ class TieredIvf(_TieredPlanes):
     list_sizes: jax.Array      # (n_lists,) int32
     hot_data: jax.Array        # (n_hot, max_list_size, d) f32 — HBM
     cold_data: jax.Array       # (n_cold, max_list_size, d) f32 — host
-    hot_slot_map: jax.Array    # (n_lists,) int32, hot slot or -1
-    cold_slot_map: jax.Array   # (n_lists,) int32, cold slot or -1
-    hot_lists: np.ndarray      # (n_hot,) list id occupying each hot slot
-    cold_lists: np.ndarray     # (n_cold,) list id occupying each cold slot
+    hot_slot_map: jax.Array    # (n_lists,) int32, hot slot or -1  # guarded-by: _swap_lock
+    cold_slot_map: jax.Array   # (n_lists,) int32, cold slot or -1  # guarded-by: _swap_lock
+    hot_lists: np.ndarray      # (n_hot,) list id occupying each hot slot  # guarded-by: _swap_lock
+    cold_lists: np.ndarray     # (n_cold,) list id occupying each cold slot  # guarded-by: _swap_lock
     metric: DistanceType
     host_resident: bool        # did the cold tier land in host memory?
-    generation: int = 0        # placement generation (apply_plan bumps)
+    generation: int = 0        # placement generation (apply_plan bumps)  # guarded-by: _swap_lock
     # serializes placement writes against serving reads: a search
     # must capture the placement-affected arrays as ONE consistent
     # generation (all pre-swap or all post-swap, never mixed — a new
@@ -231,16 +231,16 @@ class TieredIvfPq(_TieredPlanes):
     list_sizes: jax.Array      # (n_lists,) int32
     hot_codes: jax.Array       # (n_hot, max, pq_bytes) u8 — HBM
     cold_codes: jax.Array      # (n_cold, max, pq_bytes) u8 — host
-    hot_slot_map: jax.Array    # (n_lists,) int32, hot slot or -1
-    cold_slot_map: jax.Array   # (n_lists,) int32, cold slot or -1
-    hot_lists: np.ndarray
-    cold_lists: np.ndarray
+    hot_slot_map: jax.Array    # (n_lists,) int32, hot slot or -1  # guarded-by: _swap_lock
+    cold_slot_map: jax.Array   # (n_lists,) int32, cold slot or -1  # guarded-by: _swap_lock
+    hot_lists: np.ndarray  # guarded-by: _swap_lock
+    cold_lists: np.ndarray  # guarded-by: _swap_lock
     metric: DistanceType
     codebook_kind: object      # ivf_pq.CodebookKind
     pq_bits: int
     packed: bool
     host_resident: bool
-    generation: int = 0
+    generation: int = 0  # guarded-by: _swap_lock
     _swap_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False, compare=False)
 
@@ -284,13 +284,13 @@ class TieredIvfBq(_TieredPlanes):
     cold_errw: jax.Array
     hot_data: jax.Array        # (n_hot, max, dim) f32 — rerank rows
     cold_data: jax.Array
-    hot_slot_map: jax.Array
-    cold_slot_map: jax.Array
-    hot_lists: np.ndarray
-    cold_lists: np.ndarray
+    hot_slot_map: jax.Array  # guarded-by: _swap_lock
+    cold_slot_map: jax.Array  # guarded-by: _swap_lock
+    hot_lists: np.ndarray  # guarded-by: _swap_lock
+    cold_lists: np.ndarray  # guarded-by: _swap_lock
     metric: DistanceType
     host_resident: bool
-    generation: int = 0
+    generation: int = 0  # guarded-by: _swap_lock
     _swap_lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False, compare=False)
 

@@ -119,10 +119,10 @@ class AdmissionQueue:
         # so both completion paths feed one burn-rate ledger
         self._slo = slo
         self._lock = threading.Lock()
-        self._groups: Dict[Any, List[SearchRequest]] = {}
-        self._n = 0
-        self._rate = 0.0
-        self._last_arrival: Optional[float] = None
+        self._groups: Dict[Any, List[SearchRequest]] = {}  # guarded-by: _lock
+        self._n = 0                                        # guarded-by: _lock
+        self._rate = 0.0                                   # guarded-by: _lock
+        self._last_arrival: Optional[float] = None         # guarded-by: _lock
 
     # -- state --------------------------------------------------------------
 

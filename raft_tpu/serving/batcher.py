@@ -206,10 +206,10 @@ class DynamicBatcher:
         self._queue = AdmissionQueue(self.config.capacity,
                                      self.config.shed, slo=self._slo)
         self._cond = threading.Condition()
-        self._closing = False
+        self._closing = False   # guarded-by: _cond
         # fairness bookkeeping: the group served last and its streak
-        self._last_key = None
-        self._consecutive = 0
+        self._last_key = None   # guarded-by: _cond
+        self._consecutive = 0   # guarded-by: _cond
         self._thread: Optional[threading.Thread] = None
         if start:
             self._thread = threading.Thread(
@@ -231,7 +231,7 @@ class DynamicBatcher:
         typed ``Overloaded`` on a full queue and ``ShutDown`` after
         :meth:`close`; unsupported index/params/filter combinations
         fail here, synchronously."""
-        if self._closing:
+        if self._closing:  # graftlint: disable=R8(benign racy fast-fail; the authoritative check re-runs under _cond before enqueue)
             raise ShutDown("batcher is closed")
         now = self._clock.now()
         if deadline is None:

@@ -126,9 +126,9 @@ class ContinuousCapture:
         # always win; a busy lock defers the tick
         self.profile_lock: Optional[threading.Lock] = None
         self._lock = threading.Lock()
-        self._armed_at: Optional[float] = None
-        self._last: Optional[float] = None
-        self._captured_s = 0.0
+        self._armed_at: Optional[float] = None  # guarded-by: _lock
+        self._last: Optional[float] = None      # guarded-by: _lock
+        self._captured_s = 0.0                  # guarded-by: _lock
 
     def _budget_ok_locked(self, now: float) -> bool:
         """Is the cumulative profiler time ALREADY spent within

@@ -222,7 +222,7 @@ class FleetAggregator:
         if not isinstance(replicas, dict):
             replicas = {f"r{i}": u for i, u in enumerate(replicas)}
         self._lock = threading.Lock()
-        self._states: Dict[str, ReplicaState] = {}
+        self._states: Dict[str, ReplicaState] = {}  # guarded-by: _lock
         for name, url in replicas.items():
             if not url.endswith(".json"):
                 url = url.rstrip("/") + "/snapshot.json"
@@ -230,12 +230,12 @@ class FleetAggregator:
         # per-(replica, counter) high-water marks: the monotonicity
         # assertion — a fleet counter can never go backwards, however
         # a replica's registries were reset mid-scrape
-        self._high: Dict[str, Dict[str, float]] = {
+        self._high: Dict[str, Dict[str, float]] = {  # guarded-by: _lock
             name: {} for name in self._states}
         # the last merged view (set by merge()): the exposition path
         # renders from it instead of re-running the whole merge —
         # /metrics already merged once in fleet_snapshot()
-        self._last_merged: Optional[dict] = None
+        self._last_merged: Optional[dict] = None  # guarded-by: _lock
         # fleet-level multiburn alerting (PR 13): the merged
         # attained/missed sums' last-seen values, and the paired
         # windows the per-merge deltas fold into. The fleet sums are
@@ -290,8 +290,12 @@ class FleetAggregator:
             now = self._clock.now()
         tracing.inc_counter(SCRAPES)
         # push-mode replicas are never fetched — their snapshots
-        # arrive through push(); they still count into health below
-        states = [s for s in self._states.values() if not s.push]
+        # arrive through push(); they still count into health below.
+        # snapshot the replica list under the lock: a concurrent
+        # push() registering a new replica mutates the dict
+        with self._lock:
+            states = [s for s in self._states.values() if not s.push]
+            all_states = list(self._states.values())
         if not states:
             results = []
         elif len(states) == 1:
@@ -312,7 +316,7 @@ class FleetAggregator:
                 state.scraped_at = now
                 state.scrapes += 1
                 self._clamp_counters_locked(state.name, snap)
-        for state in self._states.values():
+        for state in all_states:
             if state.healthy(now, self.config.staleness_s):
                 healthy += 1
         return healthy

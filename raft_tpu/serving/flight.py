@@ -190,14 +190,14 @@ class FlightRecorder:
         # check instead of consuming the cooldown on a doomed capture.
         self.profile_lock: Optional[threading.Lock] = None
         self._lock = threading.Lock()
-        self._bundles: "collections.deque" = collections.deque(
+        self._bundles: "collections.deque" = collections.deque(  # guarded-by: _lock
             maxlen=max(int(self.config.max_bundles), 1))
-        self._seq = 0
-        self._last_capture: Optional[float] = None
+        self._seq = 0  # guarded-by: _lock
+        self._last_capture: Optional[float] = None  # guarded-by: _lock
         # latency-window baseline: primed at construction so the first
         # check's window starts HERE, not at process start (a service
         # attaching a recorder mid-life must not re-judge its history)
-        self._last_cum: Optional[list] = None
+        self._last_cum: Optional[list] = None  # guarded-by: _lock
         if self.config.latency is not None:
             self._last_cum = tracing.get_histogram(
                 self.config.latency.histogram).snapshot()["bucket_counts"]
@@ -264,7 +264,8 @@ class FlightRecorder:
         return timed_capture(self.profile_dir,
                              self.config.capture_seconds)
 
-    def _build_bundle(self, now: float, reasons: List[str]) -> dict:
+    def _build_bundle(self, now: float, reasons: List[str],
+                      seq: int) -> dict:
         attribution = None
         trace_file = None
         error = None
@@ -287,7 +288,7 @@ class FlightRecorder:
             error = f"{type(e).__name__}: {e}"
         rec = tracing.span_recorder()
         bundle = {
-            "incident": self._seq,
+            "incident": seq,
             "time": now,
             "triggers": list(reasons),
             "slo": tracing.gauges("serving.slo."),
@@ -356,13 +357,14 @@ class FlightRecorder:
                 return None
             self._last_capture = now
             self._seq += 1
+            seq = self._seq
         # the capture itself runs OUTSIDE the lock: it sleeps
         # capture_seconds, and a concurrent scrape's check() must see
         # the advanced cooldown stamp instead of blocking behind it
         # (the held profile_lock meanwhile 409s /profile — the same
         # one-capture-at-a-time contract, both directions)
         try:
-            bundle = self._build_bundle(now, reasons)
+            bundle = self._build_bundle(now, reasons, seq)
         finally:
             if self.profile_lock is not None:
                 self.profile_lock.release()

@@ -97,16 +97,16 @@ class RecallWindow:
         # None (default) keeps the original uniform weighting.
         self.decay_half_life_s = decay_half_life_s
         self._lock = threading.Lock()
-        self._events: "collections.deque" = collections.deque()
-        self._hits = 0
-        self._trials = 0
+        self._events: "collections.deque" = collections.deque()  # guarded-by: _lock
+        self._hits = 0    # guarded-by: _lock
+        self._trials = 0  # guarded-by: _lock
         # decay path: running sums of event weights, anchored at
         # ``_anchor`` — scaling both sums by the elapsed decay factor
         # on access keeps record/estimate O(events-pruned), never
         # O(window); record() sits on the shadow-completion path
-        self._wh = 0.0
-        self._wt = 0.0
-        self._anchor: Optional[float] = None
+        self._wh = 0.0  # guarded-by: _lock
+        self._wt = 0.0  # guarded-by: _lock
+        self._anchor: Optional[float] = None  # guarded-by: _lock
 
     def _decay_to_locked(self, now: float) -> None:
         if self._anchor is None:
@@ -263,7 +263,7 @@ class ShadowSampler:
         self._clock = batcher._clock
         self._rng = random.Random(self.config.seed)
         self._lock = threading.Lock()
-        self._pending: "collections.deque" = collections.deque()
+        self._pending: "collections.deque" = collections.deque()  # guarded-by: _lock
         self.window = RecallWindow(window_s=self.config.window_s)
         # params-sweep legs: one window per swept n_probes, published
         # as its own gauge family — together they sample the live
@@ -273,7 +273,7 @@ class ShadowSampler:
                 window_s=self.config.window_s,
                 gauge_prefix=f"index.recall.sweep.p{int(p)}")
             for p in self.config.sweep_probes}
-        self._sweep_cursor = 0
+        self._sweep_cursor = 0  # guarded-by: _lock
 
     def submit(self, index, queries, k: int, params=None, **kw):
         """Submit one live request (exactly ``batcher.submit``) and
@@ -431,13 +431,13 @@ class DriftDetector:
         self.alpha = alpha
         self.alert_threshold = alert_threshold
         self._lock = threading.Lock()
-        self._last: Optional[np.ndarray] = None
-        self._ewma: Optional[np.ndarray] = None
+        self._last: Optional[np.ndarray] = None  # guarded-by: _lock
+        self._ewma: Optional[np.ndarray] = None  # guarded-by: _lock
         # EWMA of per-window probe traffic (same alpha): the weight a
         # fleet aggregator scales this replica's normalized live
         # histogram by — without it, pooling would weigh an idle
         # replica the same as one carrying 99% of fleet traffic
-        self._traffic = 0.0
+        self._traffic = 0.0  # guarded-by: _lock
         # identity watch (PR 8 follow-on): which index object this
         # baseline was snapshotted from. extend()/rebuild returns a NEW
         # index whose list_sizes shifted — scoring live traffic against

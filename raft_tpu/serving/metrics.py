@@ -227,9 +227,9 @@ class SloWindow:
         # folds per-merge deltas of fleet counter sums, and appending
         # thousands of unit events per merge would make the window
         # O(fleet traffic) instead of O(merges)
-        self._events: "collections.deque" = collections.deque()
-        self._total = 0
-        self._missed = 0
+        self._events: "collections.deque" = collections.deque()  # guarded-by: _lock
+        self._total = 0   # guarded-by: _lock
+        self._missed = 0  # guarded-by: _lock
 
     def _prune_locked(self, now: float) -> None:
         horizon = now - self.config.window_s
@@ -399,7 +399,7 @@ def params_class(params) -> Optional[str]:
 # 32 distinct classes covers any realistic sweep; overflow is counted,
 # not silent.
 EXECUTE_CLASS_CAP = 32
-_execute_classes: set = set()
+_execute_classes: set = set()  # guarded-by: _execute_classes_lock
 _execute_classes_lock = threading.Lock()
 
 

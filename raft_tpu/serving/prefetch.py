@@ -179,9 +179,9 @@ class TierPrefetcher:
         self.config = config or PrefetchConfig()
         self.ledger = ledger
         self._lock = threading.Lock()
-        self._ewma = np.zeros((tiered.n_lists,), np.float64)
-        self._epochs_observed = 0
-        self._stage_seq = 0
+        self._ewma = np.zeros((tiered.n_lists,), np.float64)  # guarded-by: _lock
+        self._epochs_observed = 0                             # guarded-by: _lock
+        self._stage_seq = 0                                   # guarded-by: _lock
         cap = self.config.capacity
         if cap is None:
             cap = int(width)
@@ -199,9 +199,9 @@ class TierPrefetcher:
         # row bookkeeping (host-side truth): which list each staged
         # row holds (−1 free), the placement generation it was staged
         # against, and a logical age for LRU eviction
-        self._row_list = np.full((cap,), -1, np.int64)
-        self._row_gen = np.zeros((cap,), np.int64)
-        self._row_age = np.zeros((cap,), np.int64)
+        self._row_list = np.full((cap,), -1, np.int64)  # guarded-by: _lock
+        self._row_gen = np.zeros((cap,), np.int64)      # guarded-by: _lock
+        self._row_age = np.zeros((cap,), np.int64)      # guarded-by: _lock
         # fixed (K, ...) staged storage per hot plane, committed to
         # the default device like the hot tier it feeds — allocated
         # ONCE; every stage donates it back in place
@@ -236,10 +236,10 @@ class TierPrefetcher:
         so a racing scrape can never double-fold a window (the
         DriftDetector locking model)."""
         window = np.asarray(window_counts, np.float64)
-        expect(window.shape == self._ewma.shape,
-               "observe() needs one count per list")
         a = self.config.alpha
         with self._lock:
+            expect(window.shape == self._ewma.shape,
+                   "observe() needs one count per list")
             if self._epochs_observed == 0:
                 self._ewma = window.copy()
             else:

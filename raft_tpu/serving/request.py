@@ -65,7 +65,7 @@ class ResultHandle:
     def __init__(self):
         self._lock = threading.Lock()
         self._event = threading.Event()
-        self._state = _PENDING
+        self._state = _PENDING  # guarded-by: _lock
         self._result: Optional[Tuple[Any, Any]] = None
         self._exception: Optional[BaseException] = None
 

@@ -1058,16 +1058,16 @@ class TestSuppressions:
 
     def test_unknown_rule_id_is_a_finding(self):
         src = ("x = 1"
-               "  # graftlint: disable=R9(typo for a real rule)\n")
+               "  # graftlint: disable=R77(typo for a real rule)\n")
         rep = lint_lib(src, ["R0"])
-        assert any("unknown rule 'R9'" in f.message
+        assert any("unknown rule 'R77'" in f.message
                    for f in rep.findings), [
             f.render() for f in rep.findings]
 
     def test_rule_filtered_run_has_no_pragma_hygiene_leak(self):
         """ops-guard style runs (rules=[R6]) must not surface R0
         pragma-hygiene findings from unrelated files."""
-        src = "x = 1  # graftlint: disable=R9\n"
+        src = "x = 1  # graftlint: disable=R77\n"
         rep = lint_lib(src, ["R6"])
         assert rep.ok
         rep = lint_lib(src, ["R0"])
@@ -1110,6 +1110,13 @@ class TestRepoWide:
         # device-free shim, second suppression with the same reason
         ("raft_tpu/serving/harness.py", "R5",
          "device-free test shim: inputs are host arrays by contract"),
+        # PR 19: R8 guarded-by seeding — two benign races kept by
+        # design, each with the reason the race is safe
+        ("raft_tpu/core/tracing.py", "R8",
+         "deque reference never rebinds; maxlen is immutable"),
+        ("raft_tpu/serving/batcher.py", "R8",
+         "benign racy fast-fail; the authoritative check re-runs "
+         "under _cond before enqueue"),
     ]
 
     @pytest.fixture(scope="class")
@@ -1118,7 +1125,7 @@ class TestRepoWide:
 
     def test_registry_is_complete(self):
         assert sorted(RULES) == ["R0", "R1", "R2", "R3", "R4", "R5",
-                                 "R6", "R7"]
+                                 "R6", "R7", "R8", "R9"]
 
     def test_repo_lints_clean(self, report):
         assert report.ok, "\n" + "\n".join(
@@ -1134,6 +1141,15 @@ class TestRepoWide:
     def test_every_suppression_is_used(self, report):
         stale = [s for s in report.suppressions if not s.used]
         assert not stale, stale
+
+    def test_suppression_inventory_json_shape(self, report):
+        """``--list-suppressions --format=json`` and the
+        ``ci/graftlint_report.json`` artifact expose the same
+        ``[path, rule, reason]`` rows this snapshot pins."""
+        rows = report.suppression_inventory()
+        assert rows == sorted(list(t)
+                              for t in self.EXPECTED_SUPPRESSIONS)
+        assert report.to_dict()["suppression_inventory"] == rows
 
 
 # PR 9 scope proofs: the ragged plan/kernel code paths are inside
@@ -1380,3 +1396,532 @@ class TestMeshRaggedKeyProofs:
     def test_mesh_ragged_key_conforming(self):
         assert lint_lib(R1_MESH_RAGGED_KEY_CONFORMING, ["R1"],
                         rel="raft_tpu/core/executor.py").ok
+
+
+# ---------------------------------------------------------------------------
+# PR 19: graftlint v3 — R8 lock discipline, R2v2 interprocedural
+# donation escape, R9 metric-inventory conformance, the program graph
+# they stand on, and the incremental cache
+# ---------------------------------------------------------------------------
+
+R8_VIOLATING = '''\
+import threading
+
+
+class Depot:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        return self._n
+'''
+R8_CONFORMING = '''\
+import threading
+
+
+class Depot:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        with self._lock:
+            return self._n
+'''
+R8_HELPER_CONFORMING = '''\
+import threading
+
+
+class Depot:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded-by: _lock
+
+    def bump(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self._n += 1
+'''
+R8_HELPER_ESCAPE_VIOLATING = R8_HELPER_CONFORMING + '''\
+
+    def leak(self):
+        self._bump_locked()
+'''
+R8_CALLBACK_VIOLATING = '''\
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded-by: _lock
+
+    def arm(self, loop):
+        loop.call(self._on_tick)
+
+    def _on_tick(self):
+        self._n += 1
+'''
+R8_UNKNOWN_LOCK = '''\
+import threading
+
+
+class Depot:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded-by: _missing
+'''
+R8_GLOBAL_VIOLATING = '''\
+import threading
+
+_lock = threading.Lock()
+_total = 0  # guarded-by: _lock
+
+
+def bump(n):
+    global _total
+    with _lock:
+        _total += n
+
+
+def peek():
+    return _total
+'''
+R8_CYCLE_VIOLATING = '''\
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def left():
+    with _a:
+        with _b:
+            pass
+
+
+def right():
+    with _b:
+        with _a:
+            pass
+'''
+R8_CYCLE_CONFORMING = '''\
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def left():
+    with _a:
+        with _b:
+            pass
+
+
+def right():
+    with _a:
+        with _b:
+            pass
+'''
+R8_SELF_DEADLOCK_VIOLATING = '''\
+import threading
+
+_m = threading.Lock()
+
+
+def outer():
+    with _m:
+        inner()
+
+
+def inner():
+    with _m:
+        pass
+'''
+R8_SELF_DEADLOCK_CONFORMING = \
+    R8_SELF_DEADLOCK_VIOLATING.replace("threading.Lock()",
+                                       "threading.RLock()")
+
+
+class TestLockDiscipline:
+    """R8 fixture corpus: guarded-by accesses checked lexically and
+    through private-helper call sites, annotation hygiene, and the
+    static lock graph's cycle / self-deadlock findings."""
+
+    def test_unguarded_read_fires(self):
+        bad = lint_lib(R8_VIOLATING, ["R8"])
+        assert rules_fired(bad) == {"R8"}
+        msg = bad.findings[0].message
+        assert "read of 'self._n'" in msg and "Depot.peek" in msg, msg
+        assert lint_lib(R8_CONFORMING, ["R8"]).ok
+
+    def test_private_helper_inherits_callers_lock(self):
+        assert lint_lib(R8_HELPER_CONFORMING, ["R8"]).ok
+        # one unlocked call site and the helper's guarantee is gone
+        bad = lint_lib(R8_HELPER_ESCAPE_VIOLATING, ["R8"])
+        assert rules_fired(bad) == {"R8"}
+        assert "_bump_locked" in bad.findings[0].message
+
+    def test_callback_reference_never_inherits(self):
+        bad = lint_lib(R8_CALLBACK_VIOLATING, ["R8"])
+        assert rules_fired(bad) == {"R8"}
+        assert "_on_tick" in bad.findings[0].message
+
+    def test_annotation_must_name_a_real_lock(self):
+        bad = lint_lib(R8_UNKNOWN_LOCK, ["R8"])
+        assert rules_fired(bad) == {"R8"}
+        assert "no lock of that name exists" in bad.findings[0].message
+
+    def test_module_globals_are_covered(self):
+        bad = lint_lib(R8_GLOBAL_VIOLATING, ["R8"])
+        assert rules_fired(bad) == {"R8"}
+        assert "read of '_total'" in bad.findings[0].message
+
+    def test_lock_order_cycle(self):
+        bad = lint_lib(R8_CYCLE_VIOLATING, ["R8"])
+        assert rules_fired(bad) == {"R8"}
+        msgs = " ".join(f.message for f in bad.findings)
+        assert "lock-order cycle" in msgs, msgs
+        assert "_a" in msgs and "_b" in msgs
+        assert lint_lib(R8_CYCLE_CONFORMING, ["R8"]).ok
+
+    def test_interprocedural_self_deadlock(self):
+        bad = lint_lib(R8_SELF_DEADLOCK_VIOLATING, ["R8"])
+        assert rules_fired(bad) == {"R8"}
+        assert "self-deadlock" in bad.findings[0].message
+        assert lint_lib(R8_SELF_DEADLOCK_CONFORMING, ["R8"]).ok
+
+    def test_lockgraph_artifact_shape(self):
+        from raft_tpu.analysis.core import Project
+        from raft_tpu.analysis.rules_locks import build_lock_graph
+
+        project = Project.from_texts(
+            {"raft_tpu/ops/sample.py": R8_CYCLE_VIOLATING})
+        d = build_lock_graph(project).to_dict()
+        assert sorted(d) == ["cycles", "edges", "locks",
+                             "self_deadlocks"]
+        assert len(d["locks"]) == 2
+        assert d["cycles"], d
+        assert not d["self_deadlocks"]
+
+
+R2_INTERPROC_VIOLATING = '''\
+import jax
+
+
+def _step_fn(state):
+    return state
+
+
+def _advance(state):
+    step = jax.jit(_step_fn, donate_argnums=(0,))
+    return step(state)
+
+
+def serve(state):
+    out = _advance(state)
+    return out + state
+'''
+R2_INTERPROC_CONFORMING = '''\
+import jax
+
+
+def _step_fn(state):
+    return state
+
+
+def _advance(state):
+    step = jax.jit(_step_fn, donate_argnums=(0,))
+    return step(state)
+
+
+def serve(state):
+    state = _advance(state)
+    return state
+'''
+R2_FIELD_ESCAPE_VIOLATING = '''\
+import jax
+
+
+def _step_fn(plane):
+    return plane
+
+
+def _consume(entry):
+    step = jax.jit(_step_fn, donate_argnums=(0,))
+    return step(entry.plane)
+
+
+def refresh(entry):
+    out = _consume(entry)
+    return out + entry.plane
+'''
+R2_METHOD_ESCAPE_VIOLATING = '''\
+import jax
+
+
+def _step_fn(state):
+    return state
+
+
+class Entry:
+    def claim(self):
+        step = jax.jit(_step_fn, donate_argnums=(0,))
+        return step(self.state)
+
+
+def roll():
+    entry = Entry()
+    out = entry.claim()
+    return out + entry.state
+'''
+
+
+class TestDonationEscape:
+    """R2v2 fixture corpus: donation summaries flow across function
+    boundaries — a helper that donates its argument taints every
+    caller, fields included, while result-threading stays blessed."""
+
+    def test_escape_through_helper(self):
+        bad = lint_lib(R2_INTERPROC_VIOLATING, ["R2"])
+        assert rules_fired(bad) == {"R2"}
+        msg = bad.findings[0].message
+        assert "donation escaping through '_advance'" in msg, msg
+        assert lint_lib(R2_INTERPROC_CONFORMING, ["R2"]).ok
+
+    def test_field_path_escape(self):
+        bad = lint_lib(R2_FIELD_ESCAPE_VIOLATING, ["R2"])
+        assert rules_fired(bad) == {"R2"}
+        assert "'entry.plane'" in bad.findings[0].message
+        # an un-donated sibling field stays readable
+        ok = R2_FIELD_ESCAPE_VIOLATING.replace(
+            "return out + entry.plane", "return out + entry.meta")
+        assert lint_lib(ok, ["R2"]).ok
+
+    def test_method_receiver_escape(self):
+        bad = lint_lib(R2_METHOD_ESCAPE_VIOLATING, ["R2"])
+        assert rules_fired(bad) == {"R2"}
+        assert "'entry.state'" in bad.findings[0].message
+
+
+R9_LIB = '''\
+from raft_tpu.core import tracing
+
+
+def record(n, split):
+    tracing.inc_counter("serving.sample.calls", n)
+    tracing.inc_counter(f"serving.sample.{split}.rows", n)
+    tracing.set_gauge("serving.sample.depth", n)
+'''
+R9_ARCH_OK = (
+    "## Metric inventory\n"
+    "\n"
+    "| name | type | meaning |\n"
+    "| --- | --- | --- |\n"
+    "| `serving.sample.calls` | counter | total calls |\n"
+    "| `serving.sample.<split>.rows` | counter | rows per split |\n"
+    "| `serving.sample.depth` | gauge | queue depth |\n"
+)
+R9_ARCH_MISSING_GAUGE = R9_ARCH_OK.replace(
+    "| `serving.sample.depth` | gauge | queue depth |\n", "")
+R9_FLOORS_OK = (
+    "SNAPSHOT_FLOORS = {\n"
+    '    "serving.sample.calls": 10,\n'
+    "}\n"
+)
+R9_FLOORS_DEAD = (
+    "SNAPSHOT_FLOORS = {\n"
+    '    "serving.sample.calls": 10,\n'
+    '    "serving.sample.ghost": 1,\n'
+    "}\n"
+)
+R9_EXPORTER_OK = (
+    "_HELP_PREFIXES = (\n"
+    '    ("serving.sample", "sample family"),\n'
+    ")\n"
+)
+R9_EXPORTER_DEAD = (
+    "_HELP_PREFIXES = (\n"
+    '    ("serving.sample", "sample family"),\n'
+    '    ("serving.ghostly", "nothing registers this"),\n'
+    ")\n"
+)
+
+
+class TestMetricInventory:
+    """R9 fixture corpus: the registered-pattern inventory against the
+    ARCHITECTURE.md tables, SNAPSHOT_FLOORS, and _HELP_PREFIXES — each
+    drift direction is one finding, and the rule is quiet when a
+    fixture project supplies no aux evidence."""
+
+    def test_documented_inventory_conforms(self):
+        rep = lint_texts({"raft_tpu/serving/sample.py": R9_LIB},
+                         rules=["R9"],
+                         aux={"ARCHITECTURE.md": R9_ARCH_OK})
+        assert rep.ok, [f.render() for f in rep.findings]
+
+    def test_undocumented_gauge_fires(self):
+        rep = lint_texts({"raft_tpu/serving/sample.py": R9_LIB},
+                         rules=["R9"],
+                         aux={"ARCHITECTURE.md": R9_ARCH_MISSING_GAUGE})
+        assert rules_fired(rep) == {"R9"}
+        msg = rep.findings[0].message
+        assert "gauge 'serving.sample.depth'" in msg, msg
+        assert "ARCHITECTURE.md" in msg
+
+    def test_dead_floor_fires(self):
+        rep = lint_texts({"raft_tpu/serving/sample.py": R9_LIB},
+                         rules=["R9"],
+                         aux={"ARCHITECTURE.md": R9_ARCH_OK,
+                              "ci/bench_compare.py": R9_FLOORS_DEAD})
+        assert rules_fired(rep) == {"R9"}
+        msg = rep.findings[0].message
+        assert "serving.sample.ghost" in msg and "floor" in msg, msg
+        assert rep.findings[0].path == "ci/bench_compare.py"
+        rep = lint_texts({"raft_tpu/serving/sample.py": R9_LIB},
+                         rules=["R9"],
+                         aux={"ARCHITECTURE.md": R9_ARCH_OK,
+                              "ci/bench_compare.py": R9_FLOORS_OK})
+        assert rep.ok
+
+    def test_dead_help_prefix_fires(self):
+        texts = {"raft_tpu/serving/sample.py": R9_LIB,
+                 "raft_tpu/serving/exporter.py": R9_EXPORTER_DEAD}
+        rep = lint_texts(texts, rules=["R9"],
+                         aux={"ARCHITECTURE.md": R9_ARCH_OK})
+        assert rules_fired(rep) == {"R9"}
+        assert "serving.ghostly" in rep.findings[0].message
+        texts["raft_tpu/serving/exporter.py"] = R9_EXPORTER_OK
+        assert lint_texts(texts, rules=["R9"],
+                          aux={"ARCHITECTURE.md": R9_ARCH_OK}).ok
+
+    def test_quiet_without_aux(self):
+        assert lint_texts({"raft_tpu/serving/sample.py": R9_LIB},
+                          rules=["R9"]).ok
+
+
+class TestProgGraph:
+    """The cross-module program graph R8/R9/R2v2 stand on."""
+
+    def test_guarded_fields_and_lock_kinds(self):
+        from raft_tpu.analysis import proggraph
+        from raft_tpu.analysis.core import Project
+
+        src = (
+            "import threading\n"
+            "import dataclasses\n"
+            "from dataclasses import field\n"
+            "\n"
+            "\n"
+            "@dataclasses.dataclass\n"
+            "class Plane:\n"
+            "    rows: int = 0  # guarded-by: _swap_lock\n"
+            "    _swap_lock: object = field(\n"
+            "        default_factory=threading.Lock)\n"
+            "\n"
+            "\n"
+            "class Depot:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.RLock()\n"
+            "        self._n = 0  # guarded-by: _lock\n"
+        )
+        project = Project.from_texts({"raft_tpu/core/sample.py": src})
+        graph = proggraph.get_graph(project)
+        mod = graph.modules["raft_tpu/core/sample.py"]
+        plane = mod.classes["Plane"]
+        assert plane.fields["rows"].guarded_by == "_swap_lock"
+        assert plane.fields["_swap_lock"].is_lock
+        depot = mod.classes["Depot"]
+        assert depot.fields["_n"].guarded_by == "_lock"
+        assert depot.fields["_lock"].is_lock
+
+    def test_cross_module_call_resolution(self):
+        from raft_tpu.analysis import proggraph
+        from raft_tpu.analysis.core import Project
+
+        project = Project.from_texts({
+            "raft_tpu/core/util.py": (
+                "def helper(x):\n"
+                "    return x\n"),
+            "raft_tpu/core/main.py": (
+                "from raft_tpu.core.util import helper\n"
+                "\n"
+                "\n"
+                "def caller(x):\n"
+                "    return helper(x)\n")})
+        graph = proggraph.get_graph(project)
+        fn = graph.modules["raft_tpu/core/main.py"].functions["caller"]
+        callees = [c.name for c, _call in graph.callees(fn)]
+        assert callees == ["helper"]
+
+
+class TestLintCache:
+    """The incremental content-hash cache: per-file keys for
+    file-scope rules, one project digest for program-scope rules, and
+    version-stamped invalidation."""
+
+    TEXTS = {"raft_tpu/ops/a.py": "x = 1\n",
+             "raft_tpu/ops/b.py": "y = 2\n"}
+
+    def _run(self, path, texts, rules, version="v1"):
+        from raft_tpu.analysis import LintCache
+        from raft_tpu.analysis.core import Project, run
+
+        cache = LintCache(path, version)
+        rep = run(Project.from_texts(texts), rules=rules, cache=cache)
+        cache.save()
+        return rep
+
+    def test_second_run_is_all_hits(self, tmp_path):
+        path = tmp_path / "cache.json"
+        r1 = self._run(path, self.TEXTS, ["R0"])
+        assert r1.cache_misses == 2 and r1.cache_hits == 0
+        r2 = self._run(path, self.TEXTS, ["R0"])
+        assert r2.cache_hits == 2 and r2.cache_misses == 0
+        assert r2.ok == r1.ok
+
+    def test_edit_invalidates_only_that_file(self, tmp_path):
+        path = tmp_path / "cache.json"
+        self._run(path, self.TEXTS, ["R0"])
+        edited = dict(self.TEXTS)
+        edited["raft_tpu/ops/b.py"] = "y = 3\n"
+        r = self._run(path, edited, ["R0"])
+        assert r.cache_hits == 1 and r.cache_misses == 1
+
+    def test_program_scope_keys_on_project_digest(self, tmp_path):
+        path = tmp_path / "cache.json"
+        r1 = self._run(path, self.TEXTS, ["R8"])
+        assert (r1.cache_hits, r1.cache_misses) == (0, 1)
+        r2 = self._run(path, self.TEXTS, ["R8"])
+        assert (r2.cache_hits, r2.cache_misses) == (1, 0)
+        # ANY file edit re-runs a whole-program rule
+        edited = dict(self.TEXTS)
+        edited["raft_tpu/ops/b.py"] = "y = 3\n"
+        r3 = self._run(path, edited, ["R8"])
+        assert (r3.cache_hits, r3.cache_misses) == (0, 1)
+
+    def test_ruleset_version_change_invalidates(self, tmp_path):
+        path = tmp_path / "cache.json"
+        self._run(path, self.TEXTS, ["R0"])
+        r = self._run(path, self.TEXTS, ["R0"], version="v2")
+        assert r.cache_hits == 0 and r.cache_misses == 2
+
+    def test_cached_findings_match_fresh(self, tmp_path):
+        path = tmp_path / "cache.json"
+        texts = {"raft_tpu/ops/a.py": R0_VIOLATING}
+        r1 = self._run(path, texts, ["R0"])
+        r2 = self._run(path, texts, ["R0"])
+        assert r2.cache_hits > 0
+        assert ([f.render() for f in r1.findings]
+                == [f.render() for f in r2.findings])

@@ -54,7 +54,10 @@ and compiles-during-measure), BENCH_TIERED=1
 (grafttier: hot/cold tiered storage — bit-identity vs the all-HBM
 index, hot GB/s vs the HBM roofline and cold GB/s vs a host-link
 roofline, two live placement epochs with zero backend compiles and
-deterministic swap bytes).
+deterministic swap bytes), BENCH_FLEET=1 (graftroute: the fleet
+router through the device-free N-replica harness — steer and
+f32-wire fan-out bit-identity vs the solo oracle, bf16-wire recall,
+modeled merge-payload bytes per wire dtype).
 """
 
 import json
@@ -654,6 +657,16 @@ def child_main():
             print(json.dumps(last_rec), flush=True)
         except Exception as e:  # noqa: BLE001 — keep headline record
             log(f"tiered rider failed ({e}); keeping headline record")
+
+    # opt-in rider: graftroute — the fleet router through the
+    # device-free N-replica harness: steer/fan-out bit-identity,
+    # bf16-wire recall, and the modeled merge-payload bytes
+    if os.environ.get("BENCH_FLEET") == "1" and last_rec:
+        try:
+            last_rec["fleet"] = _fleet_rider()
+            print(json.dumps(last_rec), flush=True)
+        except Exception as e:  # noqa: BLE001 — keep headline record
+            log(f"fleet rider failed ({e}); keeping headline record")
 
 
 def _ivf_engine_sweep():
@@ -1564,6 +1577,138 @@ def _tiered_rider():
         "compiles_during_epochs": compiles,
         "prefetch": prefetch_ab,
     }
+
+
+def _fleet_rider():
+    """BENCH_FLEET=1 rider: graftroute's fleet router through the
+    device-free N-replica harness (deterministic hash engine — the
+    numbers gate ROUTING structure, not scan kernels). The planner
+    places a skewed traffic plane (hot head replicated fleet-wide,
+    long tail owned once), then three routed legs run against the
+    solo-replica oracle:
+
+    - ``steer``: head-covered batches steered whole to one hot
+      replica — must be bit-identical to solo;
+    - ``fanout_f32``: tail batches partitioned owner-wise, merged on
+      the f32 wire — must also be bit-identical (the exact-merge
+      contract);
+    - ``fanout_bf16``: the same legs on the opt-in bf16 distance
+      wire (ids stay exact int32) — half the merge payload, recall
+      pinned >= 0.99 and deterministic at the seeded config.
+
+    The merge-bytes columns come from ``route_payload_model`` (the
+    ``collective_payload_model`` convention), so the bf16 < f32
+    payload ordering is encoded exactly; coverage/fan-out fractions
+    come off the router's own gauge view. Env knobs:
+    BENCH_FLEET_REPLICAS / BENCH_FLEET_LISTS / BENCH_FLEET_SECONDS.
+    """
+    import numpy as np
+
+    from raft_tpu.bench.prims import timeit_stats
+    from raft_tpu.fleet import (
+        FleetPlanConfig,
+        QueryRouter,
+        RouterConfig,
+        make_fleet,
+        plan_fleet,
+        route_payload_model,
+    )
+
+    n_replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", 4))
+    n_lists = int(os.environ.get("BENCH_FLEET_LISTS", 64))
+    budget = float(os.environ.get("BENCH_FLEET_SECONDS", 2))
+
+    h = make_fleet(n_replicas, n_lists=n_lists)
+    # skewed plane: the head half is hot enough to replicate onto
+    # every replica (hot_share_ratio 0.5 → copies saturate at fleet
+    # size), the tail is owned exactly once
+    counts = np.ones(n_lists, np.int64)
+    counts[: n_lists // 2] = 10_000
+    table = plan_fleet(
+        counts, {n: None for n in h.replicas}, label="ivf:0",
+        version=1, config=FleetPlanConfig(hot_share_ratio=0.5))
+    log(f"fleet rider: {n_replicas} replicas, {n_lists} lists, "
+        f"{table.replicated_lists()} replicated")
+
+    def _router(wire):
+        r = QueryRouter(h.replicas, resolve_probes=h.resolve_probes,
+                        clock=h.clock,
+                        config=RouterConfig(merge_wire_dtype=wire))
+        assert r.apply_table(table)
+        return r
+
+    r32 = _router("f32")
+    # head batch: every probed list inside the replicated head →
+    # steered whole; tail batch: probes cross the singleton tail →
+    # owner-wise fan-out
+    q_head = h.make_queries(BATCH, 0)
+    q_tail = h.make_queries(BATCH, n_lists // 2)
+
+    legs = []
+    for name, q in (("steer", q_head), ("fanout_f32", q_tail)):
+        ref_d, ref_i = h.solo(q, K)
+        d, i, dec = r32.route(q, K)
+        bit = bool(np.array_equal(np.asarray(d), ref_d)
+                   and np.array_equal(np.asarray(i), ref_i))
+        st = timeit_stats(lambda: r32.route(q, K),
+                          min(budget, 3.0))
+        legs.append((name, {
+            "mode": dec.mode, "legs": dec.legs,
+            "bit_identical": int(bit),
+            "best_s": round(st["best_s"], 6),
+            "qps": round(BATCH / st["best_s"], 2),
+        }))
+        log(f"fleet {name}: mode={dec.mode} legs={dec.legs} "
+            f"bit_identical={bit} {st['best_s'] * 1e3:.3f} ms/iter")
+
+    # bf16 wire: same fan-out legs, half-width distance payload;
+    # recall vs the solo oracle (ids exact int32 on any wire)
+    rb = _router("bf16")
+    ref_d, ref_i = h.solo(q_tail, K)
+    d, i, dec = rb.route(q_tail, K)
+    ib = np.asarray(i)
+    hits = sum(
+        len(set(ib[row].tolist()) & set(ref_i[row].tolist()))
+        for row in range(ref_i.shape[0]))
+    recall = hits / float(ref_i.size)
+    st = timeit_stats(lambda: rb.route(q_tail, K), min(budget, 3.0))
+    pay32 = route_payload_model(BATCH, K, dec.legs, "f32")
+    pay16 = route_payload_model(BATCH, K, dec.legs, "bf16")
+    log(f"fleet fanout_bf16: recall={recall:.4f} merge bytes "
+        f"{pay32['merge_bytes']} -> {pay16['merge_bytes']}")
+    legs.append(("fanout_bf16", {
+        "mode": dec.mode, "legs": dec.legs,
+        "recall": round(recall, 4),
+        "best_s": round(st["best_s"], 6),
+        "qps": round(BATCH / st["best_s"], 2),
+    }))
+
+    # coverage split on a FRESH router under a fixed 12-head /
+    # 4-tail batch schedule — the timed routers above saw a host-
+    # speed-dependent number of iterations, this column must be
+    # exact at the pinned geometry
+    rc = _router("f32")
+    for b in range(16):
+        start = 0 if b % 4 else n_lists // 2
+        rc.route(h.make_queries(BATCH, start), K)
+    snap = rc.snapshot()["router"]
+    req = snap["requests"]
+    rec = {
+        "replicas": n_replicas, "n_lists": n_lists,
+        "batch": BATCH, "k": K,
+        "table_version": table.version,
+        "replicated_lists": table.replicated_lists(),
+        "cold_owned": len(table.cold_owned),
+        "requests": req,
+        "coverage_rate": round(snap["steered"] / req, 4),
+        "fanout_fraction": round(snap["fanout"] / req, 4),
+        "merge_bytes_f32": pay32["merge_bytes"],
+        "merge_bytes_bf16": pay16["merge_bytes"],
+        "wire_bytes_saved_frac": round(
+            1.0 - pay16["merge_bytes"] / pay32["merge_bytes"], 4),
+    }
+    rec.update(legs)
+    return rec
 
 
 def _serving_rider():

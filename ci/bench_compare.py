@@ -142,6 +142,14 @@ PINNED_ENV = {
     "BENCH_TIER_LISTS": "32",
     "BENCH_TIER_PROBES": "8",
     "BENCH_TIER_SECONDS": "2",
+    # graftroute (PR 20): the fleet-router rider — device-free
+    # N-replica harness, so every structural column (bit-identity,
+    # recall, merge bytes, coverage split) is deterministic at the
+    # pinned geometry
+    "BENCH_FLEET": "1",
+    "BENCH_FLEET_REPLICAS": "4",
+    "BENCH_FLEET_LISTS": "64",
+    "BENCH_FLEET_SECONDS": "1",
 }
 
 # Tolerance bands, keyed by dotted path into the bench record.
@@ -314,6 +322,27 @@ DEFAULT_TOLERANCES = {
         {"max_increase": 0.02},
     "multichip.kmeans_wire.cases.int8.inertia_vs_f32":
         {"max_increase": 0.02},
+    # graftroute fleet router (PR 20). Everything except wall clock
+    # is deterministic in the device-free harness, so the structural
+    # columns are EXACT: steered and f32-wire fan-out answers must
+    # stay bit-identical to the solo oracle, the bf16-wire recall is
+    # a fixed value >= the 0.99 floor at the pinned seed, the
+    # modeled merge payloads follow route_payload_model with zero
+    # slack (bf16 strictly under f32), and the planner's
+    # replication/coverage split cannot drift at the pinned plane.
+    # QPS columns are host-side routing overhead — wide bands.
+    "fleet.steer.bit_identical": {"min_ratio": 1.0},
+    "fleet.fanout_f32.bit_identical": {"min_ratio": 1.0},
+    "fleet.fanout_bf16.recall": {"min_ratio": 0.99},
+    "fleet.merge_bytes_f32": {"min_ratio": 1.0, "max_increase": 0},
+    "fleet.merge_bytes_bf16": {"min_ratio": 1.0, "max_increase": 0},
+    "fleet.wire_bytes_saved_frac": {"min_ratio": 1.0,
+                                    "max_increase": 0},
+    "fleet.replicated_lists": {"min_ratio": 1.0, "max_increase": 0},
+    "fleet.coverage_rate": {"min_ratio": 1.0, "max_increase": 0},
+    "fleet.fanout_fraction": {"min_ratio": 1.0, "max_increase": 0},
+    "fleet.steer.qps": {"min_ratio": 0.30},
+    "fleet.fanout_f32.qps": {"min_ratio": 0.30},
 }
 
 # counters the test session's metrics snapshot must carry ABOVE these
@@ -349,6 +378,12 @@ SNAPSHOT_FLOORS = {
     # accounting) zeroes the lifetime ledger and fails here
     "tier.swaps": 0.0,
     "tier.swap_bytes": 0.0,
+    # graftroute (PR 20): the router must actually route and the
+    # planner must actually plan in the tier-1 session — a refactor
+    # that silently disconnects either (or their metric emission)
+    # zeroes the lifetime ledger and fails structurally
+    "fleet.route.requests": 0.0,
+    "fleet.plan.builds": 0.0,
 }
 
 

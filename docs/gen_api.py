@@ -50,6 +50,9 @@ MODULES = [
     "raft_tpu.serving.flight", "raft_tpu.serving.continuous",
     "raft_tpu.serving.federation", "raft_tpu.serving.placement",
     "raft_tpu.serving.prefetch",
+    "raft_tpu.fleet", "raft_tpu.fleet.table",
+    "raft_tpu.fleet.planner", "raft_tpu.fleet.router",
+    "raft_tpu.fleet.harness",
     "raft_tpu.core.profiling",
     "raft_tpu.core.xplane", "raft_tpu.core.memwatch",
     "raft_tpu.comms", "raft_tpu.comms.bootstrap",

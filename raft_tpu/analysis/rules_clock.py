@@ -42,7 +42,8 @@ from typing import Iterable, List, Set
 from raft_tpu.analysis import astutil
 from raft_tpu.analysis.core import Finding, Project, rule
 
-SERVING_PREFIX = "raft_tpu/serving/"
+SERVING_PREFIXES = ("raft_tpu/serving/", "raft_tpu/fleet/")
+SERVING_PREFIX = SERVING_PREFIXES[0]
 # PR 13: graftledger's core module is additionally in scope — the
 # ledger publishes through the same scrape machinery the serving
 # frontend does, and a wall-clock read sneaking into it (a staleness
@@ -147,7 +148,7 @@ def check_clock_discipline(project: Project) -> Iterable[Finding]:
     measured."""
     out: List[Finding] = []
     for f in project.lib():
-        if f.tree is None or (not f.rel.startswith(SERVING_PREFIX)
+        if f.tree is None or (not f.rel.startswith(SERVING_PREFIXES)
                               and f.rel not in EXTRA_FILES):
             continue
         clock_spans = _clock_class_spans(f.tree)

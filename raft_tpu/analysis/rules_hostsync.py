@@ -35,7 +35,8 @@ from raft_tpu.analysis import astutil
 from raft_tpu.analysis.core import Finding, Project, rule
 
 HOT_PREFIXES = ("raft_tpu/ops/", "raft_tpu/distributed/",
-                "raft_tpu/neighbors/", "raft_tpu/serving/")
+                "raft_tpu/neighbors/", "raft_tpu/serving/",
+                "raft_tpu/fleet/")
 # core/memwatch.py joined in PR 13: its watermark sample runs on the
 # executor's dispatch path, so a stray .item()/device_get there taxes
 # every search in the process (the module itself is shape/dtype

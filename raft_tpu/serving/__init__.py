@@ -85,7 +85,12 @@ from raft_tpu.serving.continuous import (
     ContinuousConfig,
 )
 from raft_tpu.serving.exporter import MetricsExporter
-from raft_tpu.serving.federation import FleetAggregator, FleetConfig
+from raft_tpu.serving.federation import (
+    FleetAggregator,
+    FleetConfig,
+    ProbePlaneView,
+    ReplicaHeadroom,
+)
 from raft_tpu.serving.flight import (
     FlightConfig,
     FlightRecorder,
@@ -143,7 +148,9 @@ __all__ = [
     "Overloaded",
     "PlacementConfig",
     "PlacementPlan",
+    "ProbePlaneView",
     "RecallWindow",
+    "ReplicaHeadroom",
     "ResultHandle",
     "SearchRequest",
     "ServingError",

@@ -113,6 +113,7 @@ import urllib.parse
 from typing import Optional
 
 from raft_tpu.core import tracing
+from raft_tpu.core.validation import RaftError
 from raft_tpu.serving import metrics as serving_metrics
 
 _NAME_SUB = re.compile(r"[^a-zA-Z0-9_:]").sub
@@ -150,6 +151,12 @@ _MEM_DEVICE_GAUGE = re.compile(
     r"^memory\.device\.([0-9]+)\.([a-z0-9_]+)$")
 _FLEET_MEM_INDEX_GAUGE = re.compile(
     r"^fleet\.memory\.index\.([^.]+)\.(resident_bytes)$")
+# graftroute labeled families: per-replica steer counts and planned
+# hot-set sizes
+_ROUTE_REPLICA_GAUGE = re.compile(
+    r"^fleet\.route\.replica\.([^.]+)\.([a-z0-9_]+)$")
+_PLAN_REPLICA_GAUGE = re.compile(
+    r"^fleet\.plan\.replica\.([^.]+)\.([a-z0-9_]+)$")
 # per-params-class latency histograms (PR 11 graftflight satellite):
 # serving.batcher.execute_seconds.p<NP> renders as the base family
 # with a params_class label, pairing the sweep recall gauges
@@ -191,6 +198,9 @@ _HELP_PREFIXES = (
                       "min, resident sum)"),
     ("fleet.slo.", "graftledger fleet-level multiburn alert over the "
                    "merged SLO windows"),
+    ("fleet.route.", "graftroute query routing (steer coverage, "
+                     "fan-out, table lifecycle)"),
+    ("fleet.plan.", "graftroute fleet placement planning"),
     ("fleet.", "graftfleet multi-replica federation"),
     ("memory.", "graftledger device-memory truth (resident model, "
                 "live stats, reservation forecast)"),
@@ -336,6 +346,18 @@ def render_prometheus(counters: dict, gauges: dict, histograms: dict,
                         f"index_drift_{prom_name(m.group(2))}",
                         "index.drift.", f'index="{m.group(1)}"', v)
                     continue
+                m = _ROUTE_REPLICA_GAUGE.match(name)
+                if m:
+                    add_labeled(
+                        f"fleet_route_replica_{prom_name(m.group(2))}",
+                        "fleet.route.", f'replica="{m.group(1)}"', v)
+                    continue
+                m = _PLAN_REPLICA_GAUGE.match(name)
+                if m:
+                    add_labeled(
+                        f"fleet_plan_replica_{prom_name(m.group(2))}",
+                        "fleet.plan.", f'replica="{m.group(1)}"', v)
+                    continue
                 m = _FLEET_REPLICA_GAUGE.match(name)
                 if m:
                     add_labeled(
@@ -427,7 +449,7 @@ class MetricsExporter:
                  profile_dir: Optional[str] = None,
                  legacy_executable_metrics: bool = False,
                  index_gauge=None, flight=None, continuous=None,
-                 fleet=None, memory=None, tier=None):
+                 fleet=None, memory=None, tier=None, route=None):
         self.executor = executor
         self.batcher = batcher
         self.host = host
@@ -457,6 +479,13 @@ class MetricsExporter:
         # continuous capture — the exporter is the one periodic pulse
         # every serving process already has
         self.tier = tier
+        # graftroute: a QueryRouter backs /route.json, refreshes the
+        # fleet.route.* gauges per scrape, and accepts routing-table
+        # delivery on the same POST /push channel the federation uses
+        # (?route=1 — NAT-bound replicas can't be scraped OR pushed to,
+        # so the control plane pushes the table through the exporter
+        # they already reach)
+        self.route = route
         self._profile_lock = threading.Lock()
         # /memory_profile capture sequence — a counter, not a clock
         # read (R7): the file name only needs to be unique per process
@@ -681,6 +710,18 @@ class MetricsExporter:
                 "with tier=... to arm /tier.json")
         return self.tier.snapshot()
 
+    def route_snapshot(self) -> dict:
+        """The ``/route.json`` body: the attached
+        :class:`~raft_tpu.fleet.router.QueryRouter`'s live routing
+        table + router view. Raises ``LookupError`` when no router
+        is attached (or none applied a table yet) — the HTTP layer
+        maps it to 404."""
+        if self.route is None:
+            raise LookupError(
+                "no QueryRouter attached: construct MetricsExporter "
+                "with route=... to arm /route.json")
+        return self.route.snapshot()
+
     def _refresh(self) -> None:
         """Re-publish the poll-style gauges from the attached executor
         and batcher so a scrape of a quiet service (or one taken after
@@ -713,6 +754,11 @@ class MetricsExporter:
             # at most one epoch, like the continuous capture)
             self.tier.publish_gauges()
             self.tier.tick()
+        if self.route is not None:
+            # graftroute: refresh the coverage/fan-out/table-age
+            # gauges from the router's counters (scrape-driven, like
+            # the tier layout gauges)
+            self.route.publish_gauges()
         if self.flight is not None:
             # graftflight: evaluate the incident triggers — a firing
             # multiburn alert / latency anomaly captures here, rate
@@ -810,6 +856,14 @@ class MetricsExporter:
                         return
                     self._send(json.dumps(out, default=str).encode(),
                                "application/json")
+                elif path == "/route.json":
+                    try:
+                        out = exporter.route_snapshot()
+                    except LookupError as e:
+                        self._send(f"{e}\n".encode(), "text/plain", 404)
+                        return
+                    self._send(json.dumps(out, default=str).encode(),
+                               "application/json")
                 elif path == "/memory_profile":
                     diff_seq = None
                     if "diff" in qs:
@@ -893,6 +947,39 @@ class MetricsExporter:
                                            keep_blank_values=True)
                 if path != "/push":
                     self._send(b"not found\n", "text/plain", 404)
+                    return
+                if "route" in qs:
+                    # graftroute table delivery: the control plane
+                    # pushes a fresh routing table over the channel
+                    # a NAT-bound replica already exposes; version
+                    # gating makes out-of-order delivery harmless
+                    # (stale -> 409, the pusher's signal to re-read
+                    # /route.json before trying again)
+                    if exporter.route is None:
+                        self._send(b"no QueryRouter attached\n",
+                                   "text/plain", 404)
+                        return
+                    try:
+                        length = int(
+                            self.headers.get("Content-Length", 0))
+                        if length > 8 * 1024 * 1024:
+                            self._send(b"table body too large\n",
+                                       "text/plain", 413)
+                            return
+                        doc = json.loads(
+                            self.rfile.read(length).decode())
+                        applied = exporter.route.apply_table(doc)
+                    except (ValueError, UnicodeDecodeError,
+                            RaftError) as e:
+                        self._send(f"bad routing table: {e}\n"
+                                   .encode(), "text/plain", 400)
+                        return
+                    if not applied:
+                        self._send(b"stale table version\n",
+                                   "text/plain", 409)
+                        return
+                    self._send(json.dumps({"applied": True}).encode(),
+                               "application/json")
                     return
                 # federation push mode (PR 13): replicas behind NAT
                 # POST the same body they would serve at

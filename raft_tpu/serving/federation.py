@@ -78,7 +78,7 @@ import math
 import re
 import threading
 import urllib.request
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from raft_tpu.core import tracing
 from raft_tpu.serving.batcher import MonotonicClock
@@ -158,6 +158,35 @@ class ReplicaState:
     def healthy(self, now: float, staleness_s: float) -> bool:
         return self.snapshot is not None and \
             self.age_s(now) <= staleness_s
+
+
+@dataclasses.dataclass(frozen=True)
+class ProbePlaneView:
+    """One merged probe plane, typed (graftroute's planner input —
+    the planner must never parse the ``/fleet.json`` dict by string
+    key). ``counts`` is the elementwise sum over every replica that
+    ever reported the label (stale last-known retained — the plane
+    is cumulative, like the counters); ``stale_replicas`` names the
+    contributors whose snapshot is past the staleness horizon."""
+
+    label: str
+    counts: Tuple[int, ...]
+    replicas: Tuple[str, ...]
+    stale_replicas: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaHeadroom:
+    """One replica's memory headroom, typed, with the staleness
+    metadata a planner needs to discount it. ``headroom_bytes`` is
+    None when the replica is stale or reported no (finite) headroom
+    — absence of evidence, never a guessed number."""
+
+    name: str
+    headroom_bytes: Optional[float]
+    age_s: Optional[float]
+    healthy: bool
+    push: bool
 
 
 def _http_fetch(url: str, timeout: float) -> dict:
@@ -713,6 +742,102 @@ class FleetAggregator:
             now = self._clock.now()
         self.scrape(now)
         return self.merge(now)
+
+    # -- typed accessors (graftroute planner inputs) -------------------------
+
+    def merged_probe_plane(self, label: str,
+                           now: Optional[float] = None
+                           ) -> ProbePlaneView:
+        """The merged probe plane for ``label``, typed — same
+        elementwise-sum semantics as the ``/fleet.json`` merge
+        (stale last-known retained; the plane is cumulative), read
+        from the STORED snapshots (no fetch). Raises ``LookupError``
+        when no replica ever reported the label."""
+        if now is None:
+            now = self._clock.now()
+        stale_s = self.config.staleness_s
+        acc: Optional[List[int]] = None
+        contrib: List[str] = []
+        stale: List[str] = []
+        with self._lock:
+            states = sorted(self._states.values(),
+                            key=lambda s: s.name)
+            for s in states:
+                if s.snapshot is None:
+                    continue
+                fed = s.snapshot.get("federation") or {}
+                plane = (fed.get("probe_planes") or {}).get(label)
+                if plane is None:
+                    continue
+                if acc is None:
+                    acc = [0] * len(plane)
+                if len(acc) != len(plane):
+                    continue
+                for i, v in enumerate(plane):
+                    acc[i] += int(v)
+                contrib.append(s.name)
+                if not s.healthy(now, stale_s):
+                    stale.append(s.name)
+        if acc is None:
+            raise LookupError(
+                f"no replica reported probe plane {label!r}")
+        return ProbePlaneView(label=label, counts=tuple(acc),
+                              replicas=tuple(contrib),
+                              stale_replicas=tuple(stale))
+
+    def probe_plane_labels(self) -> Tuple[str, ...]:
+        """Every probe-plane label any replica ever reported."""
+        labels: set = set()
+        with self._lock:
+            for s in self._states.values():
+                if s.snapshot is None:
+                    continue
+                fed = s.snapshot.get("federation") or {}
+                labels.update(fed.get("probe_planes") or {})
+        return tuple(sorted(labels))
+
+    def replica_headroom(self, now: Optional[float] = None
+                         ) -> Tuple[ReplicaHeadroom, ...]:
+        """Per-replica memory headroom, typed, sorted by name — one
+        entry per REGISTERED replica (unreported/stale headroom is
+        None with the staleness metadata attached, so a planner can
+        tell 'no room' from 'no evidence')."""
+        if now is None:
+            now = self._clock.now()
+        stale_s = self.config.staleness_s
+        out: List[ReplicaHeadroom] = []
+        with self._lock:
+            states = sorted(self._states.values(),
+                            key=lambda s: s.name)
+            for s in states:
+                ok = s.healthy(now, stale_s)
+                room = None
+                if ok:
+                    mem = s.snapshot.get("memory")
+                    if isinstance(mem, dict):
+                        v = mem.get("headroom_bytes")
+                        try:
+                            v = float(v)
+                        except (TypeError, ValueError):
+                            v = None
+                        if v is not None and math.isfinite(v):
+                            room = v
+                age = None if s.scraped_at is None \
+                    else now - s.scraped_at
+                out.append(ReplicaHeadroom(
+                    name=s.name, headroom_bytes=room, age_s=age,
+                    healthy=ok, push=s.push))
+        return tuple(out)
+
+    def replica_health(self, now: Optional[float] = None
+                       ) -> Dict[str, bool]:
+        """Replica name → healthy (the router's steer gate)."""
+        if now is None:
+            now = self._clock.now()
+        stale_s = self.config.staleness_s
+        with self._lock:
+            return {s.name: s.healthy(now, stale_s)
+                    for s in self._states.values()}
 
     # -- Prometheus exposition ----------------------------------------------
 

@@ -1337,6 +1337,52 @@ class TestGrafttierScopeProofs:
                        rel="raft_tpu/serving/placement.py")
         assert rules_fired(bad) == {"R5"}
 
+    def test_r5_covers_fleet(self):
+        """PR 20: graftroute modules are serving-hot — a host fetch
+        inside a fleet search path (or a traced body) must fire R5
+        exactly as it would in raft_tpu/serving/."""
+        fleet_sync = (
+            "import numpy as np\n"
+            "\n"
+            "\n"
+            "def search_fanout(handles):\n"
+            "    return [np.asarray(h.result()) for h in handles]\n"
+        )
+        bad = lint_lib(fleet_sync, ["R5"],
+                       rel="raft_tpu/fleet/router.py")
+        assert rules_fired(bad) == {"R5"}
+        # the router's actual discipline: no search*-named host
+        # functions, merges stay in jnp
+        ok = (
+            "import jax.numpy as jnp\n"
+            "\n"
+            "\n"
+            "def merge_legs(parts, k):\n"
+            "    return jnp.concatenate(parts, axis=1)[:, :k]\n"
+        )
+        assert lint_lib(ok, ["R5"],
+                        rel="raft_tpu/fleet/router.py").ok
+
+    def test_r7_covers_fleet(self):
+        """PR 20: the router measures table age — only against the
+        injected clock, same discipline as the serving frontend."""
+        table_age = (
+            "import time\n"
+            "\n"
+            "\n"
+            "def table_age(applied_at):\n"
+            "    return time.monotonic() - applied_at\n"
+        )
+        bad = lint_lib(table_age, ["R7"],
+                       rel="raft_tpu/fleet/router.py")
+        assert rules_fired(bad) == {"R7"}
+        ok = (
+            "def table_age(clock, applied_at):\n"
+            "    return clock.now() - applied_at\n"
+        )
+        assert lint_lib(ok, ["R5", "R7"],
+                        rel="raft_tpu/fleet/router.py").ok
+
     def test_r7_covers_placement(self):
         epoch_clock = (
             "import time\n"

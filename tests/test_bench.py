@@ -730,7 +730,9 @@ class TestBenchCompare:
                            "fleet.scrapes": 1.0,
                            "memory.samples": 8.0,
                            "tier.swaps": 2.0,
-                           "tier.swap_bytes": 1e5}}
+                           "tier.swap_bytes": 1e5,
+                           "fleet.route.requests": 4.0,
+                           "fleet.plan.builds": 2.0}}
         assert bc.check_snapshot(ok) == []
         dark = {"counters": {"serving.execute.calls": 5.0,
                              "serving.execute.modeled_bytes": 0.0}}
@@ -759,6 +761,8 @@ class TestBenchCompare:
             "memory.samples": 8.0,
                 "tier.swaps": 2.0,
                 "tier.swap_bytes": 1e5,
+                "fleet.route.requests": 4.0,
+                "fleet.plan.builds": 2.0,
             },
         }
         assert bc.check_snapshot(snap) == []
@@ -822,6 +826,8 @@ class TestBenchCompare:
             "memory.samples": 8.0,
             "tier.swaps": 2.0,
             "tier.swap_bytes": 1e5,
+            "fleet.route.requests": 4.0,
+            "fleet.plan.builds": 2.0,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("index.probe_freq.accounted" in m for m in msgs)
@@ -850,6 +856,8 @@ class TestBenchCompare:
             "memory.samples": 8.0,
             "tier.swaps": 2.0,
             "tier.swap_bytes": 1e5,
+            "fleet.route.requests": 4.0,
+            "fleet.plan.builds": 2.0,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("profiling.captures" in m for m in msgs)
@@ -888,6 +896,8 @@ class TestBenchCompare:
             "memory.samples": 8.0,
             "tier.swaps": 2.0,
             "tier.swap_bytes": 1e5,
+            "fleet.route.requests": 4.0,
+            "fleet.plan.builds": 2.0,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("profiling.rolling.folds" in m for m in msgs)
@@ -929,6 +939,8 @@ class TestBenchCompare:
             "memory.samples": 0.0,                 # watermark dark
             "tier.swaps": 2.0,
             "tier.swap_bytes": 1e5,
+            "fleet.route.requests": 4.0,
+            "fleet.plan.builds": 2.0,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("memory.samples" in m for m in msgs)
@@ -964,6 +976,8 @@ class TestBenchCompare:
             "memory.samples": 8.0,
             "tier.swaps": 0.0,                     # swaps dark
             "tier.swap_bytes": 1e5,
+            "fleet.route.requests": 4.0,
+            "fleet.plan.builds": 2.0,
         }}
         msgs = bc.check_snapshot(dark)
         assert any("tier.swaps" in m for m in msgs)
